@@ -1,0 +1,72 @@
+"""incubator_mxnet_tpu — a TPU-native deep-learning framework with the
+capabilities of Apache MXNet 1.x (reference: janucaria/incubator-mxnet).
+
+Not a port: the reference's threaded dependency engine, mshadow/cuDNN/NCCL
+kernels and ps-lite parameter server are replaced by XLA's async runtime over
+PjRt buffers, jax.numpy/lax + Pallas kernels, ``hybridize()`` → ``jax.jit``
+compilation, and mesh collectives over ICI/DCN. See SURVEY.md for the
+component-by-component mapping.
+
+Conventional import:  ``import incubator_mxnet_tpu as mx``
+"""
+
+__version__ = "0.1.0"
+
+from .base import MXNetError  # noqa: F401
+from .context import (  # noqa: F401
+    Context, cpu, gpu, tpu, cpu_pinned, cpu_shared, current_context,
+    num_gpus, num_tpus,
+)
+from . import base  # noqa: F401
+from . import engine  # noqa: F401
+from . import random  # noqa: F401
+from . import autograd  # noqa: F401
+from . import ndarray  # noqa: F401
+from . import ndarray as nd  # noqa: F401
+from .ndarray import NDArray  # noqa: F401
+from .engine import waitall  # noqa: F401
+
+# Submodules that build on the core are imported lazily to keep import light
+# and to allow partial builds during bootstrapping.
+import importlib as _importlib
+
+_LAZY = {
+    "gluon": ".gluon",
+    "optimizer": ".optimizer",
+    "initializer": ".initializer",
+    "init": ".initializer",
+    "metric": ".metric",
+    "lr_scheduler": ".lr_scheduler",
+    "kvstore": ".kvstore",
+    "kv": ".kvstore",
+    "io": ".io",
+    "image": ".image",
+    "recordio": ".recordio",
+    "parallel": ".parallel",
+    "profiler": ".profiler",
+    "amp": ".amp",
+    "contrib": ".contrib",
+    "runtime": ".runtime",
+    "test_utils": ".test_utils",
+    "util": ".util",
+    "callback": ".callback",
+    "model": ".model",
+    "module": ".module",
+    "symbol": ".symbol",
+    "sym": ".symbol",
+    "onnx": ".onnx",
+    "numpy": ".numpy",
+    "np": ".numpy",
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        mod = _importlib.import_module(_LAZY[name], __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_LAZY))

@@ -1,0 +1,267 @@
+"""Autograd: imperative tape + reverse-mode differentiation over jax.vjp.
+
+TPU-native counterpart of ``src/imperative/imperative.cc``
+(``Imperative::RecordOp`` / ``Imperative::Backward``) and the Python surface
+``python/mxnet/autograd.py``. Where the reference records an NNVM graph and
+runs the ``Gradient`` pass, we record each dispatched op as a pure JAX
+function plus value snapshots, and differentiate node-by-node with
+``jax.vjp`` in reverse tape order. XLA still sees whole fused backward
+computations on the hybridized (jit) path — this tape only serves eager mode,
+exactly like the reference's imperative path.
+
+Semantics notes (divergences documented per SURVEY §7 "hard parts"):
+- Input values are snapshotted at record time, so later in-place mutation of
+  an input does not corrupt the recorded graph; mutating an array that is
+  *itself* required for gradient (i.e. has been recorded) raises, as MXNet
+  does.
+- ``create_graph=True`` (higher-order imperative grad) is not supported on the
+  eager tape; use the functional ``hybridize`` path / ``jax.grad`` for that.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError, _as_list
+
+__all__ = [
+    "record",
+    "pause",
+    "train_mode",
+    "predict_mode",
+    "is_recording",
+    "is_training",
+    "mark_variables",
+    "backward",
+    "grad",
+    "get_symbol",
+]
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.recording = False
+        self.training = False
+        self.tape: List["Node"] = []
+
+
+_STATE = _State()
+
+
+class Node:
+    """One recorded op: a pure function and its I/O bindings."""
+
+    __slots__ = ("fn", "inputs", "input_values", "outputs", "name")
+
+    def __init__(self, fn, inputs, input_values, outputs, name=""):
+        self.fn = fn                    # pure: (*jnp arrays) -> jnp array | tuple
+        self.inputs = inputs            # List[NDArray] (for grad routing)
+        self.input_values = input_values  # List[jax.Array] snapshot
+        self.outputs = outputs          # List[NDArray]
+        self.name = name
+
+
+# ---------------------------------------------------------------------------
+# Recording scopes
+# ---------------------------------------------------------------------------
+
+class _RecordingScope:
+    def __init__(self, recording: Optional[bool], training: Optional[bool]):
+        self._rec = recording
+        self._train = training
+        self._prev: Optional[tuple] = None
+
+    def __enter__(self):
+        self._prev = (_STATE.recording, _STATE.training)
+        if self._rec is not None:
+            _STATE.recording = self._rec
+        if self._train is not None:
+            _STATE.training = self._train
+        return self
+
+    def __exit__(self, *exc):
+        _STATE.recording, _STATE.training = self._prev
+
+
+def record(train_mode: bool = True) -> _RecordingScope:
+    """Scope in which executed ops are recorded for backward()."""
+    return _RecordingScope(True, train_mode)
+
+
+def pause(train_mode: bool = False) -> _RecordingScope:
+    """Scope in which recording is suspended."""
+    return _RecordingScope(False, train_mode)
+
+
+def train_mode() -> _RecordingScope:
+    return _RecordingScope(None, True)
+
+
+def predict_mode() -> _RecordingScope:
+    return _RecordingScope(None, False)
+
+
+def is_recording() -> bool:
+    return _STATE.recording
+
+
+def is_training() -> bool:
+    return _STATE.training
+
+
+def set_recording(flag: bool) -> bool:
+    prev, _STATE.recording = _STATE.recording, flag
+    return prev
+
+
+def set_training(flag: bool) -> bool:
+    prev, _STATE.training = _STATE.training, flag
+    return prev
+
+
+# ---------------------------------------------------------------------------
+# Tape
+# ---------------------------------------------------------------------------
+
+def _record_node(fn, inputs, input_values, outputs, name="") -> None:
+    node = Node(fn, list(inputs), list(input_values), list(outputs), name)
+    _STATE.tape.append(node)
+    for arr in node.outputs:
+        arr._fresh_grad_node = node  # mark as produced-on-tape
+
+
+def clear_tape() -> None:
+    for node in _STATE.tape:
+        for arr in node.outputs:
+            arr._fresh_grad_node = None
+    _STATE.tape.clear()
+
+
+def mark_variables(variables, gradients, grad_reqs="write") -> None:
+    """Attach gradient buffers to arrays (MXAutogradMarkVariables parity)."""
+    variables = _as_list(variables)
+    gradients = _as_list(gradients)
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for var, g, req in zip(variables, gradients, grad_reqs):
+        var._grad = g
+        var._grad_req = req
+
+
+def _is_float0(x) -> bool:
+    return getattr(x, "dtype", None) == jax.dtypes.float0
+
+
+def backward(
+    heads,
+    head_grads=None,
+    retain_graph: bool = False,
+    train_mode: bool = True,
+) -> None:
+    """Run reverse accumulation from ``heads`` into attached ``.grad`` buffers.
+
+    Reference: ``Imperative::Backward`` (src/imperative/imperative.cc).
+    """
+    heads = _as_list(heads)
+    head_grads = _as_list(head_grads) if head_grads is not None else [None] * len(heads)
+
+    grad_map: Dict[int, Any] = {}
+    for h, hg in zip(heads, head_grads):
+        g = hg._data if hasattr(hg, "_data") else hg
+        if g is None:
+            g = jnp.ones(h.shape, h._data.dtype)
+        grad_map[id(h)] = grad_map.get(id(h), 0) + g
+
+    # The tape is in execution order == a valid topological order.
+    for node in reversed(_STATE.tape):
+        out_grads = [grad_map.get(id(o)) for o in node.outputs]
+        if all(g is None for g in out_grads):
+            continue
+        cotangents = []
+        primal_out, vjp_fn = jax.vjp(node.fn, *node.input_values)
+        outs = primal_out if isinstance(primal_out, (tuple, list)) else (primal_out,)
+        for o, g in zip(outs, out_grads):
+            if g is None:
+                cotangents.append(jnp.zeros(o.shape, o.dtype))
+            else:
+                cotangents.append(jnp.asarray(g, o.dtype))
+        cot = tuple(cotangents) if isinstance(primal_out, (tuple, list)) else cotangents[0]
+        in_grads = vjp_fn(cot)
+        for arr, g in zip(node.inputs, in_grads):
+            if g is None or _is_float0(g):
+                continue
+            prev = grad_map.get(id(arr))
+            grad_map[id(arr)] = g if prev is None else prev + g
+
+    # Deposit into attached grad buffers.
+    seen = set()
+    for node in _STATE.tape:
+        for arr in node.inputs + node.outputs:
+            if id(arr) in seen:
+                continue
+            seen.add(id(arr))
+            _deposit(arr, grad_map)
+    for h in heads:
+        if id(h) not in seen:
+            _deposit(h, grad_map)
+
+    if not retain_graph:
+        clear_tape()
+
+
+def _deposit(arr, grad_map) -> None:
+    req = getattr(arr, "_grad_req", "null")
+    if req == "null" or getattr(arr, "_grad", None) is None:
+        return
+    g = grad_map.get(id(arr))
+    if g is None:
+        return
+    g = jnp.asarray(g, arr._data.dtype)
+    if req == "add":
+        arr._grad._data = arr._grad._data + g
+    else:  # 'write'
+        arr._grad._data = g
+
+
+def grad(
+    heads,
+    variables,
+    head_grads=None,
+    retain_graph: Optional[bool] = None,
+    create_graph: bool = False,
+    train_mode: bool = True,
+):
+    """Return gradients of heads w.r.t. variables (MXAutogradBackwardEx with
+    variable outputs). ``create_graph`` is unsupported on the eager tape."""
+    if create_graph:
+        raise MXNetError(
+            "create_graph=True is not supported on the eager tape; "
+            "use the hybridize/jit path (jax.grad) for higher-order grads"
+        )
+    from .ndarray import NDArray  # circular-safe local import
+
+    variables = _as_list(variables)
+    heads = _as_list(heads)
+    saved = [(v, getattr(v, "_grad", None), getattr(v, "_grad_req", "null")) for v in variables]
+    out = []
+    try:
+        for v in variables:
+            v._grad = NDArray(jnp.zeros(v.shape, v._data.dtype), ctx=v.context)
+            v._grad_req = "write"
+        backward(heads, head_grads, retain_graph=True, train_mode=train_mode)
+        out = [v._grad for v in variables]
+    finally:
+        for v, g, req in saved:
+            v._grad, v._grad_req = g, req
+        if retain_graph is False or retain_graph is None:
+            clear_tape()
+    return out
+
+
+def get_symbol(x):
+    """Reference parity stub (autograd.get_symbol): the eager tape has no NNVM
+    symbol; use HybridBlock.export for graph capture."""
+    raise MXNetError("get_symbol is not supported; hybridize() captures graphs")

@@ -1,0 +1,127 @@
+"""Base utilities: dtype handling, env-var config plane, registries.
+
+TPU-native counterpart of the reference's dmlc-core roles
+(``3rdparty/dmlc-core/include/dmlc/``: ``dmlc::GetEnv``, ``dmlc::Registry``,
+``dmlc::Parameter``) and ``include/mxnet/base.h``. See SURVEY.md §2.1/§5.6.
+
+Design: no C ABI is needed between Python and the device runtime — JAX/PjRt is
+the runtime boundary. The registry here plays the role of the reference's
+``dmlc::Registry`` / NNVM op registry for Python-visible components
+(optimizers, initializers, kvstores, losses, data iterators, metrics).
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, Generic, List, Optional, Type, TypeVar
+
+import numpy as onp
+
+__all__ = [
+    "MXNetError",
+    "get_env",
+    "env_bool",
+    "env_int",
+    "Registry",
+    "string_types",
+    "numeric_types",
+    "integer_types",
+    "_as_list",
+]
+
+
+class MXNetError(RuntimeError):
+    """Error raised by the framework (name kept for reference parity:
+    ``python/mxnet/base.py (MXNetError)``)."""
+
+
+string_types = (str,)
+numeric_types = (float, int, onp.generic)
+integer_types = (int, onp.integer)
+
+
+def _as_list(obj) -> list:
+    """Normalize an object to a list (reference: ``python/mxnet/base.py``)."""
+    if obj is None:
+        return []
+    if isinstance(obj, (list, tuple)):
+        return list(obj)
+    return [obj]
+
+
+# ---------------------------------------------------------------------------
+# Env-var config plane (reference: dmlc::GetEnv; catalog in docs/ENV_VARS.md)
+# ---------------------------------------------------------------------------
+
+def get_env(name: str, default: Any = None, typ: Optional[type] = None) -> Any:
+    """Read a config env var (``MXNET_*`` namespace kept for familiarity)."""
+    val = os.environ.get(name)
+    if val is None:
+        return default
+    if typ is None and default is not None:
+        typ = type(default)
+    if typ is bool:
+        return val.lower() in ("1", "true", "yes", "on")
+    if typ is not None:
+        return typ(val)
+    return val
+
+
+def env_bool(name: str, default: bool = False) -> bool:
+    return get_env(name, default, bool)
+
+
+def env_int(name: str, default: int = 0) -> int:
+    return get_env(name, default, int)
+
+
+# ---------------------------------------------------------------------------
+# Registry (reference: dmlc::Registry / python/mxnet/registry.py)
+# ---------------------------------------------------------------------------
+
+T = TypeVar("T")
+
+
+class Registry(Generic[T]):
+    """A named registry of classes/functions with alias support."""
+
+    _registries: Dict[str, "Registry"] = {}
+
+    def __init__(self, name: str):
+        self.name = name
+        self._entries: Dict[str, T] = {}
+        Registry._registries[name] = self
+
+    @staticmethod
+    def get(name: str) -> "Registry":
+        if name not in Registry._registries:
+            Registry(name)
+        return Registry._registries[name]
+
+    def register(self, entry: Optional[T] = None, name: Optional[str] = None):
+        def _do(e: T) -> T:
+            key = (name or getattr(e, "__name__", str(e))).lower()
+            self._entries[key] = e
+            return e
+
+        if entry is None:
+            return _do
+        return _do(entry)
+
+    def alias(self, existing: str, *aliases: str) -> None:
+        for a in aliases:
+            self._entries[a.lower()] = self._entries[existing.lower()]
+
+    def find(self, name: str) -> Optional[T]:
+        return self._entries.get(name.lower())
+
+    def create(self, name: str, *args, **kwargs):
+        entry = self.find(name)
+        if entry is None:
+            raise MXNetError(
+                f"{self.name} registry has no entry '{name}'. "
+                f"Known: {sorted(self._entries)}"
+            )
+        return entry(*args, **kwargs)
+
+    def list(self) -> List[str]:
+        return sorted(self._entries)

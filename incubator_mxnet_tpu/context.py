@@ -1,0 +1,177 @@
+"""Device Context model.
+
+TPU-native counterpart of ``include/mxnet/base.h (mxnet::Context)`` and
+``python/mxnet/context.py``. The north star (BASELINE.json) asks for TPU as a
+first-class Context: ``mx.tpu()``. Under JAX, a Context maps onto a concrete
+``jax.Device``; NDArray storage lives in PjRt device buffers addressed by it.
+
+Differences from the reference, by design:
+- ``gpu`` is accepted as an alias of the accelerator context so that reference
+  scripts run unchanged on TPU machines (``mx.gpu(0)`` → accelerator 0).
+- ``cpu_pinned``/``cpu_shared`` map to plain host CPU; PjRt manages pinned
+  staging internally and DataLoader sharing uses OS shm at the io layer.
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+import jax
+
+from .base import MXNetError
+
+__all__ = [
+    "Context",
+    "cpu",
+    "gpu",
+    "tpu",
+    "cpu_pinned",
+    "cpu_shared",
+    "current_context",
+    "num_gpus",
+    "num_tpus",
+]
+
+
+def _accel_platforms() -> List[str]:
+    return [p for p in ("tpu", "axon", "gpu", "cuda", "rocm")]
+
+
+def _devices_for(dev_type: str) -> List[jax.Device]:
+    """Concrete jax devices backing a context type."""
+    all_devices = jax.devices()
+    if dev_type in ("cpu", "cpu_pinned", "cpu_shared"):
+        try:
+            return jax.devices("cpu")
+        except RuntimeError:
+            # CPU platform absent (rare) — fall back to default devices.
+            return all_devices
+    # accelerator types: tpu (and gpu as an alias)
+    accel = [d for d in all_devices if d.platform not in ("cpu",)]
+    if accel:
+        return accel
+    # No accelerator present: transparently fall back to CPU so that
+    # device-parametrized test suites (SURVEY §4.1) run everywhere.
+    return jax.devices("cpu") if _has_cpu() else all_devices
+
+
+def _has_cpu() -> bool:
+    try:
+        jax.devices("cpu")
+        return True
+    except RuntimeError:
+        return False
+
+
+class Context:
+    """A device context ``(device_type, device_id)``.
+
+    Reference parity: ``mxnet::Context`` devtype ids (kCPU=1, kGPU=2,
+    kCPUPinned=3, kCPUShared=5) plus the new first-class kTPU=6.
+    """
+
+    devtype2id = {"cpu": 1, "gpu": 2, "cpu_pinned": 3, "cpu_shared": 5, "tpu": 6}
+    devid2type = {1: "cpu", 2: "gpu", 3: "cpu_pinned", 5: "cpu_shared", 6: "tpu"}
+
+    _default = threading.local()
+
+    def __init__(self, device_type: str, device_id: int = 0):
+        if isinstance(device_type, Context):
+            device_id = device_type.device_id
+            device_type = device_type.device_type
+        if device_type not in self.devtype2id:
+            raise MXNetError(f"Unknown device type {device_type!r}")
+        self.device_type = device_type
+        self.device_id = device_id
+
+    # -- identity ----------------------------------------------------------
+    @property
+    def device_typeid(self) -> int:
+        return self.devtype2id[self.device_type]
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, Context)
+            and self.device_type == other.device_type
+            and self.device_id == other.device_id
+        )
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    def __repr__(self):
+        return f"{self.device_type}({self.device_id})"
+
+    def __str__(self):
+        return self.__repr__()
+
+    # -- jax mapping -------------------------------------------------------
+    @property
+    def jax_device(self) -> jax.Device:
+        devs = _devices_for(self.device_type)
+        if self.device_id >= len(devs):
+            raise MXNetError(
+                f"{self}: device_id out of range, only {len(devs)} "
+                f"device(s) of this type are visible"
+            )
+        return devs[self.device_id]
+
+    @property
+    def is_accelerator(self) -> bool:
+        return self.jax_device.platform != "cpu"
+
+    # -- scoping -----------------------------------------------------------
+    def __enter__(self):
+        if not hasattr(Context._default, "stack"):
+            Context._default.stack = []
+        Context._default.stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        Context._default.stack.pop()
+
+    def empty_cache(self):
+        """Reference parity: ``Context.empty_cache`` — PjRt manages pooling;
+        trigger a GC of unreferenced buffers."""
+        import gc
+
+        gc.collect()
+
+
+def cpu(device_id: int = 0) -> Context:
+    return Context("cpu", device_id)
+
+
+def cpu_pinned(device_id: int = 0) -> Context:
+    return Context("cpu_pinned", device_id)
+
+
+def cpu_shared(device_id: int = 0) -> Context:
+    return Context("cpu_shared", device_id)
+
+
+def gpu(device_id: int = 0) -> Context:
+    """Alias of the accelerator context (reference scripts using ``mx.gpu``
+    transparently target TPU here)."""
+    return Context("gpu", device_id)
+
+
+def tpu(device_id: int = 0) -> Context:
+    return Context("tpu", device_id)
+
+
+def current_context() -> Context:
+    stack = getattr(Context._default, "stack", None)
+    if stack:
+        return stack[-1]
+    return cpu(0)
+
+
+def num_gpus() -> int:
+    """Number of accelerator devices visible (alias surface)."""
+    return num_tpus()
+
+
+def num_tpus() -> int:
+    devs = [d for d in jax.devices() if d.platform != "cpu"]
+    return len(devs)

@@ -1,0 +1,70 @@
+"""Execution-engine facade.
+
+The reference's dependency engine (``src/engine/threaded_engine_perdevice.cc``,
+``ThreadedEnginePerDevice``) schedules async ops with read/write deps on
+engine Vars. On TPU that entire role is subsumed by JAX's async dispatch +
+XLA's runtime: every op launched on a ``jax.Array`` is already asynchronous,
+ordered by data dependence, and overlapped with host code. What remains of the
+engine API is therefore a thin facade:
+
+- ``waitall()``          ≙ Engine::WaitForAll — block until all pending device
+                            work is complete.
+- ``set_bulk_size`` etc. — accepted, no-ops (XLA fuses/bulks internally).
+- NaiveEngine mode       ≙ ``jax.disable_jit`` — serialize+eagerize everything
+                            for debugging scheduling-dependent failures
+                            (SURVEY §5.2: MXNET_ENGINE_TYPE=NaiveEngine).
+
+Env: ``MXNET_ENGINE_TYPE`` ∈ {ThreadedEnginePerDevice (default), NaiveEngine}.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+from .base import get_env
+
+__all__ = ["waitall", "naive_engine", "engine_type", "bulk", "set_bulk_size"]
+
+_BULK_SIZE = 15
+
+
+def engine_type() -> str:
+    return get_env("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice")
+
+
+def waitall() -> None:
+    """Block until all async device work has completed (mx.nd.waitall)."""
+    try:
+        jax.effects_barrier()
+    except Exception:
+        pass
+    # Synchronize every live device by a tiny blocking transfer.
+    for d in jax.devices():
+        try:
+            jax.device_put(0, d).block_until_ready()
+        except Exception:
+            pass
+
+
+@contextlib.contextmanager
+def naive_engine():
+    """Serialized, un-jitted execution for debugging (NaiveEngine parity)."""
+    with jax.disable_jit():
+        yield
+
+
+def set_bulk_size(size: int) -> int:
+    """Reference parity (Engine::SetBulkSize): XLA handles bulking; no-op."""
+    global _BULK_SIZE
+    prev, _BULK_SIZE = _BULK_SIZE, size
+    return prev
+
+
+@contextlib.contextmanager
+def bulk(size: int):
+    prev = set_bulk_size(size)
+    try:
+        yield
+    finally:
+        set_bulk_size(prev)

@@ -1,0 +1,227 @@
+"""Weight initializers (reference: ``python/mxnet/initializer.py``).
+
+Same registry + ``InitDesc``-by-name dispatch: an Initializer is called with
+the parameter name and the array to fill; name patterns route ``*_bias`` to
+zeros etc., exactly like the reference's ``Initializer.__call__``.
+"""
+from __future__ import annotations
+
+import math
+import re
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from .base import Registry
+from . import random as _rng
+from .ndarray.ndarray import NDArray
+
+__all__ = ["Initializer", "Zero", "One", "Constant", "Uniform", "Normal",
+           "Orthogonal", "Xavier", "MSRAPrelu", "Bilinear", "LSTMBias",
+           "register", "create"]
+
+_registry: Registry = Registry.get("initializer")
+register = _registry.register
+
+
+def create(init, **kwargs) -> "Initializer":
+    if isinstance(init, Initializer):
+        return init
+    if init is None:
+        return Uniform(0.07)
+    return _registry.create(init, **kwargs)
+
+
+class InitDesc(str):
+    """Parameter name carrying init attrs (reference parity)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        obj = super().__new__(cls, name)
+        obj.attrs = attrs or {}
+        obj.global_init = global_init
+        return obj
+
+
+class Initializer:
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, name, arr: NDArray) -> None:
+        name = str(name)
+        if name.endswith("weight"):
+            self._init_weight(name, arr)
+        elif name.endswith("bias"):
+            self._init_bias(name, arr)
+        elif name.endswith("gamma"):
+            self._init_one(name, arr)
+        elif name.endswith("beta"):
+            self._init_zero(name, arr)
+        elif name.endswith("running_mean") or name.endswith("moving_mean"):
+            self._init_zero(name, arr)
+        elif name.endswith("running_var") or name.endswith("moving_var"):
+            self._init_one(name, arr)
+        else:
+            self._init_default(name, arr)
+
+    init_weight = __call__
+
+    def _init_zero(self, name, arr):
+        arr._set_data(jnp.zeros(arr.shape, arr._data.dtype))
+
+    def _init_one(self, name, arr):
+        arr._set_data(jnp.ones(arr.shape, arr._data.dtype))
+
+    def _init_bias(self, name, arr):
+        self._init_zero(name, arr)
+
+    def _init_weight(self, name, arr):
+        raise NotImplementedError
+
+    def _init_default(self, name, arr):
+        self._init_weight(name, arr)
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._kwargs})"
+
+
+@register
+class Zero(Initializer):
+    def _init_weight(self, name, arr):
+        self._init_zero(name, arr)
+
+
+_registry.alias("zero", "zeros")
+
+
+@register
+class One(Initializer):
+    def _init_weight(self, name, arr):
+        self._init_one(name, arr)
+
+
+_registry.alias("one", "ones")
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, name, arr):
+        arr._set_data(jnp.full(arr.shape, self.value, arr._data.dtype))
+
+
+@register
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, name, arr):
+        key = _rng.next_key(arr.context)
+        arr._set_data(jax.random.uniform(key, arr.shape, arr._data.dtype,
+                                         -self.scale, self.scale))
+
+
+@register
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, name, arr):
+        key = _rng.next_key(arr.context)
+        arr._set_data(jax.random.normal(key, arr.shape, arr._data.dtype) * self.sigma)
+
+
+@register
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+
+    def _init_weight(self, name, arr):
+        key = _rng.next_key(arr.context)
+        nout = arr.shape[0]
+        nin = int(onp.prod(arr.shape[1:]))
+        a = jax.random.normal(key, (nout, nin))
+        q, r = jnp.linalg.qr(a if nout <= nin else a.T)
+        q = q if nout <= nin else q.T
+        q = q * jnp.sign(jnp.diagonal(r))[..., None] if q.shape[0] == r.shape[0] else q
+        arr._set_data((self.scale * q[:nout, :nin]).reshape(arr.shape).astype(arr._data.dtype))
+
+
+@register
+class Xavier(Initializer):
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type, magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, name, arr):
+        shape = arr.shape
+        hw_scale = float(onp.prod(shape[2:])) if len(shape) > 2 else 1.0
+        fan_in = (shape[1] if len(shape) > 1 else shape[0]) * hw_scale
+        fan_out = shape[0] * hw_scale
+        if self.factor_type == "avg":
+            factor = (fan_in + fan_out) / 2.0
+        elif self.factor_type == "in":
+            factor = fan_in
+        else:
+            factor = fan_out
+        scale = math.sqrt(self.magnitude / factor)
+        key = _rng.next_key(arr.context)
+        if self.rnd_type == "uniform":
+            arr._set_data(jax.random.uniform(key, shape, arr._data.dtype, -scale, scale))
+        else:
+            arr._set_data(jax.random.normal(key, shape, arr._data.dtype) * scale)
+
+
+_registry.alias("xavier", "glorot")
+
+
+@register
+class MSRAPrelu(Xavier):
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+_registry.alias("msraprelu", "he")
+
+
+@register
+class Bilinear(Initializer):
+    def _init_weight(self, name, arr):
+        shape = arr.shape
+        weight = onp.zeros(int(onp.prod(shape)), dtype=onp.float32)
+        f = onp.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(onp.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        arr._set_data(jnp.asarray(weight.reshape(shape), arr._data.dtype))
+
+
+@register
+class LSTMBias(Initializer):
+    """Forget-gate bias = 1.0, others 0 (reference parity)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, name, arr):
+        b = jnp.zeros(arr.shape, arr._data.dtype)
+        n = arr.shape[0] // 4
+        b = b.at[n:2 * n].set(self.forget_bias)
+        arr._set_data(b)
+
+    _init_bias = _init_weight
+    _init_default = _init_weight

@@ -1,0 +1,99 @@
+"""LR schedulers (reference: ``python/mxnet/lr_scheduler.py``)."""
+from __future__ import annotations
+
+import math
+from typing import List, Optional
+
+__all__ = ["LRScheduler", "FactorScheduler", "MultiFactorScheduler",
+           "PolyScheduler", "CosineScheduler", "LinearWarmUp"]
+
+
+class LRScheduler:
+    def __init__(self, base_lr=0.01, warmup_steps=0, warmup_begin_lr=0.0,
+                 warmup_mode="linear"):
+        self.base_lr = base_lr
+        self.warmup_steps = warmup_steps
+        self.warmup_begin_lr = warmup_begin_lr
+        self.warmup_final_lr = base_lr
+        self.warmup_mode = warmup_mode
+
+    def get_warmup_lr(self, num_update: int) -> float:
+        assert num_update < self.warmup_steps
+        if self.warmup_mode == "linear":
+            inc = (self.warmup_final_lr - self.warmup_begin_lr) * num_update / self.warmup_steps
+            return self.warmup_begin_lr + inc
+        return self.warmup_begin_lr + (self.warmup_final_lr - self.warmup_begin_lr) * \
+            (1 - math.exp(-num_update / max(self.warmup_steps / 5.0, 1e-8)))
+
+    def __call__(self, num_update: int) -> float:
+        raise NotImplementedError
+
+
+class FactorScheduler(LRScheduler):
+    def __init__(self, step: int, factor=1.0, stop_factor_lr=1e-8, base_lr=0.01, **kw):
+        super().__init__(base_lr, **kw)
+        self.step = step
+        self.factor = factor
+        self.stop_factor_lr = stop_factor_lr
+        self.count = 0
+
+    def __call__(self, num_update: int) -> float:
+        if self.warmup_steps and num_update < self.warmup_steps:
+            return self.get_warmup_lr(num_update)
+        lr = self.base_lr * (self.factor ** (num_update // self.step))
+        return max(lr, self.stop_factor_lr)
+
+
+class MultiFactorScheduler(LRScheduler):
+    def __init__(self, step: List[int], factor=1.0, base_lr=0.01, **kw):
+        super().__init__(base_lr, **kw)
+        self.step = sorted(step)
+        self.factor = factor
+
+    def __call__(self, num_update: int) -> float:
+        if self.warmup_steps and num_update < self.warmup_steps:
+            return self.get_warmup_lr(num_update)
+        n = sum(1 for s in self.step if s <= num_update)
+        return self.base_lr * (self.factor ** n)
+
+
+class PolyScheduler(LRScheduler):
+    def __init__(self, max_update: int, base_lr=0.01, pwr=2, final_lr=0.0, **kw):
+        super().__init__(base_lr, **kw)
+        self.max_update = max_update
+        self.power = pwr
+        self.final_lr = final_lr
+
+    def __call__(self, num_update: int) -> float:
+        if self.warmup_steps and num_update < self.warmup_steps:
+            return self.get_warmup_lr(num_update)
+        t = min(num_update - self.warmup_steps, self.max_update - self.warmup_steps)
+        frac = 1.0 - t / max(self.max_update - self.warmup_steps, 1)
+        return self.final_lr + (self.base_lr - self.final_lr) * (frac ** self.power)
+
+
+class CosineScheduler(LRScheduler):
+    def __init__(self, max_update: int, base_lr=0.01, final_lr=0.0, **kw):
+        super().__init__(base_lr, **kw)
+        self.max_update = max_update
+        self.final_lr = final_lr
+
+    def __call__(self, num_update: int) -> float:
+        if self.warmup_steps and num_update < self.warmup_steps:
+            return self.get_warmup_lr(num_update)
+        t = min(num_update - self.warmup_steps, self.max_update - self.warmup_steps)
+        frac = t / max(self.max_update - self.warmup_steps, 1)
+        return self.final_lr + (self.base_lr - self.final_lr) * 0.5 * (1 + math.cos(math.pi * frac))
+
+
+class LinearWarmUp(LRScheduler):
+    """Wrap another scheduler with linear warmup (GluonNLP-style)."""
+
+    def __init__(self, schedule: LRScheduler, start_lr: float, length: int):
+        super().__init__(schedule.base_lr, warmup_steps=length, warmup_begin_lr=start_lr)
+        self.schedule = schedule
+
+    def __call__(self, num_update: int) -> float:
+        if num_update < self.warmup_steps:
+            return self.get_warmup_lr(num_update)
+        return self.schedule(num_update)

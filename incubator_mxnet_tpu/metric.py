@@ -1,0 +1,291 @@
+"""Evaluation metrics (reference: ``python/mxnet/metric.py`` — EvalMetric zoo).
+
+Host-side accumulation over device results; ``update`` accepts NDArray or
+numpy. ``get`` triggers the device→host sync point exactly like the
+reference's asnumpy-based metrics.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import numpy as onp
+
+from .base import Registry, _as_list
+from .ndarray.ndarray import NDArray
+
+__all__ = ["EvalMetric", "Accuracy", "TopKAccuracy", "F1", "MAE", "MSE",
+           "RMSE", "CrossEntropy", "NegativeLogLikelihood", "Perplexity",
+           "PearsonCorrelation", "Loss", "CompositeEvalMetric", "create"]
+
+_registry: Registry = Registry.get("metric")
+register = _registry.register
+
+
+def create(metric, *args, **kwargs):
+    if isinstance(metric, EvalMetric):
+        return metric
+    if isinstance(metric, (list, tuple)):
+        composite = CompositeEvalMetric()
+        for m in metric:
+            composite.add(create(m, *args, **kwargs))
+        return composite
+    if callable(metric):
+        return CustomMetric(metric, *args, **kwargs)
+    return _registry.create(metric, *args, **kwargs)
+
+
+def _np(x) -> onp.ndarray:
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    return onp.asarray(x)
+
+
+class EvalMetric:
+    def __init__(self, name: str, output_names=None, label_names=None):
+        self.name = name
+        self.output_names = output_names
+        self.label_names = label_names
+        self.reset()
+
+    def reset(self):
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def update(self, labels, preds):
+        raise NotImplementedError
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, self.sum_metric / self.num_inst)
+
+    def get_name_value(self):
+        name, value = self.get()
+        return list(zip(_as_list(name), _as_list(value)))
+
+    def __str__(self):
+        return f"EvalMetric: {dict(self.get_name_value())}"
+
+
+@register
+class Accuracy(EvalMetric):
+    def __init__(self, axis=1, name="accuracy", **kw):
+        super().__init__(name, **kw)
+        self.axis = axis
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            pred = _np(pred)
+            label = _np(label)
+            if pred.ndim > label.ndim:
+                pred = pred.argmax(axis=self.axis)
+            pred = pred.astype(onp.int64).reshape(-1)
+            label = label.astype(onp.int64).reshape(-1)
+            self.sum_metric += float((pred == label).sum())
+            self.num_inst += len(label)
+
+
+@register
+class TopKAccuracy(EvalMetric):
+    def __init__(self, top_k=1, name="top_k_accuracy", **kw):
+        super().__init__(f"{name}_{top_k}", **kw)
+        self.top_k = top_k
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            pred = _np(pred)
+            label = _np(label).astype(onp.int64).reshape(-1)
+            topk = onp.argsort(-pred, axis=-1)[:, : self.top_k]
+            self.sum_metric += float((topk == label[:, None]).any(axis=1).sum())
+            self.num_inst += len(label)
+
+
+@register
+class F1(EvalMetric):
+    def __init__(self, name="f1", average="macro", **kw):
+        super().__init__(name, **kw)
+        self.average = average
+
+    def reset(self):
+        super().reset()
+        self.tp = self.fp = self.fn = 0.0
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            pred = _np(pred)
+            label = _np(label).reshape(-1).astype(onp.int64)
+            if pred.ndim > 1:
+                pred = pred.argmax(axis=-1)
+            pred = pred.reshape(-1).astype(onp.int64)
+            self.tp += float(((pred == 1) & (label == 1)).sum())
+            self.fp += float(((pred == 1) & (label == 0)).sum())
+            self.fn += float(((pred == 0) & (label == 1)).sum())
+            self.num_inst += len(label)
+
+    def get(self):
+        prec = self.tp / max(self.tp + self.fp, 1e-12)
+        rec = self.tp / max(self.tp + self.fn, 1e-12)
+        f1 = 2 * prec * rec / max(prec + rec, 1e-12)
+        return (self.name, f1 if self.num_inst else float("nan"))
+
+
+@register
+class MAE(EvalMetric):
+    def __init__(self, name="mae", **kw):
+        super().__init__(name, **kw)
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            l, p = _np(label), _np(pred)
+            self.sum_metric += float(onp.abs(l - p.reshape(l.shape)).mean())
+            self.num_inst += 1
+
+
+@register
+class MSE(EvalMetric):
+    def __init__(self, name="mse", **kw):
+        super().__init__(name, **kw)
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            l, p = _np(label), _np(pred)
+            self.sum_metric += float(((l - p.reshape(l.shape)) ** 2).mean())
+            self.num_inst += 1
+
+
+@register
+class RMSE(MSE):
+    def __init__(self, name="rmse", **kw):
+        super().__init__(name=name, **kw)
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, float(onp.sqrt(self.sum_metric / self.num_inst)))
+
+
+@register
+class CrossEntropy(EvalMetric):
+    def __init__(self, eps=1e-12, name="cross-entropy", **kw):
+        super().__init__(name, **kw)
+        self.eps = eps
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            label = _np(label).astype(onp.int64).reshape(-1)
+            pred = _np(pred).reshape(len(label), -1)
+            prob = pred[onp.arange(len(label)), label]
+            self.sum_metric += float(-onp.log(prob + self.eps).sum())
+            self.num_inst += len(label)
+
+
+@register
+class NegativeLogLikelihood(CrossEntropy):
+    def __init__(self, eps=1e-12, name="nll-loss", **kw):
+        super().__init__(eps=eps, name=name, **kw)
+
+
+@register
+class Perplexity(CrossEntropy):
+    def __init__(self, ignore_label=None, axis=-1, name="perplexity", **kw):
+        super().__init__(name=name, **kw)
+        self.ignore_label = ignore_label
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            label = _np(label).astype(onp.int64).reshape(-1)
+            pred = _np(pred).reshape(len(label), -1)
+            prob = pred[onp.arange(len(label)), label]
+            if self.ignore_label is not None:
+                keep = label != self.ignore_label
+                prob = prob[keep]
+            self.sum_metric += float(-onp.log(prob + self.eps).sum())
+            self.num_inst += len(prob)
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, float(onp.exp(self.sum_metric / self.num_inst)))
+
+
+@register
+class PearsonCorrelation(EvalMetric):
+    def __init__(self, name="pearsonr", **kw):
+        super().__init__(name, **kw)
+
+    def reset(self):
+        super().reset()
+        self._labels: List[onp.ndarray] = []
+        self._preds: List[onp.ndarray] = []
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            self._labels.append(_np(label).reshape(-1))
+            self._preds.append(_np(pred).reshape(-1))
+            self.num_inst += 1
+
+    def get(self):
+        if not self._labels:
+            return (self.name, float("nan"))
+        l = onp.concatenate(self._labels)
+        p = onp.concatenate(self._preds)
+        return (self.name, float(onp.corrcoef(l, p)[0, 1]))
+
+
+@register
+class Loss(EvalMetric):
+    def __init__(self, name="loss", **kw):
+        super().__init__(name, **kw)
+
+    def update(self, _, preds):
+        for pred in _as_list(preds):
+            p = _np(pred)
+            self.sum_metric += float(p.sum())
+            self.num_inst += p.size
+
+
+class CustomMetric(EvalMetric):
+    def __init__(self, feval, name="custom", allow_extra_outputs=False, **kw):
+        super().__init__(f"custom({name})", **kw)
+        self._feval = feval
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            out = self._feval(_np(label), _np(pred))
+            if isinstance(out, tuple):
+                s, n = out
+                self.sum_metric += s
+                self.num_inst += n
+            else:
+                self.sum_metric += out
+                self.num_inst += 1
+
+
+class CompositeEvalMetric(EvalMetric):
+    def __init__(self, metrics=None, name="composite", **kw):
+        super().__init__(name, **kw)
+        self.metrics = [create(m) for m in (metrics or [])]
+
+    def add(self, metric):
+        self.metrics.append(create(metric))
+
+    def reset(self):
+        for m in getattr(self, "metrics", []):
+            m.reset()
+
+    def update(self, labels, preds):
+        for m in self.metrics:
+            m.update(labels, preds)
+
+    def get(self):
+        names, values = [], []
+        for m in self.metrics:
+            n, v = m.get()
+            names.extend(_as_list(n))
+            values.extend(_as_list(v))
+        return (names, values)
+
+
+_registry.alias("accuracy", "acc")
+_registry.alias("crossentropy", "ce")
+_registry.alias("negativeloglikelihood", "nll_loss")

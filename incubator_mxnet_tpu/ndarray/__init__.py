@@ -1,0 +1,173 @@
+"""``mx.nd`` — the imperative NDArray namespace.
+
+Reference parity: ``python/mxnet/ndarray/`` — the NDArray class plus every
+registered op reflected into this module (register.py code-gen ≙
+``make_nd_op`` over the op registry), creation ops, serialization
+(``save``/``load`` — SURVEY §5.4), and the ``random`` submodule.
+"""
+from __future__ import annotations
+
+import pickle
+import sys
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from ..base import MXNetError
+from ..context import Context, cpu, current_context
+from ..engine import waitall
+from ..ops.registry import OPS
+from .ndarray import NDArray, array, _unwrap, _dtype_of
+from .op import dispatch_op, make_nd_op
+from . import random  # noqa: F401
+
+__all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
+           "linspace", "eye", "save", "load", "waitall", "concatenate",
+           "imresize", "moveaxis", "from_numpy", "from_dlpack", "to_dlpack_for_read"]
+
+_this = sys.modules[__name__]
+
+# Reflect every registered op into this namespace (mx.nd.<op>).
+for _name, _opdef in list(OPS.items()):
+    if not hasattr(_this, _name):
+        setattr(_this, _name, make_nd_op(_opdef))
+
+
+# ---------------------------------------------------------------------------
+# operator dispatch used by NDArray dunders
+# ---------------------------------------------------------------------------
+
+_SWAPPED = {"subtract": lambda a, b: b - a if False else None}
+
+
+def _binary_dispatch(opname, lhs, rhs, reverse=False):
+    op = getattr(_this, opname)
+    if isinstance(rhs, (list, tuple)):
+        rhs = array(rhs, ctx=lhs.context)
+    if isinstance(rhs, onp.ndarray):
+        rhs = array(rhs, ctx=lhs.context)
+    a, b = (rhs, lhs) if reverse else (lhs, rhs)
+    if not isinstance(a, NDArray):
+        # scalar op array
+        ctx = b.context
+
+        def pure(bv):
+            return OPS[opname].fn(a, bv)
+
+        return dispatch_op(pure, [b], {}, ctx, name=opname)
+    return op(a, b)
+
+
+# ---------------------------------------------------------------------------
+# creation ops (reference: init_op.cc)
+# ---------------------------------------------------------------------------
+
+def zeros(shape, ctx: Optional[Context] = None, dtype=None, **kwargs) -> NDArray:
+    ctx = ctx or current_context()
+    if isinstance(shape, int):
+        shape = (shape,)
+    return NDArray(jnp.zeros(tuple(shape), _dtype_of(dtype)), ctx=ctx)
+
+
+def ones(shape, ctx: Optional[Context] = None, dtype=None, **kwargs) -> NDArray:
+    ctx = ctx or current_context()
+    if isinstance(shape, int):
+        shape = (shape,)
+    return NDArray(jnp.ones(tuple(shape), _dtype_of(dtype)), ctx=ctx)
+
+
+def full(shape, val, ctx: Optional[Context] = None, dtype=None, **kwargs) -> NDArray:
+    ctx = ctx or current_context()
+    if isinstance(shape, int):
+        shape = (shape,)
+    return NDArray(jnp.full(tuple(shape), val, _dtype_of(dtype)), ctx=ctx)
+
+
+def empty(shape, ctx: Optional[Context] = None, dtype=None) -> NDArray:
+    return zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx: Optional[Context] = None,
+           dtype=None, infer_range=False) -> NDArray:
+    ctx = ctx or current_context()
+    out = jnp.arange(start, stop, step, _dtype_of(dtype))
+    if repeat > 1:
+        out = jnp.repeat(out, repeat)
+    return NDArray(out, ctx=ctx)
+
+
+def linspace(start, stop, num, endpoint=True, ctx: Optional[Context] = None, dtype=None) -> NDArray:
+    ctx = ctx or current_context()
+    return NDArray(jnp.linspace(start, stop, num, endpoint=endpoint, dtype=_dtype_of(dtype)), ctx=ctx)
+
+
+def eye(N, M=0, k=0, ctx: Optional[Context] = None, dtype=None) -> NDArray:
+    ctx = ctx or current_context()
+    return NDArray(jnp.eye(N, M if M else N, k=k, dtype=_dtype_of(dtype)), ctx=ctx)
+
+
+def moveaxis(data, source, destination) -> NDArray:
+    return dispatch_op(lambda d: jnp.moveaxis(d, source, destination), [data], {},
+                       data.context, name="moveaxis")
+
+
+def concatenate(arrays, axis=0, always_copy=True) -> NDArray:
+    return _this.concat(*arrays, dim=axis)
+
+
+def from_numpy(np_array, zero_copy=False) -> NDArray:
+    return array(np_array)
+
+
+def from_dlpack(dlpack) -> NDArray:
+    return NDArray(jnp.from_dlpack(dlpack))
+
+
+def to_dlpack_for_read(data: NDArray):
+    return data._data.__dlpack__()
+
+
+to_dlpack_for_write = to_dlpack_for_read
+
+
+def imresize(src, w, h, interp=1) -> NDArray:
+    out = jax.image.resize(src._data, (h, w) + src.shape[2:],
+                           method="bilinear" if interp else "nearest")
+    return NDArray(out, ctx=src.context)
+
+
+# ---------------------------------------------------------------------------
+# serialization (reference: NDArray::Save/Load, src/ndarray/ndarray.cc;
+# SURVEY §5.4). Format: a versioned pickle of host numpy arrays — the dmlc
+# binary stream has no ecosystem value off-MXNet, but the API surface and
+# list/dict semantics are preserved exactly.
+# ---------------------------------------------------------------------------
+
+_MAGIC = b"MXTPU_ND1\n"
+
+
+def save(fname: str, data) -> None:
+    if isinstance(data, NDArray):
+        payload = [data.asnumpy()]
+    elif isinstance(data, dict):
+        payload = {k: v.asnumpy() for k, v in data.items()}
+    elif isinstance(data, (list, tuple)):
+        payload = [v.asnumpy() for v in data]
+    else:
+        raise MXNetError("save expects NDArray, list of NDArray, or dict of str->NDArray")
+    with open(fname, "wb") as f:
+        f.write(_MAGIC)
+        pickle.dump(payload, f, protocol=4)
+
+
+def load(fname: str):
+    with open(fname, "rb") as f:
+        magic = f.read(len(_MAGIC))
+        if magic != _MAGIC:
+            raise MXNetError(f"{fname} is not a saved NDArray file")
+        payload = pickle.load(f)
+    if isinstance(payload, dict):
+        return {k: array(v) for k, v in payload.items()}
+    return [array(v) for v in payload]

@@ -1,0 +1,535 @@
+"""NDArray: MXNet's mutable array surface over immutable XLA/PjRt buffers.
+
+TPU-native counterpart of ``include/mxnet/ndarray.h`` + ``src/ndarray/
+ndarray.cc`` and the Python frontend ``python/mxnet/ndarray/ndarray.py``.
+
+Design (SURVEY §7 "Mutability vs XLA immutability"): an NDArray is a handle
+holding a reference to an immutable ``jax.Array`` plus a version counter.
+"Mutation" (``+=``, ``__setitem__``, optimizer updates) swaps the handle's
+buffer for a functionally-updated one and bumps the version — the reference's
+engine-var write-dependency discipline collapses into this single swap,
+because XLA's async runtime already orders the underlying computations by
+data dependence. ``WaitToRead`` ≙ ``block_until_ready``.
+
+Views: basic indexing returns a *copy* (documented divergence: XLA buffers
+cannot alias mutably); ``__setitem__`` provides the write path via
+``.at[].set``. Autograd interplay: in-place mutation of an array recorded on
+the autograd tape raises, as in the reference.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from ..base import MXNetError, integer_types, numeric_types
+from ..context import Context, cpu, current_context
+from .. import autograd
+
+__all__ = ["NDArray", "array", "_wrap", "_unwrap", "_dtype_of"]
+
+
+def _dtype_of(dtype) -> jnp.dtype:
+    if dtype is None:
+        return jnp.dtype("float32")
+    return jnp.dtype(dtype)
+
+
+def _unwrap(x):
+    return x._data if isinstance(x, NDArray) else x
+
+
+class NDArray:
+    """A mutable n-dimensional array on a device Context."""
+
+    __slots__ = ("_data", "_ctx", "_version", "_grad", "_grad_req", "_fresh_grad_node", "__weakref__")
+
+    # numpy interop priority (so ndarray.__add__ defers to us)
+    __array_priority__ = 1000.0
+
+    def __init__(self, data, ctx: Optional[Context] = None, dtype=None):
+        if isinstance(data, NDArray):
+            data = data._data
+        if ctx is None:
+            ctx = current_context()
+        if not isinstance(data, jax.Array) or dtype is not None:
+            data = jnp.asarray(data, dtype=dtype)
+        # Commit to the context's device if not already there.
+        dev = ctx.jax_device
+        devs = getattr(data, "devices", None)
+        committed = getattr(data, "_committed", True)
+        if devs is None or not committed or data.devices() != {dev}:
+            if not (hasattr(data, "sharding") and len(getattr(data.sharding, "device_set", [1, 2])) > 1):
+                data = jax.device_put(data, dev)
+        self._data = data
+        self._ctx = ctx
+        self._version = 0
+        self._grad = None
+        self._grad_req = "null"
+        self._fresh_grad_node = None
+
+    # ------------------------------------------------------------------
+    # basic properties
+    # ------------------------------------------------------------------
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return onp.dtype(str(self._data.dtype))
+
+    @property
+    def context(self) -> Context:
+        return self._ctx
+
+    ctx = context
+
+    @property
+    def size(self) -> int:
+        return int(self._data.size)
+
+    @property
+    def ndim(self) -> int:
+        return self._data.ndim
+
+    @property
+    def stype(self) -> str:
+        return "default"
+
+    @property
+    def grad(self) -> Optional["NDArray"]:
+        return self._grad
+
+    @property
+    def version(self) -> int:
+        return self._version
+
+    def __repr__(self):
+        return f"\n{onp.asarray(self.asnumpy())}\n<NDArray {'x'.join(map(str, self.shape))} @{self._ctx}>"
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of unsized object")
+        return self.shape[0]
+
+    def __bool__(self):
+        if self.size != 1:
+            raise ValueError("The truth value of an NDArray with multiple elements is ambiguous.")
+        return bool(self.item())
+
+    def __float__(self):
+        return float(self.item())
+
+    def __int__(self):
+        return int(self.item())
+
+    def __index__(self):
+        return int(self.item())
+
+    def item(self):
+        return self._data.item()
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __array__(self, dtype=None):
+        a = self.asnumpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    # ------------------------------------------------------------------
+    # sync / host transfer (engine WaitToRead parity)
+    # ------------------------------------------------------------------
+    def wait_to_read(self) -> None:
+        """Block until pending writes complete (NDArray::WaitToRead)."""
+        self._data.block_until_ready()
+
+    def asnumpy(self) -> onp.ndarray:
+        """Copy to host, synchronizing (the reference's sync point)."""
+        return onp.asarray(self._data)
+
+    def asscalar(self):
+        if self.size != 1:
+            raise ValueError("The current array is not a scalar")
+        return self.asnumpy().reshape(())[()]
+
+    # ------------------------------------------------------------------
+    # mutation machinery
+    # ------------------------------------------------------------------
+    def _check_inplace_ok(self):
+        if autograd.is_recording() and self._fresh_grad_node is not None:
+            raise MXNetError(
+                "In-place mutation of an array recorded on the autograd tape "
+                "is not allowed (reference parity: inplace on recorded arrays)"
+            )
+
+    def _set_data(self, new_data) -> None:
+        """Swap the underlying buffer (the 'mutation' primitive)."""
+        self._check_inplace_ok()
+        if not isinstance(new_data, jax.Array):
+            new_data = jnp.asarray(new_data, self._data.dtype)
+        self._data = new_data
+        self._version += 1
+
+    def _assign(self, value) -> None:
+        """x[:] = value semantics."""
+        v = _unwrap(value)
+        v = jnp.broadcast_to(jnp.asarray(v, self._data.dtype), self.shape)
+        self._set_data(v)
+
+    # ------------------------------------------------------------------
+    # indexing
+    # ------------------------------------------------------------------
+    def _index_for_jnp(self, key):
+        if isinstance(key, NDArray):
+            return _unwrap(key).astype(jnp.int32) if jnp.issubdtype(_unwrap(key).dtype, jnp.floating) else _unwrap(key)
+        if isinstance(key, tuple):
+            return tuple(self._index_for_jnp(k) if isinstance(k, NDArray) else k for k in key)
+        return key
+
+    def __getitem__(self, key) -> "NDArray":
+        from .op import dispatch_op
+        key = self._index_for_jnp(key)
+        if isinstance(key, (int, onp.integer)):
+            fn = lambda d: d[key]
+        else:
+            fn = lambda d: d[key]
+        return dispatch_op(fn, (self,), {}, self._ctx, name="getitem")
+
+    def __setitem__(self, key, value) -> None:
+        key = self._index_for_jnp(key)
+        v = _unwrap(value)
+        if isinstance(v, (list, tuple)) or isinstance(v, onp.ndarray):
+            v = jnp.asarray(v)
+        if key is Ellipsis or key == slice(None):
+            self._assign(value)
+            return
+        if isinstance(v, jax.Array) or isinstance(v, numeric_types):
+            self._set_data(self._data.at[key].set(jnp.asarray(v, self._data.dtype) if not isinstance(v, numeric_types) else v))
+        else:
+            self._set_data(self._data.at[key].set(v))
+
+    # ------------------------------------------------------------------
+    # context / dtype moves
+    # ------------------------------------------------------------------
+    def as_in_context(self, context: Context) -> "NDArray":
+        if context == self._ctx:
+            return self
+        return self.copyto(context)
+
+    as_in_ctx = as_in_context
+
+    def copyto(self, other: Union[Context, "NDArray"]) -> "NDArray":
+        if isinstance(other, Context):
+            return NDArray(jax.device_put(self._data, other.jax_device), ctx=other)
+        if isinstance(other, NDArray):
+            if other is self:
+                raise MXNetError("cannot copy an array onto itself")
+            other._set_data(jax.device_put(self._data.astype(other._data.dtype), other._ctx.jax_device))
+            return other
+        raise TypeError(f"copyto does not support type {type(other)}")
+
+    def copy(self) -> "NDArray":
+        return NDArray(self._data, ctx=self._ctx)
+
+    def astype(self, dtype, copy: bool = True) -> "NDArray":
+        dt = jnp.dtype(dtype)
+        if not copy and dt == self._data.dtype:
+            return self
+        from .op import dispatch_op
+        return dispatch_op(lambda d: d.astype(dt), (self,), {}, self._ctx, name="astype")
+
+    def tostype(self, stype: str) -> "NDArray":
+        if stype == "default":
+            return self
+        from .sparse import cast_storage
+        return cast_storage(self, stype)
+
+    def detach(self) -> "NDArray":
+        out = NDArray(self._data, ctx=self._ctx)
+        return out
+
+    # ------------------------------------------------------------------
+    # autograd
+    # ------------------------------------------------------------------
+    def attach_grad(self, grad_req: str = "write", stype: Optional[str] = None) -> None:
+        self._grad = NDArray(jnp.zeros(self.shape, self._data.dtype), ctx=self._ctx)
+        self._grad_req = grad_req
+
+    def backward(self, out_grad=None, retain_graph: bool = False, train_mode: bool = True) -> None:
+        autograd.backward([self], [out_grad] if out_grad is not None else None,
+                          retain_graph=retain_graph, train_mode=train_mode)
+
+    # ------------------------------------------------------------------
+    # shape ops as methods (delegate to the op namespace)
+    # ------------------------------------------------------------------
+    def reshape(self, *shape, **kwargs) -> "NDArray":
+        from . import reshape as _reshape
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = tuple(shape[0])
+        if "shape" in kwargs:
+            shape = kwargs["shape"]
+        return _reshape(self, shape=shape)
+
+    def reshape_like(self, other: "NDArray") -> "NDArray":
+        return self.reshape(other.shape)
+
+    def transpose(self, axes=None) -> "NDArray":
+        from . import transpose as _transpose
+        return _transpose(self, axes=axes)
+
+    @property
+    def T(self) -> "NDArray":
+        return self.transpose()
+
+    def flatten(self) -> "NDArray":
+        from . import flatten as _flatten
+        return _flatten(self)
+
+    def expand_dims(self, axis: int) -> "NDArray":
+        from . import expand_dims as _ed
+        return _ed(self, axis=axis)
+
+    def squeeze(self, axis=None) -> "NDArray":
+        from . import squeeze as _sq
+        return _sq(self, axis=axis)
+
+    def broadcast_to(self, shape) -> "NDArray":
+        from . import broadcast_to as _bt
+        return _bt(self, shape=shape)
+
+    def broadcast_like(self, other) -> "NDArray":
+        return self.broadcast_to(other.shape)
+
+    def slice(self, begin, end, step=None) -> "NDArray":
+        from . import slice as _slice
+        return _slice(self, begin=begin, end=end, step=step)
+
+    def slice_axis(self, axis, begin, end) -> "NDArray":
+        from . import slice_axis as _sa
+        return _sa(self, axis=axis, begin=begin, end=end)
+
+    def take(self, indices, axis=0, mode="clip") -> "NDArray":
+        from . import take as _take
+        return _take(self, indices, axis=axis, mode=mode)
+
+    def pick(self, index, axis=-1, keepdims=False) -> "NDArray":
+        from . import pick as _pick
+        return _pick(self, index, axis=axis, keepdims=keepdims)
+
+    def one_hot(self, depth, on_value=1.0, off_value=0.0, dtype="float32") -> "NDArray":
+        from . import one_hot as _oh
+        return _oh(self, depth=depth, on_value=on_value, off_value=off_value, dtype=dtype)
+
+    def clip(self, a_min=None, a_max=None) -> "NDArray":
+        from . import clip as _clip
+        return _clip(self, a_min=a_min, a_max=a_max)
+
+    def abs(self) -> "NDArray":
+        from . import abs as _abs
+        return _abs(self)
+
+    def sign(self) -> "NDArray":
+        from . import sign as _sign
+        return _sign(self)
+
+    def sqrt(self) -> "NDArray":
+        from . import sqrt as _sqrt
+        return _sqrt(self)
+
+    def square(self) -> "NDArray":
+        from . import square as _square
+        return _square(self)
+
+    def exp(self) -> "NDArray":
+        from . import exp as _exp
+        return _exp(self)
+
+    def log(self) -> "NDArray":
+        from . import log as _log
+        return _log(self)
+
+    def relu(self) -> "NDArray":
+        from . import relu as _relu
+        return _relu(self)
+
+    def sigmoid(self) -> "NDArray":
+        from . import sigmoid as _sigmoid
+        return _sigmoid(self)
+
+    def tanh(self) -> "NDArray":
+        from . import tanh as _tanh
+        return _tanh(self)
+
+    def softmax(self, axis=-1) -> "NDArray":
+        from . import softmax as _softmax
+        return _softmax(self, axis=axis)
+
+    def log_softmax(self, axis=-1) -> "NDArray":
+        from . import log_softmax as _ls
+        return _ls(self, axis=axis)
+
+    def sum(self, axis=None, keepdims=False) -> "NDArray":
+        from . import sum as _sum
+        return _sum(self, axis=axis, keepdims=keepdims)
+
+    def mean(self, axis=None, keepdims=False) -> "NDArray":
+        from . import mean as _mean
+        return _mean(self, axis=axis, keepdims=keepdims)
+
+    def max(self, axis=None, keepdims=False) -> "NDArray":
+        from . import max as _max
+        return _max(self, axis=axis, keepdims=keepdims)
+
+    def min(self, axis=None, keepdims=False) -> "NDArray":
+        from . import min as _min
+        return _min(self, axis=axis, keepdims=keepdims)
+
+    def prod(self, axis=None, keepdims=False) -> "NDArray":
+        from . import prod as _prod
+        return _prod(self, axis=axis, keepdims=keepdims)
+
+    def argmax(self, axis=None, keepdims=False) -> "NDArray":
+        from . import argmax as _am
+        return _am(self, axis=axis, keepdims=keepdims)
+
+    def argmin(self, axis=None, keepdims=False) -> "NDArray":
+        from . import argmin as _am
+        return _am(self, axis=axis, keepdims=keepdims)
+
+    def norm(self, ord=2, axis=None, keepdims=False) -> "NDArray":
+        from . import norm as _norm
+        return _norm(self, ord=ord, axis=axis, keepdims=keepdims)
+
+    def dot(self, other) -> "NDArray":
+        from . import dot as _dot
+        return _dot(self, other)
+
+    def as_nd_ndarray(self):
+        return self
+
+    def asnumpy_or_none(self):
+        return self.asnumpy()
+
+    # ------------------------------------------------------------------
+    # arithmetic operators
+    # ------------------------------------------------------------------
+    def _binary(self, other, opname, reverse=False):
+        from . import _binary_dispatch
+        return _binary_dispatch(opname, self, other, reverse)
+
+    def __add__(self, other):
+        return self._binary(other, "add")
+
+    def __radd__(self, other):
+        return self._binary(other, "add", True)
+
+    def __sub__(self, other):
+        return self._binary(other, "subtract")
+
+    def __rsub__(self, other):
+        return self._binary(other, "subtract", True)
+
+    def __mul__(self, other):
+        return self._binary(other, "multiply")
+
+    def __rmul__(self, other):
+        return self._binary(other, "multiply", True)
+
+    def __truediv__(self, other):
+        return self._binary(other, "divide")
+
+    def __rtruediv__(self, other):
+        return self._binary(other, "divide", True)
+
+    def __floordiv__(self, other):
+        return self._binary(other, "floor_divide")
+
+    def __rfloordiv__(self, other):
+        return self._binary(other, "floor_divide", True)
+
+    def __mod__(self, other):
+        return self._binary(other, "mod")
+
+    def __rmod__(self, other):
+        return self._binary(other, "mod", True)
+
+    def __pow__(self, other):
+        return self._binary(other, "power")
+
+    def __rpow__(self, other):
+        return self._binary(other, "power", True)
+
+    def __neg__(self):
+        from . import negative
+        return negative(self)
+
+    def __abs__(self):
+        return self.abs()
+
+    def __eq__(self, other):
+        if other is None:
+            return False
+        return self._binary(other, "equal")
+
+    def __ne__(self, other):
+        if other is None:
+            return True
+        return self._binary(other, "not_equal")
+
+    def __lt__(self, other):
+        return self._binary(other, "lesser")
+
+    def __le__(self, other):
+        return self._binary(other, "lesser_equal")
+
+    def __gt__(self, other):
+        return self._binary(other, "greater")
+
+    def __ge__(self, other):
+        return self._binary(other, "greater_equal")
+
+    __hash__ = object.__hash__
+
+    # in-place: swap buffer
+    def __iadd__(self, other):
+        self._set_data(self._data + jnp.asarray(_unwrap(other), self._data.dtype))
+        return self
+
+    def __isub__(self, other):
+        self._set_data(self._data - jnp.asarray(_unwrap(other), self._data.dtype))
+        return self
+
+    def __imul__(self, other):
+        self._set_data(self._data * jnp.asarray(_unwrap(other), self._data.dtype))
+        return self
+
+    def __itruediv__(self, other):
+        self._set_data(self._data / jnp.asarray(_unwrap(other), self._data.dtype))
+        return self
+
+
+def array(source_array, ctx: Optional[Context] = None, dtype=None) -> NDArray:
+    """Create an NDArray from any array-like (mx.nd.array parity: python
+    lists default to float32; numpy/NDArray sources keep their dtype)."""
+    if isinstance(source_array, NDArray):
+        dt = dtype or source_array.dtype
+        return NDArray(source_array._data, ctx=ctx or source_array.context, dtype=dt)
+    if dtype is None:
+        if isinstance(source_array, (onp.ndarray, jax.Array)):
+            dtype = source_array.dtype
+            # TPU/x32: downcast 64-bit host arrays.
+            if onp.dtype(dtype) == onp.float64:
+                dtype = onp.float32
+            elif onp.dtype(dtype) == onp.int64:
+                dtype = onp.int32
+        else:
+            dtype = onp.float32
+    return NDArray(jnp.asarray(onp.asarray(source_array), dtype=jnp.dtype(dtype)), ctx=ctx)
+
+
+def _wrap(value, ctx: Context) -> NDArray:
+    return NDArray(value, ctx=ctx)

@@ -1,0 +1,73 @@
+"""Imperative op dispatch: the TPU-era ``Imperative::Invoke``.
+
+Reference call stack (SURVEY §3.1): generated Python op → ctypes FFI →
+``MXImperativeInvokeEx`` → ``Imperative::Invoke`` → engine push → device
+kernel. Here the whole stack collapses to: unwrap NDArray handles → run the
+registered pure JAX function (XLA dispatches asynchronously, giving the
+engine's compute/host overlap for free) → wrap outputs → append a tape node
+if autograd is recording.
+"""
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+import numpy as onp
+
+from .. import autograd
+from ..context import Context, current_context
+from .ndarray import NDArray
+
+__all__ = ["dispatch_op", "make_nd_op"]
+
+
+def dispatch_op(pure_fn: Callable, arrays: Sequence[NDArray], kwargs, ctx: Context, name: str = ""):
+    """Execute ``pure_fn(*values)`` and wrap outputs; record for autograd."""
+    vals = [a._data for a in arrays]
+    out = pure_fn(*vals)
+    multi = isinstance(out, (tuple, list))
+    outs = [NDArray(o, ctx=ctx) for o in (out if multi else (out,))]
+    if autograd.is_recording():
+        autograd._record_node(pure_fn, arrays, vals, outs, name)
+    return outs if multi else outs[0]
+
+
+def make_nd_op(opdef):
+    """Generate the ``mx.nd.<op>`` wrapper from a registered pure op
+    (reference: python/mxnet/ndarray/register.py code-gen)."""
+
+    fn = opdef.fn
+    opname = opdef.name
+
+    def nd_op(*args, out=None, **kwargs):
+        # `name`/`ctx` are accepted for API parity with generated MXNet ops
+        kwargs.pop("name", None)
+        ctx = kwargs.pop("ctx", None)
+        # Normalize: convert raw numpy/lists in tensor positions
+        arr_pos = [i for i, a in enumerate(args) if isinstance(a, NDArray)]
+        if not arr_pos:
+            raise TypeError(f"{opname} expects at least one NDArray argument")
+        ctx = ctx or args[arr_pos[0]].context
+        arrays = [args[i] for i in arr_pos]
+        static_args = list(args)
+
+        def pure(*vals):
+            full = list(static_args)
+            for i, v in zip(arr_pos, vals):
+                full[i] = v
+            return fn(*full, **kwargs)
+
+        result = dispatch_op(pure, arrays, kwargs, ctx, name=opname)
+        if out is not None:
+            if isinstance(out, NDArray):
+                out._set_data(result._data if isinstance(result, NDArray) else result)
+                return out
+            for o, r in zip(out, result):
+                o._set_data(r._data)
+            return out
+        return result
+
+    nd_op.__name__ = opname
+    nd_op.__qualname__ = opname
+    nd_op.__doc__ = fn.__doc__
+    return nd_op

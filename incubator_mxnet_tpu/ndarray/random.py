@@ -1,0 +1,203 @@
+"""Random sampling ops (``mx.nd.random.*`` / ``mx.random`` parity).
+
+Reference: ``src/operator/random/sample_op.cc`` + ``python/mxnet/ndarray/
+random.py``. Each draw advances the per-Context stateful key stream
+(../random.py) and closes over the drawn subkey, so a recorded tape replay
+is deterministic (pure w.r.t. the snapshot).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .. import random as _rng
+from ..context import Context, current_context
+from .ndarray import NDArray, _unwrap
+from .op import dispatch_op
+
+__all__ = [
+    "uniform", "normal", "randn", "randint", "exponential", "gamma",
+    "poisson", "negative_binomial", "generalized_negative_binomial",
+    "multinomial", "shuffle", "bernoulli",
+]
+
+
+def _ctx(ctx) -> Context:
+    return ctx if ctx is not None else current_context()
+
+
+def _dt(dtype):
+    if dtype is None or dtype == "None":
+        return jnp.float32
+    return jnp.dtype(dtype)
+
+
+def _maybe_param_shape(shape, *params):
+    if shape is None:
+        for p in params:
+            if isinstance(p, NDArray):
+                return p.shape
+        return (1,)
+    if isinstance(shape, int):
+        shape = (shape,)
+    return tuple(shape)
+
+
+def uniform(low=0.0, high=1.0, shape=None, dtype=None, ctx=None, out=None, **kwargs):
+    ctx = _ctx(ctx)
+    shape = _maybe_param_shape(shape, low, high)
+    key = _rng.next_key(ctx)
+    arrays = [a for a in (low, high) if isinstance(a, NDArray)]
+
+    def pure(*vals):
+        lo = vals[0] if isinstance(low, NDArray) else low
+        hi = (vals[-1] if isinstance(high, NDArray) else high)
+        u = jax.random.uniform(key, shape, _dt(dtype))
+        return lo + u * (hi - lo)
+
+    res = dispatch_op(pure, arrays, {}, ctx, name="random_uniform")
+    if out is not None:
+        out._set_data(res._data)
+        return out
+    return res
+
+
+def normal(loc=0.0, scale=1.0, shape=None, dtype=None, ctx=None, out=None, **kwargs):
+    ctx = _ctx(ctx)
+    shape = _maybe_param_shape(shape, loc, scale)
+    key = _rng.next_key(ctx)
+    arrays = [a for a in (loc, scale) if isinstance(a, NDArray)]
+
+    def pure(*vals):
+        mu = vals[0] if isinstance(loc, NDArray) else loc
+        sd = (vals[-1] if isinstance(scale, NDArray) else scale)
+        return mu + jax.random.normal(key, shape, _dt(dtype)) * sd
+
+    res = dispatch_op(pure, arrays, {}, ctx, name="random_normal")
+    if out is not None:
+        out._set_data(res._data)
+        return out
+    return res
+
+
+def randn(*shape, loc=0.0, scale=1.0, dtype=None, ctx=None, **kwargs):
+    return normal(loc=loc, scale=scale, shape=shape or (1,), dtype=dtype, ctx=ctx)
+
+
+def randint(low, high, shape=(1,), dtype="int32", ctx=None, out=None, **kwargs):
+    ctx = _ctx(ctx)
+    if isinstance(shape, int):
+        shape = (shape,)
+    key = _rng.next_key(ctx)
+    val = jax.random.randint(key, tuple(shape), int(low), int(high), jnp.dtype(dtype))
+    res = NDArray(val, ctx=ctx)
+    if out is not None:
+        out._set_data(res._data)
+        return out
+    return res
+
+
+def exponential(scale=1.0, shape=None, dtype=None, ctx=None, out=None, **kwargs):
+    ctx = _ctx(ctx)
+    shape = _maybe_param_shape(shape, scale)
+    key = _rng.next_key(ctx)
+    arrays = [a for a in (scale,) if isinstance(a, NDArray)]
+
+    def pure(*vals):
+        lam = vals[0] if isinstance(scale, NDArray) else scale
+        return jax.random.exponential(key, shape, _dt(dtype)) * lam
+
+    res = dispatch_op(pure, arrays, {}, ctx, name="random_exponential")
+    return res
+
+
+def gamma(alpha=1.0, beta=1.0, shape=None, dtype=None, ctx=None, out=None, **kwargs):
+    ctx = _ctx(ctx)
+    shape = _maybe_param_shape(shape, alpha, beta)
+    key = _rng.next_key(ctx)
+    arrays = [a for a in (alpha, beta) if isinstance(a, NDArray)]
+
+    def pure(*vals):
+        a = vals[0] if isinstance(alpha, NDArray) else alpha
+        b = (vals[-1] if isinstance(beta, NDArray) else beta)
+        return jax.random.gamma(key, a, shape, _dt(dtype)) * b
+
+    return dispatch_op(pure, arrays, {}, ctx, name="random_gamma")
+
+
+def poisson(lam=1.0, shape=None, dtype=None, ctx=None, out=None, **kwargs):
+    ctx = _ctx(ctx)
+    shape = _maybe_param_shape(shape, lam)
+    key = _rng.next_key(ctx)
+    lam_v = _unwrap(lam) if isinstance(lam, NDArray) else lam
+    val = jax.random.poisson(key, lam_v, tuple(shape)).astype(_dt(dtype))
+    return NDArray(val, ctx=ctx)
+
+
+def negative_binomial(k=1, p=1.0, shape=None, dtype=None, ctx=None, **kwargs):
+    ctx = _ctx(ctx)
+    shape = _maybe_param_shape(shape, k, p)
+    key1, key2 = jax.random.split(_rng.next_key(ctx))
+    kv = _unwrap(k) if isinstance(k, NDArray) else k
+    pv = _unwrap(p) if isinstance(p, NDArray) else p
+    # NB(k, p) = Poisson(Gamma(k, (1-p)/p))
+    lam = jax.random.gamma(key1, kv, tuple(shape)) * (1.0 - pv) / pv
+    val = jax.random.poisson(key2, lam).astype(_dt(dtype))
+    return NDArray(val, ctx=ctx)
+
+
+def generalized_negative_binomial(mu=1.0, alpha=1.0, shape=None, dtype=None, ctx=None, **kwargs):
+    ctx = _ctx(ctx)
+    shape = _maybe_param_shape(shape, mu, alpha)
+    muv = _unwrap(mu) if isinstance(mu, NDArray) else mu
+    av = _unwrap(alpha) if isinstance(alpha, NDArray) else alpha
+    key1, key2 = jax.random.split(_rng.next_key(ctx))
+    r = 1.0 / av
+    p = r / (r + muv)
+    lam = jax.random.gamma(key1, r, tuple(shape)) * (1.0 - p) / p
+    val = jax.random.poisson(key2, lam).astype(_dt(dtype))
+    return NDArray(val, ctx=ctx)
+
+
+def multinomial(data, shape=1, get_prob=False, dtype="int32", **kwargs):
+    """Sample from categorical distributions given probabilities (N, K)."""
+    ctx = data.context
+    key = _rng.next_key(ctx)
+    n = shape if isinstance(shape, int) else int(jnp.prod(jnp.array(shape)))
+    logits = jnp.log(jnp.maximum(data._data, 1e-30))
+    if data._data.ndim == 1:
+        out = jax.random.categorical(key, logits, shape=(n,))
+        if n == 1 and shape == 1:
+            out = out.reshape(())
+    else:
+        out = jax.random.categorical(key, logits[:, None, :], axis=-1, shape=(data.shape[0], n))
+        if shape == 1:
+            out = out[:, 0]
+    res = NDArray(out.astype(jnp.dtype(dtype)), ctx=ctx)
+    if get_prob:
+        logp = jnp.take_along_axis(jnp.log(jnp.maximum(data._data, 1e-30)),
+                                   out.reshape(out.shape + (1,)).astype(jnp.int32), axis=-1)[..., 0] \
+            if data._data.ndim > 1 else jnp.log(jnp.maximum(data._data, 1e-30))[out]
+        return res, NDArray(logp, ctx=ctx)
+    return res
+
+
+def shuffle(data, **kwargs):
+    ctx = data.context
+    key = _rng.next_key(ctx)
+    perm = jax.random.permutation(key, data.shape[0])
+
+    def pure(d):
+        return d[perm]
+
+    return dispatch_op(pure, [data], {}, ctx, name="shuffle")
+
+
+def bernoulli(prob=0.5, shape=None, dtype="float32", ctx=None, **kwargs):
+    ctx = _ctx(ctx)
+    shape = _maybe_param_shape(shape, prob)
+    key = _rng.next_key(ctx)
+    pv = _unwrap(prob) if isinstance(prob, NDArray) else prob
+    return NDArray(jax.random.bernoulli(key, pv, tuple(shape)).astype(jnp.dtype(dtype)), ctx=ctx)

@@ -1,0 +1,151 @@
+"""Sparse NDArray facade: ``row_sparse`` and ``csr`` storage types.
+
+Reference: ``include/mxnet/ndarray.h`` storage types + ``python/mxnet/
+ndarray/sparse.py``. SURVEY §7 scopes this explicitly: sparse layouts are
+TPU-hostile (dynamic shapes defeat XLA tiling), so parity is a *host-side
+facade* — compressed representations with correct semantics, converting to
+dense at device-compute boundaries. Gradient sparsity for embeddings is
+instead handled densely (XLA scatter-add is efficient on TPU).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as onp
+
+from ..base import MXNetError
+from ..context import Context, current_context
+from .ndarray import NDArray, array
+
+__all__ = ["RowSparseNDArray", "CSRNDArray", "row_sparse_array", "csr_matrix",
+           "cast_storage", "zeros"]
+
+
+class BaseSparseNDArray(NDArray):
+    """Common base; behaves as its dense equivalent for compute."""
+
+    __slots__ = ()
+
+    def asnumpy(self):
+        return super().asnumpy()
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    __slots__ = ("_indices",)
+
+    def __init__(self, data, indices, shape=None, ctx=None, dtype=None):
+        dense_rows = jnp.asarray(data, dtype=dtype)
+        idx = jnp.asarray(indices, dtype=jnp.int32)
+        if shape is None:
+            shape = dense_rows.shape
+        dense = jnp.zeros(tuple(shape), dense_rows.dtype).at[idx].set(dense_rows)
+        super().__init__(dense, ctx=ctx)
+        self._indices = idx
+
+    @property
+    def stype(self):
+        return "row_sparse"
+
+    @property
+    def indices(self) -> NDArray:
+        return NDArray(self._indices, ctx=self.context)
+
+    @property
+    def data(self) -> NDArray:
+        return NDArray(self._data[self._indices], ctx=self.context)
+
+    def tostype(self, stype):
+        if stype == "row_sparse":
+            return self
+        if stype == "default":
+            return NDArray(self._data, ctx=self.context)
+        raise MXNetError(f"cast_storage row_sparse->{stype} unsupported")
+
+
+class CSRNDArray(BaseSparseNDArray):
+    __slots__ = ("_indptr", "_col_indices", "_values")
+
+    def __init__(self, data, indptr, indices, shape, ctx=None, dtype=None):
+        vals = jnp.asarray(data, dtype=dtype)
+        indptr = jnp.asarray(indptr, dtype=jnp.int32)
+        col = jnp.asarray(indices, dtype=jnp.int32)
+        dense = onp.zeros(tuple(shape), dtype=onp.dtype(str(vals.dtype)))
+        ip = onp.asarray(indptr)
+        cl = onp.asarray(col)
+        vl = onp.asarray(vals)
+        for r in range(shape[0]):
+            for j in range(int(ip[r]), int(ip[r + 1])):
+                dense[r, int(cl[j])] = vl[j]
+        super().__init__(jnp.asarray(dense), ctx=ctx)
+        self._indptr, self._col_indices, self._values = indptr, col, vals
+
+    @property
+    def stype(self):
+        return "csr"
+
+    @property
+    def indptr(self) -> NDArray:
+        return NDArray(self._indptr, ctx=self.context)
+
+    @property
+    def indices(self) -> NDArray:
+        return NDArray(self._col_indices, ctx=self.context)
+
+    @property
+    def data(self) -> NDArray:
+        return NDArray(self._values, ctx=self.context)
+
+    def tostype(self, stype):
+        if stype == "csr":
+            return self
+        if stype == "default":
+            return NDArray(self._data, ctx=self.context)
+        raise MXNetError(f"cast_storage csr->{stype} unsupported")
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None) -> RowSparseNDArray:
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        return RowSparseNDArray(data, indices, shape=shape, ctx=ctx, dtype=dtype)
+    dense = onp.asarray(arg1._data if isinstance(arg1, NDArray) else arg1)
+    nz = onp.where(onp.abs(dense).reshape(dense.shape[0], -1).sum(axis=1) > 0)[0]
+    return RowSparseNDArray(dense[nz], nz, shape=dense.shape, ctx=ctx, dtype=dtype)
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None) -> CSRNDArray:
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        return CSRNDArray(data, indptr, indices, shape, ctx=ctx, dtype=dtype)
+    dense = onp.asarray(arg1._data if isinstance(arg1, NDArray) else arg1)
+    indptr = [0]
+    cols, vals = [], []
+    for r in range(dense.shape[0]):
+        nz = onp.nonzero(dense[r])[0]
+        cols.extend(nz.tolist())
+        vals.extend(dense[r][nz].tolist())
+        indptr.append(len(cols))
+    return CSRNDArray(onp.array(vals, dense.dtype), onp.array(indptr), onp.array(cols),
+                      dense.shape, ctx=ctx, dtype=dtype)
+
+
+def cast_storage(arr: NDArray, stype: str):
+    if stype == "default":
+        return NDArray(arr._data, ctx=arr.context)
+    if stype == "row_sparse":
+        return row_sparse_array(arr, ctx=arr.context)
+    if stype == "csr":
+        if arr.ndim != 2:
+            raise MXNetError("csr requires 2-D")
+        return csr_matrix(arr, ctx=arr.context)
+    raise MXNetError(f"unknown stype {stype}")
+
+
+def zeros(stype, shape, ctx=None, dtype=None):
+    ctx = ctx or current_context()
+    if stype == "row_sparse":
+        return row_sparse_array(onp.zeros(shape), ctx=ctx, dtype=dtype)
+    if stype == "csr":
+        return csr_matrix(onp.zeros(shape), ctx=ctx, dtype=dtype)
+    from . import zeros as dense_zeros
+    return dense_zeros(shape, ctx=ctx, dtype=dtype)

@@ -1,0 +1,8 @@
+"""Pure-JAX operator library (the ``src/operator/`` counterpart).
+
+Importing this package registers all ops into ``registry.OPS``; the
+``mx.nd`` namespace is generated from that registry.
+"""
+from . import tensor  # noqa: F401
+from . import nn  # noqa: F401
+from .registry import OPS, OpDef, register_op, alias_op  # noqa: F401

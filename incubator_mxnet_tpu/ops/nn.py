@@ -1,0 +1,590 @@
+"""Neural-network ops: conv, FC, pooling, norms, softmax, dropout, RNN.
+
+TPU-native counterpart of ``src/operator/nn/`` (SURVEY §2.4): where the
+reference dispatches to cuDNN/mshadow kernels (``cudnn_convolution-inl.h``,
+``batch_norm.cu``, ``cudnn_rnn-inl.h``), these lower to ``jax.lax`` ops that
+XLA tiles onto the MXU (conv/matmul) and VPU (elementwise/norm), with fusion
+replacing the reference's hand-written fused kernels.
+
+Layouts follow MXNet: NCHW for 2-D conv (NCW / NCDHW for 1-D/3-D), weights
+OIHW, time-major (T, N, C) for the fused RNN op.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _tup(v, n):
+    if v is None:
+        return (1,) * n
+    if isinstance(v, int):
+        return (v,) * n
+    v = tuple(v)
+    if len(v) == 1:
+        return v * n
+    return v
+
+
+# ---------------------------------------------------------------------------
+# FullyConnected (reference: fully_connected.cc — cuBLAS gemm → MXU)
+# ---------------------------------------------------------------------------
+
+@register_op("FullyConnected", aliases=("fully_connected",))
+def fully_connected(data, weight, bias=None, num_hidden=None, no_bias=False, flatten=True, **_):
+    if flatten:
+        x = data.reshape(data.shape[0], -1)
+    else:
+        x = data
+    out = jnp.matmul(x, weight.T)
+    if bias is not None and not no_bias:
+        out = out + bias
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Convolution / Deconvolution (reference: convolution.cc + cudnn wrappers)
+# ---------------------------------------------------------------------------
+
+_CONV_SPECS = {1: ("NCH", "OIH", "NCH"), 2: ("NCHW", "OIHW", "NCHW"), 3: ("NCDHW", "OIDHW", "NCDHW")}
+
+
+def _conv_dims(kernel):
+    return len(kernel) if not isinstance(kernel, int) else 1
+
+
+@register_op("Convolution", aliases=("convolution",))
+def convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
+                pad=None, num_filter=None, num_group=1, no_bias=False, layout=None, **_):
+    nd = _conv_dims(kernel)
+    stride = _tup(stride, nd)
+    dilate = _tup(dilate, nd)
+    pad = _tup(pad if pad is not None else 0, nd)
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape, _CONV_SPECS[nd])
+    out = lax.conv_general_dilated(
+        data, weight,
+        window_strides=stride,
+        padding=[(p, p) for p in pad],
+        rhs_dilation=dilate,
+        dimension_numbers=dn,
+        feature_group_count=num_group,
+        preferred_element_type=None,
+    )
+    if bias is not None and not no_bias:
+        out = out + bias.reshape((1, -1) + (1,) * nd)
+    return out
+
+
+@register_op("Deconvolution", aliases=("deconvolution",))
+def deconvolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
+                  pad=None, adj=None, num_filter=None, num_group=1, no_bias=False,
+                  target_shape=None, layout=None, **_):
+    nd = _conv_dims(kernel)
+    stride = _tup(stride, nd)
+    pad = _tup(pad if pad is not None else 0, nd)
+    adj = _tup(adj if adj is not None else 0, nd)
+    # ConvTranspose = gradient of conv: lhs_dilation implements fractional stride.
+    # weight layout for MXNet Deconvolution is (in, out/g, *k).
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape, _CONV_SPECS[nd])
+    k = weight.shape[2:]
+    padding = [(k[i] - 1 - pad[i], k[i] - 1 - pad[i] + adj[i]) for i in range(nd)]
+    w = jnp.flip(weight, axis=tuple(range(2, 2 + nd)))
+    if num_group == 1:
+        w = jnp.swapaxes(w, 0, 1)
+    else:
+        ci, co = w.shape[0], w.shape[1]
+        w = w.reshape(num_group, ci // num_group, co, *k)
+        w = jnp.swapaxes(w, 1, 2).reshape(num_group * co, ci // num_group, *k)
+    out = lax.conv_general_dilated(
+        data, w,
+        window_strides=(1,) * nd,
+        padding=padding,
+        lhs_dilation=stride,
+        dimension_numbers=dn,
+        feature_group_count=num_group,
+    )
+    if bias is not None and not no_bias:
+        out = out + bias.reshape((1, -1) + (1,) * nd)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Pooling (reference: pooling.cc → lax.reduce_window)
+# ---------------------------------------------------------------------------
+
+@register_op("Pooling", aliases=("pooling",))
+def pooling(data, kernel=None, pool_type="max", global_pool=False, stride=None,
+            pad=None, pooling_convention="valid", count_include_pad=True, layout=None, **_):
+    nd = data.ndim - 2
+    if global_pool:
+        axes = tuple(range(2, 2 + nd))
+        if pool_type == "max":
+            return jnp.max(data, axis=axes, keepdims=True)
+        return jnp.mean(data, axis=axes, keepdims=True)
+    kernel = _tup(kernel, nd)
+    stride = _tup(stride, nd)
+    pad = _tup(pad if pad is not None else 0, nd)
+    window = (1, 1) + kernel
+    strides = (1, 1) + stride
+    padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+    if pooling_convention == "full":
+        # ceil-mode: add extra right-padding so the last window fits
+        extra = []
+        for i in range(nd):
+            size = data.shape[2 + i] + 2 * pad[i]
+            rem = (size - kernel[i]) % stride[i]
+            extra.append(0 if rem == 0 else stride[i] - rem)
+        padding = ((0, 0), (0, 0)) + tuple((p, p + e) for p, e in zip(pad, extra))
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
+        return lax.reduce_window(data, init, lax.max, window, strides, padding)
+    if pool_type in ("avg", "sum"):
+        summed = lax.reduce_window(data, 0.0, lax.add, window, strides, padding)
+        if pool_type == "sum":
+            return summed
+        if count_include_pad:
+            denom = 1.0
+            for k in kernel:
+                denom *= k
+            return summed / denom
+        ones = jnp.ones_like(data)
+        counts = lax.reduce_window(ones, 0.0, lax.add, window, strides, padding)
+        return summed / counts
+    if pool_type == "lp":
+        p = 2.0
+        s = lax.reduce_window(jnp.abs(data) ** p, 0.0, lax.add, window, strides, padding)
+        return s ** (1.0 / p)
+    raise ValueError(f"unknown pool_type {pool_type}")
+
+
+# ---------------------------------------------------------------------------
+# Normalization (reference: batch_norm.cc, layer_norm.cc, group_norm.cc)
+# ---------------------------------------------------------------------------
+
+@register_op("BatchNorm", aliases=("batch_norm",))
+def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-5, momentum=0.9,
+               fix_gamma=True, use_global_stats=False, output_mean_var=False,
+               axis=1, training=False, **_):
+    """Returns (out, batch_mean, batch_var). The layer updates running stats
+    functionally from the returned batch statistics (aux-state discipline —
+    see gluon/nn BatchNorm; reference mutates aux states inside the op)."""
+    axes = tuple(i for i in range(data.ndim) if i != axis)
+    if training and not use_global_stats:
+        m = jnp.mean(data, axis=axes)
+        v = jnp.var(data, axis=axes)
+    else:
+        m, v = moving_mean, moving_var
+    shape = [1] * data.ndim
+    shape[axis] = data.shape[axis]
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    out = (data - m.reshape(shape)) * lax.rsqrt(v.reshape(shape) + eps) * g.reshape(shape) + beta.reshape(shape)
+    return out, m, v
+
+
+@register_op("LayerNorm", aliases=("layer_norm",))
+def layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False, **_):
+    m = jnp.mean(data, axis=axis, keepdims=True)
+    v = jnp.var(data, axis=axis, keepdims=True)
+    out = (data - m) * lax.rsqrt(v + eps)
+    shape = [1] * data.ndim
+    shape[axis] = data.shape[axis]
+    out = out * gamma.reshape(shape) + beta.reshape(shape)
+    if output_mean_var:
+        return out, jnp.squeeze(m, axis), jnp.squeeze(v, axis)
+    return out
+
+
+@register_op("GroupNorm")
+def group_norm(data, gamma, beta, num_groups=1, eps=1e-5, **_):
+    n, c = data.shape[0], data.shape[1]
+    rest = data.shape[2:]
+    x = data.reshape(n, num_groups, c // num_groups, *rest)
+    axes = tuple(range(2, x.ndim))
+    m = jnp.mean(x, axis=axes, keepdims=True)
+    v = jnp.var(x, axis=axes, keepdims=True)
+    x = (x - m) * lax.rsqrt(v + eps)
+    x = x.reshape(data.shape)
+    shape = (1, c) + (1,) * len(rest)
+    return x * gamma.reshape(shape) + beta.reshape(shape)
+
+
+@register_op("InstanceNorm")
+def instance_norm(data, gamma, beta, eps=1e-3, **_):
+    axes = tuple(range(2, data.ndim))
+    m = jnp.mean(data, axis=axes, keepdims=True)
+    v = jnp.var(data, axis=axes, keepdims=True)
+    x = (data - m) * lax.rsqrt(v + eps)
+    shape = (1, data.shape[1]) + (1,) * (data.ndim - 2)
+    return x * gamma.reshape(shape) + beta.reshape(shape)
+
+
+@register_op("L2Normalization")
+def l2_normalization(data, eps=1e-10, mode="instance", **_):
+    if mode == "instance":
+        axes = tuple(range(1, data.ndim))
+    elif mode == "channel":
+        axes = (1,)
+    else:  # spatial
+        axes = tuple(range(2, data.ndim))
+    nrm = jnp.sqrt(jnp.sum(jnp.square(data), axis=axes, keepdims=True) + eps)
+    return data / nrm
+
+
+@register_op("RMSNorm", aliases=("rms_norm",))
+def rms_norm(data, gamma, axis=-1, eps=1e-6, **_):
+    """TPU-era extension (not in reference): RMSNorm for LLaMA-family models."""
+    v = jnp.mean(jnp.square(data), axis=axis, keepdims=True)
+    return data * lax.rsqrt(v + eps) * gamma
+
+
+# ---------------------------------------------------------------------------
+# Activations (reference: activation.cc, leaky_relu.cc)
+# ---------------------------------------------------------------------------
+
+_ACTS = {
+    "relu": lambda x: jnp.maximum(x, 0),
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "softrelu": jax.nn.softplus,
+    "softsign": jax.nn.soft_sign,
+}
+
+
+@register_op("Activation", aliases=("activation",))
+def activation(data, act_type="relu", **_):
+    return _ACTS[act_type](data)
+
+
+@register_op("LeakyReLU", aliases=("leaky_relu",))
+def leaky_relu(data, gamma=None, act_type="leaky", slope=0.25, lower_bound=0.125,
+               upper_bound=0.334, **_):
+    if act_type == "leaky":
+        return jnp.where(data >= 0, data, slope * data)
+    if act_type == "prelu":
+        g = gamma
+        if g.ndim < data.ndim:
+            shape = [1] * data.ndim
+            if data.ndim > 1:
+                shape[1] = g.size
+            g = g.reshape(shape)
+        return jnp.where(data >= 0, data, g * data)
+    if act_type == "elu":
+        return jnp.where(data >= 0, data, slope * jnp.expm1(data))
+    if act_type == "selu":
+        alpha, scale = 1.6732632423543772, 1.0507009873554805
+        return scale * jnp.where(data >= 0, data, alpha * jnp.expm1(data))
+    if act_type == "gelu":
+        return jax.nn.gelu(data, approximate=False)
+    if act_type == "rrelu":
+        mid = (lower_bound + upper_bound) / 2.0
+        return jnp.where(data >= 0, data, mid * data)
+    raise ValueError(f"unknown act_type {act_type}")
+
+
+@register_op("gelu_tanh")
+def gelu_tanh(data, **_):
+    return jax.nn.gelu(data, approximate=True)
+
+
+@register_op("silu", aliases=("swish",))
+def silu(data, **_):
+    return data * jax.nn.sigmoid(data)
+
+
+# ---------------------------------------------------------------------------
+# Softmax family (reference: softmax.cc incl. SoftmaxWithLength)
+# ---------------------------------------------------------------------------
+
+@register_op("softmax")
+def softmax(data, length=None, axis=-1, temperature=None, use_length=False, **_):
+    x = data / temperature if temperature not in (None, 1.0) else data
+    if use_length and length is not None:
+        # mask positions >= length along `axis` (SoftmaxWithLength)
+        T = data.shape[axis]
+        steps = jnp.arange(T)
+        shape = [1] * data.ndim
+        shape[axis] = T
+        lshape = list(data.shape)
+        lshape[axis] = 1
+        mask = steps.reshape(shape) < length.reshape(lshape).astype(jnp.int32)
+        x = jnp.where(mask, x, -jnp.inf)
+        out = jax.nn.softmax(x, axis=axis)
+        return jnp.where(mask, out, 0.0)
+    return jax.nn.softmax(x, axis=axis)
+
+
+@register_op("log_softmax")
+def log_softmax(data, axis=-1, temperature=None, **_):
+    x = data / temperature if temperature not in (None, 1.0) else data
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@register_op("softmin")
+def softmin(data, axis=-1, **_):
+    return jax.nn.softmax(-data, axis=axis)
+
+
+@register_op("masked_softmax")
+def masked_softmax(data, mask=None, axis=-1, temperature=1.0, **_):
+    x = data / temperature
+    if mask is not None:
+        x = jnp.where(mask != 0, x, -jnp.inf)
+    out = jax.nn.softmax(x, axis=axis)
+    if mask is not None:
+        out = jnp.where(mask != 0, out, 0.0)
+    return out
+
+
+@register_op("SoftmaxOutput", aliases=("softmax_output",))
+def softmax_output(data, label, grad_scale=1.0, ignore_label=-1, multi_output=False,
+                   use_ignore=False, preserve_shape=False, normalization="null",
+                   out_grad=False, smooth_alpha=0.0, **_):
+    """Forward = softmax; the loss-gradient fusion of the reference is handled
+    by autograd on the loss side."""
+    return jax.nn.softmax(data, axis=-1)
+
+
+@register_op("softmax_cross_entropy")
+def softmax_cross_entropy(data, label, **_):
+    logp = jax.nn.log_softmax(data, axis=-1)
+    nll = -jnp.take_along_axis(logp, label.astype(jnp.int32)[:, None], axis=-1)
+    return jnp.sum(nll)
+
+
+@register_op("smooth_l1")
+def smooth_l1(data, scalar=1.0, **_):
+    s2 = scalar * scalar
+    a = jnp.abs(data)
+    return jnp.where(a < 1.0 / s2, 0.5 * s2 * jnp.square(data), a - 0.5 / s2)
+
+
+# ---------------------------------------------------------------------------
+# Dropout (reference: dropout.cc — cuDNN dropout state ≙ explicit key)
+# ---------------------------------------------------------------------------
+
+@register_op("Dropout", aliases=("dropout",))
+def dropout(data, p=0.5, mode="training", axes=(), training=False, key=None, **_):
+    if not training or p <= 0.0 or key is None:
+        return data
+    shape = list(data.shape)
+    for a in axes:
+        shape[a] = 1
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, tuple(shape)).astype(data.dtype)
+    return data * mask / keep
+
+
+# ---------------------------------------------------------------------------
+# UpSampling / resize (reference: upsampling.cc, bilinear_resize.cc)
+# ---------------------------------------------------------------------------
+
+@register_op("UpSampling")
+def upsampling(data, scale=1, sample_type="nearest", num_args=1, **_):
+    n, c, h, w = data.shape
+    if sample_type == "nearest":
+        return jnp.repeat(jnp.repeat(data, scale, axis=2), scale, axis=3)
+    return jax.image.resize(data, (n, c, h * scale, w * scale), method="bilinear")
+
+
+@register_op("contrib_BilinearResize2D", aliases=("bilinear_resize_2d",))
+def bilinear_resize_2d(data, height=None, width=None, scale_height=None, scale_width=None, **_):
+    n, c, h, w = data.shape
+    oh = height if height else int(h * scale_height)
+    ow = width if width else int(w * scale_width)
+    return jax.image.resize(data, (n, c, oh, ow), method="bilinear")
+
+
+# ---------------------------------------------------------------------------
+# Fused RNN op (reference: rnn.cc / cudnn_rnn-inl.h → lax.scan)
+# ---------------------------------------------------------------------------
+
+def _lstm_cell(x, h, c, wx, wh, bx, bh):
+    gates = jnp.matmul(x, wx.T) + jnp.matmul(h, wh.T) + bx + bh
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    c2 = f * c + i * g
+    h2 = o * jnp.tanh(c2)
+    return h2, c2
+
+
+def _gru_cell(x, h, wx, wh, bx, bh):
+    xr, xz, xn = jnp.split(jnp.matmul(x, wx.T) + bx, 3, axis=-1)
+    hr, hz, hn = jnp.split(jnp.matmul(h, wh.T) + bh, 3, axis=-1)
+    r = jax.nn.sigmoid(xr + hr)
+    z = jax.nn.sigmoid(xz + hz)
+    n = jnp.tanh(xn + r * hn)
+    return (1 - z) * n + z * h
+
+
+def _rnn_cell(x, h, wx, wh, bx, bh, act):
+    return act(jnp.matmul(x, wx.T) + jnp.matmul(h, wh.T) + bx + bh)
+
+
+def _gates(mode):
+    return {"rnn_relu": 1, "rnn_tanh": 1, "gru": 3, "lstm": 4}[mode]
+
+
+def rnn_unpack_params(params, mode, num_layers, input_size, hidden, bidirectional):
+    """Slice MXNet's flat fused-RNN parameter vector into per-layer weights.
+    Layout (cuDNN order, reference rnn-inl.h): all Wx,Wh per layer/direction,
+    then all bx,bh."""
+    ngates = _gates(mode)
+    dirs = 2 if bidirectional else 1
+    shapes = []
+    for layer in range(num_layers):
+        isz = input_size if layer == 0 else hidden * dirs
+        for _ in range(dirs):
+            shapes.append((ngates * hidden, isz))   # wx
+            shapes.append((ngates * hidden, hidden))  # wh
+    bias_shapes = []
+    for layer in range(num_layers):
+        for _ in range(dirs):
+            bias_shapes.append((ngates * hidden,))
+            bias_shapes.append((ngates * hidden,))
+    ws, off = [], 0
+    for s in shapes:
+        n = s[0] * (s[1] if len(s) > 1 else 1)
+        ws.append(params[off:off + n].reshape(s))
+        off += n
+    bs = []
+    for s in bias_shapes:
+        bs.append(params[off:off + s[0]].reshape(s))
+        off += s[0]
+    return ws, bs
+
+
+def rnn_param_size(mode, num_layers, input_size, hidden, bidirectional):
+    ngates = _gates(mode)
+    dirs = 2 if bidirectional else 1
+    size = 0
+    for layer in range(num_layers):
+        isz = input_size if layer == 0 else hidden * dirs
+        size += dirs * ngates * hidden * (isz + hidden + 2)
+    return size
+
+
+@register_op("RNN")
+def rnn(data, parameters, state, state_cell=None, state_size=None, num_layers=1,
+        mode="lstm", bidirectional=False, p=0.0, state_outputs=False,
+        projection_size=None, **_):
+    """Fused multi-layer (bi)RNN. data: (T, N, C) time-major like the
+    reference. Returns out or (out, h_n[, c_n]) per state_outputs."""
+    T, N, C = data.shape
+    hidden = state_size
+    dirs = 2 if bidirectional else 1
+    ws, bs = rnn_unpack_params(parameters, mode, num_layers, C, hidden, bidirectional)
+    act = jnp.tanh if mode != "rnn_relu" else (lambda x: jnp.maximum(x, 0))
+
+    x = data
+    h_states, c_states = [], []
+    for layer in range(num_layers):
+        outs_dir = []
+        for d in range(dirs):
+            wi = ws[(layer * dirs + d) * 2]
+            wh = ws[(layer * dirs + d) * 2 + 1]
+            bi = bs[(layer * dirs + d) * 2]
+            bh = bs[(layer * dirs + d) * 2 + 1]
+            h0 = state[layer * dirs + d]
+            seq = x if d == 0 else jnp.flip(x, axis=0)
+            if mode == "lstm":
+                c0 = state_cell[layer * dirs + d]
+
+                def step(carry, xt):
+                    h, c = carry
+                    h2, c2 = _lstm_cell(xt, h, c, wi, wh, bi, bh)
+                    return (h2, c2), h2
+
+                (hT, cT), out = lax.scan(step, (h0, c0), seq)
+                c_states.append(cT)
+            elif mode == "gru":
+                def step(h, xt):
+                    h2 = _gru_cell(xt, h, wi, wh, bi, bh)
+                    return h2, h2
+
+                hT, out = lax.scan(step, h0, seq)
+            else:
+                def step(h, xt):
+                    h2 = _rnn_cell(xt, h, wi, wh, bi, bh, act)
+                    return h2, h2
+
+                hT, out = lax.scan(step, h0, seq)
+            h_states.append(hT)
+            if d == 1:
+                out = jnp.flip(out, axis=0)
+            outs_dir.append(out)
+        x = jnp.concatenate(outs_dir, axis=-1) if dirs == 2 else outs_dir[0]
+
+    outs = [x, jnp.stack(h_states, axis=0)]
+    if mode == "lstm":
+        outs.append(jnp.stack(c_states, axis=0))
+    if state_outputs:
+        return tuple(outs)
+    return x
+
+
+# ---------------------------------------------------------------------------
+# CTC loss (reference: ctc_loss.cc — forward-backward via scan in log space)
+# ---------------------------------------------------------------------------
+
+@register_op("CTCLoss", aliases=("ctc_loss",))
+def ctc_loss(data, label, data_lengths=None, label_lengths=None,
+             use_data_lengths=False, use_label_lengths=False, blank_label="first", **_):
+    """data: (T, N, C) activations (pre-softmax); label: (N, L) padded with
+    -1 (or 0s when blank_label='last'). Returns per-example loss (N,)."""
+    T, N, C = data.shape
+    logp = jax.nn.log_softmax(data, axis=-1)
+    blank = 0 if blank_label == "first" else C - 1
+    L = label.shape[1]
+    lab = label.astype(jnp.int32)
+    if use_label_lengths and label_lengths is not None:
+        lab_len = label_lengths.astype(jnp.int32)
+    else:
+        valid = (lab >= 0) & (lab != blank if blank_label == "first" else lab >= 0)
+        lab_len = jnp.sum((lab > 0) if blank_label == "first" else (lab >= 0), axis=1).astype(jnp.int32)
+        lab_len = jnp.sum(lab > -1, axis=1).astype(jnp.int32) if blank_label != "first" else jnp.sum(lab > 0, axis=1) + jnp.sum(lab == 0, axis=1) * 0
+        lab_len = jnp.sum(lab > 0, axis=1).astype(jnp.int32) if blank_label == "first" else jnp.sum(lab >= 0, axis=1).astype(jnp.int32)
+    t_len = (data_lengths.astype(jnp.int32) if use_data_lengths and data_lengths is not None
+             else jnp.full((N,), T, jnp.int32))
+
+    S = 2 * L + 1
+    # extended label: blank, l1, blank, l2, ... blank
+    ext = jnp.full((N, S), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(lab)
+    neg_inf = -1e30
+
+    def per_example(logp_n, ext_n, ll, tl):
+        # alpha: (S,)
+        alpha0 = jnp.full((S,), neg_inf)
+        alpha0 = alpha0.at[0].set(logp_n[0, blank])
+        alpha0 = alpha0.at[1].set(jnp.where(ll > 0, logp_n[0, ext_n[1]], neg_inf))
+
+        allow_skip = jnp.concatenate([
+            jnp.array([False, False]),
+            (ext_n[2:] != blank) & (ext_n[2:] != ext_n[:-2]),
+        ])
+
+        def step(alpha, t):
+            a_prev1 = jnp.concatenate([jnp.array([neg_inf]), alpha[:-1]])
+            a_prev2 = jnp.concatenate([jnp.array([neg_inf, neg_inf]), alpha[:-2]])
+            a_prev2 = jnp.where(allow_skip, a_prev2, neg_inf)
+            merged = jnp.logaddexp(jnp.logaddexp(alpha, a_prev1), a_prev2)
+            new = merged + logp_n[t, ext_n]
+            new = jnp.where(t < tl, new, alpha)
+            return new, None
+
+        alphaT, _ = lax.scan(step, alpha0, jnp.arange(1, T))
+        end = 2 * ll
+        p1 = alphaT[end]
+        p2 = jnp.where(end - 1 >= 0, alphaT[jnp.maximum(end - 1, 0)], neg_inf)
+        return -jnp.logaddexp(p1, p2)
+
+    return jax.vmap(per_example)(jnp.transpose(logp, (1, 0, 2)), ext, lab_len, t_len)

@@ -1,0 +1,54 @@
+"""Operator registry.
+
+TPU-native counterpart of the NNVM op registry (``NNVM_REGISTER_OP`` +
+``FCompute`` attrs — SURVEY §2.4). Each op here is a *pure JAX function*
+``fn(*arrays, **params) -> array | tuple`` :
+
+- ``FCompute``        ≙ the function body (jax.numpy/lax, compiled by XLA)
+- ``FInferShape/Type``≙ JAX abstract evaluation (free)
+- ``FGradient``       ≙ ``jax.vjp`` of the same function (free)
+- name + aliases      ≙ the registered op name reflected into ``mx.nd.*``
+                        (reference: ``python/mxnet/ndarray/register.py``)
+
+The ``mx.nd`` namespace wrappers (NDArray-level, autograd-recording) are
+generated from this registry in ``ndarray/__init__.py``.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+__all__ = ["OpDef", "register_op", "OPS", "alias_op"]
+
+
+class OpDef:
+    __slots__ = ("name", "fn", "aliases", "module")
+
+    def __init__(self, name: str, fn: Callable, aliases: Tuple[str, ...] = ()):
+        self.name = name
+        self.fn = fn
+        self.aliases = aliases
+        self.module = fn.__module__
+
+
+OPS: Dict[str, OpDef] = {}
+
+
+def register_op(name: Optional[str] = None, aliases: Tuple[str, ...] = ()):
+    """Register a pure op. Usable as ``@register_op()`` or
+    ``@register_op("name", aliases=("alias1",))``."""
+
+    def _do(fn: Callable) -> Callable:
+        opname = name or fn.__name__
+        opdef = OpDef(opname, fn, tuple(aliases))
+        OPS[opname] = opdef
+        for a in aliases:
+            OPS[a] = opdef
+        return fn
+
+    return _do
+
+
+def alias_op(existing: str, *names: str) -> None:
+    opdef = OPS[existing]
+    for n in names:
+        OPS[n] = opdef

@@ -1,0 +1,746 @@
+"""Tensor ops: elementwise, broadcast, reduce, matmul, shape, indexing, sort.
+
+TPU-native counterpart of ``src/operator/tensor/`` (SURVEY §2.4:
+``elemwise_binary_broadcast_op_basic.cc``, ``dot-inl.h``, ``matrix_op.cc``,
+``indexing_op.cc``, ``ordering_op.cc``). Every op is a pure JAX function;
+XLA provides the CPU/TPU kernels, fusion, and (via jax.vjp) the gradients
+that the reference hand-registers per op.
+
+MXNet semantic details preserved: ``reshape`` magic codes (0,-1,-2,-3,-4),
+``dot``'s last-axis·first-axis contraction, ``topk``'s ret_typ modes,
+``take``'s clip/wrap modes, 0/1-valued comparison outputs in input dtype.
+"""
+from __future__ import annotations
+
+import builtins
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op, alias_op
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _axis_tuple(axis, ndim):
+    if axis is None:
+        return tuple(range(ndim))
+    if isinstance(axis, int):
+        return (axis,)
+    return tuple(axis)
+
+
+# ---------------------------------------------------------------------------
+# unary elementwise (reference: elemwise_unary_op_basic.cc etc.)
+# ---------------------------------------------------------------------------
+
+def _unary(name, f, aliases=()):
+    @register_op(name, aliases=aliases)
+    def op(data, **_ignored):
+        return f(data)
+    op.__name__ = name
+    return op
+
+
+_unary("negative", lambda x: -x)
+_unary("abs", jnp.abs)
+_unary("sign", jnp.sign)
+_unary("ceil", jnp.ceil)
+_unary("floor", jnp.floor)
+_unary("round", jnp.round)
+_unary("rint", jnp.rint)
+_unary("trunc", jnp.trunc)
+_unary("fix", jnp.trunc)
+_unary("square", jnp.square)
+_unary("sqrt", jnp.sqrt)
+_unary("rsqrt", lambda x: lax.rsqrt(x))
+_unary("cbrt", jnp.cbrt)
+_unary("rcbrt", lambda x: 1.0 / jnp.cbrt(x))
+_unary("exp", jnp.exp)
+_unary("expm1", jnp.expm1)
+_unary("log", jnp.log)
+_unary("log10", jnp.log10)
+_unary("log2", jnp.log2)
+_unary("log1p", jnp.log1p)
+_unary("sin", jnp.sin)
+_unary("cos", jnp.cos)
+_unary("tan", jnp.tan)
+_unary("arcsin", jnp.arcsin)
+_unary("arccos", jnp.arccos)
+_unary("arctan", jnp.arctan)
+_unary("sinh", jnp.sinh)
+_unary("cosh", jnp.cosh)
+_unary("tanh", jnp.tanh)
+_unary("arcsinh", jnp.arcsinh)
+_unary("arccosh", jnp.arccosh)
+_unary("arctanh", jnp.arctanh)
+_unary("degrees", jnp.degrees)
+_unary("radians", jnp.radians)
+_unary("reciprocal", lambda x: 1.0 / x)
+_unary("erf", jax.scipy.special.erf)
+_unary("erfinv", jax.scipy.special.erfinv)
+_unary("gamma", lambda x: jnp.exp(jax.scipy.special.gammaln(x)))
+_unary("gammaln", jax.scipy.special.gammaln)
+_unary("logical_not", lambda x: (x == 0).astype(x.dtype))
+_unary("relu", lambda x: jnp.maximum(x, 0))
+_unary("sigmoid", jax.nn.sigmoid)
+_unary("softsign", jax.nn.soft_sign)
+_unary("softrelu", jax.nn.softplus, aliases=("softplus",))
+_unary("hard_sigmoid", lambda x: jnp.clip(0.2 * x + 0.5, 0.0, 1.0))
+_unary("identity", lambda x: x, aliases=("copy", "stop_gradient_identity", "BlockGrad_", ))
+
+
+@register_op("BlockGrad", aliases=("stop_gradient",))
+def block_grad(data):
+    return lax.stop_gradient(data)
+
+
+@register_op("make_loss")
+def make_loss(data, grad_scale=1.0, **_):
+    return data
+
+
+@register_op("cast", aliases=("Cast",))
+def cast(data, dtype="float32"):
+    return data.astype(jnp.dtype(dtype))
+
+
+@register_op("clip")
+def clip(data, a_min=None, a_max=None):
+    return jnp.clip(data, a_min, a_max)
+
+
+@register_op("isnan")
+def isnan(data):
+    return jnp.isnan(data)
+
+
+@register_op("isinf")
+def isinf(data):
+    return jnp.isinf(data)
+
+
+@register_op("isfinite")
+def isfinite(data):
+    return jnp.isfinite(data)
+
+
+# ---------------------------------------------------------------------------
+# binary broadcast (reference: elemwise_binary_broadcast_op_*.cc)
+# ---------------------------------------------------------------------------
+
+def _binary(name, f, aliases=()):
+    @register_op(name, aliases=aliases)
+    def op(lhs, rhs, **_ignored):
+        return f(lhs, rhs)
+    op.__name__ = name
+    return op
+
+
+_binary("add", jnp.add, aliases=("broadcast_add", "broadcast_plus", "elemwise_add", "__add__"))
+_binary("subtract", jnp.subtract, aliases=("broadcast_sub", "broadcast_minus", "elemwise_sub"))
+_binary("multiply", jnp.multiply, aliases=("broadcast_mul", "elemwise_mul"))
+_binary("divide", jnp.divide, aliases=("broadcast_div", "elemwise_div"))
+_binary("floor_divide", jnp.floor_divide)
+_binary("mod", jnp.mod, aliases=("broadcast_mod",))
+_binary("power", jnp.power, aliases=("broadcast_power", "pow"))
+_binary("maximum", jnp.maximum, aliases=("broadcast_maximum",))
+_binary("minimum", jnp.minimum, aliases=("broadcast_minimum",))
+_binary("hypot", jnp.hypot, aliases=("broadcast_hypot",))
+_binary("arctan2", jnp.arctan2)
+
+
+def _cmp(name, f, aliases=()):
+    @register_op(name, aliases=aliases)
+    def op(lhs, rhs, **_ignored):
+        dt = jnp.result_type(lhs, rhs)
+        if dt == jnp.bool_:
+            dt = jnp.float32
+        return f(lhs, rhs).astype(dt)
+    op.__name__ = name
+    return op
+
+
+_cmp("equal", jnp.equal, aliases=("broadcast_equal",))
+_cmp("not_equal", jnp.not_equal, aliases=("broadcast_not_equal",))
+_cmp("greater", jnp.greater, aliases=("broadcast_greater",))
+_cmp("greater_equal", jnp.greater_equal, aliases=("broadcast_greater_equal",))
+_cmp("lesser", jnp.less, aliases=("broadcast_lesser", "less"))
+_cmp("lesser_equal", jnp.less_equal, aliases=("broadcast_lesser_equal", "less_equal"))
+_cmp("logical_and", lambda a, b: (a != 0) & (b != 0), aliases=("broadcast_logical_and",))
+_cmp("logical_or", lambda a, b: (a != 0) | (b != 0), aliases=("broadcast_logical_or",))
+_cmp("logical_xor", lambda a, b: (a != 0) ^ (b != 0), aliases=("broadcast_logical_xor",))
+
+
+@register_op("add_n", aliases=("ElementWiseSum", "sum_n"))
+def add_n(*args):
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return out
+
+
+@register_op("where")
+def where(condition, x, y):
+    return jnp.where(condition != 0 if condition.dtype != jnp.bool_ else condition, x, y)
+
+
+# ---------------------------------------------------------------------------
+# reductions (reference: broadcast_reduce_op_value.cc)
+# ---------------------------------------------------------------------------
+
+@register_op("sum", aliases=("sum_axis",))
+def sum(data, axis=None, keepdims=False, exclude=False, **_):
+    axis = _excl(axis, exclude, data.ndim)
+    return jnp.sum(data, axis=axis, keepdims=keepdims)
+
+
+def _excl(axis, exclude, ndim):
+    if not exclude:
+        return axis
+    ax = set(_axis_tuple(axis, ndim))
+    return tuple(i for i in range(ndim) if i not in ax)
+
+
+@register_op("nansum")
+def nansum(data, axis=None, keepdims=False, **_):
+    return jnp.nansum(data, axis=axis, keepdims=keepdims)
+
+
+@register_op("mean")
+def mean(data, axis=None, keepdims=False, exclude=False, **_):
+    axis = _excl(axis, exclude, data.ndim)
+    return jnp.mean(data, axis=axis, keepdims=keepdims)
+
+
+@register_op("prod")
+def prod(data, axis=None, keepdims=False, **_):
+    return jnp.prod(data, axis=axis, keepdims=keepdims)
+
+
+@register_op("nanprod")
+def nanprod(data, axis=None, keepdims=False, **_):
+    return jnp.nanprod(data, axis=axis, keepdims=keepdims)
+
+
+@register_op("max", aliases=("max_axis",))
+def max(data, axis=None, keepdims=False, **_):
+    return jnp.max(data, axis=axis, keepdims=keepdims)
+
+
+@register_op("min", aliases=("min_axis",))
+def min(data, axis=None, keepdims=False, **_):
+    return jnp.min(data, axis=axis, keepdims=keepdims)
+
+
+@register_op("norm")
+def norm(data, ord=2, axis=None, keepdims=False, **_):
+    if ord == 1:
+        return jnp.sum(jnp.abs(data), axis=axis, keepdims=keepdims)
+    return jnp.sqrt(jnp.sum(jnp.square(data), axis=axis, keepdims=keepdims))
+
+
+@register_op("logsumexp")
+def logsumexp(data, axis=None, keepdims=False, **_):
+    return jax.scipy.special.logsumexp(data, axis=axis, keepdims=keepdims)
+
+
+@register_op("argmax")
+def argmax(data, axis=None, keepdims=False, **_):
+    out = jnp.argmax(data, axis=axis, keepdims=keepdims)
+    return out.astype(jnp.float32)
+
+
+@register_op("argmin")
+def argmin(data, axis=None, keepdims=False, **_):
+    return jnp.argmin(data, axis=axis, keepdims=keepdims).astype(jnp.float32)
+
+
+@register_op("argmax_channel")
+def argmax_channel(data, **_):
+    return jnp.argmax(data, axis=1).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# matmul family (reference: dot-inl.h — cuBLAS → MXU)
+# ---------------------------------------------------------------------------
+
+@register_op("dot")
+def dot(lhs, rhs, transpose_a=False, transpose_b=False, **_):
+    """MXNet dot: contract lhs's last axis with rhs's first axis.
+    transpose_a/b contract the *first* axis of lhs / *last* of rhs instead."""
+    la = 0 if transpose_a else lhs.ndim - 1
+    ra = rhs.ndim - 1 if transpose_b else 0
+    if lhs.ndim == 1 and rhs.ndim == 1:
+        return jnp.dot(lhs, rhs)
+    return jnp.tensordot(lhs, rhs, axes=(la, ra))
+
+
+@register_op("batch_dot")
+def batch_dot(lhs, rhs, transpose_a=False, transpose_b=False, **_):
+    if transpose_a:
+        lhs = jnp.swapaxes(lhs, -1, -2)
+    if transpose_b:
+        rhs = jnp.swapaxes(rhs, -1, -2)
+    return jnp.matmul(lhs, rhs)
+
+
+@register_op("matmul")
+def matmul(a, b, **_):
+    return jnp.matmul(a, b)
+
+
+@register_op("khatri_rao")
+def khatri_rao(*args):
+    out = args[0]
+    for m in args[1:]:
+        out = jnp.einsum("i...,j...->ij...", out, m).reshape(-1, out.shape[-1])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# shape manipulation (reference: matrix_op.cc)
+# ---------------------------------------------------------------------------
+
+def _mx_reshape_shape(ishape: Tuple[int, ...], shape: Sequence[int]) -> Tuple[int, ...]:
+    """MXNet reshape magic: 0 copy-dim, -1 infer, -2 copy-rest, -3 merge-two,
+    -4 split (followed by two dims, one may be -1)."""
+    out = []
+    i = 0  # index into ishape
+    j = 0  # index into shape spec
+    shape = list(shape)
+    while j < len(shape):
+        s = shape[j]
+        if s == 0:
+            out.append(ishape[i]); i += 1
+        elif s == -1:
+            out.append(-1); i += 1
+        elif s == -2:
+            out.extend(ishape[i:]); i = len(ishape)
+        elif s == -3:
+            out.append(ishape[i] * ishape[i + 1]); i += 2
+        elif s == -4:
+            d1, d2 = shape[j + 1], shape[j + 2]
+            j += 2
+            cur = ishape[i]; i += 1
+            if d1 == -1:
+                d1 = cur // d2
+            if d2 == -1:
+                d2 = cur // d1
+            out.extend([d1, d2])
+        else:
+            out.append(s); i += 1
+        j += 1
+    if out.count(-1) > 1:
+        raise ValueError("reshape can infer at most one dimension")
+    return tuple(out)
+
+
+@register_op("reshape", aliases=("Reshape",))
+def reshape(data, shape=None, reverse=False, **_):
+    newshape = _mx_reshape_shape(data.shape, shape)
+    return jnp.reshape(data, newshape)
+
+
+@register_op("reshape_like")
+def reshape_like(lhs, rhs, **_):
+    return jnp.reshape(lhs, rhs.shape)
+
+
+@register_op("transpose")
+def transpose(data, axes=None, **_):
+    if axes is not None and len(axes) == 0:
+        axes = None
+    return jnp.transpose(data, axes=axes)
+
+
+@register_op("swapaxes", aliases=("SwapAxis",))
+def swapaxes(data, dim1=0, dim2=0, **_):
+    return jnp.swapaxes(data, dim1, dim2)
+
+
+@register_op("flatten", aliases=("Flatten",))
+def flatten(data, **_):
+    return jnp.reshape(data, (data.shape[0], -1))
+
+
+@register_op("expand_dims")
+def expand_dims(data, axis=0, **_):
+    return jnp.expand_dims(data, axis)
+
+
+@register_op("squeeze")
+def squeeze(data, axis=None, **_):
+    return jnp.squeeze(data, axis=axis)
+
+
+@register_op("broadcast_to")
+def broadcast_to(data, shape=None, **_):
+    tgt = tuple(d if s == 0 else s for s, d in zip(shape, data.shape)) if len(shape) == data.ndim else tuple(shape)
+    return jnp.broadcast_to(data, tgt)
+
+
+@register_op("broadcast_like")
+def broadcast_like(lhs, rhs, **_):
+    return jnp.broadcast_to(lhs, rhs.shape)
+
+
+@register_op("broadcast_axis", aliases=("broadcast_axes",))
+def broadcast_axis(data, axis=(), size=(), **_):
+    axis = _axis_tuple(axis, data.ndim) if not isinstance(axis, tuple) else axis
+    size = (size,) if isinstance(size, int) else tuple(size)
+    tgt = list(data.shape)
+    for a, s in zip(axis, size):
+        tgt[a] = s
+    return jnp.broadcast_to(data, tuple(tgt))
+
+
+@register_op("slice", aliases=("crop",))
+def slice(data, begin=None, end=None, step=None, **_):
+    idx = []
+    step = step or [None] * len(begin)
+    for b, e, s in zip(begin, end, step):
+        idx.append(builtins.slice(b, e, s))
+    return data[tuple(idx)]
+
+
+@register_op("slice_axis")
+def slice_axis(data, axis=0, begin=0, end=None, **_):
+    idx = [builtins.slice(None)] * data.ndim
+    if end is not None and end < 0:
+        end = data.shape[axis] + end
+    idx[axis] = builtins.slice(begin, end)
+    return data[tuple(idx)]
+
+
+@register_op("slice_like")
+def slice_like(data, shape_like, axes=(), **_):
+    axes = axes or tuple(range(shape_like.ndim))
+    idx = [builtins.slice(None)] * data.ndim
+    for a in axes:
+        idx[a] = builtins.slice(0, shape_like.shape[a])
+    return data[tuple(idx)]
+
+
+@register_op("take")
+def take(a, indices, axis=0, mode="clip", **_):
+    indices = indices.astype(jnp.int32)
+    if mode == "wrap":
+        indices = jnp.mod(indices, a.shape[axis])
+        mode = "clip"
+    return jnp.take(a, indices, axis=axis, mode=mode)
+
+
+@register_op("pick", aliases=("choose_element_0index",))
+def pick(data, index, axis=-1, keepdims=False, mode="clip", **_):
+    index = jnp.clip(index.astype(jnp.int32), 0, data.shape[axis] - 1)
+    out = jnp.take_along_axis(data, jnp.expand_dims(index, axis), axis=axis)
+    if not keepdims:
+        out = jnp.squeeze(out, axis=axis)
+    return out
+
+
+@register_op("gather_nd")
+def gather_nd(data, indices, **_):
+    indices = indices.astype(jnp.int32)
+    idx = tuple(indices[i] for i in range(indices.shape[0]))
+    return data[idx]
+
+
+@register_op("scatter_nd")
+def scatter_nd(data, indices, shape=None, **_):
+    indices = indices.astype(jnp.int32)
+    out = jnp.zeros(tuple(shape), data.dtype)
+    idx = tuple(indices[i] for i in range(indices.shape[0]))
+    return out.at[idx].set(data)
+
+
+@register_op("one_hot")
+def one_hot(indices, depth=None, on_value=1.0, off_value=0.0, dtype="float32", **_):
+    oh = jax.nn.one_hot(indices.astype(jnp.int32), depth, dtype=jnp.dtype(dtype))
+    return oh * (on_value - off_value) + off_value
+
+
+@register_op("concat", aliases=("Concat",))
+def concat(*args, dim=1, **_):
+    return jnp.concatenate(args, axis=dim)
+
+
+@register_op("stack")
+def stack(*args, axis=0, **_):
+    return jnp.stack(args, axis=axis)
+
+
+@register_op("split", aliases=("SliceChannel",))
+def split(data, num_outputs=1, axis=1, squeeze_axis=False, **_):
+    parts = jnp.split(data, num_outputs, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts) if num_outputs > 1 else parts[0]
+
+
+@register_op("split_v2")
+def split_v2(data, indices_or_sections=1, axis=0, squeeze_axis=False, **_):
+    parts = jnp.split(data, indices_or_sections, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts) if len(parts) > 1 else parts[0]
+
+
+@register_op("tile")
+def tile(data, reps=(), **_):
+    return jnp.tile(data, reps)
+
+
+@register_op("repeat")
+def repeat(data, repeats=1, axis=None, **_):
+    return jnp.repeat(data, repeats, axis=axis)
+
+
+@register_op("pad", aliases=("Pad",))
+def pad(data, mode="constant", pad_width=(), constant_value=0.0, **_):
+    pw = [(pad_width[2 * i], pad_width[2 * i + 1]) for i in range(len(pad_width) // 2)]
+    jmode = {"constant": "constant", "edge": "edge", "reflect": "reflect"}[mode]
+    if jmode == "constant":
+        return jnp.pad(data, pw, mode=jmode, constant_values=constant_value)
+    return jnp.pad(data, pw, mode=jmode)
+
+
+@register_op("reverse", aliases=("flip",))
+def reverse(data, axis=(), **_):
+    axis = (axis,) if isinstance(axis, int) else tuple(axis)
+    return jnp.flip(data, axis=axis)
+
+
+@register_op("roll")
+def roll(data, shift=0, axis=None, **_):
+    return jnp.roll(data, shift, axis=axis)
+
+
+@register_op("diag")
+def diag(data, k=0, **_):
+    if data.ndim == 1:
+        return jnp.diag(data, k=k)
+    return jnp.diagonal(data, offset=k, axis1=-2, axis2=-1)
+
+
+@register_op("depth_to_space")
+def depth_to_space(data, block_size=1, **_):
+    n, c, h, w = data.shape
+    b = block_size
+    x = data.reshape(n, b, b, c // (b * b), h, w)
+    x = x.transpose(0, 3, 4, 1, 5, 2)
+    return x.reshape(n, c // (b * b), h * b, w * b)
+
+
+@register_op("space_to_depth")
+def space_to_depth(data, block_size=1, **_):
+    n, c, h, w = data.shape
+    b = block_size
+    x = data.reshape(n, c, h // b, b, w // b, b)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    return x.reshape(n, c * b * b, h // b, w // b)
+
+
+@register_op("ravel_multi_index")
+def ravel_multi_index(data, shape=None, **_):
+    idx = tuple(data[i].astype(jnp.int32) for i in range(data.shape[0]))
+    return jnp.ravel_multi_index(idx, tuple(shape), mode="clip").astype(jnp.float32)
+
+
+@register_op("unravel_index")
+def unravel_index(data, shape=None, **_):
+    outs = jnp.unravel_index(data.astype(jnp.int32), tuple(shape))
+    return jnp.stack(outs, axis=0).astype(jnp.float32)
+
+
+@register_op("shape_array")
+def shape_array(data, **_):
+    return jnp.array(data.shape, dtype=jnp.int32)
+
+
+@register_op("size_array")
+def size_array(data, **_):
+    return jnp.array([data.size], dtype=jnp.int32)
+
+
+@register_op("zeros_like")
+def zeros_like(data, **_):
+    return jnp.zeros_like(data)
+
+
+@register_op("ones_like")
+def ones_like(data, **_):
+    return jnp.ones_like(data)
+
+
+@register_op("full_like")
+def full_like(data, fill_value=0.0, **_):
+    return jnp.full_like(data, fill_value)
+
+
+# ---------------------------------------------------------------------------
+# ordering ops (reference: ordering_op.cc, cub-based — XLA sort/top_k here)
+# ---------------------------------------------------------------------------
+
+@register_op("sort")
+def sort(data, axis=-1, is_ascend=True, **_):
+    out = jnp.sort(data, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out
+
+
+@register_op("argsort")
+def argsort(data, axis=-1, is_ascend=True, dtype="float32", **_):
+    out = jnp.argsort(data, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out.astype(jnp.dtype(dtype))
+
+
+@register_op("topk")
+def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32", **_):
+    ax = axis if axis >= 0 else data.ndim + axis
+    moved = jnp.moveaxis(data, ax, -1)
+    src = -moved if is_ascend else moved
+    vals, idx = lax.top_k(src, k)
+    if is_ascend:
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, ax)
+    idx = jnp.moveaxis(idx, -1, ax)
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "indices":
+        return idx.astype(jnp.dtype(dtype))
+    if ret_typ == "both":
+        return vals, idx.astype(jnp.dtype(dtype))
+    if ret_typ == "mask":
+        mask = jnp.zeros(moved.shape, jnp.int32)
+        mask = jnp.put_along_axis(mask, idx if not is_ascend else idx, 1, axis=-1, inplace=False) \
+            if hasattr(jnp, "put_along_axis") else mask.at[..., :].set(0)
+        onehot = jax.nn.one_hot(jnp.moveaxis(idx, ax, -1).astype(jnp.int32), moved.shape[-1], dtype=jnp.int32).sum(-2)
+        return jnp.moveaxis(onehot, -1, ax).astype(data.dtype)
+    raise ValueError(f"unknown ret_typ {ret_typ}")
+
+
+# ---------------------------------------------------------------------------
+# sequence ops (reference: sequence_*.cc; time-major, axis 0)
+# ---------------------------------------------------------------------------
+
+def _seq_mask(data, sequence_length, value, axis):
+    # data: (T, B, ...) when axis==0, (B, T, ...) when axis==1
+    T = data.shape[axis]
+    steps = jnp.arange(T)
+    if axis == 0:
+        shape = (T, -1) + (1,) * (data.ndim - 2)
+        mask = steps[:, None] < sequence_length[None, :].astype(jnp.int32)
+        mask = mask.reshape((T,) + (sequence_length.shape[0],) + (1,) * (data.ndim - 2))
+    else:
+        mask = steps[None, :] < sequence_length[:, None].astype(jnp.int32)
+        mask = mask.reshape((sequence_length.shape[0], T) + (1,) * (data.ndim - 2))
+    return jnp.where(mask, data, jnp.asarray(value, data.dtype))
+
+
+@register_op("SequenceMask", aliases=("sequence_mask",))
+def sequence_mask(data, sequence_length=None, use_sequence_length=False, value=0.0, axis=0, **_):
+    if not use_sequence_length or sequence_length is None:
+        return data
+    return _seq_mask(data, sequence_length, value, axis)
+
+
+@register_op("SequenceLast", aliases=("sequence_last",))
+def sequence_last(data, sequence_length=None, use_sequence_length=False, axis=0, **_):
+    if not use_sequence_length or sequence_length is None:
+        idx = [builtins.slice(None)] * data.ndim
+        idx[axis] = -1
+        return data[tuple(idx)]
+    last = (sequence_length.astype(jnp.int32) - 1)
+    if axis == 0:
+        return jax.vmap(lambda i, col: col[i], in_axes=(0, 1))(last, data)
+    return jax.vmap(lambda i, row: row[i], in_axes=(0, 0))(last, data)
+
+
+@register_op("SequenceReverse", aliases=("sequence_reverse",))
+def sequence_reverse(data, sequence_length=None, use_sequence_length=False, axis=0, **_):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.flip(data, axis=0)
+    T = data.shape[0]
+    steps = jnp.arange(T)
+
+    def rev_col(length, col):
+        idx = jnp.where(steps < length, length - 1 - steps, steps)
+        return col[idx]
+
+    return jax.vmap(rev_col, in_axes=(0, 1), out_axes=1)(sequence_length.astype(jnp.int32), data)
+
+
+# ---------------------------------------------------------------------------
+# linalg namespace subset (reference: la_op.cc — cuSOLVER → XLA)
+# ---------------------------------------------------------------------------
+
+@register_op("linalg_gemm2")
+def linalg_gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0, **_):
+    if transpose_a:
+        A = jnp.swapaxes(A, -1, -2)
+    if transpose_b:
+        B = jnp.swapaxes(B, -1, -2)
+    return alpha * jnp.matmul(A, B)
+
+
+@register_op("linalg_gemm")
+def linalg_gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0, beta=1.0, **_):
+    if transpose_a:
+        A = jnp.swapaxes(A, -1, -2)
+    if transpose_b:
+        B = jnp.swapaxes(B, -1, -2)
+    return alpha * jnp.matmul(A, B) + beta * C
+
+
+@register_op("linalg_potrf")
+def linalg_potrf(A, **_):
+    return jnp.linalg.cholesky(A)
+
+
+@register_op("linalg_trsm")
+def linalg_trsm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0, **_):
+    import jax.scipy.linalg as jsl
+    if rightside:
+        X = jsl.solve_triangular(A, jnp.swapaxes(alpha * B, -1, -2),
+                                 trans="T" if not transpose else "N", lower=lower)
+        return jnp.swapaxes(X, -1, -2)
+    return jsl.solve_triangular(A, alpha * B, trans="T" if transpose else "N", lower=lower)
+
+
+@register_op("linalg_sumlogdiag")
+def linalg_sumlogdiag(A, **_):
+    return jnp.sum(jnp.log(jnp.diagonal(A, axis1=-2, axis2=-1)), axis=-1)
+
+
+@register_op("linalg_syrk")
+def linalg_syrk(A, transpose=False, alpha=1.0, **_):
+    At = jnp.swapaxes(A, -1, -2)
+    return alpha * (jnp.matmul(At, A) if transpose else jnp.matmul(A, At))
+
+
+@register_op("linalg_extractdiag")
+def linalg_extractdiag(A, offset=0, **_):
+    return jnp.diagonal(A, offset=offset, axis1=-2, axis2=-1)
+
+
+@register_op("linalg_makediag")
+def linalg_makediag(A, offset=0, **_):
+    return jax.vmap(jnp.diag)(A.reshape(-1, A.shape[-1])).reshape(A.shape[:-1] + (A.shape[-1], A.shape[-1])) if A.ndim > 1 else jnp.diag(A, k=offset)
+
+
+# ---------------------------------------------------------------------------
+# embedding (reference: indexing_op.cc Embedding)
+# ---------------------------------------------------------------------------
+
+@register_op("Embedding", aliases=("embedding",))
+def embedding(data, weight, input_dim=None, output_dim=None, dtype="float32", sparse_grad=False, **_):
+    idx = jnp.clip(data.astype(jnp.int32), 0, weight.shape[0] - 1)
+    return jnp.take(weight, idx, axis=0)

@@ -1,0 +1,4 @@
+from .optimizer import (  # noqa: F401
+    Optimizer, SGD, NAG, Adam, AdamW, RMSProp, AdaGrad, AdaDelta, FTRL,
+    Signum, LAMB, LARS, Updater, register, create, get_updater,
+)

@@ -1,0 +1,76 @@
+"""Stateful RNG facade over JAX's functional PRNG.
+
+Reference parity: MXNet keeps a per-device stateful PRNG requested by ops via
+``ResourceRequest::kRandom`` (``src/resource.cc``) and seeded by
+``mx.random.seed`` (``python/mxnet/random.py``). JAX PRNG is functional
+(explicit keys); this module holds one key per Context and splits it on every
+draw, giving MXNet's stateful surface with JAX's reproducibility.
+
+Hybridized (jitted) code must not hit hidden state — the gluon CachedOp pulls
+an explicit key from here *outside* the traced function and feeds it as an
+argument (SURVEY §7 "RNG parity").
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+import jax
+
+from .context import Context, current_context
+
+__all__ = ["seed", "next_key", "fork_key", "get_state"]
+
+_lock = threading.Lock()
+_keys: Dict[Context, jax.Array] = {}
+_root_seed = 0
+
+
+def seed(seed_state: int, ctx: str | Context = "all") -> None:
+    """Seed the generator(s). ``ctx='all'`` reseeds every context
+    (reference: MXRandomSeed / MXRandomSeedContext)."""
+    global _root_seed
+    with _lock:
+        if isinstance(ctx, str) and ctx == "all":
+            _root_seed = seed_state
+            _keys.clear()
+        else:
+            ctx = Context(ctx) if not isinstance(ctx, Context) else ctx
+            _keys[ctx] = jax.random.key(seed_state)
+
+
+def _key_for(ctx: Context) -> jax.Array:
+    if ctx not in _keys:
+        # Derive a distinct stream per (root seed, device type, device id).
+        base = jax.random.key(_root_seed)
+        _keys[ctx] = jax.random.fold_in(
+            jax.random.fold_in(base, ctx.device_typeid), ctx.device_id
+        )
+    return _keys[ctx]
+
+
+def next_key(ctx: Optional[Context] = None) -> jax.Array:
+    """Draw-and-advance: returns a fresh subkey, advancing the context's
+    stateful stream."""
+    ctx = ctx or current_context()
+    with _lock:
+        key = _key_for(ctx)
+        new, sub = jax.random.split(key)
+        _keys[ctx] = new
+    return sub
+
+
+def fork_key(ctx: Optional[Context] = None, num: int = 1):
+    """Split N independent subkeys in one advance (for multi-worker use)."""
+    ctx = ctx or current_context()
+    with _lock:
+        key = _key_for(ctx)
+        parts = jax.random.split(key, num + 1)
+        _keys[ctx] = parts[0]
+    return parts[1:]
+
+
+def get_state(ctx: Optional[Context] = None) -> jax.Array:
+    ctx = ctx or current_context()
+    with _lock:
+        return _key_for(ctx)

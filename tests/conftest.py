@@ -1,0 +1,51 @@
+"""Test-session config.
+
+Tests run on an 8-device *virtual CPU mesh* (SURVEY §4 mechanism 4) so every
+multi-chip sharding path executes everywhere without real chips. Two details
+matter in this environment:
+
+- The axon TPU plugin registers itself at interpreter boot (sitecustomize)
+  and forces ``jax_platforms="axon,cpu"``. Tests must not claim the TPU
+  tunnel, so we switch the config back to cpu-only *before* any backend is
+  initialized (jax is already imported at this point; backends are not).
+- ``xla_force_host_platform_device_count`` must be in XLA_FLAGS before the
+  CPU client is created, i.e. before the first jax.devices() call.
+"""
+import os
+
+prev = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in prev:
+    os.environ["XLA_FLAGS"] = (prev + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as onp
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed_all(request):
+    """with_seed() parity: reproducible-yet-random seeding with the failing
+    seed logged (reference: tests/python/unittest/common.py)."""
+    seed = onp.random.randint(0, 2**31)
+    explicit = os.environ.get("MXNET_TEST_SEED")
+    if explicit:
+        seed = int(explicit)
+    onp.random.seed(seed)
+    import incubator_mxnet_tpu as mx
+
+    mx.random.seed(seed)
+    yield
+    failed = getattr(getattr(request.node, "rep_call", None), "failed", False)
+    if failed:
+        print(f"To reproduce: MXNET_TEST_SEED={seed}")
+
+
+@pytest.hookimpl(tryfirst=True, hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    outcome = yield
+    rep = outcome.get_result()
+    setattr(item, "rep_" + rep.when, rep)

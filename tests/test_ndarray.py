@@ -1,0 +1,203 @@
+"""NDArray semantics corpus (reference: tests/python/unittest/test_ndarray.py)."""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.test_utils import assert_almost_equal, default_context
+
+
+def test_creation_dtypes():
+    a = nd.array([1, 2, 3])
+    assert a.dtype == onp.float32  # python lists default to float32
+    b = nd.array(onp.array([1, 2, 3], dtype=onp.int32))
+    assert b.dtype == onp.int32
+    c = nd.zeros((2, 3), dtype="float16")
+    assert c.dtype == onp.float16 and c.shape == (2, 3)
+    d = nd.ones((4,))
+    assert_almost_equal(d, onp.ones(4))
+    e = nd.full((2, 2), 7.0)
+    assert_almost_equal(e, onp.full((2, 2), 7.0))
+    f = nd.arange(0, 10, 2)
+    assert_almost_equal(f, onp.arange(0, 10, 2, dtype=onp.float32))
+
+
+def test_context_placement():
+    ctx = default_context()
+    a = nd.zeros((3,), ctx=ctx)
+    assert a.context == ctx
+    b = a.as_in_context(mx.cpu(0))
+    assert b.context == mx.cpu(0)
+
+
+def test_basic_arithmetic():
+    x = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    y = nd.array([[5.0, 6.0], [7.0, 8.0]])
+    assert_almost_equal(x + y, onp.array([[6, 8], [10, 12]]))
+    assert_almost_equal(x - y, onp.array([[-4, -4], [-4, -4]]))
+    assert_almost_equal(x * y, onp.array([[5, 12], [21, 32]]))
+    assert_almost_equal(y / x, onp.array([[5, 3], [7 / 3, 2]]))
+    assert_almost_equal(x ** 2, onp.array([[1, 4], [9, 16]]))
+    assert_almost_equal(1 + x, onp.array([[2, 3], [4, 5]]))
+    assert_almost_equal(10 - x, onp.array([[9, 8], [7, 6]]))
+    assert_almost_equal(2 / x, onp.array([[2, 1], [2 / 3, 0.5]]))
+    assert_almost_equal(-x, -x.asnumpy())
+
+
+def test_broadcast_arithmetic():
+    x = nd.ones((2, 3))
+    y = nd.array([1.0, 2.0, 3.0])
+    assert (x + y).shape == (2, 3)
+    assert_almost_equal(x + y, onp.ones((2, 3)) + onp.array([1, 2, 3]))
+
+
+def test_comparison_ops():
+    x = nd.array([1.0, 2.0, 3.0])
+    y = nd.array([3.0, 2.0, 1.0])
+    assert_almost_equal(x == y, onp.array([0.0, 1.0, 0.0]))
+    assert_almost_equal(x < y, onp.array([1.0, 0.0, 0.0]))
+    assert_almost_equal(x >= y, onp.array([0.0, 1.0, 1.0]))
+
+
+def test_inplace_mutation():
+    x = nd.ones((2, 2))
+    v0 = x.version
+    x += 1
+    assert x.version == v0 + 1
+    assert_almost_equal(x, onp.full((2, 2), 2.0))
+    x *= 2
+    assert_almost_equal(x, onp.full((2, 2), 4.0))
+    x /= 4
+    assert_almost_equal(x, onp.ones((2, 2)))
+    x -= 1
+    assert_almost_equal(x, onp.zeros((2, 2)))
+
+
+def test_setitem_getitem():
+    x = nd.zeros((3, 4))
+    x[1] = 1.0
+    assert_almost_equal(x[1], onp.ones(4))
+    x[0, 2] = 5.0
+    assert x[0, 2].asscalar() == 5.0
+    x[:, 1] = 7.0
+    assert_almost_equal(x[:, 1], onp.full(3, 7.0))
+    x[:] = 0.0
+    assert_almost_equal(x, onp.zeros((3, 4)))
+    # slice assignment
+    x[0:2, 0:2] = nd.ones((2, 2))
+    assert x.asnumpy()[:2, :2].sum() == 4.0
+    # advanced indexing read
+    idx = nd.array(onp.array([0, 2], dtype=onp.int32))
+    assert x[idx].shape == (2, 4)
+
+
+def test_getitem_is_copy():
+    # documented divergence: basic indexing returns a copy
+    x = nd.zeros((2, 2))
+    row = x[0]
+    row += 1
+    assert x.asnumpy().sum() == 0.0
+
+
+def test_reshape_magic_codes():
+    x = nd.zeros((2, 3, 4))
+    assert x.reshape((6, 4)).shape == (6, 4)
+    assert x.reshape((-1,)).shape == (24,)
+    assert x.reshape((0, -1)).shape == (2, 12)
+    assert x.reshape((-2,)).shape == (2, 3, 4)
+    assert x.reshape((-3, 4)).shape == (6, 4)
+    assert x.reshape((0, 0, -1)).shape == (2, 3, 4)
+    assert x.reshape((-4, 1, 2, 0, 0)).shape == (1, 2, 3, 4)
+
+
+def test_shape_ops():
+    x = nd.array(onp.arange(24).reshape(2, 3, 4))
+    assert x.transpose().shape == (4, 3, 2)
+    assert x.transpose((1, 0, 2)).shape == (3, 2, 4)
+    assert x.flatten().shape == (2, 12)
+    assert x.expand_dims(0).shape == (1, 2, 3, 4)
+    assert nd.squeeze(x.expand_dims(0), axis=0).shape == (2, 3, 4)
+    assert x.T.shape == (4, 3, 2)
+    assert nd.swapaxes(x, dim1=0, dim2=2).shape == (4, 3, 2)
+
+
+def test_copy_semantics():
+    x = nd.ones((2, 2))
+    y = x.copy()
+    y += 1
+    assert x.asnumpy().sum() == 4.0
+    z = nd.zeros((2, 2))
+    x.copyto(z)
+    assert_almost_equal(z, onp.ones((2, 2)))
+
+
+def test_scalar_conversion():
+    x = nd.array([3.5])
+    assert float(x) == 3.5
+    assert x.asscalar() == onp.float32(3.5)
+    with pytest.raises(ValueError):
+        bool(nd.ones((2,)))
+
+
+def test_wait_to_read_and_waitall():
+    x = nd.ones((100, 100))
+    y = nd.dot(x, x)
+    y.wait_to_read()
+    nd.waitall()
+    assert y.asnumpy()[0, 0] == 100.0
+
+
+def test_concat_split_stack():
+    a = nd.ones((2, 3))
+    b = nd.zeros((2, 3))
+    c = nd.concat(a, b, dim=0)
+    assert c.shape == (4, 3)
+    parts = nd.split(c, num_outputs=2, axis=0)
+    assert len(parts) == 2 and parts[0].shape == (2, 3)
+    s = nd.stack(a, b, axis=0)
+    assert s.shape == (2, 2, 3)
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "arrays.bin")
+    x = nd.ones((2, 2))
+    y = nd.zeros((3,))
+    nd.save(fname, [x, y])
+    loaded = nd.load(fname)
+    assert_almost_equal(loaded[0], x)
+    assert_almost_equal(loaded[1], y)
+    nd.save(fname, {"w": x, "b": y})
+    d = nd.load(fname)
+    assert set(d.keys()) == {"w", "b"}
+    assert_almost_equal(d["w"], x)
+
+
+def test_dtype_cast():
+    x = nd.ones((2, 2))
+    y = x.astype("float16")
+    assert y.dtype == onp.float16
+    z = nd.cast(x, dtype="int32")
+    assert z.dtype == onp.int32
+
+
+def test_numpy_interop():
+    x = nd.array([[1.0, 2.0]])
+    n = onp.asarray(x)
+    assert n.shape == (1, 2)
+    y = nd.array(n * 2)
+    assert_almost_equal(y, n * 2)
+
+
+def test_mixed_scalar_types():
+    x = nd.ones((2,), dtype="int32")
+    y = x + 1
+    assert y.dtype == onp.int32
+    z = nd.ones((2,)) * 2.5
+    assert_almost_equal(z, onp.array([2.5, 2.5]))
+
+
+def test_iter_len():
+    x = nd.array(onp.arange(6).reshape(3, 2))
+    assert len(x) == 3
+    rows = list(x)
+    assert len(rows) == 3 and rows[0].shape == (2,)

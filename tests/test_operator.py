@@ -1,0 +1,309 @@
+"""Operator numerics vs numpy golden (reference: tests/python/unittest/test_operator.py)."""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.test_utils import (
+    assert_almost_equal, check_numeric_gradient, rand_ndarray,
+)
+
+
+def test_unary_ops():
+    x_np = onp.random.uniform(0.5, 2.0, (3, 4)).astype(onp.float32)
+    x = nd.array(x_np)
+    for name, ref in [
+        ("exp", onp.exp), ("log", onp.log), ("sqrt", onp.sqrt),
+        ("square", onp.square), ("abs", onp.abs), ("sign", onp.sign),
+        ("sin", onp.sin), ("cos", onp.cos), ("tanh", onp.tanh),
+        ("floor", onp.floor), ("ceil", onp.ceil),
+    ]:
+        assert_almost_equal(getattr(nd, name)(x), ref(x_np), rtol=1e-5, atol=1e-5)
+
+
+def test_activation_ops():
+    x_np = onp.random.uniform(-3, 3, (5, 5)).astype(onp.float32)
+    x = nd.array(x_np)
+    assert_almost_equal(nd.relu(x), onp.maximum(x_np, 0))
+    assert_almost_equal(nd.sigmoid(x), 1 / (1 + onp.exp(-x_np)), rtol=1e-5)
+    assert_almost_equal(nd.Activation(x, act_type="tanh"), onp.tanh(x_np), rtol=1e-5)
+    assert_almost_equal(nd.LeakyReLU(x, act_type="leaky", slope=0.1),
+                        onp.where(x_np >= 0, x_np, 0.1 * x_np), rtol=1e-5)
+    # elu / selu / gelu sanity
+    for t in ("elu", "selu", "gelu"):
+        out = nd.LeakyReLU(x, act_type=t)
+        assert out.shape == x.shape
+
+
+def test_reductions():
+    x_np = onp.random.uniform(-1, 1, (2, 3, 4)).astype(onp.float32)
+    x = nd.array(x_np)
+    assert_almost_equal(nd.sum(x), x_np.sum(), rtol=1e-5)
+    assert_almost_equal(nd.sum(x, axis=1), x_np.sum(axis=1), rtol=1e-5)
+    assert_almost_equal(nd.mean(x, axis=(0, 2)), x_np.mean(axis=(0, 2)), rtol=1e-5)
+    assert_almost_equal(nd.max(x, axis=2), x_np.max(axis=2))
+    assert_almost_equal(nd.min(x), x_np.min())
+    assert_almost_equal(nd.prod(x, axis=0), x_np.prod(axis=0), rtol=1e-5)
+    assert_almost_equal(nd.norm(x), onp.sqrt((x_np ** 2).sum()), rtol=1e-5)
+    assert_almost_equal(nd.sum(x, axis=1, exclude=True), x_np.sum(axis=(0, 2)), rtol=1e-5)
+
+
+def test_argmax_argmin():
+    x_np = onp.random.uniform(-1, 1, (3, 7)).astype(onp.float32)
+    x = nd.array(x_np)
+    assert_almost_equal(nd.argmax(x, axis=1), x_np.argmax(axis=1).astype(onp.float32))
+    assert_almost_equal(nd.argmin(x, axis=0), x_np.argmin(axis=0).astype(onp.float32))
+
+
+def test_dot():
+    a_np = onp.random.normal(size=(3, 4)).astype(onp.float32)
+    b_np = onp.random.normal(size=(4, 5)).astype(onp.float32)
+    assert_almost_equal(nd.dot(nd.array(a_np), nd.array(b_np)), a_np @ b_np, rtol=1e-4)
+    # transpose flags
+    assert_almost_equal(
+        nd.dot(nd.array(a_np), nd.array(b_np.T), transpose_b=True), a_np @ b_np, rtol=1e-4)
+    assert_almost_equal(
+        nd.dot(nd.array(a_np.T), nd.array(b_np), transpose_a=True), a_np @ b_np, rtol=1e-4)
+    # ND dot: contract last axis of lhs with first of rhs
+    c_np = onp.random.normal(size=(2, 3, 4)).astype(onp.float32)
+    d_np = onp.random.normal(size=(4, 6)).astype(onp.float32)
+    assert_almost_equal(nd.dot(nd.array(c_np), nd.array(d_np)),
+                        onp.tensordot(c_np, d_np, axes=(2, 0)), rtol=1e-4)
+
+
+def test_batch_dot():
+    a_np = onp.random.normal(size=(5, 3, 4)).astype(onp.float32)
+    b_np = onp.random.normal(size=(5, 4, 2)).astype(onp.float32)
+    assert_almost_equal(nd.batch_dot(nd.array(a_np), nd.array(b_np)),
+                        onp.matmul(a_np, b_np), rtol=1e-4)
+    assert_almost_equal(
+        nd.batch_dot(nd.array(a_np), nd.array(onp.swapaxes(b_np, 1, 2)), transpose_b=True),
+        onp.matmul(a_np, b_np), rtol=1e-4)
+
+
+def test_take_pick_gather():
+    x_np = onp.random.normal(size=(4, 5)).astype(onp.float32)
+    x = nd.array(x_np)
+    idx = nd.array(onp.array([0, 3], dtype=onp.int32))
+    assert_almost_equal(nd.take(x, idx, axis=0), x_np[[0, 3]])
+    pick_idx = nd.array(onp.array([1, 0, 2, 4], dtype=onp.int32))
+    assert_almost_equal(nd.pick(x, pick_idx, axis=1),
+                        x_np[onp.arange(4), [1, 0, 2, 4]])
+    gnd_idx = nd.array(onp.array([[0, 1], [1, 2]], dtype=onp.int32))
+    assert_almost_equal(nd.gather_nd(x, gnd_idx), x_np[[0, 1], [1, 2]])
+
+
+def test_one_hot_embedding():
+    idx = nd.array(onp.array([0, 2, 1], dtype=onp.int32))
+    oh = nd.one_hot(idx, depth=4)
+    ref = onp.eye(4, dtype=onp.float32)[[0, 2, 1]]
+    assert_almost_equal(oh, ref)
+    w_np = onp.random.normal(size=(10, 6)).astype(onp.float32)
+    emb = nd.Embedding(idx, nd.array(w_np), input_dim=10, output_dim=6)
+    assert_almost_equal(emb, w_np[[0, 2, 1]])
+
+
+def test_softmax_family():
+    x_np = onp.random.normal(size=(3, 6)).astype(onp.float32)
+    x = nd.array(x_np)
+    e = onp.exp(x_np - x_np.max(axis=-1, keepdims=True))
+    ref = e / e.sum(axis=-1, keepdims=True)
+    assert_almost_equal(nd.softmax(x), ref, rtol=1e-5)
+    assert_almost_equal(nd.log_softmax(x), onp.log(ref), rtol=1e-4)
+    # softmax with length masking (SoftmaxWithLength parity)
+    length = nd.array(onp.array([2, 4, 6], dtype=onp.int32))
+    out = nd.softmax(x, length, axis=-1, use_length=True).asnumpy()
+    assert out[0, 2:].sum() == pytest.approx(0.0, abs=1e-6)
+    assert out[0, :2].sum() == pytest.approx(1.0, rel=1e-5)
+
+
+def test_topk_sort():
+    x_np = onp.random.permutation(24).reshape(4, 6).astype(onp.float32)
+    x = nd.array(x_np)
+    vals = nd.topk(x, k=3, ret_typ="value")
+    ref = -onp.sort(-x_np, axis=-1)[:, :3]
+    assert_almost_equal(vals, ref)
+    both = nd.topk(x, k=2, ret_typ="both")
+    assert len(both) == 2
+    asc = nd.topk(x, k=2, ret_typ="value", is_ascend=True)
+    assert_almost_equal(asc, onp.sort(x_np, axis=-1)[:, :2])
+    assert_almost_equal(nd.sort(x, is_ascend=False), -onp.sort(-x_np, axis=-1))
+    assert_almost_equal(nd.argsort(x, is_ascend=True),
+                        onp.argsort(x_np, axis=-1).astype(onp.float32))
+
+
+def test_elementwise_broadcast_binary():
+    a_np = onp.random.normal(size=(2, 1, 4)).astype(onp.float32)
+    b_np = onp.random.normal(size=(1, 3, 4)).astype(onp.float32)
+    a, b = nd.array(a_np), nd.array(b_np)
+    assert_almost_equal(nd.broadcast_add(a, b), a_np + b_np, rtol=1e-5)
+    assert_almost_equal(nd.broadcast_mul(a, b), a_np * b_np, rtol=1e-5)
+    assert_almost_equal(nd.maximum(a, b), onp.maximum(a_np, b_np))
+    assert_almost_equal(nd.minimum(a, b), onp.minimum(a_np, b_np))
+
+
+def test_where_clip():
+    x_np = onp.random.normal(size=(3, 3)).astype(onp.float32)
+    x = nd.array(x_np)
+    assert_almost_equal(nd.clip(x, a_min=-0.5, a_max=0.5), onp.clip(x_np, -0.5, 0.5))
+    cond = nd.array((x_np > 0).astype(onp.float32))
+    assert_almost_equal(nd.where(cond, x, -x), onp.where(x_np > 0, x_np, -x_np))
+
+
+def test_convolution_shapes_and_numerics():
+    # 3x3 conv vs explicit correlation
+    x_np = onp.random.normal(size=(2, 3, 8, 8)).astype(onp.float32)
+    w_np = onp.random.normal(size=(5, 3, 3, 3)).astype(onp.float32)
+    b_np = onp.random.normal(size=(5,)).astype(onp.float32)
+    out = nd.Convolution(nd.array(x_np), nd.array(w_np), nd.array(b_np),
+                         kernel=(3, 3), num_filter=5, stride=(1, 1), pad=(1, 1))
+    assert out.shape == (2, 5, 8, 8)
+    # golden via scipy-style direct computation at one position
+    patch = x_np[0, :, 0:3, 0:3]
+    expect = (patch * w_np[1]).sum() + b_np[1]
+    assert out.asnumpy()[0, 1, 1, 1] == pytest.approx(expect, rel=1e-4)
+    # stride-2, no pad
+    out2 = nd.Convolution(nd.array(x_np), nd.array(w_np), nd.array(b_np),
+                          kernel=(3, 3), num_filter=5, stride=(2, 2), pad=(0, 0))
+    assert out2.shape == (2, 5, 3, 3)
+    # grouped conv
+    wg = onp.random.normal(size=(6, 1, 3, 3)).astype(onp.float32)
+    outg = nd.Convolution(nd.array(x_np[:, :3]), nd.array(wg[:3]), None, kernel=(3, 3),
+                          num_filter=3, num_group=3, pad=(1, 1), no_bias=True)
+    assert outg.shape == (2, 3, 8, 8)
+
+
+def test_pooling():
+    x_np = onp.random.normal(size=(1, 2, 6, 6)).astype(onp.float32)
+    x = nd.array(x_np)
+    mp = nd.Pooling(x, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    assert mp.shape == (1, 2, 3, 3)
+    assert mp.asnumpy()[0, 0, 0, 0] == x_np[0, 0, :2, :2].max()
+    ap = nd.Pooling(x, kernel=(2, 2), stride=(2, 2), pool_type="avg")
+    assert ap.asnumpy()[0, 1, 1, 1] == pytest.approx(x_np[0, 1, 2:4, 2:4].mean(), rel=1e-5)
+    gp = nd.Pooling(x, pool_type="avg", global_pool=True)
+    assert gp.shape == (1, 2, 1, 1)
+    assert gp.asnumpy()[0, 0, 0, 0] == pytest.approx(x_np[0, 0].mean(), rel=1e-5)
+
+
+def test_fully_connected():
+    x_np = onp.random.normal(size=(4, 3, 2)).astype(onp.float32)
+    w_np = onp.random.normal(size=(7, 6)).astype(onp.float32)
+    b_np = onp.random.normal(size=(7,)).astype(onp.float32)
+    out = nd.FullyConnected(nd.array(x_np), nd.array(w_np), nd.array(b_np), num_hidden=7)
+    ref = x_np.reshape(4, 6) @ w_np.T + b_np
+    assert_almost_equal(out, ref, rtol=1e-4)
+    # flatten=False
+    out2 = nd.FullyConnected(nd.array(x_np), nd.array(onp.random.normal(size=(7, 2)).astype(onp.float32)),
+                             None, num_hidden=7, no_bias=True, flatten=False)
+    assert out2.shape == (4, 3, 7)
+
+
+def test_batchnorm_layernorm():
+    x_np = onp.random.normal(size=(4, 3, 5, 5)).astype(onp.float32)
+    gamma = onp.random.uniform(0.5, 1.5, 3).astype(onp.float32)
+    beta = onp.random.normal(size=3).astype(onp.float32)
+    mean = x_np.mean(axis=(0, 2, 3))
+    var = x_np.var(axis=(0, 2, 3))
+    out, m, v = nd.BatchNorm(nd.array(x_np), nd.array(gamma), nd.array(beta),
+                             nd.array(mean), nd.array(var), fix_gamma=False, training=True)
+    ref = (x_np - mean.reshape(1, 3, 1, 1)) / onp.sqrt(var.reshape(1, 3, 1, 1) + 1e-5)
+    ref = ref * gamma.reshape(1, 3, 1, 1) + beta.reshape(1, 3, 1, 1)
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-4)
+
+    x2 = onp.random.normal(size=(2, 5, 8)).astype(onp.float32)
+    g2 = onp.ones(8, onp.float32)
+    b2 = onp.zeros(8, onp.float32)
+    ln = nd.LayerNorm(nd.array(x2), nd.array(g2), nd.array(b2), axis=-1)
+    ref2 = (x2 - x2.mean(-1, keepdims=True)) / onp.sqrt(x2.var(-1, keepdims=True) + 1e-5)
+    assert_almost_equal(ln, ref2, rtol=1e-4, atol=1e-4)
+
+
+def test_sequence_ops():
+    # (T=4, B=2, C=3)
+    x_np = onp.random.normal(size=(4, 2, 3)).astype(onp.float32)
+    x = nd.array(x_np)
+    slen = nd.array(onp.array([2, 4], dtype=onp.float32))
+    masked = nd.SequenceMask(x, slen, use_sequence_length=True, value=-1.0)
+    m = masked.asnumpy()
+    assert (m[2:, 0] == -1.0).all() and (m[:, 1] == x_np[:, 1]).all()
+    last = nd.SequenceLast(x, slen, use_sequence_length=True)
+    assert_almost_equal(last, onp.stack([x_np[1, 0], x_np[3, 1]]))
+    rev = nd.SequenceReverse(x, slen, use_sequence_length=True)
+    assert_almost_equal(rev.asnumpy()[0, 0], x_np[1, 0])
+    assert_almost_equal(rev.asnumpy()[0, 1], x_np[3, 1])
+
+
+def test_rnn_op_shapes():
+    T, N, C, H = 5, 2, 4, 6
+    x = nd.array(onp.random.normal(size=(T, N, C)).astype(onp.float32))
+    from incubator_mxnet_tpu.ops.nn import rnn_param_size
+    for mode, nstates in [("lstm", 2), ("gru", 1), ("rnn_tanh", 1)]:
+        psize = rnn_param_size(mode, 1, C, H, False)
+        params = nd.array(onp.random.normal(scale=0.1, size=(psize,)).astype(onp.float32))
+        h0 = nd.zeros((1, N, H))
+        if mode == "lstm":
+            out = nd.RNN(x, params, h0, nd.zeros((1, N, H)), state_size=H,
+                         num_layers=1, mode=mode, state_outputs=True)
+            assert out[0].shape == (T, N, H) and out[1].shape == (1, N, H) and out[2].shape == (1, N, H)
+        else:
+            out = nd.RNN(x, params, h0, state_size=H, num_layers=1, mode=mode)
+            assert out.shape == (T, N, H)
+    # bidirectional
+    psize = rnn_param_size("lstm", 2, C, H, True)
+    params = nd.array(onp.random.normal(scale=0.1, size=(psize,)).astype(onp.float32))
+    out = nd.RNN(x, params, nd.zeros((4, N, H)), nd.zeros((4, N, H)), state_size=H,
+                 num_layers=2, mode="lstm", bidirectional=True)
+    assert out.shape == (T, N, 2 * H)
+
+
+def test_dropout_modes():
+    import incubator_mxnet_tpu.random as rng
+    x = nd.ones((100, 100))
+    out_eval = nd.Dropout(x, p=0.5, training=False)
+    assert_almost_equal(out_eval, onp.ones((100, 100)))
+    key = rng.next_key(x.context)
+    out_train = nd.Dropout(x, p=0.5, training=True, key=key)
+    frac = (out_train.asnumpy() == 0).mean()
+    assert 0.4 < frac < 0.6
+
+
+def test_linalg_ops():
+    a_np = onp.random.normal(size=(3, 4)).astype(onp.float32)
+    b_np = onp.random.normal(size=(4, 5)).astype(onp.float32)
+    assert_almost_equal(nd.linalg_gemm2(nd.array(a_np), nd.array(b_np)), a_np @ b_np, rtol=1e-4)
+    spd = onp.eye(4, dtype=onp.float32) * 3 + 0.1
+    L = nd.linalg_potrf(nd.array(spd))
+    assert_almost_equal(nd.batch_dot(L.expand_dims(0), L.expand_dims(0), transpose_b=True)[0],
+                        spd, rtol=1e-4)
+
+
+def test_pad_tile_repeat_flip():
+    x_np = onp.arange(6, dtype=onp.float32).reshape(2, 3)
+    x = nd.array(x_np)
+    p = nd.pad(x.reshape((1, 1, 2, 3)), mode="constant",
+               pad_width=(0, 0, 0, 0, 1, 1, 2, 2), constant_value=9.0)
+    assert p.shape == (1, 1, 4, 7)
+    assert p.asnumpy()[0, 0, 0, 0] == 9.0
+    assert_almost_equal(nd.tile(x, reps=(2, 1)), onp.tile(x_np, (2, 1)))
+    assert_almost_equal(nd.repeat(x, repeats=2, axis=1), onp.repeat(x_np, 2, 1))
+    assert_almost_equal(nd.reverse(x, axis=1), x_np[:, ::-1])
+
+
+def test_scalar_ops_on_int():
+    x = nd.array(onp.array([5, 7], dtype=onp.int32))
+    assert (x % 2).asnumpy().tolist() == [1, 1]
+    assert (x // 2).asnumpy().tolist() == [2, 3]
+
+
+def test_multi_output_ops_record_safe():
+    # ops returning tuples work under autograd recording
+    from incubator_mxnet_tpu import autograd as ag
+    x = nd.array(onp.random.normal(size=(3, 5)).astype(onp.float32))
+    x.attach_grad()
+    with ag.record():
+        vals, idx = nd.topk(x, k=2, ret_typ="both")
+        loss = vals.sum()
+    loss.backward()
+    g = x.grad.asnumpy()
+    assert (g.sum(axis=1) == 2).all()
