@@ -1,0 +1,24 @@
+"""Gluon — the imperative + hybrid high-level API (reference:
+python/mxnet/gluon/ — SURVEY §2.8)."""
+from . import _trace  # noqa: F401
+from .parameter import (  # noqa: F401
+    Constant, DeferredInitializationError, Parameter, ParameterDict,
+)
+from .block import Block, HybridBlock, SymbolBlock  # noqa: F401
+from .trainer import Trainer  # noqa: F401
+from . import nn  # noqa: F401
+from . import loss  # noqa: F401
+from . import utils  # noqa: F401
+
+import importlib as _importlib
+
+_LAZY = {"rnn": ".rnn", "data": ".data", "model_zoo": ".model_zoo",
+         "contrib": ".contrib"}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        mod = _importlib.import_module(_LAZY[name], __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

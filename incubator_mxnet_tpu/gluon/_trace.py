@@ -1,0 +1,65 @@
+"""Trace-state shared between Parameter and the HybridBlock cached op.
+
+The reference's ``CachedOp`` (src/imperative/cached_op.cc) re-executes a
+captured nnvm graph whose inputs include every descendant parameter. Our
+counterpart is a ``jax.jit``-compiled pure function; while it is being traced
+we must
+
+- substitute tracer-valued proxies for every ``Parameter.data()`` fetch
+  (otherwise parameter values get baked into the compiled executable as
+  constants and optimizer updates would be invisible), and
+- capture aux-state writes (BatchNorm running stats — the reference mutates
+  aux NDArrays inside the op) as *functional outputs* of the traced function,
+  to be deposited into the real parameters with concrete values after the
+  compiled call returns.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class _TraceState(threading.local):
+    def __init__(self):
+        self.stack: List["TraceScope"] = []
+
+
+_STATE = _TraceState()
+
+
+class TraceScope:
+    """Active while a HybridBlock cache is being traced under jax.jit."""
+
+    def __init__(self, overrides: Dict[int, Any]):
+        # id(Parameter) -> proxy NDArray (tracer-valued)
+        self.overrides = overrides
+        # aux-state effects: parallel lists of (param, ctx) and traced values
+        self.effect_keys: List[Tuple[Any, Any]] = []
+        self.effect_values: List[Any] = []
+
+    def __enter__(self):
+        _STATE.stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _STATE.stack.pop()
+
+    def lookup(self, param) -> Optional[Any]:
+        return self.overrides.get(id(param))
+
+    def record_effect(self, param, ctx, value) -> None:
+        key = (param, ctx)
+        for i, k in enumerate(self.effect_keys):
+            if k[0] is param and k[1] == ctx:
+                self.effect_values[i] = value
+                return
+        self.effect_keys.append(key)
+        self.effect_values.append(value)
+
+
+def current() -> Optional[TraceScope]:
+    return _STATE.stack[-1] if _STATE.stack else None
+
+
+def tracing() -> bool:
+    return bool(_STATE.stack)

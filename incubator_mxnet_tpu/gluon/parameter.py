@@ -1,0 +1,509 @@
+"""Parameter and ParameterDict.
+
+Reference parity: ``python/mxnet/gluon/parameter.py`` (``Parameter._init_impl``,
+deferred init, per-device replicas via ``list_data``, ``grad_req``) — SURVEY
+§2.8. TPU-era differences: replicas are keyed by :class:`Context` over PjRt
+buffers, and while a HybridBlock cache is being traced, ``data()`` returns the
+trace proxy so parameters become jit inputs rather than baked constants.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as onp
+
+from ..base import MXNetError
+from ..context import Context, cpu, current_context
+from .. import initializer as init_mod
+from ..ndarray import NDArray
+from ..ndarray.ndarray import _unwrap
+from . import _trace
+
+__all__ = ["DeferredInitializationError", "Parameter", "Constant", "ParameterDict", "tensor_types"]
+
+tensor_types = (NDArray,)
+
+
+class DeferredInitializationError(MXNetError):
+    """Raised when a parameter's shape is still unknown at data() time."""
+
+
+def _shape_complete(shape) -> bool:
+    return shape is not None and len(shape) >= 0 and all(
+        isinstance(s, (int, onp.integer)) and s > 0 for s in shape)
+
+
+class Parameter:
+    """A weight/bias/aux-state tensor with lazy (deferred) initialization.
+
+    Reference: ``gluon.Parameter`` — holds one replica per Context, a grad
+    buffer per replica when ``grad_req != 'null'``, and supports deferred
+    shape inference (shape dims of 0 are unknown until the first forward).
+    """
+
+    def __init__(self, name: str, grad_req: str = "write", shape=None,
+                 dtype="float32", lr_mult: float = 1.0, wd_mult: float = 1.0,
+                 init=None, allow_deferred_init: bool = False,
+                 differentiable: bool = True, stype: str = "default",
+                 grad_stype: str = "default"):
+        self._name = name
+        self._grad_req = None
+        self._data: Optional[Dict[Context, NDArray]] = None
+        self._grad: Optional[Dict[Context, NDArray]] = None
+        self._shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+        if not differentiable:
+            grad_req = "null"
+        self._stype = stype
+        self._grad_stype = grad_stype
+        self.grad_req = grad_req
+        self._deferred_init = ()  # (init, ctx_list, default_init, data)
+        self._ctx_list: Optional[List[Context]] = None
+        self._var = None
+
+    # ------------------------------------------------------------------
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def grad_req(self) -> str:
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req: str):
+        if req not in ("write", "add", "null"):
+            raise ValueError(f"grad_req must be write/add/null, got {req}")
+        prev, self._grad_req = self._grad_req, req
+        if prev != req and self._data is not None:
+            if req == "null":
+                self._grad = None
+            else:
+                self._init_grad()
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @shape.setter
+    def shape(self, new_shape):
+        if self._shape is None:
+            self._shape = tuple(new_shape)
+            return
+        # Merge: unknown (0) dims take the new value; known dims must match.
+        if len(self._shape) != len(new_shape):
+            raise AssertionError(
+                f"Expected shape {new_shape} incompatible with {self._shape}")
+        merged = []
+        for o, n in zip(self._shape, new_shape):
+            if o and n and o != n:
+                raise AssertionError(
+                    f"Expected shape {new_shape} incompatible with {self._shape}")
+            merged.append(o if o else n)
+        self._shape = tuple(merged)
+
+    # ------------------------------------------------------------------
+    # initialization
+    # ------------------------------------------------------------------
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit: bool = False) -> None:
+        """Create replica data on ``ctx`` (reference: Parameter.initialize)."""
+        if default_init is None:
+            default_init = init_mod.Xavier()
+        if self._data is not None and not force_reinit:
+            return
+        if ctx is None:
+            ctx = [current_context()]
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        ctx = list(ctx)
+        if init is None:
+            init = self.init if self.init is not None else default_init
+        if not _shape_complete(self._shape):
+            if self.allow_deferred_init:
+                self._deferred_init = (init, ctx, default_init, None)
+                return
+            raise ValueError(
+                f"Cannot initialize Parameter '{self.name}' because it has "
+                f"invalid shape: {self._shape}. Set allow_deferred_init=True "
+                "or specify in_units/in_channels.")
+        self._init_impl(init, ctx)
+
+    def _init_impl(self, init, ctx_list, data=None) -> None:
+        self._ctx_list = list(ctx_list)
+        self._data = OrderedDict()
+        if data is None:
+            initializer = init_mod.create(init) if isinstance(init, str) else init
+            data = NDArray(jnp.zeros(self._shape, jnp.dtype(self.dtype)),
+                           ctx=self._ctx_list[0])
+            initializer(init_mod.InitDesc(self.name), data)
+        for ctx in self._ctx_list:
+            if isinstance(data, NDArray):
+                arr = data if data.context == ctx else data.copyto(ctx)
+                if arr is data:
+                    arr = data.copy() if len(self._ctx_list) > 1 else data
+            else:
+                arr = NDArray(jnp.asarray(onp.asarray(data), jnp.dtype(self.dtype)), ctx=ctx)
+            if str(arr.dtype) != str(jnp.dtype(self.dtype)):
+                arr._data = arr._data.astype(jnp.dtype(self.dtype))
+            self._data[ctx] = arr
+        self._deferred_init = ()
+        if self.grad_req != "null":
+            self._init_grad()
+
+    def _init_grad(self) -> None:
+        self._grad = OrderedDict()
+        for ctx, arr in self._data.items():
+            g = NDArray(jnp.zeros(arr.shape, arr._data.dtype), ctx=ctx)
+            self._grad[ctx] = g
+            arr._grad = g
+            arr._grad_req = self.grad_req
+
+    def _finish_deferred_init(self) -> None:
+        if not self._deferred_init:
+            return
+        init, ctx, default_init, data = self._deferred_init
+        if not _shape_complete(self._shape):
+            raise DeferredInitializationError(
+                f"Parameter '{self.name}' has unknown shape {self._shape} and "
+                "shape inference did not resolve it.")
+        self._init_impl(init if init is not None else default_init, ctx, data)
+
+    def _load_init(self, data: NDArray, ctx, cast_dtype=False, dtype_source="current") -> None:
+        """Install loaded weights (reference: Parameter._load_init)."""
+        if self._shape is not None and _shape_complete(self._shape):
+            if tuple(data.shape) != tuple(self._shape):
+                raise AssertionError(
+                    f"Failed loading Parameter '{self.name}' from saved params: "
+                    f"shape incompatible expected {self._shape} vs saved {data.shape}")
+        else:
+            self._shape = tuple(data.shape)
+        if cast_dtype and dtype_source == "current":
+            data = data.astype(self.dtype)
+        else:
+            self.dtype = str(data.dtype)
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        if self._data is None:
+            if self._deferred_init:
+                ctx = self._deferred_init[1]
+            self._init_impl(None, ctx or [current_context()], data=data)
+        else:
+            self.set_data(data)
+
+    # ------------------------------------------------------------------
+    # access
+    # ------------------------------------------------------------------
+    def _check_and_get(self, arr_dict, ctx):
+        if arr_dict is not None:
+            if ctx is list:
+                return list(arr_dict.values())
+            if ctx is None:
+                if len(arr_dict) == 1:
+                    return next(iter(arr_dict.values()))
+                ctx = current_context()
+            if ctx in arr_dict:
+                return arr_dict[ctx]
+            # device-type match (cpu(0) vs cpu(0) different objects already
+            # handled by Context __eq__/__hash__); fall back to any replica
+            # with the same device type.
+            for c, v in arr_dict.items():
+                if c.device_type == getattr(ctx, "device_type", None):
+                    return v
+            raise RuntimeError(
+                f"Parameter '{self.name}' was not initialized on context {ctx}. "
+                f"It was only initialized on {list(arr_dict)}.")
+        if self._deferred_init:
+            raise DeferredInitializationError(
+                f"Parameter '{self.name}' has not been initialized yet because "
+                "initialization was deferred. Actual initialization happens "
+                "during the first forward pass.")
+        raise RuntimeError(
+            f"Parameter '{self.name}' has not been initialized. You should "
+            "initialize parameters and create a Trainer first, then use "
+            ".data()/.grad() to access them.")
+
+    def data(self, ctx: Optional[Context] = None) -> NDArray:
+        scope = _trace.current()
+        if scope is not None:
+            proxy = scope.lookup(self)
+            if proxy is not None:
+                return proxy
+        return self._check_and_get(self._data, ctx)
+
+    def list_data(self) -> List[NDArray]:
+        return self._check_and_get(self._data, list)
+
+    def grad(self, ctx: Optional[Context] = None) -> NDArray:
+        if self._data is not None and self._grad is None:
+            raise RuntimeError(
+                f"Cannot get gradient array for Parameter '{self.name}' "
+                "because grad_req='null'")
+        return self._check_and_get(self._grad, ctx)
+
+    def list_grad(self) -> List[NDArray]:
+        if self._data is not None and self._grad is None:
+            raise RuntimeError(
+                f"Cannot get gradient array for Parameter '{self.name}' "
+                "because grad_req='null'")
+        return self._check_and_get(self._grad, list)
+
+    def list_ctx(self) -> List[Context]:
+        if self._data is None:
+            if self._deferred_init:
+                return list(self._deferred_init[1])
+            raise RuntimeError(f"Parameter '{self.name}' has not been initialized")
+        return list(self._data.keys())
+
+    def set_data(self, data) -> None:
+        """Set this parameter's value on all contexts."""
+        self.shape = tuple(data.shape)
+        if self._data is None:
+            if not self._deferred_init:
+                raise RuntimeError(
+                    f"Parameter '{self.name}' has not been initialized")
+            self._deferred_init = self._deferred_init[:3] + (data,)
+            return
+        for ctx, arr in self._data.items():
+            val = _unwrap(data)
+            arr._data = jnp.asarray(val, arr._data.dtype) if not hasattr(val, "devices") else val.astype(arr._data.dtype)
+            arr._version += 1
+
+    def _deposit_aux(self, value, ctx: Optional[Context] = None) -> None:
+        """Trace-aware aux-state write (BatchNorm running stats).
+
+        Eagerly: in-place update of the replica on ``ctx``. Under an active
+        HybridBlock trace: recorded as a functional output and deposited with
+        a concrete value after the compiled call (see gluon/_trace.py).
+        """
+        scope = _trace.current()
+        val = _unwrap(value)
+        if scope is not None and scope.lookup(self) is not None:
+            scope.record_effect(self, ctx, val)
+            return
+        arr = self._check_and_get(self._data, ctx)
+        arr._data = jnp.asarray(val, arr._data.dtype)
+        arr._version += 1
+
+    def zero_grad(self) -> None:
+        if self._grad is None:
+            return
+        for g in self._grad.values():
+            g._data = jnp.zeros_like(g._data)
+            g._version += 1
+
+    def reset_ctx(self, ctx) -> None:
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        ctx = list(ctx)
+        if self._data is not None:
+            data = next(iter(self._data.values()))
+            with _no_trace():
+                self._init_impl(None, ctx, data=data)
+        elif self._deferred_init:
+            init, _, default_init, data = self._deferred_init
+            self._deferred_init = (init, ctx, default_init, data)
+        else:
+            raise ValueError(f"Cannot reset context for Parameter '{self.name}' "
+                             "because it has not been initialized.")
+
+    def cast(self, dtype) -> None:
+        self.dtype = str(jnp.dtype(dtype))
+        if self._data is None:
+            return
+        for arr in self._data.values():
+            arr._data = arr._data.astype(jnp.dtype(dtype))
+            arr._version += 1
+        if self._grad is not None:
+            for g in self._grad.values():
+                g._data = g._data.astype(jnp.dtype(dtype))
+                g._version += 1
+
+    def var(self):
+        """Symbol variable for this parameter (symbolic API parity)."""
+        if self._var is None:
+            from ..symbol import var
+            self._var = var(self.name, shape=self.shape, dtype=self.dtype)
+        return self._var
+
+    def __repr__(self):
+        return f"Parameter {self.name} (shape={self._shape}, dtype={self.dtype})"
+
+
+class _no_trace:
+    def __enter__(self):
+        self._saved = _trace._STATE.stack
+        _trace._STATE.stack = []
+
+    def __exit__(self, *exc):
+        _trace._STATE.stack = self._saved
+
+
+class Constant(Parameter):
+    """A constant parameter: never updated by the Trainer.
+
+    Reference: ``gluon.Constant`` — grad_req='null', value fixed at build.
+    """
+
+    def __init__(self, name, value):
+        if not isinstance(value, NDArray):
+            value = NDArray(jnp.asarray(onp.asarray(value)))
+        self.value = value
+
+        class _CInit(init_mod.Initializer):
+            def _init_weight(slf, _, arr):
+                arr[:] = onp.asarray(value.asnumpy())
+
+        super().__init__(name, grad_req="null", shape=value.shape,
+                         dtype=str(value.dtype), init=_CInit())
+
+
+class ParameterDict:
+    """A prefix-scoped dictionary of Parameters (reference: ParameterDict)."""
+
+    def __init__(self, prefix: str = "", shared: Optional["ParameterDict"] = None):
+        self._prefix = prefix
+        self._params: "OrderedDict[str, Parameter]" = OrderedDict()
+        self._shared = shared
+
+    # -- mapping protocol --------------------------------------------------
+    def __getitem__(self, key) -> Parameter:
+        return self._params[key]
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def __len__(self):
+        return len(self._params)
+
+    def __contains__(self, key):
+        return key in self._params
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    @property
+    def prefix(self) -> str:
+        return self._prefix
+
+    def _get_impl(self, name) -> Optional[Parameter]:
+        if name in self._params:
+            return self._params[name]
+        if self._shared is not None and name in self._shared._params:
+            self._params[name] = self._shared._params[name]
+            return self._params[name]
+        return None
+
+    def get(self, name: str, **kwargs) -> Parameter:
+        """Get-or-create ``prefix+name`` (reference: ParameterDict.get)."""
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            param = Parameter(name, **kwargs)
+            self._params[name] = param
+        else:
+            for k, v in kwargs.items():
+                if hasattr(param, k) and getattr(param, k) is not None:
+                    if k == "shape" and v is not None:
+                        param.shape = v
+                    elif k == "dtype" and str(getattr(param, k)) != str(v):
+                        raise AssertionError(
+                            f"Cannot retrieve Parameter '{name}' because desired "
+                            f"attribute does not match with stored for attribute {k}")
+                else:
+                    setattr(param, k, v)
+        return param
+
+    def get_constant(self, name: str, value=None) -> Constant:
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            if value is None:
+                raise KeyError(f"No constant named '{name}'.")
+            param = Constant(name, value)
+            self._params[name] = param
+        elif value is not None and not isinstance(param, Constant):
+            raise AssertionError(f"Parameter '{name}' already exists but is not a constant.")
+        return param
+
+    def update(self, other: "ParameterDict") -> None:
+        for k, v in other.items():
+            if k in self._params and self._params[k] is not v:
+                raise ValueError(f"Cannot update self with other because they "
+                                 f"have different Parameters with the same name '{k}'")
+            self._params[k] = v
+
+    def initialize(self, init=None, ctx=None, verbose: bool = False,
+                   force_reinit: bool = False) -> None:
+        if init is None:
+            init = init_mod.Uniform()
+        for _, v in self.items():
+            v.initialize(None, ctx, init, force_reinit=force_reinit)
+
+    def zero_grad(self) -> None:
+        for v in self.values():
+            v.zero_grad()
+
+    def reset_ctx(self, ctx) -> None:
+        for v in self.values():
+            v.reset_ctx(ctx)
+
+    def list_ctx(self):
+        s = []
+        for v in self.values():
+            for c in v.list_ctx():
+                if c not in s:
+                    s.append(c)
+        return s
+
+    def setattr(self, name: str, value) -> None:
+        for v in self.values():
+            setattr(v, name, value)
+
+    def save(self, filename: str, strip_prefix: str = "") -> None:
+        from .. import ndarray as nd
+        arg_dict = {}
+        for param in self.values():
+            weight = param._check_and_get(param._data, None) if param._data else None
+            if weight is None and param._deferred_init:
+                raise RuntimeError(f"Parameter '{param.name}' is deferred-initialized; "
+                                   "run a forward pass before saving")
+            name = param.name
+            if strip_prefix and name.startswith(strip_prefix):
+                name = name[len(strip_prefix):]
+            arg_dict[name] = weight
+        nd.save(filename, arg_dict)
+
+    def load(self, filename: str, ctx=None, allow_missing: bool = False,
+             ignore_extra: bool = False, restore_prefix: str = "",
+             cast_dtype: bool = False, dtype_source: str = "current") -> None:
+        from .. import ndarray as nd
+        loaded = nd.load(filename)
+        arg_dict = {(restore_prefix + k.split(":", 1)[-1]): v for k, v in loaded.items()}
+        if not allow_missing:
+            for name in self.keys():
+                if name not in arg_dict:
+                    raise AssertionError(f"Parameter '{name}' is missing in file '{filename}'")
+        for name, data in arg_dict.items():
+            if name not in self._params:
+                if not ignore_extra:
+                    raise AssertionError(f"Parameter '{name}' loaded from file "
+                                         f"'{filename}' is not present in this dict")
+                continue
+            self[name]._load_init(data, ctx, cast_dtype=cast_dtype, dtype_source=dtype_source)
+
+    def __repr__(self):
+        s = "\n".join(f"  {v}" for v in self.values())
+        return f"{type(self).__name__} '{self._prefix}' (\n{s}\n)"

@@ -1,0 +1,176 @@
+"""Trainer — the optimizer driver.
+
+Reference parity: ``python/mxnet/gluon/trainer.py`` (``Trainer.step``,
+``Trainer._init_kvstore``) — SURVEY §2.8, call stack §3.2. Gradient exchange
+goes through the kvstore seam; on a device mesh the kvstore is the XLA
+collectives layer (SURVEY §2.5 north-star seam), while single-process
+multi-replica parameters reduce locally, matching ``kvstore('device')``.
+"""
+from __future__ import annotations
+
+import pickle
+from typing import Dict, List, Optional, Union
+
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from .. import optimizer as opt_mod
+from ..ndarray import NDArray
+from .parameter import Parameter, ParameterDict
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params: Union[ParameterDict, Dict[str, Parameter], List[Parameter]],
+                 optimizer, optimizer_params: Optional[dict] = None,
+                 kvstore: Optional[str] = "device", compression_params=None,
+                 update_on_kvstore: Optional[bool] = None):
+        if isinstance(params, (dict, ParameterDict)):
+            params = [params[k] for k in sorted(list(params.keys()))]
+        if not isinstance(params, (list, tuple)):
+            raise ValueError(
+                "First argument must be a list or dict of Parameters, "
+                f"got {type(params)}.")
+        self._params: List[Parameter] = []
+        self._param2idx: Dict[str, int] = {}
+        for i, param in enumerate(params):
+            if not isinstance(param, Parameter):
+                raise ValueError(
+                    f"First argument must be a list or dict of Parameters, "
+                    f"got list of {type(param)}.")
+            self._param2idx[param.name] = i
+            self._params.append(param)
+        self._compression_params = compression_params
+        self._contains_sparse = any(p._stype != "default" for p in self._params)
+        optimizer_params = optimizer_params or {}
+        self._scale = float(optimizer_params.get("rescale_grad", 1.0))
+        self._init_optimizer(optimizer, optimizer_params)
+        self._kvstore_type = kvstore
+        self._kvstore = None
+        self._update_on_kvstore = update_on_kvstore
+        self._kv_initialized = False
+        self._states: Dict[int, tuple] = {}
+        self._states_synced: Dict[int, bool] = {}
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: param for i, param in enumerate(self._params)}
+        if isinstance(optimizer, opt_mod.Optimizer):
+            if optimizer_params and set(optimizer_params) - {"rescale_grad"}:
+                raise ValueError(
+                    "optimizer_params must be None if optimizer is an "
+                    "Optimizer instance")
+            self._optimizer = optimizer
+        else:
+            self._optimizer = opt_mod.create(optimizer, **optimizer_params)
+        self._optimizer.param_dict = param_dict
+
+    def _init_kvstore(self):
+        """Resolve the gradient-exchange backend lazily, as the reference
+        does on the first step (Trainer._init_kvstore)."""
+        if self._kvstore_type and not isinstance(self._kvstore_type, str):
+            self._kvstore = self._kvstore_type  # explicit KVStore object
+        elif self._kvstore_type in (None, "local", "device", "nccl"):
+            # Single-process replica reduce handled inline (CommDevice parity);
+            # mesh-sharded training uses parallel.* + kvstore('mesh').
+            self._kvstore = None
+        else:
+            from .. import kvstore as kv
+            self._kvstore = kv.create(self._kvstore_type)
+        if self._kvstore is not None:
+            for i, param in enumerate(self._params):
+                if param._data is not None:
+                    self._kvstore.init(i, param._check_and_get(param._data, None))
+        self._kv_initialized = True
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def set_learning_rate(self, lr: float):
+        self._optimizer.set_learning_rate(lr)
+
+    def _row_sparse_pull(self, parameter, out, row_id, full_idx=False):
+        # Sparse pull is a PS-era optimization; dense on TPU.
+        pass
+
+    def allreduce_grads(self):
+        """Sum gradients across parameter replicas (kvstore push/pull —
+        reference stack §3.4; local CommDevice reduce when single-process)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null" or param._data is None:
+                continue
+            if self._kvstore is not None:
+                grads = param.list_grad()
+                self._kvstore.push(i, grads)
+                self._kvstore.pull(i, grads)
+                continue
+            grads = param.list_grad()
+            if len(grads) > 1:
+                total = grads[0]._data
+                for g in grads[1:]:
+                    total = total + g._data.astype(total.dtype)
+                for g in grads:
+                    g._data = total.astype(g._data.dtype)
+                    g._version += 1
+
+    def step(self, batch_size: int, ignore_stale_grad: bool = False):
+        """allreduce + optimizer update (reference: Trainer.step)."""
+        rescale_grad = self._scale / batch_size
+        self._optimizer.rescale_grad = rescale_grad
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self.allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def update(self, batch_size: int, ignore_stale_grad: bool = False):
+        """Optimizer update only — assumes gradients were already reduced
+        (the Horovod/custom-allreduce seam, reference: Trainer.update)."""
+        self._optimizer.rescale_grad = self._scale / batch_size
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad: bool = False):
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null" or param._data is None:
+                continue
+            if not ignore_stale_grad:
+                for data in param.list_data():
+                    pass  # version-staleness bookkeeping is implicit (tape)
+            for weight, grad in zip(param.list_data(), param.list_grad()):
+                if i not in self._states:
+                    self._states[i] = self._optimizer.create_state_multi_precision(i, weight)
+                self._states[i] = self._optimizer.update(
+                    i, weight, grad, self._states[i])
+                break  # replicas share one update; broadcast below
+            datas = param.list_data()
+            if len(datas) > 1:
+                src = datas[0]
+                for w in datas[1:]:
+                    w._data = src._data
+                    w._version += 1
+
+    def save_states(self, fname: str):
+        """Serialize optimizer state (reference: Trainer.save_states)."""
+        import numpy as onp
+        blob = {
+            "num_update": self._optimizer.num_update,
+            "states": {i: tuple(onp.asarray(s) for s in st)
+                       for i, st in self._states.items()},
+        }
+        with open(fname, "wb") as f:
+            pickle.dump(blob, f)
+
+    def load_states(self, fname: str):
+        with open(fname, "rb") as f:
+            blob = pickle.load(f)
+        self._optimizer.num_update = blob["num_update"]
+        self._states = {i: tuple(jnp.asarray(s) for s in st)
+                        for i, st in blob["states"].items()}

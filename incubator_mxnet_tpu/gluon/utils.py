@@ -1,0 +1,97 @@
+"""Gluon utilities (reference: python/mxnet/gluon/utils.py)."""
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import List, Optional
+
+import jax.numpy as jnp
+import numpy as onp
+
+from ..base import MXNetError
+from ..context import Context
+from ..ndarray import NDArray
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1",
+           "download"]
+
+
+def split_data(data: NDArray, num_slice: int, batch_axis: int = 0,
+               even_split: bool = True) -> List[NDArray]:
+    """Split along ``batch_axis`` into ``num_slice`` pieces (reference:
+    gluon.utils.split_data — the data-parallel batch splitter)."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise ValueError(
+            f"data with shape {data.shape} cannot be evenly split into "
+            f"{num_slice} slices along axis {batch_axis}. Use a batch size "
+            f"that's a multiple of {num_slice} or set even_split=False.")
+    if num_slice == 1:
+        return [data]
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = (i + 1) * step if i < num_slice - 1 else size
+        idx = [slice(None)] * data.ndim
+        idx[batch_axis] = slice(begin, end)
+        slices.append(data[tuple(idx)])
+    return slices
+
+
+def split_and_load(data, ctx_list: List[Context], batch_axis: int = 0,
+                   even_split: bool = True) -> List[NDArray]:
+    """Split a batch and load each slice onto one context (reference:
+    gluon.utils.split_and_load — SURVEY §2.5 single-process DP)."""
+    if not isinstance(data, NDArray):
+        data = NDArray(jnp.asarray(onp.asarray(data)), ctx=ctx_list[0])
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays: List[NDArray], max_norm: float,
+                     check_isfinite: bool = True) -> float:
+    """Rescale arrays so the joint L2 norm is at most ``max_norm``
+    (reference: gluon.utils.clip_global_norm)."""
+    if not arrays:
+        raise ValueError("arrays must not be empty")
+    total = sum(float(jnp.sum(jnp.square(a._data.astype(jnp.float32)))) for a in arrays)
+    total_norm = total ** 0.5
+    if check_isfinite and not onp.isfinite(total_norm):
+        import warnings
+        warnings.warn("nan or inf is detected. Clipping results will be "
+                      "undefined.", stacklevel=2)
+    scale = max_norm / (total_norm + 1e-8)
+    if scale < 1.0:
+        for a in arrays:
+            a._data = a._data * jnp.asarray(scale, a._data.dtype)
+            a._version += 1
+    return total_norm
+
+
+def check_sha1(filename: str, sha1_hash: str) -> bool:
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url: str, path: Optional[str] = None, overwrite: bool = False,
+             sha1_hash: Optional[str] = None, retries: int = 5,
+             verify_ssl: bool = True) -> str:
+    """Download a file (reference: gluon.utils.download). This environment
+    has no network egress; only pre-existing files are honored."""
+    fname = path if path and not os.path.isdir(path) else os.path.join(
+        path or ".", url.split("/")[-1])
+    if os.path.exists(fname) and not overwrite and (
+            sha1_hash is None or check_sha1(fname, sha1_hash)):
+        return fname
+    raise MXNetError(
+        f"download({url}) unavailable: no network egress in this "
+        f"environment and {fname} does not exist locally.")

@@ -56,6 +56,16 @@ class NDArray:
             ctx = current_context()
         if not isinstance(data, jax.Array) or dtype is not None:
             data = jnp.asarray(data, dtype=dtype)
+        if isinstance(data, jax.core.Tracer):
+            # Inside a jit trace (HybridBlock cached op): no device commit —
+            # placement is the compiled executable's concern.
+            self._data = data
+            self._ctx = ctx
+            self._version = 0
+            self._grad = None
+            self._grad_req = "null"
+            self._fresh_grad_node = None
+            return
         # Commit to the context's device if not already there.
         dev = ctx.jax_device
         devs = getattr(data, "devices", None)

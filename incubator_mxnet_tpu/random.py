@@ -19,11 +19,39 @@ import jax
 
 from .context import Context, current_context
 
-__all__ = ["seed", "next_key", "fork_key", "get_state"]
+__all__ = ["seed", "next_key", "fork_key", "get_state", "trace_rng"]
 
 _lock = threading.Lock()
 _keys: Dict[Context, jax.Array] = {}
 _root_seed = 0
+
+
+class _TraceRNG(threading.local):
+    def __init__(self):
+        self.stack = []
+
+
+_TRACE_RNG = _TraceRNG()
+
+
+class trace_rng:
+    """While a HybridBlock cache is traced, ``next_key`` splits from this
+    explicit key (a jit argument) instead of the hidden per-device stream, so
+    randomness is an input of the compiled executable (SURVEY §7 RNG parity)."""
+
+    def __init__(self, key):
+        self._key = key
+
+    def __enter__(self):
+        _TRACE_RNG.stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _TRACE_RNG.stack.pop()
+
+    def split(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
 
 
 def seed(seed_state: int, ctx: str | Context = "all") -> None:
@@ -52,6 +80,8 @@ def _key_for(ctx: Context) -> jax.Array:
 def next_key(ctx: Optional[Context] = None) -> jax.Array:
     """Draw-and-advance: returns a fresh subkey, advancing the context's
     stateful stream."""
+    if _TRACE_RNG.stack:
+        return _TRACE_RNG.stack[-1].split()
     ctx = ctx or current_context()
     with _lock:
         key = _key_for(ctx)

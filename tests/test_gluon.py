@@ -1,0 +1,439 @@
+"""Gluon Block/HybridBlock/Parameter/Trainer tests.
+
+Modeled on the reference's tests/python/unittest/test_gluon.py corpus
+(SURVEY §4): op-level numerics vs numpy, hybridize parity, deferred init,
+save/load round-trips, trainer updates.
+"""
+import os
+
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon
+from incubator_mxnet_tpu.gluon import nn, rnn
+
+
+def test_parameter_basic():
+    p = gluon.Parameter("weight", shape=(10, 10))
+    p.initialize(init="xavier")
+    assert p.shape == (10, 10)
+    assert p.data().shape == (10, 10)
+    assert p.list_data()[0] is p.data()
+    assert p.grad().shape == (10, 10)
+
+
+def test_parameter_deferred_init():
+    dense = nn.Dense(5)
+    dense.initialize()
+    # weight shape unknown until first forward
+    with pytest.raises(gluon.DeferredInitializationError):
+        dense.weight.data()
+    x = mx.nd.ones((2, 7))
+    out = dense(x)
+    assert out.shape == (2, 5)
+    assert dense.weight.shape == (5, 7)
+
+
+def test_parameter_shape_mismatch_raises():
+    dense = nn.Dense(5, in_units=4)
+    dense.initialize()
+    with pytest.raises(Exception):
+        dense(mx.nd.ones((2, 7)))
+
+
+def test_dense_numerics():
+    dense = nn.Dense(3, use_bias=True, in_units=4)
+    dense.initialize(init="ones")
+    x = mx.nd.array(onp.arange(8).reshape(2, 4).astype("float32"))
+    out = dense(x).asnumpy()
+    expect = onp.arange(8).reshape(2, 4).astype("float32").sum(axis=1, keepdims=True)
+    onp.testing.assert_allclose(out, onp.repeat(expect, 3, axis=1), rtol=1e-5)
+
+
+def test_sequential_and_getitem():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(4), nn.Dense(3), nn.Dense(2))
+    assert len(net) == 3
+    assert isinstance(net[1], nn.Dense)
+    net.initialize()
+    assert net(mx.nd.ones((2, 5))).shape == (2, 2)
+
+
+def test_hybridize_parity():
+    """Hybridized and eager forward must agree (reference: hybridize tests)."""
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu"), nn.BatchNorm(), nn.Dense(8))
+    net.initialize()
+    x = mx.nd.array(onp.random.randn(4, 10).astype("float32"))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    h1 = net(x).asnumpy()  # first call: warmup (eager)
+    h2 = net(x).asnumpy()  # second call: jit cache
+    onp.testing.assert_allclose(eager, h1, rtol=1e-5, atol=1e-5)
+    onp.testing.assert_allclose(eager, h2, rtol=1e-5, atol=1e-5)
+
+
+def test_hybridize_param_update_visible():
+    """Optimizer updates must flow into the jitted forward (no baked
+    constants)."""
+    net = nn.Dense(1, in_units=2)
+    net.initialize(init="ones")
+    net.hybridize()
+    x = mx.nd.ones((1, 2))
+    assert float(net(x).asnumpy()) == pytest.approx(2.0)
+    assert float(net(x).asnumpy()) == pytest.approx(2.0)
+    net.weight.set_data(mx.nd.full((1, 2), 3.0))
+    assert float(net(x).asnumpy()) == pytest.approx(6.0)
+
+
+def test_batchnorm_running_stats_update():
+    bn = nn.BatchNorm(in_channels=3)
+    bn.initialize()
+    x = mx.nd.array(onp.random.randn(8, 3, 4, 4).astype("float32") * 2 + 5)
+    with mx.autograd.record():
+        bn(x)
+    rm = bn.running_mean.data().asnumpy()
+    assert not onp.allclose(rm, 0)  # moved toward batch mean
+    # inference mode uses running stats, doesn't change them
+    before = bn.running_mean.data().asnumpy()
+    bn(x)
+    onp.testing.assert_allclose(before, bn.running_mean.data().asnumpy())
+
+
+def test_batchnorm_running_stats_update_hybrid():
+    bn = nn.BatchNorm(in_channels=3)
+    bn.initialize()
+    bn.hybridize()
+    x = mx.nd.array(onp.random.randn(8, 3, 4, 4).astype("float32") * 2 + 5)
+    with mx.autograd.record():
+        bn(x)  # warmup (eager)
+    rm1 = bn.running_mean.data().asnumpy().copy()
+    with mx.autograd.record():
+        bn(x)  # jit path
+    rm2 = bn.running_mean.data().asnumpy()
+    assert not onp.allclose(rm1, rm2)
+
+
+def test_dropout_modes():
+    do = nn.Dropout(0.5)
+    do.initialize()
+    x = mx.nd.ones((100, 100))
+    out = do(x)  # predict mode: identity
+    onp.testing.assert_allclose(out.asnumpy(), x.asnumpy())
+    with mx.autograd.record():
+        out = do(x)
+    frac = (out.asnumpy() == 0).mean()
+    assert 0.3 < frac < 0.7
+
+
+def test_conv_pool_shapes():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(8, kernel_size=3, padding=1, activation="relu"),
+                nn.MaxPool2D(2),
+                nn.Conv2D(16, kernel_size=3, padding=1),
+                nn.GlobalAvgPool2D(),
+                nn.Flatten(),
+                nn.Dense(10))
+    net.initialize()
+    out = net(mx.nd.ones((2, 3, 16, 16)))
+    assert out.shape == (2, 10)
+    assert net[0].weight.shape == (8, 3, 3, 3)
+
+
+def test_trainer_reduces_loss():
+    net = nn.Dense(1, in_units=4)
+    net.initialize(init="zeros")
+    trainer = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.5})
+    loss_fn = gluon.loss.L2Loss()
+    x = mx.nd.array(onp.random.randn(16, 4).astype("float32"))
+    w_true = onp.array([[1.0, -2.0, 3.0, 0.5]], dtype="float32")
+    y = mx.nd.array(x.asnumpy() @ w_true.T)
+    losses = []
+    for _ in range(30):
+        with mx.autograd.record():
+            l = loss_fn(net(x), y)
+        l.backward()
+        trainer.step(16)
+        losses.append(float(l.asnumpy().mean()))
+    assert losses[-1] < losses[0] * 0.05
+
+
+def test_trainer_save_load_states(tmp_path):
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "adam", {"learning_rate": 0.1})
+    x = mx.nd.ones((2, 2))
+    with mx.autograd.record():
+        l = gluon.loss.L2Loss()(net(x), mx.nd.ones((2, 2)))
+    l.backward()
+    trainer.step(2)
+    fname = str(tmp_path / "trainer.states")
+    trainer.save_states(fname)
+    trainer2 = gluon.Trainer(net.collect_params(), "adam", {"learning_rate": 0.1})
+    trainer2.load_states(fname)
+    assert trainer2._optimizer.num_update == trainer._optimizer.num_update
+
+
+def test_save_load_parameters(tmp_path):
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(8, activation="relu"), nn.Dense(4))
+    net.initialize()
+    x = mx.nd.ones((2, 6))
+    out1 = net(x).asnumpy()
+    fname = str(tmp_path / "net.params")
+    net.save_parameters(fname)
+
+    net2 = nn.HybridSequential()
+    with net2.name_scope():
+        net2.add(nn.Dense(8, activation="relu"), nn.Dense(4))
+    net2.load_parameters(fname)
+    out2 = net2(x).asnumpy()
+    onp.testing.assert_allclose(out1, out2, rtol=1e-6)
+
+
+def test_export_import(tmp_path):
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(4, in_units=3))
+    net.initialize()
+    net.hybridize()
+    net(mx.nd.ones((1, 3)))
+    sym_file, params_file = net.export(str(tmp_path / "model"))
+    assert os.path.exists(sym_file) and os.path.exists(params_file)
+
+
+def test_collect_params_select():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(4, in_units=3), nn.Dense(2, in_units=4))
+    sel = net.collect_params(".*weight")
+    assert all(k.endswith("weight") for k in sel.keys())
+    assert len(sel) == 2
+
+
+def test_shared_params():
+    d1 = nn.Dense(4, in_units=3)
+    d2 = nn.Dense(4, in_units=3, params=d1.params)
+    d1.initialize()
+    x = mx.nd.array(onp.random.randn(2, 3).astype("float32"))
+    onp.testing.assert_allclose(d1(x).asnumpy(), d2(x).asnumpy())
+
+
+def test_constant_param():
+    class Net(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            with self.name_scope():
+                self.const = self.params.get_constant(
+                    "const", onp.ones((1, 3)).astype("float32") * 2)
+
+        def hybrid_forward(self, F, x, const):
+            return x * const
+
+    net = Net()
+    net.initialize()
+    out = net(mx.nd.ones((2, 3)))
+    onp.testing.assert_allclose(out.asnumpy(), onp.full((2, 3), 2.0))
+
+
+def test_zero_grad():
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    x = mx.nd.ones((1, 2))
+    with mx.autograd.record():
+        l = net(x).sum()
+    l.backward()
+    assert onp.abs(net.weight.grad().asnumpy()).sum() > 0
+    net.collect_params().zero_grad()
+    assert onp.abs(net.weight.grad().asnumpy()).sum() == 0
+
+
+def test_block_repr_and_summary(capsys):
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(4, in_units=3))
+    net.initialize()
+    repr(net)
+    net.summary(mx.nd.ones((1, 3)))
+    out = capsys.readouterr().out
+    assert "Dense" in out
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+
+def test_l2_loss():
+    loss = gluon.loss.L2Loss()
+    pred = mx.nd.array([[1.0, 2.0]])
+    label = mx.nd.array([[0.0, 0.0]])
+    out = loss(pred, label).asnumpy()
+    onp.testing.assert_allclose(out, [(1 + 4) / 2 / 2], rtol=1e-6)
+
+
+def test_softmax_ce_loss_sparse_vs_dense():
+    pred = mx.nd.array(onp.random.randn(4, 5).astype("float32"))
+    label_idx = mx.nd.array([0, 1, 2, 3])
+    dense = onp.zeros((4, 5), dtype="float32")
+    dense[onp.arange(4), [0, 1, 2, 3]] = 1
+    l1 = gluon.loss.SoftmaxCrossEntropyLoss()(pred, label_idx).asnumpy()
+    l2 = gluon.loss.SoftmaxCrossEntropyLoss(sparse_label=False)(
+        pred, mx.nd.array(dense)).asnumpy()
+    onp.testing.assert_allclose(l1, l2, rtol=1e-5)
+
+
+def test_sigmoid_bce_loss():
+    loss = gluon.loss.SigmoidBinaryCrossEntropyLoss()
+    pred = mx.nd.array(onp.random.randn(3, 4).astype("float32"))
+    label = mx.nd.array((onp.random.rand(3, 4) > 0.5).astype("float32"))
+    out = loss(pred, label).asnumpy()
+    p = 1 / (1 + onp.exp(-pred.asnumpy()))
+    expect = -(label.asnumpy() * onp.log(p) + (1 - label.asnumpy()) * onp.log(1 - p))
+    onp.testing.assert_allclose(out, expect.mean(axis=1), rtol=1e-4, atol=1e-5)
+
+
+def test_huber_hinge_losses():
+    pred = mx.nd.array(onp.random.randn(4, 3).astype("float32"))
+    label = mx.nd.array(onp.random.randn(4, 3).astype("float32"))
+    assert gluon.loss.HuberLoss()(pred, label).shape == (4,)
+    assert gluon.loss.HingeLoss()(pred, label).shape == (4,)
+    assert gluon.loss.SquaredHingeLoss()(pred, label).shape == (4,)
+
+
+# ---------------------------------------------------------------------------
+# rnn
+# ---------------------------------------------------------------------------
+
+def test_lstm_layer_shapes():
+    lstm = rnn.LSTM(20, num_layers=2)
+    lstm.initialize()
+    x = mx.nd.array(onp.random.randn(5, 3, 10).astype("float32"))
+    out = lstm(x)
+    assert out.shape == (5, 3, 20)
+    out, states = lstm(x, lstm.begin_state(3))
+    assert states[0].shape == (2, 3, 20) and states[1].shape == (2, 3, 20)
+
+
+def test_bidirectional_gru_ntc():
+    gru = rnn.GRU(8, num_layers=1, bidirectional=True, layout="NTC")
+    gru.initialize()
+    x = mx.nd.array(onp.random.randn(3, 5, 4).astype("float32"))
+    assert gru(x).shape == (3, 5, 16)
+
+
+def test_lstm_cell_vs_layer():
+    """Cell-unrolled LSTM must match the fused layer when weights are tied
+    (reference: consistency between rnn_cell and fused RNN op)."""
+    hidden, T, N, C = 6, 4, 2, 3
+    cell = rnn.LSTMCell(hidden, input_size=C)
+    cell.initialize()
+    layer = rnn.LSTM(hidden, num_layers=1, input_size=C)
+    layer.initialize()
+    # tie layer params to cell params
+    layer.l0_i2h_weight.set_data(cell.i2h_weight.data())
+    layer.l0_h2h_weight.set_data(cell.h2h_weight.data())
+    layer.l0_i2h_bias.set_data(cell.i2h_bias.data())
+    layer.l0_h2h_bias.set_data(cell.h2h_bias.data())
+    x = mx.nd.array(onp.random.randn(T, N, C).astype("float32"))
+    out_layer = layer(x).asnumpy()
+    outs, _ = cell.unroll(T, x, layout="TNC", merge_outputs=True)
+    onp.testing.assert_allclose(out_layer, outs.asnumpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_rnn_cell_begin_state_and_seq():
+    stack = rnn.SequentialRNNCell()
+    with stack.name_scope():
+        stack.add(rnn.LSTMCell(4, input_size=3))
+        stack.add(rnn.GRUCell(5, input_size=4))
+    stack.initialize()
+    outputs, states = stack.unroll(
+        3, mx.nd.ones((2, 3, 3)), layout="NTC", merge_outputs=True)
+    assert outputs.shape == (2, 3, 5)
+    assert len(states) == 3  # lstm h,c + gru h
+
+
+def test_rnn_grad_flows():
+    lstm = rnn.LSTM(4, num_layers=1, input_size=3)
+    lstm.initialize()
+    x = mx.nd.array(onp.random.randn(3, 2, 3).astype("float32"))
+    with mx.autograd.record():
+        out = lstm(x).sum()
+    out.backward()
+    g = lstm.l0_i2h_weight.grad().asnumpy()
+    assert onp.abs(g).sum() > 0
+
+
+# ---------------------------------------------------------------------------
+# data
+# ---------------------------------------------------------------------------
+
+def test_array_dataset_dataloader():
+    X = onp.random.randn(10, 3).astype("float32")
+    Y = onp.arange(10).astype("float32")
+    ds = gluon.data.ArrayDataset(X, Y)
+    assert len(ds) == 10
+    loader = gluon.data.DataLoader(ds, batch_size=4, last_batch="keep")
+    batches = list(loader)
+    assert len(batches) == 3
+    assert batches[0][0].shape == (4, 3)
+    assert batches[2][0].shape == (2, 3)
+
+
+def test_dataloader_shuffle_and_discard():
+    ds = gluon.data.ArrayDataset(onp.arange(10).astype("float32"))
+    loader = gluon.data.DataLoader(ds, batch_size=3, shuffle=True,
+                                   last_batch="discard")
+    batches = list(loader)
+    assert len(batches) == 3
+    all_vals = onp.concatenate([b.asnumpy() for b in batches])
+    assert len(set(all_vals.astype(int).tolist())) == 9
+
+
+def test_dataset_transform_shard():
+    ds = gluon.data.SimpleDataset(list(range(10)))
+    t = ds.transform(lambda x: x * 2)
+    assert t[3] == 6
+    sh = ds.shard(3, 0)
+    assert len(sh) == 4
+
+
+def test_mnist_dataset_and_transforms():
+    from incubator_mxnet_tpu.gluon.data.vision import MNIST, transforms
+    ds = MNIST(train=False)
+    x, y = ds[0]
+    assert x.shape == (28, 28, 1)
+    tds = ds.transform_first(transforms.ToTensor())
+    x2, y2 = tds[0]
+    assert x2.shape == (1, 28, 28)
+    assert x2.max() <= 1.0
+
+
+def test_fixed_bucket_sampler():
+    lengths = onp.random.randint(5, 100, size=200)
+    sampler = gluon.data.FixedBucketSampler(lengths, batch_size=8, num_buckets=5)
+    seen = set()
+    for batch in sampler:
+        assert len(batch) <= 8 * 3
+        seen.update(batch)
+    assert len(seen) == 200
+
+
+def test_split_and_load():
+    data = mx.nd.arange(12).reshape((6, 2))
+    parts = gluon.utils.split_data(data, 3)
+    assert [p.shape for p in parts] == [(2, 2)] * 3
+    loaded = gluon.utils.split_and_load(data, [mx.cpu(0)])
+    assert loaded[0].shape == (6, 2)
+
+
+def test_clip_global_norm():
+    arrays = [mx.nd.full((2, 2), 10.0), mx.nd.full((2,), 10.0)]
+    norm = gluon.utils.clip_global_norm(arrays, 1.0)
+    assert norm > 1.0
+    total = sum(float((a * a).sum().asnumpy()) for a in arrays) ** 0.5
+    assert total == pytest.approx(1.0, rel=1e-4)
