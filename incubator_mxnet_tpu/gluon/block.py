@@ -117,6 +117,42 @@ def _flatten_args(args):
     return flat, fmt
 
 
+class _ArrSlot:
+    """Placeholder for an NDArray position in a cached-arg skeleton (so the
+    jit closure doesn't pin the cache-building batch's device buffers)."""
+
+    __slots__ = ()
+
+
+_ARR_SLOT = _ArrSlot()
+
+
+def _strip_arrays(args):
+    def rec(a):
+        if isinstance(a, NDArray):
+            return _ARR_SLOT
+        if isinstance(a, (list, tuple)):
+            return [rec(x) for x in a]
+        return a
+
+    return tuple(rec(a) for a in args)
+
+
+def _static_key(flat_args):
+    """Hashable digest of the non-array leaves (they are baked into the
+    traced graph, so they must key the cache)."""
+    out = []
+    for a in flat_args:
+        if isinstance(a, NDArray):
+            continue
+        try:
+            hash(a)
+            out.append(a)
+        except TypeError:
+            out.append(repr(a))
+    return tuple(out)
+
+
 def _regroup(flat, fmt):
     it = iter(flat)
 
@@ -455,12 +491,13 @@ class HybridBlock(Block):
         training = autograd.is_training()
         key_val = random_mod.next_key(ctx)
         n_in = len(arr_args)
-        cache_key = training
+        cache_key = (training, _static_key(flat_args))
 
         if cache_key not in self._jit_cache:
             info = {"out_fmt": None, "effects": []}
             self._cache_info[cache_key] = info
             block = self
+            skeleton = _strip_arrays(args)
 
             def pure(key, *vals):
                 ins, pvals = vals[:n_in], vals[n_in:]
@@ -469,7 +506,7 @@ class HybridBlock(Block):
                     proxies[id(p)] = NDArray(v, ctx=ctx)
                 # rebuild args replacing NDArray slots with traced proxies
                 it = iter(NDArray(v, ctx=ctx) for v in ins)
-                rebuilt = _rebuild_args(args, it)
+                rebuilt = _rebuild_args(skeleton, it)
                 _TRACING.flag = True
                 try:
                     with autograd.pause(train_mode=training), \
@@ -553,7 +590,7 @@ def params_data(params, ctx):
 
 def _rebuild_args(args, it):
     def rec(a):
-        if isinstance(a, NDArray):
+        if isinstance(a, NDArray) or isinstance(a, _ArrSlot):
             return next(it)
         if isinstance(a, (list, tuple)):
             return [rec(x) for x in a]

@@ -186,14 +186,15 @@ class Adam(Optimizer):
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
 
     def create_state(self, index, weight):
-        z = jnp.zeros(weight.shape, weight._data.dtype)
-        return (z, z)
+        def z():
+            return jnp.zeros(weight.shape, weight._data.dtype)
+        return (z(), z())
 
     def step(self, w, g, state, lr, wd, t):
         g = self._prep_grad(g) + wd * w
         m = self.beta1 * state[0] + (1 - self.beta1) * g
         v = self.beta2 * state[1] + (1 - self.beta2) * jnp.square(g)
-        lr_t = lr * math.sqrt(1 - self.beta2 ** t) / (1 - self.beta1 ** t)
+        lr_t = lr * jnp.sqrt(1.0 - self.beta2 ** t) / (1.0 - self.beta1 ** t)
         return w - lr_t * m / (jnp.sqrt(v) + self.epsilon), (m, v)
 
 
@@ -220,10 +221,11 @@ class RMSProp(Optimizer):
         self.clip_weights = clip_weights
 
     def create_state(self, index, weight):
-        z = jnp.zeros(weight.shape, weight._data.dtype)
+        def z():
+            return jnp.zeros(weight.shape, weight._data.dtype)
         if self.centered:
-            return (z, z, z)  # n, g_bar, delta
-        return (z,)
+            return (z(), z(), z())  # n, g_bar, delta
+        return (z(),)
 
     def step(self, w, g, state, lr, wd, t):
         g = self._prep_grad(g) + wd * w
@@ -262,8 +264,9 @@ class AdaDelta(Optimizer):
         self.rho, self.epsilon = rho, epsilon
 
     def create_state(self, index, weight):
-        z = jnp.zeros(weight.shape, weight._data.dtype)
-        return (z, z)
+        def z():
+            return jnp.zeros(weight.shape, weight._data.dtype)
+        return (z(), z())
 
     def step(self, w, g, state, lr, wd, t):
         g = self._prep_grad(g) + wd * w
@@ -280,8 +283,9 @@ class FTRL(Optimizer):
         self.lamda1, self.beta = lamda1, beta
 
     def create_state(self, index, weight):
-        z = jnp.zeros(weight.shape, weight._data.dtype)
-        return (z, z)  # z, n
+        def z():
+            return jnp.zeros(weight.shape, weight._data.dtype)
+        return (z(), z())  # z, n
 
     def step(self, w, g, state, lr, wd, t):
         g = self._prep_grad(g)
@@ -329,8 +333,9 @@ class LAMB(Optimizer):
         self.bias_correction = bias_correction
 
     def create_state(self, index, weight):
-        z = jnp.zeros(weight.shape, jnp.float32)
-        return (z, z)
+        def z():
+            return jnp.zeros(weight.shape, jnp.float32)
+        return (z(), z())
 
     def step(self, w, g, state, lr, wd, t):
         g = self._prep_grad(g).astype(jnp.float32)

@@ -1,0 +1,39 @@
+"""SPMD parallelism over the TPU device mesh.
+
+This package is the TPU-native replacement for the reference's entire
+distributed stack (SURVEY §2.5, §5.8): ``src/kvstore/comm.h`` (local device
+reduce), ``src/kvstore/kvstore_nccl.h`` (NCCL all-reduce), ``3rdparty/ps-lite``
+(multi-node parameter server) and ``tools/launch.py`` (process launcher) all
+collapse into ONE mechanism — XLA collectives over the ICI/DCN fabric, driven
+by ``jax.sharding`` annotations on a named device mesh.
+
+Modules:
+
+- :mod:`mesh` — named-mesh construction (``dp``/``tp``/``pp``/``sp``/``ep``
+  axes), the process-wide default mesh.
+- :mod:`sharding` — regex→PartitionSpec rule tables mapping parameter names
+  to shardings (the counterpart of the reference's per-key kvstore layout).
+- :mod:`collectives` — thin wrappers over ``lax.psum``/``all_gather``/…
+  usable inside ``shard_map`` (the NCCL verb surface).
+- :mod:`dist` — multi-host runtime init (replaces ``tools/launch.py`` +
+  ps-lite role env vars with ``jax.distributed.initialize``).
+- :mod:`trainer` — :class:`ShardedTrainer`: one jit-compiled SPMD training
+  step (forward+backward+optimizer) over the mesh; the fusion of the
+  reference's CachedOp forward/backward + kvstore push/pull + optimizer ops.
+- :mod:`ring` — ring attention over the ``sp`` axis (sequence/context
+  parallelism; capability-parity-plus, SURVEY §5.7).
+"""
+from .mesh import (  # noqa: F401
+    MeshConfig, make_mesh, default_mesh, set_default_mesh, local_mesh,
+    AXIS_DP, AXIS_TP, AXIS_PP, AXIS_SP, AXIS_EP,
+)
+from .sharding import (  # noqa: F401
+    ShardingRules, named_sharding, shard_array, replicate, data_sharding,
+)
+from . import collectives  # noqa: F401
+from .collectives import (  # noqa: F401
+    all_reduce, all_gather, reduce_scatter, broadcast, ppermute, all_to_all,
+)
+from .dist import initialize, finalize, process_count, process_index  # noqa: F401
+from .trainer import ShardedTrainer  # noqa: F401
+from .ring import ring_attention, ring_attention_sharded  # noqa: F401

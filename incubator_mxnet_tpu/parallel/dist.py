@@ -1,0 +1,71 @@
+"""Multi-host runtime initialization.
+
+Reference counterpart: ``tools/launch.py`` + dmlc tracker, which spawned the
+ps-lite scheduler/server/worker processes and wired them with ``DMLC_ROLE`` /
+``DMLC_PS_ROOT_URI`` / ``DMLC_NUM_WORKER`` env vars (SURVEY §2.5). In the
+multi-controller JAX model every host runs the same program;
+``jax.distributed.initialize`` plays the scheduler's role (rendezvous at the
+coordinator address), after which ``jax.devices()`` spans the whole pod and
+every mesh built from it is global. There are no server processes — gradient
+exchange is XLA collectives inside the compiled step.
+
+Env-var compatibility: if the dmlc-style vars are present they are mapped
+onto the JAX rendezvous so reference launch scripts keep working:
+
+- ``DMLC_PS_ROOT_URI:DMLC_PS_ROOT_PORT`` → coordinator_address
+- ``DMLC_NUM_WORKER``                   → num_processes
+- ``DMLC_WORKER_ID``                    → process_id
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+_INITIALIZED = [False]
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None,
+               local_device_ids=None) -> None:
+    """Rendezvous this process into the global runtime. No-op when
+    single-process (the common single-host case) or already initialized."""
+    if _INITIALIZED[0]:
+        return
+    if coordinator_address is None:
+        uri = os.environ.get("DMLC_PS_ROOT_URI")
+        port = os.environ.get("DMLC_PS_ROOT_PORT", "9000")
+        if uri:
+            coordinator_address = f"{uri}:{port}"
+    if num_processes is None and "DMLC_NUM_WORKER" in os.environ:
+        num_processes = int(os.environ["DMLC_NUM_WORKER"])
+    if process_id is None and "DMLC_WORKER_ID" in os.environ:
+        process_id = int(os.environ["DMLC_WORKER_ID"])
+    if coordinator_address is None and num_processes in (None, 1):
+        _INITIALIZED[0] = True  # single-process: nothing to do
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids)
+    _INITIALIZED[0] = True
+
+
+def finalize() -> None:
+    if _INITIALIZED[0]:
+        try:
+            jax.distributed.shutdown()
+        except Exception:
+            pass
+        _INITIALIZED[0] = False
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def process_index() -> int:
+    return jax.process_index()

@@ -1,0 +1,98 @@
+"""Ring attention — sequence/context parallelism over the ``sp`` mesh axis.
+
+The reference has NO sequence parallelism (SURVEY §5.7): its longest-context
+story is the fused interleaved-MHA kernels in
+``src/operator/contrib/transformer.cc`` with the O(L²) score matrix
+materialized. This module is the capability-parity-plus counterpart: the
+sequence dim is sharded over ``sp``, K/V blocks rotate around the ring via
+``lax.ppermute`` (one ICI hop per step), and each hop folds into a running
+flash-style online softmax — so no device ever holds the full L×L matrix and
+context length scales linearly with the ring size.
+
+Shapes follow the contrib-op convention [batch, heads, seq, head_dim].
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+
+P = PartitionSpec
+
+__all__ = ["ring_attention", "ring_attention_sharded"]
+
+_NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, o, m, l, q_off, k_off, scale, causal):
+    """One ring hop: fold local K/V block into the online-softmax state."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        lq, lk = q.shape[2], k.shape[2]
+        qpos = q_off + jnp.arange(lq)[:, None]
+        kpos = k_off + jnp.arange(lk)[None, :]
+        s = jnp.where(qpos >= kpos, s, _NEG_INF)
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    # guard fully-masked rows (exp(-inf - -inf)): keep them at zero weight
+    alpha = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new[..., None])
+    l_new = l * alpha + p.sum(axis=-1)
+    o_new = o * alpha[..., None] + jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    return o_new, m_new, l_new
+
+
+def ring_attention(q, k, v, axis: str = "sp", causal: bool = False,
+                   scale: Optional[float] = None):
+    """Attention over sequence shards; call inside shard_map with ``axis``
+    bound. q/k/v: [B, H, L_local, D] local shards of the L dimension."""
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    n = lax.psum(1, axis)
+    idx = lax.axis_index(axis)
+    lq, lk = q.shape[2], k.shape[2]
+    b, h = q.shape[0], q.shape[1]
+
+    o0 = jnp.zeros((b, h, lq, q.shape[3]), jnp.float32)
+    m0 = jnp.full((b, h, lq), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, lq), jnp.float32)
+    q_off = idx * lq
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def body(i, carry):
+        o, m, l, k_cur, v_cur = carry
+        src = (idx - i) % n          # whose block we currently hold
+        o, m, l = _block_attn(q, k_cur, v_cur, o, m, l,
+                              q_off, src * lk, scale, causal)
+        k_nxt = lax.ppermute(k_cur, axis, perm)
+        v_nxt = lax.ppermute(v_cur, axis, perm)
+        return o, m, l, k_nxt, v_nxt
+
+    # n-1 hops with rotation, then fold the final held block without the
+    # wasted last rotation.
+    o, m, l, k_last, v_last = lax.fori_loop(0, n - 1, body, (o0, m0, l0, k, v))
+    o, m, l = _block_attn(q, k_last, v_last, o, m, l,
+                          q_off, ((idx - (n - 1)) % n) * lk, scale, causal)
+    l = jnp.where(l == 0.0, 1.0, l)
+    return (o / l[..., None]).astype(q.dtype)
+
+
+def ring_attention_sharded(mesh: Mesh, q, k, v, causal: bool = False,
+                           scale: Optional[float] = None, axis: str = "sp"):
+    """Host-level entry: q/k/v global [B,H,L,D]; shards L over ``axis``,
+    batch over ``dp`` when that axis exists."""
+    bspec = "dp" if mesh.shape.get("dp", 1) > 1 else None
+    spec = P(bspec, None, axis, None)
+    fn = shard_map(
+        partial(ring_attention, axis=axis, causal=causal, scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    args = tuple(jax.device_put(x, NamedSharding(mesh, spec)) for x in (q, k, v))
+    return jax.jit(fn)(*args)

@@ -1,0 +1,100 @@
+"""Parameter/array sharding rules.
+
+Reference counterpart: the kvstore's per-key layout decisions — how
+``KVStoreLocal`` shards big arrays across devices
+(``MXNET_KVSTORE_BIGARRAY_BOUND``) and how ps-lite range-partitions keys over
+servers (``src/kvstore/kvstore_dist.h``). TPU-natively the layout is a
+compile-time annotation: each parameter name maps (by regex rule table) to a
+:class:`~jax.sharding.PartitionSpec` over the named mesh axes, and XLA's SPMD
+partitioner inserts the collectives the kvstore used to run by hand.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+P = PartitionSpec
+
+__all__ = ["ShardingRules", "named_sharding", "shard_array", "replicate",
+           "data_sharding", "P"]
+
+
+class ShardingRules:
+    """Ordered (regex → PartitionSpec) table; first match wins, default
+    replicated. The standard megatron-style table for a transformer:
+
+    >>> rules = ShardingRules([
+    ...     (r".*qkv.*weight", P("tp", None)),
+    ...     (r".*ffn_in.*weight", P("tp", None)),
+    ...     (r".*ffn_out.*weight", P(None, "tp")),
+    ...     (r".*embed.*weight", P("tp", None)),
+    ... ])
+    """
+
+    def __init__(self, rules: Sequence[Tuple[str, PartitionSpec]] = ()):
+        self._rules: List[Tuple[re.Pattern, PartitionSpec]] = [
+            (re.compile(pat), spec) for pat, spec in rules]
+
+    def add(self, pattern: str, spec: PartitionSpec) -> "ShardingRules":
+        self._rules.append((re.compile(pattern), spec))
+        return self
+
+    def spec_for(self, name: str, shape: Optional[Tuple[int, ...]] = None,
+                 mesh: Optional[Mesh] = None) -> PartitionSpec:
+        for pat, spec in self._rules:
+            if pat.search(name):
+                if shape is not None and mesh is not None and not _divisible(
+                        shape, spec, mesh):
+                    return P()
+                return spec
+        return P()
+
+    def sharding_for(self, name: str, mesh: Mesh,
+                     shape: Optional[Tuple[int, ...]] = None) -> NamedSharding:
+        return NamedSharding(mesh, self.spec_for(name, shape, mesh))
+
+    def __repr__(self):
+        return f"ShardingRules({[(p.pattern, s) for p, s in self._rules]})"
+
+
+def _divisible(shape, spec, mesh) -> bool:
+    for dim, axes in zip(shape, tuple(spec)):
+        if axes is None:
+            continue
+        axes = (axes,) if isinstance(axes, str) else axes
+        size = 1
+        for a in axes:
+            size *= mesh.shape.get(a, 1)
+        if size and dim % size:
+            return False
+    return True
+
+
+def named_sharding(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec))
+
+
+def replicate(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def data_sharding(mesh: Mesh, batch_axis: int = 0, seq_axis: Optional[int] = None,
+                  ndim: int = 2) -> NamedSharding:
+    """Input-batch sharding: batch dim over ``dp``, sequence dim over ``sp``
+    when those mesh axes have size > 1."""
+    spec: List = [None] * ndim
+    if mesh.shape.get("dp", 1) > 1:
+        spec[batch_axis] = "dp"
+    if seq_axis is not None and mesh.shape.get("sp", 1) > 1:
+        spec[seq_axis] = "sp"
+    return NamedSharding(mesh, P(*spec))
+
+
+def shard_array(x, mesh: Mesh, spec: Union[PartitionSpec, Sequence]) -> jax.Array:
+    """Place ``x`` (jax array / numpy) with the given PartitionSpec."""
+    if not isinstance(spec, PartitionSpec):
+        spec = P(*spec)
+    return jax.device_put(x, NamedSharding(mesh, spec))

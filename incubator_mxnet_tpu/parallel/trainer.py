@@ -1,0 +1,248 @@
+"""ShardedTrainer — one compiled SPMD training step over the mesh.
+
+Reference counterpart: the whole inner loop of SURVEY §3.2 fused into one XLA
+executable. What the reference runs as four separate engine phases —
+``CachedOp::Forward``, ``Imperative::Backward``, kvstore push/pull
+(``KVStoreNCCL`` all-reduce), and per-parameter optimizer ops
+(``src/operator/optimizer_op.cc``) — is here a single jit-compiled pure
+function ``(params, opt_state, batch) -> (loss, params', opt_state')`` whose
+gradient collectives are inserted by XLA's SPMD partitioner from the sharding
+annotations: batch over ``dp`` ⇒ grad psum over ``dp`` rides ICI exactly
+where ncclAllReduce sat. Parameter donation gives the in-place-update memory
+behavior of ``FMutateInputs``.
+
+Usage::
+
+    mesh = parallel.make_mesh(dp=2, tp=4)
+    trainer = parallel.ShardedTrainer(net, loss_fn, 'adamw',
+                                      {'learning_rate': 1e-4}, mesh=mesh,
+                                      rules=bert_sharding_rules())
+    loss = trainer.step(data, label)       # compiled after first call
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..base import MXNetError
+from ..context import current_context
+from ..ndarray import NDArray
+from .. import autograd
+from .. import optimizer as opt_mod
+from .. import random as random_mod
+from ..gluon import _trace
+from ..gluon.block import _TRACING
+from .mesh import default_mesh
+from .sharding import ShardingRules, data_sharding
+
+P = PartitionSpec
+
+__all__ = ["ShardedTrainer"]
+
+
+class ShardedTrainer:
+    """Drives a HybridBlock's training SPMD over a named mesh.
+
+    Unlike :class:`~incubator_mxnet_tpu.gluon.trainer.Trainer` (which mirrors
+    the reference's kvstore push/pull step), this owns the parameters as a
+    sharded pytree and updates them functionally each step — the TPU-idiomatic
+    formulation. ``sync_to_block()`` writes the current values back into the
+    gluon Parameters (for save_parameters / evaluation on one chip).
+    """
+
+    def __init__(self, block, loss_fn: Callable, optimizer,
+                 optimizer_params: Optional[dict] = None,
+                 mesh: Optional[Mesh] = None,
+                 rules: Optional[ShardingRules] = None,
+                 n_labels: int = 1, seq_axis: Optional[int] = None,
+                 donate: bool = True):
+        self._block = block
+        self._loss_fn = loss_fn
+        self._optimizer = opt_mod.create(
+            optimizer, **(optimizer_params or {}))
+        self._mesh = mesh if mesh is not None else default_mesh()
+        self._rules = rules if rules is not None else ShardingRules()
+        self._n_labels = n_labels
+        self._seq_axis = seq_axis
+        self._donate = donate
+        self._params = None          # sorted List[Parameter]
+        self._param_vals = None      # tuple of sharded jax arrays
+        self._opt_states = None      # tuple of per-param state tuples
+        self._step_fn = None
+        self._info: Dict[str, Any] = {}
+        self._t = 0
+        self._ctx = current_context()
+
+    # ------------------------------------------------------------------
+    @property
+    def mesh(self) -> Mesh:
+        return self._mesh
+
+    @property
+    def num_update(self) -> int:
+        return self._t
+
+    def _init_state(self, data_args: Sequence[NDArray]) -> None:
+        """Warm up the block eagerly (finishes deferred init), then shard
+        every parameter and optimizer state onto the mesh by rule."""
+        blk = self._block
+        with autograd.pause(train_mode=True):
+            _TRACING.flag = True
+            try:
+                blk.forward(*data_args)
+            finally:
+                _TRACING.flag = False
+        items = sorted(blk.collect_params().items())
+        self._params = [p for _, p in items]
+        opt = self._optimizer
+        opt.idx2name = {i: name for i, (name, _) in enumerate(items)}
+        # Optimizer state arrays share the weight's layout when same-shaped
+        # (momentum / adam moments / fp32 master weights); anything else is
+        # replicated. Weights are copied before placement: device_put of an
+        # already-matching array shares the buffer, and step-time donation
+        # would otherwise delete the gluon Parameter's live data.
+        vals, states = [], []
+        for i, (name, p) in enumerate(items):
+            v = p.data(self._ctx)._data
+            sh = self._rules.sharding_for(name, self._mesh, tuple(v.shape))
+            vals.append(jax.device_put(jnp.copy(v), sh))
+            placed = []
+            for s in opt.create_state_multi_precision(i, p.data(self._ctx)):
+                spec = (self._rules.spec_for(name, tuple(v.shape), self._mesh)
+                        if tuple(s.shape) == tuple(v.shape) else P())
+                placed.append(jax.device_put(
+                    s, NamedSharding(self._mesh, spec)))
+            states.append(tuple(placed))
+        self._param_vals = tuple(vals)
+        self._opt_states = tuple(states)
+
+    # ------------------------------------------------------------------
+    def _build_step(self, n_data: int, arg_struct) -> Callable:
+        blk, params, opt = self._block, self._params, self._optimizer
+        loss_fn, ctx, info = self._loss_fn, self._ctx, self._info
+        lr_mults = [opt._get_lr(i) / max(opt.learning_rate, 1e-30)
+                    for i in range(len(params))]
+        wds = [opt._get_wd(i) for i in range(len(params))]
+        # Mixed precision: state[0] is the fp32 master weight (reference:
+        # Optimizer.update_multi_precision master branch).
+        mp = [bool(opt.multi_precision
+                   and self._param_vals[i].dtype in (jnp.float16, jnp.bfloat16)
+                   and self._opt_states[i]
+                   and self._opt_states[i][0].dtype == jnp.float32
+                   and self._opt_states[i][0].shape == self._param_vals[i].shape)
+              for i in range(len(params))]
+
+        def step(param_vals, opt_states, key, lr, t, *batch_vals):
+            def loss_of(pvals):
+                proxies = {id(p): NDArray(v, ctx=ctx)
+                           for p, v in zip(params, pvals)}
+                ins = [NDArray(v, ctx=ctx) for v in batch_vals]
+                _TRACING.flag = True
+                try:
+                    with autograd.pause(train_mode=True), \
+                            random_mod.trace_rng(key), \
+                            _trace.TraceScope(proxies) as scope:
+                        out = blk.forward(*ins[:n_data])
+                        loss = loss_fn(out, *ins[n_data:])
+                finally:
+                    _TRACING.flag = False
+                lv = loss._data if isinstance(loss, NDArray) else loss
+                info["effects"] = list(scope.effect_keys)
+                return jnp.mean(lv), tuple(scope.effect_values)
+
+            (loss, effects), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(param_vals)
+            new_vals, new_states = [], []
+            for i, (w, g, s) in enumerate(zip(param_vals, grads, opt_states)):
+                if mp[i]:
+                    nm, ns = opt.step(s[0], g.astype(jnp.float32), tuple(s[1:]),
+                                      lr * lr_mults[i], wds[i], t)
+                    new_vals.append(nm.astype(w.dtype))
+                    new_states.append((nm,) + tuple(ns))
+                else:
+                    nw, ns = opt.step(w, g.astype(w.dtype), s,
+                                      lr * lr_mults[i], wds[i], t)
+                    new_vals.append(nw.astype(w.dtype))
+                    new_states.append(tuple(ns))
+            return loss, tuple(new_vals), tuple(new_states), effects
+
+        donate = (0, 1) if self._donate else ()
+        return jax.jit(step, donate_argnums=donate)
+
+    # ------------------------------------------------------------------
+    def step(self, *batch) -> NDArray:
+        """Run one training step on a global batch; returns the mean loss.
+
+        ``batch`` = data arguments then ``n_labels`` label arguments, as
+        NDArrays or numpy/jax arrays (placed with batch-over-``dp``,
+        seq-over-``sp`` sharding).
+        """
+        n_data = len(batch) - self._n_labels
+        if n_data < 1:
+            raise MXNetError("step() needs at least one data argument")
+        arrs = [a if isinstance(a, NDArray) else NDArray(jnp.asarray(a), ctx=self._ctx)
+                for a in batch]
+        if self._params is None:
+            self._init_state(arrs[:n_data])
+        vals = []
+        for a in arrs:
+            v = a._data
+            sh = data_sharding(self._mesh, batch_axis=0,
+                               seq_axis=self._seq_axis, ndim=v.ndim)
+            vals.append(jax.device_put(v, sh))
+        if self._step_fn is None:
+            self._step_fn = self._build_step(n_data, None)
+        self._t += 1
+        lr = jnp.asarray(self._optimizer.learning_rate, jnp.float32)
+        t = jnp.asarray(self._t, jnp.int32)
+        key = random_mod.next_key(self._ctx)
+        loss, self._param_vals, self._opt_states, effects = self._step_fn(
+            self._param_vals, self._opt_states, key, lr, t, *vals)
+        self._optimizer.num_update = self._t
+        for (p, ectx), val in zip(self._info.get("effects", ()), effects):
+            p._deposit_aux(val._data if isinstance(val, NDArray) else val,
+                           ectx if ectx is not None else self._ctx)
+        return NDArray(loss, ctx=self._ctx)
+
+    # ------------------------------------------------------------------
+    def sync_to_block(self) -> None:
+        """Write current sharded values back into the gluon Parameters."""
+        if self._params is None:
+            return
+        for p, v in zip(self._params, self._param_vals):
+            p.set_data(NDArray(jax.device_get(v), ctx=self._ctx))
+
+    def save_states(self, fname: str) -> None:
+        import pickle
+        state = {
+            "t": self._t,
+            "opt_states": jax.device_get(self._opt_states),
+            "param_vals": jax.device_get(self._param_vals),
+        }
+        with open(fname, "wb") as f:
+            pickle.dump(state, f)
+
+    def load_states(self, fname: str) -> None:
+        import pickle
+        with open(fname, "rb") as f:
+            state = pickle.load(f)
+        self._t = state["t"]
+        if self._params is None:
+            raise MXNetError("call step() once (or _init_state) before "
+                             "load_states so the parameter set exists")
+        items = sorted(self._block.collect_params().items())
+        vals, states = [], []
+        for (name, p), v, st in zip(items, state["param_vals"], state["opt_states"]):
+            sh = self._rules.sharding_for(name, self._mesh, tuple(v.shape))
+            vals.append(jax.device_put(jnp.asarray(v), sh))
+            placed = []
+            for s in st:
+                spec = (self._rules.spec_for(name, tuple(s.shape), self._mesh)
+                        if tuple(s.shape) == tuple(v.shape) else P())
+                placed.append(jax.device_put(
+                    jnp.asarray(s), NamedSharding(self._mesh, spec)))
+            states.append(tuple(placed))
+        self._param_vals, self._opt_states = tuple(vals), tuple(states)
