@@ -1,0 +1,103 @@
+"""Headline benchmark: BERT pretraining throughput on one chip.
+
+Mirrors the BASELINE.json north-star workload (GluonNLP
+scripts/bert/run_pretraining.py): full pretraining step — embeddings, encoder
+on flash attention, MLM+NSP heads, loss, grads, AdamW — compiled to one XLA
+executable, bf16 activations/params with fp32 master weights.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": tokens/sec/chip, "unit": ..., "vs_baseline": MFU/0.40}
+
+Env knobs: MXTPU_BENCH_MODEL (bert_12_768_12|bert_24_1024_16),
+MXTPU_BENCH_BATCH, MXTPU_BENCH_SEQ, MXTPU_PEAK_TFLOPS (per-chip bf16 peak,
+default 459 = TPU v5p).
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as onp
+
+
+def _peak_tflops() -> float:
+    """Per-chip bf16 peak for MFU accounting, by device kind (public specs);
+    override with MXTPU_PEAK_TFLOPS."""
+    env = os.environ.get("MXTPU_PEAK_TFLOPS")
+    if env:
+        return float(env)
+    import jax
+    kind = jax.devices()[0].device_kind.lower()
+    table = {"v5 lite": 197.0, "v5e": 197.0, "v5p": 459.0, "v5": 459.0,
+             "v4": 275.0, "v3": 123.0, "v6e": 918.0, "v6 lite": 918.0,
+             "trillium": 918.0}
+    for key, val in table.items():
+        if key in kind:
+            return val
+    return 459.0
+
+
+def main() -> None:
+    import jax
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import models, parallel
+
+    model_name = os.environ.get("MXTPU_BENCH_MODEL", "bert_12_768_12")
+    B = int(os.environ.get("MXTPU_BENCH_BATCH", "8"))
+    L = int(os.environ.get("MXTPU_BENCH_SEQ", "512"))
+    peak_tflops = _peak_tflops()
+    steps = int(os.environ.get("MXTPU_BENCH_STEPS", "20"))
+    vocab, P = 30522, 76  # 76 ≈ 0.15 * 512 masked positions
+
+    cfg = models.bert.BERT_CONFIGS[model_name]
+    net = models.get_bert(model_name, vocab_size=vocab, max_length=L,
+                          dropout=0.1, dtype="bfloat16")
+    net.initialize()
+    mesh = parallel.make_mesh(devices=jax.devices()[:1])
+    trainer = parallel.ShardedTrainer(
+        net, models.bert_pretrain_loss, "adamw",
+        {"learning_rate": 1e-4, "multi_precision": True}, mesh=mesh,
+        rules=models.bert_sharding_rules(), n_labels=3)
+
+    rng = onp.random.RandomState(0)
+    ids = rng.randint(0, vocab, (B, L)).astype("int32")
+    tt = rng.randint(0, 2, (B, L)).astype("int32")
+    vl = onp.full((B,), L, "float32")
+    pos = rng.randint(0, L, (B, P)).astype("int32")
+    mlm_lab = rng.randint(0, vocab, (B, P)).astype("float32")
+    mlm_w = onp.ones((B, P), "float32")
+    nsp = rng.randint(0, 2, (B,)).astype("float32")
+    batch = (ids, tt, vl, pos, mlm_lab, mlm_w, nsp)
+
+    trainer.step(*batch).asnumpy()  # init + compile
+    trainer.step(*batch).asnumpy()  # warm
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = trainer.step(*batch)
+    loss.asnumpy()
+    dt = (time.perf_counter() - t0) / steps
+
+    tokens_per_sec = B * L / dt
+    # Transformer pretraining FLOPs: 6 * n_params * n_tokens for the
+    # matmul-dominated path + attention term 12 * layers * units * L² * B
+    # (fwd+bwd), the standard PaLM-appendix accounting.
+    n_params = sum(int(onp.prod(p.shape))
+                   for _, p in net.collect_params().items())
+    flops = 6 * n_params * B * L + 12 * cfg["num_layers"] * cfg["units"] * L * L * B
+    mfu = (flops / dt) / (peak_tflops * 1e12)
+    result = {
+        "metric": f"{model_name}_pretrain_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(mfu / 0.40, 4),
+        "extra": {"step_ms": round(dt * 1e3, 2), "mfu": round(mfu, 4),
+                  "batch": B, "seq": L, "params": n_params,
+                  "backend": jax.default_backend(),
+                  "loss": float(loss.asnumpy())},
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

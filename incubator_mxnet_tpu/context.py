@@ -167,6 +167,17 @@ def current_context() -> Context:
     return cpu(0)
 
 
+def context_for_device(device) -> Context:
+    """Context addressing a concrete jax.Device (e.g. a mesh's first device)."""
+    dev_type = "cpu" if device.platform == "cpu" else "tpu"
+    peers = _devices_for(dev_type)
+    try:
+        idx = peers.index(device)
+    except ValueError:
+        idx = 0
+    return Context(dev_type, idx)
+
+
 def num_gpus() -> int:
     """Number of accelerator devices visible (alias surface)."""
     return num_tpus()

@@ -491,7 +491,10 @@ class HybridBlock(Block):
         training = autograd.is_training()
         key_val = random_mod.next_key(ctx)
         n_in = len(arr_args)
-        cache_key = (training, _static_key(flat_args))
+        # Key must cover the arg *structure* (array count/nesting), not just
+        # static leaf values — otherwise a call with a different number of
+        # arrays would reuse a jit fn with a stale n_in/skeleton.
+        cache_key = (training, n_in, repr(fmt), _static_key(flat_args))
 
         if cache_key not in self._jit_cache:
             info = {"out_fmt": None, "effects": []}

@@ -1,0 +1,16 @@
+"""Model zoo — the in-framework counterpart of the GluonCV/GluonNLP workloads
+named in BASELINE.json (SURVEY §2.9): BERT pretraining, Transformer NMT,
+image classification (LeNet/ResNet...), detection (SSD).
+
+All models are HybridBlocks: eager for debugging, one ``hybridize()`` away
+from a single XLA computation, and shardable over the parallel mesh with the
+per-family ``*_sharding_rules()`` helpers.
+"""
+from . import transformer  # noqa: F401
+from . import bert  # noqa: F401
+from .transformer import (  # noqa: F401
+    MultiHeadAttention, PositionwiseFFN, TransformerEncoderCell,
+)
+from .bert import (  # noqa: F401
+    BERTModel, BERTEncoder, bert_sharding_rules, get_bert, bert_pretrain_loss,
+)

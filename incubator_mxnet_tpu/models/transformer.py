@@ -1,0 +1,133 @@
+"""Transformer building blocks shared by BERT and the NMT Transformer.
+
+Reference parity: GluonNLP's ``BERTEncoder``/``TransformerEncoderCell``
+(gluonnlp/model/bert.py, transformer.py), whose hot path is the contrib
+interleaved-MHA ops (``src/operator/contrib/transformer.cc`` — SURVEY §2.4).
+
+TPU-native design: one fused QKV projection (a single MXU matmul over the
+batch·seq rows) followed by :func:`~incubator_mxnet_tpu.ops.attention.
+dot_product_attention` — which lowers to the Pallas flash kernel on TPU. The
+reference's (B·H, L, L) score tensor never exists in HBM.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..gluon.block import HybridBlock
+from ..gluon import nn
+
+__all__ = ["MultiHeadAttention", "PositionwiseFFN", "TransformerEncoderCell"]
+
+
+class MultiHeadAttention(HybridBlock):
+    """Multi-head attention with fused QKV projection.
+
+    ``__call__(query, kv, mask)`` — pass ``kv=None`` (or ``query``) for
+    self-attention (one fused qkv matmul); a different ``kv`` gives
+    cross-attention (q proj + fused kv proj, the encdec layout of the
+    reference's ``interleaved_matmul_encdec_*`` ops).
+
+    ``mask`` is broadcastable to (B, H, Lq, Lk), 1 = attend; ``None`` = full.
+    """
+
+    def __init__(self, units: int, num_heads: int, dropout: float = 0.0,
+                 causal: bool = False, use_bias: bool = True, dtype="float32",
+                 weight_initializer=None, **kwargs):
+        super().__init__(**kwargs)
+        if units % num_heads:
+            raise ValueError(f"units {units} not divisible by heads {num_heads}")
+        self._units = units
+        self._num_heads = num_heads
+        self._causal = causal
+        with self.name_scope():
+            self.qkv = nn.Dense(3 * units, flatten=False, use_bias=use_bias,
+                                in_units=units, dtype=dtype, prefix="qkv_",
+                                weight_initializer=weight_initializer)
+            self.q_proj = nn.Dense(units, flatten=False, use_bias=use_bias,
+                                   in_units=units, dtype=dtype, prefix="query_",
+                                   weight_initializer=weight_initializer)
+            self.kv_proj = nn.Dense(2 * units, flatten=False, use_bias=use_bias,
+                                    in_units=units, dtype=dtype, prefix="kv_",
+                                    weight_initializer=weight_initializer)
+            self.proj = nn.Dense(units, flatten=False, use_bias=use_bias,
+                                 in_units=units, dtype=dtype, prefix="proj_",
+                                 weight_initializer=weight_initializer)
+            self.dropout = nn.Dropout(dropout) if dropout else None
+
+    def _heads(self, F, x, n):
+        # (B, L, n*C) -> n tensors of (B, H, L, d)
+        B, L = x.shape[0], x.shape[1]
+        H, d = self._num_heads, self._units // self._num_heads
+        parts = F.split(x, num_outputs=n, axis=2) if n > 1 else [x]
+        outs = []
+        for p in parts:
+            outs.append(F.transpose(F.reshape(p, (B, L, H, d)), axes=(0, 2, 1, 3)))
+        return outs
+
+    def hybrid_forward(self, F, query, kv=None, mask=None):
+        B, Lq = query.shape[0], query.shape[1]
+        if kv is None or kv is query:
+            q, k, v = self._heads(F, self.qkv(query), 3)
+        else:
+            q, = self._heads(F, self.q_proj(query), 1)
+            k, v = self._heads(F, self.kv_proj(kv), 2)
+        if mask is not None:
+            out = F.dot_product_attention(q, k, v, mask, causal=self._causal)
+        else:
+            out = F.dot_product_attention(q, k, v, causal=self._causal)
+        # (B, H, Lq, d) -> (B, Lq, C)
+        out = F.reshape(F.transpose(out, axes=(0, 2, 1, 3)), (B, Lq, self._units))
+        out = self.proj(out)
+        if self.dropout is not None:
+            out = self.dropout(out)
+        return out
+
+
+class PositionwiseFFN(HybridBlock):
+    """The transformer MLP: dense(hidden) -> act -> dense(units) -> dropout."""
+
+    def __init__(self, units: int, hidden_size: int, dropout: float = 0.0,
+                 activation: str = "gelu", dtype="float32",
+                 weight_initializer=None, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.ffn1 = nn.Dense(hidden_size, flatten=False, in_units=units,
+                                 activation=activation, dtype=dtype,
+                                 prefix="ffn1_",
+                                 weight_initializer=weight_initializer)
+            self.ffn2 = nn.Dense(units, flatten=False, in_units=hidden_size,
+                                 dtype=dtype, prefix="ffn2_",
+                                 weight_initializer=weight_initializer)
+            self.dropout = nn.Dropout(dropout) if dropout else None
+
+    def hybrid_forward(self, F, x):
+        out = self.ffn2(self.ffn1(x))
+        if self.dropout is not None:
+            out = self.dropout(out)
+        return out
+
+
+class TransformerEncoderCell(HybridBlock):
+    """Post-LN transformer encoder layer (BERT layout):
+    ``x = LN(x + MHA(x)); x = LN(x + FFN(x))``."""
+
+    def __init__(self, units: int, hidden_size: int, num_heads: int,
+                 dropout: float = 0.0, layer_norm_eps: float = 1e-12,
+                 activation: str = "gelu", dtype="float32",
+                 weight_initializer=None, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.attention = MultiHeadAttention(
+                units, num_heads, dropout=dropout, dtype=dtype,
+                prefix="attn_", weight_initializer=weight_initializer)
+            self.ln1 = nn.LayerNorm(epsilon=layer_norm_eps, prefix="ln1_")
+            self.ffn = PositionwiseFFN(
+                units, hidden_size, dropout=dropout, activation=activation,
+                dtype=dtype, prefix="ffn_",
+                weight_initializer=weight_initializer)
+            self.ln2 = nn.LayerNorm(epsilon=layer_norm_eps, prefix="ln2_")
+
+    def hybrid_forward(self, F, x, mask=None):
+        x = self.ln1(x + self.attention(x, None, mask))
+        x = self.ln2(x + self.ffn(x))
+        return x

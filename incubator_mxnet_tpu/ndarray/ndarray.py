@@ -54,8 +54,14 @@ class NDArray:
             data = data._data
         if ctx is None:
             ctx = current_context()
-        if not isinstance(data, jax.Array) or dtype is not None:
-            data = jnp.asarray(data, dtype=dtype)
+        if not isinstance(data, jax.Array):
+            # Host data: one hop straight onto the context's device (going
+            # through jnp.asarray would land on the *default* backend first
+            # and bounce — a sync round-trip when ctx is not the default).
+            npdt = jnp.dtype(dtype) if dtype is not None else None
+            data = jax.device_put(onp.asarray(data, dtype=npdt), ctx.jax_device)
+        elif dtype is not None and data.dtype != jnp.dtype(dtype):
+            data = data.astype(dtype)
         if isinstance(data, jax.core.Tracer):
             # Inside a jit trace (HybridBlock cached op): no device commit —
             # placement is the compiled executable's concern.

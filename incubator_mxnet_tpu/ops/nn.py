@@ -255,6 +255,13 @@ _ACTS = {
     "tanh": jnp.tanh,
     "softrelu": jax.nn.softplus,
     "softsign": jax.nn.soft_sign,
+    # extended set (Gluon Activation accepts these in the TPU build; the
+    # reference routes them through LeakyReLU/contrib ops instead)
+    "gelu": lambda x: jax.nn.gelu(x, approximate=False),
+    "gelu_tanh": lambda x: jax.nn.gelu(x, approximate=True),
+    "silu": jax.nn.silu,
+    "swish": jax.nn.silu,
+    "mish": lambda x: x * jnp.tanh(jax.nn.softplus(x)),
 }
 
 

@@ -1,0 +1,8 @@
+"""Pallas TPU kernels — the counterpart of the reference's hand-written CUDA
+fast paths (``src/operator/contrib/*.cu``, ``src/operator/fusion/``).
+
+Only ops where XLA's automatic fusion leaves profit on the table get a kernel
+here (flash attention first); everything else stays jax.numpy/lax and lets
+XLA tile onto the MXU.
+"""
+from . import flash_attention  # noqa: F401

@@ -16,9 +16,21 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec
 try:
-    from jax import shard_map
+    from jax import shard_map as _raw_shard_map
 except ImportError:  # older jax
-    from jax.experimental.shard_map import shard_map
+    from jax.experimental.shard_map import shard_map as _raw_shard_map
+
+
+def shard_map(f, mesh, in_specs, out_specs):
+    """shard_map with replication checking off, across jax versions (the
+    kwarg was renamed check_rep → check_vma)."""
+    try:
+        return _raw_shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_vma=False)
+    except TypeError:
+        return _raw_shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=False)
+
 
 P = PartitionSpec
 
@@ -83,8 +95,7 @@ def _reduce_fn(mesh: Mesh, axis: str, op: str, spec: PartitionSpec):
     fn = _REDUCE_CACHE.get(key)
     if fn is None:
         fn = jax.jit(shard_map(lambda v: all_reduce(v, axis, op), mesh=mesh,
-                               in_specs=(spec,), out_specs=spec,
-                               check_vma=False))
+                               in_specs=(spec,), out_specs=spec))
         _REDUCE_CACHE[key] = fn
     return fn
 

@@ -20,10 +20,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
-try:
-    from jax import shard_map
-except ImportError:  # older jax
-    from jax.experimental.shard_map import shard_map
+from .collectives import shard_map
 
 P = PartitionSpec
 
@@ -92,7 +89,6 @@ def ring_attention_sharded(mesh: Mesh, q, k, v, causal: bool = False,
     spec = P(bspec, None, axis, None)
     fn = shard_map(
         partial(ring_attention, axis=axis, causal=causal, scale=scale),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False)
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     args = tuple(jax.device_put(x, NamedSharding(mesh, spec)) for x in (q, k, v))
     return jax.jit(fn)(*args)

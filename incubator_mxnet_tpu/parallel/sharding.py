@@ -61,6 +61,8 @@ class ShardingRules:
 
 
 def _divisible(shape, spec, mesh) -> bool:
+    if len(tuple(spec)) > len(shape):
+        return False  # rank mismatch: rule written for a higher-rank param
     for dim, axes in zip(shape, tuple(spec)):
         if axes is None:
             continue
@@ -86,9 +88,10 @@ def data_sharding(mesh: Mesh, batch_axis: int = 0, seq_axis: Optional[int] = Non
     """Input-batch sharding: batch dim over ``dp``, sequence dim over ``sp``
     when those mesh axes have size > 1."""
     spec: List = [None] * ndim
-    if mesh.shape.get("dp", 1) > 1:
+    if batch_axis < ndim and mesh.shape.get("dp", 1) > 1:
         spec[batch_axis] = "dp"
-    if seq_axis is not None and mesh.shape.get("sp", 1) > 1:
+    # rank-1 labels etc. simply don't have a sequence dim to shard
+    if seq_axis is not None and seq_axis < ndim and mesh.shape.get("sp", 1) > 1:
         spec[seq_axis] = "sp"
     return NamedSharding(mesh, P(*spec))
 

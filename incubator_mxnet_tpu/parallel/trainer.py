@@ -25,6 +25,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as onp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ..base import MXNetError
@@ -74,7 +75,11 @@ class ShardedTrainer:
         self._step_fn = None
         self._info: Dict[str, Any] = {}
         self._t = 0
-        self._ctx = current_context()
+        # Work in the mesh's device context: wrapping step outputs/batches in
+        # the *default* (cpu) Context would force sync device→host round
+        # trips every step (critical over a tunneled TPU).
+        from ..context import context_for_device
+        self._ctx = context_for_device(self._mesh.devices.flat[0])
 
     # ------------------------------------------------------------------
     @property
@@ -85,9 +90,10 @@ class ShardedTrainer:
     def num_update(self) -> int:
         return self._t
 
-    def _init_state(self, data_args: Sequence[NDArray]) -> None:
-        """Warm up the block eagerly (finishes deferred init), then shard
-        every parameter and optimizer state onto the mesh by rule."""
+    def _init_state(self, data_args: Sequence[NDArray], warm_ctx) -> None:
+        """Warm up the block eagerly (finishes deferred init) in the context
+        the parameters live on, then shard every parameter and optimizer
+        state onto the mesh by rule."""
         blk = self._block
         with autograd.pause(train_mode=True):
             _TRACING.flag = True
@@ -106,11 +112,11 @@ class ShardedTrainer:
         # would otherwise delete the gluon Parameter's live data.
         vals, states = [], []
         for i, (name, p) in enumerate(items):
-            v = p.data(self._ctx)._data
+            v = p.data(warm_ctx)._data
             sh = self._rules.sharding_for(name, self._mesh, tuple(v.shape))
             vals.append(jax.device_put(jnp.copy(v), sh))
             placed = []
-            for s in opt.create_state_multi_precision(i, p.data(self._ctx)):
+            for s in opt.create_state_multi_precision(i, p.data(warm_ctx)):
                 spec = (self._rules.spec_for(name, tuple(v.shape), self._mesh)
                         if tuple(s.shape) == tuple(v.shape) else P())
                 placed.append(jax.device_put(
@@ -120,7 +126,7 @@ class ShardedTrainer:
         self._opt_states = tuple(states)
 
     # ------------------------------------------------------------------
-    def _build_step(self, n_data: int, arg_struct) -> Callable:
+    def _build_step(self, n_data: int) -> Callable:
         blk, params, opt = self._block, self._params, self._optimizer
         loss_fn, ctx, info = self._loss_fn, self._ctx, self._info
         lr_mults = [opt._get_lr(i) / max(opt.learning_rate, 1e-30)
@@ -183,18 +189,28 @@ class ShardedTrainer:
         n_data = len(batch) - self._n_labels
         if n_data < 1:
             raise MXNetError("step() needs at least one data argument")
-        arrs = [a if isinstance(a, NDArray) else NDArray(jnp.asarray(a), ctx=self._ctx)
-                for a in batch]
         if self._params is None:
-            self._init_state(arrs[:n_data])
+            # Eager warmup runs wherever the parameters were initialized
+            # (current context), NOT on the mesh.
+            warm_ctx = current_context()
+            warm = [a if isinstance(a, NDArray) else NDArray(a, ctx=warm_ctx)
+                    for a in batch[:n_data]]
+            self._init_state(warm, warm_ctx)
         vals = []
-        for a in arrs:
-            v = a._data
+        for a in batch:
+            # One hop host→mesh (or on-device reshard); never through an
+            # NDArray wrap, which would commit to the default context first.
+            if isinstance(a, NDArray):
+                v = a._data
+            elif isinstance(a, jax.Array):
+                v = a
+            else:
+                v = onp.asarray(a)
             sh = data_sharding(self._mesh, batch_axis=0,
                                seq_axis=self._seq_axis, ndim=v.ndim)
             vals.append(jax.device_put(v, sh))
         if self._step_fn is None:
-            self._step_fn = self._build_step(n_data, None)
+            self._step_fn = self._build_step(n_data)
         self._t += 1
         lr = jnp.asarray(self._optimizer.learning_rate, jnp.float32)
         t = jnp.asarray(self._t, jnp.int32)

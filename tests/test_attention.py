@@ -1,0 +1,126 @@
+"""Fused attention ops: interleaved contrib parity + flash kernel vs XLA
+(reference test model: tests/python/unittest/test_operator.py attention
+cases + check_consistency, SURVEY §4)."""
+import jax
+import jax.numpy as jnp
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu.ops.attention import (
+    dot_product_attention, interleaved_matmul_selfatt_qk,
+    interleaved_matmul_selfatt_valatt, interleaved_matmul_encdec_qk,
+    interleaved_matmul_encdec_valatt)
+from incubator_mxnet_tpu.ops.pallas.flash_attention import flash_attention
+
+
+def _dense_ref(q, k, v, mask=None, causal=False):
+    D = q.shape[-1]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (D ** -0.5)
+    if mask is not None:
+        s = jnp.where(mask.astype(bool), s, -1e30)
+    if causal:
+        lq, lk = s.shape[-2], s.shape[-1]
+        s = jnp.where(jnp.tril(jnp.ones((lq, lk), bool), lk - lq), s, -1e30)
+    return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
+
+
+def test_dot_product_attention_xla_matches_dense():
+    rng = onp.random.RandomState(0)
+    B, H, L, D = 2, 3, 17, 8          # odd L: must work on the XLA path
+    q, k, v = (jnp.asarray(rng.randn(B, H, L, D), jnp.float32) for _ in range(3))
+    vl = rng.randint(3, L, (B,))
+    mask = jnp.asarray((onp.arange(L)[None, :] < vl[:, None]
+                        ).astype("float32")[:, None, None, :])
+    for causal in (False, True):
+        out = dot_product_attention(q, k, v, mask, causal=causal, impl="xla")
+        ref = _dense_ref(q, k, v, mask, causal)
+        onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref), atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("masked", [False, True])
+def test_flash_kernel_matches_xla(causal, masked):
+    """Pallas kernel (interpret mode on CPU) == XLA path, fwd + grads."""
+    rng = onp.random.RandomState(1)
+    B, H, L, D = 2, 2, 256, 64
+    q, k, v = (jnp.asarray(rng.randn(B, H, L, D), jnp.float32) for _ in range(3))
+    mask = None
+    if masked:
+        vl = rng.randint(64, L, (B,))
+        mask = jnp.asarray((onp.arange(L)[None, :] < vl[:, None]
+                            ).astype("float32")[:, None, None, :])
+    out = flash_attention(q, k, v, mask=mask, causal=causal)
+    ref = dot_product_attention(q, k, v, mask, causal=causal, impl="xla") \
+        if masked else dot_product_attention(q, k, v, causal=causal, impl="xla")
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref), atol=2e-5)
+
+    def loss_flash(q, k, v):
+        return jnp.mean(flash_attention(q, k, v, mask=mask, causal=causal) ** 2)
+
+    def loss_xla(q, k, v):
+        if masked:
+            return jnp.mean(dot_product_attention(q, k, v, mask, causal=causal,
+                                                  impl="xla") ** 2)
+        return jnp.mean(dot_product_attention(q, k, v, causal=causal,
+                                              impl="xla") ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_xla, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gr, gf):
+        onp.testing.assert_allclose(onp.asarray(b), onp.asarray(a),
+                                    atol=1e-6, rtol=1e-3)
+
+
+def test_interleaved_selfatt_ops_match_dense():
+    """Reference-layout contract: (L, B, H*3*D) interleaved qkv, scores
+    (B*H, L, L) with q pre-scaled (src/operator/contrib/transformer.cc)."""
+    rng = onp.random.RandomState(2)
+    L, B, H, D = 12, 3, 4, 8
+    qkv = jnp.asarray(rng.randn(L, B, H * 3 * D), jnp.float32)
+    scores = interleaved_matmul_selfatt_qk(qkv, heads=H)
+    assert scores.shape == (B * H, L, L)
+    att = jax.nn.softmax(scores, -1)
+    out = interleaved_matmul_selfatt_valatt(qkv, att, heads=H)
+    assert out.shape == (L, B, H * D)
+
+    x = onp.asarray(qkv).reshape(L, B, H, 3, D)
+    q = jnp.asarray(x[:, :, :, 0].transpose(1, 2, 0, 3))
+    k = jnp.asarray(x[:, :, :, 1].transpose(1, 2, 0, 3))
+    v = jnp.asarray(x[:, :, :, 2].transpose(1, 2, 0, 3))
+    ref = _dense_ref(q, k, v)
+    ref_out = onp.asarray(ref).transpose(2, 0, 1, 3).reshape(L, B, H * D)
+    onp.testing.assert_allclose(onp.asarray(out), ref_out, atol=2e-5)
+
+
+def test_interleaved_encdec_ops_match_dense():
+    rng = onp.random.RandomState(3)
+    Lq, Lk, B, H, D = 7, 11, 2, 2, 8
+    qs = jnp.asarray(rng.randn(Lq, B, H * D), jnp.float32)
+    kv = jnp.asarray(rng.randn(Lk, B, H * 2 * D), jnp.float32)
+    scores = interleaved_matmul_encdec_qk(qs, kv, heads=H)
+    assert scores.shape == (B * H, Lq, Lk)
+    att = jax.nn.softmax(scores, -1)
+    out = interleaved_matmul_encdec_valatt(kv, att, heads=H)
+    assert out.shape == (Lq, B, H * D)
+
+    q = jnp.asarray(onp.asarray(qs).reshape(Lq, B, H, D).transpose(1, 2, 0, 3))
+    x = onp.asarray(kv).reshape(Lk, B, H, 2, D)
+    k = jnp.asarray(x[:, :, :, 0].transpose(1, 2, 0, 3))
+    v = jnp.asarray(x[:, :, :, 1].transpose(1, 2, 0, 3))
+    ref = _dense_ref(q, k, v)
+    ref_out = onp.asarray(ref).transpose(2, 0, 1, 3).reshape(Lq, B, H * D)
+    onp.testing.assert_allclose(onp.asarray(out), ref_out, atol=2e-5)
+
+
+def test_nd_contrib_aliases_exposed():
+    """The reference op names are callable from mx.nd (mx.nd.contrib parity)."""
+    rng = onp.random.RandomState(4)
+    qkv = mx.nd.array(rng.randn(6, 2, 2 * 3 * 4).astype("float32"))
+    s = mx.nd._contrib_interleaved_matmul_selfatt_qk(qkv, heads=2)
+    assert s.shape == (4, 6, 6)
+    out = mx.nd.dot_product_attention(
+        mx.nd.array(rng.randn(1, 2, 8, 4).astype("float32")),
+        mx.nd.array(rng.randn(1, 2, 8, 4).astype("float32")),
+        mx.nd.array(rng.randn(1, 2, 8, 4).astype("float32")))
+    assert out.shape == (1, 2, 8, 4)

@@ -1,0 +1,126 @@
+"""Model-zoo tests: BERT family (flagship) — forward contract, hybridize
+consistency, autograd training, SPMD sharded pretraining step.
+
+Reference model: GluonNLP test_models.py BERT cases + the convergence-smoke
+pattern of tests/python/train/ (SURVEY §4 mechanism 6)."""
+import jax
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon, models, parallel
+
+
+def _batch(rng, B, L, P, vocab):
+    return (mx.nd.array(rng.randint(0, vocab, (B, L)), dtype="int32"),
+            mx.nd.array(rng.randint(0, 2, (B, L)), dtype="int32"),
+            mx.nd.array(rng.randint(L // 2, L, (B,)), dtype="float32"),
+            mx.nd.array(rng.randint(0, L, (B, P)), dtype="int32"))
+
+
+def test_bert_forward_contract():
+    net = models.get_bert("bert_2_128_2", vocab_size=500, max_length=64,
+                          dropout=0.0)
+    net.initialize()
+    B, L, P = 2, 16, 3
+    ids, tt, vl, pos = _batch(onp.random.RandomState(0), B, L, P, 500)
+    seq, pooled, nsp, mlm = net(ids, tt, vl, pos)
+    assert seq.shape == (B, L, 128)
+    assert pooled.shape == (B, 128)
+    assert nsp.shape == (B, 2)
+    assert mlm.shape == (B, P, 500)
+    # no masked positions -> 3 outputs
+    seq2, pooled2, nsp2 = net(ids, tt, vl)
+    assert nsp2.shape == (B, 2)
+
+
+def test_bert_hybridize_matches_eager():
+    net = models.get_bert("bert_2_128_2", vocab_size=300, max_length=32,
+                          dropout=0.0)
+    net.initialize()
+    ids, tt, vl, pos = _batch(onp.random.RandomState(1), 2, 16, 3, 300)
+    with mx.autograd.predict_mode():
+        eager = net(ids, tt, vl, pos)
+        net.hybridize()
+        net(ids, tt, vl, pos)          # build cache
+        jit = net(ids, tt, vl, pos)
+    for a, b in zip(eager, jit):
+        onp.testing.assert_allclose(a.asnumpy(), b.asnumpy(), atol=2e-5)
+
+
+def test_bert_tied_decoder_embedding():
+    """MLM output projection shares the word-embedding weight."""
+    net = models.get_bert("bert_2_128_2", vocab_size=100, max_length=16,
+                          dropout=0.0)
+    net.initialize()
+    names = [n for n, _ in net.collect_params().items()]
+    assert len([n for n in names if n.endswith("word_embed_weight")]) == 1
+    assert net.decoder_tied_weight is net.word_embed.weight
+
+
+def test_bert_sharded_pretrain_step_loss_decreases():
+    mesh = parallel.make_mesh(dp=2, tp=2, sp=2)
+    net = models.get_bert("bert_2_128_2", vocab_size=400, max_length=32,
+                          dropout=0.1)
+    net.initialize()
+    tr = parallel.ShardedTrainer(net, models.bert_pretrain_loss, "adamw",
+                                 {"learning_rate": 3e-3}, mesh=mesh,
+                                 rules=models.bert_sharding_rules(),
+                                 n_labels=3)
+    rng = onp.random.RandomState(0)
+    B, L, P = 8, 32, 4
+    ids = rng.randint(0, 400, (B, L)).astype("int32")
+    tt = rng.randint(0, 2, (B, L)).astype("int32")
+    vl = onp.full((B,), L, "float32")
+    pos = rng.randint(0, L, (B, P)).astype("int32")
+    mlm_lab = rng.randint(0, 400, (B, P)).astype("float32")
+    mlm_w = onp.ones((B, P), "float32")
+    nsp = rng.randint(0, 2, (B,)).astype("float32")
+    losses = [float(tr.step(ids, tt, vl, pos, mlm_lab, mlm_w, nsp).asnumpy())
+              for _ in range(12)]
+    assert losses[-1] < losses[0]
+    assert all(onp.isfinite(losses))
+
+
+def test_bert_single_device_autograd_step():
+    """Plain gluon Trainer path (kvstore-style step) trains the same model."""
+    net = models.get_bert("bert_2_128_2", vocab_size=200, max_length=16,
+                          dropout=0.0)
+    net.initialize()
+    loss_fn = models.bert_pretrain_loss
+    rng = onp.random.RandomState(2)
+    B, L, P = 4, 16, 3
+    ids, tt, vl, pos = _batch(rng, B, L, P, 200)
+    mlm_lab = mx.nd.array(rng.randint(0, 200, (B, P)), dtype="float32")
+    mlm_w = mx.nd.array(onp.ones((B, P)), dtype="float32")
+    nsp = mx.nd.array(rng.randint(0, 2, (B,)), dtype="float32")
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-3})
+    first = None
+    for i in range(4):
+        with mx.autograd.record():
+            out = net(ids, tt, vl, pos)
+            loss = loss_fn(out, mlm_lab, mlm_w, nsp)
+        loss.backward()
+        trainer.step(1)
+        val = float(loss.asnumpy())
+        first = val if first is None else first
+    assert val < first
+
+
+def test_bert_save_load_roundtrip(tmp_path):
+    net = models.get_bert("bert_2_128_2", vocab_size=150, max_length=16,
+                          dropout=0.0)
+    net.initialize()
+    ids, tt, vl, pos = _batch(onp.random.RandomState(3), 2, 8, 2, 150)
+    with mx.autograd.predict_mode():
+        ref = net(ids, tt, vl, pos)
+    f = str(tmp_path / "bert.params")
+    net.save_parameters(f)
+    net2 = models.get_bert("bert_2_128_2", vocab_size=150, max_length=16,
+                           dropout=0.0)
+    net2.load_parameters(f)
+    with mx.autograd.predict_mode():
+        out = net2(ids, tt, vl, pos)
+    for a, b in zip(ref, out):
+        onp.testing.assert_allclose(a.asnumpy(), b.asnumpy(), atol=1e-6)
