@@ -48,7 +48,8 @@ def main() -> None:
     L = int(os.environ.get("MXTPU_BENCH_SEQ", "512"))
     peak_tflops = _peak_tflops()
     steps = int(os.environ.get("MXTPU_BENCH_STEPS", "20"))
-    vocab, P = 30522, 76  # 76 ≈ 0.15 * 512 masked positions
+    vocab = 30522
+    P = max(1, round(0.15 * L))  # BERT's 15% masking rate
 
     cfg = models.bert.BERT_CONFIGS[model_name]
     net = models.get_bert(model_name, vocab_size=vocab, max_length=L,

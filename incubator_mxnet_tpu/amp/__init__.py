@@ -1,0 +1,169 @@
+"""AMP — automatic mixed precision.
+
+Reference parity (SURVEY §2.7): ``python/mxnet/amp/amp.py`` — op allow/deny
+lists, ``amp.init()`` patching the op namespace with casts, dynamic
+``LossScaler``, ``multi_precision`` optimizers, ``convert_hybrid_block``.
+
+TPU-native design: the target dtype is **bfloat16** (MXU-native; same
+exponent range as fp32, so the fp16 loss-scaling machinery is unnecessary —
+it is kept for API parity and used only when someone forces float16).
+``init()`` wraps the matmul/conv-class ops in ``mx.nd`` so their float32
+array inputs are cast down (the reference's FP16_FUNCS list); reductions,
+norms, softmax and losses stay fp32 (FP32_FUNCS). Under ``hybridize()`` the
+casts trace into the jitted graph, giving XLA the bf16 MXU lowering.
+"""
+from __future__ import annotations
+
+import warnings
+from typing import List, Optional
+
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from .. import ndarray as nd_mod
+from ..ndarray import NDArray
+from . import lists
+
+__all__ = ["init", "init_trainer", "scale_loss", "unscale",
+           "convert_hybrid_block", "LossScaler", "lists"]
+
+_STATE = {"initialized": False, "dtype": None, "patched": {}}
+
+
+def _cast_wrapper(fn, target_dtype):
+    def wrapped(*args, **kwargs):
+        cast_args = []
+        for a in args:
+            if isinstance(a, NDArray) and a.dtype == jnp.float32:
+                cast_args.append(a.astype(target_dtype))
+            else:
+                cast_args.append(a)
+        return fn(*cast_args, **kwargs)
+    wrapped.__name__ = getattr(fn, "__name__", "amp_op")
+    wrapped._amp_wrapped = fn
+    return wrapped
+
+
+def init(target_dtype: str = "bfloat16", target_precision_ops: Optional[List[str]] = None,
+         conditional_fp32_ops=None, fp32_ops: Optional[List[str]] = None) -> None:
+    """Patch the imperative op namespace for mixed precision
+    (reference: amp.init — graph-pass based there, namespace-patch here)."""
+    if _STATE["initialized"]:
+        return
+    dtype = jnp.dtype(target_dtype)
+    if dtype not in (jnp.dtype(jnp.bfloat16), jnp.dtype(jnp.float16)):
+        raise MXNetError("AMP target must be bfloat16 or float16")
+    if dtype == jnp.dtype(jnp.float16):
+        warnings.warn("float16 on TPU is emulated; bfloat16 is the native "
+                      "MXU dtype and needs no loss scaling.")
+    ops = list(target_precision_ops or lists.FP16_FP32_FUNCS)
+    skip = set(fp32_ops or lists.FP32_FUNCS)
+    for name in ops:
+        if name in skip:
+            continue
+        fn = getattr(nd_mod, name, None)
+        if fn is None:
+            continue
+        _STATE["patched"][name] = fn
+        setattr(nd_mod, name, _cast_wrapper(fn, dtype))
+    _STATE["initialized"] = True
+    _STATE["dtype"] = dtype
+
+
+def reset() -> None:
+    """Undo init() (test helper; the reference has no unpatch)."""
+    for name, fn in _STATE["patched"].items():
+        setattr(nd_mod, name, fn)
+    _STATE.update(initialized=False, dtype=None, patched={})
+
+
+class LossScaler:
+    """Dynamic loss scaling (reference: amp/loss_scaler.py). Needed for
+    fp16 only; bf16 keeps scale=1 forever."""
+
+    def __init__(self, init_scale: float = 2 ** 16, scale_factor: float = 2.0,
+                 scale_window: int = 2000):
+        self.loss_scale = init_scale
+        self._scale_factor = scale_factor
+        self._scale_window = scale_window
+        self._unskipped = 0
+
+    def has_overflow(self, params) -> bool:
+        import jax.numpy as jnp
+        for p in params:
+            g = getattr(p, "_grad", None)
+            if not g:
+                continue
+            for arr in g.values():
+                if not bool(jnp.isfinite(arr._data).all()):
+                    return True
+        return False
+
+    def update_scale(self, overflow: bool) -> None:
+        if overflow:
+            self.loss_scale = max(1.0, self.loss_scale / self._scale_factor)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped >= self._scale_window:
+                self.loss_scale *= self._scale_factor
+                self._unskipped = 0
+
+
+_SCALER = None
+
+
+def init_trainer(trainer) -> None:
+    """Attach dynamic loss scaling to a Trainer (fp16 path)."""
+    global _SCALER
+    if _STATE["dtype"] == jnp.dtype(jnp.float16):
+        _SCALER = LossScaler()
+    trainer._amp_loss_scaler = _SCALER
+
+
+class scale_loss:
+    """``with amp.scale_loss(loss, trainer) as scaled: scaled.backward()``"""
+
+    def __init__(self, loss, trainer):
+        self._loss = loss
+        self._trainer = trainer
+        self._scaler = getattr(trainer, "_amp_loss_scaler", None)
+
+    def __enter__(self):
+        if self._scaler is None:
+            return self._loss
+        s = self._scaler.loss_scale
+        if isinstance(self._loss, (list, tuple)):
+            return [l * s for l in self._loss]
+        return self._loss * s
+
+    def __exit__(self, *exc):
+        if self._scaler is not None:
+            overflow = self._scaler.has_overflow(self._trainer._params)
+            self._scaler.update_scale(overflow)
+            self._trainer._scale = (0.0 if overflow
+                                    else 1.0 / self._scaler.loss_scale)
+
+
+def unscale(trainer) -> None:
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        return
+    inv = 1.0 / scaler.loss_scale
+    for p in trainer._params:
+        if p._grad:
+            for g in p._grad.values():
+                g._set_data(g._data * inv)
+
+
+def convert_hybrid_block(block, target_dtype: str = "bfloat16", ctx=None):
+    """Cast a HybridBlock's parameters (reference: convert_hybrid_block
+    rewrites the symbol graph; XLA recompiles on the new dtype for free)."""
+    block.cast(target_dtype)
+    return block
+
+
+def convert_model(sym, arg_params, aux_params, target_dtype="bfloat16", **_):
+    """Symbol-API model conversion: cast the param dicts."""
+    cast = {k: v.astype(target_dtype) for k, v in arg_params.items()}
+    return sym, cast, dict(aux_params)

@@ -1,0 +1,42 @@
+"""AMP op lists (reference: python/mxnet/amp/lists/symbol_fp16.py).
+
+FP16_FP32_FUNCS: matmul/conv-class ops cast down to the target dtype (MXU).
+FP32_FUNCS: numerically sensitive ops pinned to float32.
+WIDEST_TYPE_CASTS: ops that follow their widest input (handled implicitly by
+jnp promotion; listed for parity/documentation).
+"""
+
+FP16_FP32_FUNCS = [
+    "FullyConnected",
+    "Convolution",
+    "Deconvolution",
+    "dot",
+    "batch_dot",
+    "linalg_gemm2",
+    "dot_product_attention",
+    "interleaved_matmul_selfatt_qk",
+    "interleaved_matmul_selfatt_valatt",
+    "interleaved_matmul_encdec_qk",
+    "interleaved_matmul_encdec_valatt",
+    "RNN",
+]
+
+FP32_FUNCS = [
+    "BatchNorm",
+    "LayerNorm",
+    "GroupNorm",
+    "InstanceNorm",
+    "L2Normalization",
+    "softmax",
+    "log_softmax",
+    "masked_softmax",
+    "SoftmaxOutput",
+    "softmax_cross_entropy",
+    "exp", "log", "log2", "log10", "log1p",
+    "sum", "mean", "prod", "norm", "logsumexp",
+    "Dropout",
+]
+
+WIDEST_TYPE_CASTS = [
+    "add_n", "concat", "stack", "where", "broadcast_add", "broadcast_mul",
+]

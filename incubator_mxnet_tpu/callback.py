@@ -1,0 +1,85 @@
+"""Training callbacks (reference: python/mxnet/callback.py — SURVEY §5.5).
+
+``Speedometer`` reports samples/sec; ``do_checkpoint`` saves per epoch;
+``LogValidationMetricsCallback`` logs eval metrics. Signature-compatible with
+Module.fit's epoch/batch callback slots.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import Optional
+
+__all__ = ["Speedometer", "do_checkpoint", "log_train_metric",
+           "LogValidationMetricsCallback", "module_checkpoint"]
+
+
+class Speedometer:
+    """Log throughput every ``frequent`` batches (reference parity)."""
+
+    def __init__(self, batch_size: int, frequent: int = 50,
+                 auto_reset: bool = True):
+        self.batch_size = batch_size
+        self.frequent = frequent
+        self.auto_reset = auto_reset
+        self.init = False
+        self.tic = 0.0
+        self.last_count = 0
+
+    def __call__(self, param) -> None:
+        count = param.nbatch
+        if self.last_count > count:
+            self.init = False
+        self.last_count = count
+        if self.init:
+            if count % self.frequent == 0:
+                speed = self.frequent * self.batch_size / (time.time() - self.tic)
+                if param.eval_metric is not None:
+                    name_value = param.eval_metric.get_name_value()
+                    if self.auto_reset:
+                        param.eval_metric.reset()
+                    msg = "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec\t%s" % (
+                        param.epoch, count, speed,
+                        "\t".join(f"{n}={v:.6f}" for n, v in name_value))
+                else:
+                    msg = "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec" % (
+                        param.epoch, count, speed)
+                logging.info(msg)
+                self.tic = time.time()
+        else:
+            self.init = True
+            self.tic = time.time()
+
+
+def do_checkpoint(prefix: str, period: int = 1):
+    """Epoch-end callback saving symbol+params (reference: do_checkpoint)."""
+    period = int(max(1, period))
+
+    def _callback(iter_no, sym=None, arg=None, aux=None):
+        if (iter_no + 1) % period == 0:
+            from . import model
+            model.save_checkpoint(prefix, iter_no + 1, sym, arg or {}, aux or {})
+    return _callback
+
+
+module_checkpoint = do_checkpoint
+
+
+def log_train_metric(period: int, auto_reset: bool = False):
+    def _callback(param):
+        if param.nbatch % period == 0 and param.eval_metric is not None:
+            name_value = param.eval_metric.get_name_value()
+            for name, value in name_value:
+                logging.info("Iter[%d] Batch[%d] Train-%s=%f",
+                             param.epoch, param.nbatch, name, value)
+            if auto_reset:
+                param.eval_metric.reset()
+    return _callback
+
+
+class LogValidationMetricsCallback:
+    def __call__(self, param):
+        if param.eval_metric is None:
+            return
+        for name, value in param.eval_metric.get_name_value():
+            logging.info("Epoch[%d] Validation-%s=%f", param.epoch, name, value)

@@ -1,0 +1,431 @@
+"""``mx.io`` — data iterators.
+
+Reference parity: ``include/mxnet/io.h`` (``IIterator<DataBatch>``) and
+``src/io/`` (SURVEY §2.6): ``NDArrayIter``, ``CSVIter``, ``MNISTIter``,
+``ImageRecordIter``, ``PrefetchingIter``, ``ResizeIter``, plus the
+``DataBatch``/``DataDesc`` records the Module API consumes.
+
+TPU-native design: iterators produce host-side batches (numpy-backed
+NDArrays); the device hop happens once per step inside the compiled path
+(``ShardedTrainer``/Trainer) — matching the reference's pinned-staging +
+priority-copy-thread overlap, which PjRt performs internally. The decode/
+augment pipeline of ``ImageRecordIter`` runs in a thread pool
+(``ThreadedIter`` parity).
+"""
+from __future__ import annotations
+
+import os
+import struct
+import threading
+import queue as _queue
+from collections import namedtuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as onp
+
+from ..base import MXNetError
+from ..ndarray import NDArray, array
+from .. import recordio as rec_mod
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
+           "MNISTIter", "ImageRecordIter", "PrefetchingIter", "ResizeIter"]
+
+
+class DataDesc(namedtuple("DataDesc", ["name", "shape", "dtype", "layout"])):
+    def __new__(cls, name, shape, dtype=onp.float32, layout="NCHW"):
+        return super().__new__(cls, name, tuple(shape), dtype, layout)
+
+    @staticmethod
+    def get_batch_axis(layout: Optional[str]) -> int:
+        return 0 if layout is None else layout.find("N")
+
+
+class DataBatch:
+    def __init__(self, data, label=None, pad: int = 0, index=None,
+                 provide_data=None, provide_label=None):
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+    def __repr__(self):
+        shapes = [getattr(d, "shape", None) for d in (self.data or [])]
+        return f"DataBatch: data shapes {shapes} pad {self.pad}"
+
+
+class DataIter:
+    """Iterator base (reference: io.DataIter)."""
+
+    def __init__(self, batch_size: int = 0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self) -> DataBatch:
+        if self.iter_next():
+            return DataBatch(self.getdata(), self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self) -> bool:
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self) -> int:
+        return 0
+
+
+def _as_named_arrays(data, default_name: str):
+    """Normalize array|list|dict into an ordered [(name, ndarray)] list."""
+    if data is None:
+        return []
+    if isinstance(data, dict):
+        items = list(data.items())
+    elif isinstance(data, (list, tuple)):
+        items = [(f"{default_name}" if i == 0 else f"{default_name}{i}", d)
+                 for i, d in enumerate(data)]
+    else:
+        items = [(default_name, data)]
+    out = []
+    for name, d in items:
+        if isinstance(d, NDArray):
+            d = d.asnumpy()
+        out.append((name, onp.asarray(d)))
+    return out
+
+
+class NDArrayIter(DataIter):
+    """In-memory iterator (reference: io.NDArrayIter): shuffle,
+    pad/discard/roll_over last-batch handling."""
+
+    def __init__(self, data, label=None, batch_size: int = 1,
+                 shuffle: bool = False, last_batch_handle: str = "pad",
+                 data_name: str = "data", label_name: str = "softmax_label"):
+        super().__init__(batch_size)
+        self.data = _as_named_arrays(data, data_name)
+        self.label = _as_named_arrays(label, label_name)
+        self.num_data = self.data[0][1].shape[0] if self.data else 0
+        for _, d in self.data + self.label:
+            if d.shape[0] != self.num_data:
+                raise MXNetError("all data/label arrays must share dim 0")
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        self.cursor = -batch_size
+        self._order = onp.arange(self.num_data)
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(n, (self.batch_size,) + d.shape[1:], d.dtype)
+                for n, d in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(n, (self.batch_size,) + d.shape[1:], d.dtype)
+                for n, d in self.label]
+
+    def reset(self):
+        if self.shuffle:
+            onp.random.shuffle(self._order)
+        if self.last_batch_handle == "roll_over" and \
+                0 < self.cursor < self.num_data:
+            self.cursor = -self.batch_size + (self.cursor % self.num_data)
+        else:
+            self.cursor = -self.batch_size
+
+    def iter_next(self) -> bool:
+        self.cursor += self.batch_size
+        if self.last_batch_handle == "discard":
+            return self.cursor + self.batch_size <= self.num_data
+        return self.cursor < self.num_data
+
+    def _slice(self, arrays):
+        out = []
+        for _, d in arrays:
+            idx = self._order[max(0, self.cursor):self.cursor + self.batch_size]
+            chunk = d[idx]
+            if chunk.shape[0] < self.batch_size:  # pad by wrapping
+                extra = self._order[:self.batch_size - chunk.shape[0]]
+                chunk = onp.concatenate([chunk, d[extra]], axis=0)
+            out.append(array(chunk))
+        return out
+
+    def getdata(self):
+        return self._slice(self.data)
+
+    def getlabel(self):
+        return self._slice(self.label)
+
+    def getpad(self) -> int:
+        if self.last_batch_handle == "pad" and \
+                self.cursor + self.batch_size > self.num_data:
+            return self.cursor + self.batch_size - self.num_data
+        return 0
+
+
+class CSVIter(NDArrayIter):
+    """CSV-backed iterator (reference: src/io/iter_csv.cc)."""
+
+    def __init__(self, data_csv: str, data_shape: Tuple[int, ...],
+                 label_csv: Optional[str] = None, label_shape: Tuple[int, ...] = (1,),
+                 batch_size: int = 1, **kwargs):
+        data = onp.loadtxt(data_csv, delimiter=",", dtype=onp.float32)
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv:
+            label = onp.loadtxt(label_csv, delimiter=",", dtype=onp.float32)
+            label = label.reshape((-1,) + tuple(label_shape))
+        super().__init__(data, label, batch_size=batch_size, **kwargs)
+
+
+class MNISTIter(NDArrayIter):
+    """idx-format MNIST reader (reference: src/io/iter_mnist.cc)."""
+
+    def __init__(self, image: str, label: str, batch_size: int = 128,
+                 shuffle: bool = False, flat: bool = False, **kwargs):
+        imgs = _read_idx_images(image)
+        labs = _read_idx_labels(label)
+        if flat:
+            imgs = imgs.reshape(imgs.shape[0], -1)
+        else:
+            imgs = imgs.reshape(imgs.shape[0], 1, 28, 28)
+        super().__init__(imgs.astype(onp.float32) / 255.0,
+                         labs.astype(onp.float32),
+                         batch_size=batch_size, shuffle=shuffle,
+                         label_name="softmax_label", **kwargs)
+
+
+def _read_idx_images(path: str) -> onp.ndarray:
+    import gzip
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        if magic != 2051:
+            raise MXNetError(f"{path} is not an MNIST image idx file")
+        return onp.frombuffer(f.read(), dtype=onp.uint8).reshape(n, rows, cols)
+
+
+def _read_idx_labels(path: str) -> onp.ndarray:
+    import gzip
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        if magic != 2049:
+            raise MXNetError(f"{path} is not an MNIST label idx file")
+        return onp.frombuffer(f.read(), dtype=onp.uint8)
+
+
+class ImageRecordIter(DataIter):
+    """.rec image pipeline with threaded decode+augment
+    (reference: src/io/iter_image_recordio_2.cc ImageRecordIOParser2).
+
+    Supported aug params mirror the common reference set: resize,
+    rand_crop, rand_mirror, data_shape, mean_r/g/b, std_r/g/b, shuffle.
+    """
+
+    def __init__(self, path_imgrec: str, data_shape: Tuple[int, int, int],
+                 batch_size: int, path_imgidx: Optional[str] = None,
+                 shuffle: bool = False, rand_crop: bool = False,
+                 rand_mirror: bool = False, resize: int = -1,
+                 mean_r: float = 0.0, mean_g: float = 0.0, mean_b: float = 0.0,
+                 std_r: float = 1.0, std_g: float = 1.0, std_b: float = 1.0,
+                 preprocess_threads: int = 4, round_batch: bool = True,
+                 seed: int = 0, **kwargs):
+        super().__init__(batch_size)
+        self.data_shape = tuple(data_shape)
+        self._rand_crop = rand_crop
+        self._rand_mirror = rand_mirror
+        self._resize = resize
+        self._mean = onp.array([mean_r, mean_g, mean_b], onp.float32)
+        self._std = onp.array([std_r, std_g, std_b], onp.float32)
+        self._rng = onp.random.RandomState(seed)
+        self._shuffle = shuffle
+        self._threads = max(1, preprocess_threads)
+        # Load the record offsets once; records decode lazily per batch.
+        idx = path_imgidx or (path_imgrec[:-4] + ".idx")
+        if os.path.isfile(idx):
+            self._rec = rec_mod.MXIndexedRecordIO(idx, path_imgrec, "r")
+            self._keys = list(self._rec.keys)
+        else:
+            self._rec = rec_mod.MXRecordIO(path_imgrec, "r")
+            self._keys = None
+            self._records = []
+            while True:
+                r = self._rec.read()
+                if r is None:
+                    break
+                self._records.append(r)
+        self._order = None
+        self._pos = 0
+        self.reset()
+
+    def reset(self):
+        n = len(self._keys) if self._keys is not None else len(self._records)
+        self._order = onp.arange(n)
+        if self._shuffle:
+            self._rng.shuffle(self._order)
+        self._pos = 0
+
+    def _fetch(self, i: int) -> bytes:
+        if self._keys is not None:
+            return self._rec.read_idx(self._keys[i])
+        return self._records[i]
+
+    def _decode_one(self, raw: bytes):
+        header, img = rec_mod.unpack_img(raw, iscolor=1)
+        import cv2
+        if self._resize > 0:
+            h, w = img.shape[:2]
+            scale = self._resize / min(h, w)
+            img = cv2.resize(img, (int(w * scale + 0.5), int(h * scale + 0.5)))
+        c, H, W = self.data_shape
+        h, w = img.shape[:2]
+        if self._rand_crop and (h > H or w > W):
+            y = self._rng.randint(0, h - H + 1)
+            x = self._rng.randint(0, w - W + 1)
+        else:
+            y, x = max(0, (h - H) // 2), max(0, (w - W) // 2)
+        img = img[y:y + H, x:x + W]
+        if img.shape[:2] != (H, W):
+            img = cv2.resize(img, (W, H))
+        img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB).astype(onp.float32)
+        if self._rand_mirror and self._rng.rand() < 0.5:
+            img = img[:, ::-1]
+        img = (img - self._mean) / self._std
+        label = header.label if onp.ndim(header.label) else float(header.label)
+        return img.transpose(2, 0, 1), onp.float32(label)
+
+    def iter_next(self) -> bool:
+        return self._pos + self.batch_size <= len(self._order)
+
+    def next(self) -> DataBatch:
+        if not self.iter_next():
+            raise StopIteration
+        idxs = self._order[self._pos:self._pos + self.batch_size]
+        self._pos += self.batch_size
+        raws = [self._fetch(int(i)) for i in idxs]
+        if self._threads > 1:
+            from concurrent.futures import ThreadPoolExecutor
+            if not hasattr(self, "_pool"):
+                self._pool = ThreadPoolExecutor(self._threads)
+            decoded = list(self._pool.map(self._decode_one, raws))
+        else:
+            decoded = [self._decode_one(r) for r in raws]
+        data = onp.stack([d for d, _ in decoded])
+        label = onp.stack([l for _, l in decoded])
+        return DataBatch([array(data)], [array(label)], pad=0)
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        return [DataDesc("softmax_label", (self.batch_size,))]
+
+
+class PrefetchingIter(DataIter):
+    """Background-thread prefetch wrapper (reference: iter_prefetcher.h —
+    the ThreadedIter overlap that hides decode latency behind compute)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None,
+                 prefetch_depth: int = 2):
+        if not isinstance(iters, (list, tuple)):
+            iters = [iters]
+        if len(iters) != 1:
+            raise MXNetError("PrefetchingIter here wraps a single iterator")
+        self._it = iters[0]
+        super().__init__(self._it.batch_size)
+        self._depth = prefetch_depth
+        self._queue: _queue.Queue = _queue.Queue(maxsize=prefetch_depth)
+        self._worker = None
+        self._start()
+
+    def _start(self):
+        def run():
+            try:
+                for b in self._it:
+                    self._queue.put(b)
+            finally:
+                self._queue.put(None)
+        self._worker = threading.Thread(target=run, daemon=True)
+        self._worker.start()
+
+    def reset(self):
+        if self._worker is not None:
+            self._worker.join(timeout=5)
+        self._it.reset()
+        self._queue = _queue.Queue(maxsize=self._depth)
+        self._start()
+
+    def next(self) -> DataBatch:
+        b = self._queue.get()
+        if b is None:
+            raise StopIteration
+        return b
+
+    def iter_next(self) -> bool:
+        raise MXNetError("PrefetchingIter supports iteration via next() only")
+
+    @property
+    def provide_data(self):
+        return self._it.provide_data
+
+    @property
+    def provide_label(self):
+        return self._it.provide_label
+
+
+class ResizeIter(DataIter):
+    """Truncate/extend an iterator to exactly ``size`` batches
+    (reference: io.ResizeIter)."""
+
+    def __init__(self, data_iter, size: int, reset_internal: bool = True):
+        super().__init__(data_iter.batch_size)
+        self._it = data_iter
+        self._size = size
+        self._reset_internal = reset_internal
+        self._cur = 0
+
+    def reset(self):
+        self._cur = 0
+        if self._reset_internal:
+            self._it.reset()
+
+    def next(self) -> DataBatch:
+        if self._cur >= self._size:
+            raise StopIteration
+        self._cur += 1
+        try:
+            return self._it.next()
+        except StopIteration:
+            self._it.reset()
+            return self._it.next()
+
+    def iter_next(self) -> bool:
+        return self._cur < self._size
+
+    @property
+    def provide_data(self):
+        return self._it.provide_data
+
+    @property
+    def provide_label(self):
+        return self._it.provide_label

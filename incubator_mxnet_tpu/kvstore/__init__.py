@@ -1,0 +1,268 @@
+"""KVStore — the gradient-exchange / parameter-synchronization API.
+
+Reference parity (SURVEY §2.5, §5.8): ``include/mxnet/kvstore.h``
+(``KVStore::Create/Push/Pull``), with three in-tree backends — local device
+comm (``src/kvstore/comm.h``), NCCL all-reduce (``src/kvstore/kvstore_nccl.h``)
+and the ps-lite parameter server (``src/kvstore/kvstore_dist.h``) — plus the
+``KVStoreBase`` plugin registry that Horovod/BytePS attach through.
+
+TPU-native design: ONE execution mechanism — XLA collectives over the
+ICI/DCN mesh — behind the same API names:
+
+========================  =================================================
+``create('local')``       in-process aggregating store (CommCPU parity)
+``create('device')``      same (device memory IS the store; XLA manages it)
+``create('nccl')``        mesh all-reduce on push (KVStoreNCCL parity)
+``create('dist_sync')``   same compiled psum, spanning hosts after
+                          ``parallel.dist.initialize()`` (ps-lite's
+                          scheduler role). Synchronous by construction.
+``create('dist_async')``  accepted with a warning; async PS semantics have
+                          no XLA analog (documented divergence, SURVEY §7).
+========================  =================================================
+
+``set_optimizer`` enables update-on-kvstore exactly like the reference's
+server-side optimizer (``KVStoreDistServer::DataHandleEx`` sync branch).
+"""
+from __future__ import annotations
+
+import pickle
+import warnings
+from typing import Callable, Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from ..base import MXNetError
+from ..ndarray import NDArray
+from ..context import current_context
+
+__all__ = ["KVStore", "KVStoreBase", "create"]
+
+
+_REGISTRY: Dict[str, type] = {}
+
+
+def register(name_or_cls=None):
+    """Backend plugin registry (reference: KVStoreBase plugin seam,
+    python/mxnet/kvstore/base.py). Usable as ``@register`` or
+    ``@register("name")``."""
+    def _do(cls, name=None):
+        _REGISTRY[(name or cls.__name__).lower()] = cls
+        return cls
+    if isinstance(name_or_cls, str):
+        return lambda cls: _do(cls, name_or_cls)
+    if name_or_cls is not None:
+        return _do(name_or_cls)
+    return _do
+
+
+def create(name: str = "local", **kwargs) -> "KVStoreBase":
+    """KVStore factory (reference: ``mx.kv.create`` → ``KVStore::Create``)."""
+    if not isinstance(name, str):
+        raise MXNetError(f"KVStore name must be a string, got {type(name)}")
+    key = name.lower()
+    if key in ("dist_async",):
+        warnings.warn(
+            "dist_async parameter-server semantics have no XLA analog; "
+            "using synchronous mesh all-reduce (dist_sync) instead.")
+        key = "dist_sync"
+    if key in ("local", "device", "local_allreduce_cpu", "local_allreduce_device"):
+        return KVStore(comm="local", **kwargs)
+    if key in ("nccl", "mesh", "dist", "dist_sync", "dist_device_sync",
+               "horovod", "byteps"):
+        return KVStore(comm="mesh", **kwargs)
+    if key in _REGISTRY:
+        return _REGISTRY[key](**kwargs)
+    raise MXNetError(
+        f"Unknown KVStore type '{name}'. Built-ins: local, device, nccl, "
+        f"dist_sync, dist_async; plugins: {sorted(_REGISTRY)}")
+
+
+class KVStoreBase:
+    """Minimal backend interface (reference: kvstore/base.py)."""
+
+    def init(self, key, value):
+        raise NotImplementedError
+
+    def push(self, key, value, priority: int = 0):
+        raise NotImplementedError
+
+    def pull(self, key, out=None, priority: int = 0, ignore_sparse: bool = True):
+        raise NotImplementedError
+
+    def pushpull(self, key, value, out=None, priority: int = 0):
+        self.push(key, value, priority)
+        return self.pull(key, out=out, priority=priority)
+
+    def broadcast(self, key, value, out=None, priority: int = 0):
+        self.init(key, value)
+        return self.pull(key, out=out, priority=priority)
+
+    @property
+    def rank(self) -> int:
+        return 0
+
+    @property
+    def num_workers(self) -> int:
+        return 1
+
+
+class KVStore(KVStoreBase):
+    """The aggregating store.
+
+    Semantics follow the reference local kvstore: ``init`` seeds a key;
+    ``push`` *accumulates* (a list value pushes the sum of the list — the
+    multi-device gradient reduce of ``CommDevice``); ``pull`` returns the
+    merged value (after the optimizer update when one is set).
+
+    comm='mesh' additionally sums pushes across *processes* with a compiled
+    ``psum`` over all devices (KVStoreNCCL / dist_sync parity). Single
+    process on one device it degenerates to local — same code path the
+    reference gets with one GPU.
+    """
+
+    def __init__(self, comm: str = "local"):
+        self._comm = comm
+        self._store: Dict[Union[int, str], NDArray] = {}
+        self._merged: Dict[Union[int, str], NDArray] = {}
+        self._updater: Optional[Callable] = None
+        self._optimizer = None
+        self._opt_states: Dict[Union[int, str], tuple] = {}
+        self._compression: Dict[str, float] = {}
+
+    # -- identity ----------------------------------------------------------
+    @property
+    def type(self) -> str:
+        return "device" if self._comm == "local" else "dist_sync"
+
+    @property
+    def rank(self) -> int:
+        return jax.process_index() if self._comm == "mesh" else 0
+
+    @property
+    def num_workers(self) -> int:
+        return jax.process_count() if self._comm == "mesh" else 1
+
+    # -- core ops ----------------------------------------------------------
+    def _keys(self, key):
+        return key if isinstance(key, (list, tuple)) else [key]
+
+    def _vals(self, key, value):
+        if isinstance(key, (list, tuple)):
+            if len(key) != len(value):
+                raise MXNetError("key list and value list length mismatch")
+            return list(value)
+        return [value]
+
+    def init(self, key, value):
+        for k, v in zip(self._keys(key), self._vals(key, value)):
+            if isinstance(v, (list, tuple)):
+                v = v[0]
+            if k in self._store:
+                continue
+            self._store[k] = NDArray(jnp.array(v._data))
+
+    def _reduce(self, vlist) -> jnp.ndarray:
+        total = vlist[0]._data
+        for v in vlist[1:]:
+            total = total + v._data.astype(total.dtype)
+        return total
+
+    def _cross_process_sum(self, arr: jnp.ndarray) -> jnp.ndarray:
+        if self._comm != "mesh" or jax.process_count() == 1:
+            return arr
+        # Multi-controller sum: every process contributes its local reduced
+        # gradient; the gather+sum over the process axis is the pod-wide
+        # ncclAllReduce of the reference (rides ICI/DCN via XLA).
+        from jax.experimental import multihost_utils
+        return multihost_utils.process_allgather(arr).sum(axis=0)
+
+    def push(self, key, value, priority: int = 0):
+        for k, v in zip(self._keys(key), self._vals(key, value)):
+            vlist = v if isinstance(v, (list, tuple)) else [v]
+            merged = self._cross_process_sum(self._reduce(vlist))
+            if self._updater is not None or self._optimizer is not None:
+                if k not in self._store:
+                    raise MXNetError(f"please init key {k!r} before push")
+                self._apply_update(k, merged)
+            else:
+                self._merged[k] = NDArray(merged)
+
+    def pull(self, key, out=None, priority: int = 0, ignore_sparse: bool = True):
+        results = []
+        for k in self._keys(key):
+            if self._updater is not None or self._optimizer is not None:
+                src = self._store.get(k)
+            else:
+                src = self._merged.get(k, self._store.get(k))
+            if src is None:
+                raise MXNetError(f"key {k!r} was never initialized or pushed")
+            results.append(src)
+        if out is not None:
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            flat = []
+            for o in outs:
+                (flat.extend(o) if isinstance(o, (list, tuple)) else flat.append(o))
+            srcs = results if len(results) > 1 else results * len(flat)
+            for o, r in zip(flat, srcs):
+                o._set_data(r._data.astype(o.dtype))
+            return out
+        return results if isinstance(key, (list, tuple)) else results[0]
+
+    def row_sparse_pull(self, key, out=None, priority: int = 0, row_ids=None):
+        # Dense on TPU (SURVEY §7 sparse scoping) — full pull.
+        return self.pull(key, out=out, priority=priority)
+
+    # -- server-side optimizer (update_on_kvstore) -------------------------
+    def set_updater(self, updater: Callable):
+        """reference: KVStore.set_updater / server controller fn."""
+        self._updater = updater
+
+    def set_optimizer(self, optimizer):
+        from .. import optimizer as opt_mod
+        if isinstance(optimizer, str):
+            optimizer = opt_mod.create(optimizer)
+        self._optimizer = optimizer
+
+    def _apply_update(self, k, grad):
+        weight = self._store[k]
+        if self._updater is not None:
+            self._updater(k, NDArray(grad), weight)
+            return
+        idx = k if isinstance(k, int) else abs(hash(k)) % (2 ** 31)
+        if k not in self._opt_states:
+            self._opt_states[k] = self._optimizer.create_state_multi_precision(
+                idx, weight)
+        self._opt_states[k] = self._optimizer.update(
+            idx, weight, NDArray(grad), self._opt_states[k])
+
+    def set_gradient_compression(self, compression_params: dict):
+        """2-bit gradient compression parity
+        (src/kvstore/gradient_compression.cc): accepted and recorded; XLA
+        collectives on ICI don't benefit from software compression, so this
+        is a no-op for execution (documented divergence)."""
+        self._compression = dict(compression_params or {})
+
+    # -- persistence (reference: MXKVStoreSaveOptimizerStates) -------------
+    def save_optimizer_states(self, fname: str, dump_optimizer: bool = False):
+        blob = {"states": {k: tuple(onp.asarray(s._data if isinstance(s, NDArray)
+                                                else s) for s in st)
+                           for k, st in self._opt_states.items()}}
+        with open(fname, "wb") as f:
+            pickle.dump(blob, f)
+
+    def load_optimizer_states(self, fname: str):
+        with open(fname, "rb") as f:
+            blob = pickle.load(f)
+        self._opt_states = {k: tuple(jnp.asarray(s) for s in st)
+                            for k, st in blob["states"].items()}
+
+    def barrier(self):
+        """Global barrier (reference: kvstore barrier via ps-lite)."""
+        if self._comm == "mesh" and jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("kvstore_barrier")
+
+    def __repr__(self):
+        return f"KVStore(type={self.type!r}, keys={len(self._store)})"

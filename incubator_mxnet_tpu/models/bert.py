@@ -79,6 +79,9 @@ class BERTModel(HybridBlock):
         self._vocab_size = vocab_size
         self._units = units
         self._max_length = max_length
+        if use_classifier and not use_pooler:
+            raise ValueError("use_classifier=True requires use_pooler=True "
+                             "(the NSP head reads the pooled [CLS] vector)")
         self.use_pooler = use_pooler
         self.use_decoder = use_decoder
         self.use_classifier = use_classifier

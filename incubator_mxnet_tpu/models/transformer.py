@@ -32,22 +32,30 @@ class MultiHeadAttention(HybridBlock):
 
     def __init__(self, units: int, num_heads: int, dropout: float = 0.0,
                  causal: bool = False, use_bias: bool = True, dtype="float32",
-                 weight_initializer=None, **kwargs):
+                 cross_attention: bool = False, weight_initializer=None,
+                 **kwargs):
         super().__init__(**kwargs)
         if units % num_heads:
             raise ValueError(f"units {units} not divisible by heads {num_heads}")
         self._units = units
         self._num_heads = num_heads
         self._causal = causal
+        self._cross = cross_attention
         with self.name_scope():
-            self.qkv = nn.Dense(3 * units, flatten=False, use_bias=use_bias,
-                                in_units=units, dtype=dtype, prefix="qkv_",
-                                weight_initializer=weight_initializer)
-            self.q_proj = nn.Dense(units, flatten=False, use_bias=use_bias,
-                                   in_units=units, dtype=dtype, prefix="query_",
-                                   weight_initializer=weight_initializer)
-            self.kv_proj = nn.Dense(2 * units, flatten=False, use_bias=use_bias,
-                                    in_units=units, dtype=dtype, prefix="kv_",
+            # Only the projections this cell actually uses exist — dead
+            # parameters would get optimizer state and distort MFU accounting.
+            if cross_attention:
+                self.q_proj = nn.Dense(units, flatten=False, use_bias=use_bias,
+                                       in_units=units, dtype=dtype,
+                                       prefix="query_",
+                                       weight_initializer=weight_initializer)
+                self.kv_proj = nn.Dense(2 * units, flatten=False,
+                                        use_bias=use_bias, in_units=units,
+                                        dtype=dtype, prefix="kv_",
+                                        weight_initializer=weight_initializer)
+            else:
+                self.qkv = nn.Dense(3 * units, flatten=False, use_bias=use_bias,
+                                    in_units=units, dtype=dtype, prefix="qkv_",
                                     weight_initializer=weight_initializer)
             self.proj = nn.Dense(units, flatten=False, use_bias=use_bias,
                                  in_units=units, dtype=dtype, prefix="proj_",
@@ -66,9 +74,11 @@ class MultiHeadAttention(HybridBlock):
 
     def hybrid_forward(self, F, query, kv=None, mask=None):
         B, Lq = query.shape[0], query.shape[1]
-        if kv is None or kv is query:
+        if not self._cross:
             q, k, v = self._heads(F, self.qkv(query), 3)
         else:
+            if kv is None:
+                kv = query
             q, = self._heads(F, self.q_proj(query), 1)
             k, v = self._heads(F, self.kv_proj(kv), 2)
         if mask is not None:

@@ -99,7 +99,7 @@ def _as_key_mask(mask, B, H, Lq, Lk):
 # ---------------------------------------------------------------------------
 
 def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, *,
-                scale, causal, bk, n_heads):
+                scale, causal, bk, n_heads, causal_off=0):
     bq, d = q_ref.shape[1], q_ref.shape[2]
     lk = k_ref.shape[1]
     nk = lk // bk
@@ -120,9 +120,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, *,
             mb = mask_ref[0, 0, pl.ds(j * bk, bk)]
             s = jnp.where(mb[None, :].astype(bool), s, _NEG)
         if causal:
+            # bottom-right aligned (tril k = Lk-Lq), matching the XLA path
             rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + iq * bq
             cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + j * bk
-            s = jnp.where(cols <= rows, s, _NEG)
+            s = jnp.where(cols <= rows + causal_off, s, _NEG)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
@@ -160,7 +161,7 @@ def _fwd(q, k, v, key_mask, causal, scale):
         args.append(key_mask.astype(jnp.int32).reshape(key_mask.shape[0], 1, Lk))
     kern = functools.partial(
         _fwd_kernel if key_mask is not None else _fwd_kernel_nomask,
-        scale=scale, causal=causal, bk=bk, n_heads=H)
+        scale=scale, causal=causal, bk=bk, n_heads=H, causal_off=Lk - Lq)
     o, lse = pl.pallas_call(
         kern,
         grid=grid,
@@ -188,7 +189,8 @@ def _fwd_kernel_nomask(q_ref, k_ref, v_ref, o_ref, lse_ref, **kw):
 # ---------------------------------------------------------------------------
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
-                    dk_ref, dv_ref, *, scale, causal, bq, n_heads):
+                    dk_ref, dv_ref, *, scale, causal, bq, n_heads,
+                    causal_off=0):
     bk, d = k_ref.shape[1], k_ref.shape[2]
     lq = q_ref.shape[1]
     nq = lq // bq
@@ -212,7 +214,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
         if causal:
             rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + i * bq
             cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + jk * bk
-            s = jnp.where(cols <= rows, s, _NEG)
+            s = jnp.where(cols <= rows + causal_off, s, _NEG)
         p = jnp.exp(s - lseb[:, None])
         dv = dv + jax.lax.dot_general(p, dob, (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
@@ -237,7 +239,7 @@ def _bwd_dkv_kernel_nomask(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
-                   dq_ref, *, scale, causal, bk, n_heads):
+                   dq_ref, *, scale, causal, bk, n_heads, causal_off=0):
     bq, d = q_ref.shape[1], q_ref.shape[2]
     lk = k_ref.shape[1]
     nk = lk // bk
@@ -259,7 +261,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
         if causal:
             rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + iq * bq
             cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + j * bk
-            s = jnp.where(cols <= rows, s, _NEG)
+            s = jnp.where(cols <= rows + causal_off, s, _NEG)
         p = jnp.exp(s - lseb[:, None])
         dp = jax.lax.dot_general(dob, vb, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -311,7 +313,7 @@ def _bwd(q, k, v, key_mask, causal, scale, o, lse, do):
                                     memory_space=_VMEM)] if key_mask is not None else [])
     dkv_kern = functools.partial(
         _bwd_dkv_kernel if key_mask is not None else _bwd_dkv_kernel_nomask,
-        scale=scale, causal=causal, bq=bq, n_heads=H)
+        scale=scale, causal=causal, bq=bq, n_heads=H, causal_off=Lk - Lq)
     dk, dv = pl.pallas_call(
         dkv_kern,
         grid=(BH, Lk // bk),
@@ -336,7 +338,7 @@ def _bwd(q, k, v, key_mask, causal, scale, o, lse, do):
     ] + mask_spec
     dq_kern = functools.partial(
         _bwd_dq_kernel if key_mask is not None else _bwd_dq_kernel_nomask,
-        scale=scale, causal=causal, bk=bk, n_heads=H)
+        scale=scale, causal=causal, bk=bk, n_heads=H, causal_off=Lk - Lq)
     dq = pl.pallas_call(
         dq_kern,
         grid=(BH, Lq // bq),
@@ -382,6 +384,11 @@ def flash_attention(q, k, v, mask=None, causal: bool = False,
     scale = (q.shape[-1] ** -0.5) if scale is None else float(scale)
     B, H, Lq, D = q.shape
     Lk = k.shape[2]
+    if Lq % _bq(Lq) or Lk % _bk(Lk):
+        raise ValueError(
+            f"flash_attention needs Lq/Lk divisible by the block size "
+            f"({_bq(Lq)}/{_bk(Lk)}); got Lq={Lq}, Lk={Lk} — pad the "
+            "sequence or use the XLA path (dot_product_attention impl='xla')")
     key_mask = _as_key_mask(mask, B, H, Lq, Lk)
     if mask is not None and key_mask is None:
         raise ValueError("flash_attention supports key-padding masks "

@@ -1,0 +1,117 @@
+"""Profiler facade over jax.profiler / XProf.
+
+Reference parity (SURVEY §5.1): ``python/mxnet/profiler.py`` —
+``set_config(filename=...)``, ``set_state('run'|'stop')``, ``pause``/
+``resume``, user scopes (``Scope``/``Task``/``Frame``/``Marker``), ``dump()``,
+``dumps()``. The C++ profiler's chrome://tracing JSON becomes an XProf/
+TensorBoard trace directory; operator-level aggregation comes from the XLA
+trace instead of hand-instrumented engine events. NVTX ranges map to
+``jax.profiler.TraceAnnotation``.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, Optional
+
+import jax
+
+__all__ = ["set_config", "set_state", "pause", "resume", "dump", "dumps",
+           "Scope", "Task", "Frame", "Marker", "scope"]
+
+_STATE = {"running": False, "dir": "profile_output", "aggregate": False,
+          "started_at": None}
+
+
+def set_config(filename: str = "profile.json", profile_all: bool = False,
+               profile_symbolic: bool = True, profile_imperative: bool = True,
+               profile_memory: bool = True, profile_api: bool = True,
+               aggregate_stats: bool = False, **kwargs) -> None:
+    """Accepts the reference kwargs; the trace directory is derived from
+    ``filename`` (XProf writes a directory, not one JSON file)."""
+    base = filename[:-5] if filename.endswith(".json") else filename
+    _STATE["dir"] = base + "_xprof"
+    _STATE["aggregate"] = aggregate_stats
+
+
+def set_state(state: str = "stop") -> None:
+    if state == "run" and not _STATE["running"]:
+        os.makedirs(_STATE["dir"], exist_ok=True)
+        jax.profiler.start_trace(_STATE["dir"])
+        _STATE["running"] = True
+        _STATE["started_at"] = time.time()
+    elif state == "stop" and _STATE["running"]:
+        jax.profiler.stop_trace()
+        _STATE["running"] = False
+
+
+def pause(profile_process: str = "worker") -> None:
+    if _STATE["running"]:
+        jax.profiler.stop_trace()
+        _STATE["running"] = False
+
+
+def resume(profile_process: str = "worker") -> None:
+    if not _STATE["running"]:
+        jax.profiler.start_trace(_STATE["dir"])
+        _STATE["running"] = True
+
+
+def dump(finished: bool = True, profile_process: str = "worker") -> None:
+    """Flush the trace (reference: MXDumpProfile). Stops an active trace —
+    XProf writes on stop."""
+    if _STATE["running"]:
+        set_state("stop")
+
+
+def dumps(reset: bool = False) -> str:
+    """Aggregate-stats table parity: points at the XProf directory (the
+    per-op table lives in the trace viewer)."""
+    return (f"Profile data in {_STATE['dir']!r} "
+            f"(open with XProf/TensorBoard profile plugin)")
+
+
+class Scope:
+    """User annotation scope (reference: mx.profiler.Scope; NVTX parity)."""
+
+    def __init__(self, name: str = "<unk>"):
+        self._ann = jax.profiler.TraceAnnotation(name)
+
+    def __enter__(self):
+        self._ann.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        self._ann.__exit__(*exc)
+
+
+def scope(name: str = "<unk>") -> Scope:
+    return Scope(name)
+
+
+class Task(Scope):
+    """Named task annotation (reference: profiler.Task)."""
+
+    def __init__(self, name: str = "task", domain=None):
+        super().__init__(name)
+
+    def start(self):
+        self.__enter__()
+
+    def stop(self):
+        self.__exit__(None, None, None)
+
+
+class Frame(Task):
+    pass
+
+
+class Marker:
+    """Instant event (reference: profiler.Marker.mark)."""
+
+    def __init__(self, name: str = "marker", domain=None):
+        self._name = name
+
+    def mark(self, scope_name: str = "process") -> None:
+        with jax.profiler.TraceAnnotation(f"{self._name}:{scope_name}"):
+            pass

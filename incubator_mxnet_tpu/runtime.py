@@ -1,0 +1,72 @@
+"""Runtime feature discovery.
+
+Reference parity: ``mx.runtime.Features()`` / ``MXLibInfoFeatures``
+(``src/libinfo.cc`` — SURVEY §5.6): lets user/test code probe what this build
+supports. The TPU build reports accelerator topology instead of CUDA/MKLDNN
+compile flags.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import jax
+
+__all__ = ["Feature", "Features", "feature_list"]
+
+
+class Feature:
+    def __init__(self, name: str, enabled: bool):
+        self.name = name
+        self.enabled = enabled
+
+    def __repr__(self):
+        return f"[{'✔' if self.enabled else '✖'} {self.name}]"
+
+
+def _detect() -> Dict[str, bool]:
+    devices = jax.devices()
+    platforms = {d.platform for d in devices}
+    has_tpu = "tpu" in platforms
+    feats = {
+        "TPU": has_tpu,
+        "CUDA": False, "CUDNN": False, "NCCL": False, "TENSORRT": False,
+        "MKLDNN": False, "CPU": True,
+        "XLA": True,
+        "PALLAS": has_tpu,
+        "BF16": True,
+        "INT64_TENSOR_SIZE": True,
+        "DIST_KVSTORE": True,            # jax.distributed multi-controller
+        "SIGNAL_HANDLER": True,
+        "OPENCV": _has("cv2"),
+        "F16C": False,
+        "FLASH_ATTENTION": has_tpu,
+        "MESH_SPMD": True,
+        "PROFILER": True,
+    }
+    return feats
+
+
+def _has(mod: str) -> bool:
+    try:
+        __import__(mod)
+        return True
+    except Exception:
+        return False
+
+
+class Features(dict):
+    """dict-like: ``fts = mx.runtime.Features(); fts.is_enabled('TPU')``."""
+
+    def __init__(self):
+        super().__init__({k: Feature(k, v) for k, v in _detect().items()})
+
+    def is_enabled(self, name: str) -> bool:
+        f = self.get(name.upper())
+        return bool(f and f.enabled)
+
+    def __repr__(self):
+        return "[" + ", ".join(repr(v) for v in self.values()) + "]"
+
+
+def feature_list() -> List[Feature]:
+    return list(Features().values())

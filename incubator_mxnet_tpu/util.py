@@ -1,0 +1,98 @@
+"""Misc utilities (reference: python/mxnet/util.py + dmlc::GetEnv plane).
+
+The env-var catalog (SURVEY §5.6) is centralized here: every runtime knob the
+framework reads goes through :func:`getenv` with its default, and
+:func:`env_var_doc` renders the ``env_var.md``-style table.
+"""
+from __future__ import annotations
+
+import functools
+import os
+from typing import Any, Dict, Optional
+
+__all__ = ["getenv", "setenv", "env_var_doc", "makedirs", "use_np_shape",
+           "is_np_shape", "is_np_array", "set_np", "reset_np", "np_shape"]
+
+#: name -> (default, description). The single catalog, reference
+#: docs/static_site/src/pages/api/faq/env_var.md.
+ENV_VARS: Dict[str, tuple] = {
+    "MXNET_ENGINE_TYPE": ("XLA", "Execution engine; XLA async dispatch "
+                          "replaces ThreadedEnginePerDevice. 'Naive' maps to "
+                          "jax.disable_jit debugging."),
+    "MXNET_ENFORCE_DETERMINISM": ("0", "Request deterministic XLA lowering."),
+    "MXNET_USE_FUSION": ("1", "XLA fusion is always on; kept for parity."),
+    "MXNET_GPU_MEM_POOL_RESERVE": ("0", "PjRt manages HBM pooling."),
+    "MXNET_KVSTORE_BIGARRAY_BOUND": ("1000000", "Kept for parity; sharding "
+                                     "rules make the layout decision."),
+    "MXNET_TEST_SEED": ("", "Fix the test RNG seed."),
+    "MXTPU_BENCH_MODEL": ("bert_12_768_12", "bench.py model config."),
+    "MXTPU_PEAK_TFLOPS": ("", "Override per-chip peak for MFU accounting."),
+    "MXTPU_FLASH_ATTENTION": ("1", "Enable the Pallas flash-attention path."),
+}
+
+
+def getenv(name: str, default: Optional[str] = None) -> Optional[str]:
+    if default is None and name in ENV_VARS:
+        default = ENV_VARS[name][0]
+    return os.environ.get(name, default)
+
+
+def setenv(name: str, value: str) -> None:
+    os.environ[name] = value
+
+
+def env_var_doc() -> str:
+    lines = ["| Variable | Default | Description |", "|---|---|---|"]
+    for k, (d, desc) in sorted(ENV_VARS.items()):
+        lines.append(f"| {k} | {d!r} | {desc} |")
+    return "\n".join(lines)
+
+
+def makedirs(d: str) -> None:
+    os.makedirs(d, exist_ok=True)
+
+
+# --- numpy-semantics switches (reference: mx.util.set_np / np_shape) -------
+_NP_SHAPE = [True]   # TPU build: numpy semantics are the native behavior
+_NP_ARRAY = [False]
+
+
+def is_np_shape() -> bool:
+    return _NP_SHAPE[0]
+
+
+def is_np_array() -> bool:
+    return _NP_ARRAY[0]
+
+
+def set_np(shape: bool = True, array: bool = True) -> None:
+    _NP_SHAPE[0] = shape
+    _NP_ARRAY[0] = array
+
+
+def reset_np() -> None:
+    set_np(True, False)
+
+
+class np_shape:
+    """Context manager parity for ``mx.util.np_shape``."""
+
+    def __init__(self, active: bool = True):
+        self._active = active
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = _NP_SHAPE[0]
+        _NP_SHAPE[0] = self._active
+        return self
+
+    def __exit__(self, *exc):
+        _NP_SHAPE[0] = self._prev
+
+
+def use_np_shape(fn):
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        with np_shape(True):
+            return fn(*args, **kwargs)
+    return wrapped

@@ -72,6 +72,25 @@ def test_flash_kernel_matches_xla(causal, masked):
                                     atol=1e-6, rtol=1e-3)
 
 
+def test_flash_cross_length_causal_matches_xla():
+    """Bottom-right-aligned causal masking when Lq != Lk (decode shapes)."""
+    rng = onp.random.RandomState(5)
+    q = jnp.asarray(rng.randn(1, 2, 128, 32), jnp.float32)
+    k = jnp.asarray(rng.randn(1, 2, 256, 32), jnp.float32)
+    v = jnp.asarray(rng.randn(1, 2, 256, 32), jnp.float32)
+    out = flash_attention(q, k, v, causal=True)
+    ref = dot_product_attention(q, k, v, causal=True, impl="xla")
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref), atol=2e-5)
+
+
+def test_flash_rejects_non_divisible_lengths():
+    rng = onp.random.RandomState(6)
+    q, k, v = (jnp.asarray(rng.randn(1, 1, 200, 32), jnp.float32)
+               for _ in range(3))
+    with pytest.raises(ValueError):
+        flash_attention(q, k, v)
+
+
 def test_interleaved_selfatt_ops_match_dense():
     """Reference-layout contract: (L, B, H*3*D) interleaved qkv, scores
     (B*H, L, L) with q pre-scaled (src/operator/contrib/transformer.cc)."""

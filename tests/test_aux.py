@@ -1,0 +1,91 @@
+"""Auxiliary subsystems: runtime features, profiler facade, AMP, util,
+model checkpoints, callbacks (SURVEY §5)."""
+import logging
+import os
+
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import amp, callback, model, profiler, runtime, util
+from incubator_mxnet_tpu import gluon
+
+
+def test_runtime_features():
+    fts = runtime.Features()
+    assert fts.is_enabled("XLA")
+    assert not fts.is_enabled("CUDA")
+    assert fts.is_enabled("MESH_SPMD")
+    assert any(f.name == "TPU" for f in runtime.feature_list())
+
+
+def test_util_env_catalog():
+    doc = util.env_var_doc()
+    assert "MXNET_ENGINE_TYPE" in doc
+    assert util.getenv("MXNET_ENGINE_TYPE") == "XLA"
+    assert util.is_np_shape()
+
+
+def test_profiler_scopes(tmp_path):
+    profiler.set_config(filename=str(tmp_path / "prof.json"))
+    profiler.set_state("run")
+    with profiler.Scope("user_scope"):
+        x = mx.nd.ones((8, 8))
+        (x @ x if hasattr(x, "__matmul__") else x.dot(x)).asnumpy()
+    t = profiler.Task("t0")
+    t.start(); t.stop()
+    profiler.Marker("m").mark()
+    profiler.set_state("stop")
+    assert "xprof" in profiler.dumps()
+    profiler.dump()
+
+
+def test_amp_init_casts_matmul_ops():
+    amp.init("bfloat16")
+    try:
+        a = mx.nd.ones((4, 4))
+        b = mx.nd.ones((4, 4))
+        out = mx.nd.dot(a, b)
+        assert str(out.dtype) == "bfloat16"
+        # FP32 op untouched
+        s = mx.nd.softmax(a)
+        assert str(s.dtype) == "float32"
+    finally:
+        amp.reset()
+    out2 = mx.nd.dot(mx.nd.ones((2, 2)), mx.nd.ones((2, 2)))
+    assert str(out2.dtype) == "float32"
+
+
+def test_amp_convert_hybrid_block():
+    net = gluon.nn.Dense(4, in_units=4)
+    net.initialize()
+    amp.convert_hybrid_block(net, "bfloat16")
+    assert str(net.weight.data().dtype) == "bfloat16"
+
+
+def test_model_checkpoint_roundtrip(tmp_path):
+    prefix = str(tmp_path / "ck")
+    arg = {"w": mx.nd.ones((2, 2)), "b": mx.nd.zeros((2,))}
+    aux = {"mean": mx.nd.full((2,), 3.0)}
+    model.save_checkpoint(prefix, 7, None, arg, aux)
+    sym, arg2, aux2 = model.load_checkpoint(prefix, 7)
+    onp.testing.assert_allclose(arg2["w"].asnumpy(), onp.ones((2, 2)))
+    onp.testing.assert_allclose(aux2["mean"].asnumpy(), onp.full((2,), 3.0))
+
+
+def test_speedometer_and_callbacks(caplog):
+    sp = callback.Speedometer(batch_size=32, frequent=2)
+    metric = mx.metric.Accuracy()
+    metric.update(mx.nd.array([0, 1]), mx.nd.array([[0.9, 0.1], [0.2, 0.8]]))
+    with caplog.at_level(logging.INFO):
+        for nb in range(1, 5):
+            sp(model.BatchEndParam(epoch=0, nbatch=nb, eval_metric=metric,
+                                   locals=None))
+    assert any("samples/sec" in r.message for r in caplog.records)
+
+
+def test_do_checkpoint_callback(tmp_path):
+    prefix = str(tmp_path / "net")
+    cb = callback.do_checkpoint(prefix, period=1)
+    cb(0, None, {"w": mx.nd.ones((2,))}, {})
+    assert os.path.exists(prefix + "-0001.params")

@@ -1,0 +1,159 @@
+"""Data I/O tests: recordio roundtrip (reference: test_recordio.py),
+iterators (test_io.py), image ops."""
+import os
+import struct
+
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import io as mio
+from incubator_mxnet_tpu import recordio, image
+
+
+def test_recordio_roundtrip(tmp_path):
+    f = str(tmp_path / "a.rec")
+    w = recordio.MXRecordIO(f, "w")
+    payloads = [b"hello", b"x" * 1237, b""]
+    for p in payloads:
+        w.write(p)
+    w.close()
+    r = recordio.MXRecordIO(f, "r")
+    got = []
+    while True:
+        rec = r.read()
+        if rec is None:
+            break
+        got.append(rec)
+    assert got == payloads
+
+
+def test_indexed_recordio(tmp_path):
+    rec, idx = str(tmp_path / "b.rec"), str(tmp_path / "b.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(5):
+        w.write_idx(i, f"record{i}".encode())
+    w.close()
+    r = recordio.MXIndexedRecordIO(idx, rec, "r")
+    assert r.read_idx(3) == b"record3"
+    assert r.read_idx(0) == b"record0"
+    assert r.keys == [0, 1, 2, 3, 4]
+
+
+def test_pack_unpack_multilabel():
+    h = recordio.IRHeader(0, [1.0, 2.0, 3.0], 7, 0)
+    s = recordio.pack(h, b"payload")
+    h2, payload = recordio.unpack(s)
+    onp.testing.assert_allclose(h2.label, [1.0, 2.0, 3.0])
+    assert payload == b"payload"
+    assert h2.id == 7
+
+
+def test_pack_img_unpack_img():
+    img = (onp.random.RandomState(0).rand(16, 16, 3) * 255).astype("uint8")
+    s = recordio.pack_img(recordio.IRHeader(0, 2.0, 1, 0), img, quality=95)
+    h, img2 = recordio.unpack_img(s)
+    assert h.label == 2.0
+    assert img2.shape == (16, 16, 3)
+
+
+def test_ndarray_iter_pad_and_discard():
+    X = onp.arange(10 * 3).reshape(10, 3).astype("float32")
+    Y = onp.arange(10).astype("float32")
+    it = mio.NDArrayIter(X, Y, batch_size=4, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[-1].pad == 2
+    it2 = mio.NDArrayIter(X, Y, batch_size=4, last_batch_handle="discard")
+    assert len(list(it2)) == 2
+    it2.reset()
+    assert len(list(it2)) == 2
+
+
+def test_ndarray_iter_provide_data():
+    it = mio.NDArrayIter({"data": onp.zeros((8, 2))}, batch_size=4)
+    d = it.provide_data[0]
+    assert d.name == "data" and d.shape == (4, 2)
+
+
+def test_csv_iter(tmp_path):
+    f = str(tmp_path / "d.csv")
+    onp.savetxt(f, onp.arange(12).reshape(6, 2), delimiter=",")
+    it = mio.CSVIter(f, (2,), batch_size=3)
+    b = next(it)
+    assert b.data[0].shape == (3, 2)
+
+
+def test_image_record_iter(tmp_path):
+    rec, idx = str(tmp_path / "im.rec"), str(tmp_path / "im.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    rng = onp.random.RandomState(0)
+    for i in range(8):
+        img = (rng.rand(20, 24, 3) * 255).astype("uint8")
+        w.write_idx(i, recordio.pack_img(recordio.IRHeader(0, float(i % 3), i, 0), img))
+    w.close()
+    it = mio.ImageRecordIter(rec, (3, 16, 16), batch_size=4, path_imgidx=idx,
+                             rand_crop=True, rand_mirror=True,
+                             preprocess_threads=2)
+    b = it.next()
+    assert b.data[0].shape == (4, 3, 16, 16)
+    assert b.label[0].shape == (4,)
+    it.reset()
+    n = 0
+    while it.iter_next():
+        it.next(); n += 1
+    assert n == 2  # 8 records / batch 4 = 2 batches per epoch
+
+
+def test_prefetching_iter():
+    X = onp.zeros((12, 2), "float32")
+    base = mio.NDArrayIter(X, onp.zeros(12, "float32"), batch_size=4)
+    pf = mio.PrefetchingIter(base)
+    assert len(list(pf)) == 3
+    pf.reset()
+    assert len(list(pf)) == 3
+
+
+def test_resize_iter():
+    X = onp.zeros((8, 2), "float32")
+    base = mio.NDArrayIter(X, onp.zeros(8, "float32"), batch_size=4)
+    r = mio.ResizeIter(base, 5)
+    assert len(list(r)) == 5
+
+
+def test_image_ops_roundtrip(tmp_path):
+    rng = onp.random.RandomState(0)
+    img = (rng.rand(32, 48, 3) * 255).astype("uint8")
+    import cv2
+    ok, buf = cv2.imencode(".png", img)
+    decoded = image.imdecode(buf.tobytes(), to_rgb=True)
+    assert decoded.shape == (32, 48, 3)
+    small = image.resize_short(decoded, 16)
+    assert min(small.shape[:2]) == 16
+    crop, _ = image.center_crop(decoded, (20, 20))
+    assert crop.shape[:2] == (20, 20)
+    norm = image.color_normalize(crop, mean=onp.array([1.0, 1.0, 1.0]))
+    assert str(norm.dtype) == "float32"
+    augs = image.CreateAugmenter((3, 16, 16), resize=20, rand_crop=True,
+                                 rand_mirror=True, mean=True, std=True)
+    out = decoded
+    for a in augs:
+        out = a(out)
+    assert out.shape[:2] == (16, 16)
+
+
+def test_mnist_iter(tmp_path):
+    # Synthesize a tiny idx-format MNIST pair
+    imgs = (onp.random.RandomState(0).rand(10, 28, 28) * 255).astype("uint8")
+    labs = onp.arange(10).astype("uint8") % 10
+    ip, lp = str(tmp_path / "img.idx3"), str(tmp_path / "lab.idx1")
+    with open(ip, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, 10, 28, 28))
+        f.write(imgs.tobytes())
+    with open(lp, "wb") as f:
+        f.write(struct.pack(">II", 2049, 10))
+        f.write(labs.tobytes())
+    it = mio.MNISTIter(ip, lp, batch_size=5, flat=False)
+    b = next(it)
+    assert b.data[0].shape == (5, 1, 28, 28)
+    assert float(b.data[0].asnumpy().max()) <= 1.0

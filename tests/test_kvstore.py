@@ -1,0 +1,100 @@
+"""KVStore API tests (reference model: tests/python/unittest/test_kvstore.py).
+
+Single-process semantics of the reference local kvstore: init seeds, push
+aggregates (lists sum), pull returns merged; set_updater/set_optimizer give
+update-on-kvstore. Mesh types alias to the same compiled-collective store.
+"""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon
+
+
+def test_init_push_pull_single_key():
+    kv = mx.kv.create("local")
+    kv.init(3, mx.nd.ones((2, 3)))
+    out = kv.pull(3)
+    onp.testing.assert_allclose(out.asnumpy(), onp.ones((2, 3)))
+    kv.push(3, mx.nd.full((2, 3), 4.0))
+    onp.testing.assert_allclose(kv.pull(3).asnumpy(), onp.full((2, 3), 4.0))
+
+
+def test_push_list_aggregates():
+    kv = mx.kv.create("device")
+    kv.init("w", mx.nd.zeros((4,)))
+    kv.push("w", [mx.nd.ones((4,)), mx.nd.ones((4,)) * 2, mx.nd.ones((4,)) * 3])
+    onp.testing.assert_allclose(kv.pull("w").asnumpy(), onp.full((4,), 6.0))
+
+
+def test_pull_into_out_list():
+    kv = mx.kv.create("local")
+    kv.init(0, mx.nd.ones((3,)))
+    kv.push(0, mx.nd.full((3,), 2.0))
+    a, b = mx.nd.zeros((3,)), mx.nd.zeros((3,))
+    kv.pull(0, out=[a, b])
+    onp.testing.assert_allclose(a.asnumpy(), onp.full((3,), 2.0))
+    onp.testing.assert_allclose(b.asnumpy(), onp.full((3,), 2.0))
+
+
+def test_list_keys():
+    kv = mx.kv.create("local")
+    keys = [5, 7, 9]
+    kv.init(keys, [mx.nd.ones((2,))] * 3)
+    kv.push(keys, [mx.nd.full((2,), float(i)) for i in range(3)])
+    outs = kv.pull(keys)
+    for i, o in enumerate(outs):
+        onp.testing.assert_allclose(o.asnumpy(), onp.full((2,), float(i)))
+
+
+def test_updater_update_on_kvstore():
+    kv = mx.kv.create("local")
+    kv.init("w", mx.nd.ones((2,)))
+
+    def sgd(key, grad, weight):
+        weight._set_data(weight._data - 0.1 * grad._data)
+
+    kv.set_updater(sgd)
+    kv.push("w", mx.nd.ones((2,)))
+    onp.testing.assert_allclose(kv.pull("w").asnumpy(), onp.full((2,), 0.9),
+                                rtol=1e-6)
+
+
+def test_set_optimizer_server_side_update():
+    kv = mx.kv.create("dist_sync")   # single process: local semantics
+    kv.init(0, mx.nd.ones((3,)))
+    kv.set_optimizer(mx.optimizer.create("sgd", learning_rate=0.5))
+    kv.push(0, mx.nd.ones((3,)))
+    onp.testing.assert_allclose(kv.pull(0).asnumpy(), onp.full((3,), 0.5),
+                                rtol=1e-6)
+
+
+def test_dist_async_warns_and_degrades():
+    with pytest.warns(UserWarning):
+        kv = mx.kv.create("dist_async")
+    assert kv.type == "dist_sync"
+
+
+def test_unknown_type_raises():
+    with pytest.raises(mx.MXNetError):
+        mx.kv.create("no_such_backend")
+
+
+def test_trainer_with_explicit_kvstore():
+    """gluon.Trainer driving grads through a kvstore object (stack §3.4)."""
+    net = gluon.nn.Dense(1, in_units=2)
+    net.initialize()
+    kv = mx.kv.create("local")
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1},
+                       kvstore=kv)
+    x = mx.nd.array(onp.random.RandomState(0).randn(4, 2).astype("float32"))
+    y = mx.nd.zeros((4, 1))
+    loss_fn = gluon.loss.L2Loss()
+    with mx.autograd.record():
+        l = loss_fn(net(x), y).mean()
+    l0 = float(l.asnumpy())
+    l.backward()
+    tr.step(4)
+    with mx.autograd.record():
+        l = loss_fn(net(x), y).mean()
+    assert float(l.asnumpy()) < l0
