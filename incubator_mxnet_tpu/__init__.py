@@ -57,6 +57,9 @@ _LAZY = {
     "onnx": ".onnx",
     "numpy": ".numpy",
     "np": ".numpy",
+    "numpy_extension": ".numpy_extension",
+    "npx": ".numpy_extension",
+    "models": ".models",
 }
 
 

@@ -128,11 +128,12 @@ class scale_loss:
         self._loss = loss
         self._trainer = trainer
         self._scaler = getattr(trainer, "_amp_loss_scaler", None)
+        self._used_scale = None
 
     def __enter__(self):
         if self._scaler is None:
             return self._loss
-        s = self._scaler.loss_scale
+        s = self._used_scale = self._scaler.loss_scale
         if isinstance(self._loss, (list, tuple)):
             return [l * s for l in self._loss]
         return self._loss * s
@@ -140,9 +141,10 @@ class scale_loss:
     def __exit__(self, *exc):
         if self._scaler is not None:
             overflow = self._scaler.has_overflow(self._trainer._params)
+            # Unscale with the scale the loss was actually multiplied by —
+            # update_scale may change loss_scale for the NEXT step.
+            self._trainer._scale = 0.0 if overflow else 1.0 / self._used_scale
             self._scaler.update_scale(overflow)
-            self._trainer._scale = (0.0 if overflow
-                                    else 1.0 / self._scaler.loss_scale)
 
 
 def unscale(trainer) -> None:

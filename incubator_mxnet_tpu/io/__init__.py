@@ -297,9 +297,11 @@ class ImageRecordIter(DataIter):
             img = cv2.resize(img, (int(w * scale + 0.5), int(h * scale + 0.5)))
         c, H, W = self.data_shape
         h, w = img.shape[:2]
-        if self._rand_crop and (h > H or w > W):
-            y = self._rng.randint(0, h - H + 1)
-            x = self._rng.randint(0, w - W + 1)
+        if self._rand_crop:
+            # per-dimension: random offset where the image is larger, 0 where
+            # it is smaller (the resize below fixes undersized dims)
+            y = self._rng.randint(0, h - H + 1) if h > H else 0
+            x = self._rng.randint(0, w - W + 1) if w > W else 0
         else:
             y, x = max(0, (h - H) // 2), max(0, (w - W) // 2)
         img = img[y:y + H, x:x + W]
@@ -356,19 +358,44 @@ class PrefetchingIter(DataIter):
         self._depth = prefetch_depth
         self._queue: _queue.Queue = _queue.Queue(maxsize=prefetch_depth)
         self._worker = None
+        self._gen = 0
         self._start()
 
     def _start(self):
+        gen = self._gen
+        q = self._queue
+
         def run():
+            # A stale generation (reset() bumped self._gen) must stop touching
+            # the shared underlying iterator and exit without the sentinel.
+            done = False
             try:
-                for b in self._it:
-                    self._queue.put(b)
+                while gen == self._gen:
+                    try:
+                        b = self._it.next()
+                    except StopIteration:
+                        done = True
+                        break
+                    while gen == self._gen:
+                        try:
+                            q.put(b, timeout=0.05)
+                            break
+                        except _queue.Full:
+                            continue
             finally:
-                self._queue.put(None)
+                if done and gen == self._gen:
+                    q.put(None)
+
         self._worker = threading.Thread(target=run, daemon=True)
         self._worker.start()
 
     def reset(self):
+        self._gen += 1  # signal the old worker to exit
+        try:
+            while True:
+                self._queue.get_nowait()
+        except _queue.Empty:
+            pass
         if self._worker is not None:
             self._worker.join(timeout=5)
         self._it.reset()

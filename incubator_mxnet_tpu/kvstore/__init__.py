@@ -200,13 +200,16 @@ class KVStore(KVStoreBase):
                 raise MXNetError(f"key {k!r} was never initialized or pushed")
             results.append(src)
         if out is not None:
-            outs = out if isinstance(out, (list, tuple)) else [out]
-            flat = []
-            for o in outs:
-                (flat.extend(o) if isinstance(o, (list, tuple)) else flat.append(o))
-            srcs = results if len(results) > 1 else results * len(flat)
-            for o, r in zip(flat, srcs):
-                o._set_data(r._data.astype(o.dtype))
+            if isinstance(key, (list, tuple)):
+                # per-key out slot; each slot may be a replica list
+                outs = out
+                if len(outs) != len(results):
+                    raise MXNetError("pull: out list length != key list length")
+            else:
+                outs = [out]
+            for o, r in zip(outs, results):
+                for oo in (o if isinstance(o, (list, tuple)) else [o]):
+                    oo._set_data(r._data.astype(oo.dtype))
             return out
         return results if isinstance(key, (list, tuple)) else results[0]
 

@@ -54,6 +54,19 @@ class EvalMetric:
     def update(self, labels, preds):
         raise NotImplementedError
 
+    def update_dict(self, label: dict, pred: dict):
+        """Name-keyed update (reference: EvalMetric.update_dict, used by
+        Module.update_metric)."""
+        if self.output_names is not None:
+            preds = [pred[n] for n in self.output_names if n in pred]
+        else:
+            preds = list(pred.values())
+        if self.label_names is not None:
+            labels = [label[n] for n in self.label_names if n in label]
+        else:
+            labels = list(label.values())
+        self.update(labels, preds)
+
     def get(self):
         if self.num_inst == 0:
             return (self.name, float("nan"))

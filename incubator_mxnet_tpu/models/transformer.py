@@ -75,6 +75,10 @@ class MultiHeadAttention(HybridBlock):
     def hybrid_forward(self, F, query, kv=None, mask=None):
         B, Lq = query.shape[0], query.shape[1]
         if not self._cross:
+            if kv is not None and kv is not query:
+                raise ValueError(
+                    "this MultiHeadAttention was built for self-attention; "
+                    "pass cross_attention=True to attend over a memory")
             q, k, v = self._heads(F, self.qkv(query), 3)
         else:
             if kv is None:

@@ -1,0 +1,261 @@
+"""Module API — the legacy symbolic training loop.
+
+Reference parity: ``python/mxnet/module/`` (``BaseModule.fit``, ``Module``)
+over ``GraphExecutor`` via ``simple_bind`` — SURVEY §2.7, call stack §3.5.
+This is what ``example/image-classification/train_mnist.py`` uses.
+
+TPU-native design: one Executor = one jitted XLA callable + vjp; the
+``DataParallelExecutorGroup`` batch-slicing disappears (SPMD sharding does
+data parallelism below this API — or use parallel.ShardedTrainer for the
+modern path).
+"""
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as onp
+
+from ..base import MXNetError
+from ..context import Context, cpu, current_context
+from ..ndarray import NDArray, array
+from .. import initializer as init_mod
+from .. import metric as metric_mod
+from .. import model as model_mod
+from .. import optimizer as opt_mod
+
+__all__ = ["BaseModule", "Module"]
+
+
+class BaseModule:
+    """Shared training-loop driver (reference: base_module.py)."""
+
+    def __init__(self, logger=logging):
+        self.logger = logger
+        self.binded = False
+        self.params_initialized = False
+        self.optimizer_initialized = False
+
+    # fit ------------------------------------------------------------------
+    def fit(self, train_data, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None,
+            kvstore: str = "local", optimizer: str = "sgd",
+            optimizer_params=(("learning_rate", 0.01),),
+            eval_end_callback=None, initializer=None,
+            arg_params=None, aux_params=None, allow_missing: bool = False,
+            force_init: bool = False, begin_epoch: int = 0,
+            num_epoch: Optional[int] = None, validation_metric=None,
+            monitor=None, sparse_row_id_fn=None):
+        if num_epoch is None:
+            raise MXNetError("fit requires num_epoch")
+        if not self.binded:
+            self.bind(data_shapes=train_data.provide_data,
+                      label_shapes=train_data.provide_label, for_training=True)
+        if not self.params_initialized or force_init:
+            self.init_params(initializer or init_mod.Xavier(magnitude=2.0),
+                             arg_params, aux_params, allow_missing, force_init)
+        if not self.optimizer_initialized:
+            self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                                optimizer_params=optimizer_params)
+        if not isinstance(eval_metric, metric_mod.EvalMetric):
+            eval_metric = metric_mod.create(eval_metric)
+        validation_metric = validation_metric or eval_metric
+
+        for epoch in range(begin_epoch, num_epoch):
+            eval_metric.reset()
+            nbatch = 0
+            train_data.reset()
+            for batch in train_data:
+                self.forward_backward(batch)
+                self.update()
+                self.update_metric(eval_metric, batch.label)
+                if batch_end_callback is not None:
+                    param = model_mod.BatchEndParam(
+                        epoch=epoch, nbatch=nbatch, eval_metric=eval_metric,
+                        locals=None)
+                    for cb in _as_list(batch_end_callback):
+                        cb(param)
+                nbatch += 1
+            for name, val in eval_metric.get_name_value():
+                self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
+            if epoch_end_callback is not None:
+                arg_p, aux_p = self.get_params()
+                for cb in _as_list(epoch_end_callback):
+                    cb(epoch, self.symbol, arg_p, aux_p)
+            if eval_data is not None:
+                res = self.score(eval_data, validation_metric)
+                for name, val in res:
+                    self.logger.info("Epoch[%d] Validation-%s=%f", epoch, name, val)
+
+    def forward_backward(self, data_batch) -> None:
+        self.forward(data_batch, is_train=True)
+        self.backward()
+
+    def score(self, eval_data, eval_metric, num_batch=None, reset=True):
+        if not isinstance(eval_metric, metric_mod.EvalMetric):
+            eval_metric = metric_mod.create(eval_metric)
+        if reset:
+            eval_data.reset()
+            eval_metric.reset()
+        for i, batch in enumerate(eval_data):
+            if num_batch is not None and i >= num_batch:
+                break
+            self.forward(batch, is_train=False)
+            self.update_metric(eval_metric, batch.label)
+        return eval_metric.get_name_value()
+
+    def predict(self, eval_data, num_batch=None, reset=True):
+        if reset:
+            eval_data.reset()
+        outs = []
+        for i, batch in enumerate(eval_data):
+            if num_batch is not None and i >= num_batch:
+                break
+            self.forward(batch, is_train=False)
+            outs.append(self.get_outputs()[0].asnumpy())
+        return array(onp.concatenate(outs, axis=0))
+
+
+def _as_list(x):
+    return x if isinstance(x, (list, tuple)) else [x]
+
+
+class Module(BaseModule):
+    """Single-executor Module (reference: module.py Module)."""
+
+    def __init__(self, symbol, data_names: Sequence[str] = ("data",),
+                 label_names: Sequence[str] = ("softmax_label",),
+                 logger=logging, context: Union[Context, Sequence[Context], None] = None,
+                 work_load_list=None, fixed_param_names=None, state_names=None,
+                 group2ctxs=None, compression_params=None):
+        super().__init__(logger)
+        self.symbol = symbol
+        self._data_names = list(data_names)
+        self._label_names = list(label_names or [])
+        ctx = context if context is not None else current_context()
+        if isinstance(ctx, (list, tuple)):
+            ctx = ctx[0]  # SPMD replaces multi-ctx executor groups
+        self._ctx = ctx
+        self._exec = None
+        self._optimizer = None
+        self._opt_states: Dict[int, tuple] = {}
+        self._arg_names: List[str] = []
+
+    # -- bind / init -------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training: bool = True,
+             inputs_need_grad: bool = False, force_rebind: bool = False,
+             shared_module=None, grad_req: str = "write"):
+        if self.binded and not force_rebind:
+            return
+        shapes = {}
+        for desc in data_shapes:
+            name, shape = (desc.name, desc.shape) if hasattr(desc, "name") else desc
+            shapes[name] = tuple(shape)
+        for desc in (label_shapes or []):
+            name, shape = (desc.name, desc.shape) if hasattr(desc, "name") else desc
+            shapes[name] = tuple(shape)
+        self._exec = self.symbol.simple_bind(
+            ctx=self._ctx, grad_req=grad_req if for_training else "null",
+            **shapes)
+        self._arg_names = self.symbol.list_arguments()
+        self._input_names = list(shapes)
+        self._param_names = [n for n in self._arg_names
+                             if n not in self._input_names]
+        self.binded = True
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing: bool = False, force_init: bool = False,
+                    allow_extra: bool = False):
+        if self.params_initialized and not force_init:
+            return
+        if not self.binded:
+            raise MXNetError("call bind before init_params")
+        if arg_params is None and getattr(self, "_preloaded", None):
+            # Module.load path: checkpoint params take the arg_params slot
+            arg_params, aux_params = self._preloaded
+        initializer = initializer or init_mod.Uniform(0.01)
+        for name in self._param_names:
+            arr = self._exec.arg_dict[name]
+            if arg_params and name in arg_params:
+                arr._set_data(arg_params[name]._data)
+            else:
+                init_arr = NDArray(arr._data)
+                initializer(init_mod.InitDesc(name), init_arr)
+                arr._set_data(init_arr._data)
+        self.params_initialized = True
+
+    def get_params(self) -> Tuple[Dict[str, NDArray], Dict[str, NDArray]]:
+        args = {n: self._exec.arg_dict[n].copy() for n in self._param_names}
+        return args, dict(self._exec.aux_dict)
+
+    def set_params(self, arg_params, aux_params=None, allow_missing=False,
+                   force_init=True, allow_extra=False):
+        self.init_params(arg_params=arg_params, aux_params=aux_params,
+                         allow_missing=allow_missing, force_init=force_init)
+
+    def init_optimizer(self, kvstore: str = "local", optimizer: str = "sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init: bool = False):
+        if isinstance(optimizer, str):
+            optimizer = opt_mod.create(optimizer, **dict(optimizer_params))
+        self._optimizer = optimizer
+        self.optimizer_initialized = True
+
+    # -- compute -----------------------------------------------------------
+    def forward(self, data_batch, is_train: Optional[bool] = None):
+        feeds = {}
+        for name, arr in zip(self._data_names, data_batch.data):
+            feeds[name] = arr
+        if self._label_names and data_batch.label:
+            for name, arr in zip(self._label_names, data_batch.label):
+                feeds[name] = arr
+        self._exec.forward(is_train=bool(is_train), **feeds)
+
+    def backward(self, out_grads=None):
+        self._exec.backward(out_grads)
+
+    def update(self):
+        for i, name in enumerate(self._param_names):
+            w = self._exec.arg_dict[name]
+            g = self._exec.grad_dict.get(name)
+            if g is None:
+                continue
+            if i not in self._opt_states:
+                self._opt_states[i] = \
+                    self._optimizer.create_state_multi_precision(i, w)
+            self._opt_states[i] = self._optimizer.update(i, w, g, self._opt_states[i])
+
+    def get_outputs(self, merge_multi_context: bool = True) -> List[NDArray]:
+        return self._exec.outputs
+
+    def get_input_grads(self, merge_multi_context: bool = True):
+        return [self._exec.grad_dict.get(n) for n in self._data_names]
+
+    def update_metric(self, eval_metric, labels, pre_sliced: bool = False):
+        eval_metric.update_dict(
+            {n: l for n, l in zip(self._label_names, labels or [])},
+            {o_name: o for o_name, o in zip(self.symbol.list_outputs(),
+                                            self._exec.outputs)})
+
+    # -- checkpoint --------------------------------------------------------
+    def save_checkpoint(self, prefix: str, epoch: int,
+                        save_optimizer_states: bool = False):
+        arg_params, aux_params = self.get_params()
+        model_mod.save_checkpoint(prefix, epoch, self.symbol, arg_params,
+                                  aux_params)
+
+    @staticmethod
+    def load(prefix: str, epoch: int, load_optimizer_states: bool = False,
+             **kwargs) -> "Module":
+        sym, arg_params, aux_params = model_mod.load_checkpoint(prefix, epoch)
+        mod = Module(sym, **kwargs)
+        mod._preloaded = (arg_params, aux_params)
+        return mod
+
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def output_names(self):
+        return self.symbol.list_outputs()

@@ -1,0 +1,153 @@
+"""``mx.np`` — the NumPy-compatible array namespace.
+
+Reference parity: ``python/mxnet/numpy/`` (SURVEY §2.7) — the np-on-device
+API MXNet 1.6+ ships next to ``mx.nd``. TPU-natively this is nearly free:
+jax.numpy IS a NumPy implementation, so every function here wraps the jnp
+twin, keeps arrays as autograd-recording :class:`NDArray` handles, and
+inherits XLA compilation. Functions not listed fall through via __getattr__
+to a generated jnp wrapper, so coverage is the whole jnp surface.
+"""
+from __future__ import annotations
+
+import sys
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from ..base import MXNetError
+from ..context import current_context
+from ..ndarray import NDArray
+from ..ndarray.op import dispatch_op
+
+__all__ = ["ndarray", "array", "zeros", "ones", "empty", "full", "arange",
+           "pi", "e", "inf", "nan", "newaxis", "random"]
+
+_this = sys.modules[__name__]
+
+ndarray = NDArray
+newaxis = None
+pi = onp.pi
+e = onp.e
+inf = onp.inf
+nan = onp.nan
+float32 = onp.float32
+float64 = onp.float64
+int32 = onp.int32
+int64 = onp.int64
+uint8 = onp.uint8
+bool_ = onp.bool_
+from jax.numpy import bfloat16  # noqa: E402,F401
+float16 = onp.float16
+
+
+def array(obj, dtype=None, ctx=None) -> NDArray:
+    return NDArray(obj, ctx=ctx or current_context(), dtype=dtype)
+
+
+def zeros(shape, dtype=None, ctx=None, order="C") -> NDArray:
+    return NDArray(jnp.zeros(shape, dtype or jnp.float32), ctx=ctx)
+
+
+def ones(shape, dtype=None, ctx=None, order="C") -> NDArray:
+    return NDArray(jnp.ones(shape, dtype or jnp.float32), ctx=ctx)
+
+
+empty = zeros
+
+
+def full(shape, fill_value, dtype=None, ctx=None) -> NDArray:
+    return NDArray(jnp.full(shape, fill_value, dtype), ctx=ctx)
+
+
+def arange(start, stop=None, step=1, dtype=None, ctx=None) -> NDArray:
+    return NDArray(jnp.arange(start, stop, step, dtype), ctx=ctx)
+
+
+def _wrap_jnp(name: str):
+    jfn = getattr(jnp, name)
+    if not callable(jfn):
+        return jfn
+
+    def fn(*args, **kwargs):
+        leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+        arr_pos = [i for i, l in enumerate(leaves) if isinstance(l, NDArray)]
+        if not arr_pos:
+            out = jfn(*args, **kwargs)
+            if isinstance(out, jnp.ndarray):
+                return NDArray(out)
+            return out
+        ctx = leaves[arr_pos[0]].context
+        arrays = [leaves[i] for i in arr_pos]
+
+        def pure(*vals):
+            lv = list(leaves)
+            for i, v in zip(arr_pos, vals):
+                lv[i] = v
+            a, kw = jax.tree_util.tree_unflatten(treedef, lv)
+            return jfn(*a, **kw)
+
+        return dispatch_op(pure, arrays, kwargs, ctx, name=f"np.{name}")
+
+    fn.__name__ = name
+    fn.__qualname__ = f"np.{name}"
+    fn.__doc__ = getattr(jfn, "__doc__", None)
+    return fn
+
+
+def __getattr__(name: str) -> Any:
+    if hasattr(jnp, name):
+        wrapped = _wrap_jnp(name)
+        setattr(_this, name, wrapped)
+        return wrapped
+    raise AttributeError(f"module 'mx.np' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(list(globals()) + dir(jnp)))
+
+
+class _NPRandom:
+    """mx.np.random — stateful-feeling wrapper over the Context RNG."""
+
+    @staticmethod
+    def _key():
+        from .. import random as random_mod
+        return random_mod.next_key(current_context())
+
+    def uniform(self, low=0.0, high=1.0, size=None, dtype=None, ctx=None):
+        shape = size if size is not None else ()
+        out = jax.random.uniform(self._key(), shape, dtype or jnp.float32,
+                                 low, high)
+        return NDArray(out, ctx=ctx)
+
+    def normal(self, loc=0.0, scale=1.0, size=None, dtype=None, ctx=None):
+        shape = size if size is not None else ()
+        out = jax.random.normal(self._key(), shape, dtype or jnp.float32)
+        return NDArray(out * scale + loc, ctx=ctx)
+
+    def randint(self, low, high=None, size=None, dtype=None, ctx=None):
+        if high is None:
+            low, high = 0, low
+        shape = size if size is not None else ()
+        out = jax.random.randint(self._key(), shape, low, high,
+                                 dtype or jnp.int32)
+        return NDArray(out, ctx=ctx)
+
+    def choice(self, a, size=None, replace=True, p=None, ctx=None):
+        arr = a._data if isinstance(a, NDArray) else jnp.asarray(a)
+        shape = size if size is not None else ()
+        p_ = p._data if isinstance(p, NDArray) else p
+        out = jax.random.choice(self._key(), arr, shape, replace, p_)
+        return NDArray(out, ctx=ctx)
+
+    def shuffle(self, x: NDArray) -> None:
+        x._set_data(jax.random.permutation(self._key(), x._data))
+
+    def seed(self, s):
+        from .. import random as random_mod
+        random_mod.seed(int(s))
+
+
+random = _NPRandom()

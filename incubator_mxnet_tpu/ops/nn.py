@@ -354,9 +354,40 @@ def masked_softmax(data, mask=None, axis=-1, temperature=1.0, **_):
 def softmax_output(data, label, grad_scale=1.0, ignore_label=-1, multi_output=False,
                    use_ignore=False, preserve_shape=False, normalization="null",
                    out_grad=False, smooth_alpha=0.0, **_):
-    """Forward = softmax; the loss-gradient fusion of the reference is handled
-    by autograd on the loss side."""
-    return jax.nn.softmax(data, axis=-1)
+    """Forward = softmax; backward = (p - onehot(label)) * grad_scale,
+    IGNORING the incoming head gradient (reference: softmax_output-inl.h —
+    the op fuses the cross-entropy loss gradient; Module-era nets end in it
+    and call backward() with no explicit loss)."""
+    axis = 1 if multi_output else -1
+
+    @jax.custom_vjp
+    def _f(x, lab):
+        return jax.nn.softmax(x, axis=axis)
+
+    def _fwd(x, lab):
+        p = jax.nn.softmax(x, axis=axis)
+        return p, (p, lab)
+
+    def _bwd(res, g):
+        p, lab = res
+        k = p.shape[axis]
+        oh = jax.nn.one_hot(lab.astype(jnp.int32), k, axis=axis, dtype=p.dtype)
+        if smooth_alpha:
+            oh = oh * (1.0 - smooth_alpha) + smooth_alpha / k
+        gx = p - oh
+        if use_ignore:
+            keep = (lab != ignore_label)
+            gx = gx * jnp.expand_dims(keep.astype(p.dtype), axis)
+            if normalization == "valid":
+                gx = gx / jnp.maximum(jnp.sum(keep), 1.0)
+        if normalization == "batch":
+            gx = gx / p.shape[0]
+        if out_grad:
+            gx = gx * g
+        return gx * grad_scale, jnp.zeros_like(lab)
+
+    _f.defvjp(_fwd, _bwd)
+    return _f(data, label)
 
 
 @register_op("softmax_cross_entropy")

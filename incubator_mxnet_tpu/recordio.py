@@ -111,9 +111,11 @@ class MXRecordIO:
             if pad:
                 self.handle.read(pad)
             parts.append(data)
-            # cflag: 0 whole, 1 start, 2 middle, 3 end (dmlc continuation)
+            # cflag: 0 whole, 1 start, 2 middle, 3 end. dmlc's writer splits
+            # a payload at embedded magic bytes (removing them); its reader
+            # re-inserts the magic between parts — so must we.
             if cflag in (0, 3):
-                return b"".join(parts)
+                return parts[0] if len(parts) == 1 else _KMAGIC.join(parts)
 
 
 class MXIndexedRecordIO(MXRecordIO):

