@@ -1,0 +1,45 @@
+"""``mx.contrib`` — contrib op namespaces.
+
+Reference parity: ``python/mxnet/contrib/`` — ``mx.contrib.nd.<op>`` and
+``mx.contrib.sym.<op>`` views over the ``_contrib_*`` registered ops
+(SURVEY §2.4 contrib subtree: transformer fused attention, bounding-box/
+MultiBox detection ops, ROIAlign).
+"""
+from __future__ import annotations
+
+import sys
+import types
+
+from ..ops.registry import OPS
+from ..ndarray.op import make_nd_op
+
+__all__ = ["nd", "sym"]
+
+
+def _contrib_names():
+    out = {}
+    for name, opdef in OPS.items():
+        if name.startswith("_contrib_"):
+            out[name[len("_contrib_"):]] = opdef
+    return out
+
+
+nd = types.ModuleType("incubator_mxnet_tpu.contrib.nd")
+for _short, _opdef in _contrib_names().items():
+    setattr(nd, _short, make_nd_op(_opdef))
+sys.modules[nd.__name__] = nd
+
+
+def _make_sym(opname):
+    def sym_op(*args, name=None, **kwargs):
+        from .. import symbol as S
+        ins = [a for a in args if isinstance(a, S.Symbol)]
+        return S.Symbol(opname, ins, attrs=kwargs, name=name)
+    sym_op.__name__ = opname
+    return sym_op
+
+
+sym = types.ModuleType("incubator_mxnet_tpu.contrib.sym")
+for _short, _opdef in _contrib_names().items():
+    setattr(sym, _short, _make_sym(_opdef.name))
+sys.modules[sym.__name__] = sym

@@ -1,0 +1,3 @@
+"""Model zoo (reference: python/mxnet/gluon/model_zoo — SURVEY §2.8)."""
+from . import vision  # noqa: F401
+from .vision import get_model  # noqa: F401

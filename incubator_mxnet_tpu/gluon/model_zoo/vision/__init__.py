@@ -1,0 +1,58 @@
+"""Vision model zoo.
+
+Reference parity: ``python/mxnet/gluon/model_zoo/vision/`` — resnet
+(v1/v2, 18–152), vgg (11–19 ±BN), alexnet, squeezenet, densenet,
+mobilenet (v1/v2), accessible by name through :func:`get_model`
+(GluonCV's ResNet-50 recipe in BASELINE.json builds on these).
+
+All HybridBlocks in NCHW; ``hybridize()`` compiles each to one XLA
+computation whose convs tile onto the MXU. Pretrained-weight download needs
+network access — load converted weights via ``load_parameters`` instead.
+"""
+from __future__ import annotations
+
+from ...block import HybridBlock  # noqa: F401  (re-export convenience)
+from .resnet import (  # noqa: F401
+    ResNetV1, ResNetV2, resnet18_v1, resnet34_v1, resnet50_v1, resnet101_v1,
+    resnet152_v1, resnet18_v2, resnet34_v2, resnet50_v2, resnet101_v2,
+    resnet152_v2, get_resnet, resnet_sharding_rules,
+)
+from .alexnet import AlexNet, alexnet  # noqa: F401
+from .vgg import VGG, vgg11, vgg13, vgg16, vgg19, vgg11_bn, vgg13_bn, vgg16_bn, vgg19_bn  # noqa: F401
+from .squeezenet import SqueezeNet, squeezenet1_0, squeezenet1_1  # noqa: F401
+from .densenet import DenseNet, densenet121, densenet161, densenet169, densenet201  # noqa: F401
+from .mobilenet import (  # noqa: F401
+    MobileNet, MobileNetV2, mobilenet1_0, mobilenet0_75, mobilenet0_5,
+    mobilenet0_25, mobilenet_v2_1_0, mobilenet_v2_0_75, mobilenet_v2_0_5,
+    mobilenet_v2_0_25,
+)
+
+_MODELS = {}
+
+
+def _register_models():
+    import sys
+    mod = sys.modules[__name__]
+    for name in ["resnet18_v1", "resnet34_v1", "resnet50_v1", "resnet101_v1",
+                 "resnet152_v1", "resnet18_v2", "resnet34_v2", "resnet50_v2",
+                 "resnet101_v2", "resnet152_v2", "alexnet", "vgg11", "vgg13",
+                 "vgg16", "vgg19", "vgg11_bn", "vgg13_bn", "vgg16_bn",
+                 "vgg19_bn", "squeezenet1.0", "squeezenet1.1", "densenet121",
+                 "densenet161", "densenet169", "densenet201", "mobilenet1.0",
+                 "mobilenet0.75", "mobilenet0.5", "mobilenet0.25",
+                 "mobilenetv2_1.0", "mobilenetv2_0.75", "mobilenetv2_0.5",
+                 "mobilenetv2_0.25"]:
+        attr = name.replace(".", "_").replace("mobilenetv2", "mobilenet_v2")
+        _MODELS[name] = getattr(mod, attr)
+
+
+_register_models()
+
+
+def get_model(name: str, **kwargs):
+    """Name-based constructor (reference: model_zoo.vision.get_model)."""
+    name = name.lower()
+    if name not in _MODELS:
+        raise ValueError(
+            f"Model {name!r} is not in the zoo. Available: {sorted(_MODELS)}")
+    return _MODELS[name](**kwargs)

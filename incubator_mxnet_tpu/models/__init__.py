@@ -8,6 +8,12 @@ per-family ``*_sharding_rules()`` helpers.
 """
 from . import transformer  # noqa: F401
 from . import bert  # noqa: F401
+from . import lenet  # noqa: F401
+from .lenet import LeNet  # noqa: F401
+from . import nmt  # noqa: F401
+from .nmt import NMTModel, beam_search  # noqa: F401
+from . import ssd  # noqa: F401
+from .ssd import SSD, SSDTargetLoss  # noqa: F401
 from .transformer import (  # noqa: F401
     MultiHeadAttention, PositionwiseFFN, TransformerEncoderCell,
 )

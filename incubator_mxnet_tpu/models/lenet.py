@@ -1,0 +1,68 @@
+"""LeNet-5 — the train_mnist.py smoke model.
+
+Reference parity: ``example/image-classification/train_mnist.py`` +
+``symbols/lenet.py`` (SURVEY §2.9 / §7 stage 4: the first end-to-end
+milestone). Both API styles ship: :class:`LeNet` (Gluon HybridBlock) and
+:func:`lenet_symbol` (Module-era symbol ending in SoftmaxOutput).
+"""
+from __future__ import annotations
+
+from ..gluon.block import HybridBlock
+from ..gluon import nn
+
+__all__ = ["LeNet", "lenet", "lenet_symbol", "mlp_symbol"]
+
+
+class LeNet(HybridBlock):
+    def __init__(self, classes: int = 10, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.features = nn.HybridSequential(prefix="")
+            self.features.add(nn.Conv2D(20, kernel_size=5, activation="tanh"))
+            self.features.add(nn.MaxPool2D(2, 2))
+            self.features.add(nn.Conv2D(50, kernel_size=5, activation="tanh"))
+            self.features.add(nn.MaxPool2D(2, 2))
+            self.features.add(nn.Flatten())
+            self.features.add(nn.Dense(500, activation="tanh"))
+            self.output = nn.Dense(classes)
+
+    def hybrid_forward(self, F, x):
+        return self.output(self.features(x))
+
+
+def lenet(**kwargs) -> LeNet:
+    return LeNet(**kwargs)
+
+
+def lenet_symbol(classes: int = 10):
+    """Module-era LeNet (reference: example/.../symbols/lenet.py)."""
+    from .. import symbol as sym
+    data = sym.Variable("data")
+    c1 = sym.Convolution(data, kernel=(5, 5), num_filter=20, name="conv1")
+    t1 = sym.Activation(c1, act_type="tanh", name="tanh1")
+    p1 = sym.Pooling(t1, pool_type="max", kernel=(2, 2), stride=(2, 2),
+                     name="pool1")
+    c2 = sym.Convolution(p1, kernel=(5, 5), num_filter=50, name="conv2")
+    t2 = sym.Activation(c2, act_type="tanh", name="tanh2")
+    p2 = sym.Pooling(t2, pool_type="max", kernel=(2, 2), stride=(2, 2),
+                     name="pool2")
+    f = sym.flatten(p2, name="flatten")
+    fc1 = sym.FullyConnected(f, num_hidden=500, name="fc1")
+    t3 = sym.Activation(fc1, act_type="tanh", name="tanh3")
+    fc2 = sym.FullyConnected(t3, num_hidden=classes, name="fc2")
+    return sym.SoftmaxOutput(fc2, sym.Variable("softmax_label"),
+                             name="softmax")
+
+
+def mlp_symbol(classes: int = 10):
+    """train_mnist.py's default MLP."""
+    from .. import symbol as sym
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(sym.flatten(data, name="flat"), num_hidden=128,
+                             name="fc1")
+    a1 = sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = sym.FullyConnected(a1, num_hidden=64, name="fc2")
+    a2 = sym.Activation(fc2, act_type="relu", name="relu2")
+    fc3 = sym.FullyConnected(a2, num_hidden=classes, name="fc3")
+    return sym.SoftmaxOutput(fc3, sym.Variable("softmax_label"),
+                             name="softmax")

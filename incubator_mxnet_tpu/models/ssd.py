@@ -1,0 +1,131 @@
+"""SSD — single-shot detection.
+
+Reference parity: GluonCV ``gluoncv/model_zoo/ssd`` + the in-tree MultiBox
+ops (``src/operator/contrib/multibox_*.cc``) exercised by BASELINE.json's
+SSD-512 config. Anchors/targets/decode all go through the fixed-shape
+``multibox_*`` ops in ``ops/detection.py`` — everything static-shape, so
+training and inference both jit.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+from ..gluon.block import HybridBlock
+from ..gluon import nn
+
+__all__ = ["SSD", "ssd_300", "SSDTargetLoss"]
+
+
+class _FeatureExtractor(HybridBlock):
+    """Small VGG-style trunk emitting multi-scale maps (GluonCV uses the
+    zoo backbones; this trunk keeps tests/dataset-free usage light — swap in
+    model_zoo.vision features for the full recipe)."""
+
+    def __init__(self, filters: Sequence[int] = (32, 64, 128, 128, 128), **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.blocks = []
+            for i, f in enumerate(filters):
+                blk = nn.HybridSequential(prefix=f"scale{i}_")
+                with blk.name_scope():
+                    blk.add(nn.Conv2D(f, 3, padding=1, activation="relu"))
+                    blk.add(nn.Conv2D(f, 3, padding=1, activation="relu"))
+                    blk.add(nn.MaxPool2D(2, 2))
+                self.register_child(blk, f"scale{i}")
+                self.blocks.append(blk)
+
+    def hybrid_forward(self, F, x):
+        feats = []
+        for blk in self.blocks:
+            x = blk(x)
+            feats.append(x)
+        return tuple(feats[1:])  # skip the stem scale
+
+
+class SSD(HybridBlock):
+    """``forward(x)`` → (cls_preds (B, N, num_cls+1), box_preds (B, N*4),
+    anchors (1, N, 4)). Train with :class:`SSDTargetLoss`; decode with
+    ``contrib.nd.MultiBoxDetection`` (see ``detect``)."""
+
+    def __init__(self, num_classes: int,
+                 sizes: Sequence[Sequence[float]] = ((0.2, 0.27), (0.37, 0.44),
+                                                     (0.54, 0.62), (0.71, 0.79)),
+                 ratios: Sequence[Sequence[float]] = ((1, 2, 0.5),) * 4,
+                 filters: Sequence[int] = (32, 64, 128, 128, 128), **kw):
+        super().__init__(**kw)
+        self._num_classes = num_classes
+        self._sizes = sizes
+        self._ratios = ratios
+        with self.name_scope():
+            self.features = _FeatureExtractor(filters, prefix="features_")
+            self.cls_preds = []
+            self.box_preds = []
+            for i, (s, r) in enumerate(zip(sizes, ratios)):
+                a = len(s) + len(r) - 1
+                cp = nn.Conv2D(a * (num_classes + 1), 3, padding=1,
+                               prefix=f"cls{i}_")
+                bp = nn.Conv2D(a * 4, 3, padding=1, prefix=f"box{i}_")
+                self.register_child(cp, f"cls{i}")
+                self.register_child(bp, f"box{i}")
+                self.cls_preds.append(cp)
+                self.box_preds.append(bp)
+
+    def hybrid_forward(self, F, x):
+        feats = self.features(x)
+        B = x.shape[0]
+        cls_out, box_out, anchors = [], [], []
+        for feat, cp, bp, s, r in zip(feats, self.cls_preds, self.box_preds,
+                                      self._sizes, self._ratios):
+            c = cp(feat)   # (B, A*(C+1), H, W)
+            b = bp(feat)   # (B, A*4, H, W)
+            cls_out.append(F.reshape(
+                F.transpose(c, axes=(0, 2, 3, 1)),
+                (B, -1, self._num_classes + 1)))
+            box_out.append(F.reshape(F.transpose(b, axes=(0, 2, 3, 1)),
+                                     (B, -1)))
+            anchors.append(F.multibox_prior(feat, sizes=tuple(s),
+                                            ratios=tuple(r)))
+        cls_preds = F.concat(*cls_out, dim=1)
+        box_preds = F.concat(*box_out, dim=1)
+        anchor = F.concat(*anchors, dim=1)
+        return cls_preds, box_preds, anchor
+
+    def detect(self, x, threshold=0.01, nms_threshold=0.45, nms_topk=400):
+        """Full inference: forward + decode + NMS → (B, N, 6)."""
+        from .. import ndarray as F
+        from .. import autograd
+        with autograd.predict_mode():
+            cls_preds, box_preds, anchor = self(x)
+            cls_prob = F.softmax(cls_preds, axis=-1)
+            cls_prob = F.transpose(cls_prob, axes=(0, 2, 1))
+            return F.multibox_detection(
+                cls_prob, box_preds, anchor, threshold=threshold,
+                nms_threshold=nms_threshold, nms_topk=nms_topk)
+
+
+class SSDTargetLoss(HybridBlock):
+    """MultiBoxTarget + (CE cls loss, SmoothL1 box loss) — the standard SSD
+    training objective (reference: GluonCV SSDMultiBoxLoss over the
+    MultiBoxTarget op)."""
+
+    def __init__(self, negative_mining_ratio: float = 3.0, **kw):
+        super().__init__(**kw)
+        self._ratio = negative_mining_ratio
+
+    def hybrid_forward(self, F, cls_preds, box_preds, anchor, label):
+        cls_pred_t = F.transpose(cls_preds, axes=(0, 2, 1))
+        loc_t, loc_mask, cls_t = F.multibox_target(
+            anchor, label, cls_pred_t,
+            negative_mining_ratio=self._ratio, ignore_label=-1.0)
+        # anchors marked ignore (-1) by hard negative mining drop out of CE
+        keep = F.greater_equal(cls_t, cls_t * 0.0)
+        ce = -F.pick(F.log_softmax(cls_preds, axis=-1),
+                     F.clip(cls_t, a_min=0.0), axis=-1)
+        cls_loss = ce * keep
+        num_pos = F.sum(F.greater(cls_t, cls_t * 0.0)) + 1.0
+        box_loss = F.smooth_l1((box_preds - loc_t) * loc_mask, scalar=1.0)
+        return (F.sum(cls_loss) + F.sum(box_loss)) / num_pos
+
+
+def ssd_300(num_classes: int = 20, **kw) -> SSD:
+    return SSD(num_classes, **kw)

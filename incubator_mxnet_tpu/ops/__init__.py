@@ -6,4 +6,5 @@ Importing this package registers all ops into ``registry.OPS``; the
 from . import tensor  # noqa: F401
 from . import nn  # noqa: F401
 from . import attention  # noqa: F401
+from . import detection  # noqa: F401
 from .registry import OPS, OpDef, register_op, alias_op  # noqa: F401

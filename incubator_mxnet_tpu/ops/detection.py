@@ -1,0 +1,372 @@
+"""Detection ops — box_nms, MultiBox*, ROIAlign/ROIPooling, box_iou.
+
+Reference parity: ``src/operator/contrib/bounding_box.cc`` (``box_nms``,
+``box_iou``, ``bipartite_matching``), ``src/operator/contrib/multibox_*.cc``
+(SSD's MultiBoxPrior/Target/Detection) and ``src/operator/contrib/
+roi_align.cc`` / ``src/operator/roi_pooling.cc`` — SURVEY §2.4's "padded
+top-k NMS" fixed-shape rewrite requirement.
+
+TPU-native design: every op is fixed-shape. NMS keeps all N slots and marks
+suppressed entries with -1 (exactly the reference's output convention, which
+happens to be TPU-friendly already); the suppression loop is a
+``lax.fori_loop`` over a precomputed (N, N) IoU matrix, compiling to one
+fused kernel instead of the reference's sort + sequential CUDA kernel chain.
+ROIAlign gathers bilinear samples with static sampling grids.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+
+__all__ = ["box_iou", "box_nms", "bipartite_matching", "multibox_prior",
+           "multibox_target", "multibox_detection", "roi_align", "roi_pooling"]
+
+
+def _corner_iou(a, b):
+    """IoU between corner-format boxes a (..., N, 4) and b (..., M, 4)."""
+    ax1, ay1, ax2, ay2 = jnp.split(a, 4, axis=-1)       # (..., N, 1)
+    bx1, by1, bx2, by2 = (x.squeeze(-1) for x in jnp.split(b, 4, axis=-1))
+    ix1 = jnp.maximum(ax1, bx1[..., None, :])           # (..., N, M)
+    iy1 = jnp.maximum(ay1, by1[..., None, :])
+    ix2 = jnp.minimum(ax2, bx2[..., None, :])
+    iy2 = jnp.minimum(ay2, by2[..., None, :])
+    inter = jnp.clip(ix2 - ix1, 0) * jnp.clip(iy2 - iy1, 0)
+    area_a = jnp.clip(ax2 - ax1, 0) * jnp.clip(ay2 - ay1, 0)
+    area_b = jnp.clip(bx2 - bx1, 0) * jnp.clip(by2 - by1, 0)
+    union = area_a + area_b[..., None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def _center_to_corner(b):
+    x, y, w, h = jnp.split(b, 4, axis=-1)
+    return jnp.concatenate([x - w / 2, y - h / 2, x + w / 2, y + h / 2], -1)
+
+
+def _corner_to_center(b):
+    x1, y1, x2, y2 = jnp.split(b, 4, axis=-1)
+    return jnp.concatenate([(x1 + x2) / 2, (y1 + y2) / 2, x2 - x1, y2 - y1], -1)
+
+
+@register_op(aliases=("_contrib_box_iou",))
+def box_iou(lhs, rhs, format="corner", **_):
+    if format == "center":
+        lhs, rhs = _center_to_corner(lhs), _center_to_corner(rhs)
+    return _corner_iou(lhs, rhs)
+
+
+@register_op(aliases=("_contrib_box_nms",))
+def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+            coord_start=2, score_index=1, id_index=-1,
+            background_id=-1, force_suppress=False, in_format="corner",
+            out_format="corner", **_):
+    """Fixed-shape NMS. data (..., N, K) with K >= coord_start+4; output has
+    identical shape with suppressed/invalid rows set to -1 and survivors
+    sorted by score (reference output convention)."""
+    squeeze = data.ndim == 2
+    if squeeze:
+        data = data[None]
+    *batch, N, K = data.shape
+    flat = data.reshape((-1, N, K))
+
+    def one(sample):
+        scores = sample[:, score_index]
+        valid = scores > valid_thresh
+        if id_index >= 0 and background_id >= 0:
+            valid &= sample[:, id_index] != background_id
+        order = jnp.argsort(-jnp.where(valid, scores, -jnp.inf))
+        s = sample[order]
+        svalid = valid[order]
+        if topk > 0:
+            svalid &= jnp.arange(N) < topk
+        boxes = s[:, coord_start:coord_start + 4]
+        if in_format == "center":
+            boxes = _center_to_corner(boxes)
+        iou = _corner_iou(boxes, boxes)
+        if not force_suppress and id_index >= 0:
+            same = s[:, id_index][:, None] == s[:, id_index][None, :]
+            iou = jnp.where(same, iou, 0.0)
+
+        def body(i, keep):
+            ki = keep[i] & svalid[i]
+            sup = (iou[i] > overlap_thresh) & (jnp.arange(N) > i) & ki
+            return keep & ~sup
+
+        keep = lax.fori_loop(0, N, body, jnp.ones(N, bool)) & svalid
+        if out_format != in_format:
+            coords = s[:, coord_start:coord_start + 4]
+            conv = (_center_to_corner(coords) if out_format == "corner"
+                    else _corner_to_center(coords))
+            s = s.at[:, coord_start:coord_start + 4].set(conv)
+        out = jnp.where(keep[:, None], s, -jnp.ones_like(s))
+        return out
+
+    out = jax.vmap(one)(flat).reshape(data.shape)
+    return out[0] if squeeze else out
+
+
+@register_op(aliases=("_contrib_bipartite_matching",))
+def bipartite_matching(data, threshold=0.5, is_ascend=False, topk=-1, **_):
+    """Greedy bipartite matching over a (..., N, M) score matrix
+    (reference: bounding_box.cc BipartiteMatching). Returns (row_match,
+    col_match): for each row the matched col (or -1), and inverse."""
+    squeeze = data.ndim == 2
+    if squeeze:
+        data = data[None]
+    B, N, M = data.shape
+    sign = 1.0 if is_ascend else -1.0
+
+    def one(mat):
+        def body(_, carry):
+            row_m, col_m, m = carry
+            masked = jnp.where((row_m[:, None] < 0) & (col_m[None, :] < 0),
+                               m, sign * jnp.inf)
+            # best remaining pair: max score (descend) / min (ascend)
+            idx = jnp.argmax(-sign * masked.reshape(-1))
+            r, c = idx // M, idx % M
+            # threshold the MASKED value: when rows/cols are exhausted the
+            # argmax lands on an inf slot, which must never match
+            val = masked[r, c]
+            ok = (val > threshold) if not is_ascend else (val < threshold)
+            row_m = jnp.where(ok, row_m.at[r].set(c), row_m)
+            col_m = jnp.where(ok, col_m.at[c].set(r), col_m)
+            return row_m, col_m, m
+
+        k = N if topk <= 0 else min(topk, N)
+        row0 = -jnp.ones(N, jnp.int32)
+        col0 = -jnp.ones(M, jnp.int32)
+        row_m, col_m, _ = lax.fori_loop(0, k, body, (row0, col0, mat))
+        return row_m.astype(data.dtype), col_m.astype(data.dtype)
+
+    rows, cols = jax.vmap(one)(data)
+    if squeeze:
+        return rows[0], cols[0]
+    return rows, cols
+
+
+@register_op(aliases=("_contrib_MultiBoxPrior", "MultiBoxPrior"))
+def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                   steps=(-1.0, -1.0), offsets=(0.5, 0.5), **_):
+    """SSD anchor generation (reference: multibox_prior.cc). data is the
+    (B, C, H, W) feature map; returns (1, H*W*(S+R-1), 4) corner anchors."""
+    H, W = data.shape[-2], data.shape[-1]
+    step_y = steps[0] if steps[0] > 0 else 1.0 / H
+    step_x = steps[1] if steps[1] > 0 else 1.0 / W
+    cy = (jnp.arange(H) + offsets[0]) * step_y
+    cx = (jnp.arange(W) + offsets[1]) * step_x
+    cy, cx = jnp.meshgrid(cy, cx, indexing="ij")
+    centers = jnp.stack([cx.reshape(-1), cy.reshape(-1)], -1)  # (HW, 2)
+    # widths carry the reference's in_h/in_w aspect correction
+    # (multibox_prior.cc) so anchors stay square in image space on
+    # non-square feature maps.
+    ar = H / W
+    whs = []
+    s0 = sizes[0]
+    for s in sizes:
+        whs.append((s * ar, s))
+    for r in ratios[1:]:
+        rr = float(r) ** 0.5
+        whs.append((s0 * rr * ar, s0 / rr))
+    whs = jnp.asarray(whs)                                       # (A, 2)
+    A = whs.shape[0]
+    c = jnp.repeat(centers[:, None, :], A, axis=1)               # (HW, A, 2)
+    wh = jnp.broadcast_to(whs[None], (centers.shape[0], A, 2))
+    boxes = jnp.concatenate([c - wh / 2, c + wh / 2], -1).reshape(1, -1, 4)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    return boxes.astype(data.dtype)
+
+
+@register_op(aliases=("_contrib_MultiBoxTarget", "MultiBoxTarget"))
+def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                    ignore_label=-1.0, negative_mining_ratio=-1.0,
+                    negative_mining_thresh=0.5, minimum_negative_samples=0,
+                    variances=(0.1, 0.1, 0.2, 0.2), **_):
+    """SSD training targets (reference: multibox_target.cc).
+    anchor (1, N, 4) corner; label (B, M, 5) [cls, x1, y1, x2, y2] with -1
+    padding; cls_pred (B, num_cls+1, N). Returns (loc_target (B, N*4),
+    loc_mask (B, N*4), cls_target (B, N))."""
+    anchors = anchor.reshape(-1, 4)
+    N = anchors.shape[0]
+    var = jnp.asarray(variances)
+
+    def one(lab, pred):
+        gt_valid = lab[:, 0] >= 0
+        gt_boxes = lab[:, 1:5]
+        iou = _corner_iou(anchors, gt_boxes)              # (N, M)
+        iou = jnp.where(gt_valid[None, :], iou, 0.0)
+        best_gt = jnp.argmax(iou, axis=1)                 # (N,)
+        best_iou = jnp.max(iou, axis=1)
+        # force-match: each VALID gt's best anchor is positive. at[].max so a
+        # padding gt (argmax lands on anchor 0) can't overwrite a real match.
+        best_anchor = jnp.argmax(iou, axis=0)             # (M,)
+        forced = jnp.zeros(N, bool).at[best_anchor].max(gt_valid)
+        pos = (best_iou >= overlap_threshold) | forced
+        matched = gt_boxes[best_gt]                       # (N, 4)
+        # encode regression target (center offsets / variances)
+        aw = anchors[:, 2] - anchors[:, 0]
+        ah = anchors[:, 3] - anchors[:, 1]
+        acx = (anchors[:, 0] + anchors[:, 2]) / 2
+        acy = (anchors[:, 1] + anchors[:, 3]) / 2
+        gw = jnp.clip(matched[:, 2] - matched[:, 0], 1e-8)
+        gh = jnp.clip(matched[:, 3] - matched[:, 1], 1e-8)
+        gcx = (matched[:, 0] + matched[:, 2]) / 2
+        gcy = (matched[:, 1] + matched[:, 3]) / 2
+        tx = (gcx - acx) / jnp.clip(aw, 1e-8) / var[0]
+        ty = (gcy - acy) / jnp.clip(ah, 1e-8) / var[1]
+        tw = jnp.log(gw / jnp.clip(aw, 1e-8)) / var[2]
+        th = jnp.log(gh / jnp.clip(ah, 1e-8)) / var[3]
+        loc_t = jnp.stack([tx, ty, tw, th], -1)           # (N, 4)
+        loc_mask = jnp.broadcast_to(pos[:, None], (N, 4)).astype(anchor.dtype)
+        pos_cls = lab[best_gt, 0] + 1.0
+        if negative_mining_ratio > 0:
+            # hard negative mining (multibox_target.cc): keep the
+            # ratio*num_pos hardest background anchors (largest background
+            # CE under the current predictions); the rest get ignore_label.
+            neg_loss = -jax.nn.log_softmax(pred, axis=0)[0]
+            num_pos = jnp.sum(pos)
+            max_neg = jnp.maximum(num_pos * negative_mining_ratio,
+                                  float(minimum_negative_samples))
+            cand = jnp.where(pos, -jnp.inf, neg_loss)
+            order = jnp.argsort(-cand)
+            rank = jnp.zeros(N, jnp.int32).at[order].set(
+                jnp.arange(N, dtype=jnp.int32))
+            sel_neg = (~pos) & (rank < max_neg)
+            cls_t = jnp.where(pos, pos_cls,
+                              jnp.where(sel_neg, 0.0, ignore_label))
+        else:
+            cls_t = jnp.where(pos, pos_cls, 0.0)
+        return (loc_t * loc_mask).reshape(-1), loc_mask.reshape(-1), cls_t
+
+    loc_t, loc_m, cls_t = jax.vmap(one)(label, cls_pred)
+    return loc_t.astype(anchor.dtype), loc_m, cls_t.astype(anchor.dtype)
+
+
+@register_op(aliases=("_contrib_MultiBoxDetection", "MultiBoxDetection"))
+def multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
+                       background_id=0, nms_threshold=0.5, force_suppress=False,
+                       variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1, **_):
+    """SSD decode + NMS (reference: multibox_detection.cc).
+    cls_prob (B, num_cls+1, N), loc_pred (B, N*4), anchor (1, N, 4).
+    Returns (B, N, 6) [id, score, x1, y1, x2, y2], -1 for invalid."""
+    B = cls_prob.shape[0]
+    N = anchor.shape[1]
+    var = jnp.asarray(variances)
+    anchors = anchor.reshape(N, 4)
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    acx = (anchors[:, 0] + anchors[:, 2]) / 2
+    acy = (anchors[:, 1] + anchors[:, 3]) / 2
+
+    def one(prob, loc):
+        loc = loc.reshape(N, 4)
+        cx = loc[:, 0] * var[0] * aw + acx
+        cy = loc[:, 1] * var[1] * ah + acy
+        w = jnp.exp(loc[:, 2] * var[2]) * aw
+        h = jnp.exp(loc[:, 3] * var[3]) * ah
+        boxes = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], -1)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        # best non-background class per anchor (background assumed class 0,
+        # the reference's default layout)
+        fg = prob[1:] if background_id == 0 else prob
+        cls = jnp.argmax(fg, axis=0)
+        score = jnp.max(fg, axis=0)
+        det = jnp.concatenate([cls[:, None].astype(boxes.dtype),
+                               score[:, None], boxes], -1)
+        return box_nms(det, overlap_thresh=nms_threshold,
+                       valid_thresh=threshold, topk=nms_topk,
+                       force_suppress=force_suppress, coord_start=2,
+                       score_index=1, id_index=0)
+
+    return jax.vmap(one)(cls_prob, loc_pred)
+
+
+@register_op(aliases=("_contrib_ROIAlign", "ROIAlign"))
+def roi_align(data, rois, pooled_size=(7, 7), spatial_scale=1.0,
+              sample_ratio=2, position_sensitive=False, aligned=False, **_):
+    """ROIAlign with bilinear sampling (reference: roi_align.cc).
+    data (B, C, H, W); rois (R, 5) [batch_idx, x1, y1, x2, y2] in image
+    coords. Returns (R, C, PH, PW)."""
+    if position_sensitive:
+        raise NotImplementedError(
+            "position-sensitive ROIAlign (PS-ROIAlign) is not implemented; "
+            "use position_sensitive=False")
+    B, C, H, W = data.shape
+    PH, PW = pooled_size
+    sr = max(1, int(sample_ratio))
+    off = 0.5 if aligned else 0.0
+
+    def one(roi):
+        bidx = roi[0].astype(jnp.int32)
+        x1, y1, x2, y2 = roi[1] * spatial_scale - off, roi[2] * spatial_scale - off, \
+            roi[3] * spatial_scale - off, roi[4] * spatial_scale - off
+        rw = jnp.maximum(x2 - x1, 1.0 if not aligned else 1e-6)
+        rh = jnp.maximum(y2 - y1, 1.0 if not aligned else 1e-6)
+        bin_w, bin_h = rw / PW, rh / PH
+        # static (PH*sr, PW*sr) sampling grid
+        gy = y1 + (jnp.repeat(jnp.arange(PH), sr)
+                   + (jnp.tile(jnp.arange(sr), PH) + 0.5) / sr) * bin_h
+        gx = x1 + (jnp.repeat(jnp.arange(PW), sr)
+                   + (jnp.tile(jnp.arange(sr), PW) + 0.5) / sr) * bin_w
+        img = data[bidx]                                  # (C, H, W)
+
+        def bilinear(y, x):
+            y0 = jnp.clip(jnp.floor(y), 0, H - 1)
+            x0 = jnp.clip(jnp.floor(x), 0, W - 1)
+            y1_ = jnp.clip(y0 + 1, 0, H - 1)
+            x1_ = jnp.clip(x0 + 1, 0, W - 1)
+            wy = jnp.clip(y - y0, 0, 1)
+            wx = jnp.clip(x - x0, 0, 1)
+            y0i, x0i, y1i, x1i = (v.astype(jnp.int32) for v in (y0, x0, y1_, x1_))
+            v00 = img[:, y0i, x0i]
+            v01 = img[:, y0i, x1i]
+            v10 = img[:, y1i, x0i]
+            v11 = img[:, y1i, x1i]
+            return (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+                    + v10 * wy * (1 - wx) + v11 * wy * wx)
+
+        yy, xx = jnp.meshgrid(gy, gx, indexing="ij")      # (PH*sr, PW*sr)
+        samples = jax.vmap(jax.vmap(bilinear))(yy, xx)    # (PH*sr, PW*sr, C)
+        samples = samples.reshape(PH, sr, PW, sr, C)
+        return jnp.mean(samples, axis=(1, 3)).transpose(2, 0, 1)
+
+    return jax.vmap(one)(rois).astype(data.dtype)
+
+
+@register_op(aliases=("ROIPooling",))
+def roi_pooling(data, rois, pooled_size=(7, 7), spatial_scale=1.0, **_):
+    """Max-pool ROI (reference: roi_pooling.cc) via dense ROIAlign samples."""
+    B, C, H, W = data.shape
+    PH, PW = pooled_size
+
+    def one(roi):
+        bidx = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * spatial_scale)
+        y1 = jnp.round(roi[2] * spatial_scale)
+        x2 = jnp.round(roi[3] * spatial_scale)
+        y2 = jnp.round(roi[4] * spatial_scale)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        img = data[bidx]
+        ys = jnp.arange(H)
+        xs = jnp.arange(W)
+
+        def cell(ph, pw):
+            cy1 = y1 + jnp.floor(ph * rh / PH)
+            cy2 = y1 + jnp.ceil((ph + 1) * rh / PH)
+            cx1 = x1 + jnp.floor(pw * rw / PW)
+            cx2 = x1 + jnp.ceil((pw + 1) * rw / PW)
+            mask = ((ys[:, None] >= cy1) & (ys[:, None] < cy2)
+                    & (xs[None, :] >= cx1) & (xs[None, :] < cx2))
+            vals = jnp.where(mask[None], img, -jnp.inf)
+            m = jnp.max(vals, axis=(1, 2))
+            return jnp.where(jnp.isfinite(m), m, 0.0)
+
+        phs, pws = jnp.meshgrid(jnp.arange(PH), jnp.arange(PW), indexing="ij")
+        out = jax.vmap(jax.vmap(cell))(phs, pws)          # (PH, PW, C)
+        return out.transpose(2, 0, 1)
+
+    return jax.vmap(one)(rois).astype(data.dtype)

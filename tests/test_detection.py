@@ -1,0 +1,181 @@
+"""Detection op tests (reference: tests/python/unittest/test_operator.py
+box_nms/box_iou cases, test_contrib_* MultiBox/ROIAlign)."""
+import jax.numpy as jnp
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu.ops.detection import (
+    bipartite_matching, box_iou, box_nms, multibox_detection, multibox_prior,
+    multibox_target, roi_align, roi_pooling)
+
+
+def test_box_iou_known_values():
+    a = jnp.asarray([[0.0, 0.0, 2.0, 2.0]])
+    b = jnp.asarray([[1.0, 1.0, 3.0, 3.0], [0.0, 0.0, 2.0, 2.0],
+                     [5.0, 5.0, 6.0, 6.0]])
+    iou = onp.asarray(box_iou(a, b))
+    onp.testing.assert_allclose(iou[0], [1.0 / 7.0, 1.0, 0.0], rtol=1e-6)
+
+
+def test_box_nms_suppresses_overlaps():
+    # [id, score, x1, y1, x2, y2]
+    dets = jnp.asarray([
+        [0, 0.9, 0.0, 0.0, 1.0, 1.0],
+        [0, 0.8, 0.05, 0.05, 1.0, 1.0],   # high overlap with first -> dropped
+        [0, 0.7, 2.0, 2.0, 3.0, 3.0],     # disjoint -> kept
+        [1, 0.6, 0.0, 0.0, 1.0, 1.0],     # other class -> kept
+    ])
+    out = onp.asarray(box_nms(dets, overlap_thresh=0.5, coord_start=2,
+                              score_index=1, id_index=0))
+    kept = out[out[:, 0] >= 0]
+    assert len(kept) == 3
+    assert 0.8 not in kept[:, 1]
+    # force_suppress ignores class ids
+    out2 = onp.asarray(box_nms(dets, overlap_thresh=0.5, coord_start=2,
+                               score_index=1, id_index=0, force_suppress=True))
+    kept2 = out2[out2[:, 0] >= 0]
+    assert len(kept2) == 2
+
+
+def test_box_nms_batch_and_topk():
+    rng = onp.random.RandomState(0)
+    dets = rng.rand(2, 8, 6).astype("float32")
+    dets[:, :, 2:4] = dets[:, :, 2:4] * 0.3
+    dets[:, :, 4:6] = dets[:, :, 2:4] + 0.5
+    out = box_nms(jnp.asarray(dets), topk=3, id_index=0)
+    assert out.shape == (2, 8, 6)
+
+
+def test_bipartite_matching():
+    scores = jnp.asarray([[0.9, 0.1], [0.8, 0.7], [0.2, 0.3]])
+    rows, cols = bipartite_matching(scores, threshold=0.5)
+    rows, cols = onp.asarray(rows), onp.asarray(cols)
+    assert rows[0] == 0        # best pair (0,0)
+    assert rows[1] == 1        # next best valid (1,1)=0.7
+    assert rows[2] == -1       # below threshold
+    assert cols[0] == 0 and cols[1] == 1
+
+
+def test_bipartite_matching_exhausted_no_spurious_match():
+    """N > M: once columns run out, no fake (0,0) match may appear."""
+    scores = jnp.asarray([[0.9], [0.95], [0.8]])
+    rows, cols = bipartite_matching(scores, threshold=0.5)
+    rows, cols = onp.asarray(rows), onp.asarray(cols)
+    assert rows.tolist() == [-1, 0, -1]
+    assert cols.tolist() == [1]
+
+
+def test_multibox_target_padding_gt_keeps_forced_match():
+    """A -1 padding label row must not erase anchor 0's forced match."""
+    anchor = jnp.asarray([[[0.0, 0.0, 0.5, 0.5], [2.0, 2.0, 3.0, 3.0]]])
+    label = jnp.asarray([[[1.0, 0.0, 0.0, 1.0, 1.0],
+                          [-1.0, 0.0, 0.0, 0.0, 0.0]]])
+    cls_pred = jnp.zeros((1, 3, 2))
+    _, _, cls_t = multibox_target(anchor, label, cls_pred)
+    assert onp.asarray(cls_t)[0, 0] == 2.0   # class 1 -> target 2
+
+
+def test_multibox_target_negative_mining():
+    rng = onp.random.RandomState(0)
+    anchor = jnp.asarray(rng.rand(1, 20, 4).astype("float32"))
+    anchor = jnp.concatenate([anchor[..., :2] * 0.5,
+                              anchor[..., :2] * 0.5 + 0.3], -1)
+    label = jnp.asarray([[[0.0, 0.0, 0.0, 0.3, 0.3]]])
+    cls_pred = jnp.asarray(rng.randn(1, 3, 20).astype("float32"))
+    _, _, cls_t = multibox_target(anchor, label, cls_pred,
+                                  negative_mining_ratio=1.0)
+    cls_t = onp.asarray(cls_t)[0]
+    n_pos = (cls_t > 0).sum()
+    n_neg = (cls_t == 0).sum()
+    n_ign = (cls_t == -1).sum()
+    assert n_pos >= 1 and n_ign > 0
+    assert n_neg <= max(1, n_pos * 1.0) + 1e-6
+
+
+def test_multibox_prior_aspect_correction():
+    """Anchors are square in image space: width = size * H/W."""
+    fmap = jnp.zeros((1, 1, 4, 6))
+    a = onp.asarray(multibox_prior(fmap, sizes=(0.5,), ratios=(1,)))[0]
+    w = a[0, 2] - a[0, 0]
+    h = a[0, 3] - a[0, 1]
+    onp.testing.assert_allclose(w, 0.5 * 4 / 6, rtol=1e-6)
+    onp.testing.assert_allclose(h, 0.5, rtol=1e-6)
+
+
+def test_multibox_prior_shapes_and_range():
+    fmap = jnp.zeros((1, 8, 4, 6))
+    anchors = multibox_prior(fmap, sizes=(0.5, 0.25), ratios=(1, 2))
+    A = 2 + 2 - 1
+    assert anchors.shape == (1, 4 * 6 * A, 4)
+    a = onp.asarray(anchors)[0]
+    assert (a[:, 2] > a[:, 0]).all() and (a[:, 3] > a[:, 1]).all()
+
+
+def test_multibox_target_positive_assignment():
+    anchor = jnp.asarray([[[0.0, 0.0, 0.5, 0.5], [0.5, 0.5, 1.0, 1.0],
+                           [0.0, 0.5, 0.5, 1.0]]])
+    # one gt overlapping anchor 0 exactly, class 2
+    label = jnp.asarray([[[2.0, 0.0, 0.0, 0.5, 0.5],
+                          [-1.0, 0.0, 0.0, 0.0, 0.0]]])
+    cls_pred = jnp.zeros((1, 4, 3))
+    loc_t, loc_m, cls_t = multibox_target(anchor, label, cls_pred)
+    assert loc_t.shape == (1, 12) and cls_t.shape == (1, 3)
+    cls_t = onp.asarray(cls_t)
+    assert cls_t[0, 0] == 3.0            # class 2 -> target 3 (bg=0)
+    assert cls_t[0, 1] == 0.0
+    loc_m = onp.asarray(loc_m)
+    assert loc_m[0, :4].all() and not loc_m[0, 4:8].any()
+
+
+def test_multibox_detection_decodes_and_nms():
+    anchor = jnp.asarray([[[0.1, 0.1, 0.4, 0.4], [0.6, 0.6, 0.9, 0.9]]])
+    cls_prob = jnp.asarray([[[0.1, 0.2], [0.9, 0.1], [0.0, 0.7]]])  # (1,3,2)
+    loc_pred = jnp.zeros((1, 8))
+    out = onp.asarray(multibox_detection(cls_prob, loc_pred, anchor))
+    assert out.shape == (1, 2, 6)
+    valid = out[0][out[0, :, 0] >= 0]
+    assert len(valid) == 2
+    # anchor0 -> class 0 (score .9), anchor1 -> class 1 (score .7)
+    ids = sorted(valid[:, 0])
+    assert ids == [0.0, 1.0]
+
+
+def test_roi_align_uniform_image():
+    data = jnp.broadcast_to(jnp.arange(2.0)[None, :, None, None],
+                            (1, 2, 8, 8)) + 0.0
+    rois = jnp.asarray([[0.0, 1.0, 1.0, 5.0, 5.0]])
+    out = onp.asarray(roi_align(data, rois, pooled_size=(2, 2),
+                                spatial_scale=1.0))
+    assert out.shape == (1, 2, 2, 2)
+    onp.testing.assert_allclose(out[0, 0], onp.zeros((2, 2)), atol=1e-6)
+    onp.testing.assert_allclose(out[0, 1], onp.ones((2, 2)), atol=1e-6)
+
+
+def test_roi_pooling_max():
+    img = jnp.arange(16.0).reshape(1, 1, 4, 4)
+    rois = jnp.asarray([[0.0, 0.0, 0.0, 3.0, 3.0]])
+    out = onp.asarray(roi_pooling(img, rois, pooled_size=(2, 2),
+                                  spatial_scale=1.0))
+    onp.testing.assert_allclose(out[0, 0], [[5.0, 7.0], [13.0, 15.0]])
+
+
+def test_contrib_namespace():
+    from incubator_mxnet_tpu import contrib
+    dets = mx.nd.array(onp.asarray([[0, 0.9, 0.0, 0.0, 1.0, 1.0]],
+                                   dtype="float32"))
+    out = contrib.nd.box_nms(dets)
+    assert out.shape == (1, 6)
+    assert hasattr(contrib.nd, "interleaved_matmul_selfatt_qk")
+    assert hasattr(contrib.sym, "box_iou")
+
+
+def test_model_zoo_get_model_names():
+    from incubator_mxnet_tpu.gluon.model_zoo import vision
+    with pytest.raises(ValueError):
+        vision.get_model("resnet999")
+    net = vision.get_model("resnet18_v1", thumbnail=True, classes=10)
+    net.initialize()
+    with mx.autograd.predict_mode():
+        out = net(mx.nd.array(onp.random.rand(2, 3, 32, 32).astype("float32")))
+    assert out.shape == (2, 10)
