@@ -204,10 +204,11 @@ def beam_search(model: NMTModel, src, src_valid_length=None, beam_size: int = 4,
                 alpha: float = 0.6):
     """Static-shape beam search (reference: GluonNLP BeamSearchSampler).
 
-    Re-encodes once, then decodes ``max_length`` steps with a fixed
-    (B*beam) batch — each step re-runs the decoder on the prefix (O(L²)
-    total, the simple/robust formulation; incremental KV caching is a
-    kernel-level optimization the flash path can add later).
+    Encodes once, then decodes ``max_length`` steps. Every step feeds the
+    decoder the SAME fixed (B·beam, max_length) token buffer — causal
+    masking makes position t depend only on tokens ≤ t, so the step logits
+    are read at column t and the decoder compiles exactly once (O(L²) total
+    compute; incremental KV caching is a later kernel-level optimization).
     Returns (sequences (B, beam, max_length), scores (B, beam)).
     """
     from ..ndarray import NDArray
@@ -242,7 +243,8 @@ def beam_search(model: NMTModel, src, src_valid_length=None, beam_size: int = 4,
 
     V = model._tgt_vocab
     for t in range(max_length):
-        logits = dec_step(seqs[:, :t + 1])[:, -1]        # (B*K, V)
+        # fixed-shape prefix: causality makes column t ignore columns > t
+        logits = dec_step(seqs[:, :max_length])[:, t]    # (B*K, V)
         logp = jax.nn.log_softmax(logits, -1)
         # finished beams only extend with eos at no cost
         eos_only = jnp.full((V,), -1e9).at[eos_id].set(0.0)

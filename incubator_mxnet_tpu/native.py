@@ -1,0 +1,306 @@
+"""ctypes bindings for the C++ runtime shim (native/mxtpu_native.cc).
+
+Reference parity: the ctypes half of the C ABI boundary
+(``python/mxnet/base.py`` ``_LIB`` loading libmxnet.so — SURVEY §2.7). The
+shared library is built on demand from ``native/`` with the system g++; all
+callers degrade gracefully to the pure-Python paths when a toolchain is
+unavailable (``native.available()``).
+
+Surfaces:
+- :class:`NativeRecordReader` / :class:`NativeRecordWriter` / index_build —
+  the C++ recordio parser (src/io/ parity).
+- :class:`ShmSegment` — named POSIX shared memory
+  (CPUSharedStorageManager parity) for DataLoader worker transfer.
+- :class:`NativeEngine` — host-side dependency engine (ThreadedEngine
+  parity): push(fn, read_vars, write_vars), wait_all.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Callable, List, Optional, Sequence
+
+from .base import MXNetError
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "libmxtpu_native.so")
+
+_LIB: Optional[ctypes.CDLL] = None
+_LOAD_LOCK = threading.Lock()
+_LOAD_FAILED = False
+
+_TASK_FN = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(["make", "-C", _NATIVE_DIR],
+                       check=True, capture_output=True, timeout=120)
+        return os.path.exists(_SO_PATH)
+    except Exception:
+        return False
+
+
+def _lib() -> ctypes.CDLL:
+    global _LIB, _LOAD_FAILED
+    if _LIB is not None:
+        return _LIB
+    with _LOAD_LOCK:
+        if _LIB is not None:
+            return _LIB
+        if _LOAD_FAILED:
+            raise MXNetError("native library unavailable (build failed)")
+        if not os.path.exists(_SO_PATH) and not _build():
+            _LOAD_FAILED = True
+            raise MXNetError(
+                "cannot build native/libmxtpu_native.so (no toolchain?)")
+        lib = ctypes.CDLL(_SO_PATH)
+        lib.MXTPUGetLastError.restype = ctypes.c_char_p
+        lib.MXTPURecordIOWriterCreate.restype = ctypes.c_void_p
+        lib.MXTPURecordIOWriterCreate.argtypes = [ctypes.c_char_p]
+        lib.MXTPURecordIOWriterWrite.restype = ctypes.c_int
+        lib.MXTPURecordIOWriterWrite.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint64)]
+        lib.MXTPURecordIOWriterFree.argtypes = [ctypes.c_void_p]
+        lib.MXTPURecordIOReaderCreate.restype = ctypes.c_void_p
+        lib.MXTPURecordIOReaderCreate.argtypes = [ctypes.c_char_p]
+        lib.MXTPURecordIOReaderSeek.restype = ctypes.c_int
+        lib.MXTPURecordIOReaderSeek.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.MXTPURecordIOReaderNext.restype = ctypes.c_int64
+        lib.MXTPURecordIOReaderNext.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_int)]
+        lib.MXTPURecordIOReaderTell.restype = ctypes.c_uint64
+        lib.MXTPURecordIOReaderTell.argtypes = [ctypes.c_void_p]
+        lib.MXTPURecordIOWriterTell.restype = ctypes.c_uint64
+        lib.MXTPURecordIOWriterTell.argtypes = [ctypes.c_void_p]
+        lib.MXTPURecordIOReaderFree.argtypes = [ctypes.c_void_p]
+        lib.MXTPURecordIOIndexBuild.restype = ctypes.c_int64
+        lib.MXTPURecordIOIndexBuild.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64), ctypes.c_int64]
+        lib.MXTPUShmCreate.restype = ctypes.c_void_p
+        lib.MXTPUShmCreate.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        lib.MXTPUShmAttach.restype = ctypes.c_void_p
+        lib.MXTPUShmAttach.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        lib.MXTPUShmPtr.restype = ctypes.c_void_p
+        lib.MXTPUShmPtr.argtypes = [ctypes.c_void_p]
+        lib.MXTPUShmSize.restype = ctypes.c_uint64
+        lib.MXTPUShmSize.argtypes = [ctypes.c_void_p]
+        lib.MXTPUShmFree.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.MXTPUEngineCreate.restype = ctypes.c_void_p
+        lib.MXTPUEngineCreate.argtypes = [ctypes.c_int]
+        lib.MXTPUEngineNewVar.restype = ctypes.c_int64
+        lib.MXTPUEngineNewVar.argtypes = [ctypes.c_void_p]
+        lib.MXTPUEnginePush.argtypes = [
+            ctypes.c_void_p, _TASK_FN, ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int]
+        lib.MXTPUEngineWaitAll.argtypes = [ctypes.c_void_p]
+        lib.MXTPUEngineFree.argtypes = [ctypes.c_void_p]
+        _LIB = lib
+        return _LIB
+
+
+def available() -> bool:
+    try:
+        _lib()
+        return True
+    except MXNetError:
+        return False
+
+
+def last_error() -> str:
+    return _lib().MXTPUGetLastError().decode()
+
+
+# ---------------------------------------------------------------------------
+# RecordIO
+# ---------------------------------------------------------------------------
+
+class NativeRecordWriter:
+    def __init__(self, path: str):
+        self._h = _lib().MXTPURecordIOWriterCreate(path.encode())
+        if not self._h:
+            raise MXNetError(last_error())
+
+    def write(self, buf: bytes) -> int:
+        pos = ctypes.c_uint64()
+        if _lib().MXTPURecordIOWriterWrite(self._h, buf, len(buf),
+                                           ctypes.byref(pos)) != 0:
+            raise MXNetError(last_error())
+        return pos.value
+
+    def tell(self) -> int:
+        return _lib().MXTPURecordIOWriterTell(self._h)
+
+    def close(self):
+        if self._h:
+            _lib().MXTPURecordIOWriterFree(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class NativeRecordReader:
+    def __init__(self, path: str):
+        self._h = _lib().MXTPURecordIOReaderCreate(path.encode())
+        if not self._h:
+            raise MXNetError(last_error())
+
+    def seek(self, pos: int) -> None:
+        _lib().MXTPURecordIOReaderSeek(self._h, pos)
+
+    def read(self) -> Optional[bytes]:
+        out = ctypes.c_char_p()
+        eof = ctypes.c_int()
+        n = _lib().MXTPURecordIOReaderNext(self._h, ctypes.byref(out),
+                                           ctypes.byref(eof))
+        if n < 0:
+            raise MXNetError(last_error())
+        if eof.value:
+            return None
+        return ctypes.string_at(out, n)
+
+    def tell(self) -> int:
+        return _lib().MXTPURecordIOReaderTell(self._h)
+
+    def close(self):
+        if self._h:
+            _lib().MXTPURecordIOReaderFree(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def index_build(path: str) -> List[int]:
+    """Native two-pass index: count records, then fill the exact-size
+    offset array (the C function tolerates a NULL buffer for counting)."""
+    lib = _lib()
+    n = lib.MXTPURecordIOIndexBuild(path.encode(), None, 0)
+    if n < 0:
+        raise MXNetError(last_error())
+    if n == 0:
+        return []
+    arr = (ctypes.c_uint64 * n)()
+    n2 = lib.MXTPURecordIOIndexBuild(path.encode(), arr, n)
+    if n2 < 0:
+        raise MXNetError(last_error())
+    return list(arr[:n2])
+
+
+# ---------------------------------------------------------------------------
+# Shared memory
+# ---------------------------------------------------------------------------
+
+class ShmSegment:
+    """Named POSIX shared memory, zero-copy viewable as a numpy buffer."""
+
+    def __init__(self, name: str, size: int, create: bool = True):
+        lib = _lib()
+        fn = lib.MXTPUShmCreate if create else lib.MXTPUShmAttach
+        self._h = fn(name.encode(), size)
+        if not self._h:
+            raise MXNetError(last_error())
+        self.name = name
+        self.size = size
+        self._create = create
+
+    def as_numpy(self, shape, dtype):
+        import numpy as onp
+
+        class _ShmArray(onp.ndarray):
+            # ndarray subclass so the view can pin the segment: the mapping
+            # must outlive every array built on it.
+            pass
+
+        ptr = _lib().MXTPUShmPtr(self._h)
+        n = int(onp.prod(shape)) * onp.dtype(dtype).itemsize
+        if n > self.size:
+            raise MXNetError(f"shm segment too small: {n} > {self.size}")
+        buf = (ctypes.c_char * n).from_address(ptr)
+        arr = onp.frombuffer(buf, dtype=dtype).reshape(shape).view(_ShmArray)
+        arr._segment = self
+        return arr
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __enter__(self):
+        return self
+
+    def close(self, unlink: Optional[bool] = None):
+        if self._h:
+            _lib().MXTPUShmFree(self._h, 1 if (unlink if unlink is not None
+                                               else self._create) else 0)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# Dependency engine
+# ---------------------------------------------------------------------------
+
+class NativeEngine:
+    """Host-side async executor with read/write var dependencies
+    (ThreadedEngine semantics: concurrent readers, exclusive ordered
+    writers)."""
+
+    def __init__(self, num_workers: int = 0):
+        self._h = _lib().MXTPUEngineCreate(num_workers)
+        # ctypes callbacks stay referenced until wait_all(): freeing one from
+        # inside its own trampoline would unmap the ffi closure the C worker
+        # thread is still returning through.
+        self._keepalive: list = []
+        self._lock = threading.Lock()
+
+    def new_var(self) -> int:
+        return _lib().MXTPUEngineNewVar(self._h)
+
+    def push(self, fn: Callable[[], None],
+             read_vars: Sequence[int] = (),
+             write_vars: Sequence[int] = ()) -> None:
+        def trampoline(_ctx, _fn=fn):
+            _fn()
+
+        cfn = _TASK_FN(trampoline)
+        with self._lock:
+            self._keepalive.append(cfn)
+        rv = (ctypes.c_int64 * max(1, len(read_vars)))(*read_vars)
+        wv = (ctypes.c_int64 * max(1, len(write_vars)))(*write_vars)
+        _lib().MXTPUEnginePush(self._h, cfn, None, rv, len(read_vars),
+                               wv, len(write_vars))
+
+    def wait_all(self) -> None:
+        _lib().MXTPUEngineWaitAll(self._h)
+        # all pushed tasks have returned through their closures; safe to free
+        with self._lock:
+            self._keepalive.clear()
+
+    def close(self):
+        if self._h:
+            _lib().MXTPUEngineWaitAll(self._h)
+            _lib().MXTPUEngineFree(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

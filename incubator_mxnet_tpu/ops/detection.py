@@ -269,11 +269,17 @@ def multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
         boxes = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], -1)
         if clip:
             boxes = jnp.clip(boxes, 0.0, 1.0)
-        # best non-background class per anchor (background assumed class 0,
-        # the reference's default layout)
-        fg = prob[1:] if background_id == 0 else prob
-        cls = jnp.argmax(fg, axis=0)
-        score = jnp.max(fg, axis=0)
+        # best non-background class per anchor; output class ids are
+        # 0-based over the non-background classes (reference convention)
+        C = prob.shape[0]
+        if 0 <= background_id < C:
+            masked = prob.at[background_id].set(-jnp.inf)
+            raw = jnp.argmax(masked, axis=0)
+            cls = jnp.where(raw > background_id, raw - 1, raw)
+            score = jnp.max(masked, axis=0)
+        else:
+            cls = jnp.argmax(prob, axis=0)
+            score = jnp.max(prob, axis=0)
         det = jnp.concatenate([cls[:, None].astype(boxes.dtype),
                                score[:, None], boxes], -1)
         return box_nms(det, overlap_thresh=nms_threshold,

@@ -40,29 +40,58 @@ def _decode_lrec(lrec: int):
     return lrec & ((1 << 29) - 1), lrec >> 29
 
 
+def _native():
+    """The C++ recordio parser (native/mxtpu_native.cc) when buildable."""
+    if os.environ.get("MXTPU_NO_NATIVE"):
+        return None
+    try:
+        from . import native
+        return native if native.available() else None
+    except Exception:
+        return None
+
+
 class MXRecordIO:
-    """Sequential .rec reader/writer (reference: dmlc::RecordIOWriter)."""
+    """Sequential .rec reader/writer (reference: dmlc::RecordIOWriter).
+
+    Uses the C++ parser (native/mxtpu_native.cc — the src/io/ counterpart)
+    when available; the pure-Python path below is the fallback and the
+    format specification."""
 
     def __init__(self, uri: str, flag: str):
         self.uri = uri
         self.flag = flag
         self.handle = None
+        self._nat = None
         self.open()
 
     def open(self):
+        nat = _native()
         if self.flag == "w":
-            self.handle = open(self.uri, "wb")
             self.writable = True
+            if nat is not None:
+                self._nat = nat.NativeRecordWriter(self.uri)
+                self.handle = None
+            else:
+                self.handle = open(self.uri, "wb")
         elif self.flag == "r":
-            self.handle = open(self.uri, "rb")
             self.writable = False
+            if nat is not None:
+                self._nat = nat.NativeRecordReader(self.uri)
+                self.handle = None
+            else:
+                self.handle = open(self.uri, "rb")
         else:
             raise MXNetError(f"Invalid flag {self.flag!r} (use 'r' or 'w')")
         self.is_open = True
 
     def close(self):
         if self.is_open:
-            self.handle.close()
+            if self._nat is not None:
+                self._nat.close()
+                self._nat = None
+            if self.handle is not None:
+                self.handle.close()
             self.is_open = False
 
     def __del__(self):
@@ -82,21 +111,38 @@ class MXRecordIO:
         self.open()
 
     def tell(self) -> int:
+        if self._nat is not None:
+            return self._nat.tell()
         return self.handle.tell()
 
-    def write(self, buf: bytes):
+    def seek(self, pos: int):
+        if self._nat is not None:
+            self._nat.seek(pos)
+        else:
+            self.handle.seek(pos)
+
+    def write(self, buf: bytes) -> int:
+        """Append one record; returns its byte offset."""
         if not self.writable:
             raise MXNetError("recordio not opened for writing")
+        if self._nat is not None:
+            return self._nat.write(buf)
+        pos = self.handle.tell()
+        # NB: unlike the native writer this simple path does not split
+        # payloads containing the magic; the reader handles both layouts.
         self.handle.write(_KMAGIC)
         self.handle.write(struct.pack("<I", _lrec(len(buf), 0)))
         self.handle.write(buf)
         pad = (4 - len(buf) % 4) % 4
         if pad:
             self.handle.write(b"\x00" * pad)
+        return pos
 
     def read(self) -> Optional[bytes]:
         if self.writable:
             raise MXNetError("recordio not opened for reading")
+        if self._nat is not None:
+            return self._nat.read()
         parts: List[bytes] = []
         while True:
             head = self.handle.read(8)
@@ -145,15 +191,14 @@ class MXIndexedRecordIO(MXRecordIO):
         super().close()
 
     def seek(self, idx):
-        self.handle.seek(self.idx[idx])
+        MXRecordIO.seek(self, self.idx[idx])
 
     def read_idx(self, idx) -> bytes:
         self.seek(idx)
         return self.read()
 
     def write_idx(self, idx, buf: bytes):
-        pos = self.tell()
-        self.write(buf)
+        pos = self.write(buf)
         self.idx[idx] = pos
         self.keys.append(idx)
 

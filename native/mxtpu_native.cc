@@ -1,0 +1,482 @@
+// mxtpu_native — the C++ runtime shim.
+//
+// Reference parity (SURVEY §2.2, §2.6, §2.7): the native pieces of the
+// runtime that are NOT subsumed by XLA/PjRt:
+//
+//   1. RecordIO reader/writer (dmlc-core recordio + the C++ parser loop of
+//      src/io/iter_image_recordio_2.cc) — byte-identical wire format to the
+//      Python implementation in recordio.py (magic 0xced7230a framing).
+//   2. CPU shared-memory storage (src/storage/cpu_shared_storage_manager.h)
+//      — named POSIX shm segments for zero-copy DataLoader worker→trainer
+//      batch transfer.
+//   3. Dependency engine (include/mxnet/engine.h, ThreadedEngine) — async
+//      task execution with read/write dependencies on integer vars, used for
+//      the host-side decode/augment pipeline. Device scheduling itself is
+//      XLA's job; this engine covers the host half the reference ran on its
+//      CPU worker pool.
+//
+// Exposed as a flat C ABI (c_api.cc parity: MXTPU* functions, last-error
+// string per thread), loaded from Python via ctypes (native.py).
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <functional>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#define MXTPU_API extern "C" __attribute__((visibility("default")))
+
+namespace {
+
+thread_local std::string g_last_error;
+
+void SetError(const std::string& msg) { g_last_error = msg; }
+
+constexpr uint32_t kMagic = 0xced7230a;
+
+inline uint32_t EncodeLRec(uint32_t cflag, uint32_t length) {
+  return (cflag << 29U) | length;
+}
+inline uint32_t DecodeFlag(uint32_t rec) { return rec >> 29U; }
+inline uint32_t DecodeLength(uint32_t rec) { return rec & ((1U << 29U) - 1U); }
+
+// ---------------------------------------------------------------------------
+// RecordIO
+// ---------------------------------------------------------------------------
+
+struct RecordWriter {
+  FILE* fp = nullptr;
+};
+
+struct RecordReader {
+  FILE* fp = nullptr;
+  std::vector<char> buf;
+};
+
+}  // namespace
+
+MXTPU_API const char* MXTPUGetLastError() { return g_last_error.c_str(); }
+
+MXTPU_API void* MXTPURecordIOWriterCreate(const char* path) {
+  FILE* fp = std::fopen(path, "wb");
+  if (!fp) {
+    SetError(std::string("cannot open for write: ") + path);
+    return nullptr;
+  }
+  auto* w = new RecordWriter();
+  w->fp = fp;
+  return w;
+}
+
+MXTPU_API int MXTPURecordIOWriterWrite(void* handle, const char* data,
+                                       uint64_t size, uint64_t* out_pos) {
+  auto* w = static_cast<RecordWriter*>(handle);
+  if (out_pos) *out_pos = static_cast<uint64_t>(std::ftell(w->fp));
+  // dmlc semantics: split the payload at embedded magics; the reader joins
+  // the parts back with the magic re-inserted.
+  std::vector<uint64_t> splits;
+  for (uint64_t i = 0; i + 4 <= size; ++i) {
+    uint32_t word;
+    std::memcpy(&word, data + i, 4);
+    if (word == kMagic) {
+      splits.push_back(i);
+      i += 3;
+    }
+  }
+  auto write_chunk = [&](const char* p, uint32_t len, uint32_t cflag) -> bool {
+    uint32_t head[2] = {kMagic, EncodeLRec(cflag, len)};
+    if (std::fwrite(head, 4, 2, w->fp) != 2) return false;
+    if (len && std::fwrite(p, 1, len, w->fp) != len) return false;
+    uint32_t pad = (4 - len % 4) % 4;
+    static const char zeros[4] = {0, 0, 0, 0};
+    if (pad && std::fwrite(zeros, 1, pad, w->fp) != pad) return false;
+    return true;
+  };
+  bool ok;
+  if (splits.empty()) {
+    ok = write_chunk(data, static_cast<uint32_t>(size), 0);
+  } else {
+    uint64_t begin = 0;
+    for (size_t k = 0; k <= splits.size(); ++k) {
+      uint64_t end = (k < splits.size()) ? splits[k] : size;
+      uint32_t cflag = (k == 0) ? 1U : (k == splits.size()) ? 3U : 2U;
+      ok = write_chunk(data + begin, static_cast<uint32_t>(end - begin), cflag);
+      if (!ok) break;
+      begin = end + 4;  // skip the magic itself
+    }
+  }
+  if (!ok) {
+    SetError("recordio write failed");
+    return -1;
+  }
+  return 0;
+}
+
+MXTPU_API void MXTPURecordIOWriterFree(void* handle) {
+  auto* w = static_cast<RecordWriter*>(handle);
+  if (w->fp) std::fclose(w->fp);
+  delete w;
+}
+
+MXTPU_API void* MXTPURecordIOReaderCreate(const char* path) {
+  FILE* fp = std::fopen(path, "rb");
+  if (!fp) {
+    SetError(std::string("cannot open for read: ") + path);
+    return nullptr;
+  }
+  auto* r = new RecordReader();
+  r->fp = fp;
+  return r;
+}
+
+MXTPU_API int MXTPURecordIOReaderSeek(void* handle, uint64_t pos) {
+  auto* r = static_cast<RecordReader*>(handle);
+  return std::fseek(r->fp, static_cast<long>(pos), SEEK_SET);
+}
+
+// Returns record size (>= 0), -1 on error. *eof is set to 1 on clean EOF
+// (return 0 + eof=0 is a legitimate empty record). Data pointer valid until
+// the next call (owned by the reader's buffer).
+MXTPU_API int64_t MXTPURecordIOReaderNext(void* handle, const char** out,
+                                          int* eof) {
+  auto* r = static_cast<RecordReader*>(handle);
+  r->buf.clear();
+  *eof = 0;
+  while (true) {
+    uint32_t head[2];
+    size_t n = std::fread(head, 4, 2, r->fp);
+    if (n == 0 && r->buf.empty()) {
+      *eof = 1;
+      return 0;  // clean EOF
+    }
+    if (n != 2) {
+      if (r->buf.empty()) {
+        *eof = 1;
+        return 0;
+      }
+      SetError("truncated record header");
+      return -1;
+    }
+    if (head[0] != kMagic) {
+      SetError("bad record magic");
+      return -1;
+    }
+    uint32_t len = DecodeLength(head[1]);
+    uint32_t cflag = DecodeFlag(head[1]);
+    if (!r->buf.empty()) {
+      // continuation: re-insert the magic the writer split on
+      const char* m = reinterpret_cast<const char*>(&kMagic);
+      r->buf.insert(r->buf.end(), m, m + 4);
+    }
+    size_t old = r->buf.size();
+    r->buf.resize(old + len);
+    if (len && std::fread(r->buf.data() + old, 1, len, r->fp) != len) {
+      SetError("truncated record payload");
+      return -1;
+    }
+    uint32_t pad = (4 - len % 4) % 4;
+    if (pad) std::fseek(r->fp, pad, SEEK_CUR);
+    if (cflag == 0 || cflag == 3) {
+      static const char kEmpty[1] = {0};
+      *out = r->buf.empty() ? kEmpty : r->buf.data();
+      return static_cast<int64_t>(r->buf.size());
+    }
+  }
+}
+
+MXTPU_API uint64_t MXTPURecordIOReaderTell(void* handle) {
+  return static_cast<uint64_t>(
+      std::ftell(static_cast<RecordReader*>(handle)->fp));
+}
+
+MXTPU_API uint64_t MXTPURecordIOWriterTell(void* handle) {
+  return static_cast<uint64_t>(
+      std::ftell(static_cast<RecordWriter*>(handle)->fp));
+}
+
+MXTPU_API void MXTPURecordIOReaderFree(void* handle) {
+  auto* r = static_cast<RecordReader*>(handle);
+  if (r->fp) std::fclose(r->fp);
+  delete r;
+}
+
+// Build an index (offset of every top-level record) in one native pass.
+// Returns count, fills out_offsets (caller-allocated, max_count entries).
+MXTPU_API int64_t MXTPURecordIOIndexBuild(const char* path,
+                                          uint64_t* out_offsets,
+                                          int64_t max_count) {
+  FILE* fp = std::fopen(path, "rb");
+  if (!fp) {
+    SetError(std::string("cannot open: ") + path);
+    return -1;
+  }
+  int64_t count = 0;
+  bool in_continuation = false;
+  while (true) {
+    long pos = std::ftell(fp);
+    uint32_t head[2];
+    if (std::fread(head, 4, 2, fp) != 2) break;
+    if (head[0] != kMagic) {
+      SetError("bad record magic while indexing");
+      std::fclose(fp);
+      return -1;
+    }
+    uint32_t len = DecodeLength(head[1]);
+    uint32_t cflag = DecodeFlag(head[1]);
+    if (!in_continuation) {
+      if (count < max_count && out_offsets)
+        out_offsets[count] = static_cast<uint64_t>(pos);
+      ++count;
+    }
+    in_continuation = (cflag == 1 || cflag == 2);
+    uint32_t skip = len + (4 - len % 4) % 4;
+    std::fseek(fp, skip, SEEK_CUR);
+  }
+  std::fclose(fp);
+  return count;
+}
+
+// ---------------------------------------------------------------------------
+// Shared-memory storage (CPUSharedStorageManager parity)
+// ---------------------------------------------------------------------------
+
+namespace {
+struct ShmSegment {
+  std::string name;
+  void* addr = nullptr;
+  uint64_t size = 0;
+  bool owner = false;
+};
+}  // namespace
+
+MXTPU_API void* MXTPUShmCreate(const char* name, uint64_t size) {
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) {
+    SetError(std::string("shm_open create failed: ") + name);
+    return nullptr;
+  }
+  if (ftruncate(fd, static_cast<off_t>(size)) != 0) {
+    close(fd);
+    shm_unlink(name);
+    SetError("ftruncate failed");
+    return nullptr;
+  }
+  void* addr = mmap(nullptr, size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (addr == MAP_FAILED) {
+    shm_unlink(name);
+    SetError("mmap failed");
+    return nullptr;
+  }
+  auto* seg = new ShmSegment{name, addr, size, true};
+  return seg;
+}
+
+MXTPU_API void* MXTPUShmAttach(const char* name, uint64_t size) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) {
+    SetError(std::string("shm_open attach failed: ") + name);
+    return nullptr;
+  }
+  void* addr = mmap(nullptr, size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (addr == MAP_FAILED) {
+    SetError("mmap failed");
+    return nullptr;
+  }
+  auto* seg = new ShmSegment{name, addr, size, false};
+  return seg;
+}
+
+MXTPU_API void* MXTPUShmPtr(void* handle) {
+  return static_cast<ShmSegment*>(handle)->addr;
+}
+
+MXTPU_API uint64_t MXTPUShmSize(void* handle) {
+  return static_cast<ShmSegment*>(handle)->size;
+}
+
+MXTPU_API void MXTPUShmFree(void* handle, int unlink) {
+  auto* seg = static_cast<ShmSegment*>(handle);
+  munmap(seg->addr, seg->size);
+  if (unlink && seg->owner) shm_unlink(seg->name.c_str());
+  delete seg;
+}
+
+// ---------------------------------------------------------------------------
+// Dependency engine (ThreadedEngine parity, host-side)
+// ---------------------------------------------------------------------------
+
+namespace {
+
+using TaskFn = void (*)(void* ctx);
+
+struct Engine;
+
+struct Task {
+  TaskFn fn;
+  void* ctx;
+  std::vector<int64_t> read_vars;
+  std::vector<int64_t> write_vars;
+  int wait_count = 0;
+  int64_t id = 0;
+};
+
+// Per-var FIFO queue discipline: readers run concurrently, writers
+// exclusively, in push order — exactly ThreadedVar's semantics
+// (src/engine/threaded_engine.cc AppendReadDependency/WriteDependency).
+struct VarQueue {
+  std::deque<std::pair<Task*, bool>> pending;  // (task, is_write)
+  int running_readers = 0;
+  bool running_writer = false;
+};
+
+struct Engine {
+  std::vector<std::thread> workers;
+  std::deque<Task*> ready;
+  std::unordered_map<int64_t, VarQueue> vars;
+  std::mutex mu;
+  std::condition_variable cv;
+  std::condition_variable idle_cv;
+  std::atomic<int64_t> next_var{1};
+  int64_t inflight = 0;
+  bool shutdown = false;
+
+  void WorkerLoop() {
+    while (true) {
+      Task* t = nullptr;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv.wait(lk, [&] { return shutdown || !ready.empty(); });
+        if (shutdown && ready.empty()) return;
+        t = ready.front();
+        ready.pop_front();
+      }
+      t->fn(t->ctx);
+      Complete(t);
+    }
+  }
+
+  void Schedule(Task* t) {  // caller holds mu
+    ready.push_back(t);
+    cv.notify_one();
+  }
+
+  // Try to start queue heads for one var; caller holds mu.
+  void Advance(int64_t var) {
+    auto& q = vars[var];
+    while (!q.pending.empty()) {
+      auto [t, is_write] = q.pending.front();
+      if (is_write) {
+        if (q.running_readers > 0 || q.running_writer) break;
+        q.running_writer = true;
+        q.pending.pop_front();
+        if (--t->wait_count == 0) Schedule(t);
+      } else {
+        if (q.running_writer) break;
+        ++q.running_readers;
+        q.pending.pop_front();
+        if (--t->wait_count == 0) Schedule(t);
+        continue;  // more readers may start
+      }
+      break;
+    }
+  }
+
+  void Push(Task* t) {
+    std::unique_lock<std::mutex> lk(mu);
+    ++inflight;
+    t->wait_count = static_cast<int>(t->read_vars.size() +
+                                     t->write_vars.size());
+    if (t->wait_count == 0) {
+      Schedule(t);
+      return;
+    }
+    for (int64_t v : t->read_vars) {
+      vars[v].pending.emplace_back(t, false);
+      Advance(v);
+    }
+    for (int64_t v : t->write_vars) {
+      vars[v].pending.emplace_back(t, true);
+      Advance(v);
+    }
+  }
+
+  void Complete(Task* t) {
+    std::unique_lock<std::mutex> lk(mu);
+    for (int64_t v : t->read_vars) {
+      --vars[v].running_readers;
+      Advance(v);
+    }
+    for (int64_t v : t->write_vars) {
+      vars[v].running_writer = false;
+      Advance(v);
+    }
+    --inflight;
+    if (inflight == 0) idle_cv.notify_all();
+    delete t;
+  }
+
+  void WaitAll() {
+    std::unique_lock<std::mutex> lk(mu);
+    idle_cv.wait(lk, [&] { return inflight == 0; });
+  }
+};
+
+}  // namespace
+
+MXTPU_API void* MXTPUEngineCreate(int num_workers) {
+  auto* e = new Engine();
+  int n = num_workers > 0 ? num_workers
+                          : static_cast<int>(std::thread::hardware_concurrency());
+  for (int i = 0; i < n; ++i) {
+    e->workers.emplace_back([e] { e->WorkerLoop(); });
+  }
+  return e;
+}
+
+MXTPU_API int64_t MXTPUEngineNewVar(void* handle) {
+  return static_cast<Engine*>(handle)->next_var.fetch_add(1);
+}
+
+MXTPU_API void MXTPUEnginePush(void* handle, TaskFn fn, void* ctx,
+                               const int64_t* read_vars, int n_read,
+                               const int64_t* write_vars, int n_write) {
+  auto* e = static_cast<Engine*>(handle);
+  auto* t = new Task();
+  t->fn = fn;
+  t->ctx = ctx;
+  t->read_vars.assign(read_vars, read_vars + n_read);
+  t->write_vars.assign(write_vars, write_vars + n_write);
+  e->Push(t);
+}
+
+MXTPU_API void MXTPUEngineWaitAll(void* handle) {
+  static_cast<Engine*>(handle)->WaitAll();
+}
+
+MXTPU_API void MXTPUEngineFree(void* handle) {
+  auto* e = static_cast<Engine*>(handle);
+  e->WaitAll();
+  {
+    std::unique_lock<std::mutex> lk(e->mu);
+    e->shutdown = true;
+    e->cv.notify_all();
+  }
+  for (auto& th : e->workers) th.join();
+  delete e;
+}

@@ -29,23 +29,25 @@ def test_lenet_gluon_converges_digits():
     """The stage-4 gate: data iter -> hybridized conv net -> autograd ->
     Trainer -> metric, accuracy >= 0.95 held out."""
     Xtr, Ytr, Xte, Yte = _digits()
+    mx.random.seed(42)   # deterministic init: this is a convergence gate,
+    onp.random.seed(42)  # not a seed-robustness sweep
     # 8x8 images: trim LeNet kernels via a small variant of the same shape
     net = gluon.nn.HybridSequential()
-    net.add(gluon.nn.Conv2D(16, 3, padding=1, activation="tanh"),
+    net.add(gluon.nn.Conv2D(16, 3, padding=1, activation="relu"),
             gluon.nn.MaxPool2D(2, 2),
-            gluon.nn.Conv2D(32, 3, padding=1, activation="tanh"),
+            gluon.nn.Conv2D(32, 3, padding=1, activation="relu"),
             gluon.nn.MaxPool2D(2, 2),
             gluon.nn.Flatten(),
-            gluon.nn.Dense(128, activation="tanh"),
+            gluon.nn.Dense(128, activation="relu"),
             gluon.nn.Dense(10))
     net.initialize(mx.init.Xavier())
     net.hybridize()
     trainer = gluon.Trainer(net.collect_params(), "adam",
-                            {"learning_rate": 2e-3})
+                            {"learning_rate": 3e-3})
     loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
     it = mio.NDArrayIter(Xtr, Ytr, batch_size=100, shuffle=True,
                          last_batch_handle="discard")
-    for epoch in range(6):
+    for epoch in range(10):
         it.reset()
         for batch in it:
             with mx.autograd.record():
