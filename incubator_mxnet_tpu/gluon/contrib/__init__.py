@@ -1,0 +1,5 @@
+"""gluon.contrib (reference: python/mxnet/gluon/contrib — SURVEY §2.8):
+SyncBatchNorm, the estimator fit loop, and misc experimental blocks."""
+from ..nn.basic_layers import SyncBatchNorm  # noqa: F401
+from . import estimator  # noqa: F401
+from .estimator import Estimator  # noqa: F401

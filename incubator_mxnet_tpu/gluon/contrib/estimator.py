@@ -1,0 +1,130 @@
+"""Estimator — the packaged Gluon fit loop.
+
+Reference parity: ``python/mxnet/gluon/contrib/estimator/estimator.py`` —
+``Estimator(net, loss, metrics, trainer).fit(train_data, val_data, epochs)``
+with event handlers (epoch/batch begin/end).
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import List, Optional, Sequence
+
+from ... import autograd
+from ... import metric as metric_mod
+from ...ndarray import NDArray
+from ..trainer import Trainer
+
+__all__ = ["Estimator", "TrainBegin", "TrainEnd", "EpochBegin", "EpochEnd",
+           "BatchBegin", "BatchEnd"]
+
+
+class TrainBegin:
+    def train_begin(self, estimator):
+        pass
+
+
+class TrainEnd:
+    def train_end(self, estimator):
+        pass
+
+
+class EpochBegin:
+    def epoch_begin(self, estimator):
+        pass
+
+
+class EpochEnd:
+    def epoch_end(self, estimator):
+        pass
+
+
+class BatchBegin:
+    def batch_begin(self, estimator, batch):
+        pass
+
+
+class BatchEnd:
+    def batch_end(self, estimator, batch, loss):
+        pass
+
+
+class Estimator:
+    def __init__(self, net, loss, train_metrics=None, trainer: Optional[Trainer] = None,
+                 context=None, logger=None):
+        self.net = net
+        self.loss = loss
+        mets = train_metrics or [metric_mod.Accuracy()]
+        self.train_metrics = mets if isinstance(mets, (list, tuple)) else [mets]
+        self.trainer = trainer or Trainer(
+            net.collect_params(), "adam", {"learning_rate": 1e-3})
+        self.logger = logger or logging.getLogger("estimator")
+        self.epoch = 0
+
+    def _batch_fn(self, batch):
+        data = batch.data[0] if hasattr(batch, "data") else batch[0]
+        label = batch.label[0] if hasattr(batch, "label") else batch[1]
+        return data, label
+
+    def evaluate(self, val_data, metrics=None):
+        metrics = metrics or self.train_metrics
+        for m in metrics:
+            m.reset()
+        val_data.reset()
+        for batch in val_data:
+            data, label = self._batch_fn(batch)
+            with autograd.predict_mode():
+                out = self.net(data)
+            for m in metrics:
+                m.update(label, out)
+        return [(m.name, m.get()[1]) for m in metrics]
+
+    def fit(self, train_data, val_data=None, epochs: int = 1,
+            event_handlers: Sequence = (), batches: Optional[int] = None):
+        handlers = list(event_handlers)
+        for h in handlers:
+            if isinstance(h, TrainBegin):
+                h.train_begin(self)
+        for epoch in range(epochs):
+            self.epoch = epoch
+            for m in self.train_metrics:
+                m.reset()
+            for h in handlers:
+                if isinstance(h, EpochBegin):
+                    h.epoch_begin(self)
+            train_data.reset()
+            t0 = time.time()
+            n = 0
+            for batch in train_data:
+                if batches is not None and n >= batches:
+                    break
+                for h in handlers:
+                    if isinstance(h, BatchBegin):
+                        h.batch_begin(self, batch)
+                data, label = self._batch_fn(batch)
+                bs = data.shape[0]
+                with autograd.record():
+                    out = self.net(data)
+                    loss = self.loss(out, label).mean()
+                loss.backward()
+                self.trainer.step(bs)
+                for m in self.train_metrics:
+                    m.update(label, out)
+                for h in handlers:
+                    if isinstance(h, BatchEnd):
+                        h.batch_end(self, batch, loss)
+                n += 1
+            msg = f"Epoch[{epoch}] {time.time() - t0:.1f}s " + " ".join(
+                f"train-{m.name}={m.get()[1]:.4f}" for m in self.train_metrics)
+            if val_data is not None:
+                msg += " " + " ".join(
+                    f"val-{name}={v:.4f}"
+                    for name, v in self.evaluate(val_data))
+            self.logger.info(msg)
+            for h in handlers:
+                if isinstance(h, EpochEnd):
+                    h.epoch_end(self)
+        for h in handlers:
+            if isinstance(h, TrainEnd):
+                h.train_end(self)
+        return self

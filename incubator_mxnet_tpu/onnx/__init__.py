@@ -1,0 +1,195 @@
+"""ONNX interop (reference: python/mxnet/onnx — SURVEY §2.7).
+
+The ``onnx`` package is not part of this build's frozen environment, so the
+conversion surface is API-complete but gated: with ``onnx`` installed,
+``export_model`` emits a real ModelProto for symbol graphs made of the
+supported op set; without it, a clear MXNetError explains the gate.
+
+The deploy-format story on TPU is StableHLO (``HybridBlock.export`` /
+``jax.export``) — ONNX remains for ecosystem exchange only.
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as onp
+
+from ..base import MXNetError
+
+__all__ = ["export_model", "import_model", "get_model_metadata"]
+
+
+def _require_onnx():
+    try:
+        import onnx  # noqa: F401
+        return onnx
+    except ImportError:
+        raise MXNetError(
+            "ONNX interop requires the 'onnx' package, which is not "
+            "installed in this environment. Use HybridBlock.export() "
+            "(StableHLO + params) for the TPU-native deploy format.")
+
+
+#: symbol-op -> (onnx op type, attr mapper)
+_OP_MAP = {
+    "FullyConnected": "Gemm",
+    "Convolution": "Conv",
+    "Activation": "Relu",  # refined by act_type
+    "flatten": "Flatten",
+    "Flatten": "Flatten",
+    "Pooling": "MaxPool",
+    "softmax": "Softmax",
+    "SoftmaxOutput": "Softmax",
+    "broadcast_add": "Add",
+    "broadcast_sub": "Sub",
+    "broadcast_mul": "Mul",
+    "broadcast_div": "Div",
+    "concat": "Concat",
+    "relu": "Relu",
+    "sigmoid": "Sigmoid",
+    "tanh": "Tanh",
+}
+
+_ACT_MAP = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+            "softrelu": "Softplus"}
+
+
+def export_model(sym, params: Dict, input_shape: Sequence[Tuple[int, ...]],
+                 input_type=onp.float32, onnx_file_path: str = "model.onnx",
+                 verbose: bool = False, opset_version: Optional[int] = None):
+    """Export a symbol + params dict to an ONNX file
+    (reference: mx.onnx.export_model)."""
+    onnx = _require_onnx()
+    from onnx import TensorProto, helper, numpy_helper
+
+    from ..symbol import Symbol, _topo
+
+    if not isinstance(sym, Symbol):
+        raise MXNetError("export_model expects a Symbol (use "
+                         "HybridBlock.export for Gluon models)")
+    nodes = _topo(sym)
+    arg_names = sym.list_arguments()
+    data_names = [n for n in arg_names if n not in params]
+    if len(data_names) != len(input_shape):
+        data_names = data_names[:len(input_shape)]
+
+    inits, inputs, onnx_nodes = [], [], []
+    for name, shape in zip(data_names, input_shape):
+        inputs.append(helper.make_tensor_value_info(
+            name, TensorProto.FLOAT, list(shape)))
+    for name, arr in params.items():
+        a = arr.asnumpy() if hasattr(arr, "asnumpy") else onp.asarray(arr)
+        inits.append(numpy_helper.from_array(a.astype(onp.float32), name))
+
+    out_names = {}
+    for node in nodes:
+        if node._op is None and node._base is None:
+            out_names[id(node)] = node._name
+            continue
+        op = node._op
+        if op not in _OP_MAP:
+            raise MXNetError(f"op {op!r} has no ONNX mapping yet")
+        onnx_op = _OP_MAP[op]
+        attrs = {}
+        if op == "Activation":
+            onnx_op = _ACT_MAP.get(node._attrs.get("act_type", "relu"), "Relu")
+        if op == "Pooling" and node._attrs.get("pool_type") == "avg":
+            onnx_op = "AveragePool"
+        if onnx_op in ("MaxPool", "AveragePool"):
+            attrs["kernel_shape"] = list(node._attrs.get("kernel", (2, 2)))
+            attrs["strides"] = list(node._attrs.get("stride", (1, 1)))
+        if onnx_op == "Conv":
+            attrs["kernel_shape"] = list(node._attrs.get("kernel", (1, 1)))
+            attrs["strides"] = list(node._attrs.get("stride", (1, 1)) or (1, 1))
+            attrs["pads"] = list(node._attrs.get("pad", (0, 0)) or (0, 0)) * 2
+        if onnx_op == "Gemm":
+            attrs.update(alpha=1.0, beta=1.0, transA=0, transB=1)
+        ins = [out_names[id(i)] for i in node._inputs
+               if id(i) in out_names]
+        if op == "SoftmaxOutput":
+            ins = ins[:1]
+        name = node._name
+        out_names[id(node)] = name
+        onnx_nodes.append(helper.make_node(onnx_op, ins, [name], name=name,
+                                           **attrs))
+
+    out_shapes = sym.infer_shape(**{n: s for n, s in
+                                    zip(data_names, input_shape)})[1]
+    outputs = [helper.make_tensor_value_info(
+        out_names[id(nodes[-1])], TensorProto.FLOAT, list(out_shapes[0]))]
+    graph = helper.make_graph(onnx_nodes, "incubator_mxnet_tpu", inputs,
+                              outputs, initializer=inits)
+    model = helper.make_model(graph)
+    onnx.save(model, onnx_file_path)
+    return onnx_file_path
+
+
+def import_model(model_file: str):
+    """Import an ONNX model into (sym, arg_params, aux_params)
+    (reference: mx.onnx.import_model). Supports the same op subset as
+    export."""
+    onnx = _require_onnx()
+    from onnx import numpy_helper
+    from .. import symbol as S
+    from ..ndarray import array
+
+    model = onnx.load(model_file)
+    g = model.graph
+    params = {init.name: array(numpy_helper.to_array(init))
+              for init in g.initializer}
+    env: Dict[str, S.Symbol] = {}
+    for vi in g.input:
+        if vi.name not in params:
+            env[vi.name] = S.Variable(vi.name)
+    for name in params:
+        env[name] = S.Variable(name)
+    _REV = {"Gemm": "FullyConnected", "Conv": "Convolution", "Relu": "relu",
+            "Sigmoid": "sigmoid", "Tanh": "tanh", "Softmax": "softmax",
+            "Add": "broadcast_add", "Sub": "broadcast_sub",
+            "Mul": "broadcast_mul", "Div": "broadcast_div",
+            "Flatten": "flatten", "MaxPool": "Pooling",
+            "AveragePool": "Pooling"}
+    for node in g.node:
+        if node.op_type not in _REV:
+            raise MXNetError(f"ONNX op {node.op_type!r} unsupported on import")
+        op = _REV[node.op_type]
+        ins = [env[i] for i in node.input if i in env]
+        attrs = {a.name: onnx.helper.get_attribute_value(a)
+                 for a in node.attribute}
+        kw = {}
+        if op == "FullyConnected":
+            w = params.get(node.input[1])
+            kw["num_hidden"] = int(w.shape[0]) if w is not None else 0
+        if op == "Convolution":
+            kw["kernel"] = tuple(attrs.get("kernel_shape", (1, 1)))
+            kw["stride"] = tuple(attrs.get("strides", (1, 1)))
+            pads = attrs.get("pads", [0, 0, 0, 0])
+            kw["pad"] = tuple(pads[:2])
+            w = params.get(node.input[1])
+            kw["num_filter"] = int(w.shape[0]) if w is not None else 0
+        if op == "Pooling":
+            kw["pool_type"] = "avg" if node.op_type == "AveragePool" else "max"
+            kw["kernel"] = tuple(attrs.get("kernel_shape", (2, 2)))
+            kw["stride"] = tuple(attrs.get("strides", (1, 1)))
+        env[node.output[0]] = S.Symbol(op, ins, attrs=kw, name=node.name or None)
+    out = env[g.output[0].name] if g.output[0].name in env else \
+        env[g.node[-1].output[0]]
+    return out, params, {}
+
+
+def get_model_metadata(model_file: str) -> Dict:
+    onnx = _require_onnx()
+    model = onnx.load(model_file)
+    g = model.graph
+    init_names = {i.name for i in g.initializer}
+    return {
+        "input_tensor_data": [(vi.name,
+                               tuple(d.dim_value
+                                     for d in vi.type.tensor_type.shape.dim))
+                              for vi in g.input if vi.name not in init_names],
+        "output_tensor_data": [(vi.name,
+                                tuple(d.dim_value
+                                      for d in vi.type.tensor_type.shape.dim))
+                               for vi in g.output],
+    }
