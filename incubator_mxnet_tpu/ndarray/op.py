@@ -43,19 +43,26 @@ def make_nd_op(opdef):
         # `name`/`ctx` are accepted for API parity with generated MXNet ops
         kwargs.pop("name", None)
         ctx = kwargs.pop("ctx", None)
-        # Normalize: convert raw numpy/lists in tensor positions
+        # Normalize: convert raw numpy/lists in tensor positions. NDArrays
+        # passed by keyword (e.g. LeakyReLU(x, gamma=alpha)) are tape inputs
+        # too — gradients must flow through them.
         arr_pos = [i for i, a in enumerate(args) if isinstance(a, NDArray)]
-        if not arr_pos:
+        arr_keys = [k for k, a in kwargs.items() if isinstance(a, NDArray)]
+        if not arr_pos and not arr_keys:
             raise TypeError(f"{opname} expects at least one NDArray argument")
-        ctx = ctx or args[arr_pos[0]].context
-        arrays = [args[i] for i in arr_pos]
+        ctx = ctx or (args[arr_pos[0]] if arr_pos else
+                      kwargs[arr_keys[0]]).context
+        arrays = [args[i] for i in arr_pos] + [kwargs[k] for k in arr_keys]
         static_args = list(args)
 
         def pure(*vals):
             full = list(static_args)
             for i, v in zip(arr_pos, vals):
                 full[i] = v
-            return fn(*full, **kwargs)
+            kw = dict(kwargs)
+            for k, v in zip(arr_keys, vals[len(arr_pos):]):
+                kw[k] = v
+            return fn(*full, **kw)
 
         result = dispatch_op(pure, arrays, kwargs, ctx, name=opname)
         if out is not None:

@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .registry import register_op
+from .registry import Field, Schema, Shape, register_op
 
 # ---------------------------------------------------------------------------
 # helpers
@@ -38,8 +38,16 @@ def _tup(v, n):
 # FullyConnected (reference: fully_connected.cc — cuBLAS gemm → MXU)
 # ---------------------------------------------------------------------------
 
-@register_op("FullyConnected", aliases=("fully_connected",))
-def fully_connected(data, weight, bias=None, num_hidden=None, no_bias=False, flatten=True, **_):
+@register_op("FullyConnected", aliases=("fully_connected",), schema=Schema(
+    num_hidden=Field(int, None, "Number of hidden units (inferred from the "
+                     "weight shape when omitted).", nullable=True),
+    no_bias=Field(bool, False, "Whether to disable the bias term."),
+    flatten=Field(bool, True, "Collapse all axes but the first before the "
+                  "matmul (reference FullyConnectedParam::flatten)."),
+))
+def fully_connected(data, weight, bias=None, num_hidden=None, no_bias=False, flatten=True):
+    """Linear transform y = x·Wᵀ + b (reference:
+    src/operator/nn/fully_connected.cc) — one MXU matmul."""
     if flatten:
         x = data.reshape(data.shape[0], -1)
     else:
@@ -61,9 +69,28 @@ def _conv_dims(kernel):
     return len(kernel) if not isinstance(kernel, int) else 1
 
 
-@register_op("Convolution", aliases=("convolution",))
+@register_op("Convolution", aliases=("convolution",), schema=Schema(
+    ignore=("cudnn_tune", "cudnn_off", "workspace"),
+    kernel=Field(Shape, describe="Convolution kernel size, e.g. (3, 3)."),
+    stride=Field(Shape, None, "Convolution stride; defaults to 1 per dim.",
+                 nullable=True),
+    dilate=Field(Shape, None, "Convolution dilation; defaults to 1 per dim.",
+                 nullable=True),
+    pad=Field(Shape, None, "Zero-padding per spatial dim; defaults to 0.",
+              nullable=True),
+    num_filter=Field(int, None, "Number of output channels (inferred from "
+                     "the weight when omitted).", nullable=True, ge=1),
+    num_group=Field(int, 1, "Grouped-convolution group count "
+                    "(feature_group_count in the XLA lowering).", ge=1),
+    no_bias=Field(bool, False, "Whether to disable the bias term."),
+    layout=Field(str, None, "Data layout; only the reference default "
+                 "NC(DHW) layouts are supported.", nullable=True,
+                 choices=("NCW", "NCHW", "NCDHW")),
+))
 def convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
-                pad=None, num_filter=None, num_group=1, no_bias=False, layout=None, **_):
+                pad=None, num_filter=None, num_group=1, no_bias=False, layout=None):
+    """N-d convolution over NC(DHW) via lax.conv_general_dilated (reference:
+    src/operator/nn/convolution.cc + cudnn wrappers, subsumed by XLA)."""
     nd = _conv_dims(kernel)
     stride = _tup(stride, nd)
     dilate = _tup(dilate, nd)
@@ -83,10 +110,27 @@ def convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
     return out
 
 
-@register_op("Deconvolution", aliases=("deconvolution",))
+@register_op("Deconvolution", aliases=("deconvolution",), schema=Schema(
+    ignore=("cudnn_tune", "cudnn_off", "workspace"),
+    kernel=Field(Shape, describe="Deconvolution kernel size."),
+    stride=Field(Shape, None, "Stride (lhs_dilation in the XLA lowering).",
+                 nullable=True),
+    dilate=Field(Shape, None, "Dilation.", nullable=True),
+    pad=Field(Shape, None, "Padding removed from the output.", nullable=True),
+    adj=Field(Shape, None, "Output-size adjustment per spatial dim.",
+              nullable=True),
+    num_filter=Field(int, None, "Number of output channels.", nullable=True,
+                     ge=1),
+    num_group=Field(int, 1, "Group count.", ge=1),
+    no_bias=Field(bool, False, "Whether to disable the bias term."),
+    target_shape=Field(Shape, None, "Explicit output spatial shape.",
+                       nullable=True),
+    layout=Field(str, None, "Data layout.", nullable=True,
+                 choices=("NCW", "NCHW", "NCDHW")),
+))
 def deconvolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
                   pad=None, adj=None, num_filter=None, num_group=1, no_bias=False,
-                  target_shape=None, layout=None, **_):
+                  target_shape=None, layout=None):
     nd = _conv_dims(kernel)
     stride = _tup(stride, nd)
     pad = _tup(pad if pad is not None else 0, nd)
@@ -120,9 +164,23 @@ def deconvolution(data, weight, bias=None, kernel=None, stride=None, dilate=None
 # Pooling (reference: pooling.cc → lax.reduce_window)
 # ---------------------------------------------------------------------------
 
-@register_op("Pooling", aliases=("pooling",))
+@register_op("Pooling", aliases=("pooling",), schema=Schema(
+    ignore=("cudnn_off", "p_value"),
+    kernel=Field(Shape, None, "Pooling window size.", nullable=True),
+    pool_type=Field(str, "max", "Pooling reduction.",
+                    choices=("max", "avg", "sum", "lp")),
+    global_pool=Field(bool, False, "Pool over the whole spatial extent."),
+    stride=Field(Shape, None, "Window stride; defaults to 1 per dim.",
+                 nullable=True),
+    pad=Field(Shape, None, "Zero padding; defaults to 0.", nullable=True),
+    pooling_convention=Field(str, "valid", "Output-size rounding rule.",
+                             choices=("valid", "full", "same")),
+    count_include_pad=Field(bool, True, "Average counts padded cells."),
+    layout=Field(str, None, "Data layout.", nullable=True,
+                 choices=("NCW", "NCHW", "NCDHW")),
+))
 def pooling(data, kernel=None, pool_type="max", global_pool=False, stride=None,
-            pad=None, pooling_convention="valid", count_include_pad=True, layout=None, **_):
+            pad=None, pooling_convention="valid", count_include_pad=True, layout=None):
     nd = data.ndim - 2
     if global_pool:
         axes = tuple(range(2, 2 + nd))
@@ -169,10 +227,21 @@ def pooling(data, kernel=None, pool_type="max", global_pool=False, stride=None,
 # Normalization (reference: batch_norm.cc, layer_norm.cc, group_norm.cc)
 # ---------------------------------------------------------------------------
 
-@register_op("BatchNorm", aliases=("batch_norm",))
+@register_op("BatchNorm", aliases=("batch_norm",), schema=Schema(
+    ignore=("cudnn_off",),
+    eps=Field(float, 1e-5, "Epsilon added to the variance.", ge=0.0),
+    momentum=Field(float, 0.9, "Moving-average momentum for running stats."),
+    fix_gamma=Field(bool, True, "Treat gamma as constant 1 (reference "
+                    "BatchNormParam::fix_gamma)."),
+    use_global_stats=Field(bool, False, "Always normalize with the running "
+                           "statistics, even in training."),
+    output_mean_var=Field(bool, False, "Also return the batch mean/var."),
+    axis=Field(int, 1, "Channel axis."),
+    training=Field(bool, False, "Training mode (batch statistics)."),
+))
 def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-5, momentum=0.9,
                fix_gamma=True, use_global_stats=False, output_mean_var=False,
-               axis=1, training=False, **_):
+               axis=1, training=False):
     """Returns (out, batch_mean, batch_var). The layer updates running stats
     functionally from the returned batch statistics (aux-state discipline —
     see gluon/nn BatchNorm; reference mutates aux states inside the op)."""
@@ -189,8 +258,12 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-5, momentum=0.
     return out, m, v
 
 
-@register_op("LayerNorm", aliases=("layer_norm",))
-def layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False, **_):
+@register_op("LayerNorm", aliases=("layer_norm",), schema=Schema(
+    axis=Field(int, -1, "Axis to normalize over."),
+    eps=Field(float, 1e-5, "Epsilon added to the variance.", ge=0.0),
+    output_mean_var=Field(bool, False, "Also return mean/var."),
+))
+def layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
     m = jnp.mean(data, axis=axis, keepdims=True)
     v = jnp.var(data, axis=axis, keepdims=True)
     out = (data - m) * lax.rsqrt(v + eps)
@@ -265,14 +338,26 @@ _ACTS = {
 }
 
 
-@register_op("Activation", aliases=("activation",))
-def activation(data, act_type="relu", **_):
+@register_op("Activation", aliases=("activation",), schema=Schema(
+    act_type=Field(str, describe="Activation function to apply.",
+                   choices=("relu", "sigmoid", "tanh", "softrelu", "softsign",
+                            "gelu", "gelu_tanh", "silu", "swish", "mish")),
+))
+def activation(data, act_type="relu"):
     return _ACTS[act_type](data)
 
 
-@register_op("LeakyReLU", aliases=("leaky_relu",))
+@register_op("LeakyReLU", aliases=("leaky_relu",), schema=Schema(
+    gamma=Field(object, None, "Learnable slope tensor (prelu).",
+                nullable=True),
+    act_type=Field(str, "leaky", "Leaky-family activation variant.",
+                   choices=("leaky", "prelu", "elu", "selu", "gelu", "rrelu")),
+    slope=Field(float, 0.25, "Negative slope (leaky/elu)."),
+    lower_bound=Field(float, 0.125, "rrelu lower slope bound."),
+    upper_bound=Field(float, 0.334, "rrelu upper slope bound."),
+))
 def leaky_relu(data, gamma=None, act_type="leaky", slope=0.25, lower_bound=0.125,
-               upper_bound=0.334, **_):
+               upper_bound=0.334):
     if act_type == "leaky":
         return jnp.where(data >= 0, data, slope * data)
     if act_type == "prelu":
@@ -310,8 +395,16 @@ def silu(data, **_):
 # Softmax family (reference: softmax.cc incl. SoftmaxWithLength)
 # ---------------------------------------------------------------------------
 
-@register_op("softmax")
-def softmax(data, length=None, axis=-1, temperature=None, use_length=False, **_):
+@register_op("softmax", schema=Schema(
+    length=Field(object, None, "Per-row valid lengths (SoftmaxWithLength).",
+                 nullable=True),
+    axis=Field(int, -1, "Axis to normalize over."),
+    temperature=Field(float, None, "Softmax temperature.", nullable=True),
+    use_length=Field(bool, False, "Mask positions >= length along axis."),
+    dtype=Field(str, None, "Accepted for parity; output follows input dtype.",
+                nullable=True),
+))
+def softmax(data, length=None, axis=-1, temperature=None, use_length=False, dtype=None):
     x = data / temperature if temperature not in (None, 1.0) else data
     if use_length and length is not None:
         # mask positions >= length along `axis` (SoftmaxWithLength)
@@ -408,8 +501,17 @@ def smooth_l1(data, scalar=1.0, **_):
 # Dropout (reference: dropout.cc — cuDNN dropout state ≙ explicit key)
 # ---------------------------------------------------------------------------
 
-@register_op("Dropout", aliases=("dropout",))
-def dropout(data, p=0.5, mode="training", axes=(), training=False, key=None, **_):
+@register_op("Dropout", aliases=("dropout",), schema=Schema(
+    ignore=("cudnn_off",),
+    p=Field(float, 0.5, "Fraction of units to drop.", ge=0.0, le=1.0),
+    mode=Field(str, "training", "When to apply dropout.",
+               choices=("training", "always")),
+    axes=Field(Shape, (), "Axes to broadcast the drop mask over."),
+    training=Field(bool, False, "Training mode (apply the mask)."),
+    key=Field(object, None, "PRNG key (threaded by the RNG trace scope).",
+              nullable=True),
+))
+def dropout(data, p=0.5, mode="training", axes=(), training=False, key=None):
     if not training or p <= 0.0 or key is None:
         return data
     shape = list(data.shape)
@@ -510,10 +612,21 @@ def rnn_param_size(mode, num_layers, input_size, hidden, bidirectional):
     return size
 
 
-@register_op("RNN")
+@register_op("RNN", schema=Schema(
+    ignore=("lstm_state_clip_min", "lstm_state_clip_max",
+            "lstm_state_clip_nan", "use_sequence_length"),
+    state_size=Field(int, describe="Hidden state size.", ge=1),
+    num_layers=Field(int, 1, "Number of stacked layers.", ge=1),
+    mode=Field(str, "lstm", "Cell type.",
+               choices=("rnn_relu", "rnn_tanh", "lstm", "gru")),
+    bidirectional=Field(bool, False, "Run a reverse direction too."),
+    p=Field(float, 0.0, "Inter-layer dropout (ignored at 0).", ge=0.0, le=1.0),
+    state_outputs=Field(bool, False, "Also return the final states."),
+    projection_size=Field(int, None, "LSTMP projection size.", nullable=True),
+))
 def rnn(data, parameters, state, state_cell=None, state_size=None, num_layers=1,
         mode="lstm", bidirectional=False, p=0.0, state_outputs=False,
-        projection_size=None, **_):
+        projection_size=None):
     """Fused multi-layer (bi)RNN. data: (T, N, C) time-major like the
     reference. Returns out or (out, h_n[, c_n]) per state_outputs."""
     T, N, C = data.shape

@@ -1,49 +1,250 @@
-"""Operator registry.
+"""Operator registry with a declarative parameter-schema system.
 
 TPU-native counterpart of the NNVM op registry (``NNVM_REGISTER_OP`` +
-``FCompute`` attrs — SURVEY §2.4). Each op here is a *pure JAX function*
-``fn(*arrays, **params) -> array | tuple`` :
+``FCompute`` attrs — SURVEY §2.4) plus the ``dmlc::Parameter`` /
+``DMLC_DECLARE_FIELD`` op-param schema (SURVEY §5.6, e.g.
+``src/operator/nn/convolution-inl.h (ConvolutionParam)``). Each op here is a
+*pure JAX function* ``fn(*arrays, **params) -> array | tuple`` :
 
 - ``FCompute``        ≙ the function body (jax.numpy/lax, compiled by XLA)
 - ``FInferShape/Type``≙ JAX abstract evaluation (free)
 - ``FGradient``       ≙ ``jax.vjp`` of the same function (free)
 - name + aliases      ≙ the registered op name reflected into ``mx.nd.*``
                         (reference: ``python/mxnet/ndarray/register.py``)
+- ``schema=``         ≙ the declarative kwargs spec: typed fields with
+                        defaults/choices/ranges, validated + string-coerced on
+                        every call (both frontends), reflected into generated
+                        docstrings — what ``DMLC_DECLARE_FIELD(...)
+                        .set_default(...).describe(...)`` does in the
+                        reference, reflected there through
+                        ``python/mxnet/ndarray/register.py``.
 
 The ``mx.nd`` namespace wrappers (NDArray-level, autograd-recording) are
 generated from this registry in ``ndarray/__init__.py``.
 """
 from __future__ import annotations
 
-from typing import Callable, Dict, List, Optional, Tuple
+import functools
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
-__all__ = ["OpDef", "register_op", "OPS", "alias_op"]
+__all__ = ["OpDef", "register_op", "OPS", "alias_op", "Field", "Schema",
+           "Shape", "REQUIRED"]
+
+
+class _Required:
+    def __repr__(self):  # pragma: no cover
+        return "<required>"
+
+
+#: Sentinel for fields with no default (dmlc: field without set_default).
+REQUIRED = _Required()
+
+
+class Shape(tuple):
+    """Marker type for tuple-of-int params (dmlc ``TShape``). Accepts int,
+    sequence, or the string form ``"(3, 3)"`` the reference's frontends emit."""
+
+
+def _parse_bool(v) -> bool:
+    if isinstance(v, bool):
+        return v
+    if isinstance(v, (int, float)):
+        return bool(v)
+    if isinstance(v, str):
+        s = v.strip().lower()
+        if s in ("true", "1", "yes"):
+            return True
+        if s in ("false", "0", "no", ""):
+            return False
+    raise ValueError(f"cannot interpret {v!r} as bool")
+
+
+def _parse_shape(v) -> Optional[tuple]:
+    if v is None:
+        return None
+    if isinstance(v, int):
+        return (v,)
+    if isinstance(v, str):
+        s = v.strip().strip("()[]")
+        if not s:
+            return ()
+        return tuple(int(p) for p in s.replace(",", " ").split())
+    return tuple(int(p) for p in v)
+
+
+class Field:
+    """One declared op parameter (dmlc ``DMLC_DECLARE_FIELD`` analog).
+
+    ``ftype`` is one of ``int float bool str`` or :class:`Shape`; values are
+    coerced (including from the string forms symbolic frontends ship) and
+    range/choice-checked. ``default=REQUIRED`` makes the field mandatory.
+    """
+
+    __slots__ = ("ftype", "default", "describe", "choices", "ge", "le",
+                 "nullable")
+
+    def __init__(self, ftype, default=REQUIRED, describe: str = "",
+                 choices: Optional[Sequence] = None, ge=None, le=None,
+                 nullable: bool = False):
+        self.ftype = ftype
+        self.default = default
+        self.describe = describe
+        self.choices = tuple(choices) if choices is not None else None
+        self.ge = ge
+        self.le = le
+        self.nullable = nullable or default is None
+
+    def coerce(self, opname: str, name: str, v):
+        if v is None:
+            if self.nullable:
+                return None
+            raise ValueError(
+                f"{opname}: parameter '{name}' must not be None")
+        if self.ftype is object:   # passthrough (tensor-valued / any)
+            return v
+        try:
+            if self.ftype is bool:
+                v = _parse_bool(v)
+            elif self.ftype is Shape:
+                v = _parse_shape(v)
+            elif self.ftype is int:
+                if isinstance(v, bool):
+                    v = int(v)
+                elif not isinstance(v, int):
+                    v = int(str(v).strip()) if isinstance(v, str) else int(v)
+            elif self.ftype is float:
+                v = float(v)
+            elif self.ftype is str:
+                v = str(v)
+        except (TypeError, ValueError) as e:
+            raise ValueError(
+                f"{opname}: parameter '{name}' expects "
+                f"{getattr(self.ftype, '__name__', self.ftype)}, got {v!r} "
+                f"({e})") from None
+        if self.choices is not None and v not in self.choices:
+            raise ValueError(
+                f"{opname}: parameter '{name}' must be one of "
+                f"{list(self.choices)}, got {v!r}")
+        if self.ge is not None and v < self.ge:
+            raise ValueError(
+                f"{opname}: parameter '{name}' must be >= {self.ge}, got {v!r}")
+        if self.le is not None and v > self.le:
+            raise ValueError(
+                f"{opname}: parameter '{name}' must be <= {self.le}, got {v!r}")
+        return v
+
+    def doc_line(self, name: str) -> str:
+        tname = getattr(self.ftype, "__name__", str(self.ftype))
+        parts = [f"{name} : {tname}"]
+        if self.default is REQUIRED:
+            parts.append("required")
+        else:
+            parts.append(f"default={self.default!r}")
+        if self.choices is not None:
+            parts.append(f"choices={list(self.choices)}")
+        head = ", ".join(parts)
+        return f"    {head}\n        {self.describe}" if self.describe \
+            else f"    {head}"
+
+
+class Schema:
+    """Declared parameter set for one op (dmlc ``Parameter`` struct analog).
+
+    ``ignore`` lists kwargs accepted-and-dropped for reference API parity
+    (e.g. cudnn knobs that have no TPU meaning). Unknown kwargs raise with
+    the op name and the known-field list.
+    """
+
+    __slots__ = ("fields", "ignore")
+
+    def __init__(self, ignore: Sequence[str] = (), **fields: Field):
+        self.fields = fields
+        self.ignore = frozenset(ignore) | {"name", "ctx"}
+
+    def validate(self, opname: str, kwargs: Dict[str, Any],
+                 skip: Sequence[str] = ()) -> Dict[str, Any]:
+        """Coerce/check ``kwargs``; fill defaults; raise on unknown/missing.
+
+        ``skip`` names params already bound positionally at the call site —
+        they are neither defaulted nor required-checked here (their values
+        bypass string-coercion, the Python-API convention).
+        """
+        out = {}
+        for k, v in kwargs.items():
+            if k in self.fields:
+                out[k] = self.fields[k].coerce(opname, k, v)
+            elif k not in self.ignore:
+                raise TypeError(
+                    f"{opname}: unknown parameter '{k}'. Known parameters: "
+                    f"{sorted(self.fields)}")
+        for k, f in self.fields.items():
+            if k not in out and k not in skip:
+                if f.default is REQUIRED:
+                    raise TypeError(
+                        f"{opname}: required parameter '{k}' is missing "
+                        f"({f.describe or 'no description'})")
+                out[k] = f.default
+        return out
+
+    def doc(self) -> str:
+        lines = ["", "Parameters (declared schema)", "-" * 28]
+        lines += [f.doc_line(n) for n, f in self.fields.items()]
+        if self.ignore - {"name", "ctx"}:
+            lines.append(
+                f"    (accepted for API parity, ignored on TPU: "
+                f"{sorted(self.ignore - {'name', 'ctx'})})")
+        return "\n".join(lines)
 
 
 class OpDef:
-    __slots__ = ("name", "fn", "aliases", "module")
+    __slots__ = ("name", "fn", "aliases", "module", "schema")
 
-    def __init__(self, name: str, fn: Callable, aliases: Tuple[str, ...] = ()):
+    def __init__(self, name: str, fn: Callable, aliases: Tuple[str, ...] = (),
+                 schema: Optional[Schema] = None):
         self.name = name
         self.fn = fn
         self.aliases = aliases
         self.module = fn.__module__
+        self.schema = schema
 
 
 OPS: Dict[str, OpDef] = {}
 
 
-def register_op(name: Optional[str] = None, aliases: Tuple[str, ...] = ()):
+def register_op(name: Optional[str] = None, aliases: Tuple[str, ...] = (),
+                schema: Optional[Schema] = None):
     """Register a pure op. Usable as ``@register_op()`` or
-    ``@register_op("name", aliases=("alias1",))``."""
+    ``@register_op("name", aliases=("alias1",), schema=Schema(...))``.
+
+    With a schema, keyword params are validated/coerced on every call (both
+    the ``mx.nd`` and ``mx.sym`` frontends route through the wrapped fn) and
+    the schema is appended to the op docstring.
+    """
 
     def _do(fn: Callable) -> Callable:
         opname = name or fn.__name__
-        opdef = OpDef(opname, fn, tuple(aliases))
+        body = fn
+        if schema is not None:
+            import inspect
+            fn_argnames = tuple(inspect.signature(fn).parameters)
+
+            @functools.wraps(fn)
+            def body(*args, _fn=fn, _schema=schema, _opname=opname, **kwargs):
+                # A schema param bound positionally (e.g. softmax(x, length),
+                # activation(x, "relu")) is neither defaulted, required-
+                # checked, nor allowed to also arrive as a kwarg.
+                bound = fn_argnames[:len(args)]
+                for b in bound:
+                    if b in kwargs:
+                        raise TypeError(f"{_opname}: got multiple values for "
+                                        f"parameter '{b}'")
+                return _fn(*args, **_schema.validate(_opname, kwargs, bound))
+            body.__doc__ = (fn.__doc__ or "") + "\n" + schema.doc()
+        opdef = OpDef(opname, body, tuple(aliases), schema=schema)
         OPS[opname] = opdef
         for a in aliases:
             OPS[a] = opdef
-        return fn
+        return body
 
     return _do
 

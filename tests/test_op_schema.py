@@ -1,0 +1,130 @@
+"""Declarative op-param schema (dmlc::Parameter analog — SURVEY §5.6).
+
+Reference behavior being mirrored: `DMLC_DECLARE_FIELD(...).set_default(...)
+.describe(...)` structs (e.g. src/operator/nn/convolution-inl.h
+ConvolutionParam) validate op kwargs field-by-field, parse the string forms
+the frontends ship, and surface the schema in generated docstrings.
+"""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu.ops.registry import (Field, Schema, Shape, OPS,
+                                              REQUIRED)
+
+
+def _x(shape=(2, 3, 8, 8)):
+    return mx.nd.array(onp.random.randn(*shape).astype("float32"))
+
+
+class TestFieldCoercion:
+    def test_shape_from_string(self):
+        f = Field(Shape)
+        assert f.coerce("op", "kernel", "(3, 3)") == (3, 3)
+        assert f.coerce("op", "kernel", "[2,2]") == (2, 2)
+        assert f.coerce("op", "kernel", 3) == (3,)
+        assert f.coerce("op", "kernel", [4, 5]) == (4, 5)
+
+    def test_bool_from_string(self):
+        f = Field(bool, False)
+        assert f.coerce("op", "b", "True") is True
+        assert f.coerce("op", "b", "0") is False
+        assert f.coerce("op", "b", 1) is True
+
+    def test_int_range(self):
+        f = Field(int, 1, ge=1)
+        with pytest.raises(ValueError, match="must be >= 1"):
+            f.coerce("op", "n", 0)
+
+    def test_choices(self):
+        f = Field(str, "max", choices=("max", "avg"))
+        with pytest.raises(ValueError, match="must be one of"):
+            f.coerce("op", "pool_type", "median")
+
+    def test_bad_type_names_field(self):
+        f = Field(int, 0)
+        with pytest.raises(ValueError, match="'depth'"):
+            f.coerce("myop", "depth", "not-an-int")
+
+
+class TestSchemaValidate:
+    def test_unknown_kwarg_raises_with_known_list(self):
+        with pytest.raises(TypeError, match="unknown parameter 'bogus'"):
+            mx.nd.Convolution(_x(), _x((4, 3, 3, 3)), kernel=(3, 3), bogus=1)
+
+    def test_missing_required(self):
+        with pytest.raises(TypeError, match="required parameter 'act_type'"):
+            mx.nd.Activation(_x())
+
+    def test_defaults_filled(self):
+        s = Schema(a=Field(int, 7), b=Field(bool, True))
+        out = s.validate("op", {})
+        assert out == {"a": 7, "b": True}
+
+    def test_ignored_parity_kwargs_dropped(self):
+        y = mx.nd.Convolution(_x(), _x((4, 3, 3, 3)), kernel=(3, 3),
+                              cudnn_tune="fastest", workspace=512)
+        assert y.shape == (2, 4, 6, 6)
+
+    def test_string_forms_from_symbolic_frontend(self):
+        y = mx.nd.Convolution(_x(), _x((4, 3, 3, 3)), kernel="(3,3)",
+                              num_filter="4", no_bias="True", stride="(1, 1)")
+        assert y.shape == (2, 4, 6, 6)
+
+
+class TestGeneratedDocs:
+    def test_docstring_shows_schema(self):
+        doc = mx.nd.Convolution.__doc__
+        assert "Parameters (declared schema)" in doc
+        assert "kernel : Shape, required" in doc
+        assert "num_group : int, default=1" in doc
+
+    def test_describe_text_present(self):
+        assert "feature_group_count" in mx.nd.Convolution.__doc__
+
+
+class TestValidatedOpsStillWork:
+    def test_pooling_validates(self):
+        with pytest.raises(ValueError, match="pool_type"):
+            mx.nd.Pooling(_x(), kernel=(2, 2), pool_type="median")
+        y = mx.nd.Pooling(_x(), kernel=(2, 2), stride=(2, 2), pool_type="avg")
+        assert y.shape == (2, 3, 4, 4)
+
+    def test_dropout_p_range(self):
+        with pytest.raises(ValueError, match="'p' must be <= 1.0"):
+            mx.nd.Dropout(_x(), p=1.5)
+
+    def test_batchnorm_through_gluon(self):
+        from incubator_mxnet_tpu.gluon import nn
+        net = nn.BatchNorm()
+        net.initialize()
+        y = net(_x())
+        assert y.shape == (2, 3, 8, 8)
+
+    def test_prelu_gamma_kwarg_gets_gradient(self):
+        # NDArray passed by keyword (LeakyReLU(x, gamma=alpha)) must be a
+        # tape input: alpha is a Parameter and needs its gradient.
+        from incubator_mxnet_tpu import autograd
+        from incubator_mxnet_tpu.gluon import nn
+        p = nn.PReLU(in_channels=3)
+        p.initialize()
+        x = _x((2, 3, 4, 4))
+        with autograd.record():
+            loss = p(x).sum()
+        loss.backward()
+        assert onp.abs(p.alpha.grad().asnumpy()).sum() > 0
+
+    def test_required_param_positional(self):
+        from incubator_mxnet_tpu.ops.nn import activation
+        import jax.numpy as jnp
+        out = activation(jnp.ones((2, 2)), "relu")
+        assert out.shape == (2, 2)
+
+    def test_symbol_frontend_validates_too(self):
+        # Both frontends route through the same wrapped fn.
+        import incubator_mxnet_tpu.symbol as sym
+        data = sym.var("data")
+        s = sym.Convolution(data, kernel=(3, 3), num_filter=4, no_bias=True)
+        ex = s.simple_bind(data=(2, 3, 8, 8))
+        (out,) = ex.forward(data=onp.random.randn(2, 3, 8, 8).astype("float32"))
+        assert out.shape == (2, 4, 6, 6)
