@@ -25,6 +25,7 @@ from . import ndarray  # noqa: F401
 from . import ndarray as nd  # noqa: F401
 from .ndarray import NDArray  # noqa: F401
 from .engine import waitall  # noqa: F401
+from . import operator  # noqa: F401  (registers the Custom op seam)
 
 # Submodules that build on the core are imported lazily to keep import light
 # and to allow partial builds during bootstrapping.

@@ -30,9 +30,15 @@ __all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
 _this = sys.modules[__name__]
 
 # Reflect every registered op into this namespace (mx.nd.<op>).
-for _name, _opdef in list(OPS.items()):
-    if not hasattr(_this, _name):
-        setattr(_this, _name, make_nd_op(_opdef))
+def refresh_ops() -> None:
+    """(Re-)reflect the op registry into mx.nd — called again by modules
+    that register ops after this one is imported (e.g. mx.operator)."""
+    for _name, _opdef in list(OPS.items()):
+        if not hasattr(_this, _name):
+            setattr(_this, _name, make_nd_op(_opdef))
+
+
+refresh_ops()
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,216 @@
+"""Python-defined custom operators (``mx.operator``).
+
+Reference counterpart: ``src/operator/custom/custom.cc``
+(``CustomOperator::Push``) + ``python/mxnet/operator.py`` — a C++ shim that
+marshals op execution onto a dedicated worker thread and calls back into
+Python, integrating with the dependency engine.
+
+TPU-native design: the host round-trip is `jax.pure_callback`, which XLA
+schedules inside the compiled program — so a ``Custom`` op works eagerly,
+under ``autograd.record``, and *inside a hybridized (jit) block*, exactly the
+reference contract. The gradient is a ``jax.custom_vjp`` whose backward is a
+second callback into :meth:`CustomOp.backward`. As in the reference, this is
+an off-perf-path escape hatch (SURVEY §7: "perf-off-path only").
+
+Divergences (documented): ``aux`` states are not supported (use regular
+params), and ``ctx`` passed to ``create_operator`` is the *current* context
+facade — the callback itself always runs on host.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Type
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_prop_cls"]
+
+
+class CustomOp:
+    """Base class for the op implementation (reference:
+    python/mxnet/operator.py CustomOp). Subclasses override ``forward`` and
+    ``backward``; arrays arrive as host NDArrays on the cpu context."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError(
+            "CustomOp.backward not implemented — required once the op is "
+            "used under autograd.record / jax.grad")
+
+    def assign(self, dst, req, src):
+        """Write ``src`` into ``dst`` honouring the write/add/null request."""
+        if req in ("null", 0):
+            return
+        if req in ("add", 3):
+            dst[:] = dst + src
+        else:  # write / inplace
+            dst[:] = src
+
+
+class CustomOpProp:
+    """Shape/type contract + factory (reference CustomOpProp).
+
+    ``need_top_grad=False`` matches loss-style ops whose backward ignores
+    the incoming gradient (the callback still receives it; it is simply
+    all-ones at the chain root as in the reference).
+    """
+
+    def __init__(self, need_top_grad: bool = True):
+        self.need_top_grad_ = bool(need_top_grad)
+
+    def list_arguments(self) -> List[str]:
+        return ["data"]
+
+    def list_outputs(self) -> List[str]:
+        return ["output"]
+
+    def list_auxiliary_states(self) -> List[str]:
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]] * len(self.list_outputs()), []
+
+    def create_operator(self, ctx, shapes, dtypes) -> CustomOp:
+        raise NotImplementedError
+
+    def need_top_grad(self) -> bool:
+        return self.need_top_grad_
+
+
+_PROPS: Dict[str, Type[CustomOpProp]] = {}
+
+
+def register(op_type: str):
+    """Class decorator registering a :class:`CustomOpProp` under ``op_type``
+    (reference: ``mx.operator.register``). The op is then invocable as
+    ``mx.nd.Custom(*data, op_type=op_type)`` or ``mx.sym.Custom(...)``."""
+
+    def _reg(cls: Type[CustomOpProp]):
+        if not issubclass(cls, CustomOpProp):
+            raise TypeError(f"{cls!r} must subclass CustomOpProp")
+        _PROPS[op_type] = cls
+        return cls
+
+    return _reg
+
+
+def get_prop_cls(op_type: str) -> Type[CustomOpProp]:
+    try:
+        return _PROPS[op_type]
+    except KeyError:
+        raise KeyError(
+            f"no CustomOp registered as '{op_type}'. Registered: "
+            f"{sorted(_PROPS)}") from None
+
+
+# ---------------------------------------------------------------------------
+# the Custom op itself
+# ---------------------------------------------------------------------------
+
+def _host_ndarrays(np_arrays: Sequence[onp.ndarray]):
+    """Wrap host numpy buffers as cpu-context NDArrays so user code can use
+    the full NDArray surface inside the callback."""
+    from .context import cpu
+    from .ndarray import NDArray
+    c = cpu()
+    with jax.default_device(jax.devices("cpu")[0]):
+        return [NDArray(jnp.asarray(a), ctx=c) for a in np_arrays]
+
+
+def _custom_fn(op_type: str, str_kwargs: Dict[str, str], is_train: bool,
+               n_in: int):
+    """Build the jax-level function (with custom VJP) for one Custom call
+    site. Shapes/types are resolved at trace time via the prop contract."""
+    prop = get_prop_cls(op_type)(**str_kwargs)
+
+    def _resolve(vals):
+        in_shapes = [list(v.shape) for v in vals]
+        in_types = [onp.dtype(v.dtype) for v in vals]
+        shp = prop.infer_shape(in_shapes)
+        ishapes, oshapes = shp[0], shp[1]
+        typ = prop.infer_type(in_types)
+        otypes = typ[1]
+        out_sd = tuple(jax.ShapeDtypeStruct(tuple(s), onp.dtype(t))
+                       for s, t in zip(oshapes, otypes))
+        return ishapes, in_types, out_sd
+
+    @jax.custom_vjp
+    def fn(*vals):
+        return _fwd_impl(vals)
+
+    def _fwd_impl(vals):
+        ishapes, itypes, out_sd = _resolve(vals)
+
+        def host_fwd(*np_vals):
+            op = prop.create_operator(None, ishapes, itypes)
+            ins = _host_ndarrays(np_vals)
+            outs = _host_ndarrays([onp.zeros(sd.shape, sd.dtype)
+                                   for sd in out_sd])
+            op.forward(is_train=is_train, req=["write"] * len(outs),
+                       in_data=ins, out_data=outs, aux=[])
+            return tuple(onp.asarray(o.asnumpy(), sd.dtype)
+                         for o, sd in zip(outs, out_sd))
+
+        return jax.pure_callback(host_fwd, out_sd, *vals, vmap_method="sequential")
+
+    def fn_fwd(*vals):
+        outs = _fwd_impl(vals)
+        return outs, (vals, outs)
+
+    def fn_bwd(res, gouts):
+        vals, outs = res
+        ishapes, itypes, _ = _resolve(vals)
+        gin_sd = tuple(jax.ShapeDtypeStruct(tuple(v.shape), onp.dtype(v.dtype))
+                       for v in vals)
+
+        def host_bwd(*np_all):
+            ni, no = len(vals), len(outs)
+            ins = _host_ndarrays(np_all[:ni])
+            os_ = _host_ndarrays(np_all[ni:ni + no])
+            gs = _host_ndarrays(np_all[ni + no:])
+            gin = _host_ndarrays([onp.zeros(sd.shape, sd.dtype)
+                                  for sd in gin_sd])
+            op = prop.create_operator(None, ishapes, itypes)
+            op.backward(req=["write"] * ni, out_grad=gs, in_data=ins,
+                        out_data=os_, in_grad=gin, aux=[])
+            return tuple(onp.asarray(g.asnumpy(), sd.dtype)
+                         for g, sd in zip(gin, gin_sd))
+
+        return jax.pure_callback(host_bwd, gin_sd, *vals, *outs, *gouts,
+                                 vmap_method="sequential")
+
+    fn.defvjp(fn_fwd, fn_bwd)
+    return fn
+
+
+def _register_custom_op():
+    from .ops.registry import register_op
+
+    @register_op("Custom")
+    def custom(*in_vals, op_type=None, **kwargs):
+        """Invoke a registered Python CustomOp (reference:
+        src/operator/custom/custom.cc; params ship as strings, as the
+        reference's C ABI does)."""
+        if op_type is None:
+            raise TypeError("Custom requires op_type=<registered name>")
+        from . import autograd
+        str_kwargs = {k: str(v) for k, v in kwargs.items()}
+        fn = _custom_fn(op_type, str_kwargs, autograd.is_training(), len(in_vals))
+        out = fn(*in_vals)
+        return out if len(out) > 1 else out[0]
+
+    return custom
+
+
+_register_custom_op()
+
+# mx.nd may already have been reflected from the registry before this module
+# ran — pick up the Custom op.
+from .ndarray import refresh_ops as _refresh_ops  # noqa: E402
+_refresh_ops()

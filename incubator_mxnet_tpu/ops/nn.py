@@ -201,6 +201,16 @@ def pooling(data, kernel=None, pool_type="max", global_pool=False, stride=None,
             rem = (size - kernel[i]) % stride[i]
             extra.append(0 if rem == 0 else stride[i] - rem)
         padding = ((0, 0), (0, 0)) + tuple((p, p + e) for p, e in zip(pad, extra))
+    elif pooling_convention == "same":
+        # out = ceil(in/stride): distribute the needed pad low/high (extra on
+        # the high side), on top of any explicit pad.
+        pads = []
+        for i in range(nd):
+            size = data.shape[2 + i] + 2 * pad[i]
+            out = -(-size // stride[i])
+            total = max((out - 1) * stride[i] + kernel[i] - size, 0)
+            pads.append((pad[i] + total // 2, pad[i] + total - total // 2))
+        padding = ((0, 0), (0, 0)) + tuple(pads)
     if pool_type == "max":
         init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
         return lax.reduce_window(data, init, lax.max, window, strides, padding)

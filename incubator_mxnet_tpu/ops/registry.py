@@ -111,7 +111,13 @@ class Field:
                 if isinstance(v, bool):
                     v = int(v)
                 elif not isinstance(v, int):
-                    v = int(str(v).strip()) if isinstance(v, str) else int(v)
+                    if isinstance(v, str):
+                        v = int(v.strip())
+                    else:
+                        iv = int(v)
+                        if iv != v:  # dmlc rejects non-integral values
+                            raise ValueError(f"non-integral value {v!r}")
+                        v = iv
             elif self.ftype is float:
                 v = float(v)
             elif self.ftype is str:
@@ -162,17 +168,23 @@ class Schema:
         self.ignore = frozenset(ignore) | {"name", "ctx"}
 
     def validate(self, opname: str, kwargs: Dict[str, Any],
-                 skip: Sequence[str] = ()) -> Dict[str, Any]:
+                 skip: Sequence[str] = (),
+                 input_names: Sequence[str] = ()) -> Dict[str, Any]:
         """Coerce/check ``kwargs``; fill defaults; raise on unknown/missing.
 
         ``skip`` names params already bound positionally at the call site —
         they are neither defaulted nor required-checked here (their values
-        bypass string-coercion, the Python-API convention).
+        bypass string-coercion, the Python-API convention). ``input_names``
+        are the op's tensor slots (fn params that are not schema fields):
+        kwargs naming one pass through unvalidated — the standard MXNet
+        keyword-input style, e.g. ``FullyConnected(data=x, weight=w)``.
         """
         out = {}
         for k, v in kwargs.items():
             if k in self.fields:
                 out[k] = self.fields[k].coerce(opname, k, v)
+            elif k in input_names:
+                out[k] = v
             elif k not in self.ignore:
                 raise TypeError(
                     f"{opname}: unknown parameter '{k}'. Known parameters: "
@@ -227,6 +239,11 @@ def register_op(name: Optional[str] = None, aliases: Tuple[str, ...] = (),
         if schema is not None:
             import inspect
             fn_argnames = tuple(inspect.signature(fn).parameters)
+            # Tensor slots: fn params that are not schema fields (data,
+            # weight, bias, ...) — addressable by keyword without tripping
+            # the unknown-parameter check.
+            input_names = tuple(n for n in fn_argnames
+                                if n not in schema.fields)
 
             @functools.wraps(fn)
             def body(*args, _fn=fn, _schema=schema, _opname=opname, **kwargs):
@@ -238,7 +255,8 @@ def register_op(name: Optional[str] = None, aliases: Tuple[str, ...] = (),
                     if b in kwargs:
                         raise TypeError(f"{_opname}: got multiple values for "
                                         f"parameter '{b}'")
-                return _fn(*args, **_schema.validate(_opname, kwargs, bound))
+                return _fn(*args, **_schema.validate(_opname, kwargs, bound,
+                                                     input_names))
             body.__doc__ = (fn.__doc__ or "") + "\n" + schema.doc()
         opdef = OpDef(opname, body, tuple(aliases), schema=schema)
         OPS[opname] = opdef

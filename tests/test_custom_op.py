@@ -1,0 +1,163 @@
+"""CustomOp seam (reference: src/operator/custom/custom.cc +
+python/mxnet/operator.py; SURVEY §2.4 custom/).
+
+The TPU-era mechanism is jax.pure_callback: the Python op body runs on host
+but participates in the compiled program, autograd, and hybridize()/jit."""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon
+from incubator_mxnet_tpu.gluon import nn
+
+
+@mx.operator.register("scaled_square")
+class ScaledSquareProp(mx.operator.CustomOpProp):
+    """y = scale * x^2, dx = 2 * scale * x * dy — closed-form check."""
+
+    def __init__(self, scale="1.0"):
+        super().__init__(need_top_grad=True)
+        self.scale = float(scale)
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        scale = self.scale
+
+        class _Op(mx.operator.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                x = in_data[0].asnumpy()
+                self.assign(out_data[0], req[0], mx.nd.array(scale * x * x))
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+                x = in_data[0].asnumpy()
+                g = out_grad[0].asnumpy()
+                self.assign(in_grad[0], req[0],
+                            mx.nd.array(2.0 * scale * x * g))
+
+        return _Op()
+
+
+@mx.operator.register("host_softsign")
+class HostSoftsignProp(mx.operator.CustomOpProp):
+    """Numpy-only body; gradient checked against finite differences."""
+
+    def create_operator(self, ctx, shapes, dtypes):
+        class _Op(mx.operator.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                x = in_data[0].asnumpy()
+                self.assign(out_data[0], req[0], mx.nd.array(x / (1 + onp.abs(x))))
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+                x = in_data[0].asnumpy()
+                g = out_grad[0].asnumpy()
+                self.assign(in_grad[0], req[0],
+                            mx.nd.array(g / (1 + onp.abs(x)) ** 2))
+
+        return _Op()
+
+
+class CustomDense(nn.HybridBlock):
+    """Custom op inside a hybridizable block, composed with a Dense layer."""
+
+    def __init__(self, units, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.dense = nn.Dense(units, flatten=False)
+
+    def hybrid_forward(self, F, x):
+        return F.Custom(self.dense(x), op_type="scaled_square", scale=0.5)
+
+
+def test_forward_eager():
+    x = mx.nd.array(onp.arange(6, dtype="float32").reshape(2, 3))
+    y = mx.nd.Custom(x, op_type="scaled_square", scale=2.0)
+    onp.testing.assert_allclose(y.asnumpy(), 2.0 * x.asnumpy() ** 2, rtol=1e-6)
+
+
+def test_unregistered_name_errors():
+    x = mx.nd.array(onp.ones((2, 2), "float32"))
+    with pytest.raises(KeyError, match="no CustomOp registered as 'nope'"):
+        mx.nd.Custom(x, op_type="nope")
+
+
+def test_backward_closed_form():
+    xv = onp.random.randn(3, 4).astype("float32")
+    x = mx.nd.array(xv)
+    x.attach_grad()
+    with autograd.record():
+        y = mx.nd.Custom(x, op_type="scaled_square", scale=3.0)
+        loss = y.sum()
+    loss.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), 6.0 * xv, rtol=1e-5)
+
+
+def test_backward_vs_numeric():
+    xv = onp.random.randn(5).astype("float64") * 2
+    x = mx.nd.array(xv, dtype="float64")
+    x.attach_grad()
+    with autograd.record():
+        loss = mx.nd.Custom(x, op_type="host_softsign").sum()
+    loss.backward()
+    eps = 1e-6
+    num = onp.array([
+        ((xv[i] + eps) / (1 + abs(xv[i] + eps))
+         - (xv[i] - eps) / (1 + abs(xv[i] - eps))) / (2 * eps)
+        for i in range(len(xv))])
+    onp.testing.assert_allclose(x.grad.asnumpy(), num, rtol=1e-4)
+
+
+def test_trains_inside_hybridized_block():
+    """The reference contract end-to-end: a Python-defined op inside a
+    hybridized (jit-compiled) net, trained with autograd + Trainer."""
+    net = CustomDense(4)
+    net.initialize()
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.05})
+    rng = onp.random.RandomState(0)
+    X = mx.nd.array(rng.randn(16, 8).astype("float32"))
+    Y = mx.nd.array(onp.abs(rng.randn(16, 4)).astype("float32"))
+    l2 = gluon.loss.L2Loss()
+    losses = []
+    for _ in range(40):
+        with autograd.record():
+            out = net(X)
+            loss = l2(out, Y).mean()
+        loss.backward()
+        trainer.step(16)
+        losses.append(float(loss.asnumpy()))
+    assert losses[-1] < losses[0] * 0.7, losses[::6]
+
+
+def test_multi_input_shapes():
+    @mx.operator.register("host_mul")
+    class HostMulProp(mx.operator.CustomOpProp):
+        def list_arguments(self):
+            return ["a", "b"]
+
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0]], []
+
+        def create_operator(self, ctx, shapes, dtypes):
+            class _Op(mx.operator.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    self.assign(out_data[0], req[0], in_data[0] * in_data[1])
+
+                def backward(self, req, out_grad, in_data, out_data,
+                             in_grad, aux):
+                    self.assign(in_grad[0], req[0], out_grad[0] * in_data[1])
+                    self.assign(in_grad[1], req[1], out_grad[0] * in_data[0])
+
+            return _Op()
+
+    a = mx.nd.array(onp.random.randn(2, 3).astype("float32"))
+    b = mx.nd.array(onp.random.randn(2, 3).astype("float32"))
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        out = mx.nd.Custom(a, b, op_type="host_mul")
+        out.sum().backward()
+    onp.testing.assert_allclose(a.grad.asnumpy(), b.asnumpy(), rtol=1e-6)
+    onp.testing.assert_allclose(b.grad.asnumpy(), a.asnumpy(), rtol=1e-6)
