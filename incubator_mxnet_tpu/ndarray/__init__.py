@@ -146,29 +146,44 @@ def imresize(src, w, h, interp=1) -> NDArray:
 
 # ---------------------------------------------------------------------------
 # serialization (reference: NDArray::Save/Load, src/ndarray/ndarray.cc;
-# SURVEY §5.4). Format: a versioned pickle of host numpy arrays — the dmlc
-# binary stream has no ecosystem value off-MXNet, but the API surface and
-# list/dict semantics are preserved exactly.
+# SURVEY §5.4). Default format: the upstream dmlc `.params` binary stream —
+# files interchange with upstream MXNet 1.x mx.nd.save/load name-for-name.
+# The earlier pickle container is read transparently on load.
 # ---------------------------------------------------------------------------
 
 _MAGIC = b"MXTPU_ND1\n"
 
 
 def save(fname: str, data) -> None:
+    from .serialization import dmlc_save
     if isinstance(data, NDArray):
-        payload = [data.asnumpy()]
+        arrays, names = [data.asnumpy()], []
     elif isinstance(data, dict):
-        payload = {k: v.asnumpy() for k, v in data.items()}
+        names = list(data.keys())
+        arrays = [v.asnumpy() for v in data.values()]
     elif isinstance(data, (list, tuple)):
-        payload = [v.asnumpy() for v in data]
+        arrays, names = [v.asnumpy() for v in data], []
     else:
         raise MXNetError("save expects NDArray, list of NDArray, or dict of str->NDArray")
-    with open(fname, "wb") as f:
-        f.write(_MAGIC)
-        pickle.dump(payload, f, protocol=4)
+    dmlc_save(fname, arrays, names)
 
 
 def load(fname: str):
+    from .serialization import NotDmlcFile, dmlc_load
+    try:
+        arrays, names = dmlc_load(fname)
+    except NotDmlcFile:
+        # only a container-magic mismatch falls back; parse errors inside a
+        # genuine .params stream surface as-is
+        return _load_pickle(fname)
+    if names:
+        if len(names) != len(arrays):
+            raise MXNetError(f"{fname}: name/array count mismatch")
+        return {n: NDArray(a) for n, a in zip(names, arrays)}
+    return [NDArray(a) for a in arrays]
+
+
+def _load_pickle(fname: str):
     with open(fname, "rb") as f:
         magic = f.read(len(_MAGIC))
         if magic != _MAGIC:

@@ -1,0 +1,167 @@
+"""dmlc-stream NDArray serialization — upstream ``.params`` compatibility.
+
+Reference: ``src/ndarray/ndarray.cc (NDArray::Save/Load)`` +
+``MXNDArraySave`` (src/c_api/c_api.cc) and SURVEY §5.4 ("keep `.params` file
+import for ecosystem weight compatibility"). Wire layout (all little-endian):
+
+File (kMXAPINDArrayListMagic list container)::
+
+    uint64  0x112 (list magic)      uint64  0 (reserved)
+    uint64  n_arrays                n_arrays × <NDArray record>
+    uint64  n_names                 n_names × (uint64 len + utf-8 bytes)
+
+NDArray record (V2 0xF993FAC9 / V3 0xF993FACA; V1 0xF993FAC8 and the
+pre-magic legacy layout are load-only)::
+
+    uint32  version magic
+    int32   storage type (0 = dense; sparse records are load-rejected)
+    uint32  ndim   +  int64 × ndim          (TShape, dim_t = int64 in 1.x)
+    int32   dev_type   int32   dev_id       (Context::Save)
+    int32   type flag (kFloat32=0 ... kBfloat16=12)
+    raw     data bytes, C-contiguous
+
+Writing always emits V2 dense records, so files produced here load into
+upstream MXNet 1.x (`mx.nd.load`) and vice versa. The previous pickle
+container is still read transparently (magic mismatch → pickle fallback).
+"""
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Sequence, Union
+
+import numpy as onp
+
+from ..base import MXNetError
+
+__all__ = ["dmlc_save", "dmlc_load", "DMLC_LIST_MAGIC", "NotDmlcFile"]
+
+
+class NotDmlcFile(MXNetError):
+    """The file is not a dmlc .params container at all (magic mismatch /
+    too short for the header) — the only condition that may fall back to
+    another loader. Real parse errors inside a genuine container raise
+    plain MXNetError and must surface."""
+
+DMLC_LIST_MAGIC = 0x112
+_ND_V1 = 0xF993FAC8
+_ND_V2 = 0xF993FAC9
+_ND_V3 = 0xF993FACA
+
+# mshadow type flags (include/mxnet/base.h TypeFlag)
+_FLAG_TO_DTYPE = {
+    0: "float32", 1: "float64", 2: "float16", 3: "uint8", 4: "int32",
+    5: "int8", 6: "int64", 7: "bool", 8: "int16", 9: "uint16",
+    10: "uint32", 11: "uint64", 12: "bfloat16",
+}
+_DTYPE_TO_FLAG = {v: k for k, v in _FLAG_TO_DTYPE.items()}
+
+
+def _np_dtype(name: str):
+    if name == "bfloat16":
+        import ml_dtypes
+        return onp.dtype(ml_dtypes.bfloat16)
+    return onp.dtype(name)
+
+
+def _write_ndarray(f, arr: onp.ndarray) -> None:
+    name = "bfloat16" if arr.dtype.name == "bfloat16" else arr.dtype.name
+    if name not in _DTYPE_TO_FLAG:
+        raise MXNetError(f"dtype {name} has no dmlc type flag")
+    arr = onp.ascontiguousarray(arr)
+    if arr.ndim == 0:
+        # upstream has no 0-d arrays (ndim==0 marks a "none" record that
+        # carries no ctx/dtype/data) — promote scalars the way nd.array does
+        arr = arr.reshape(1)
+    f.write(struct.pack("<I", _ND_V2))
+    f.write(struct.pack("<i", 0))                       # kDefaultStorage
+    f.write(struct.pack("<I", arr.ndim))
+    f.write(struct.pack(f"<{arr.ndim}q", *arr.shape))
+    f.write(struct.pack("<ii", 1, 0))                   # Context: cpu(0)
+    f.write(struct.pack("<i", _DTYPE_TO_FLAG[name]))
+    f.write(arr.tobytes())
+
+
+def _read_exact(f, n: int) -> bytes:
+    b = f.read(n)
+    if len(b) != n:
+        raise MXNetError("truncated dmlc NDArray stream")
+    return b
+
+
+def _read_ndarray(f) -> onp.ndarray:
+    (magic,) = struct.unpack("<I", _read_exact(f, 4))
+    if magic in (_ND_V2, _ND_V3):
+        (stype,) = struct.unpack("<i", _read_exact(f, 4))
+        if stype not in (0,):  # dense only; sparse = load-rejected
+            raise MXNetError(
+                f"sparse storage type {stype} in .params is not supported "
+                "on the TPU build (dense-convert it in the source framework)")
+        (ndim,) = struct.unpack("<I", _read_exact(f, 4))
+        if ndim == 0:  # upstream "none" record: nothing else follows
+            return onp.zeros((0,), "float32")
+        shape = struct.unpack(f"<{ndim}q", _read_exact(f, 8 * ndim))
+    elif magic == _ND_V1:
+        (ndim,) = struct.unpack("<I", _read_exact(f, 4))
+        if ndim == 0:
+            return onp.zeros((0,), "float32")
+        shape = struct.unpack(f"<{ndim}q", _read_exact(f, 8 * ndim))
+    else:
+        # legacy pre-magic layout: the uint32 just read IS ndim (uint32 dims)
+        ndim = magic
+        if ndim > 32:
+            raise MXNetError("unrecognized NDArray record magic "
+                             f"0x{magic:08x}")
+        shape = struct.unpack(f"<{ndim}I", _read_exact(f, 4 * ndim))
+    (dev_type, _dev_id) = struct.unpack("<ii", _read_exact(f, 8))
+    (flag,) = struct.unpack("<i", _read_exact(f, 4))
+    if flag not in _FLAG_TO_DTYPE:
+        raise MXNetError(f"unknown dmlc type flag {flag}")
+    dt = _np_dtype(_FLAG_TO_DTYPE[flag])
+    n = 1
+    for s in shape:
+        n *= int(s)
+    data = _read_exact(f, n * dt.itemsize)
+    return onp.frombuffer(data, dtype=dt).reshape(shape).copy()
+
+
+def dmlc_save(fname: str,
+              arrays: Sequence[onp.ndarray],
+              names: Sequence[str]) -> None:
+    """Write the kMXAPINDArrayListMagic container (upstream `.params`)."""
+    with open(fname, "wb") as f:
+        f.write(struct.pack("<QQ", DMLC_LIST_MAGIC, 0))
+        f.write(struct.pack("<Q", len(arrays)))
+        for a in arrays:
+            _write_ndarray(f, a)
+        f.write(struct.pack("<Q", len(names)))
+        for s in names:
+            b = s.encode("utf-8")
+            f.write(struct.pack("<Q", len(b)))
+            f.write(b)
+
+
+def dmlc_load(fname: str):
+    """Read an upstream `.params` file → (list_of_arrays, list_of_names).
+
+    Raises MXNetError if the list magic doesn't match (caller falls back to
+    the pickle container).
+    """
+    with open(fname, "rb") as f:
+        head = f.read(16)
+        if len(head) != 16:
+            raise NotDmlcFile(f"{fname}: too short for a dmlc .params file")
+        magic, _reserved = struct.unpack("<QQ", head)
+        if magic != DMLC_LIST_MAGIC:
+            raise NotDmlcFile(f"{fname}: not a dmlc .params file")
+        (n,) = struct.unpack("<Q", _read_exact(f, 8))
+        arrays = [_read_ndarray(f) for _ in range(n)]
+        names: List[str] = []
+        rest = f.read(8)
+        if rest:
+            if len(rest) != 8:
+                raise MXNetError("truncated dmlc NDArray stream")
+            (nn,) = struct.unpack("<Q", rest)
+            for _ in range(nn):
+                (ln,) = struct.unpack("<Q", _read_exact(f, 8))
+                names.append(_read_exact(f, ln).decode("utf-8"))
+    return arrays, names

@@ -71,7 +71,7 @@ class CustomOpProp:
         return []
 
     def infer_shape(self, in_shape):
-        return in_shape, [in_shape[0]], []
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
 
     def infer_type(self, in_type):
         return in_type, [in_type[0]] * len(self.list_outputs()), []
@@ -123,11 +123,27 @@ def _host_ndarrays(np_arrays: Sequence[onp.ndarray]):
         return [NDArray(jnp.asarray(a), ctx=c) for a in np_arrays]
 
 
-def _custom_fn(op_type: str, str_kwargs: Dict[str, str], is_train: bool,
-               n_in: int):
-    """Build the jax-level function (with custom VJP) for one Custom call
-    site. Shapes/types are resolved at trace time via the prop contract."""
+_FN_CACHE: Dict[tuple, object] = {}
+
+
+def _custom_fn(op_type: str, str_kwargs: Dict[str, str], is_train: bool):
+    """Build (and cache) the jax-level function (with custom VJP) for one
+    Custom call signature. Shapes/types are resolved at trace time via the
+    prop contract. As in the reference (one CustomOperator per op node), ONE
+    CustomOp instance serves both forward and backward, so state stashed on
+    ``self`` in forward (masks etc.) is visible in backward."""
+    cache_key = (op_type, tuple(sorted(str_kwargs.items())), is_train)
+    if cache_key in _FN_CACHE:
+        return _FN_CACHE[cache_key]
     prop = get_prop_cls(op_type)(**str_kwargs)
+    op_box: list = []  # created lazily, shared by fwd/bwd callbacks
+
+    def _op_for(ishapes, itypes) -> CustomOp:
+        if not op_box:
+            from .context import current_context
+            op_box.append(prop.create_operator(current_context(), ishapes,
+                                               itypes))
+        return op_box[0]
 
     def _resolve(vals):
         in_shapes = [list(v.shape) for v in vals]
@@ -148,7 +164,7 @@ def _custom_fn(op_type: str, str_kwargs: Dict[str, str], is_train: bool,
         ishapes, itypes, out_sd = _resolve(vals)
 
         def host_fwd(*np_vals):
-            op = prop.create_operator(None, ishapes, itypes)
+            op = _op_for(ishapes, itypes)
             ins = _host_ndarrays(np_vals)
             outs = _host_ndarrays([onp.zeros(sd.shape, sd.dtype)
                                    for sd in out_sd])
@@ -176,7 +192,7 @@ def _custom_fn(op_type: str, str_kwargs: Dict[str, str], is_train: bool,
             gs = _host_ndarrays(np_all[ni + no:])
             gin = _host_ndarrays([onp.zeros(sd.shape, sd.dtype)
                                   for sd in gin_sd])
-            op = prop.create_operator(None, ishapes, itypes)
+            op = _op_for(ishapes, itypes)
             op.backward(req=["write"] * ni, out_grad=gs, in_data=ins,
                         out_data=os_, in_grad=gin, aux=[])
             return tuple(onp.asarray(g.asnumpy(), sd.dtype)
@@ -186,6 +202,7 @@ def _custom_fn(op_type: str, str_kwargs: Dict[str, str], is_train: bool,
                                  vmap_method="sequential")
 
     fn.defvjp(fn_fwd, fn_bwd)
+    _FN_CACHE[cache_key] = fn
     return fn
 
 
@@ -201,7 +218,7 @@ def _register_custom_op():
             raise TypeError("Custom requires op_type=<registered name>")
         from . import autograd
         str_kwargs = {k: str(v) for k, v in kwargs.items()}
-        fn = _custom_fn(op_type, str_kwargs, autograd.is_training(), len(in_vals))
+        fn = _custom_fn(op_type, str_kwargs, autograd.is_training())
         out = fn(*in_vals)
         return out if len(out) > 1 else out[0]
 

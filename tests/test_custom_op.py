@@ -131,6 +131,56 @@ def test_trains_inside_hybridized_block():
     assert losses[-1] < losses[0] * 0.7, losses[::6]
 
 
+def test_stateful_op_forward_state_visible_in_backward():
+    """Upstream pattern: forward stashes state on self (e.g. a drop mask),
+    backward reads it — one CustomOp instance serves both."""
+    @mx.operator.register("stateful_gate")
+    class StatefulProp(mx.operator.CustomOpProp):
+        def create_operator(self, ctx, shapes, dtypes):
+            class _Op(mx.operator.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    x = in_data[0].asnumpy()
+                    self.mask = (x > 0).astype(x.dtype)
+                    self.assign(out_data[0], req[0], mx.nd.array(x * self.mask))
+
+                def backward(self, req, out_grad, in_data, out_data,
+                             in_grad, aux):
+                    g = out_grad[0].asnumpy()
+                    self.assign(in_grad[0], req[0],
+                                mx.nd.array(g * self.mask))  # uses fwd state
+
+            return _Op()
+
+    xv = onp.array([[1.0, -2.0, 3.0]], "float32")
+    x = mx.nd.array(xv)
+    x.attach_grad()
+    with autograd.record():
+        mx.nd.Custom(x, op_type="stateful_gate").sum().backward()
+    onp.testing.assert_array_equal(x.grad.asnumpy(),
+                                   (xv > 0).astype("float32"))
+
+
+def test_multi_output_default_infer_shape():
+    @mx.operator.register("split_pm")
+    class SplitProp(mx.operator.CustomOpProp):
+        def list_outputs(self):
+            return ["plus", "minus"]
+
+        def create_operator(self, ctx, shapes, dtypes):
+            class _Op(mx.operator.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    x = in_data[0].asnumpy()
+                    self.assign(out_data[0], req[0], mx.nd.array(x + 1))
+                    self.assign(out_data[1], req[1], mx.nd.array(x - 1))
+
+            return _Op()
+
+    x = mx.nd.array(onp.zeros((2, 2), "float32"))
+    plus, minus = mx.nd.Custom(x, op_type="split_pm")
+    onp.testing.assert_array_equal(plus.asnumpy(), onp.ones((2, 2), "f"))
+    onp.testing.assert_array_equal(minus.asnumpy(), -onp.ones((2, 2), "f"))
+
+
 def test_multi_input_shapes():
     @mx.operator.register("host_mul")
     class HostMulProp(mx.operator.CustomOpProp):

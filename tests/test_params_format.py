@@ -1,0 +1,164 @@
+"""Upstream `.params` dmlc-stream compatibility (SURVEY §5.4; reference:
+src/ndarray/ndarray.cc NDArray::Save/Load + MXNDArraySave list container).
+
+The fixture bytes are hand-assembled from the wire-format spec (NOT via our
+writer), so these tests pin the layout itself: list magic 0x112, V2 record
+magic 0xF993FAC9, int64 TShape dims, Context pair, mshadow type flags.
+"""
+import struct
+
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu.base import MXNetError
+
+
+def _fixture_params_bytes():
+    """Hand-build a 2-array named .params file exactly as upstream mx.nd.save
+    would: {'fc_weight': float32 (2,3), 'fc_bias': int64 (4,)}."""
+    w = onp.arange(6, dtype="float32").reshape(2, 3)
+    b = onp.array([7, 8, 9, 10], dtype="int64")
+    out = bytearray()
+    out += struct.pack("<QQ", 0x112, 0)            # list magic + reserved
+    out += struct.pack("<Q", 2)                    # n arrays
+    # -- record 1: V2, dense, (2,3), cpu(0), kFloat32=0
+    out += struct.pack("<I", 0xF993FAC9)
+    out += struct.pack("<i", 0)
+    out += struct.pack("<I", 2) + struct.pack("<2q", 2, 3)
+    out += struct.pack("<ii", 1, 0)
+    out += struct.pack("<i", 0)
+    out += w.tobytes()
+    # -- record 2: V2, dense, (4,), cpu(0), kInt64=6
+    out += struct.pack("<I", 0xF993FAC9)
+    out += struct.pack("<i", 0)
+    out += struct.pack("<I", 1) + struct.pack("<q", 4)
+    out += struct.pack("<ii", 1, 0)
+    out += struct.pack("<i", 6)
+    out += b.tobytes()
+    # -- names
+    out += struct.pack("<Q", 2)
+    for name in (b"fc_weight", b"fc_bias"):
+        out += struct.pack("<Q", len(name)) + name
+    return bytes(out), w, b
+
+
+def test_load_hand_built_upstream_fixture(tmp_path):
+    raw, w, b = _fixture_params_bytes()
+    p = tmp_path / "upstream.params"
+    p.write_bytes(raw)
+    d = mx.nd.load(str(p))
+    assert sorted(d) == ["fc_bias", "fc_weight"]
+    onp.testing.assert_array_equal(d["fc_weight"].asnumpy(), w)
+    onp.testing.assert_array_equal(d["fc_bias"].asnumpy(), b)
+    # jax runs with x64 disabled: 64-bit payloads narrow to 32-bit on wrap
+    # (framework-wide divergence); the values survive.
+    assert d["fc_bias"].dtype == onp.int32
+
+
+def test_save_emits_exact_upstream_layout(tmp_path):
+    """Byte-exact check of the writer against hand-assembled records."""
+    w = onp.arange(6, dtype="float32").reshape(2, 3)
+    b = onp.array([7, 8, 9, 10], dtype="int32")
+    raw = bytearray()
+    raw += struct.pack("<QQQ", 0x112, 0, 2)
+    raw += struct.pack("<I", 0xF993FAC9) + struct.pack("<i", 0)
+    raw += struct.pack("<I", 2) + struct.pack("<2q", 2, 3)
+    raw += struct.pack("<ii", 1, 0) + struct.pack("<i", 0) + w.tobytes()
+    raw += struct.pack("<I", 0xF993FAC9) + struct.pack("<i", 0)
+    raw += struct.pack("<I", 1) + struct.pack("<q", 4)
+    raw += struct.pack("<ii", 1, 0) + struct.pack("<i", 4) + b.tobytes()
+    raw += struct.pack("<Q", 2)
+    for name in (b"fc_weight", b"fc_bias"):
+        raw += struct.pack("<Q", len(name)) + name
+    p = tmp_path / "ours.params"
+    mx.nd.save(str(p), {"fc_weight": mx.nd.array(w, dtype="float32"),
+                        "fc_bias": mx.nd.array(b, dtype="int32")})
+    assert p.read_bytes() == bytes(raw)
+
+
+def test_dict_roundtrip_dtypes(tmp_path):
+    p = tmp_path / "rt.params"
+    data = {
+        "a": mx.nd.array(onp.random.randn(3, 4), dtype="float32"),
+        "c": mx.nd.array(onp.random.randn(5), dtype="float16"),
+        "d": mx.nd.array(onp.arange(4), dtype="int32"),
+        "e": mx.nd.array(onp.random.randn(2, 3), dtype="bfloat16"),
+    }
+    mx.nd.save(str(p), data)
+    out = mx.nd.load(str(p))
+    assert sorted(out) == sorted(data)
+    for k in data:
+        assert out[k].dtype == data[k].dtype, k
+        onp.testing.assert_array_equal(out[k].asnumpy(), data[k].asnumpy())
+
+
+def test_list_roundtrip_unnamed(tmp_path):
+    p = tmp_path / "lst.params"
+    mx.nd.save(str(p), [mx.nd.ones((2, 2)), mx.nd.zeros((3,))])
+    out = mx.nd.load(str(p))
+    assert isinstance(out, list) and len(out) == 2
+    onp.testing.assert_array_equal(out[0].asnumpy(), onp.ones((2, 2), "f"))
+
+
+def test_scalar_and_empty_shapes(tmp_path):
+    # 0-d promotes to (1,) on save — upstream ndim==0 means a "none" record
+    p = tmp_path / "s.params"
+    mx.nd.save(str(p), [mx.nd.array(onp.float32(3.5))])
+    (out,) = mx.nd.load(str(p))
+    assert out.shape == (1,) and float(out.asnumpy()[0]) == 3.5
+
+
+def test_v1_record_loads(tmp_path):
+    # V1: magic, ndim+int64 dims, ctx, dtype, data (no stype field)
+    a = onp.array([[1.5, -2.0]], dtype="float32")
+    raw = struct.pack("<QQQ", 0x112, 0, 1)
+    raw += struct.pack("<I", 0xF993FAC8)
+    raw += struct.pack("<I", 2) + struct.pack("<2q", 1, 2)
+    raw += struct.pack("<ii", 1, 0) + struct.pack("<i", 0) + a.tobytes()
+    raw += struct.pack("<Q", 0)
+    p = tmp_path / "v1.params"
+    p.write_bytes(raw)
+    (out,) = mx.nd.load(str(p))
+    onp.testing.assert_array_equal(out.asnumpy(), a)
+
+
+def test_gluon_save_parameters_interchange(tmp_path):
+    """Block.save_parameters now writes upstream-loadable .params."""
+    from incubator_mxnet_tpu.gluon import nn
+    from incubator_mxnet_tpu.ndarray.serialization import dmlc_load
+    net = nn.Dense(4, in_units=3)
+    net.initialize()
+    f = str(tmp_path / "dense.params")
+    net.save_parameters(f)
+    arrays, names = dmlc_load(f)      # parses as a dmlc stream
+    assert len(arrays) == len(names) == 2
+    net2 = nn.Dense(4, in_units=3)
+    net2.load_parameters(f)
+    onp.testing.assert_array_equal(net2.weight.data().asnumpy(),
+                                   net.weight.data().asnumpy())
+
+
+def test_pickle_fallback_still_loads(tmp_path):
+    import pickle
+    p = tmp_path / "old.params"
+    with open(p, "wb") as f:
+        f.write(b"MXTPU_ND1\n")
+        pickle.dump({"x": onp.ones((2,), "float32")}, f, protocol=4)
+    out = mx.nd.load(str(p))
+    onp.testing.assert_array_equal(out["x"].asnumpy(), onp.ones((2,), "f"))
+
+
+def test_garbage_rejected(tmp_path):
+    p = tmp_path / "junk.params"
+    p.write_bytes(b"definitely not a params file")
+    with pytest.raises(MXNetError):
+        mx.nd.load(str(p))
+
+
+def test_truncated_rejected(tmp_path):
+    raw, _, _ = _fixture_params_bytes()
+    p = tmp_path / "trunc.params"
+    p.write_bytes(raw[: len(raw) // 2])
+    with pytest.raises(MXNetError, match="truncated dmlc NDArray stream"):
+        mx.nd.load(str(p))
