@@ -36,13 +36,22 @@ BERT_CONFIGS = {
 
 
 class BERTEncoder(HybridBlock):
-    """Stack of post-LN transformer encoder cells."""
+    """Stack of post-LN transformer encoder cells.
+
+    ``remat=True`` wraps each cell in ``jax.checkpoint`` when the stack is
+    compiled (hybridize / ShardedTrainer): activations inside a layer are
+    rematerialized in backward instead of living in HBM across the whole
+    stack — O(L·C·1) live activations instead of O(L·C·layers), the lever
+    that lets BERT-large batches fill the chip (SURVEY §7 "jax.checkpoint /
+    rematerialisation"). No effect on eager execution.
+    """
 
     def __init__(self, num_layers: int, units: int, hidden_size: int,
                  num_heads: int, dropout: float = 0.1, dtype="float32",
-                 weight_initializer=None, **kwargs):
+                 weight_initializer=None, remat: bool = False, **kwargs):
         super().__init__(**kwargs)
         self._num_layers = num_layers
+        self._remat = remat
         with self.name_scope():
             self.layers = []
             for i in range(num_layers):
@@ -54,6 +63,18 @@ class BERTEncoder(HybridBlock):
                 self.layers.append(cell)
 
     def hybrid_forward(self, F, x, mask=None):
+        from ..gluon.block import _is_tracing
+        if self._remat and _is_tracing():
+            import jax
+            from ..ndarray import NDArray
+            for cell in self.layers:
+                # jax.checkpoint over the cell body; params/mask/rng keys are
+                # closed-over tracers (new-style remat closure-converts them,
+                # cotangents flow).
+                def body(xv, cell=cell, mask=mask, ctx=x.context):
+                    return cell(NDArray(xv, ctx=ctx), mask)._data
+                x = NDArray(jax.checkpoint(body)(x._data), ctx=x.context)
+            return x
         for cell in self.layers:
             x = cell(x, mask)
         return x
@@ -74,7 +95,7 @@ class BERTModel(HybridBlock):
                  token_type_vocab_size: int = 2, dropout: float = 0.1,
                  use_pooler: bool = True, use_decoder: bool = True,
                  use_classifier: bool = True, dtype="float32",
-                 embed_initializer=None, **kwargs):
+                 embed_initializer=None, remat: bool = False, **kwargs):
         super().__init__(**kwargs)
         self._vocab_size = vocab_size
         self._units = units
@@ -100,7 +121,7 @@ class BERTModel(HybridBlock):
             self.embed_dropout = nn.Dropout(dropout) if dropout else None
             self.encoder = BERTEncoder(num_layers, units, hidden_size,
                                        num_heads, dropout=dropout, dtype=dtype,
-                                       prefix="encoder_")
+                                       prefix="encoder_", remat=remat)
             if use_pooler:
                 self.pooler = nn.Dense(units, flatten=False, in_units=units,
                                        activation="tanh", prefix="pooler_",

@@ -7,11 +7,23 @@ Reference counterpart: the fused interleaved-MHA contrib ops
 is O(L·D) instead of O(L²) (SURVEY §5.7 calls this the required
 capability-parity-plus deliverable).
 
-Layout: inputs are (B, H, L, D); internally flattened to (B·H, L, D) with the
-grid over (batch·head, query-block). K/V for one (b, h) are resident in VMEM
-and walked in BK tiles by a ``fori_loop`` — fine up to L ≈ 4k (L·D·2 arrays);
-longer sequences go through ring attention over the ``sp`` mesh axis
-(``parallel/ring.py``), which calls back into this kernel per shard.
+TPU mapping (the parts that set the MFU):
+
+- All matmuls run on the MXU in the *input* dtype (bf16 in training) with
+  fp32 accumulation (``preferred_element_type``); probabilities are cast
+  back to bf16 before the PV dot. fp32 operands would run the MXU at a
+  fraction of peak.
+- K/V are **streamed from HBM one (BK, D) block per grid step** — the grid's
+  innermost "arbitrary" dimension — with softmax state (m, l, acc) carried
+  in VMEM scratch across steps. Pallas double-buffers the HBM→VMEM copies
+  automatically, so there is no whole-sequence VMEM residency and no cap on
+  L (the old design held all of K/V per (b,h) in VMEM and capped L at 4k).
+- ``dimension_semantics``: (batch·head, q-block) grid dims are "parallel";
+  the k-block dim is "arbitrary" (carries the softmax recurrence).
+- Fully-masked causal tiles are skipped with ``pl.when`` (≈2× on causal).
+
+Longer-than-memory sequences go through ring attention over the ``sp`` mesh
+axis (``parallel/ring.py``), which calls back into this kernel per shard.
 
 Masking: ``causal`` and/or a key-padding mask of shape (B, Lk) (1 = valid).
 The generic (B, H, Lq, Lk) mask case falls back to the XLA path in
@@ -20,6 +32,7 @@ The generic (B, H, Lq, Lk) mask case falls back to the XLA path in
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -36,7 +49,6 @@ except Exception:  # pragma: no cover
 __all__ = ["flash_attention", "flash_supported"]
 
 _NEG = -1e30
-_MAX_VMEM_L = 4096
 
 
 def _platform_of(x) -> Optional[str]:
@@ -66,7 +78,7 @@ def flash_supported(q, k, v, mask=None) -> bool:
     Lk = k.shape[2]
     if D % 8 or D > 256:
         return False
-    if Lq % _bq(Lq) or Lk % _bk(Lk) or Lk > _MAX_VMEM_L:
+    if Lq % _bq(Lq) or Lk % _bk(Lk):
         return False
     if mask is not None and _as_key_mask(mask, B, H, Lq, Lk) is None:
         return False
@@ -74,11 +86,18 @@ def flash_supported(q, k, v, mask=None) -> bool:
 
 
 def _bq(lq: int) -> int:
-    return min(128, lq)
+    return min(int(os.environ.get("MXTPU_FLASH_BQ", "256")), lq)
 
 
 def _bk(lk: int) -> int:
-    return min(128, lk)
+    return min(int(os.environ.get("MXTPU_FLASH_BK", "512")), lk)
+
+
+def _dimsem(n: int = 2):
+    """(parallel, ..., arbitrary) compiler hints; None off-TPU."""
+    if pltpu is None:
+        return None
+    return dict(dimension_semantics=("parallel",) * n + ("arbitrary",))
 
 
 def _as_key_mask(mask, B, H, Lq, Lk):
@@ -94,48 +113,79 @@ def _as_key_mask(mask, B, H, Lq, Lk):
     return None
 
 
+def _causal_live(iq, jk, bq, bk, causal_off):
+    """Does q-block iq intersect any unmasked position of k-block jk?
+    (bottom-right aligned causal: col <= row + causal_off)"""
+    first_row = iq * bq
+    first_col = jk * bk
+    return first_col <= first_row + (bq - 1) + causal_off
+
+
 # ---------------------------------------------------------------------------
-# forward
+# forward: grid (B·H, nq, nk) — K/V streamed block-by-block, state in scratch
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, *,
-                scale, causal, bk, n_heads, causal_off=0):
+def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref, *, scale, causal, causal_off):
     bq, d = q_ref.shape[1], q_ref.shape[2]
-    lk = k_ref.shape[1]
-    nk = lk // bk
+    bk = k_ref.shape[1]
     iq = pl.program_id(1)
+    jk = pl.program_id(2)
+    nk = pl.num_programs(2)
 
-    q = q_ref[0].astype(jnp.float32) * scale
-    acc0 = jnp.zeros((bq, d), jnp.float32)
-    m0 = jnp.full((bq, 1), _NEG, jnp.float32)
-    l0 = jnp.zeros((bq, 1), jnp.float32)
+    @pl.when(jk == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG)
+        l_ref[...] = jnp.zeros_like(l_ref)
 
-    def body(j, carry):
-        acc, m, l = carry
-        kb = k_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
-        vb = v_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+    def _step():
+        q = q_ref[0]                       # input dtype (bf16 in training)
+        kb = k_ref[0]
+        # MXU dot in input dtype, fp32 accumulate; scale applied in fp32
         s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) * scale
         if mask_ref is not None:
-            mb = mask_ref[0, 0, pl.ds(j * bk, bk)]
+            mb = mask_ref[0, 0]
             s = jnp.where(mb[None, :].astype(bool), s, _NEG)
         if causal:
-            # bottom-right aligned (tril k = Lk-Lq), matching the XLA path
             rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + iq * bq
-            cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + j * bk
+            cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + jk * bk
             s = jnp.where(cols <= rows + causal_off, s, _NEG)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        m_prev = m_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m - m_new)
-        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc = acc * alpha + jax.lax.dot_general(
-            p, vb, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
-        return acc, m_new, l
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[...] = m_new
 
-    acc, m, l = jax.lax.fori_loop(0, nk, body, (acc0, m0, l0))
-    l = jnp.maximum(l, 1e-30)  # fully-masked rows: output 0, lse finite
-    o_ref[0] = (acc / l).astype(o_ref.dtype)
-    lse_ref[0, 0] = (m + jnp.log(l))[:, 0]
+    if causal:  # skip tiles fully above the diagonal
+        pl.when(_causal_live(iq, jk, bq, bk, causal_off))(_step)
+    else:
+        _step()
+
+    @pl.when(jk == nk - 1)
+    def _finish():
+        l = jnp.maximum(l_ref[...], 1e-30)  # fully-masked rows → output 0
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+        lse_ref[0, 0] = (m_ref[...] + jnp.log(l))[:, 0]
+
+
+def _fwd_kernel_nomask(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                       acc_ref, m_ref, l_ref, **kw):
+    _fwd_kernel(q_ref, k_ref, v_ref, None, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref, **kw)
+
+
+def _scratch(bq, d):
+    if pltpu is None:
+        return None
+    return [pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32)]
 
 
 def _fwd(q, k, v, key_mask, causal, scale):
@@ -146,137 +196,159 @@ def _fwd(q, k, v, key_mask, causal, scale):
     q3 = q.reshape(BH, Lq, D)
     k3 = k.reshape(BH, Lk, D)
     v3 = v.reshape(BH, Lk, D)
-    grid = (BH, Lq // bq)
+    grid = (BH, Lq // bq, Lk // bk)
     in_specs = [
-        pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0), memory_space=_VMEM),
-        pl.BlockSpec((1, Lk, D), lambda b, i: (b, 0, 0), memory_space=_VMEM),
-        pl.BlockSpec((1, Lk, D), lambda b, i: (b, 0, 0), memory_space=_VMEM),
+        pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0), memory_space=_VMEM),
+        pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0), memory_space=_VMEM),
+        pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0), memory_space=_VMEM),
     ]
     args = [q3, k3, v3]
     if key_mask is not None:
         # (B, 1, Lk): TPU block shapes need the trailing two dims to be
         # tile-divisible or whole, so the mask rides with a singleton row.
         in_specs.append(pl.BlockSpec(
-            (1, 1, Lk), lambda b, i: (b // H, 0, 0), memory_space=_VMEM))
+            (1, 1, bk), lambda b, i, j: (b // H, 0, j), memory_space=_VMEM))
         args.append(key_mask.astype(jnp.int32).reshape(key_mask.shape[0], 1, Lk))
     kern = functools.partial(
         _fwd_kernel if key_mask is not None else _fwd_kernel_nomask,
-        scale=scale, causal=causal, bk=bk, n_heads=H, causal_off=Lk - Lq)
+        scale=scale, causal=causal, causal_off=Lk - Lq)
+    interpret = _interpret_for(q3)
+    kwargs = {}
+    if not interpret and pltpu is not None:
+        kwargs["compiler_params"] = pltpu.CompilerParams(**_dimsem(2))
     o, lse = pl.pallas_call(
         kern,
         grid=grid,
         in_specs=in_specs,
         out_specs=[
-            pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0), memory_space=_VMEM),
-            pl.BlockSpec((1, 1, bq), lambda b, i: (b, 0, i), memory_space=_VMEM),
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0),
+                         memory_space=_VMEM),
+            pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i),
+                         memory_space=_VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((BH, Lq, D), q.dtype),
             jax.ShapeDtypeStruct((BH, 1, Lq), jnp.float32),
         ],
-        interpret=_interpret_for(q3),
+        scratch_shapes=_scratch(bq, D),
+        interpret=interpret,
+        **kwargs,
     )(*args)
     return o.reshape(B, H, Lq, D), lse.reshape(B, H, Lq)
 
 
-def _fwd_kernel_nomask(q_ref, k_ref, v_ref, o_ref, lse_ref, **kw):
-    _fwd_kernel(q_ref, k_ref, v_ref, None, o_ref, lse_ref, **kw)
-
-
 # ---------------------------------------------------------------------------
-# backward: dkv kernel (grid over key blocks) + dq kernel (grid over q blocks)
+# backward: dkv kernel (grid B·H, nk, nq) + dq kernel (grid B·H, nq, nk);
 # delta = rowsum(do * o) precomputed with plain jnp.
 # ---------------------------------------------------------------------------
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
-                    dk_ref, dv_ref, *, scale, causal, bq, n_heads,
-                    causal_off=0):
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
+                    causal_off):
     bk, d = k_ref.shape[1], k_ref.shape[2]
-    lq = q_ref.shape[1]
-    nq = lq // bq
+    bq = q_ref.shape[1]
     jk = pl.program_id(1)
+    iq = pl.program_id(2)
+    nq = pl.num_programs(2)
 
-    kb = k_ref[0].astype(jnp.float32)
-    vb = v_ref[0].astype(jnp.float32)
-    if mask_ref is not None:
-        mb = mask_ref[0, 0].astype(bool)  # (bk,)
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
 
-    def body(i, carry):
-        dk, dv = carry
-        qb = q_ref[0, pl.ds(i * bq, bq), :].astype(jnp.float32)
-        dob = do_ref[0, pl.ds(i * bq, bq), :].astype(jnp.float32)
-        lseb = lse_ref[0, 0, pl.ds(i * bq, bq)]
-        deltab = delta_ref[0, 0, pl.ds(i * bq, bq)]
-        s = jax.lax.dot_general(qb * scale, kb, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+    def _step():
+        kb = k_ref[0]
+        vb = v_ref[0]
+        qb = q_ref[0]
+        dob = do_ref[0]
+        lseb = lse_ref[0, 0]
+        deltab = delta_ref[0, 0]
+        s = jax.lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
         if mask_ref is not None:
-            s = jnp.where(mb[None, :], s, _NEG)
+            s = jnp.where(mask_ref[0, 0].astype(bool)[None, :], s, _NEG)
         if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + i * bq
+            rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + iq * bq
             cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + jk * bk
             s = jnp.where(cols <= rows + causal_off, s, _NEG)
         p = jnp.exp(s - lseb[:, None])
-        dv = dv + jax.lax.dot_general(p, dob, (((0,), (0,)), ((), ())),
-                                      preferred_element_type=jnp.float32)
+        pb = p.astype(dob.dtype)
+        dv_acc[...] += jax.lax.dot_general(
+            pb, dob, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(dob, vb, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - deltab[:, None]) * scale
-        dk = dk + jax.lax.dot_general(ds, qb, (((0,), (0,)), ((), ())),
-                                      preferred_element_type=jnp.float32)
-        return dk, dv
+        ds = (p * (dp - deltab[:, None]) * scale).astype(qb.dtype)
+        dk_acc[...] += jax.lax.dot_general(
+            ds, qb, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
-    dk0 = jnp.zeros((bk, d), jnp.float32)
-    dv0 = jnp.zeros((bk, d), jnp.float32)
-    dk, dv = jax.lax.fori_loop(0, nq, body, (dk0, dv0))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    if causal:
+        pl.when(_causal_live(iq, jk, bq, bk, causal_off))(_step)
+    else:
+        _step()
+
+    @pl.when(iq == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
 def _bwd_dkv_kernel_nomask(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                           dk_ref, dv_ref, **kw):
+                           dk_ref, dv_ref, dk_acc, dv_acc, **kw):
     _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, None,
-                    dk_ref, dv_ref, **kw)
+                    dk_ref, dv_ref, dk_acc, dv_acc, **kw)
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
-                   dq_ref, *, scale, causal, bk, n_heads, causal_off=0):
+                   dq_ref, dq_acc, *, scale, causal, causal_off):
     bq, d = q_ref.shape[1], q_ref.shape[2]
-    lk = k_ref.shape[1]
-    nk = lk // bk
+    bk = k_ref.shape[1]
     iq = pl.program_id(1)
+    jk = pl.program_id(2)
+    nk = pl.num_programs(2)
 
-    qb = q_ref[0].astype(jnp.float32)
-    dob = do_ref[0].astype(jnp.float32)
-    lseb = lse_ref[0, 0]
-    deltab = delta_ref[0, 0]
+    @pl.when(jk == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
 
-    def body(j, dq):
-        kb = k_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
-        vb = v_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
-        s = jax.lax.dot_general(qb * scale, kb, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+    def _step():
+        qb = q_ref[0]
+        kb = k_ref[0]
+        vb = v_ref[0]
+        dob = do_ref[0]
+        lseb = lse_ref[0, 0]
+        deltab = delta_ref[0, 0]
+        s = jax.lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
         if mask_ref is not None:
-            mb = mask_ref[0, 0, pl.ds(j * bk, bk)]
-            s = jnp.where(mb[None, :].astype(bool), s, _NEG)
+            s = jnp.where(mask_ref[0, 0].astype(bool)[None, :], s, _NEG)
         if causal:
             rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + iq * bq
-            cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + j * bk
+            cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + jk * bk
             s = jnp.where(cols <= rows + causal_off, s, _NEG)
         p = jnp.exp(s - lseb[:, None])
         dp = jax.lax.dot_general(dob, vb, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - deltab[:, None]) * scale
-        return dq + jax.lax.dot_general(ds, kb, (((1,), (0,)), ((), ())),
-                                        preferred_element_type=jnp.float32)
+        ds = (p * (dp - deltab[:, None]) * scale).astype(kb.dtype)
+        dq_acc[...] += jax.lax.dot_general(
+            ds, kb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
-    dq = jax.lax.fori_loop(0, nk, body, jnp.zeros((bq, d), jnp.float32))
-    dq_ref[0] = dq.astype(dq_ref.dtype)
+    if causal:
+        pl.when(_causal_live(iq, jk, bq, bk, causal_off))(_step)
+    else:
+        _step()
+
+    @pl.when(jk == nk - 1)
+    def _finish():
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
 
 
 def _bwd_dq_kernel_nomask(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                          dq_ref, **kw):
+                          dq_ref, dq_acc, **kw):
     _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, None,
-                   dq_ref, **kw)
+                   dq_ref, dq_acc, **kw)
 
 
 def _bwd(q, k, v, key_mask, causal, scale, o, lse, do):
@@ -289,64 +361,77 @@ def _bwd(q, k, v, key_mask, causal, scale, o, lse, do):
     do3 = do.reshape(BH, Lq, D)
     lse3 = lse.reshape(BH, 1, Lq)
     delta3 = delta.reshape(BH, 1, Lq)
+    interpret = _interpret_for(q3)
+    kwargs = {}
+    if not interpret and pltpu is not None:
+        kwargs["compiler_params"] = pltpu.CompilerParams(**_dimsem(2))
 
-    common = [
-        pl.BlockSpec((1, Lq, D), lambda b, j: (b, 0, 0), memory_space=_VMEM),
-        pl.BlockSpec((1, Lk, D), lambda b, j: (b, 0, 0), memory_space=_VMEM),
-        pl.BlockSpec((1, Lk, D), lambda b, j: (b, 0, 0), memory_space=_VMEM),
-        pl.BlockSpec((1, Lq, D), lambda b, j: (b, 0, 0), memory_space=_VMEM),
-        pl.BlockSpec((1, 1, Lq), lambda b, j: (b, 0, 0), memory_space=_VMEM),
-        pl.BlockSpec((1, 1, Lq), lambda b, j: (b, 0, 0), memory_space=_VMEM),
+    # ---- dk/dv: fixed k-block (parallel), stream q-blocks (arbitrary)
+    dkv_specs = [
+        pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0), memory_space=_VMEM),
+        pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0), memory_space=_VMEM),
+        pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0), memory_space=_VMEM),
+        pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0), memory_space=_VMEM),
+        pl.BlockSpec((1, 1, bq), lambda b, j, i: (b, 0, i), memory_space=_VMEM),
+        pl.BlockSpec((1, 1, bq), lambda b, j, i: (b, 0, i), memory_space=_VMEM),
     ]
     args = [q3, k3, v3, do3, lse3, delta3]
-    mask_spec = []
     if key_mask is not None:
-        mask_spec = [pl.BlockSpec((1, 1, Lk), lambda b, j: (b // H, 0, 0),
-                                  memory_space=_VMEM)]
+        dkv_specs.append(pl.BlockSpec((1, 1, bk),
+                                      lambda b, j, i: (b // H, 0, j),
+                                      memory_space=_VMEM))
         args = args + [key_mask.astype(jnp.int32).reshape(-1, 1, Lk)]
-
-    dkv_specs = [
-        common[0],
-        pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0), memory_space=_VMEM),
-        pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0), memory_space=_VMEM),
-    ] + common[3:] + ([pl.BlockSpec((1, 1, bk), lambda b, j: (b // H, 0, j),
-                                    memory_space=_VMEM)] if key_mask is not None else [])
     dkv_kern = functools.partial(
         _bwd_dkv_kernel if key_mask is not None else _bwd_dkv_kernel_nomask,
-        scale=scale, causal=causal, bq=bq, n_heads=H, causal_off=Lk - Lq)
+        scale=scale, causal=causal, causal_off=Lk - Lq)
     dk, dv = pl.pallas_call(
         dkv_kern,
-        grid=(BH, Lk // bk),
+        grid=(BH, Lk // bk, Lq // bq),
         in_specs=dkv_specs,
         out_specs=[
-            pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0), memory_space=_VMEM),
-            pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0), memory_space=_VMEM),
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0),
+                         memory_space=_VMEM),
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0),
+                         memory_space=_VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((BH, Lk, D), k.dtype),
             jax.ShapeDtypeStruct((BH, Lk, D), v.dtype),
         ],
-        interpret=_interpret_for(q3),
+        scratch_shapes=([pltpu.VMEM((bk, D), jnp.float32),
+                         pltpu.VMEM((bk, D), jnp.float32)]
+                        if pltpu is not None else None),
+        interpret=interpret,
+        **kwargs,
     )(*args)
 
+    # ---- dq: fixed q-block (parallel), stream k-blocks (arbitrary)
     dq_specs = [
-        pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0), memory_space=_VMEM),
-        common[1], common[2],
-        pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0), memory_space=_VMEM),
-        pl.BlockSpec((1, 1, bq), lambda b, i: (b, 0, i), memory_space=_VMEM),
-        pl.BlockSpec((1, 1, bq), lambda b, i: (b, 0, i), memory_space=_VMEM),
-    ] + mask_spec
+        pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0), memory_space=_VMEM),
+        pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0), memory_space=_VMEM),
+        pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0), memory_space=_VMEM),
+        pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0), memory_space=_VMEM),
+        pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i), memory_space=_VMEM),
+        pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i), memory_space=_VMEM),
+    ]
+    if key_mask is not None:
+        dq_specs.append(pl.BlockSpec((1, 1, bk),
+                                     lambda b, i, j: (b // H, 0, j),
+                                     memory_space=_VMEM))
     dq_kern = functools.partial(
         _bwd_dq_kernel if key_mask is not None else _bwd_dq_kernel_nomask,
-        scale=scale, causal=causal, bk=bk, n_heads=H, causal_off=Lk - Lq)
+        scale=scale, causal=causal, causal_off=Lk - Lq)
     dq = pl.pallas_call(
         dq_kern,
-        grid=(BH, Lq // bq),
+        grid=(BH, Lq // bq, Lk // bk),
         in_specs=dq_specs,
-        out_specs=pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0),
+        out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0),
                                memory_space=_VMEM),
         out_shape=jax.ShapeDtypeStruct((BH, Lq, D), q.dtype),
-        interpret=_interpret_for(q3),
+        scratch_shapes=([pltpu.VMEM((bq, D), jnp.float32)]
+                        if pltpu is not None else None),
+        interpret=interpret,
+        **kwargs,
     )(*args)
     return (dq.reshape(B, H, Lq, D), dk.reshape(B, H, Lk, D),
             dv.reshape(B, H, Lk, D))

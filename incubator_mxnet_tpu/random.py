@@ -54,6 +54,19 @@ class trace_rng:
         return sub
 
 
+def _impl() -> str:
+    """PRNG implementation. TPU default is ``rbg`` (XLA RngBitGenerator):
+    bit generation runs at a fraction of threefry's cost, which matters for
+    per-step dropout masks over (B, L, hidden) activations — the reference's
+    cuDNN dropout uses a device generator of the same character. Override
+    with MXTPU_RNG_IMPL=threefry2x32 for strict cross-backend key parity."""
+    import os
+    env = os.environ.get("MXTPU_RNG_IMPL")
+    if env:
+        return env
+    return "rbg" if jax.default_backend() == "tpu" else "threefry2x32"
+
+
 def seed(seed_state: int, ctx: str | Context = "all") -> None:
     """Seed the generator(s). ``ctx='all'`` reseeds every context
     (reference: MXRandomSeed / MXRandomSeedContext)."""
@@ -64,13 +77,13 @@ def seed(seed_state: int, ctx: str | Context = "all") -> None:
             _keys.clear()
         else:
             ctx = Context(ctx) if not isinstance(ctx, Context) else ctx
-            _keys[ctx] = jax.random.key(seed_state)
+            _keys[ctx] = jax.random.key(seed_state, impl=_impl())
 
 
 def _key_for(ctx: Context) -> jax.Array:
     if ctx not in _keys:
         # Derive a distinct stream per (root seed, device type, device id).
-        base = jax.random.key(_root_seed)
+        base = jax.random.key(_root_seed, impl=_impl())
         _keys[ctx] = jax.random.fold_in(
             jax.random.fold_in(base, ctx.device_typeid), ctx.device_id
         )

@@ -84,11 +84,22 @@ def test_flash_cross_length_causal_matches_xla():
 
 
 def test_flash_rejects_non_divisible_lengths():
+    # 300 > the 256 q-block and not a multiple of it; short sequences
+    # (L <= block) are always divisible since the block clamps to L.
     rng = onp.random.RandomState(6)
-    q, k, v = (jnp.asarray(rng.randn(1, 1, 200, 32), jnp.float32)
+    q, k, v = (jnp.asarray(rng.randn(1, 1, 300, 32), jnp.float32)
                for _ in range(3))
     with pytest.raises(ValueError):
         flash_attention(q, k, v)
+
+
+def test_flash_odd_short_length_now_supported():
+    rng = onp.random.RandomState(7)
+    q, k, v = (jnp.asarray(rng.randn(1, 2, 200, 32), jnp.float32)
+               for _ in range(3))
+    out = flash_attention(q, k, v)
+    ref = dot_product_attention(q, k, v, impl="xla")
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref), atol=2e-5)
 
 
 def test_interleaved_selfatt_ops_match_dense():
