@@ -224,6 +224,10 @@ def _deposit(arr, grad_map) -> None:
         arr._grad._data = arr._grad._data + g
     else:  # 'write'
         arr._grad._data = g
+    arr._grad._version += 1
+    # Freshness mark read by Trainer's stale-grad check (reference:
+    # Parameter._fresh_grad — only backward sets it, only updates clear it).
+    arr._grad._fresh_grad = True
 
 
 def grad(

@@ -38,11 +38,21 @@ def _accel_platforms() -> List[str]:
 
 
 def _devices_for(dev_type: str) -> List[jax.Device]:
-    """Concrete jax devices backing a context type."""
-    all_devices = jax.devices()
+    """Concrete jax devices backing a context type.
+
+    device_id is WORKER-LOCAL (reference: each dmlc worker numbers its own
+    GPUs from 0) — in a multi-controller run only this process's devices are
+    addressable, so contexts index ``jax.local_devices()``.
+    """
+    all_devices = jax.local_devices()
     if dev_type in ("cpu", "cpu_pinned", "cpu_shared"):
+        local_cpu = [d for d in all_devices if d.platform == "cpu"]
+        if local_cpu:
+            return local_cpu
         try:
-            return jax.devices("cpu")
+            # default backend is an accelerator: this process's CPU devices
+            # live on the cpu backend (still worker-local).
+            return jax.local_devices(backend="cpu")
         except RuntimeError:
             # CPU platform absent (rare) — fall back to default devices.
             return all_devices
@@ -52,7 +62,7 @@ def _devices_for(dev_type: str) -> List[jax.Device]:
         return accel
     # No accelerator present: transparently fall back to CPU so that
     # device-parametrized test suites (SURVEY §4.1) run everywhere.
-    return jax.devices("cpu") if _has_cpu() else all_devices
+    return _devices_for("cpu") if _has_cpu() else all_devices
 
 
 def _has_cpu() -> bool:

@@ -141,10 +141,23 @@ class Trainer:
         for i, param in enumerate(self._params):
             if param.grad_req == "null" or param._data is None:
                 continue
-            if not ignore_stale_grad:
-                for data in param.list_data():
-                    pass  # version-staleness bookkeeping is implicit (tape)
-            for weight, grad in zip(param.list_data(), param.list_grad()):
+            grads = param.list_grad()
+            # Stale-gradient protocol (reference: Trainer._update over
+            # Parameter._fresh_grad): a grad is fresh only if backward
+            # deposited into it since the last applied update. Stale ⇒
+            # UserWarning, or a skipped update under ignore_stale_grad.
+            if not all(g._fresh_grad for g in grads):
+                if not ignore_stale_grad:
+                    raise UserWarning(
+                        f"Gradient of Parameter `{param.name}` on context "
+                        f"{param.list_ctx()} has not been updated by backward "
+                        "since last `step`. This could mean a bug in your "
+                        "model that made it only use a subset of the "
+                        "Parameters (Blocks) for this iteration. If you are "
+                        "intentionally only using a subset, call step with "
+                        "ignore_stale_grad=True to suppress this warning")
+                continue
+            for weight, grad in zip(param.list_data(), grads):
                 if i not in self._states:
                     self._states[i] = self._optimizer.create_state_multi_precision(i, weight)
                 self._states[i] = self._optimizer.update(
@@ -156,6 +169,8 @@ class Trainer:
                 for w in datas[1:]:
                     w._data = src._data
                     w._version += 1
+            for g in grads:
+                g._fresh_grad = False
 
     def save_states(self, fname: str):
         """Serialize optimizer state (reference: Trainer.save_states)."""

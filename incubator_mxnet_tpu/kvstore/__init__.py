@@ -32,12 +32,91 @@ from typing import Callable, Dict, List, Optional, Sequence, Union
 import jax
 import jax.numpy as jnp
 import numpy as onp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ..base import MXNetError
 from ..ndarray import NDArray
 from ..context import current_context
 
 __all__ = ["KVStore", "KVStoreBase", "create"]
+
+P = PartitionSpec
+
+
+# ---------------------------------------------------------------------------
+# The ONE execution mechanism: a jitted shard_map psum over a device mesh,
+# batched over keys (KVStoreNCCL's grouped ncclAllReduce; SURVEY §2.5/§5.8).
+# Executables are cached per (mesh devices, shapes/dtypes) — the analog of
+# NCCL communicator reuse across pushes.
+# ---------------------------------------------------------------------------
+
+_AR_CACHE: Dict[tuple, Callable] = {}
+
+
+def _allreduce_fn(mesh: Mesh, sig: tuple) -> Callable:
+    """Compiled all-reduce over the leading (device) axis for a tuple of
+    stacked arrays — ONE executable for the whole key batch; XLA emits one
+    fused all-reduce (verified in tests via the lowered HLO)."""
+    key = (tuple(mesh.devices.flat), sig)
+    fn = _AR_CACHE.get(key)
+    if fn is None:
+        from ..parallel.collectives import shard_map
+
+        def reduce_all(*xs):
+            return tuple(jax.lax.psum(x, "kv") for x in xs)
+
+        fn = jax.jit(shard_map(
+            reduce_all, mesh=mesh,
+            in_specs=tuple(P("kv") for _ in sig),
+            out_specs=tuple(P("kv") for _ in sig)))
+        _AR_CACHE[key] = fn
+    return fn
+
+
+def _device_allreduce(batches: List[List[jax.Array]]) -> List[jax.Array]:
+    """Sum each key's replica list with one compiled cross-device collective.
+
+    ``batches``: per key, the list of replica arrays (all same shape).
+    Co-located replicas are pre-summed with device-local adds; the distinct
+    devices then join ONE jitted psum, their replicas stacked zero-copy into
+    an array sharded over a 1-D mesh. When multi-process, the mesh spans
+    every process's devices (devices without a replica contribute zeros), so
+    the same single executable is the pod-wide all-reduce — psum over
+    ICI/DCN exactly where the reference's ncclAllReduce sat. Returns, per
+    key, a mesh-sharded array in which EVERY contributing device holds the
+    full sum as its shard (read back per device without further transfers).
+    """
+    per_key = []
+    for vlist in batches:
+        by_dev: Dict = {}
+        for v in vlist:
+            d = next(iter(v.devices()))
+            by_dev[d] = v if d not in by_dev else by_dev[d] + v
+        per_key.append(by_dev)
+    multi = jax.process_count() > 1
+    used = sorted({d for bk in per_key for d in bk}, key=lambda d: d.id)
+    if not multi and len(used) == 1:
+        return [next(iter(bk.values())) for bk in per_key]
+    devices = list(jax.devices()) if multi else used
+    local_devices = jax.local_devices() if multi else used
+    mesh = Mesh(onp.array(devices), ("kv",))
+    n_dev = len(devices)
+    stacked, sig = [], []
+    for by_dev in per_key:
+        sample = next(iter(by_dev.values()))
+        shape, dtype = tuple(sample.shape), sample.dtype
+        shards = []
+        for d in local_devices:
+            src = by_dev.get(d)
+            buf = (jax.device_put(jnp.zeros(shape, dtype), d)
+                   if src is None else src)
+            shards.append(buf.reshape((1,) + shape))
+        arr = jax.make_array_from_single_device_arrays(
+            (n_dev,) + shape, NamedSharding(mesh, P("kv")), shards)
+        stacked.append(arr)
+        sig.append((shape, str(dtype)))
+    outs = _allreduce_fn(mesh, tuple(sig))(*stacked)
+    return list(outs)
 
 
 _REGISTRY: Dict[str, type] = {}
@@ -126,6 +205,9 @@ class KVStore(KVStoreBase):
         self._comm = comm
         self._store: Dict[Union[int, str], NDArray] = {}
         self._merged: Dict[Union[int, str], NDArray] = {}
+        #: per key, {device: full-sum shard} left behind by the collective —
+        #: lets pull() hand every replica its device-resident copy for free
+        self._merged_shards: Dict[Union[int, str], Dict] = {}
         self._updater: Optional[Callable] = None
         self._optimizer = None
         self._opt_states: Dict[Union[int, str], tuple] = {}
@@ -163,28 +245,42 @@ class KVStore(KVStoreBase):
                 continue
             self._store[k] = NDArray(jnp.array(v._data))
 
-    def _reduce(self, vlist) -> jnp.ndarray:
-        total = vlist[0]._data
-        for v in vlist[1:]:
-            total = total + v._data.astype(total.dtype)
-        return total
-
-    def _cross_process_sum(self, arr: jnp.ndarray) -> jnp.ndarray:
-        if self._comm != "mesh" or jax.process_count() == 1:
-            return arr
-        # Multi-controller sum: every process contributes its local reduced
-        # gradient; the gather+sum over the process axis is the pod-wide
-        # ncclAllReduce of the reference (rides ICI/DCN via XLA).
-        from jax.experimental import multihost_utils
-        return multihost_utils.process_allgather(arr).sum(axis=0)
-
     def push(self, key, value, priority: int = 0):
+        """Accumulate. comm='mesh' sums every key's replica list — and, when
+        multi-process, every process's push — in ONE compiled collective per
+        key batch (``_device_allreduce``; KVStoreNCCL / dist_sync parity).
+        Push a key *list* to get the reference's grouped-all-reduce batching.
+        """
+        items = []
         for k, v in zip(self._keys(key), self._vals(key, value)):
             vlist = v if isinstance(v, (list, tuple)) else [v]
-            merged = self._cross_process_sum(self._reduce(vlist))
+            items.append((k, [x._data for x in vlist]))
+        if self._comm == "mesh":
+            sums = _device_allreduce([b for _, b in items])
+            merged_list = []
+            for (k, _), s in zip(items, sums):
+                if len(s.devices()) > 1:  # mesh-sharded full-sum result
+                    shards = {sh.device: sh.data.reshape(s.shape[1:])
+                              for sh in s.addressable_shards}
+                    self._merged_shards[k] = shards
+                    merged_list.append((k, next(iter(shards.values()))))
+                else:
+                    self._merged_shards.pop(k, None)
+                    merged_list.append((k, s))
+        else:
+            merged_list = []
+            for k, b in items:
+                total = b[0]
+                for a in b[1:]:
+                    total = total + a.astype(total.dtype)
+                merged_list.append((k, total))
+        for k, merged in merged_list:
             if self._updater is not None or self._optimizer is not None:
                 if k not in self._store:
                     raise MXNetError(f"please init key {k!r} before push")
+                # pull() must see the UPDATED WEIGHT, not the gradient sum
+                # the collective left per device.
+                self._merged_shards.pop(k, None)
                 self._apply_update(k, merged)
             else:
                 self._merged[k] = NDArray(merged)
@@ -207,9 +303,14 @@ class KVStore(KVStoreBase):
                     raise MXNetError("pull: out list length != key list length")
             else:
                 outs = [out]
-            for o, r in zip(outs, results):
+            for k, o, r in zip(self._keys(key), outs, results):
+                shards = self._merged_shards.get(k, {})
                 for oo in (o if isinstance(o, (list, tuple)) else [o]):
-                    oo._set_data(r._data.astype(oo.dtype))
+                    # Zero transfer when the collective already left the full
+                    # sum on this replica's device.
+                    dev = next(iter(oo._data.devices()), None)
+                    src = shards.get(dev, r._data)
+                    oo._set_data(src.astype(oo.dtype))
             return out
         return results if isinstance(key, (list, tuple)) else results[0]
 

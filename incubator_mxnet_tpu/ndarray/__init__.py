@@ -22,6 +22,7 @@ from ..ops.registry import OPS
 from .ndarray import NDArray, array, _unwrap, _dtype_of
 from .op import dispatch_op, make_nd_op
 from . import random  # noqa: F401
+from . import sparse  # noqa: F401
 
 __all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
            "linspace", "eye", "save", "load", "waitall", "concatenate",
@@ -39,6 +40,19 @@ def refresh_ops() -> None:
 
 
 refresh_ops()
+
+_dense_dot = _this.dot
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False, **kwargs) -> NDArray:
+    """dot with sparse dispatch: a CSR lhs routes to the nnz-structured
+    kernel (sparse.dot), everything else to the dense MXU path."""
+    from .sparse import CSRNDArray, dot as _sparse_dot
+    if isinstance(lhs, CSRNDArray):
+        return _sparse_dot(lhs, rhs, transpose_a=transpose_a,
+                           transpose_b=transpose_b)
+    return _dense_dot(lhs, rhs, transpose_a=transpose_a,
+                      transpose_b=transpose_b, **kwargs)
 
 
 # ---------------------------------------------------------------------------
@@ -127,12 +141,40 @@ def from_numpy(np_array, zero_copy=False) -> NDArray:
     return array(np_array)
 
 
+class DLPackCarrier:
+    """DLPack-protocol view over a device buffer (zero-copy interchange;
+    reference: python/mxnet/dlpack.py). Modern consumers (np/torch/jax
+    ``from_dlpack``) call ``__dlpack__``/``__dlpack_device__`` themselves —
+    this object defers capsule creation to the consumer, which is the
+    zero-copy contract (a pre-made capsule can be consumed only once)."""
+
+    def __init__(self, arr):
+        self._arr = arr
+
+    def __dlpack__(self, **kwargs):
+        return self._arr.__dlpack__(**kwargs)
+
+    def __dlpack_device__(self):
+        return self._arr.__dlpack_device__()
+
+
 def from_dlpack(dlpack) -> NDArray:
-    return NDArray(jnp.from_dlpack(dlpack))
+    """Accepts a DLPack-protocol object (anything with ``__dlpack__``) or a
+    legacy PyCapsule (consumed via torch, one host copy)."""
+    if hasattr(dlpack, "__dlpack__"):
+        return NDArray(jnp.from_dlpack(dlpack))
+    try:  # legacy capsule path: jax only accepts protocol objects
+        import torch.utils.dlpack as _tdl
+    except ImportError as e:
+        raise MXNetError(
+            "from_dlpack got a raw PyCapsule; consuming one needs torch "
+            "(pass the producing array itself, or any object implementing "
+            "__dlpack__, for the zero-copy path)") from e
+    return NDArray(jnp.asarray(_tdl.from_dlpack(dlpack).detach().cpu().numpy()))
 
 
-def to_dlpack_for_read(data: NDArray):
-    return data._data.__dlpack__()
+def to_dlpack_for_read(data: NDArray) -> DLPackCarrier:
+    return DLPackCarrier(data._data)
 
 
 to_dlpack_for_write = to_dlpack_for_read

@@ -44,7 +44,8 @@ def _unwrap(x):
 class NDArray:
     """A mutable n-dimensional array on a device Context."""
 
-    __slots__ = ("_data", "_ctx", "_version", "_grad", "_grad_req", "_fresh_grad_node", "__weakref__")
+    __slots__ = ("_data", "_ctx", "_version", "_grad", "_grad_req",
+                 "_fresh_grad_node", "_fresh_grad", "__weakref__")
 
     # numpy interop priority (so ndarray.__add__ defers to us)
     __array_priority__ = 1000.0
@@ -71,6 +72,7 @@ class NDArray:
             self._grad = None
             self._grad_req = "null"
             self._fresh_grad_node = None
+            self._fresh_grad = False
             return
         # Commit to the context's device if not already there.
         dev = ctx.jax_device
@@ -85,6 +87,10 @@ class NDArray:
         self._grad = None
         self._grad_req = "null"
         self._fresh_grad_node = None
+        # Set by autograd backward when it deposits into this array's grad
+        # slot; cleared by Trainer updates (reference: NDArray fresh-grad
+        # state behind MXNDArrayGetGradState).
+        self._fresh_grad = False
 
     # ------------------------------------------------------------------
     # basic properties
@@ -423,6 +429,14 @@ class NDArray:
     def dot(self, other) -> "NDArray":
         from . import dot as _dot
         return _dot(self, other)
+
+    def to_dlpack_for_read(self):
+        """DLPack-protocol view over the device buffer (zero-copy
+        interchange; reference: python/mxnet/dlpack.py)."""
+        from . import to_dlpack_for_read as _to
+        return _to(self)
+
+    to_dlpack_for_write = to_dlpack_for_read
 
     def as_nd_ndarray(self):
         return self

@@ -19,7 +19,7 @@ from ..context import Context, current_context
 from .ndarray import NDArray, array
 
 __all__ = ["RowSparseNDArray", "CSRNDArray", "row_sparse_array", "csr_matrix",
-           "cast_storage", "zeros"]
+           "cast_storage", "dot", "zeros"]
 
 
 class BaseSparseNDArray(NDArray):
@@ -64,7 +64,7 @@ class RowSparseNDArray(BaseSparseNDArray):
 
 
 class CSRNDArray(BaseSparseNDArray):
-    __slots__ = ("_indptr", "_col_indices", "_values")
+    __slots__ = ("_indptr", "_col_indices", "_values", "_row_indices")
 
     def __init__(self, data, indptr, indices, shape, ctx=None, dtype=None):
         vals = jnp.asarray(data, dtype=dtype)
@@ -74,11 +74,14 @@ class CSRNDArray(BaseSparseNDArray):
         ip = onp.asarray(indptr)
         cl = onp.asarray(col)
         vl = onp.asarray(vals)
-        for r in range(shape[0]):
-            for j in range(int(ip[r]), int(ip[r + 1])):
-                dense[r, int(cl[j])] = vl[j]
+        # Vectorized scatter: row index of every nonzero from the indptr
+        # runs. Duplicate (row, col) entries accumulate — same contract as
+        # the nnz-structured dot() below.
+        rows = onp.repeat(onp.arange(int(shape[0])), onp.diff(ip))
+        onp.add.at(dense, (rows, cl), vl)
         super().__init__(jnp.asarray(dense), ctx=ctx)
         self._indptr, self._col_indices, self._values = indptr, col, vals
+        self._row_indices = jnp.asarray(rows, jnp.int32)
 
     @property
     def stype(self):
@@ -118,15 +121,43 @@ def csr_matrix(arg1, shape=None, ctx=None, dtype=None) -> CSRNDArray:
         data, indices, indptr = arg1
         return CSRNDArray(data, indptr, indices, shape, ctx=ctx, dtype=dtype)
     dense = onp.asarray(arg1._data if isinstance(arg1, NDArray) else arg1)
-    indptr = [0]
-    cols, vals = [], []
-    for r in range(dense.shape[0]):
-        nz = onp.nonzero(dense[r])[0]
-        cols.extend(nz.tolist())
-        vals.extend(dense[r][nz].tolist())
-        indptr.append(len(cols))
-    return CSRNDArray(onp.array(vals, dense.dtype), onp.array(indptr), onp.array(cols),
-                      dense.shape, ctx=ctx, dtype=dtype)
+    rows, cols = onp.nonzero(dense)
+    vals = dense[rows, cols]
+    counts = onp.bincount(rows, minlength=dense.shape[0])
+    indptr = onp.concatenate([[0], onp.cumsum(counts)])
+    return CSRNDArray(vals.astype(dense.dtype), indptr.astype(onp.int64),
+                      cols.astype(onp.int64), dense.shape, ctx=ctx, dtype=dtype)
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Sparse-dense matmul computed on the nonzero structure only.
+
+    Reference parity: the csr kernels of ``src/operator/tensor/dot-inl.h``
+    (``dot(csr, dense)`` and ``dot(csr.T, dense)``). TPU formulation: a
+    gather of B rows by the nonzeros' column index followed by a
+    segment-sum scatter-add — both static-shaped over nnz, so the whole
+    contraction jits (no dynamic sparsity inside the compiled program).
+    """
+    if transpose_b:
+        raise MXNetError("sparse dot: transpose_b is unsupported (reference "
+                         "csr kernels are lhs-sparse only)")
+    if not isinstance(lhs, CSRNDArray):
+        raise MXNetError("sparse dot needs a CSR lhs; use dense dot otherwise")
+    B = rhs._data if isinstance(rhs, NDArray) else jnp.asarray(rhs)
+    vals, rows, cols = lhs._values, lhs._row_indices, lhs._col_indices
+    out_dtype = jnp.result_type(vals.dtype, B.dtype)
+    contrib_shape = vals.shape + (1,) * (B.ndim - 1)
+    if transpose_a:
+        # out[k] += A[r, k] * B[r]  for every nonzero (r, k)
+        out_rows = int(lhs.shape[1])
+        contrib = vals.reshape(contrib_shape) * B[rows]
+        out = jnp.zeros((out_rows,) + B.shape[1:], out_dtype).at[cols].add(contrib)
+    else:
+        # out[r] += A[r, c] * B[c]  for every nonzero (r, c)
+        out_rows = int(lhs.shape[0])
+        contrib = vals.reshape(contrib_shape) * B[cols]
+        out = jnp.zeros((out_rows,) + B.shape[1:], out_dtype).at[rows].add(contrib)
+    return NDArray(out, ctx=lhs.context)
 
 
 def cast_storage(arr: NDArray, stype: str):

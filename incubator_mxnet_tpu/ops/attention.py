@@ -52,8 +52,11 @@ def dot_product_attention(query, key, value, mask=None, causal=False,
     ``(B, H, Lq, D)``.
 
     ``impl``: "auto" picks the Pallas flash kernel on TPU when shapes allow,
-    else the XLA-fused jnp path; "xla" / "flash" force one.
+    else the XLA-fused jnp path; "xla" / "flash" force one (env override:
+    MXTPU_ATTN_IMPL).
     """
+    import os
+    impl = os.environ.get("MXTPU_ATTN_IMPL", impl)
     scale = (query.shape[-1] ** -0.5) if scale is None else scale
     use_flash = False
     if impl in ("auto", "flash"):

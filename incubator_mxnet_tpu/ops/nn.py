@@ -708,11 +708,17 @@ def ctc_loss(data, label, data_lengths=None, label_lengths=None,
     lab = label.astype(jnp.int32)
     if use_label_lengths and label_lengths is not None:
         lab_len = label_lengths.astype(jnp.int32)
+    elif blank_label == "first":
+        # blank is class 0, real labels are 1..C-1, padding is 0 or -1
+        # (reference semantics: ctc_loss label packing).
+        lab_len = jnp.sum(lab > 0, axis=1).astype(jnp.int32)
     else:
-        valid = (lab >= 0) & (lab != blank if blank_label == "first" else lab >= 0)
-        lab_len = jnp.sum((lab > 0) if blank_label == "first" else (lab >= 0), axis=1).astype(jnp.int32)
-        lab_len = jnp.sum(lab > -1, axis=1).astype(jnp.int32) if blank_label != "first" else jnp.sum(lab > 0, axis=1) + jnp.sum(lab == 0, axis=1) * 0
-        lab_len = jnp.sum(lab > 0, axis=1).astype(jnp.int32) if blank_label == "first" else jnp.sum(lab >= 0, axis=1).astype(jnp.int32)
+        # blank is class C-1, real labels are 0..C-2, padding is -1.
+        lab_len = jnp.sum(lab >= 0, axis=1).astype(jnp.int32)
+    # Padded entries may be -1; clamp to blank so ext never holds a negative
+    # class index (those positions sit beyond 2*lab_len and cannot influence
+    # the left-to-right alpha recurrence).
+    lab = jnp.where(lab >= 0, lab, blank)
     t_len = (data_lengths.astype(jnp.int32) if use_data_lengths and data_lengths is not None
              else jnp.full((N,), T, jnp.int32))
 

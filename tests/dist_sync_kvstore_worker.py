@@ -1,0 +1,53 @@
+"""Worker for the multi-process kvstore test (reference:
+tests/nightly/dist_sync_kvstore.py — N workers on localhost, one store).
+
+Spawned by tests/test_dist_kvstore.py with env pinned to the CPU backend and
+1 local device per process. argv: <coordinator> <num_procs> <pid>.
+"""
+import sys
+
+import numpy as onp
+
+import jax
+
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+coord, nproc, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+
+from incubator_mxnet_tpu import parallel  # noqa: E402
+
+parallel.dist.initialize(coordinator_address=coord, num_processes=nproc,
+                         process_id=pid)
+assert jax.process_count() == nproc, jax.process_count()
+
+import incubator_mxnet_tpu as mx  # noqa: E402
+
+kv = mx.kv.create("dist_sync")
+assert kv.num_workers == nproc
+assert kv.rank == pid
+
+# 1. push/pull one key: every worker pushes rank+1 -> sum over workers
+kv.init(3, mx.nd.zeros((4, 2)))
+kv.push(3, mx.nd.full((4, 2), float(pid + 1)))
+want = sum(range(1, nproc + 1))
+out = mx.nd.zeros((4, 2))
+kv.pull(3, out=out)
+onp.testing.assert_allclose(out.asnumpy(), onp.full((4, 2), float(want)))
+
+# 2. batched key list in one push (grouped all-reduce)
+keys = [10, 11]
+kv.init(keys, [mx.nd.zeros((3,))] * 2)
+kv.push(keys, [mx.nd.full((3,), float(pid + 1)),
+               mx.nd.full((3,), 2.0 * (pid + 1))])
+o1, o2 = kv.pull(keys)
+onp.testing.assert_allclose(o1.asnumpy(), onp.full((3,), float(want)))
+onp.testing.assert_allclose(o2.asnumpy(), onp.full((3,), 2.0 * want))
+
+# 3. barrier then repeated push (state reuse / cached executable)
+kv.barrier()
+kv.push(3, mx.nd.ones((4, 2)))
+kv.pull(3, out=out)
+onp.testing.assert_allclose(out.asnumpy(),
+                            onp.full((4, 2), float(nproc)))
+
+print(f"DIST_KV_OK rank={pid}", flush=True)

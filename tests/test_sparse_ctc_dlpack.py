@@ -1,0 +1,224 @@
+"""Trust tests for the round-3 cleanups: CTC label-length semantics vs a
+brute-force numpy reference, vectorized CSR + sparse dot, dlpack interchange,
+and Trainer stale-gradient detection."""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, gluon, autograd
+
+
+# ---------------------------------------------------------------------------
+# CTC: brute-force reference — sum P(path) over every length-T path whose
+# collapse (dedup repeats, drop blanks) equals the label.
+# ---------------------------------------------------------------------------
+
+def _collapse(path, blank):
+    out, prev = [], None
+    for p in path:
+        if p != prev and p != blank:
+            out.append(p)
+        prev = p
+    return out
+
+
+def _brute_ctc(probs, label, blank):
+    """probs: (T, C) softmax-ed; label: list of class ids. Returns -log p."""
+    import itertools
+    T, C = probs.shape
+    total = 0.0
+    for path in itertools.product(range(C), repeat=T):
+        if _collapse(path, blank) == list(label):
+            p = 1.0
+            for t, cls in enumerate(path):
+                p *= probs[t, cls]
+            total += p
+    return -onp.log(max(total, 1e-300))
+
+
+def _ctc_case(blank_label, pad, labels):
+    rng = onp.random.RandomState(7)
+    T, N, C = 5, len(labels), 4
+    blank = 0 if blank_label == "first" else C - 1
+    acts = rng.randn(T, N, C).astype("float32")
+    L = max(len(l) for l in labels)
+    lab = onp.full((N, L), pad, "float32")
+    for i, l in enumerate(labels):
+        lab[i, :len(l)] = l
+    out = nd.ctc_loss(nd.array(acts), nd.array(lab),
+                      blank_label=blank_label).asnumpy()
+    probs = onp.exp(acts) / onp.exp(acts).sum(-1, keepdims=True)
+    want = [_brute_ctc(probs[:, i], labels[i], blank) for i in range(N)]
+    onp.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-4)
+
+
+def test_ctc_blank_first():
+    # blank=0, real labels 1..C-1, padded with 0
+    _ctc_case("first", pad=0, labels=[[1, 2], [3], [1, 1, 2]])
+
+
+def test_ctc_blank_last():
+    # blank=C-1, real labels 0..C-2, padded with -1
+    _ctc_case("last", pad=-1, labels=[[0, 1], [2], [0, 0, 1]])
+
+
+def test_ctc_explicit_label_lengths():
+    rng = onp.random.RandomState(3)
+    T, C = 5, 4
+    acts = rng.randn(T, 1, C).astype("float32")
+    # label row holds garbage beyond the declared length
+    lab = onp.array([[1, 2, 3]], "float32")
+    out = nd.ctc_loss(nd.array(acts), nd.array(lab),
+                      label_lengths=nd.array([2]),
+                      use_label_lengths=True).asnumpy()
+    probs = onp.exp(acts) / onp.exp(acts).sum(-1, keepdims=True)
+    want = _brute_ctc(probs[:, 0], [1, 2], 0)
+    onp.testing.assert_allclose(out, [want], rtol=1e-4, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# sparse
+# ---------------------------------------------------------------------------
+
+def _rand_csr(m, k, density, rng):
+    dense = rng.randn(m, k).astype("float32")
+    dense[rng.rand(m, k) >= density] = 0.0
+    return dense, mx.nd.sparse.csr_matrix(dense)
+
+
+def test_csr_round_trip():
+    rng = onp.random.RandomState(0)
+    dense, sp = _rand_csr(7, 9, 0.3, rng)
+    assert sp.stype == "csr"
+    onp.testing.assert_array_equal(sp.asnumpy(), dense)
+    # construct from (data, indices, indptr) triple too
+    sp2 = mx.nd.sparse.csr_matrix(
+        (sp.data.asnumpy(), sp.indices.asnumpy(), sp.indptr.asnumpy()),
+        shape=dense.shape)
+    onp.testing.assert_array_equal(sp2.asnumpy(), dense)
+
+
+def test_csr_empty_rows():
+    dense = onp.zeros((4, 5), "float32")
+    dense[2, 3] = 2.5
+    sp = mx.nd.sparse.csr_matrix(dense)
+    onp.testing.assert_array_equal(sp.asnumpy(), dense)
+    onp.testing.assert_array_equal(sp.indptr.asnumpy(), [0, 0, 0, 1, 1])
+
+
+def test_sparse_dot():
+    rng = onp.random.RandomState(1)
+    dense, sp = _rand_csr(6, 8, 0.25, rng)
+    B = rng.randn(8, 3).astype("float32")
+    out = nd.dot(sp, nd.array(B))
+    onp.testing.assert_allclose(out.asnumpy(), dense @ B, rtol=1e-5, atol=1e-5)
+
+
+def test_sparse_dot_transpose_a():
+    rng = onp.random.RandomState(2)
+    dense, sp = _rand_csr(6, 8, 0.25, rng)
+    B = rng.randn(6, 4).astype("float32")
+    out = nd.dot(sp, nd.array(B), transpose_a=True)
+    onp.testing.assert_allclose(out.asnumpy(), dense.T @ B, rtol=1e-5, atol=1e-5)
+
+
+def test_sparse_dot_vector_rhs():
+    rng = onp.random.RandomState(4)
+    dense, sp = _rand_csr(5, 7, 0.4, rng)
+    b = rng.randn(7).astype("float32")
+    out = nd.dot(sp, nd.array(b))
+    onp.testing.assert_allclose(out.asnumpy(), dense @ b, rtol=1e-5, atol=1e-5)
+
+
+def test_row_sparse_and_cast_storage():
+    rng = onp.random.RandomState(5)
+    dense = rng.randn(6, 4).astype("float32")
+    dense[[1, 3, 5]] = 0.0
+    rs = mx.nd.sparse.cast_storage(nd.array(dense), "row_sparse")
+    assert rs.stype == "row_sparse"
+    onp.testing.assert_array_equal(rs.asnumpy(), dense)
+    back = rs.tostype("default")
+    onp.testing.assert_array_equal(back.asnumpy(), dense)
+
+
+# ---------------------------------------------------------------------------
+# dlpack
+# ---------------------------------------------------------------------------
+
+def test_dlpack_round_trip():
+    x = nd.array(onp.arange(12, dtype="float32").reshape(3, 4))
+    cap = x.to_dlpack_for_read()
+    y = nd.from_dlpack(cap)
+    onp.testing.assert_array_equal(y.asnumpy(), x.asnumpy())
+
+
+def test_dlpack_module_functions():
+    x = nd.array(onp.ones((2, 2), "float32"))
+    y = nd.from_dlpack(nd.to_dlpack_for_read(x))
+    onp.testing.assert_array_equal(y.asnumpy(), x.asnumpy())
+
+
+# ---------------------------------------------------------------------------
+# stale gradients
+# ---------------------------------------------------------------------------
+
+def _tiny_two_branch():
+    class Net(gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.a = gluon.nn.Dense(3, in_units=4)
+                self.b = gluon.nn.Dense(3, in_units=4)
+
+        def hybrid_forward(self, F, x, use_b=False):
+            return self.b(x) if use_b else self.a(x)
+
+    net = Net()
+    net.initialize()
+    return net
+
+
+def test_stale_grad_raises():
+    net = _tiny_two_branch()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    x = nd.array(onp.random.RandomState(0).randn(2, 4).astype("float32"))
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    # b never went through backward -> its grad was never fresh (reference
+    # raises on the very first step too)
+    with pytest.raises(UserWarning):
+        trainer.step(2)
+
+
+def test_fresh_grads_update_cleanly():
+    net = _tiny_two_branch()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    x = nd.array(onp.random.RandomState(0).randn(2, 4).astype("float32"))
+    a_before = net.a.weight.data().asnumpy()
+    for _ in range(2):  # both branches touched each iteration
+        with autograd.record():
+            loss = (net(x, True).sum() + net(x).sum())
+        loss.backward()
+        trainer.step(2)
+    assert not onp.allclose(net.a.weight.data().asnumpy(), a_before)
+
+
+def test_stale_grad_ignored_skips_update():
+    net = _tiny_two_branch()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    x = nd.array(onp.random.RandomState(0).randn(2, 4).astype("float32"))
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    trainer.step(2, ignore_stale_grad=True)
+    b_before = net.b.weight.data().asnumpy()
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    trainer.step(2, ignore_stale_grad=True)
+    # a moved, b (never used) did not
+    onp.testing.assert_array_equal(net.b.weight.data().asnumpy(), b_before)
