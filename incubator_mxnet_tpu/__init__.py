@@ -61,6 +61,7 @@ _LAZY = {
     "numpy_extension": ".numpy_extension",
     "npx": ".numpy_extension",
     "models": ".models",
+    "quantization": ".quantization",
 }
 
 

@@ -43,3 +43,13 @@ sym = types.ModuleType("incubator_mxnet_tpu.contrib.sym")
 for _short, _opdef in _contrib_names().items():
     setattr(sym, _short, _make_sym(_opdef.name))
 sys.modules[sym.__name__] = sym
+
+
+def __getattr__(name):
+    # mx.contrib.quantization — lazy (reference: contrib/quantization.py)
+    if name == "quantization":
+        import importlib
+        mod = importlib.import_module("..quantization", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -481,6 +481,11 @@ class HybridBlock(Block):
             self._cached_params = [
                 p for _, p in sorted(self.collect_params().items())]
             self._warmed_up = True
+            # export() works after THIS call already (one hybridized
+            # forward, per the reference contract)
+            self._last_sig = (_strip_arrays(args), len(arr_args),
+                              [(tuple(a.shape), str(a._data.dtype))
+                               for a in arr_args], ctx)
             return out
 
         params = self._cached_params
@@ -491,6 +496,11 @@ class HybridBlock(Block):
         training = autograd.is_training()
         key_val = random_mod.next_key(ctx)
         n_in = len(arr_args)
+        # Remember the call signature so export() can re-trace an inference
+        # version of this graph for the deploy artifact.
+        self._last_sig = (_strip_arrays(args), n_in,
+                          [(tuple(a.shape), str(a._data.dtype))
+                           for a in arr_args], ctx)
         # Key must cover the arg *structure* (array count/nesting), not just
         # static leaf values — otherwise a call with a different number of
         # arrays would reuse a jit fn with a stale n_in/skeleton.
@@ -552,30 +562,94 @@ class HybridBlock(Block):
         return tuple(result)
 
     # ------------------------------------------------------------------
-    def export(self, path: str, epoch: int = 0) -> Tuple[str, str]:
-        """Serialize architecture + params (reference: HybridBlock.export →
-        model-symbol.json + model-0000.params). The architecture is exported
-        as the StableHLO of the jitted forward when available."""
+    def export(self, path: str, epoch: int = 0,
+               platforms=None) -> Tuple[str, str]:
+        """Serialize a self-contained deploy artifact (reference:
+        HybridBlock.export → model-symbol.json + model-0000.params).
+
+        TPU-native form: the inference forward is re-traced with
+        ``train_mode=False`` and serialized as **StableHLO** via
+        ``jax.export`` (`<path>-symbol.stablehlo`), alongside the dmlc
+        ``.params`` weights and a JSON manifest that records the calling
+        convention (input avals, parameter order, RNG key wire format,
+        output structure). :meth:`SymbolBlock.imports` reconstructs a
+        runnable block from these files WITHOUT the original Python class.
+
+        Requires one prior hybridized call (the reference requires a forward
+        before export for the same reason — shapes must be known).
+        ``platforms``: optional list (e.g. ``["cpu", "tpu"]``) to make the
+        artifact portable across backends; default = current backend only.
+        """
+        import json
+
         params_file = f"{path}-{epoch:04d}.params"
         params = self._collect_params_with_prefix()
         from .. import ndarray as nd
         nd.save(params_file, {k: p._check_and_get(p._data, None)
                               for k, p in params.items() if p._data is not None})
         sym_file = f"{path}-symbol.json"
-        import json
+        if getattr(self, "_last_sig", None) is None:
+            raise MXNetError(
+                "export() needs a traced graph: call hybridize() and run one "
+                "forward pass before exporting (reference behavior)")
+        skeleton, n_in, in_avals, ctx = self._last_sig
+        blk_params = self._cached_params
+        name_by_id = {id(p): k for k, p in params.items()}
+        param_order = [name_by_id[id(p)] for p in blk_params]
+        impl = random_mod._impl()
+        key_data_aval = jax.random.key_data(jax.random.key(0, impl=impl))
+        meta: Dict[str, Any] = {}
+
+        block = self
+
+        def pure_infer(key_data, *vals):
+            key = jax.random.wrap_key_data(key_data, impl=impl)
+            ins, pvals = vals[:n_in], vals[n_in:]
+            proxies = {id(p): NDArray(v, ctx=ctx)
+                       for p, v in zip(blk_params, pvals)}
+            it = iter(NDArray(v, ctx=ctx) for v in ins)
+            rebuilt = _rebuild_args(skeleton, it)
+            _TRACING.flag = True
+            try:
+                with autograd.pause(train_mode=False), \
+                        random_mod.trace_rng(key), \
+                        _trace.TraceScope(proxies):
+                    out = block.forward(*rebuilt)
+            finally:
+                _TRACING.flag = False
+            flat_out, out_fmt = _flatten_args(
+                out if isinstance(out, tuple) else (out,))
+            meta["out_fmt"] = out_fmt
+            meta["multi"] = isinstance(out, (tuple, list))
+            return tuple(o._data if isinstance(o, NDArray) else o
+                         for o in flat_out)
+
+        from jax import export as jax_export
+        args = [jax.ShapeDtypeStruct(key_data_aval.shape,
+                                     key_data_aval.dtype)]
+        args += [jax.ShapeDtypeStruct(s, jnp.dtype(d)) for s, d in in_avals]
+        args += [jax.ShapeDtypeStruct(tuple(p.shape), jnp.dtype(p.dtype))
+                 for p in blk_params]
+        kwargs = {"platforms": tuple(platforms)} if platforms else {}
+        exported = jax_export.export(jax.jit(pure_infer), **kwargs)(*args)
+        hlo_file = f"{path}-symbol.stablehlo"
+        with open(hlo_file, "wb") as f:
+            f.write(exported.serialize())
         arch = {
             "framework": "incubator_mxnet_tpu",
             "block": type(self).__name__,
             "name": self.name,
             "params": sorted(params.keys()),
+            "param_order": param_order,
+            "n_inputs": n_in,
+            "in_avals": [[list(s), d] for s, d in in_avals],
+            "key": {"shape": list(key_data_aval.shape),
+                    "dtype": str(key_data_aval.dtype), "impl": impl},
+            "out_fmt": meta["out_fmt"],
+            "multi": meta["multi"],
+            "stablehlo": hlo_file.rsplit("/", 1)[-1],
+            "platforms": list(exported.platforms),
         }
-        # Attach StableHLO if a cache exists (inspection/deploy parity).
-        for k, fn in self._jit_cache.items():
-            try:
-                arch["stablehlo_available"] = True
-            except Exception:
-                pass
-            break
         with open(sym_file, "w") as f:
             json.dump(arch, f, indent=2)
         return sym_file, params_file
@@ -603,28 +677,78 @@ def _rebuild_args(args, it):
 
 
 class SymbolBlock(HybridBlock):
-    """Construct a Block from a saved symbol + params (reference:
-    gluon.SymbolBlock.imports). Minimal TPU-era form: reloads exported
-    metadata + parameters; forward requires the original class for exotic
-    architectures."""
+    """A runnable Block reconstructed from an exported artifact (reference:
+    gluon.SymbolBlock.imports over model-symbol.json + .params).
+
+    TPU-native form: the compute graph is the serialized **StableHLO**
+    written by :meth:`HybridBlock.export`; ``imports`` deserializes it with
+    ``jax.export`` and replays it on call — the original Python Block class
+    is NOT needed. Parameters load from the dmlc ``.params`` file and feed
+    the compiled computation in the manifest's recorded order.
+    """
 
     def __init__(self, outputs, inputs, params=None):
         super().__init__(prefix="", params=params)
         self._outputs = outputs
         self._inputs = inputs
+        self._exported = None
+        self._arch = outputs if isinstance(outputs, dict) else None
+        self._param_arrays: Dict[str, NDArray] = {}
 
     @staticmethod
-    def imports(symbol_file: str, input_names, param_file: Optional[str] = None, ctx=None):
+    def imports(symbol_file: str, input_names,
+                param_file: Optional[str] = None, ctx=None) -> "SymbolBlock":
         import json
+        import os
         with open(symbol_file) as f:
             arch = json.load(f)
         blk = SymbolBlock(arch, input_names)
+        hlo_name = arch.get("stablehlo")
+        if hlo_name:
+            hlo_path = os.path.join(os.path.dirname(os.path.abspath(
+                symbol_file)), hlo_name)
+            from jax import export as jax_export
+            with open(hlo_path, "rb") as f:
+                blk._exported = jax_export.deserialize(bytearray(f.read()))
         if param_file:
-            blk.load_parameters(param_file, ctx=ctx, allow_missing=True, ignore_extra=True)
+            from .. import ndarray as nd
+            loaded = nd.load(param_file)
+            if not isinstance(loaded, dict):
+                raise MXNetError(f"{param_file}: expected a name->array dict")
+            blk._param_arrays = loaded
+            # surface them as real Parameters too (collect_params parity)
+            for name, arr in loaded.items():
+                p = blk.params.get(name, shape=arr.shape,
+                                   dtype=str(arr._data.dtype))
+                p._load_init(arr, ctx)
         return blk
 
+    def forward(self, *inputs):
+        if self._exported is None:
+            raise MXNetError(
+                "this SymbolBlock was imported from a manifest without a "
+                "StableHLO graph; re-export with HybridBlock.export() on "
+                "this framework version")
+        arch = self._arch
+        n_in = arch["n_inputs"]
+        if len(inputs) != n_in:
+            raise MXNetError(f"expected {n_in} input array(s), "
+                             f"got {len(inputs)}")
+        ctx = inputs[0].context if isinstance(inputs[0], NDArray) \
+            else current_context()
+        ins = [i._data if isinstance(i, NDArray) else jnp.asarray(i)
+               for i in inputs]
+        try:
+            pvals = [self._param_arrays[n]._data for n in arch["param_order"]]
+        except KeyError as e:
+            raise MXNetError(f"missing parameter {e} — pass param_file to "
+                             "imports()") from e
+        key = jax.random.key_data(jax.random.key(0, impl=arch["key"]["impl"]))
+        key = key.astype(jnp.dtype(arch["key"]["dtype"]))
+        outs = self._exported.call(key, *ins, *pvals)
+        flat = [NDArray(o, ctx=ctx) for o in outs]
+        result = _regroup(flat, arch["out_fmt"])
+        return tuple(result) if arch["multi"] else result[0]
+
     def hybrid_forward(self, F, x, *args, **kwargs):
-        raise MXNetError(
-            "SymbolBlock.imports on this framework restores parameters and "
-            "metadata; re-instantiate the original Block class for compute "
-            "(full symbol replay requires the symbol API, see mx.symbol).")
+        return self.forward(x, *args)

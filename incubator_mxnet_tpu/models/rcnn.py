@@ -1,0 +1,121 @@
+"""Faster-RCNN model family (reference: GluonCV ``model_zoo/faster_rcnn`` +
+the RPN path of ``src/operator/contrib/multi_proposal.cu``; SURVEY §2.9 names
+Faster-RCNN as a BASELINE.json workload).
+
+TPU-native design: the whole two-stage pipeline is FIXED-SHAPE — RPN scores →
+padded top-k → greedy-NMS scan emits exactly ``rpn_post_nms_top_n`` rois
+(zero-padded when exhausted), ROIAlign gathers static sampling grids, and the
+per-roi head is a batched matmul over ``B·R`` rois. One ``hybridize()`` away
+from a single XLA computation with no dynamic shapes anywhere.
+"""
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+from ..gluon.block import HybridBlock
+from ..gluon import nn
+
+__all__ = ["FasterRCNN", "RPN"]
+
+
+class _Backbone(HybridBlock):
+    """Small conv trunk standing in for VGG/ResNet-C4 (swap any feature
+    extractor with the same (B, C, H/stride, W/stride) contract)."""
+
+    def __init__(self, filters: Sequence[int] = (16, 32, 64), **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.blocks = []
+            for i, f in enumerate(filters):
+                conv = nn.Conv2D(f, 3, padding=1, activation="relu",
+                                 prefix=f"conv{i}_")
+                pool = nn.MaxPool2D(2, 2)
+                self.register_child(conv, f"conv{i}")
+                self.register_child(pool, f"pool{i}")
+                self.blocks += [conv, pool]
+
+    def hybrid_forward(self, F, x):
+        for b in self.blocks:
+            x = b(x)
+        return x
+
+
+class RPN(HybridBlock):
+    """Region proposal network head (reference: rcnn/rpn). Produces
+    objectness (B, 2A, H, W) and box deltas (B, 4A, H, W), then the
+    fixed-shape ``MultiProposal`` op turns them into rois."""
+
+    def __init__(self, channels: int, num_anchors: int, **kw):
+        super().__init__(**kw)
+        self._A = num_anchors
+        with self.name_scope():
+            self.conv = nn.Conv2D(channels, 3, padding=1, activation="relu",
+                                  prefix="conv_")
+            self.cls = nn.Conv2D(2 * num_anchors, 1, prefix="cls_")
+            self.reg = nn.Conv2D(4 * num_anchors, 1, prefix="reg_")
+
+    def hybrid_forward(self, F, x):
+        h = self.conv(x)
+        scores = self.cls(h)
+        B = scores.shape[0]
+        A, H, W = self._A, scores.shape[2], scores.shape[3]
+        # softmax over {bg, fg} per anchor (reference applies softmax over
+        # the reshaped (2, A*H*W) axis before Proposal)
+        s = F.softmax(scores.reshape((B, 2, A, H, W)), axis=1)
+        return s.reshape((B, 2 * A, H, W)), self.reg(h)
+
+
+class FasterRCNN(HybridBlock):
+    """Two-stage detector.
+
+    ``forward(x, im_info)`` → ``(cls_scores (B, R, num_classes+1),
+    box_deltas (B, R, 4·(num_classes+1)), rois (B·R, 5))`` with
+    ``R = rpn_post_nms_top_n`` — every output fixed-shape.
+    """
+
+    def __init__(self, num_classes: int,
+                 scales: Tuple[float, ...] = (2, 4),
+                 ratios: Tuple[float, ...] = (0.5, 1, 2),
+                 feature_stride: int = 8,
+                 rpn_pre_nms_top_n: int = 64,
+                 rpn_post_nms_top_n: int = 16,
+                 rpn_min_size: int = 2,
+                 roi_size: Tuple[int, int] = (7, 7),
+                 backbone_filters: Sequence[int] = (16, 32, 64), **kw):
+        super().__init__(**kw)
+        self._num_classes = num_classes
+        self._scales, self._ratios = tuple(scales), tuple(ratios)
+        self._stride = feature_stride
+        self._pre, self._post = rpn_pre_nms_top_n, rpn_post_nms_top_n
+        self._min_size = rpn_min_size
+        self._roi_size = tuple(roi_size)
+        A = len(scales) * len(ratios)
+        with self.name_scope():
+            self.backbone = _Backbone(backbone_filters, prefix="backbone_")
+            self.rpn = RPN(backbone_filters[-1], A, prefix="rpn_")
+            self.head_dense = nn.Dense(128, activation="relu",
+                                       prefix="head_", flatten=False)
+            self.cls_score = nn.Dense(num_classes + 1, prefix="cls_score_",
+                                      flatten=False)
+            self.bbox_pred = nn.Dense(4 * (num_classes + 1),
+                                      prefix="bbox_pred_", flatten=False)
+
+    def hybrid_forward(self, F, x, im_info):
+        feat = self.backbone(x)
+        rpn_cls, rpn_reg = self.rpn(feat)
+        rois = F.MultiProposal(
+            rpn_cls, rpn_reg, im_info,
+            rpn_pre_nms_top_n=self._pre, rpn_post_nms_top_n=self._post,
+            rpn_min_size=self._min_size, scales=self._scales,
+            ratios=self._ratios, feature_stride=self._stride)
+        pooled = F.ROIAlign(feat, rois, pooled_size=self._roi_size,
+                            spatial_scale=1.0 / self._stride,
+                            sample_ratio=2)                 # (B·R, C, PH, PW)
+        B = x.shape[0]
+        R = self._post
+        flat = pooled.reshape((B * R, -1))
+        h = self.head_dense(flat)
+        cls = F.softmax(self.cls_score(h), axis=-1).reshape(
+            (B, R, self._num_classes + 1))
+        box = self.bbox_pred(h).reshape((B, R, 4 * (self._num_classes + 1)))
+        return cls, box, rois

@@ -42,6 +42,58 @@ def _mask_bias(mask, dtype):
     return jnp.where(mask.astype(bool), jnp.zeros((), dtype), jnp.full((), _NEG, dtype))
 
 
+def _maybe_ring(query, key, value, mask, causal, scale):
+    """Lower to ring attention when an active mesh shards sequence over sp.
+
+    Conditions: tracing (inside a compiled step), sp>1, self-attention
+    (Lq == Lk, divisible over sp), and a key-padding-style mask (or none).
+    Returns None to fall through to the single-shard paths.
+    """
+    from ..parallel.mesh import current_active_mesh
+    mesh = current_active_mesh()
+    if mesh is None or mesh.shape.get("sp", 1) <= 1:
+        return None
+    if not isinstance(query, jax.core.Tracer):
+        return None
+    if query.ndim != 4 or key.shape != value.shape:
+        return None
+    B, H, Lq, D = query.shape
+    Lk = key.shape[2]
+    sp = mesh.shape["sp"]
+    if Lq != Lk or Lq % sp:
+        return None
+    dp = mesh.shape.get("dp", 1)
+    tp = mesh.shape.get("tp", 1)
+    if B % max(dp, 1) or H % max(tp, 1):
+        return None
+    key_mask = None
+    if mask is not None:
+        from .pallas.flash_attention import _as_key_mask
+        key_mask = _as_key_mask(mask, B, H, Lq, Lk)
+        if key_mask is None:
+            return None                     # dense masks stay on XLA path
+        if key_mask.shape[1] % sp:
+            return None
+    from functools import partial
+    from ..parallel.collectives import shard_map
+    from ..parallel.ring import ring_attention
+    from jax.sharding import PartitionSpec as P
+    bspec = "dp" if dp > 1 else None
+    hspec = "tp" if tp > 1 else None
+    spec = P(bspec, hspec, "sp", None)
+    if key_mask is None:
+        fn = shard_map(
+            partial(ring_attention, key_mask=None, axis="sp",
+                    causal=causal, scale=scale),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+        return fn(query, key, value)
+    mspec = P(bspec, "sp")
+    fn = shard_map(
+        partial(ring_attention, axis="sp", causal=causal, scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec, mspec), out_specs=spec)
+    return fn(query, key, value, key_mask)
+
+
 @register_op()
 def dot_product_attention(query, key, value, mask=None, causal=False,
                           scale=None, impl="auto", **_):
@@ -58,6 +110,14 @@ def dot_product_attention(query, key, value, mask=None, causal=False,
     import os
     impl = os.environ.get("MXTPU_ATTN_IMPL", impl)
     scale = (query.shape[-1] ** -0.5) if scale is None else scale
+    # Sequence parallelism: when tracing under a mesh with sp>1 (ShardedTrainer
+    # binds it via parallel.mesh.active_mesh), lower to ring attention — K/V
+    # shards rotate over the sp axis, the per-hop block attention is the
+    # Pallas flash kernel. See parallel/ring.py.
+    if impl in ("auto", "ring"):
+        ring_out = _maybe_ring(query, key, value, mask, causal, scale)
+        if ring_out is not None:
+            return ring_out
     use_flash = False
     if impl in ("auto", "flash"):
         try:

@@ -296,12 +296,13 @@ def roi_align(data, rois, pooled_size=(7, 7), spatial_scale=1.0,
     """ROIAlign with bilinear sampling (reference: roi_align.cc).
     data (B, C, H, W); rois (R, 5) [batch_idx, x1, y1, x2, y2] in image
     coords. Returns (R, C, PH, PW)."""
-    if position_sensitive:
-        raise NotImplementedError(
-            "position-sensitive ROIAlign (PS-ROIAlign) is not implemented; "
-            "use position_sensitive=False")
     B, C, H, W = data.shape
     PH, PW = pooled_size
+    if position_sensitive:
+        if C % (PH * PW):
+            raise ValueError(
+                f"PS-ROIAlign needs channels divisible by PH*PW={PH * PW}, "
+                f"got {C}")
     sr = max(1, int(sample_ratio))
     off = 0.5 if aligned else 0.0
 
@@ -337,7 +338,19 @@ def roi_align(data, rois, pooled_size=(7, 7), spatial_scale=1.0,
         yy, xx = jnp.meshgrid(gy, gx, indexing="ij")      # (PH*sr, PW*sr)
         samples = jax.vmap(jax.vmap(bilinear))(yy, xx)    # (PH*sr, PW*sr, C)
         samples = samples.reshape(PH, sr, PW, sr, C)
-        return jnp.mean(samples, axis=(1, 3)).transpose(2, 0, 1)
+        pooled = jnp.mean(samples, axis=(1, 3))           # (PH, PW, C)
+        if not position_sensitive:
+            return pooled.transpose(2, 0, 1)
+        # PS-ROIAlign (reference: R-FCN / deformable PS-ROIPooling layout):
+        # bin (ph, pw) of output channel o reads input channel
+        # o*PH*PW + ph*PW + pw — each spatial bin has its own score map.
+        Cout = C // (PH * PW)
+        ps = pooled.reshape(PH, PW, Cout, PH * PW)
+        bin_idx = (jnp.arange(PH)[:, None] * PW
+                   + jnp.arange(PW)[None, :])             # (PH, PW)
+        ps = jnp.take_along_axis(
+            ps, bin_idx[:, :, None, None].astype(jnp.int32), axis=3)[..., 0]
+        return ps.transpose(2, 0, 1)                      # (Cout, PH, PW)
 
     return jax.vmap(one)(rois).astype(data.dtype)
 
@@ -376,3 +389,253 @@ def roi_pooling(data, rois, pooled_size=(7, 7), spatial_scale=1.0, **_):
         return out.transpose(2, 0, 1)
 
     return jax.vmap(one)(rois).astype(data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Faster-RCNN surface: Proposal / MultiProposal, DeformableConvolution,
+# PSROIPooling (reference: src/operator/contrib/{proposal,multi_proposal}.cu,
+# nn/deformable_convolution.cu, psroi_pooling.cu — SURVEY §2.4 "padded-topk
+# fixed-shape rewrite" requirement for the RPN path).
+# ---------------------------------------------------------------------------
+
+def _base_anchors(base_size, scales, ratios):
+    """The reference's generate_anchors (rounded width/height enumeration):
+    one (A, 4) corner-format anchor set centered on a base_size cell."""
+    import numpy as onp
+    base = onp.array([0, 0, base_size - 1, base_size - 1], onp.float32)
+    w = base[2] - base[0] + 1
+    h = base[3] - base[1] + 1
+    xc, yc = base[0] + 0.5 * (w - 1), base[1] + 0.5 * (h - 1)
+    out = []
+    for r in ratios:
+        size_r = (w * h) / r
+        ws = onp.round(onp.sqrt(size_r))
+        hs = onp.round(ws * r)
+        for s in scales:
+            wss, hss = ws * s, hs * s
+            out.append([xc - 0.5 * (wss - 1), yc - 0.5 * (hss - 1),
+                        xc + 0.5 * (wss - 1), yc + 0.5 * (hss - 1)])
+    return onp.array(out, onp.float32)
+
+
+def _shifted_anchors(H, W, stride, base):
+    """All anchors over an (H, W) feature map: (H*W*A, 4), row-major over
+    (h, w, a) — matching the reference's enumeration order."""
+    import numpy as onp
+    sx = onp.arange(W, dtype=onp.float32) * stride
+    sy = onp.arange(H, dtype=onp.float32) * stride
+    shifts = onp.stack([
+        onp.tile(sx, H),
+        onp.repeat(sy, W),
+        onp.tile(sx, H),
+        onp.repeat(sy, W),
+    ], axis=1)                                            # (H*W, 4)
+    A = base.shape[0]
+    all_anchors = (shifts[:, None, :] + base[None, :, :]).reshape(-1, 4)
+    return all_anchors                                    # (H*W*A, 4)
+
+
+def _bbox_pred(anchors, deltas, iou_loss=False):
+    """Apply RPN regression deltas (reference: BBoxTransformInv)."""
+    ws = anchors[:, 2] - anchors[:, 0] + 1.0
+    hs = anchors[:, 3] - anchors[:, 1] + 1.0
+    cx = anchors[:, 0] + 0.5 * (ws - 1.0)
+    cy = anchors[:, 1] + 0.5 * (hs - 1.0)
+    if iou_loss:
+        return jnp.stack([anchors[:, 0] + deltas[:, 0],
+                          anchors[:, 1] + deltas[:, 1],
+                          anchors[:, 2] + deltas[:, 2],
+                          anchors[:, 3] + deltas[:, 3]], axis=1)
+    pcx = deltas[:, 0] * ws + cx
+    pcy = deltas[:, 1] * hs + cy
+    pw = jnp.exp(deltas[:, 2]) * ws
+    ph = jnp.exp(deltas[:, 3]) * hs
+    return jnp.stack([pcx - 0.5 * (pw - 1.0), pcy - 0.5 * (ph - 1.0),
+                      pcx + 0.5 * (pw - 1.0), pcy + 0.5 * (ph - 1.0)], axis=1)
+
+
+def _proposal_one(fg, deltas, iminfo, anchors, pre, post, thresh,
+                  min_size, iou_loss):
+    """One sample's RPN → rois. All shapes static: top-k to ``pre``, greedy
+    NMS emitting exactly ``post`` slots (padded with zeros when exhausted).
+    """
+    imh, imw, imscale = iminfo[0], iminfo[1], iminfo[2]
+    boxes = _bbox_pred(anchors, deltas, iou_loss)
+    boxes = jnp.stack([
+        jnp.clip(boxes[:, 0], 0.0, imw - 1.0),
+        jnp.clip(boxes[:, 1], 0.0, imh - 1.0),
+        jnp.clip(boxes[:, 2], 0.0, imw - 1.0),
+        jnp.clip(boxes[:, 3], 0.0, imh - 1.0)], axis=1)
+    ws = boxes[:, 2] - boxes[:, 0] + 1.0
+    hs = boxes[:, 3] - boxes[:, 1] + 1.0
+    ms = min_size * imscale
+    scores = jnp.where((ws >= ms) & (hs >= ms), fg, -jnp.inf)
+    k = min(pre, scores.shape[0])
+    top_scores, idx = lax.top_k(scores, k)
+    top_boxes = boxes[idx]
+
+    def nms_step(carry, _):
+        alive, sc = carry
+        j = jnp.argmax(jnp.where(alive, sc, -jnp.inf))
+        ok = alive[j] & jnp.isfinite(sc[j])
+        box = top_boxes[j]
+        score = jnp.where(ok, sc[j], 0.0)
+        box = jnp.where(ok, box, jnp.zeros(4, box.dtype))
+        iou = _corner_iou(box[None, :], top_boxes)[0]
+        alive = alive & (iou <= thresh) & (jnp.arange(k) != j)
+        return (alive, sc), (box, score)
+
+    (_, _), (sel_boxes, sel_scores) = lax.scan(
+        nms_step, (jnp.ones(k, bool), top_scores), None, length=post)
+    return sel_boxes, sel_scores
+
+
+@register_op(aliases=("_contrib_MultiProposal", "MultiProposal"))
+def multi_proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+                   rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+                   scales=(4, 8, 16, 32), ratios=(0.5, 1, 2),
+                   feature_stride=16, output_score=False, iou_loss=False, **_):
+    """Batched RPN proposal op (reference: multi_proposal.cu).
+
+    cls_prob (B, 2A, H, W) [bg scores then fg scores], bbox_pred (B, 4A, H,
+    W), im_info (B, 3) [h, w, scale]. Returns rois (B*post, 5) with
+    [batch_idx, x1, y1, x2, y2]; plus scores (B*post, 1) if output_score.
+    TPU rewrite: fixed-shape padded top-k + greedy NMS scan (SURVEY §2.4).
+    """
+    B, A2, H, W = cls_prob.shape
+    A = A2 // 2
+    anchors = jnp.asarray(_shifted_anchors(H, W, feature_stride,
+                                           _base_anchors(feature_stride,
+                                                         scales, ratios)))
+    # fg scores: channels A..2A, layout (A, H, W) → (H, W, A) → flat (HWA,)
+    fg = jnp.transpose(cls_prob[:, A:, :, :], (0, 2, 3, 1)).reshape(B, -1)
+    # deltas: (4A, H, W) = A boxes × 4 coords → (H, W, A, 4) → (HWA, 4)
+    dl = bbox_pred.reshape(B, A, 4, H, W)
+    dl = jnp.transpose(dl, (0, 3, 4, 1, 2)).reshape(B, -1, 4)
+    pre = int(rpn_pre_nms_top_n)
+    post = int(rpn_post_nms_top_n)
+
+    def one(fg_b, dl_b, info_b):
+        return _proposal_one(fg_b, dl_b, info_b, anchors, pre, post,
+                             float(threshold), float(rpn_min_size), iou_loss)
+
+    boxes, scores = jax.vmap(one)(fg, dl, im_info)        # (B, post, 4/1)
+    bidx = jnp.repeat(jnp.arange(B, dtype=boxes.dtype), post)[:, None]
+    rois = jnp.concatenate([bidx, boxes.reshape(B * post, 4)], axis=1)
+    if output_score:
+        return rois, scores.reshape(B * post, 1)
+    return rois
+
+
+@register_op(aliases=("_contrib_Proposal", "Proposal"))
+def proposal(cls_prob, bbox_pred, im_info, **kwargs):
+    """Single-image RPN proposal (reference: proposal.cu) — the B=1 case of
+    :func:`multi_proposal`."""
+    return multi_proposal(cls_prob, bbox_pred, im_info, **kwargs)
+
+
+@register_op(aliases=("_contrib_DeformableConvolution",
+                      "DeformableConvolution"))
+def deformable_convolution(data, offset, weight, bias=None, kernel=(3, 3),
+                           stride=(1, 1), pad=(0, 0), dilate=(1, 1),
+                           num_filter=0, num_group=1, num_deformable_group=1,
+                           no_bias=False, **_):
+    """Deformable convolution v1 (reference: nn/deformable_convolution.cu —
+    DCN). Each kernel tap samples the input at a learned fractional offset.
+
+    TPU-native formulation: instead of the reference's im2col-with-offsets
+    CUDA kernel, the sampled patches are gathered with vectorized bilinear
+    interpolation (static shapes) and contracted with the weight in ONE MXU
+    einsum — XLA sees gather + matmul, both native.
+
+    data (B, C, H, W); offset (B, 2·ndg·K·K, Ho, Wo) ordered (dg, kk, [y,x]);
+    weight (O, C/num_group, Kh, Kw). Returns (B, O, Ho, Wo).
+    """
+    B, C, H, W = data.shape
+    Kh, Kw = kernel
+    sh, sw = stride
+    ph, pw = pad
+    dh, dw = dilate
+    Ho = (H + 2 * ph - dh * (Kh - 1) - 1) // sh + 1
+    Wo = (W + 2 * pw - dw * (Kw - 1) - 1) // sw + 1
+    KK = Kh * Kw
+    ndg = num_deformable_group
+    off = offset.reshape(B, ndg, KK, 2, Ho, Wo)
+
+    # base sampling grid per output position and tap (no offset yet)
+    oy = jnp.arange(Ho) * sh - ph
+    ox = jnp.arange(Wo) * sw - pw
+    ky = jnp.repeat(jnp.arange(Kh) * dh, Kw)              # (KK,)
+    kx = jnp.tile(jnp.arange(Kw) * dw, Kh)
+    base_y = oy[None, :, None] + ky[:, None, None]        # (KK, Ho, 1)
+    base_x = ox[None, None, :] + kx[:, None, None]        # (KK, 1, Wo)
+    sy = base_y + off[:, :, :, 0]                         # (B, ndg, KK, Ho, Wo)
+    sx = base_x + off[:, :, :, 1]
+
+    def bilinear(img2d, y, x):
+        """img2d (H, W); y/x (...) fractional; zeros outside."""
+        y0 = jnp.floor(y)
+        x0 = jnp.floor(x)
+        wy = y - y0
+        wx = x - x0
+
+        def at(yy, xx):
+            inside = (yy >= 0) & (yy <= H - 1) & (xx >= 0) & (xx <= W - 1)
+            yi = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+            xi = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+            return jnp.where(inside, img2d[yi, xi], 0.0)
+
+        return (at(y0, x0) * (1 - wy) * (1 - wx)
+                + at(y0, x0 + 1) * (1 - wy) * wx
+                + at(y0 + 1, x0) * wy * (1 - wx)
+                + at(y0 + 1, x0 + 1) * wy * wx)
+
+    cpg = C // ndg                                        # channels per dg
+
+    def sample_b(img, sy_b, sx_b):
+        # img (C, H, W); sy_b/sx_b (ndg, KK, Ho, Wo)
+        def per_dg(imgs_dg, y_dg, x_dg):                  # (cpg, H, W)
+            return jax.vmap(lambda im: bilinear(im, y_dg, x_dg))(imgs_dg)
+
+        imgs = img.reshape(ndg, cpg, H, W)
+        out = jax.vmap(per_dg)(imgs, sy_b, sx_b)          # (ndg, cpg, KK, Ho, Wo)
+        return out.reshape(C, KK, Ho, Wo)
+
+    patches = jax.vmap(sample_b)(data.astype(jnp.float32),
+                                 sy.astype(jnp.float32),
+                                 sx.astype(jnp.float32))  # (B, C, KK, Ho, Wo)
+
+    O = weight.shape[0]
+    cg = C // num_group                                   # in-ch per group
+    og = O // num_group
+    w = weight.reshape(num_group, og, cg, KK).astype(jnp.float32)
+    p = patches.reshape(B, num_group, cg, KK, Ho, Wo)
+    out = jnp.einsum("gock,bgckhw->bgohw", w, p,
+                     preferred_element_type=jnp.float32)
+    out = out.reshape(B, O, Ho, Wo)
+    if not no_bias and bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out.astype(data.dtype)
+
+
+@register_op(aliases=("_contrib_PSROIPooling", "PSROIPooling"))
+def psroi_pooling(data, rois, output_dim, pooled_size, spatial_scale=1.0,
+                  group_size=None, **_):
+    """Position-sensitive ROI pooling (reference: psroi_pooling.cu, R-FCN).
+    Average-pools each bin from its own score-map channel group; implemented
+    on the ROIAlign sampling machinery with position_sensitive=True."""
+    ps = (pooled_size, pooled_size) if isinstance(pooled_size, int) \
+        else tuple(pooled_size)
+    if group_size is not None and tuple(
+            (group_size, group_size) if isinstance(group_size, int)
+            else group_size) != ps:
+        raise NotImplementedError(
+            "psroi_pooling: group_size != pooled_size is unsupported "
+            "(the score-map grid here is the pooled grid)")
+    C = data.shape[1]
+    if C != output_dim * ps[0] * ps[1]:
+        raise ValueError(
+            f"psroi_pooling: data needs output_dim*PH*PW = "
+            f"{output_dim * ps[0] * ps[1]} channels, got {C}")
+    return roi_align(data, rois, pooled_size=ps, spatial_scale=spatial_scale,
+                     sample_ratio=2, position_sensitive=True)

@@ -23,7 +23,9 @@ TPU mapping (the parts that set the MFU):
 - Fully-masked causal tiles are skipped with ``pl.when`` (≈2× on causal).
 
 Longer-than-memory sequences go through ring attention over the ``sp`` mesh
-axis (``parallel/ring.py``), which calls back into this kernel per shard.
+axis (``parallel/ring.py``), which calls back into this kernel's ``_fwd``
+per K/V hop and merges the per-hop (o, lse) pairs; ``dot_product_attention``
+routes there automatically when the active mesh has sp>1.
 
 Masking: ``causal`` and/or a key-padding mask of shape (B, Lk) (1 = valid).
 The generic (B, H, Lq, Lk) mask case falls back to the XLA path in
@@ -271,7 +273,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
             rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + iq * bq
             cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + jk * bk
             s = jnp.where(cols <= rows + causal_off, s, _NEG)
-        p = jnp.exp(s - lseb[:, None])
+        # masked entries: exp(s - lse) can overflow for fully-masked rows
+        # (lse floors at m + log eps); they carry no gradient — zero them.
+        p = jnp.where(s > _NEG * 0.5, jnp.exp(s - lseb[:, None]), 0.0)
         pb = p.astype(dob.dtype)
         dv_acc[...] += jax.lax.dot_general(
             pb, dob, (((0,), (0,)), ((), ())),
@@ -327,7 +331,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
             rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + iq * bq
             cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + jk * bk
             s = jnp.where(cols <= rows + causal_off, s, _NEG)
-        p = jnp.exp(s - lseb[:, None])
+        p = jnp.where(s > _NEG * 0.5, jnp.exp(s - lseb[:, None]), 0.0)
         dp = jax.lax.dot_general(dob, vb, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = (p * (dp - deltab[:, None]) * scale).astype(kb.dtype)
@@ -351,12 +355,18 @@ def _bwd_dq_kernel_nomask(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    dq_ref, dq_acc, **kw)
 
 
-def _bwd(q, k, v, key_mask, causal, scale, o, lse, do):
+def _bwd(q, k, v, key_mask, causal, scale, o, lse, do, dlse=None):
     B, H, Lq, D = q.shape
     Lk = k.shape[2]
     bq, bk = _bq(Lq), _bk(Lk)
     BH = B * H
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    if dlse is not None:
+        # The lse output's cotangent enters the score gradient as
+        # ds += p * dlse — algebraically a shift of delta, so the same
+        # backward kernels serve the (o, lse) block-attention entry used by
+        # ring attention.
+        delta = delta - dlse.astype(jnp.float32)
     q3, k3, v3 = (x.reshape(BH, -1, D) for x in (q, k, v))
     do3 = do.reshape(BH, Lq, D)
     lse3 = lse.reshape(BH, 1, Lq)
@@ -459,6 +469,34 @@ def _flash_bwd(causal, scale, res, do):
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+# ---------------------------------------------------------------------------
+# block-attention entry for ring attention: returns (o, lse), differentiable
+# in both outputs (the lse cotangent folds into delta — see _bwd).
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def flash_block(q, k, v, key_mask, causal, scale):
+    """One K/V block's attention returning ``(o, lse)`` — the unit ring
+    attention merges per hop. Same mask/shape contract as flash_attention."""
+    return _fwd(q, k, v, key_mask, causal, scale)
+
+
+def _flash_block_fwd(q, k, v, key_mask, causal, scale):
+    o, lse = _fwd(q, k, v, key_mask, causal, scale)
+    return (o, lse), (q, k, v, key_mask, o, lse)
+
+
+def _flash_block_bwd(causal, scale, res, cts):
+    do, dlse = cts
+    q, k, v, key_mask, o, lse = res
+    dq, dk, dv = _bwd(q, k, v, key_mask, causal, scale, o, lse,
+                      do.astype(q.dtype), dlse)
+    return dq, dk, dv, None
+
+
+flash_block.defvjp(_flash_block_fwd, _flash_block_bwd)
 
 
 def flash_attention(q, k, v, mask=None, causal: bool = False,

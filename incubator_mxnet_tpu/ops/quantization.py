@@ -1,0 +1,205 @@
+"""INT8 quantization ops — the TPU counterpart of the reference INT8
+subsystem (``src/operator/quantization/``: quantize/dequantize/requantize
+kernels, ``quantized_conv``/``quantized_fully_connected``/
+``quantized_pooling``, SURVEY §2.4).
+
+TPU-native design: TPUs execute int8×int8→int32 matmuls and convolutions
+natively on the MXU (``preferred_element_type=jnp.int32``), so the quantized
+compute ops are straight XLA dots/convs on int8 operands — no cuDNN-style
+hand-packed kernels. The value/range calling convention follows the
+reference exactly: every quantized tensor travels as ``(q, min_range,
+max_range)``, with the *symmetric signed* int8 scheme the reference uses for
+weights and (by default) activations: ``scale = 127 / max(|min|, |max|)``.
+
+Calibration (min/max + KL-entropy) and the graph pass that swaps float
+layers for these ops live in ``incubator_mxnet_tpu/quantization/``.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+
+__all__ = [
+    "quantize", "quantize_v2", "dequantize", "requantize",
+    "quantized_fully_connected", "quantized_conv", "quantized_pooling",
+    "quantized_flatten", "quantized_act",
+]
+
+_INT8_RANGE = 127.0
+_UINT8_RANGE = 255.0
+
+
+def _symmetric_scale(min_range, max_range):
+    """Real-value scale of the symmetric int8 encoding (reference:
+    MaxAbs(min, max) / kInt8Range in quantization_utils.h)."""
+    real = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
+    return jnp.maximum(real, 1e-30) / _INT8_RANGE
+
+
+@register_op(aliases=("_contrib_quantize",))
+def quantize(data, min_range, max_range, out_type: str = "int8", **_):
+    """Quantize fp32 -> int8 with an explicit calibration range. Returns
+    ``(q, min_range, max_range)`` (reference: quantize.cc)."""
+    if out_type not in ("int8", "uint8"):
+        raise ValueError(f"quantize: unsupported out_type {out_type!r}")
+    min_range = jnp.asarray(min_range, jnp.float32)
+    max_range = jnp.asarray(max_range, jnp.float32)
+    if out_type == "int8":
+        scale = _symmetric_scale(min_range, max_range)
+        q = jnp.clip(jnp.round(data.astype(jnp.float32) / scale),
+                     -_INT8_RANGE, _INT8_RANGE).astype(jnp.int8)
+    else:
+        # affine uint8 over [min, max] (reference uint8 branch)
+        rng = jnp.maximum(max_range - min_range, 1e-30)
+        scale = _UINT8_RANGE / rng
+        q = jnp.clip(jnp.round((data.astype(jnp.float32) - min_range) * scale),
+                     0, _UINT8_RANGE).astype(jnp.uint8)
+    return q, min_range, max_range
+
+
+@register_op(aliases=("_contrib_quantize_v2",))
+def quantize_v2(data, min_calib_range: Optional[float] = None,
+                max_calib_range: Optional[float] = None,
+                out_type: str = "int8", **_):
+    """Quantize with ranges from calibration — or computed on the fly when
+    absent (reference: quantize_v2.cc online branch)."""
+    if min_calib_range is None or max_calib_range is None:
+        min_calib_range = jnp.min(data).astype(jnp.float32)
+        max_calib_range = jnp.max(data).astype(jnp.float32)
+    return quantize(data, min_calib_range, max_calib_range, out_type=out_type)
+
+
+@register_op(aliases=("_contrib_dequantize",))
+def dequantize(data, min_range, max_range, **_):
+    """int8/uint8/int32 -> fp32 (reference: dequantize.cc). The range pair
+    always describes the REAL values representable at the dtype's full
+    integer span (127 for int8, 2³¹-1 for the int32 accumulator)."""
+    min_range = jnp.asarray(min_range, jnp.float32)
+    max_range = jnp.asarray(max_range, jnp.float32)
+    if data.dtype == jnp.uint8:
+        rng = jnp.maximum(max_range - min_range, 1e-30)
+        return data.astype(jnp.float32) * (rng / _UINT8_RANGE) + min_range
+    span = _INT8_RANGE if data.dtype == jnp.int8 else float(2 ** 31 - 1)
+    real = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
+    return data.astype(jnp.float32) * (jnp.maximum(real, 1e-30) / span)
+
+
+@register_op(aliases=("_contrib_requantize",))
+def requantize(data, min_range, max_range,
+               min_calib_range: Optional[float] = None,
+               max_calib_range: Optional[float] = None, **_):
+    """int32 accumulator -> int8 with a (calibrated or online) output range
+    (reference: requantize.cc)."""
+    real = dequantize(data, min_range, max_range)
+    if min_calib_range is None or max_calib_range is None:
+        min_calib_range = jnp.min(real)
+        max_calib_range = jnp.max(real)
+    return quantize(real, min_calib_range, max_calib_range, out_type="int8")
+
+
+@register_op(aliases=("_contrib_quantized_fully_connected",))
+def quantized_fully_connected(data, weight, bias, min_data, max_data,
+                              min_weight, max_weight, min_bias=None,
+                              max_bias=None, num_hidden: int = 0,
+                              no_bias: bool = False, flatten: bool = True, **_):
+    """int8 FC on the MXU: ``int8 @ int8 -> int32`` via
+    ``preferred_element_type`` (reference: quantized_fully_connected.cc).
+
+    data (N, ..., C) int8; weight (num_hidden, C) int8; bias int8 (its own
+    range) or None. Returns ``(acc_int32, min_out, max_out)`` where the out
+    range is the accumulator's representable real range — feed through
+    ``requantize`` (with calibration) or ``dequantize``.
+    """
+    if flatten and data.ndim > 2:
+        data = data.reshape(data.shape[0], -1)
+    acc = lax.dot_general(
+        data, weight,
+        (((data.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    sa = _symmetric_scale(jnp.asarray(min_data, jnp.float32),
+                          jnp.asarray(max_data, jnp.float32))
+    sw = _symmetric_scale(jnp.asarray(min_weight, jnp.float32),
+                          jnp.asarray(max_weight, jnp.float32))
+    if not no_bias and bias is not None:
+        # re-encode the int8 bias onto the accumulator scale sa*sw
+        sb = _symmetric_scale(jnp.asarray(min_bias, jnp.float32),
+                              jnp.asarray(max_bias, jnp.float32))
+        b32 = jnp.round(bias.astype(jnp.float32) * (sb / (sa * sw))
+                        ).astype(jnp.int32)
+        acc = acc + b32
+    bound = sa * sw * jnp.float32(2 ** 31 - 1)
+    return acc, -bound, bound
+
+
+@register_op(aliases=("_contrib_quantized_conv",))
+def quantized_conv(data, weight, bias, min_data, max_data, min_weight,
+                   max_weight, min_bias=None, max_bias=None,
+                   kernel=None, stride=(1, 1), pad=(0, 0), dilate=(1, 1),
+                   num_filter: int = 0, no_bias: bool = False,
+                   layout: str = "NCHW", **_):
+    """int8 convolution on the MXU (reference: quantized_conv.cu). NCHW
+    data, OIHW weight, int32 accumulator out with its real range."""
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape,
+                                    ("NCHW", "OIHW", "NCHW"))
+    acc = lax.conv_general_dilated(
+        data, weight, window_strides=tuple(stride),
+        padding=[(pad[0], pad[0]), (pad[1], pad[1])],
+        rhs_dilation=tuple(dilate), dimension_numbers=dn,
+        preferred_element_type=jnp.int32)
+    sa = _symmetric_scale(jnp.asarray(min_data, jnp.float32),
+                          jnp.asarray(max_data, jnp.float32))
+    sw = _symmetric_scale(jnp.asarray(min_weight, jnp.float32),
+                          jnp.asarray(max_weight, jnp.float32))
+    if not no_bias and bias is not None:
+        sb = _symmetric_scale(jnp.asarray(min_bias, jnp.float32),
+                              jnp.asarray(max_bias, jnp.float32))
+        b32 = jnp.round(bias.astype(jnp.float32) * (sb / (sa * sw))
+                        ).astype(jnp.int32)
+        acc = acc + b32.reshape(1, -1, 1, 1)
+    bound = sa * sw * jnp.float32(2 ** 31 - 1)
+    return acc, -bound, bound
+
+
+@register_op(aliases=("_contrib_quantized_pooling",))
+def quantized_pooling(data, min_data, max_data, kernel=(2, 2),
+                      stride=None, pad=(0, 0), pool_type: str = "max", **_):
+    """Pooling straight on int8 values — order-preserving, so the range
+    passes through (reference: quantized_pooling.cc)."""
+    stride = stride or kernel
+    window = (1, 1) + tuple(kernel)
+    strides = (1, 1) + tuple(stride)
+    padding = ((0, 0), (0, 0), (pad[0], pad[0]), (pad[1], pad[1]))
+    if pool_type == "max":
+        init = jnp.iinfo(jnp.int8).min if data.dtype == jnp.int8 else 0
+        out = lax.reduce_window(data, jnp.asarray(init, data.dtype), lax.max,
+                                window, strides, padding)
+        return out, min_data, max_data
+    if pool_type == "avg":
+        # average in int32, round back to the input dtype (range preserved)
+        s = lax.reduce_window(data.astype(jnp.int32), jnp.int32(0), lax.add,
+                              window, strides, padding)
+        n = kernel[0] * kernel[1]
+        info = jnp.iinfo(data.dtype)
+        out = jnp.clip(jnp.round(s / n), info.min, info.max).astype(data.dtype)
+        return out, min_data, max_data
+    raise ValueError(f"quantized_pooling: unsupported pool_type {pool_type!r}")
+
+
+@register_op(aliases=("_contrib_quantized_flatten",))
+def quantized_flatten(data, min_data, max_data, **_):
+    return data.reshape(data.shape[0], -1), min_data, max_data
+
+
+@register_op(aliases=("_contrib_quantized_act",))
+def quantized_act(data, min_data, max_data, act_type: str = "relu", **_):
+    """relu on int8 is a clamp at the zero point (symmetric: 0)."""
+    if act_type != "relu":
+        raise ValueError("only relu is supported on the int8 path "
+                         "(reference restriction)")
+    return jnp.maximum(data, 0), jnp.zeros_like(
+        jnp.asarray(min_data, jnp.float32)), jnp.asarray(max_data, jnp.float32)

@@ -117,3 +117,35 @@ def set_default_mesh(mesh: Optional[Mesh]) -> None:
 
 def axis_size(mesh: Mesh, axis: str) -> int:
     return mesh.shape[axis] if axis in mesh.shape else 1
+
+
+# ---------------------------------------------------------------------------
+# Active compute mesh: bound while a ShardedTrainer step (or any mesh-aware
+# computation) is being TRACED, so ops can emit mesh-native collectives —
+# e.g. dot_product_attention lowering to ring attention over ``sp``.
+# ---------------------------------------------------------------------------
+import threading as _threading
+
+_ACTIVE = _threading.local()
+
+
+class active_mesh:
+    """Context manager binding the mesh visible to mesh-aware ops."""
+
+    def __init__(self, mesh: Optional[Mesh]):
+        self._mesh = mesh
+
+    def __enter__(self):
+        stack = getattr(_ACTIVE, "stack", None)
+        if stack is None:
+            stack = _ACTIVE.stack = []
+        stack.append(self._mesh)
+        return self._mesh
+
+    def __exit__(self, *exc):
+        _ACTIVE.stack.pop()
+
+
+def current_active_mesh() -> Optional[Mesh]:
+    stack = getattr(_ACTIVE, "stack", None)
+    return stack[-1] if stack else None

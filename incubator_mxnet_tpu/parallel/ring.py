@@ -5,11 +5,14 @@ story is the fused interleaved-MHA kernels in
 ``src/operator/contrib/transformer.cc`` with the O(L²) score matrix
 materialized. This module is the capability-parity-plus counterpart: the
 sequence dim is sharded over ``sp``, K/V blocks rotate around the ring via
-``lax.ppermute`` (one ICI hop per step), and each hop folds into a running
-flash-style online softmax — so no device ever holds the full L×L matrix and
-context length scales linearly with the ring size.
+``lax.ppermute`` (one ICI hop per step), and each hop's block attention runs
+the **Pallas flash kernel** (``ops/pallas/flash_attention._fwd``) — the hop
+results carry their log-sum-exp and fold into a running softmax merge, so no
+device ever holds the full L×L matrix and context length scales linearly
+with the ring size.
 
 Shapes follow the contrib-op convention [batch, heads, seq, head_dim].
+Key-padding masks (B, L) ride the ring with their K/V block.
 """
 from __future__ import annotations
 
@@ -29,66 +32,142 @@ __all__ = ["ring_attention", "ring_attention_sharded"]
 _NEG_INF = -1e30
 
 
-def _block_attn(q, k, v, o, m, l, q_off, k_off, scale, causal):
-    """One ring hop: fold local K/V block into the online-softmax state."""
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
-    if causal:
-        lq, lk = q.shape[2], k.shape[2]
-        qpos = q_off + jnp.arange(lq)[:, None]
-        kpos = k_off + jnp.arange(lk)[None, :]
-        s = jnp.where(qpos >= kpos, s, _NEG_INF)
-    m_new = jnp.maximum(m, s.max(axis=-1))
-    # guard fully-masked rows (exp(-inf - -inf)): keep them at zero weight
-    alpha = jnp.exp(m - m_new)
-    p = jnp.exp(s - m_new[..., None])
-    l_new = l * alpha + p.sum(axis=-1)
-    o_new = o * alpha[..., None] + jnp.einsum(
-        "bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
-    return o_new, m_new, l_new
+def _hop_flash_ok(q, k) -> bool:
+    """Static gate: can this hop's block attention run the Pallas kernel?"""
+    import os
+    if os.environ.get("MXTPU_RING_IMPL") == "xla":
+        return False
+    B, H, Lq, D = q.shape
+    Lk = k.shape[2]
+    if D % 8 or D > 256:
+        return False
+    from ..ops.pallas.flash_attention import _bq, _bk
+    return Lq % _bq(Lq) == 0 and Lk % _bk(Lk) == 0
 
 
-def ring_attention(q, k, v, axis: str = "sp", causal: bool = False,
-                   scale: Optional[float] = None):
+def _hop_attn(q, k, v, key_mask, causal_mode, q_off, k_off, scale):
+    """One K/V block's attention: returns (o_norm fp32, lse fp32).
+
+    ``causal_mode``: 0 = full block, 1 = causal-diagonal block (same-rank
+    positions), 2 = fully masked. The Pallas kernel computes modes 0/1; the
+    XLA einsum fallback covers unsupported shapes / CPU interpret.
+    """
+    B, H, Lq, D = q.shape
+    Lk = k.shape[2]
+    if _hop_flash_ok(q, k):
+        from ..ops.pallas.flash_attention import flash_block
+
+        def full_block(q, k, v, key_mask):
+            return flash_block(q, k, v, key_mask, False, scale)
+
+        def diag_block(q, k, v, key_mask):
+            return flash_block(q, k, v, key_mask, True, scale)
+
+        def masked_block(q, k, v, key_mask):
+            return (jnp.zeros((B, H, Lq, D), q.dtype),
+                    jnp.full((B, H, Lq), _NEG_INF, jnp.float32))
+
+        o, lse = lax.switch(causal_mode, (full_block, diag_block,
+                                          masked_block), q, k, v, key_mask)
+        # fully-masked ROWS inside a live block (all-zero key mask) produce
+        # o=0, lse = m0+log(eps) ≈ huge negative — already correct for merge
+        return o.astype(jnp.float32), lse
+    # --- XLA fallback with explicit positions ---
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if key_mask is not None:
+        s = jnp.where(key_mask[:, None, None, :].astype(bool), s, _NEG_INF)
+    qpos = q_off + jnp.arange(Lq)[:, None]
+    kpos = k_off + jnp.arange(Lk)[None, :]
+    causal_keep = jnp.where(causal_mode >= 1, qpos >= kpos, True)
+    keep = causal_keep & (causal_mode < 2)
+    s = jnp.where(keep, s, _NEG_INF)
+    m = jnp.max(s, axis=-1)
+    # dead entries stay at exactly 0 weight even in fully-masked rows
+    # (where m == _NEG_INF and exp(s - m) would otherwise be 1)
+    p = jnp.where(s > _NEG_INF * 0.5, jnp.exp(s - m[..., None]), 0.0)
+    l = jnp.sum(p, axis=-1)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    o = o / jnp.maximum(l, 1e-30)[..., None]
+    lse = jnp.where(l > 0, m + jnp.log(jnp.maximum(l, 1e-30)), _NEG_INF)
+    return o, lse
+
+
+def _merge(o, lse, o_i, lse_i):
+    """Fold a hop's normalized partial into the running result (the
+    flash-attention two-pass merge rule over log-sum-exps)."""
+    lse_new = jnp.logaddexp(lse, lse_i)
+    w_old = jnp.exp(jnp.minimum(lse - lse_new, 0.0))
+    w_new = jnp.exp(jnp.minimum(lse_i - lse_new, 0.0))
+    return o * w_old[..., None] + o_i * w_new[..., None], lse_new
+
+
+def ring_attention(q, k, v, key_mask=None, axis: str = "sp",
+                   causal: bool = False, scale: Optional[float] = None):
     """Attention over sequence shards; call inside shard_map with ``axis``
-    bound. q/k/v: [B, H, L_local, D] local shards of the L dimension."""
+    bound. q/k/v: [B, H, L_local, D] local shards of the L dimension;
+    ``key_mask``: optional (B, L_local) validity shard riding the ring."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     n = lax.psum(1, axis)
     idx = lax.axis_index(axis)
-    lq, lk = q.shape[2], k.shape[2]
+    lq = q.shape[2]
     b, h = q.shape[0], q.shape[1]
 
     o0 = jnp.zeros((b, h, lq, q.shape[3]), jnp.float32)
-    m0 = jnp.full((b, h, lq), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, h, lq), jnp.float32)
+    lse0 = jnp.full((b, h, lq), _NEG_INF, jnp.float32)
     q_off = idx * lq
     perm = [(j, (j + 1) % n) for j in range(n)]
+    mask0 = key_mask if key_mask is not None \
+        else jnp.ones((b, k.shape[2]), jnp.int32)
 
-    def body(i, carry):
-        o, m, l, k_cur, v_cur = carry
+    def hop(i, carry):
+        o, lse, k_cur, v_cur, m_cur = carry
         src = (idx - i) % n          # whose block we currently hold
-        o, m, l = _block_attn(q, k_cur, v_cur, o, m, l,
-                              q_off, src * lk, scale, causal)
+        if causal:
+            # equal shard sizes ⇒ whole blocks compare by rank:
+            # src < idx → all keys precede queries (full);
+            # src == idx → diagonal (causal); src > idx → fully masked
+            mode = jnp.where(src == idx, 1, jnp.where(src < idx, 0, 2))
+        else:
+            mode = jnp.zeros((), jnp.int32)
+        o_i, lse_i = _hop_attn(q, k_cur, v_cur, m_cur, mode,
+                               q_off, src * lq, scale)
+        o, lse = _merge(o, lse, o_i, lse_i)
         k_nxt = lax.ppermute(k_cur, axis, perm)
         v_nxt = lax.ppermute(v_cur, axis, perm)
-        return o, m, l, k_nxt, v_nxt
+        m_nxt = lax.ppermute(m_cur, axis, perm)
+        return o, lse, k_nxt, v_nxt, m_nxt
 
-    # n-1 hops with rotation, then fold the final held block without the
-    # wasted last rotation.
-    o, m, l, k_last, v_last = lax.fori_loop(0, n - 1, body, (o0, m0, l0, k, v))
-    o, m, l = _block_attn(q, k_last, v_last, o, m, l,
-                          q_off, ((idx - (n - 1)) % n) * lk, scale, causal)
-    l = jnp.where(l == 0.0, 1.0, l)
-    return (o / l[..., None]).astype(q.dtype)
+    o, lse, k_last, v_last, m_last = lax.fori_loop(
+        0, n - 1, hop, (o0, lse0, k, v, mask0))
+    o, lse, *_ = hop(n - 1, (o, lse, k_last, v_last, m_last))
+    # rows with no live key anywhere (lse at the -1e30 floor) → zeros
+    o = jnp.where((lse > _NEG_INF * 0.5)[..., None], o, 0.0)
+    return o.astype(q.dtype)
 
 
-def ring_attention_sharded(mesh: Mesh, q, k, v, causal: bool = False,
+def ring_attention_sharded(mesh: Mesh, q, k, v, key_mask=None,
+                           causal: bool = False,
                            scale: Optional[float] = None, axis: str = "sp"):
     """Host-level entry: q/k/v global [B,H,L,D]; shards L over ``axis``,
-    batch over ``dp`` when that axis exists."""
+    batch over ``dp`` when that axis exists, heads over ``tp`` so a
+    tensor-parallel attention stays local in its head shard."""
     bspec = "dp" if mesh.shape.get("dp", 1) > 1 else None
-    spec = P(bspec, None, axis, None)
+    hspec = "tp" if mesh.shape.get("tp", 1) > 1 else None
+    spec = P(bspec, hspec, axis, None)
+    mspec = P(bspec, axis)
+    if key_mask is None:
+        fn = shard_map(
+            partial(ring_attention, key_mask=None, axis=axis, causal=causal,
+                    scale=scale),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+        args = tuple(jax.device_put(x, NamedSharding(mesh, spec))
+                     for x in (q, k, v))
+        return jax.jit(fn)(*args)
     fn = shard_map(
         partial(ring_attention, axis=axis, causal=causal, scale=scale),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
-    args = tuple(jax.device_put(x, NamedSharding(mesh, spec)) for x in (q, k, v))
-    return jax.jit(fn)(*args)
+        mesh=mesh, in_specs=(spec, spec, spec, mspec), out_specs=spec)
+    args = tuple(jax.device_put(x, NamedSharding(mesh, spec))
+                 for x in (q, k, v))
+    km = jax.device_put(jnp.asarray(key_mask), NamedSharding(mesh, mspec))
+    return jax.jit(fn)(*args, km)

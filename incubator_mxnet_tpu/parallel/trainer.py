@@ -235,9 +235,14 @@ class ShardedTrainer:
             self._t_dev = jnp.asarray(self._t, jnp.int32)
         if self._base_key is None:
             self._base_key = random_mod.next_key(self._ctx)
-        loss, self._param_vals, self._opt_states, effects, self._t_dev = \
-            self._step_fn(self._param_vals, self._opt_states, self._base_key,
-                          self._lr_dev, self._t_dev, *vals)
+        from .mesh import active_mesh
+        with active_mesh(self._mesh):
+            # bound during (first-call) tracing so mesh-aware ops lower to
+            # mesh collectives — e.g. attention → ring over sp
+            loss, self._param_vals, self._opt_states, effects, self._t_dev = \
+                self._step_fn(self._param_vals, self._opt_states,
+                              self._base_key, self._lr_dev, self._t_dev,
+                              *vals)
         self._optimizer.num_update = self._t
         for (p, ectx), val in zip(self._info.get("effects", ()), effects):
             p._deposit_aux(val._data if isinstance(val, NDArray) else val,

@@ -179,3 +179,199 @@ def test_model_zoo_get_model_names():
     with mx.autograd.predict_mode():
         out = net(mx.nd.array(onp.random.rand(2, 3, 32, 32).astype("float32")))
     assert out.shape == (2, 10)
+
+
+# ---------------------------------------------------------------------------
+# Faster-RCNN surface (round 3): Proposal / DeformableConvolution / PS-ROI
+# ---------------------------------------------------------------------------
+
+def _np_proposals(cls_prob, bbox_pred, im_info, scales, ratios, stride,
+                  pre, post, thresh, min_size):
+    """Pure-numpy RPN reference (mirrors the reference proposal.cc math)."""
+    from incubator_mxnet_tpu.ops.detection import (_base_anchors,
+                                                   _shifted_anchors)
+    B, A2, H, W = cls_prob.shape
+    A = A2 // 2
+    anchors = _shifted_anchors(H, W, stride,
+                               _base_anchors(stride, scales, ratios))
+    out_boxes = []
+    for b in range(B):
+        fg = cls_prob[b, A:].transpose(1, 2, 0).reshape(-1)
+        dl = bbox_pred[b].reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+        ws = anchors[:, 2] - anchors[:, 0] + 1
+        hs = anchors[:, 3] - anchors[:, 1] + 1
+        cx = anchors[:, 0] + 0.5 * (ws - 1)
+        cy = anchors[:, 1] + 0.5 * (hs - 1)
+        pcx = dl[:, 0] * ws + cx
+        pcy = dl[:, 1] * hs + cy
+        pw = onp.exp(dl[:, 2]) * ws
+        ph = onp.exp(dl[:, 3]) * hs
+        boxes = onp.stack([pcx - 0.5 * (pw - 1), pcy - 0.5 * (ph - 1),
+                           pcx + 0.5 * (pw - 1), pcy + 0.5 * (ph - 1)], 1)
+        imh, imw, sc = im_info[b]
+        boxes[:, 0] = boxes[:, 0].clip(0, imw - 1)
+        boxes[:, 1] = boxes[:, 1].clip(0, imh - 1)
+        boxes[:, 2] = boxes[:, 2].clip(0, imw - 1)
+        boxes[:, 3] = boxes[:, 3].clip(0, imh - 1)
+        bw = boxes[:, 2] - boxes[:, 0] + 1
+        bh = boxes[:, 3] - boxes[:, 1] + 1
+        scores = onp.where((bw >= min_size * sc) & (bh >= min_size * sc),
+                           fg, -onp.inf)
+        order = onp.argsort(-scores)[:pre]
+        boxes, scores = boxes[order], scores[order]
+        keep = []
+        alive = onp.ones(len(boxes), bool)
+        for _ in range(post):
+            if not alive.any() or not onp.isfinite(scores[alive]).any():
+                keep.append(onp.zeros(4))
+                continue
+            j = onp.where(alive, scores, -onp.inf).argmax()
+            keep.append(boxes[j])
+            x1 = onp.maximum(boxes[j, 0], boxes[:, 0])
+            y1 = onp.maximum(boxes[j, 1], boxes[:, 1])
+            x2 = onp.minimum(boxes[j, 2], boxes[:, 2])
+            y2 = onp.minimum(boxes[j, 3], boxes[:, 3])
+            inter = (x2 - x1).clip(0) * (y2 - y1).clip(0)
+            a1 = (boxes[j, 2] - boxes[j, 0]).clip(0) * (boxes[j, 3] - boxes[j, 1]).clip(0)
+            a2 = (boxes[:, 2] - boxes[:, 0]).clip(0) * (boxes[:, 3] - boxes[:, 1]).clip(0)
+            union = a1 + a2 - inter
+            iou = onp.where(union > 0, inter / union, 0)
+            alive &= iou <= thresh
+            alive[j] = False
+        out_boxes.append(onp.array(keep))
+    return onp.stack(out_boxes)
+
+
+def test_proposal_matches_numpy_reference():
+    from incubator_mxnet_tpu.ops.detection import multi_proposal
+    rng = onp.random.RandomState(0)
+    B, A, H, W = 2, 3, 4, 5
+    scales, ratios, stride = (8,), (0.5, 1, 2), 16
+    cls_prob = rng.rand(B, 2 * A, H, W).astype("float32")
+    bbox_pred = (rng.randn(B, 4 * A, H, W) * 0.1).astype("float32")
+    im_info = onp.array([[64, 80, 1.0], [64, 80, 2.0]], "float32")
+    pre, post = 30, 8
+    rois = onp.asarray(multi_proposal(
+        jnp.asarray(cls_prob), jnp.asarray(bbox_pred), jnp.asarray(im_info),
+        rpn_pre_nms_top_n=pre, rpn_post_nms_top_n=post, threshold=0.7,
+        rpn_min_size=4, scales=scales, ratios=ratios, feature_stride=stride))
+    want = _np_proposals(cls_prob, bbox_pred, im_info, scales, ratios,
+                         stride, pre, post, 0.7, 4)
+    assert rois.shape == (B * post, 5)
+    for b in range(B):
+        got = rois[b * post:(b + 1) * post]
+        onp.testing.assert_array_equal(got[:, 0], b)
+        onp.testing.assert_allclose(got[:, 1:], want[b], rtol=1e-4, atol=1e-3)
+
+
+def test_proposal_output_score_and_padding():
+    from incubator_mxnet_tpu.ops.detection import multi_proposal
+    # One strong box; everything else tiny -> filtered by min_size, so the
+    # post-NMS slots beyond the survivors must be zero-padded.
+    B, A, H, W = 1, 1, 2, 2
+    cls_prob = onp.zeros((B, 2, H, W), "float32")
+    cls_prob[0, 1, 0, 0] = 0.9
+    bbox_pred = onp.zeros((B, 4, H, W), "float32")
+    im_info = onp.array([[32, 32, 1.0]], "float32")
+    rois, scores = multi_proposal(
+        jnp.asarray(cls_prob), jnp.asarray(bbox_pred), jnp.asarray(im_info),
+        rpn_pre_nms_top_n=4, rpn_post_nms_top_n=4, rpn_min_size=100,
+        scales=(8,), ratios=(1.0,), feature_stride=16, output_score=True)
+    scores = onp.asarray(scores)
+    assert scores.shape == (4, 1)
+    onp.testing.assert_array_equal(scores, 0.0)  # all filtered -> padding
+
+
+def test_deformable_conv_zero_offset_is_conv():
+    from incubator_mxnet_tpu.ops.detection import deformable_convolution
+    from jax import lax
+    rng = onp.random.RandomState(1)
+    x = rng.randn(2, 3, 7, 7).astype("float32")
+    w = rng.randn(4, 3, 3, 3).astype("float32")
+    off = onp.zeros((2, 2 * 9, 5, 5), "float32")
+    got = onp.asarray(deformable_convolution(
+        jnp.asarray(x), jnp.asarray(off), jnp.asarray(w), no_bias=True,
+        kernel=(3, 3), num_filter=4))
+    dn = lax.conv_dimension_numbers(x.shape, w.shape, ("NCHW", "OIHW", "NCHW"))
+    want = onp.asarray(lax.conv_general_dilated(
+        jnp.asarray(x), jnp.asarray(w), (1, 1), [(0, 0), (0, 0)],
+        dimension_numbers=dn))
+    onp.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_conv_integer_shift():
+    from incubator_mxnet_tpu.ops.detection import deformable_convolution
+    from jax import lax
+    rng = onp.random.RandomState(2)
+    x = rng.randn(1, 2, 8, 8).astype("float32")
+    w = rng.randn(2, 2, 3, 3).astype("float32")
+    # every tap shifted one column right == conv over x shifted left
+    off = onp.zeros((1, 2 * 9, 6, 6), "float32")
+    off[0, 1::2] = 1.0   # x offsets
+    got = onp.asarray(deformable_convolution(
+        jnp.asarray(x), jnp.asarray(off), jnp.asarray(w), no_bias=True,
+        kernel=(3, 3), num_filter=2))
+    xs = onp.roll(x, -1, axis=3)
+    dn = lax.conv_dimension_numbers(x.shape, w.shape, ("NCHW", "OIHW", "NCHW"))
+    want = onp.asarray(lax.conv_general_dilated(
+        jnp.asarray(xs), jnp.asarray(w), (1, 1), [(0, 0), (0, 0)],
+        dimension_numbers=dn))
+    # interior columns only (roll wraps at the right edge)
+    onp.testing.assert_allclose(got[..., :5], want[..., :5],
+                                rtol=1e-4, atol=1e-4)
+
+
+def test_ps_roi_align_channel_selection():
+    from incubator_mxnet_tpu.ops.detection import roi_align
+    # data channel value == channel index; with position_sensitive, output
+    # bin (ph, pw) of out-channel o must read channel o*PH*PW + ph*PW + pw.
+    PH = PW = 2
+    Cout = 3
+    C = Cout * PH * PW
+    data = onp.broadcast_to(
+        onp.arange(C, dtype="float32")[None, :, None, None],
+        (1, C, 8, 8)).copy()
+    rois = onp.array([[0, 0, 0, 7, 7]], "float32")
+    out = onp.asarray(roi_align(jnp.asarray(data), jnp.asarray(rois),
+                                pooled_size=(PH, PW),
+                                position_sensitive=True))
+    assert out.shape == (1, Cout, PH, PW)
+    for o in range(Cout):
+        for ph in range(PH):
+            for pw in range(PW):
+                assert out[0, o, ph, pw] == o * PH * PW + ph * PW + pw
+
+
+def test_psroi_pooling_contrib_alias():
+    import incubator_mxnet_tpu as mx
+    data = mx.nd.ones((1, 4, 6, 6))
+    rois = mx.nd.array(onp.array([[0, 0, 0, 5, 5]], "float32"))
+    out = mx.contrib.nd.PSROIPooling(data, rois, output_dim=1, pooled_size=2)
+    assert out.shape == (1, 1, 2, 2)
+
+
+def test_faster_rcnn_smoke():
+    """Fixed-shape two-stage pipeline: eager forward, hybridized forward,
+    identical outputs, every shape static (SURVEY §2.9 Faster-RCNN row)."""
+    from incubator_mxnet_tpu.models import FasterRCNN
+    rng = onp.random.RandomState(0)
+    net = FasterRCNN(num_classes=3, rpn_pre_nms_top_n=32,
+                     rpn_post_nms_top_n=8)
+    net.initialize()
+    x = mx.nd.array(rng.rand(2, 3, 64, 64).astype("float32"))
+    info = mx.nd.array(onp.array([[64, 64, 1.0], [64, 64, 1.0]], "float32"))
+    cls, box, rois = net(x, info)
+    assert cls.shape == (2, 8, 4)
+    assert box.shape == (2, 8, 16)
+    assert rois.shape == (16, 5)
+    c = cls.asnumpy()
+    onp.testing.assert_allclose(c.sum(-1), onp.ones((2, 8)), rtol=1e-5)
+    r = rois.asnumpy()
+    assert (r[:8, 0] == 0).all() and (r[8:, 0] == 1).all()
+    assert onp.isfinite(r).all()
+    # hybridized path reproduces eager numerics
+    net.hybridize()
+    net(x, info)
+    cls2, box2, rois2 = net(x, info)
+    onp.testing.assert_allclose(cls2.asnumpy(), c, rtol=1e-5, atol=1e-6)
+    onp.testing.assert_allclose(rois2.asnumpy(), r, rtol=1e-5, atol=1e-5)

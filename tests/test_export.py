@@ -1,0 +1,119 @@
+"""Deploy-path tests: HybridBlock.export → StableHLO + .params manifest,
+SymbolBlock.imports reconstructs a runnable block with the original class out
+of the picture (reference: HybridBlock.export / gluon.SymbolBlock.imports).
+"""
+import json
+import os
+
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon, nd
+
+
+def _make_mlp():
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Dense(16, activation="relu", in_units=8))
+        net.add(gluon.nn.Dense(4, in_units=16))
+    net.initialize()
+    return net
+
+
+def test_export_import_round_trip(tmp_path):
+    net = _make_mlp()
+    net.hybridize()
+    x = nd.array(onp.random.RandomState(0).randn(3, 8).astype("float32"))
+    want = net(x)          # warm (eager), discovers params
+    want = net(x)          # cached-op path records the export signature
+    sym_file, params_file = net.export(str(tmp_path / "mlp"))
+    assert os.path.exists(sym_file) and os.path.exists(params_file)
+    arch = json.load(open(sym_file))
+    assert arch["stablehlo"] and os.path.exists(
+        str(tmp_path / arch["stablehlo"]))
+    assert "stablehlo_available" not in arch  # the old fake flag is gone
+
+    blk = gluon.SymbolBlock.imports(sym_file, ["data"], params_file)
+    got = blk(x)
+    onp.testing.assert_allclose(got.asnumpy(), want.asnumpy(),
+                                rtol=1e-5, atol=1e-5)
+
+
+def test_import_runs_without_original_class(tmp_path):
+    """The manifest + StableHLO alone reproduce the computation — feed the
+    imported block DIFFERENT data than was seen at export time."""
+    net = _make_mlp()
+    net.hybridize()
+    rng = onp.random.RandomState(1)
+    x_trace = nd.array(rng.randn(3, 8).astype("float32"))
+    net(x_trace)
+    net(x_trace)
+    sym_file, params_file = net.export(str(tmp_path / "m"))
+
+    x_new = nd.array(rng.randn(3, 8).astype("float32"))
+    want = net(x_new).asnumpy()
+    blk = gluon.SymbolBlock.imports(sym_file, ["data"], params_file)
+    onp.testing.assert_allclose(blk(x_new).asnumpy(), want,
+                                rtol=1e-5, atol=1e-5)
+
+
+def test_export_multi_output(tmp_path):
+    class TwoHead(gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.a = gluon.nn.Dense(2, in_units=4)
+                self.b = gluon.nn.Dense(3, in_units=4)
+
+        def hybrid_forward(self, F, x):
+            return self.a(x), self.b(x)
+
+    net = TwoHead()
+    net.initialize()
+    net.hybridize()
+    x = nd.array(onp.random.RandomState(2).randn(5, 4).astype("float32"))
+    net(x)
+    wa, wb = net(x)
+    sym_file, params_file = net.export(str(tmp_path / "two"))
+    blk = gluon.SymbolBlock.imports(sym_file, ["data"], params_file)
+    ga, gb = blk(x)
+    onp.testing.assert_allclose(ga.asnumpy(), wa.asnumpy(), rtol=1e-5)
+    onp.testing.assert_allclose(gb.asnumpy(), wb.asnumpy(), rtol=1e-5)
+
+
+def test_export_inference_semantics_dropout(tmp_path):
+    """Exported graph is the inference graph: dropout must be identity."""
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Dense(8, in_units=8))
+        net.add(gluon.nn.Dropout(0.9))
+    net.initialize()
+    net.hybridize()
+    x = nd.ones((2, 8))
+    net(x)
+    net(x)
+    sym_file, params_file = net.export(str(tmp_path / "do"))
+    blk = gluon.SymbolBlock.imports(sym_file, ["data"], params_file)
+    a = blk(x).asnumpy()
+    b = blk(x).asnumpy()
+    onp.testing.assert_array_equal(a, b)  # no randomness at inference
+    onp.testing.assert_allclose(a, net(x).asnumpy(), rtol=1e-5)
+
+
+def test_export_without_trace_raises(tmp_path):
+    net = _make_mlp()
+    with pytest.raises(mx.MXNetError):
+        net.export(str(tmp_path / "untraced"))
+
+
+def test_export_after_single_forward(tmp_path):
+    """The reference contract: hybridize + ONE forward suffices to export."""
+    net = _make_mlp()
+    net.hybridize()
+    x = nd.array(onp.random.RandomState(3).randn(2, 8).astype("float32"))
+    want = net(x)  # warm-up call only
+    sym_file, params_file = net.export(str(tmp_path / "single"))
+    blk = gluon.SymbolBlock.imports(sym_file, ["data"], params_file)
+    onp.testing.assert_allclose(blk(x).asnumpy(), want.asnumpy(),
+                                rtol=1e-5, atol=1e-5)

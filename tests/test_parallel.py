@@ -137,3 +137,84 @@ def test_sharded_trainer_save_load(tmp_path):
     after = [jax.device_get(v) for v in tr._param_vals]
     for a, b in zip(before, after):
         onp.testing.assert_allclose(a, b)
+
+
+def test_ring_attention_key_mask():
+    """Padding masks ride the ring with their K/V block."""
+    mesh = parallel.make_mesh(dp=1, sp=4, devices=jax.devices()[:4])
+    B, H, L, D = 2, 2, 32, 8
+    rng = onp.random.RandomState(3)
+    q, k, v = (rng.randn(B, H, L, D).astype("float32") for _ in range(3))
+    mask = (rng.rand(B, L) > 0.3).astype("float32")
+    out = parallel.ring_attention_sharded(mesh, q, k, v, key_mask=mask)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (D ** -0.5)
+    s = jnp.where(mask[:, None, None, :] > 0, s, -1e30)
+    ref = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1), v)
+    onp.testing.assert_allclose(jax.device_get(out), ref, atol=2e-5)
+
+
+def test_ring_attention_grads_match_dense():
+    """Reverse-mode through the ring (flash_block custom VJP per hop +
+    lse merge) equals dense attention gradients."""
+    mesh = parallel.make_mesh(dp=1, sp=4, devices=jax.devices()[:4])
+    B, H, L, D = 1, 2, 32, 8
+    rng = onp.random.RandomState(4)
+    q, k, v = (rng.randn(B, H, L, D).astype("float32") for _ in range(3))
+    from functools import partial
+    from jax.sharding import PartitionSpec as P
+    from incubator_mxnet_tpu.parallel.collectives import shard_map
+    from incubator_mxnet_tpu.parallel.ring import ring_attention
+    spec = P(None, None, "sp", None)
+    ring_fn = shard_map(partial(ring_attention, key_mask=None, axis="sp"),
+                        mesh=mesh, in_specs=(spec,) * 3, out_specs=spec)
+
+    def loss_ring(q, k, v):
+        return (ring_fn(q, k, v) * jnp.arange(D)).sum()
+
+    def loss_dense(q, k, v):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (D ** -0.5)
+        o = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
+        return (o * jnp.arange(D)).sum()
+
+    g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for gr, gd in zip(g_ring, g_dense):
+        onp.testing.assert_allclose(jax.device_get(gr), gd,
+                                    rtol=1e-3, atol=1e-4)
+
+
+def test_bert_step_sp4_matches_sp1():
+    """VERDICT r2 #6 done-criterion: a BERT training step on an sp=4 mesh
+    (attention lowered to ring over sp) reproduces the sp=1 numerics."""
+    from incubator_mxnet_tpu import models
+    rng = onp.random.RandomState(0)
+    B, L, vocab = 4, 32, 64
+    P_mask = 4
+
+    def batch():
+        ids = rng.randint(0, vocab, (B, L)).astype("int32")
+        tt = onp.zeros((B, L), "int32")
+        vl = onp.full((B,), L, "float32")
+        pos = rng.randint(0, L, (B, P_mask)).astype("int32")
+        lab = rng.randint(0, vocab, (B, P_mask)).astype("float32")
+        w = onp.ones((B, P_mask), "float32")
+        nsp = rng.randint(0, 2, (B,)).astype("float32")
+        return (ids, tt, vl, pos, lab, w, nsp)
+
+    data = [batch() for _ in range(2)]
+
+    def run(mesh):
+        mx.random.seed(11)
+        net = models.get_bert("bert_2_128_2", vocab_size=vocab, max_length=L,
+                              dropout=0.0)
+        net.initialize()
+        tr = parallel.ShardedTrainer(
+            net, models.bert_pretrain_loss, "sgd", {"learning_rate": 0.1},
+            mesh=mesh, n_labels=3)
+        losses = [float(tr.step(*b).asnumpy()) for b in data]
+        return losses
+
+    l_sp1 = run(parallel.make_mesh(devices=jax.devices()[:1]))
+    l_sp4 = run(parallel.make_mesh(dp=1, sp=4, tp=1,
+                                   devices=jax.devices()[:4]))
+    onp.testing.assert_allclose(l_sp4, l_sp1, rtol=2e-4, atol=2e-5)

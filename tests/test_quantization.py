@@ -1,0 +1,184 @@
+"""INT8 quantization tests (reference model:
+tests/python/quantization/test_quantization.py — op-level numerics + whole-net
+quantize within tolerance of fp32)."""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, gluon
+from incubator_mxnet_tpu.quantization import (
+    quantize_net, optimal_threshold, LayerRangeCollector)
+
+
+# ---------------------------------------------------------------------------
+# op level
+# ---------------------------------------------------------------------------
+
+def test_quantize_dequantize_round_trip():
+    rng = onp.random.RandomState(0)
+    x = rng.randn(5, 7).astype("float32") * 3
+    q, lo, hi = nd.quantize(nd.array(x), nd.array(x.min()), nd.array(x.max()))
+    assert q.asnumpy().dtype == onp.int8
+    back = nd.dequantize(q, lo, hi).asnumpy()
+    scale = max(abs(float(x.min())), abs(float(x.max()))) / 127
+    onp.testing.assert_allclose(back, x, atol=scale + 1e-6)
+
+
+def test_quantize_v2_online_range():
+    x = nd.array(onp.array([[-4.0, 2.0, 8.0]], "float32"))
+    q, lo, hi = nd.quantize_v2(x)
+    assert float(hi.asnumpy()) == 8.0
+    assert int(q.asnumpy()[0, 2]) == 127
+
+
+def test_quantized_fully_connected_matches_fp32():
+    rng = onp.random.RandomState(1)
+    x = rng.randn(4, 16).astype("float32")
+    w = rng.randn(8, 16).astype("float32")
+    b = rng.randn(8).astype("float32")
+    qx, xlo, xhi = nd.quantize_v2(nd.array(x))
+    qw, wlo, whi = nd.quantize_v2(nd.array(w))
+    qb, blo, bhi = nd.quantize_v2(nd.array(b))
+    acc, olo, ohi = nd.quantized_fully_connected(
+        qx, qw, qb, xlo, xhi, wlo, whi, blo, bhi, num_hidden=8)
+    out = nd.dequantize(acc, olo, ohi).asnumpy()
+    want = x @ w.T + b
+    err = onp.abs(out - want).max() / (onp.abs(want).max() + 1e-6)
+    assert err < 0.03, err
+
+
+def test_quantized_conv_matches_fp32():
+    rng = onp.random.RandomState(2)
+    x = rng.randn(2, 3, 8, 8).astype("float32")
+    w = rng.randn(4, 3, 3, 3).astype("float32")
+    qx, xlo, xhi = nd.quantize_v2(nd.array(x))
+    qw, wlo, whi = nd.quantize_v2(nd.array(w))
+    acc, olo, ohi = nd.quantized_conv(
+        qx, qw, None, xlo, xhi, wlo, whi, no_bias=True,
+        kernel=(3, 3), pad=(1, 1), num_filter=4)
+    out = nd.dequantize(acc, olo, ohi).asnumpy()
+    import jax.numpy as jnp
+    from jax import lax
+    dn = lax.conv_dimension_numbers(x.shape, w.shape, ("NCHW", "OIHW", "NCHW"))
+    want = onp.asarray(lax.conv_general_dilated(
+        jnp.asarray(x), jnp.asarray(w), (1, 1), [(1, 1), (1, 1)],
+        dimension_numbers=dn))
+    err = onp.abs(out - want).max() / (onp.abs(want).max() + 1e-6)
+    assert err < 0.03, err
+
+
+def test_quantized_pooling_preserves_range():
+    x = onp.arange(-8, 8, dtype="int8").reshape(1, 1, 4, 4)
+    out, lo, hi = nd.quantized_pooling(
+        nd.array(x), nd.array(-1.0), nd.array(1.0), kernel=(2, 2),
+        pool_type="max")
+    want = onp.array([[[[-3, -1], [5, 7]]]], "int8")
+    onp.testing.assert_array_equal(out.asnumpy(), want)
+    assert float(lo.asnumpy()) == -1.0 and float(hi.asnumpy()) == 1.0
+
+
+def test_optimal_threshold_clips_outliers():
+    rng = onp.random.RandomState(3)
+    data = onp.concatenate([rng.randn(100000), [40.0]]).astype("float32")
+    hist, edges = onp.histogram(data, bins=8001, range=(-40, 40))
+    th = optimal_threshold(hist, edges)
+    assert th < 20.0  # the lone outlier must not dictate the scale
+
+
+def test_collector_entropy_range_growth():
+    c = LayerRangeCollector(mode="entropy", num_bins=401)
+    rng = onp.random.RandomState(4)
+    c.collect("l", rng.randn(1000).astype("float32"))
+    c.collect("l", (rng.randn(1000) * 5).astype("float32"))  # wider
+    (lo, hi), = [c.ranges()["l"]]
+    assert lo == -hi and hi > 0
+
+
+# ---------------------------------------------------------------------------
+# net level
+# ---------------------------------------------------------------------------
+
+def _lenet():
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Conv2D(8, kernel_size=3, padding=1,
+                                activation="relu", in_channels=1))
+        net.add(gluon.nn.MaxPool2D(pool_size=2, strides=2))
+        net.add(gluon.nn.Flatten())
+        net.add(gluon.nn.Dense(32, activation="relu"))
+        net.add(gluon.nn.Dense(10))
+    net.initialize()
+    return net
+
+
+@pytest.mark.parametrize("calib_mode", ["naive", "entropy"])
+def test_quantize_net_close_to_fp32(calib_mode):
+    rng = onp.random.RandomState(5)
+    mx.random.seed(42)   # pin init: numeric-tolerance test
+    net = _lenet()
+    # bell-shaped inputs: the KL threshold search assumes activations with
+    # sparse tails (true of trained nets; uniform data would mislead it)
+    calib = [nd.array(rng.randn(4, 1, 12, 12).astype("float32"))
+             for _ in range(3)]
+    x = nd.array(rng.randn(4, 1, 12, 12).astype("float32"))
+    want = net(x).asnumpy()
+    quantize_net(net, calib_data=calib, calib_mode=calib_mode)
+    got = net(x).asnumpy()
+    if calib_mode == "naive":
+        err = onp.abs(got - want).max() / (onp.abs(want).max() + 1e-6)
+        assert err < 0.06, err
+    else:
+        # KL calibration saturates outliers BY DESIGN (it trades tail
+        # fidelity for in-range resolution) — judge it on mean error, as
+        # the reference's accuracy-based tests do.
+        err = onp.abs(got - want).mean() / (onp.abs(want).mean() + 1e-6)
+        assert err < 0.10, err
+    # argmax agreement (the metric that matters for int8 deploys)
+    assert (got.argmax(1) == want.argmax(1)).mean() >= 0.75
+
+
+def test_quantize_net_excludes_layers():
+    rng = onp.random.RandomState(6)
+    net = _lenet()
+    calib = [nd.array(rng.rand(2, 1, 12, 12).astype("float32"))]
+    from incubator_mxnet_tpu.quantization import _QuantizedLayerBase
+    quantize_net(net, calib_data=calib, exclude_layers=["dense"])
+    kinds = [type(c).__name__ for c in net._children.values()]
+    assert any("QuantizedConv" in k for k in kinds)
+    assert not any("QuantizedDense" in k for k in kinds)
+
+
+def test_quantized_net_hybridizes():
+    rng = onp.random.RandomState(7)
+    net = _lenet()
+    calib = [nd.array(rng.rand(2, 1, 12, 12).astype("float32"))]
+    quantize_net(net, calib_data=calib)
+    x = nd.array(rng.rand(2, 1, 12, 12).astype("float32"))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    net(x)  # warm
+    jitted = net(x).asnumpy()
+    onp.testing.assert_allclose(jitted, eager, rtol=1e-5, atol=1e-5)
+
+
+def test_quantize_net_on_hybridized_net():
+    """Reference workflow: quantize an already-hybridized (compiled) net.
+    Calibration must bypass the stale jit cache and the swapped net must
+    recompile (regression: silent no-op quantization)."""
+    rng = onp.random.RandomState(8)
+    mx.random.seed(43)   # pin init: numeric-tolerance test
+    net = _lenet()
+    net.hybridize()
+    x = nd.array(rng.randn(2, 1, 12, 12).astype("float32"))
+    net(x)
+    want = net(x).asnumpy()  # compiled float forward
+    calib = [nd.array(rng.randn(2, 1, 12, 12).astype("float32"))
+             for _ in range(2)]
+    quantize_net(net, calib_data=calib)
+    from incubator_mxnet_tpu.quantization import _QuantizedLayerBase
+    kinds = [type(c) for c in net._children.values()]
+    assert any(issubclass(k, _QuantizedLayerBase) for k in kinds), \
+        "quantization was a silent no-op on a hybridized net"
+    got = net(x).asnumpy()     # recompiles the int8 graph
+    err = onp.abs(got - want).mean() / (onp.abs(want).mean() + 1e-6)
+    assert err < 0.10, err
