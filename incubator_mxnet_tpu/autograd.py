@@ -54,14 +54,21 @@ _STATE = _State()
 class Node:
     """One recorded op: a pure function and its I/O bindings."""
 
-    __slots__ = ("fn", "inputs", "input_values", "outputs", "name")
+    __slots__ = ("fn", "inputs", "input_values", "outputs", "name",
+                 "vjp_fn", "multi")
 
-    def __init__(self, fn, inputs, input_values, outputs, name=""):
+    def __init__(self, fn, inputs, input_values, outputs, name="",
+                 vjp_fn=None, multi=False):
         self.fn = fn                    # pure: (*jnp arrays) -> jnp array | tuple
         self.inputs = inputs            # List[NDArray] (for grad routing)
         self.input_values = input_values  # List[jax.Array] snapshot
         self.outputs = outputs          # List[NDArray]
         self.name = name
+        #: pullback captured at forward time (residuals = stored
+        #: activations); None for ops recorded without one — backward then
+        #: falls back to re-linearizing the forward.
+        self.vjp_fn = vjp_fn
+        self.multi = multi              # did fn return a tuple/list?
 
 
 # ---------------------------------------------------------------------------
@@ -126,8 +133,10 @@ def set_training(flag: bool) -> bool:
 # Tape
 # ---------------------------------------------------------------------------
 
-def _record_node(fn, inputs, input_values, outputs, name="") -> None:
-    node = Node(fn, list(inputs), list(input_values), list(outputs), name)
+def _record_node(fn, inputs, input_values, outputs, name="",
+                 vjp_fn=None, multi=False) -> None:
+    node = Node(fn, list(inputs), list(input_values), list(outputs), name,
+                vjp_fn=vjp_fn, multi=multi)
     _STATE.tape.append(node)
     for arr in node.outputs:
         arr._fresh_grad_node = node  # mark as produced-on-tape
@@ -180,15 +189,24 @@ def backward(
         out_grads = [grad_map.get(id(o)) for o in node.outputs]
         if all(g is None for g in out_grads):
             continue
+        if node.vjp_fn is not None:
+            vjp_fn = node.vjp_fn
+            outs = node.outputs
+            multi = node.multi
+        else:
+            # node recorded without a pullback: re-linearize the forward
+            primal_out, vjp_fn = jax.vjp(node.fn, *node.input_values)
+            outs = primal_out if isinstance(primal_out, (tuple, list)) \
+                else (primal_out,)
+            multi = isinstance(primal_out, (tuple, list))
         cotangents = []
-        primal_out, vjp_fn = jax.vjp(node.fn, *node.input_values)
-        outs = primal_out if isinstance(primal_out, (tuple, list)) else (primal_out,)
         for o, g in zip(outs, out_grads):
+            o_data = o._data if hasattr(o, "_data") else o
             if g is None:
-                cotangents.append(jnp.zeros(o.shape, o.dtype))
+                cotangents.append(jnp.zeros(o_data.shape, o_data.dtype))
             else:
-                cotangents.append(jnp.asarray(g, o.dtype))
-        cot = tuple(cotangents) if isinstance(primal_out, (tuple, list)) else cotangents[0]
+                cotangents.append(jnp.asarray(g, o_data.dtype))
+        cot = tuple(cotangents) if multi else cotangents[0]
         in_grads = vjp_fn(cot)
         for arr, g in zip(node.inputs, in_grads):
             if g is None or _is_float0(g):

@@ -1,17 +1,22 @@
 """DataLoader with background workers.
 
 Reference parity: ``python/mxnet/gluon/data/dataloader.py`` — multiprocessing
-workers producing batches into shared-memory NDArrays (SURVEY §3.6). The
-TPU-era shape: workers produce *host numpy* batches (the C++ shm transport's
-job collapses into pickle-over-pipe of numpy buffers); the main process
-converts once to device arrays, and XLA's async dispatch overlaps H2D with
-compute (the reference's dedicated copy thread).
+workers producing batches into shared-memory NDArrays (SURVEY §3.6). Worker
+batches travel through the SAME transport as the reference: named POSIX
+shared memory (the native ``ShmSegment``) — a worker writes each batch array
+into a segment and only (name, shape, dtype) crosses the pipe; the parent
+attaches zero-copy and hands the buffer to XLA's async H2D (the reference's
+dedicated copy thread). When the native library is unavailable the loader
+falls back to pickle-over-pipe transparently (MXTPU_DATALOADER_SHM=0 forces
+the fallback).
 """
 from __future__ import annotations
 
 import multiprocessing
+import os
 import queue as queue_mod
 import threading
+import uuid
 from typing import Callable, List, Optional
 
 import numpy as onp
@@ -22,6 +27,80 @@ from .dataset import Dataset
 from .sampler import BatchSampler, RandomSampler, Sampler, SequentialSampler
 
 __all__ = ["DataLoader", "default_batchify_fn", "default_mp_batchify_fn"]
+
+
+# ---------------------------------------------------------------------------
+# shared-memory batch transport (reference: the C++ shm NDArray transport)
+# ---------------------------------------------------------------------------
+
+def _shm_available() -> bool:
+    if os.environ.get("MXTPU_DATALOADER_SHM", "1") == "0":
+        return False
+    try:
+        from ...native import _lib
+        _lib()
+        return True
+    except Exception:
+        return False
+
+
+_SHM_TAG = "__mxtpu_shm__"
+
+
+def _to_shm(tree):
+    """Worker side: move every ndarray into a named shm segment; the pipe
+    carries only descriptors."""
+    from ...native import ShmSegment
+    if isinstance(tree, (list, tuple)):
+        return [_to_shm(t) for t in tree]
+    if isinstance(tree, onp.ndarray) and tree.nbytes > 0:
+        arr = onp.ascontiguousarray(tree)
+        name = f"/mxtpu_dl_{os.getpid()}_{uuid.uuid4().hex[:12]}"
+        seg = ShmSegment(name, arr.nbytes, create=True)
+        seg.as_numpy(arr.shape, arr.dtype)[...] = arr
+        # keep the segment alive for the parent; parent unlinks
+        seg.close(unlink=False)
+        return (_SHM_TAG, name, arr.shape, str(arr.dtype))
+    return tree
+
+
+def _is_shm_desc(tree) -> bool:
+    return (isinstance(tree, (list, tuple)) and len(tree) == 4
+            and isinstance(tree[0], str) and tree[0] == _SHM_TAG)
+
+
+def _from_shm(tree):
+    """Parent side: attach, copy out, unlink."""
+    from ...native import ShmSegment
+    if _is_shm_desc(tree):
+        _, name, shape, dtype = tree
+        n = max(1, int(onp.prod(shape))) * onp.dtype(dtype).itemsize
+        seg = ShmSegment(name, n, create=False)
+        try:
+            arr = onp.array(seg.as_numpy(shape, onp.dtype(dtype)))
+        finally:
+            seg.close(unlink=True)
+        return arr
+    if isinstance(tree, (list, tuple)):
+        return [_from_shm(t) for t in tree]
+    return tree
+
+
+def _unlink_shm(tree) -> None:
+    """Free a descriptor tree's segments without reading them (cleanup for
+    batches the consumer abandoned — named shm outlives the process)."""
+    from ...native import ShmSegment
+    if _is_shm_desc(tree):
+        _, name, shape, dtype = tree
+        n = max(1, int(onp.prod(shape))) * onp.dtype(dtype).itemsize
+        try:
+            ShmSegment(name, n, create=False).close(unlink=True)
+        except Exception:
+            pass
+        return
+    if isinstance(tree, (list, tuple)):
+        for t in tree:
+            _unlink_shm(t)
 
 
 def default_batchify_fn(data):
@@ -63,8 +142,9 @@ def _worker_init(dataset):
     _worker_dataset = dataset
 
 
-def _worker_fn(samples, batchify_fn):
-    return batchify_fn([_worker_dataset[i] for i in samples])
+def _worker_fn(samples, batchify_fn, use_shm=False):
+    batch = batchify_fn([_worker_dataset[i] for i in samples])
+    return _to_shm(batch) if use_shm else batch
 
 
 class DataLoader:
@@ -160,12 +240,32 @@ class DataLoader:
             results = [
                 self._pool.apply_async(self._load, (samples,))
                 for samples in self._batch_sampler]
-        else:
-            results = [
-                self._pool.apply_async(_worker_fn, (samples, self._batchify_fn))
-                for samples in self._batch_sampler]
-        for r in results:
-            yield _as_nd(r.get(self._timeout))
+            for r in results:
+                yield _as_nd(r.get(self._timeout))
+            return
+        use_shm = _shm_available()
+        results = [
+            self._pool.apply_async(_worker_fn,
+                                   (samples, self._batchify_fn, use_shm))
+            for samples in self._batch_sampler]
+        done = 0
+        try:
+            for r in results:
+                batch = r.get(self._timeout)
+                done += 1
+                if use_shm:
+                    batch = _from_shm(batch)
+                yield _as_nd(batch)
+        finally:
+            if use_shm and done < len(results):
+                # consumer abandoned the iterator (break / exception):
+                # drain and unlink the already-dispatched segments so
+                # /dev/shm doesn't fill up across runs
+                for r in results[done:]:
+                    try:
+                        _unlink_shm(r.get(self._timeout))
+                    except Exception:
+                        pass
 
     def __del__(self):
         if self._pool is not None:

@@ -18,6 +18,7 @@ from . import rcnn  # noqa: F401
 from .rcnn import FasterRCNN, RPN  # noqa: F401
 from .transformer import (  # noqa: F401
     MultiHeadAttention, PositionwiseFFN, TransformerEncoderCell,
+    StackedTransformerEncoder,
 )
 from .bert import (  # noqa: F401
     BERTModel, BERTEncoder, bert_sharding_rules, get_bert, bert_pretrain_loss,

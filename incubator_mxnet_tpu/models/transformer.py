@@ -13,10 +13,14 @@ from __future__ import annotations
 
 from typing import Optional
 
+import jax
+import jax.numpy as jnp
+
 from ..gluon.block import HybridBlock
 from ..gluon import nn
 
-__all__ = ["MultiHeadAttention", "PositionwiseFFN", "TransformerEncoderCell"]
+__all__ = ["MultiHeadAttention", "PositionwiseFFN", "TransformerEncoderCell",
+           "StackedTransformerEncoder"]
 
 
 class MultiHeadAttention(HybridBlock):
@@ -145,3 +149,137 @@ class TransformerEncoderCell(HybridBlock):
         x = self.ln1(x + self.attention(x, None, mask))
         x = self.ln2(x + self.ffn(x))
         return x
+
+
+class StackedTransformerEncoder(HybridBlock):
+    """Scan-over-layers transformer encoder: every parameter carries a
+    leading ``(num_layers,)`` axis, the forward is a ``lax.scan`` over that
+    axis — the production-JAX formulation of a deep stack (one compiled
+    layer body regardless of depth).
+
+    This layout is what makes PIPELINE parallelism a pure sharding choice:
+    with an active mesh whose ``pp`` axis divides ``num_layers``, the layer
+    stack becomes ``pp`` stages of ``num_layers/pp`` layers and the forward
+    runs the microbatched GPipe schedule (``parallel/pipeline.py``), the
+    stage stacks sharded over ``pp``. Without pp it is an ordinary scan.
+    Reference counterpart: none — SURVEY §2.5 parity-plus extension.
+    """
+
+    def __init__(self, num_layers: int, units: int, hidden_size: int,
+                 num_heads: int, layer_norm_eps: float = 1e-12,
+                 n_micro: int = 4, dtype="float32", **kwargs):
+        super().__init__(**kwargs)
+        self._N = num_layers
+        self._units = units
+        self._hidden = hidden_size
+        self._heads = num_heads
+        self._eps = layer_norm_eps
+        self._n_micro = n_micro
+        N, C, H = num_layers, units, hidden_size
+        with self.name_scope():
+            get = self.params.get
+            self.qkv_w = get("qkv_weight", shape=(N, 3 * C, C), init="xavier",
+                             dtype=dtype)
+            self.qkv_b = get("qkv_bias", shape=(N, 3 * C), init="zeros",
+                             dtype=dtype)
+            self.proj_w = get("proj_weight", shape=(N, C, C), init="xavier",
+                              dtype=dtype)
+            self.proj_b = get("proj_bias", shape=(N, C), init="zeros",
+                              dtype=dtype)
+            self.ffn1_w = get("ffn1_weight", shape=(N, H, C), init="xavier",
+                              dtype=dtype)
+            self.ffn1_b = get("ffn1_bias", shape=(N, H), init="zeros",
+                              dtype=dtype)
+            self.ffn2_w = get("ffn2_weight", shape=(N, C, H), init="xavier",
+                              dtype=dtype)
+            self.ffn2_b = get("ffn2_bias", shape=(N, C), init="zeros",
+                              dtype=dtype)
+            self.ln1_g = get("ln1_gamma", shape=(N, C), init="ones",
+                             dtype=dtype)
+            self.ln1_b = get("ln1_beta", shape=(N, C), init="zeros",
+                             dtype=dtype)
+            self.ln2_g = get("ln2_gamma", shape=(N, C), init="ones",
+                             dtype=dtype)
+            self.ln2_b = get("ln2_beta", shape=(N, C), init="zeros",
+                             dtype=dtype)
+
+    # -- one layer on one (mb, L, C) block ---------------------------------
+    def _layer(self, p, x):
+        C, Hd = self._units, self._heads
+        D = C // Hd
+        B, L, _ = x.shape
+
+        def ln(v, g, b):
+            mu = v.mean(-1, keepdims=True)
+            var = ((v - mu) ** 2).mean(-1, keepdims=True)
+            return (v - mu) * jax.lax.rsqrt(var + self._eps) * g + b
+
+        qkv = jnp.einsum("blc,oc->blo", x, p["qkv_w"]) + p["qkv_b"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, L, Hd, D).transpose(0, 2, 1, 3)
+        k = k.reshape(B, L, Hd, D).transpose(0, 2, 1, 3)
+        v = v.reshape(B, L, Hd, D).transpose(0, 2, 1, 3)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                       preferred_element_type=jnp.float32) * (D ** -0.5)
+        a = jax.nn.softmax(s, axis=-1).astype(x.dtype)
+        o = jnp.einsum("bhqk,bhkd->bhqd", a, v)
+        o = o.transpose(0, 2, 1, 3).reshape(B, L, C)
+        o = jnp.einsum("blc,oc->blo", o, p["proj_w"]) + p["proj_b"]
+        x = ln(x + o, p["ln1_g"], p["ln1_b"])
+        h = jax.nn.gelu(jnp.einsum("blc,hc->blh", x, p["ffn1_w"])
+                        + p["ffn1_b"], approximate=False)
+        f = jnp.einsum("blh,ch->blc", h, p["ffn2_w"]) + p["ffn2_b"]
+        return ln(x + f, p["ln2_g"], p["ln2_b"])
+
+    def _params_tree(self, kw):
+        names = ["qkv_w", "qkv_b", "proj_w", "proj_b", "ffn1_w", "ffn1_b",
+                 "ffn2_w", "ffn2_b", "ln1_g", "ln1_b", "ln2_g", "ln2_b"]
+        from ..ndarray import NDArray
+        return {n: (kw[n]._data if isinstance(kw[n], NDArray) else kw[n])
+                for n in names}
+
+    def hybrid_forward(self, F, x, **kw):
+        from ..ndarray import NDArray
+        from ..parallel.mesh import current_active_mesh
+        xv = x._data if isinstance(x, NDArray) else x
+        tree = self._params_tree(kw)
+        mesh = current_active_mesh()
+        pp = mesh.shape.get("pp", 1) if mesh is not None else 1
+        use_pp = (pp > 1 and self._N % pp == 0
+                  and isinstance(xv, jax.core.Tracer)
+                  and xv.shape[0] % self._n_micro == 0)
+        if use_pp:
+            from functools import partial
+            from jax.sharding import PartitionSpec as P
+            from ..parallel.collectives import shard_map
+            from ..parallel.pipeline import pipeline_apply
+            per_stage = self._N // pp
+            M = self._n_micro
+            B = xv.shape[0]
+            stage = {n: v.reshape((pp, per_stage) + v.shape[1:])
+                     for n, v in tree.items()}
+
+            def stage_fn(p, mb):
+                def body(h, i):
+                    pl = jax.tree.map(lambda v: v[i], p)
+                    return self._layer(pl, h), None
+                out, _ = jax.lax.scan(body, mb, jnp.arange(per_stage))
+                return out
+
+            xm = xv.reshape((M, B // M) + xv.shape[1:])
+            dp = mesh.shape.get("dp", 1)
+            use_dp = dp > 1 and (B // M) % dp == 0
+            xspec = P(None, "dp" if use_dp else None)
+            pspec = {n: P("pp") for n in stage}
+            fn = shard_map(partial(pipeline_apply, stage_fn=stage_fn,
+                                   axis="pp"),
+                           mesh=mesh, in_specs=(pspec, xspec),
+                           out_specs=xspec)
+            out = fn(stage, xm)
+            out = out.reshape(xv.shape)
+        else:
+            def body(h, i):
+                pl = jax.tree.map(lambda v: v[i], tree)
+                return self._layer(pl, h), None
+            out, _ = jax.lax.scan(body, xv, jnp.arange(self._N))
+        return NDArray(out, ctx=x.context) if isinstance(x, NDArray) else out

@@ -22,13 +22,29 @@ __all__ = ["dispatch_op", "make_nd_op"]
 
 
 def dispatch_op(pure_fn: Callable, arrays: Sequence[NDArray], kwargs, ctx: Context, name: str = ""):
-    """Execute ``pure_fn(*values)`` and wrap outputs; record for autograd."""
+    """Execute ``pure_fn(*values)`` and wrap outputs; record for autograd.
+
+    When recording, the forward runs under ``jax.vjp`` so the pullback (with
+    its residuals — the activations) is captured NOW: backward() replays
+    only the reverse computation, never the forward. This is the reference's
+    imperative memory/compute trade (activations live on the tape until
+    backward) — without it every backward would re-execute every forward.
+    """
     vals = [a._data for a in arrays]
+    if autograd.is_recording():
+        try:
+            out, vjp_fn = jax.vjp(pure_fn, *vals)
+        except TypeError:
+            # non-differentiable op (e.g. integer outputs): plain dispatch
+            out, vjp_fn = pure_fn(*vals), None
+        multi = isinstance(out, (tuple, list))
+        outs = [NDArray(o, ctx=ctx) for o in (out if multi else (out,))]
+        autograd._record_node(pure_fn, arrays, vals, outs, name,
+                              vjp_fn=vjp_fn, multi=multi)
+        return outs if multi else outs[0]
     out = pure_fn(*vals)
     multi = isinstance(out, (tuple, list))
     outs = [NDArray(o, ctx=ctx) for o in (out if multi else (out,))]
-    if autograd.is_recording():
-        autograd._record_node(pure_fn, arrays, vals, outs, name)
     return outs if multi else outs[0]
 
 

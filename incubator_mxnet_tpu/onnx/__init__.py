@@ -1,16 +1,24 @@
 """ONNX interop (reference: python/mxnet/onnx — SURVEY §2.7).
 
-The ``onnx`` package is not part of this build's frozen environment, so the
-conversion surface is API-complete but gated: with ``onnx`` installed,
-``export_model`` emits a real ModelProto for symbol graphs made of the
-supported op set; without it, a clear MXNetError explains the gate.
+``export_model`` emits a real ONNX ModelProto for symbol graphs of the
+supported op set; ``import_model`` reads one back into
+``(sym, arg_params, aux_params)``. The environment has no ``onnx`` package,
+so serialization runs on the in-tree wire-format codec (``_proto.py`` —
+plain protobuf; files interchange with stock onnx/onnxruntime). When a real
+``onnx`` package IS present it is used instead.
+
+Supported op set (the gluon model-zoo surface): FullyConnected/Gemm,
+Convolution/Conv (pads/strides/dilations/groups), Pooling (max/avg,
+pads/ceil_mode/global), BatchNorm, Dropout, Flatten, Reshape, Transpose,
+Concat, elementwise broadcast_{add,sub,mul,div}, activations
+(relu/sigmoid/tanh/softrelu), softmax/SoftmaxOutput, and multi-output
+graphs via ``sym.Group``.
 
 The deploy-format story on TPU is StableHLO (``HybridBlock.export`` /
-``jax.export``) — ONNX remains for ecosystem exchange only.
+``jax.export``) — ONNX remains for ecosystem exchange.
 """
 from __future__ import annotations
 
-import json
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as onp
@@ -20,32 +28,29 @@ from ..base import MXNetError
 __all__ = ["export_model", "import_model", "get_model_metadata"]
 
 
-def _require_onnx():
+def _onnx_mods():
+    """(helper, numpy_helper, TensorProto, save, load) from the real onnx
+    package when importable, else the in-tree codec."""
     try:
         import onnx  # noqa: F401
-        return onnx
+        from onnx import TensorProto, helper, numpy_helper
+        return helper, numpy_helper, TensorProto, onnx.save, onnx.load
     except ImportError:
-        raise MXNetError(
-            "ONNX interop requires the 'onnx' package, which is not "
-            "installed in this environment. Use HybridBlock.export() "
-            "(StableHLO + params) for the TPU-native deploy format.")
+        from . import _proto
+        return (_proto.helper, _proto.numpy_helper, _proto.TensorProto,
+                _proto.save, _proto.load)
 
 
-#: symbol-op -> (onnx op type, attr mapper)
-_OP_MAP = {
-    "FullyConnected": "Gemm",
-    "Convolution": "Conv",
-    "Activation": "Relu",  # refined by act_type
+_SIMPLE_MAP = {
     "flatten": "Flatten",
     "Flatten": "Flatten",
-    "Pooling": "MaxPool",
     "softmax": "Softmax",
     "SoftmaxOutput": "Softmax",
     "broadcast_add": "Add",
     "broadcast_sub": "Sub",
     "broadcast_mul": "Mul",
     "broadcast_div": "Div",
-    "concat": "Concat",
+    "elemwise_add": "Add",
     "relu": "Relu",
     "sigmoid": "Sigmoid",
     "tanh": "Tanh",
@@ -55,14 +60,81 @@ _ACT_MAP = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
             "softrelu": "Softplus"}
 
 
+def _export_node(node, helper, out_names):
+    """One symbol node -> ONNX NodeProto(s)."""
+    op = node._op
+    attrs_in = node._attrs
+    ins = [out_names[id(i)] for i in node._inputs if id(i) in out_names]
+    name = node._name
+    attrs = {}
+    if op == "Activation":
+        onnx_op = _ACT_MAP.get(attrs_in.get("act_type", "relu"), "Relu")
+    elif op in _SIMPLE_MAP:
+        onnx_op = _SIMPLE_MAP[op]
+        if op == "SoftmaxOutput":
+            ins = ins[:1]
+        if op in ("softmax", "SoftmaxOutput"):
+            attrs["axis"] = int(attrs_in.get("axis", -1))
+    elif op == "FullyConnected":
+        onnx_op = "Gemm"
+        attrs.update(alpha=1.0, beta=1.0, transA=0, transB=1)
+    elif op == "Convolution":
+        onnx_op = "Conv"
+        k = list(attrs_in.get("kernel", (1, 1)))
+        attrs["kernel_shape"] = k
+        attrs["strides"] = list(attrs_in.get("stride") or (1,) * len(k))
+        pad = list(attrs_in.get("pad") or (0,) * len(k))
+        attrs["pads"] = pad + pad        # onnx: begin then end per axis
+        attrs["dilations"] = list(attrs_in.get("dilate") or (1,) * len(k))
+        attrs["group"] = int(attrs_in.get("num_group", 1))
+    elif op == "Pooling":
+        ptype = attrs_in.get("pool_type", "max")
+        if attrs_in.get("global_pool"):
+            onnx_op = "GlobalAveragePool" if ptype == "avg" \
+                else "GlobalMaxPool"
+        else:
+            onnx_op = "AveragePool" if ptype == "avg" else "MaxPool"
+            k = list(attrs_in.get("kernel", (2, 2)))
+            attrs["kernel_shape"] = k
+            # in-tree Pooling defaults stride to 1 per dim (ops/nn.py), the
+            # same as the ONNX spec default — only record explicit strides
+            attrs["strides"] = list(attrs_in.get("stride") or (1,) * len(k))
+            pad = list(attrs_in.get("pad") or (0,) * len(k))
+            attrs["pads"] = pad + pad
+            if attrs_in.get("pooling_convention") == "full":
+                attrs["ceil_mode"] = 1
+    elif op == "BatchNorm":
+        onnx_op = "BatchNormalization"
+        attrs["epsilon"] = float(attrs_in.get("eps", 1e-5))
+        attrs["momentum"] = float(attrs_in.get("momentum", 0.9))
+        # symbol input order is (data, gamma, beta, moving_mean, moving_var)
+        # = onnx (X, scale, B, mean, var)
+    elif op == "Dropout":
+        onnx_op = "Dropout"
+        # inference graph: identity semantics; ratio recorded for fidelity
+        attrs["ratio"] = float(attrs_in.get("p", 0.5))
+    elif op in ("reshape", "Reshape"):
+        onnx_op = "Reshape"
+        # shape travels as an initializer input in opset>=5; appended later
+    elif op in ("transpose",):
+        onnx_op = "Transpose"
+        axes = attrs_in.get("axes")
+        if axes:
+            attrs["perm"] = list(axes)
+    elif op in ("concat", "Concat"):
+        onnx_op = "Concat"
+        attrs["axis"] = int(attrs_in.get("dim", attrs_in.get("axis", 1)))
+    else:
+        raise MXNetError(f"op {op!r} has no ONNX mapping yet")
+    return helper.make_node(onnx_op, ins, [name], name=name, **attrs), attrs_in
+
+
 def export_model(sym, params: Dict, input_shape: Sequence[Tuple[int, ...]],
                  input_type=onp.float32, onnx_file_path: str = "model.onnx",
                  verbose: bool = False, opset_version: Optional[int] = None):
     """Export a symbol + params dict to an ONNX file
-    (reference: mx.onnx.export_model)."""
-    onnx = _require_onnx()
-    from onnx import TensorProto, helper, numpy_helper
-
+    (reference: mx.onnx.export_model). Multi-output graphs via sym.Group."""
+    helper, numpy_helper, TensorProto, onnx_save, _ = _onnx_mods()
     from ..symbol import Symbol, _topo
 
     if not isinstance(sym, Symbol):
@@ -82,105 +154,151 @@ def export_model(sym, params: Dict, input_shape: Sequence[Tuple[int, ...]],
         a = arr.asnumpy() if hasattr(arr, "asnumpy") else onp.asarray(arr)
         inits.append(numpy_helper.from_array(a.astype(onp.float32), name))
 
-    out_names = {}
+    out_names: Dict[int, str] = {}
+    group_outputs: List = []
     for node in nodes:
         if node._op is None and node._base is None:
             out_names[id(node)] = node._name
             continue
-        op = node._op
-        if op not in _OP_MAP:
-            raise MXNetError(f"op {op!r} has no ONNX mapping yet")
-        onnx_op = _OP_MAP[op]
-        attrs = {}
-        if op == "Activation":
-            onnx_op = _ACT_MAP.get(node._attrs.get("act_type", "relu"), "Relu")
-        if op == "Pooling" and node._attrs.get("pool_type") == "avg":
-            onnx_op = "AveragePool"
-        if onnx_op in ("MaxPool", "AveragePool"):
-            attrs["kernel_shape"] = list(node._attrs.get("kernel", (2, 2)))
-            attrs["strides"] = list(node._attrs.get("stride", (1, 1)))
-        if onnx_op == "Conv":
-            attrs["kernel_shape"] = list(node._attrs.get("kernel", (1, 1)))
-            attrs["strides"] = list(node._attrs.get("stride", (1, 1)) or (1, 1))
-            attrs["pads"] = list(node._attrs.get("pad", (0, 0)) or (0, 0)) * 2
-        if onnx_op == "Gemm":
-            attrs.update(alpha=1.0, beta=1.0, transA=0, transB=1)
-        ins = [out_names[id(i)] for i in node._inputs
-               if id(i) in out_names]
-        if op == "SoftmaxOutput":
-            ins = ins[:1]
-        name = node._name
-        out_names[id(node)] = name
-        onnx_nodes.append(helper.make_node(onnx_op, ins, [name], name=name,
-                                           **attrs))
+        if node._op == "_group":
+            group_outputs = list(node._inputs)
+            continue
+        if node._base is not None:       # multi-output slice: same tensor
+            out_names[id(node)] = out_names[id(node._base)]
+            continue
+        pb_node, attrs_in = _export_node(node, helper, out_names)
+        if pb_node.op_type == "Reshape":
+            shape_name = node._name + "_shape"
+            inits.append(numpy_helper.from_array(
+                onp.asarray(attrs_in.get("shape", ()), onp.int64),
+                shape_name))
+            pb_node.input.append(shape_name)
+        out_names[id(node)] = node._name
+        onnx_nodes.append(pb_node)
 
-    out_shapes = sym.infer_shape(**{n: s for n, s in
-                                    zip(data_names, input_shape)})[1]
+    known = {n: s for n, s in zip(data_names, input_shape)}
+    out_shapes = sym.infer_shape(**known)[1]
+    outs = group_outputs if group_outputs else [nodes[-1]]
     outputs = [helper.make_tensor_value_info(
-        out_names[id(nodes[-1])], TensorProto.FLOAT, list(out_shapes[0]))]
+        out_names[id(o)], TensorProto.FLOAT,
+        list(s) if s is not None else None)
+        for o, s in zip(outs, out_shapes)]
     graph = helper.make_graph(onnx_nodes, "incubator_mxnet_tpu", inputs,
                               outputs, initializer=inits)
     model = helper.make_model(graph)
-    onnx.save(model, onnx_file_path)
+    onnx_save(model, onnx_file_path)
     return onnx_file_path
+
+
+#: onnx op -> symbol op for import
+_REV = {"Gemm": "FullyConnected", "Conv": "Convolution", "Relu": "relu",
+        "Sigmoid": "sigmoid", "Tanh": "tanh", "Softplus": "softrelu",
+        "Softmax": "softmax", "Add": "broadcast_add",
+        "Sub": "broadcast_sub", "Mul": "broadcast_mul",
+        "Div": "broadcast_div", "Flatten": "flatten",
+        "MaxPool": "Pooling", "AveragePool": "Pooling",
+        "GlobalMaxPool": "Pooling", "GlobalAveragePool": "Pooling",
+        "BatchNormalization": "BatchNorm", "Dropout": "Dropout",
+        "Reshape": "reshape", "Transpose": "transpose", "Concat": "concat"}
 
 
 def import_model(model_file: str):
     """Import an ONNX model into (sym, arg_params, aux_params)
-    (reference: mx.onnx.import_model). Supports the same op subset as
-    export."""
-    onnx = _require_onnx()
-    from onnx import numpy_helper
+    (reference: mx.onnx.import_model). Supports the export op subset;
+    multi-output graphs come back as a sym.Group."""
+    helper, numpy_helper, TensorProto, _, onnx_load = _onnx_mods()
     from .. import symbol as S
     from ..ndarray import array
 
-    model = onnx.load(model_file)
+    model = onnx_load(model_file)
     g = model.graph
-    params = {init.name: array(numpy_helper.to_array(init))
-              for init in g.initializer}
+    raw_params = {init.name: numpy_helper.to_array(init)
+                  for init in g.initializer}
     env: Dict[str, S.Symbol] = {}
     for vi in g.input:
-        if vi.name not in params:
+        if vi.name not in raw_params:
             env[vi.name] = S.Variable(vi.name)
-    for name in params:
+    for name in raw_params:
         env[name] = S.Variable(name)
-    _REV = {"Gemm": "FullyConnected", "Conv": "Convolution", "Relu": "relu",
-            "Sigmoid": "sigmoid", "Tanh": "tanh", "Softmax": "softmax",
-            "Add": "broadcast_add", "Sub": "broadcast_sub",
-            "Mul": "broadcast_mul", "Div": "broadcast_div",
-            "Flatten": "flatten", "MaxPool": "Pooling",
-            "AveragePool": "Pooling"}
+
+    shape_consts = {}                      # Reshape shape initializers
+    aux_names = set()
     for node in g.node:
         if node.op_type not in _REV:
             raise MXNetError(f"ONNX op {node.op_type!r} unsupported on import")
         op = _REV[node.op_type]
-        ins = [env[i] for i in node.input if i in env]
-        attrs = {a.name: onnx.helper.get_attribute_value(a)
+        attrs = {a.name: helper.get_attribute_value(a)
                  for a in node.attribute}
         kw = {}
+        ins_names = list(node.input)
+        if op == "reshape":
+            shape = raw_params.get(ins_names[1])
+            if shape is None:
+                raise MXNetError("Reshape without constant shape input")
+            shape_consts[ins_names[1]] = True
+            kw["shape"] = tuple(int(d) for d in shape)
+            ins_names = ins_names[:1]
         if op == "FullyConnected":
-            w = params.get(node.input[1])
+            w = raw_params.get(node.input[1])
             kw["num_hidden"] = int(w.shape[0]) if w is not None else 0
+            if int(attrs.get("transB", 0)) != 1:
+                raise MXNetError("Gemm import requires transB=1 "
+                                 "(weight as (out, in))")
         if op == "Convolution":
             kw["kernel"] = tuple(attrs.get("kernel_shape", (1, 1)))
             kw["stride"] = tuple(attrs.get("strides", (1, 1)))
             pads = attrs.get("pads", [0, 0, 0, 0])
-            kw["pad"] = tuple(pads[:2])
-            w = params.get(node.input[1])
+            kw["pad"] = tuple(pads[:len(pads) // 2])
+            kw["dilate"] = tuple(attrs.get("dilations",
+                                           (1,) * len(kw["kernel"])))
+            kw["num_group"] = int(attrs.get("group", 1))
+            w = raw_params.get(node.input[1])
             kw["num_filter"] = int(w.shape[0]) if w is not None else 0
         if op == "Pooling":
-            kw["pool_type"] = "avg" if node.op_type == "AveragePool" else "max"
-            kw["kernel"] = tuple(attrs.get("kernel_shape", (2, 2)))
-            kw["stride"] = tuple(attrs.get("strides", (1, 1)))
-        env[node.output[0]] = S.Symbol(op, ins, attrs=kw, name=node.name or None)
-    out = env[g.output[0].name] if g.output[0].name in env else \
-        env[g.node[-1].output[0]]
-    return out, params, {}
+            if node.op_type.startswith("Global"):
+                kw["global_pool"] = True
+                kw["pool_type"] = ("avg" if "Average" in node.op_type
+                                   else "max")
+                kw["kernel"] = (1, 1)
+            else:
+                kw["pool_type"] = ("avg" if node.op_type == "AveragePool"
+                                   else "max")
+                kw["kernel"] = tuple(attrs.get("kernel_shape", (2, 2)))
+                # ONNX spec: strides default to 1 along each axis
+                kw["stride"] = tuple(
+                    attrs.get("strides", (1,) * len(kw["kernel"])))
+                pads = attrs.get("pads", [0, 0, 0, 0])
+                kw["pad"] = tuple(pads[:len(pads) // 2])
+                if int(attrs.get("ceil_mode", 0)):
+                    kw["pooling_convention"] = "full"
+        if op == "BatchNorm":
+            kw["eps"] = float(attrs.get("epsilon", 1e-5))
+            kw["momentum"] = float(attrs.get("momentum", 0.9))
+            aux_names.update(node.input[3:5])
+        if op == "softmax":
+            kw["axis"] = int(attrs.get("axis", -1))
+        if op == "transpose" and "perm" in attrs:
+            kw["axes"] = tuple(attrs["perm"])
+        if op == "concat":
+            kw["dim"] = int(attrs.get("axis", 1))
+        if op == "Dropout":
+            kw["p"] = float(attrs.get("ratio", 0.5))
+        ins = [env[i] for i in ins_names if i in env]
+        out_sym = S.Symbol(op, ins, attrs=kw, name=node.name or None)
+        for out_name in node.output:
+            env[out_name] = out_sym
+    outs = [env[o.name] for o in g.output if o.name in env]
+    sym = outs[0] if len(outs) == 1 else S.Group(outs)
+    arg_params = {k: array(v) for k, v in raw_params.items()
+                  if k not in shape_consts and k not in aux_names}
+    aux_params = {k: array(raw_params[k]) for k in aux_names
+                  if k in raw_params}
+    return sym, arg_params, aux_params
 
 
 def get_model_metadata(model_file: str) -> Dict:
-    onnx = _require_onnx()
-    model = onnx.load(model_file)
+    helper, numpy_helper, TensorProto, _, onnx_load = _onnx_mods()
+    model = onnx_load(model_file)
     g = model.graph
     init_names = {i.name for i in g.initializer}
     return {

@@ -1,0 +1,448 @@
+"""Self-contained ONNX protobuf wire-format codec.
+
+The build environment has no ``onnx`` package (and no network), but ONNX
+files are ordinary protobuf — so this module implements the subset of the
+public ``onnx.proto`` schema the converter needs, directly on the protobuf
+wire format (varint + length-delimited fields). Files written here load in
+stock ``onnx``/onnxruntime, and vice versa for models made of the supported
+message subset. Field numbers follow the published onnx.proto (stable since
+IR version 3):
+
+- ModelProto:    ir_version=1, producer_name=2, graph=7, opset_import=8
+- GraphProto:    node=1, name=2, initializer=5, input=11, output=12
+- NodeProto:     input=1, output=2, name=3, op_type=4, attribute=5
+- AttributeProto: name=1, f=2, i=3, s=4, floats=7, ints=8, type=20
+- TensorProto:   dims=1, data_type=2, name=8, raw_data=9
+- ValueInfoProto: name=1, type=2 / TypeProto.tensor_type=1 /
+  Tensor.elem_type=1, shape=2 / TensorShapeProto.dim=1 / Dimension.dim_value=1
+- OperatorSetIdProto: domain=1, version=2
+"""
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as onp
+
+# ---------------------------------------------------------------------------
+# wire primitives
+# ---------------------------------------------------------------------------
+
+
+def _varint(n: int) -> bytes:
+    if n < 0:
+        n += 1 << 64
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tag(fieldno: int, wire: int) -> bytes:
+    return _varint((fieldno << 3) | wire)
+
+
+def _len_delim(fieldno: int, payload: bytes) -> bytes:
+    return _tag(fieldno, 2) + _varint(len(payload)) + payload
+
+
+def _vint_field(fieldno: int, value: int) -> bytes:
+    return _tag(fieldno, 0) + _varint(value)
+
+
+def _f32_field(fieldno: int, value: float) -> bytes:
+    return _tag(fieldno, 5) + struct.pack("<f", value)
+
+
+def _signed(v: int) -> int:
+    """Fold a decoded uint64 varint back to two's-complement int64 (protobuf
+    int64 wire form — negative attribute values like axis=-1)."""
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def _read_varint(buf: bytes, i: int) -> Tuple[int, int]:
+    shift = n = 0
+    while True:
+        b = buf[i]
+        i += 1
+        n |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return n, i
+        shift += 7
+
+
+def _fields(buf: bytes):
+    """Yield (fieldno, wire, value, ) over a serialized message."""
+    i = 0
+    while i < len(buf):
+        key, i = _read_varint(buf, i)
+        fieldno, wire = key >> 3, key & 7
+        if wire == 0:
+            v, i = _read_varint(buf, i)
+        elif wire == 2:
+            ln, i = _read_varint(buf, i)
+            v = buf[i:i + ln]
+            i += ln
+        elif wire == 5:
+            v = struct.unpack("<f", buf[i:i + 4])[0]
+            i += 4
+        elif wire == 1:
+            v = struct.unpack("<d", buf[i:i + 8])[0]
+            i += 8
+        else:  # pragma: no cover
+            raise ValueError(f"unsupported wire type {wire}")
+        yield fieldno, wire, v
+
+
+# ---------------------------------------------------------------------------
+# message classes (attribute-compatible with the real onnx package for the
+# fields the converter touches)
+# ---------------------------------------------------------------------------
+
+#: onnx.TensorProto.DataType values
+FLOAT, UINT8, INT8, INT32, INT64, BOOL = 1, 2, 3, 6, 7, 9
+_NP2ONNX = {onp.dtype("float32"): FLOAT, onp.dtype("uint8"): UINT8,
+            onp.dtype("int8"): INT8, onp.dtype("int32"): INT32,
+            onp.dtype("int64"): INT64, onp.dtype("bool"): BOOL}
+_ONNX2NP = {v: k for k, v in _NP2ONNX.items()}
+
+# AttributeProto.AttributeType
+ATTR_FLOAT, ATTR_INT, ATTR_STRING = 1, 2, 3
+ATTR_FLOATS, ATTR_INTS = 6, 7
+
+
+class TensorProto:
+    FLOAT, UINT8, INT8, INT32, INT64, BOOL = FLOAT, UINT8, INT8, INT32, \
+        INT64, BOOL
+
+    def __init__(self, name="", dims=(), data_type=FLOAT, raw_data=b""):
+        self.name = name
+        self.dims = list(dims)
+        self.data_type = data_type
+        self.raw_data = raw_data
+
+    def encode(self) -> bytes:
+        out = b"".join(_vint_field(1, d) for d in self.dims)
+        out += _vint_field(2, self.data_type)
+        out += _len_delim(8, self.name.encode())
+        out += _len_delim(9, self.raw_data)
+        return out
+
+    @classmethod
+    def decode(cls, buf: bytes) -> "TensorProto":
+        t = cls()
+        for fno, _, v in _fields(buf):
+            if fno == 1:
+                t.dims.append(v)
+            elif fno == 2:
+                t.data_type = v
+            elif fno == 8:
+                t.name = v.decode()
+            elif fno == 9:
+                t.raw_data = bytes(v)
+            elif fno == 4:   # float_data fallback (packed)
+                t.raw_data += bytes(v) if isinstance(v, (bytes, bytearray)) \
+                    else struct.pack("<f", v)
+        return t
+
+
+@dataclass
+class Dimension:
+    dim_value: int = 0
+
+
+@dataclass
+class TensorShape:
+    dim: List[Dimension] = field(default_factory=list)
+
+
+@dataclass
+class TensorTypeProto:
+    elem_type: int = FLOAT
+    shape: TensorShape = field(default_factory=TensorShape)
+
+
+@dataclass
+class TypeProto:
+    tensor_type: TensorTypeProto = field(default_factory=TensorTypeProto)
+
+
+class ValueInfoProto:
+    def __init__(self, name="", elem_type=FLOAT, shape=()):
+        self.name = name
+        self.type = TypeProto(TensorTypeProto(
+            elem_type, TensorShape([Dimension(int(d)) for d in shape])))
+
+    def encode(self) -> bytes:
+        tt = self.type.tensor_type
+        shape_pb = b"".join(
+            _len_delim(1, _vint_field(1, d.dim_value))
+            for d in tt.shape.dim)
+        tensor_pb = _vint_field(1, tt.elem_type) + _len_delim(2, shape_pb)
+        type_pb = _len_delim(1, tensor_pb)
+        return _len_delim(1, self.name.encode()) + _len_delim(2, type_pb)
+
+    @classmethod
+    def decode(cls, buf: bytes) -> "ValueInfoProto":
+        vi = cls()
+        for fno, _, v in _fields(buf):
+            if fno == 1:
+                vi.name = v.decode()
+            elif fno == 2:
+                for f2, _, v2 in _fields(v):
+                    if f2 == 1:  # tensor_type
+                        for f3, _, v3 in _fields(v2):
+                            if f3 == 1:
+                                vi.type.tensor_type.elem_type = v3
+                            elif f3 == 2:
+                                dims = []
+                                for f4, _, v4 in _fields(v3):
+                                    if f4 == 1:
+                                        dv = 0
+                                        for f5, _, v5 in _fields(v4):
+                                            if f5 == 1:
+                                                dv = v5
+                                        dims.append(Dimension(dv))
+                                vi.type.tensor_type.shape.dim = dims
+        return vi
+
+
+class AttributeProto:
+    def __init__(self, name="", type=ATTR_INT, i=0, f=0.0, s=b"",
+                 ints=(), floats=()):
+        self.name = name
+        self.type = type
+        self.i = i
+        self.f = f
+        self.s = s
+        self.ints = list(ints)
+        self.floats = list(floats)
+
+    def encode(self) -> bytes:
+        out = _len_delim(1, self.name.encode())
+        if self.type == ATTR_FLOAT:
+            out += _f32_field(2, self.f)
+        elif self.type == ATTR_INT:
+            out += _vint_field(3, self.i)
+        elif self.type == ATTR_STRING:
+            out += _len_delim(4, self.s)
+        elif self.type == ATTR_FLOATS:
+            for v in self.floats:
+                out += _f32_field(7, v)
+        elif self.type == ATTR_INTS:
+            for v in self.ints:
+                out += _vint_field(8, v)
+        out += _vint_field(20, self.type)
+        return out
+
+    @classmethod
+    def decode(cls, buf: bytes) -> "AttributeProto":
+        a = cls()
+        for fno, wire, v in _fields(buf):
+            if fno == 1:
+                a.name = v.decode()
+            elif fno == 2:
+                a.f = v
+            elif fno == 3:
+                a.i = _signed(v)
+            elif fno == 4:
+                a.s = bytes(v)
+            elif fno == 7:
+                a.floats.append(v)
+            elif fno == 8:
+                if wire == 2:  # packed
+                    i = 0
+                    while i < len(v):
+                        n, i = _read_varint(v, i)
+                        a.ints.append(_signed(n))
+                else:
+                    a.ints.append(_signed(v))
+            elif fno == 20:
+                a.type = v
+        return a
+
+
+class NodeProto:
+    def __init__(self, op_type="", inputs=(), outputs=(), name="",
+                 attribute=()):
+        self.op_type = op_type
+        self.input = list(inputs)
+        self.output = list(outputs)
+        self.name = name
+        self.attribute = list(attribute)
+
+    def encode(self) -> bytes:
+        out = b"".join(_len_delim(1, s.encode()) for s in self.input)
+        out += b"".join(_len_delim(2, s.encode()) for s in self.output)
+        out += _len_delim(3, self.name.encode())
+        out += _len_delim(4, self.op_type.encode())
+        out += b"".join(_len_delim(5, a.encode()) for a in self.attribute)
+        return out
+
+    @classmethod
+    def decode(cls, buf: bytes) -> "NodeProto":
+        n = cls()
+        for fno, _, v in _fields(buf):
+            if fno == 1:
+                n.input.append(v.decode())
+            elif fno == 2:
+                n.output.append(v.decode())
+            elif fno == 3:
+                n.name = v.decode()
+            elif fno == 4:
+                n.op_type = v.decode()
+            elif fno == 5:
+                n.attribute.append(AttributeProto.decode(v))
+        return n
+
+
+class GraphProto:
+    def __init__(self, nodes=(), name="", initializer=(), inputs=(),
+                 outputs=()):
+        self.node = list(nodes)
+        self.name = name
+        self.initializer = list(initializer)
+        self.input = list(inputs)
+        self.output = list(outputs)
+
+    def encode(self) -> bytes:
+        out = b"".join(_len_delim(1, n.encode()) for n in self.node)
+        out += _len_delim(2, self.name.encode())
+        out += b"".join(_len_delim(5, t.encode()) for t in self.initializer)
+        out += b"".join(_len_delim(11, vi.encode()) for vi in self.input)
+        out += b"".join(_len_delim(12, vi.encode()) for vi in self.output)
+        return out
+
+    @classmethod
+    def decode(cls, buf: bytes) -> "GraphProto":
+        g = cls()
+        for fno, _, v in _fields(buf):
+            if fno == 1:
+                g.node.append(NodeProto.decode(v))
+            elif fno == 2:
+                g.name = v.decode()
+            elif fno == 5:
+                g.initializer.append(TensorProto.decode(v))
+            elif fno == 11:
+                g.input.append(ValueInfoProto.decode(v))
+            elif fno == 12:
+                g.output.append(ValueInfoProto.decode(v))
+        return g
+
+
+class ModelProto:
+    def __init__(self, graph: Optional[GraphProto] = None,
+                 ir_version: int = 8, opset: int = 13,
+                 producer_name: str = "incubator_mxnet_tpu"):
+        self.graph = graph if graph is not None else GraphProto()
+        self.ir_version = ir_version
+        self.opset = opset
+        self.producer_name = producer_name
+
+    def encode(self) -> bytes:
+        opset_pb = _len_delim(1, b"") + _vint_field(2, self.opset)
+        return (_vint_field(1, self.ir_version)
+                + _len_delim(2, self.producer_name.encode())
+                + _len_delim(7, self.graph.encode())
+                + _len_delim(8, opset_pb))
+
+    @classmethod
+    def decode(cls, buf: bytes) -> "ModelProto":
+        m = cls()
+        for fno, _, v in _fields(buf):
+            if fno == 1:
+                m.ir_version = v
+            elif fno == 2:
+                m.producer_name = v.decode()
+            elif fno == 7:
+                m.graph = GraphProto.decode(v)
+            elif fno == 8:
+                for f2, _, v2 in _fields(v):
+                    if f2 == 2:
+                        m.opset = v2
+        return m
+
+
+# ---------------------------------------------------------------------------
+# onnx.helper / numpy_helper compatible surface used by the converter
+# ---------------------------------------------------------------------------
+
+class helper:  # noqa: N801 — mirrors the onnx.helper module name
+    @staticmethod
+    def make_tensor_value_info(name, elem_type, shape):
+        return ValueInfoProto(name, elem_type, shape or ())
+
+    @staticmethod
+    def make_node(op_type, inputs, outputs, name="", **attrs):
+        alist = []
+        for k, v in attrs.items():
+            if isinstance(v, bool):
+                alist.append(AttributeProto(k, ATTR_INT, i=int(v)))
+            elif isinstance(v, int):
+                alist.append(AttributeProto(k, ATTR_INT, i=v))
+            elif isinstance(v, float):
+                alist.append(AttributeProto(k, ATTR_FLOAT, f=v))
+            elif isinstance(v, str):
+                alist.append(AttributeProto(k, ATTR_STRING, s=v.encode()))
+            elif isinstance(v, (list, tuple)) and v and \
+                    isinstance(v[0], float):
+                alist.append(AttributeProto(k, ATTR_FLOATS, floats=v))
+            else:
+                alist.append(AttributeProto(
+                    k, ATTR_INTS, ints=[int(x) for x in v]))
+        return NodeProto(op_type, inputs, outputs, name, alist)
+
+    @staticmethod
+    def make_graph(nodes, name, inputs, outputs, initializer=()):
+        return GraphProto(nodes, name, initializer, inputs, outputs)
+
+    @staticmethod
+    def make_model(graph, **kw):
+        return ModelProto(graph)
+
+    @staticmethod
+    def get_attribute_value(a: AttributeProto):
+        if a.type == ATTR_FLOAT:
+            return a.f
+        if a.type == ATTR_INT:
+            return a.i
+        if a.type == ATTR_STRING:
+            return a.s
+        if a.type == ATTR_FLOATS:
+            return list(a.floats)
+        if a.type == ATTR_INTS:
+            return list(a.ints)
+        raise ValueError(f"unsupported attribute type {a.type}")
+
+
+class numpy_helper:  # noqa: N801
+    @staticmethod
+    def from_array(arr: onp.ndarray, name: str = "") -> TensorProto:
+        arr = onp.ascontiguousarray(arr)
+        if arr.dtype not in _NP2ONNX:
+            raise ValueError(f"unsupported dtype {arr.dtype}")
+        return TensorProto(name, arr.shape, _NP2ONNX[arr.dtype],
+                           arr.tobytes())
+
+    @staticmethod
+    def to_array(t: TensorProto) -> onp.ndarray:
+        dt = _ONNX2NP.get(t.data_type)
+        if dt is None:
+            raise ValueError(f"unsupported ONNX data_type {t.data_type}")
+        return onp.frombuffer(t.raw_data, dtype=dt).reshape(t.dims)
+
+
+def save(model: ModelProto, path: str) -> None:
+    with open(path, "wb") as f:
+        f.write(model.encode())
+
+
+def load(path: str) -> ModelProto:
+    with open(path, "rb") as f:
+        return ModelProto.decode(f.read())

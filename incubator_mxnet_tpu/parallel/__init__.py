@@ -22,6 +22,10 @@ Modules:
   reference's CachedOp forward/backward + kvstore push/pull + optimizer ops.
 - :mod:`ring` — ring attention over the ``sp`` axis (sequence/context
   parallelism; capability-parity-plus, SURVEY §5.7).
+- :mod:`pipeline` — GPipe-style microbatched schedule over the ``pp`` axis
+  (functional: autodiff derives the backward pipeline).
+- :mod:`moe` — expert-parallel mixture-of-experts dispatch over ``ep``
+  (all_to_all token exchange).
 """
 from .mesh import (  # noqa: F401
     MeshConfig, make_mesh, default_mesh, set_default_mesh, local_mesh,
@@ -37,3 +41,5 @@ from .collectives import (  # noqa: F401
 from .dist import initialize, finalize, process_count, process_index  # noqa: F401
 from .trainer import ShardedTrainer  # noqa: F401
 from .ring import ring_attention, ring_attention_sharded  # noqa: F401
+from .pipeline import pipeline_apply, pipeline_sharded  # noqa: F401
+from .moe import moe_dispatch, MoEFFN  # noqa: F401

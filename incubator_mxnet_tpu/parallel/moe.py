@@ -1,0 +1,110 @@
+"""Expert parallelism over the ``ep`` mesh axis — switch-style MoE dispatch.
+
+Reference counterpart: none (the reference predates MoE; SURVEY §2.5 lists
+``ep`` as a parity-plus extension). TPU-native design: top-1 (switch)
+routing with a fixed per-expert capacity so every shape is static; token
+exchange between expert shards is ONE ``lax.all_to_all`` over ``ep`` each
+way (the canonical MoE dispatch collective, riding ICI), expert FFNs run as
+a batched einsum over the local expert shard.
+
+Tokens beyond an expert's capacity are dropped (standard switch-transformer
+semantics) — their output contribution is zero, so the surrounding residual
+connection passes them through unchanged.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from .collectives import shard_map
+
+P = PartitionSpec
+
+__all__ = ["moe_dispatch", "moe_ffn", "moe_ffn_sharded", "MoEFFN"]
+
+
+def moe_dispatch(x, gate_logits, n_experts: int, capacity: int):
+    """Route each token to its top-1 expert within a fixed capacity.
+
+    x (T, C); gate_logits (T, E). Returns ``(dispatched (E, cap, C),
+    combine (T,), eidx (T,), pos (T,), keep (T,))`` where ``combine`` is the
+    router probability of the chosen expert, and (eidx, pos, keep) place
+    each kept token in the dispatch buffer.
+    """
+    T, C = x.shape
+    probs = jax.nn.softmax(gate_logits, axis=-1)
+    eidx = jnp.argmax(probs, axis=-1)                     # (T,)
+    combine = jnp.take_along_axis(probs, eidx[:, None], 1)[:, 0]
+    onehot = jax.nn.one_hot(eidx, n_experts, dtype=jnp.int32)   # (T, E)
+    pos = (jnp.cumsum(onehot, axis=0) - 1)                # rank within expert
+    pos = jnp.take_along_axis(pos, eidx[:, None], 1)[:, 0]
+    keep = pos < capacity
+    dispatched = jnp.zeros((n_experts, capacity, C), x.dtype)
+    safe_pos = jnp.where(keep, pos, capacity - 1)
+    contrib = jnp.where(keep[:, None], x, 0.0)
+    dispatched = dispatched.at[eidx, safe_pos].add(contrib)
+    return dispatched, combine, eidx, pos, keep
+
+
+def moe_ffn(params, x, gate_logits, capacity: int, axis: str = "ep"):
+    """Expert-parallel switch FFN; call INSIDE shard_map with ``axis`` bound.
+
+    ``params``: dict with ``w1 (E_local, H, C)``, ``b1 (E_local, H)``,
+    ``w2 (E_local, C, H)``, ``b2 (E_local, C)`` — the LOCAL expert shard.
+    ``x`` (T_local, C) local tokens; ``gate_logits`` (T_local, E_global).
+    Returns (T_local, C).
+    """
+    ep = lax.psum(1, axis)
+    e_local = params["w1"].shape[0]
+    E = ep * e_local
+    T, C = x.shape
+    dispatched, combine, eidx, pos, keep = moe_dispatch(
+        x, gate_logits, E, capacity)
+    # (E, cap, C) = (ep, e_local, cap, C): exchange the ep dim so each shard
+    # receives, from every peer, the tokens bound for ITS experts.
+    d = dispatched.reshape(ep, e_local, capacity, C)
+    d = lax.all_to_all(d, axis, split_axis=0, concat_axis=0, tiled=False)
+    # d: (ep_src, e_local, cap, C) — run local experts on all sources at once
+    h = jnp.einsum("sekc,ehc->sekh", d, params["w1"],
+                   preferred_element_type=jnp.float32)
+    h = jax.nn.relu(h + params["b1"][None, :, None, :])
+    y = jnp.einsum("sekh,ech->sekc", h.astype(d.dtype), params["w2"],
+                   preferred_element_type=jnp.float32).astype(d.dtype)
+    y = y + params["b2"][None, :, None, :]
+    # route results back to their source shards
+    y = lax.all_to_all(y, axis, split_axis=0, concat_axis=0, tiled=False)
+    y = y.reshape(E, capacity, C)
+    out = y[eidx, jnp.where(keep, pos, 0)]                # (T, C)
+    out = jnp.where(keep[:, None], out, 0.0)
+    return out * combine[:, None].astype(out.dtype)
+
+
+def moe_ffn_sharded(mesh: Mesh, params, x, gate_logits, capacity: int,
+                    axis: str = "ep"):
+    """Host-level entry: ``params`` leaves carry a global leading expert
+    axis sharded over ``axis``; tokens shard over ``axis`` too (each expert
+    shard is also a token shard — the standard MoE data layout)."""
+    pspec = {k: P(axis) for k in params}
+    xspec = P(axis)
+    fn = shard_map(
+        partial(moe_ffn, capacity=capacity, axis=axis),
+        mesh=mesh, in_specs=(pspec, xspec, xspec), out_specs=xspec)
+    params_s = {k: jax.device_put(v, NamedSharding(mesh, P(axis)))
+                for k, v in params.items()}
+    xs = jax.device_put(x, NamedSharding(mesh, xspec))
+    gs = jax.device_put(gate_logits, NamedSharding(mesh, xspec))
+    return jax.jit(fn)(params_s, xs, gs)
+
+
+class MoEFFN:
+    """Gluon-facing switch-FFN layer (built lazily to avoid importing gluon
+    at package import)."""
+
+    def __new__(cls, *args, **kwargs):
+        from .moe_block import MoEFFNBlock
+        return MoEFFNBlock(*args, **kwargs)

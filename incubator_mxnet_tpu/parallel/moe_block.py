@@ -1,0 +1,89 @@
+"""Gluon block wrapping the expert-parallel switch FFN (see moe.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..gluon.block import HybridBlock
+
+__all__ = ["MoEFFNBlock"]
+
+
+class MoEFFNBlock(HybridBlock):
+    """Switch-transformer FFN: router → top-1 expert → combine.
+
+    Parameters carry a leading expert axis; shard it over ``ep`` with rule
+    ``(r".*moe.*(w1|w2|b1|b2)", P("ep", ...))`` and the forward dispatches
+    with one all_to_all each way when tracing under a mesh with ep>1;
+    otherwise every expert runs locally (vmap-style einsum).
+    """
+
+    def __init__(self, num_experts: int, hidden: int, units: int,
+                 capacity_factor: float = 1.25, **kw):
+        super().__init__(**kw)
+        self._E = num_experts
+        self._cap_f = capacity_factor
+        with self.name_scope():
+            self.router = self.params.get("router", shape=(num_experts, units),
+                                          init="xavier")
+            self.w1 = self.params.get("w1", shape=(num_experts, hidden, units),
+                                      init="xavier")
+            self.b1 = self.params.get("b1", shape=(num_experts, hidden),
+                                      init="zeros")
+            self.w2 = self.params.get("w2", shape=(num_experts, units, hidden),
+                                      init="xavier")
+            self.b2 = self.params.get("b2", shape=(num_experts, units),
+                                      init="zeros")
+
+    def hybrid_forward(self, F, x, router=None, w1=None, b1=None, w2=None,
+                       b2=None):
+        from ..ndarray import NDArray
+        xv = x._data if isinstance(x, NDArray) else x
+        rv, w1v, b1v, w2v, b2v = (
+            p._data if isinstance(p, NDArray) else p
+            for p in (router, w1, b1, w2, b2))
+        B, L, C = xv.shape
+        T = B * L
+        tokens = xv.reshape(T, C)
+        gate = jnp.einsum("tc,ec->te", tokens.astype(jnp.float32),
+                          rv.astype(jnp.float32))
+        E = self._E
+        from .mesh import current_active_mesh
+        mesh = current_active_mesh()
+        use_ep = (mesh is not None and mesh.shape.get("ep", 1) > 1
+                  and isinstance(xv, jax.core.Tracer)
+                  and E % mesh.shape["ep"] == 0
+                  and T % mesh.shape["ep"] == 0)
+        if use_ep:
+            from functools import partial
+            from jax.sharding import PartitionSpec as P
+            from .collectives import shard_map
+            from .moe import moe_ffn
+            ep = mesh.shape["ep"]
+            cap = max(1, int(self._cap_f * (T // ep) / E))
+            pspec = {"w1": P("ep"), "b1": P("ep"), "w2": P("ep"),
+                     "b2": P("ep")}
+            fn = shard_map(partial(moe_ffn, capacity=cap, axis="ep"),
+                           mesh=mesh,
+                           in_specs=(pspec, P("ep"), P("ep")),
+                           out_specs=P("ep"))
+            out = fn({"w1": w1v, "b1": b1v, "w2": w2v, "b2": b2v},
+                     tokens, gate)
+        else:
+            # single-shard switch FFN: same routing semantics and the same
+            # capacity formula as the ep path (cap_f·T/E per expert) so the
+            # dispatch buffer stays O(cap_f·T·C), not O(E·T·C)
+            from .moe import moe_dispatch
+            cap = min(T, max(1, int(self._cap_f * T / E)))
+            d, combine, eidx, pos, keep = moe_dispatch(tokens, gate, E, cap)
+            h = jnp.einsum("ekc,ehc->ekh", d, w1v,
+                           preferred_element_type=jnp.float32)
+            h = jax.nn.relu(h + b1v[:, None, :])
+            y = jnp.einsum("ekh,ech->ekc", h.astype(d.dtype), w2v,
+                           preferred_element_type=jnp.float32).astype(d.dtype)
+            y = y + b2v[:, None, :]
+            out = y[eidx, jnp.where(keep, pos, 0)]
+            out = jnp.where(keep[:, None], out, 0.0)
+            out = out * combine[:, None].astype(y.dtype)
+        out = out.reshape(B, L, C)
+        return NDArray(out, ctx=x.context) if isinstance(x, NDArray) else out

@@ -1,0 +1,107 @@
+"""Pipeline parallelism over the ``pp`` mesh axis.
+
+Reference counterpart: none — the reference scales by data parallelism only
+(SURVEY §2.5 names pp as a parity-plus extension). TPU-native design: a
+GPipe-style microbatched schedule expressed FUNCTIONALLY — stage parameters
+carry a leading ``(n_stages, ...)`` axis sharded over ``pp``; inside
+``shard_map`` each device applies its stage and activations hop to the next
+stage with ``lax.ppermute`` (one ICI neighbour hop per tick). The schedule is
+a ``lax.scan`` over ``n_micro + n_stages - 1`` ticks, so reverse-mode
+autodiff derives the backward pipeline automatically (the transposed
+schedule) — no hand-written 1F1B state machine to maintain, which is the
+whole point of building on a functional IR.
+
+Bubble fraction is the GPipe ``(S-1)/(M+S-1)``; pick ``n_micro >= 4·S``.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from .collectives import shard_map
+
+P = PartitionSpec
+
+__all__ = ["pipeline_apply", "pipeline_sharded"]
+
+
+def pipeline_apply(stage_params, x, stage_fn: Callable, axis: str = "pp",
+                   n_micro: Optional[int] = None):
+    """Microbatched pipeline forward; call INSIDE shard_map with ``axis``
+    bound.
+
+    ``stage_params``: pytree whose leaves have a leading stage axis of LOCAL
+    size 1 (the ``pp`` shard of a ``(n_stages, ...)`` stack).
+    ``x``: (n_micro, mb, ...) microbatched input, replicated over ``axis``.
+    ``stage_fn(params, xmb) -> ymb``: one stage's computation on one
+    microbatch (input/output shapes must match — inter-stage activations
+    ride one fixed-shape buffer).
+
+    Returns (n_micro, mb, ...) outputs, replicated over ``axis`` (each tick
+    the last stage's finished microbatch enters a result buffer; the buffer
+    is psum-broadcast at the end).
+    """
+    n_stages = lax.psum(1, axis)
+    idx = lax.axis_index(axis)
+    local = jax.tree.map(lambda p: p[0], stage_params)
+    M = x.shape[0] if n_micro is None else n_micro
+    T = M + n_stages - 1
+    perm_fwd = [(i, i + 1) for i in range(n_stages - 1)]
+    buf0 = jnp.zeros_like(x[0])
+    ys0 = jnp.zeros_like(x)
+
+    def tick(carry, t):
+        buf, ys = carry
+        # stage 0 ingests microbatch t (clamped; beyond M the result is
+        # never written), later stages consume the hopped-in activation
+        xin = jnp.where(idx == 0, x[jnp.minimum(t, M - 1)], buf)
+        y = stage_fn(local, xin)
+        done = t - (n_stages - 1)
+        write = (idx == n_stages - 1) & (done >= 0)
+        ys = lax.cond(
+            write,
+            lambda ys: lax.dynamic_update_index_in_dim(
+                ys, y, jnp.maximum(done, 0), 0),
+            lambda ys: ys, ys)
+        buf = lax.ppermute(y, axis, perm_fwd)
+        return (buf, ys), None
+
+    (_, ys), _ = lax.scan(tick, (buf0, ys0), jnp.arange(T))
+    # broadcast the last stage's result buffer to every stage
+    ys = lax.psum(jnp.where(idx == n_stages - 1, ys, jnp.zeros_like(ys)),
+                  axis)
+    return ys
+
+
+def pipeline_sharded(mesh: Mesh, stage_params, x, stage_fn: Callable,
+                     n_micro: int, axis: str = "pp",
+                     batch_axis: Optional[str] = None):
+    """Host-level entry: ``stage_params`` leaves are global
+    ``(n_stages, ...)`` stacks (sharded over ``axis``); ``x`` is a global
+    (batch, ...) array, reshaped to (n_micro, batch/n_micro, ...).
+
+    The microbatch dim stays replicated over ``axis``; ``batch_axis`` (e.g.
+    ``"dp"``) additionally shards the within-microbatch batch dim, giving
+    dp×pp hybrid parallelism from one entry point."""
+    if x.shape[0] % n_micro:
+        raise ValueError(f"batch {x.shape[0]} not divisible by "
+                         f"n_micro {n_micro}")
+    xm = x.reshape((n_micro, x.shape[0] // n_micro) + x.shape[1:])
+    pspec = jax.tree.map(lambda _: P(axis), stage_params)
+    xspec = P(None, batch_axis)
+    fn = shard_map(
+        partial(pipeline_apply, stage_fn=stage_fn, axis=axis),
+        mesh=mesh,
+        in_specs=(pspec, xspec),
+        out_specs=xspec)
+    params_sharded = jax.tree.map(
+        lambda p: jax.device_put(p, NamedSharding(mesh, P(axis))),
+        stage_params)
+    xm = jax.device_put(xm, NamedSharding(mesh, xspec))
+    out = jax.jit(fn)(params_sharded, xm)
+    return out.reshape(x.shape[0:1] + out.shape[2:])

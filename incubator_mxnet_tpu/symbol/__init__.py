@@ -120,7 +120,16 @@ class Symbol:
         return [self._name + "_output"]
 
     def list_auxiliary_states(self) -> List[str]:
-        return []
+        out = []
+        for node in _topo(self):
+            slots = _AUX_SLOTS.get(node._op)
+            if not slots:
+                continue
+            for inp in node._inputs:
+                if inp._op is None and inp._base is None and \
+                        inp._name.endswith(slots):
+                    out.append(inp._name)
+        return out
 
     def infer_shape(self, **kwargs):
         """Shape inference: per-op jax.eval_shape walk (the nnvm InferShape
@@ -275,12 +284,24 @@ def _embed_shapes(dshape, attrs):
     return {"weight": (int(attrs["input_dim"]), int(attrs["output_dim"]))}
 
 
+def _bn_shapes(dshape, attrs):
+    c = int(dshape[1])
+    return {"gamma": (c,), "beta": (c,),
+            "moving_mean": (c,), "moving_var": (c,)}
+
+
 #: op -> (ordered param slot names, shape rule)
 _PARAM_OPS: Dict[str, tuple] = {
     "FullyConnected": (("weight", "bias"), _fc_shapes),
     "Convolution": (("weight", "bias"), _conv_shapes),
     "Embedding": (("weight",), _embed_shapes),
+    "BatchNorm": (("gamma", "beta", "moving_mean", "moving_var"),
+                  _bn_shapes),
 }
+
+#: param slots that are auxiliary states, not learnable arguments
+#: (reference: nnvm ListAuxiliaryStates — BatchNorm's running stats)
+_AUX_SLOTS = {"BatchNorm": ("moving_mean", "moving_var")}
 
 
 def _infer_graph_shapes(root: Symbol, known: Dict[str, tuple]):
@@ -291,7 +312,9 @@ def _infer_graph_shapes(root: Symbol, known: Dict[str, tuple]):
     f32 = jnp.float32
 
     def spec_of(node):
-        return env.get(id(node))
+        v = env.get(id(node))
+        # multi-output op consumed as a plain symbol -> primary output
+        return v[0] if isinstance(v, (tuple, list)) else v
 
     for node in _topo(root):
         if node._base is not None:
@@ -338,6 +361,13 @@ def _infer_graph_shapes(root: Symbol, known: Dict[str, tuple]):
     return shapes, out_specs
 
 
+def _primary(v):
+    """A multi-output op consumed as a plain symbol yields its primary
+    output (reference: nnvm default output 0 — e.g. BatchNorm's out, with
+    mean/var reachable only via explicit indexing/get_internals)."""
+    return v[0] if isinstance(v, (tuple, list)) else v
+
+
 def _compile_fn(root: Symbol, arg_names: List[str]):
     """Compose the DAG into one pure function of the argument arrays."""
 
@@ -355,9 +385,9 @@ def _compile_fn(root: Symbol, arg_names: List[str]):
                 env[id(node)] = name2val[node._name]
                 continue
             if node._op == "_group":
-                env[id(node)] = [env[id(i)] for i in node._inputs]
+                env[id(node)] = [_primary(env[id(i)]) for i in node._inputs]
                 continue
-            ins = [env[id(i)] for i in node._inputs]
+            ins = [_primary(env[id(i)]) for i in node._inputs]
             attrs = {k: v for k, v in node._attrs.items()
                      if not k.startswith("_")}
             if node._op in _SCALAR_OPS:

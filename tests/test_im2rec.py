@@ -1,0 +1,78 @@
+"""im2rec CLI tests (reference: tools/im2rec.py round trip through
+ImageRecordIter)."""
+import os
+
+import numpy as onp
+import pytest
+
+cv2 = pytest.importorskip("cv2")
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import recordio
+from tools.im2rec import make_list, make_record, read_list
+
+
+def _make_tree(root):
+    rng = onp.random.RandomState(0)
+    imgs = {}
+    for cls in ("cats", "dogs"):
+        d = os.path.join(root, cls)
+        os.makedirs(d)
+        for i in range(2):
+            img = (rng.rand(10, 12, 3) * 255).astype("uint8")
+            path = os.path.join(d, f"{i}.png")
+            cv2.imwrite(path, img)
+            imgs[os.path.join(cls, f"{i}.png")] = img
+    return imgs
+
+
+def test_list_and_pack_round_trip(tmp_path):
+    root = str(tmp_path / "data")
+    os.makedirs(root)
+    imgs = _make_tree(root)
+    prefix = str(tmp_path / "train")
+    (lst,) = make_list(prefix, root)
+    rows = list(read_list(lst))
+    assert len(rows) == 4
+    labels = {rel: lab for _, lab, rel in rows}
+    assert labels[os.path.join("cats", "0.png")] == 0.0
+    assert labels[os.path.join("dogs", "1.png")] == 1.0
+
+    rec_path, idx_path = make_record(prefix, root, img_fmt=".png",
+                                     quality=90)
+    rec = recordio.MXIndexedRecordIO(idx_path, rec_path, "r")
+    seen = 0
+    for idx, label, rel in rows:
+        header, img = recordio.unpack_img(rec.read_idx(idx))
+        assert header.label == label
+        onp.testing.assert_array_equal(img, imgs[rel])  # png is lossless
+        seen += 1
+    rec.close()
+    assert seen == 4
+
+
+def test_packed_rec_feeds_image_record_iter(tmp_path):
+    root = str(tmp_path / "data")
+    os.makedirs(root)
+    _make_tree(root)
+    prefix = str(tmp_path / "train")
+    make_list(prefix, root)
+    rec_path, idx_path = make_record(prefix, root, img_fmt=".png")
+    it = mx.io.ImageRecordIter(path_imgrec=rec_path, path_imgidx=idx_path,
+                               data_shape=(3, 10, 12), batch_size=2,
+                               shuffle=False)
+    batch = next(iter(it))
+    assert batch.data[0].shape == (2, 3, 10, 12)
+    assert batch.label[0].shape == (2,)
+
+
+def test_train_val_split(tmp_path):
+    root = str(tmp_path / "data")
+    os.makedirs(root)
+    _make_tree(root)
+    prefix = str(tmp_path / "split")
+    files = make_list(prefix, root, shuffle=True, train_ratio=0.5)
+    assert len(files) == 2
+    n_train = len(list(read_list(files[0])))
+    n_val = len(list(read_list(files[1])))
+    assert n_train == 2 and n_val == 2

@@ -1,0 +1,198 @@
+"""ONNX converter tests (reference: tests/python/unittest/onnx/) — round
+trips run on the in-tree protobuf codec (no onnx package in this image):
+structural round-trip of LeNet and a ResNet block, numeric equivalence by
+executing both symbol graphs, and wire-format self-consistency."""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu.onnx import (export_model, get_model_metadata,
+                                      import_model)
+
+
+def _bind_eval(sym, params, data, extra=None):
+    args = {"data": mx.nd.array(data)}
+    for k, v in params.items():
+        args[k] = v if isinstance(v, mx.NDArray) else mx.nd.array(v)
+    if extra:
+        args.update(extra)
+    ex = sym.bind(mx.cpu(), args)
+    return [o.asnumpy() for o in ex.forward(is_train=False)]
+
+
+def _lenet_sym():
+    d = mx.sym.Variable("data")
+    c1 = mx.sym.Convolution(d, num_filter=8, kernel=(3, 3), pad=(1, 1),
+                            name="conv1")
+    a1 = mx.sym.Activation(c1, act_type="relu", name="relu1")
+    p1 = mx.sym.Pooling(a1, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                        name="pool1")
+    f = mx.sym.flatten(p1, name="flat")
+    fc1 = mx.sym.FullyConnected(f, num_hidden=32, name="fc1")
+    a2 = mx.sym.Activation(fc1, act_type="relu", name="relu2")
+    fc2 = mx.sym.FullyConnected(a2, num_hidden=10, name="fc2")
+    return mx.sym.softmax(fc2, name="prob")
+
+
+def _lenet_params(rng):
+    return {
+        "conv1_weight": rng.randn(8, 1, 3, 3).astype("float32") * 0.2,
+        "conv1_bias": onp.zeros(8, "float32"),
+        "fc1_weight": rng.randn(32, 8 * 6 * 6).astype("float32") * 0.1,
+        "fc1_bias": onp.zeros(32, "float32"),
+        "fc2_weight": rng.randn(10, 32).astype("float32") * 0.1,
+        "fc2_bias": onp.zeros(10, "float32"),
+    }
+
+
+def test_lenet_round_trip(tmp_path):
+    rng = onp.random.RandomState(0)
+    sym = _lenet_sym()
+    params = _lenet_params(rng)
+    x = rng.rand(2, 1, 12, 12).astype("float32")
+    want = _bind_eval(sym, params, x)
+
+    f = str(tmp_path / "lenet.onnx")
+    export_model(sym, {k: mx.nd.array(v) for k, v in params.items()},
+                 [(2, 1, 12, 12)], onnx_file_path=f)
+    sym2, arg2, aux2 = import_model(f)
+    got = _bind_eval(sym2, arg2, x)
+    onp.testing.assert_allclose(got[0], want[0], rtol=1e-5, atol=1e-6)
+    # structural: same op multiset up to activation/flatten aliasing
+    from incubator_mxnet_tpu.symbol import _topo
+    norm = {"Activation": "relu", "Flatten": "flatten",
+            "SoftmaxOutput": "softmax"}
+    ops = sorted(norm.get(n._op, n._op) for n in _topo(sym) if n._op)
+    ops2 = sorted(norm.get(n._op, n._op) for n in _topo(sym2) if n._op)
+    assert ops == ops2
+
+
+def test_resnet_block_round_trip(tmp_path):
+    """Conv-BN-relu ×2 with identity skip — the model-zoo residual unit."""
+    rng = onp.random.RandomState(1)
+    d = mx.sym.Variable("data")
+    c1 = mx.sym.Convolution(d, num_filter=4, kernel=(3, 3), pad=(1, 1),
+                            no_bias=True, name="c1")
+    b1 = mx.sym.BatchNorm(c1, name="bn1")
+    a1 = mx.sym.Activation(b1, act_type="relu", name="r1")
+    c2 = mx.sym.Convolution(a1, num_filter=4, kernel=(3, 3), pad=(1, 1),
+                            no_bias=True, name="c2")
+    b2 = mx.sym.BatchNorm(c2, name="bn2")
+    out = mx.sym.Activation(mx.sym.broadcast_add(b2, d), act_type="relu",
+                            name="out")
+
+    params = {
+        "c1_weight": rng.randn(4, 4, 3, 3).astype("float32") * 0.2,
+        "bn1_gamma": onp.ones(4, "float32"),
+        "bn1_beta": onp.zeros(4, "float32"),
+        "c2_weight": rng.randn(4, 4, 3, 3).astype("float32") * 0.2,
+        "bn2_gamma": onp.ones(4, "float32"),
+        "bn2_beta": onp.zeros(4, "float32"),
+    }
+    aux = {
+        "bn1_moving_mean": onp.zeros(4, "float32"),
+        "bn1_moving_var": onp.ones(4, "float32"),
+        "bn2_moving_mean": onp.zeros(4, "float32"),
+        "bn2_moving_var": onp.ones(4, "float32"),
+    }
+    x = rng.randn(2, 4, 8, 8).astype("float32")
+    want = _bind_eval(out, {**params, **aux}, x)
+
+    f = str(tmp_path / "resblock.onnx")
+    export_model(out, {k: mx.nd.array(v) for k, v in {**params, **aux}.items()},
+                 [(2, 4, 8, 8)], onnx_file_path=f)
+    sym2, arg2, aux2 = import_model(f)
+    assert set(aux2) == set(aux)          # moving stats land in aux_params
+    got = _bind_eval(sym2, {**arg2, **aux2}, x)
+    onp.testing.assert_allclose(got[0], want[0], rtol=1e-4, atol=1e-5)
+
+
+def test_reshape_transpose_concat_dropout_round_trip(tmp_path):
+    rng = onp.random.RandomState(2)
+    d = mx.sym.Variable("data")
+    r = mx.sym.reshape(d, shape=(2, 8, 4), name="rs")
+    t = mx.sym.transpose(r, axes=(0, 2, 1), name="tp")
+    cat = mx.sym.concat(t, t, dim=1, name="cat")
+    dr = mx.sym.Dropout(cat, p=0.5, name="drop")   # identity at inference
+    x = rng.randn(2, 32).astype("float32")
+    want = _bind_eval(dr, {}, x)
+    f = str(tmp_path / "rtc.onnx")
+    export_model(dr, {}, [(2, 32)], onnx_file_path=f)
+    sym2, arg2, _ = import_model(f)
+    got = _bind_eval(sym2, arg2, x)
+    onp.testing.assert_allclose(got[0], want[0], rtol=1e-6)
+
+
+def test_multi_output_group_round_trip(tmp_path):
+    rng = onp.random.RandomState(3)
+    d = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(d, num_hidden=6, name="fc")
+    g = mx.sym.Group([mx.sym.softmax(fc, name="prob"),
+                      mx.sym.relu(fc, name="feat")])
+    params = {"fc_weight": rng.randn(6, 5).astype("float32"),
+              "fc_bias": onp.zeros(6, "float32")}
+    x = rng.randn(3, 5).astype("float32")
+    want = _bind_eval(g, params, x)
+    f = str(tmp_path / "multi.onnx")
+    export_model(g, {k: mx.nd.array(v) for k, v in params.items()},
+                 [(3, 5)], onnx_file_path=f)
+    sym2, arg2, _ = import_model(f)
+    got = _bind_eval(sym2, arg2, x)
+    assert len(got) == 2
+    for a, b in zip(got, want):
+        onp.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_metadata(tmp_path):
+    rng = onp.random.RandomState(4)
+    d = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(d, num_hidden=3, name="fcm")
+    f = str(tmp_path / "meta.onnx")
+    export_model(fc, {"fcm_weight": mx.nd.array(rng.randn(3, 4).astype("f")),
+                      "fcm_bias": mx.nd.zeros((3,))},
+                 [(2, 4)], onnx_file_path=f)
+    meta = get_model_metadata(f)
+    assert meta["input_tensor_data"] == [("data", (2, 4))]
+    assert len(meta["output_tensor_data"]) == 1
+
+
+def test_wire_format_codec_round_trip():
+    """The in-tree protobuf codec reproduces its own messages byte-exactly
+    through encode→decode→encode."""
+    from incubator_mxnet_tpu.onnx import _proto as P
+    t = P.numpy_helper.from_array(
+        onp.arange(6, dtype="float32").reshape(2, 3), "w")
+    node = P.helper.make_node("Conv", ["x", "w"], ["y"], name="n0",
+                              kernel_shape=[3, 3], strides=[1, 1],
+                              alpha=1.5, mode="constant")
+    gi = P.helper.make_tensor_value_info("x", P.TensorProto.FLOAT, [2, 3])
+    go = P.helper.make_tensor_value_info("y", P.TensorProto.FLOAT, [2, 3])
+    g = P.helper.make_graph([node], "g", [gi], [go], initializer=[t])
+    m = P.helper.make_model(g)
+    blob = m.encode()
+    m2 = P.ModelProto.decode(blob)
+    assert m2.encode() == blob
+    assert m2.graph.node[0].op_type == "Conv"
+    onp.testing.assert_array_equal(
+        P.numpy_helper.to_array(m2.graph.initializer[0]),
+        onp.arange(6, dtype="float32").reshape(2, 3))
+    attrs = {a.name: P.helper.get_attribute_value(a)
+             for a in m2.graph.node[0].attribute}
+    assert attrs["kernel_shape"] == [3, 3]
+    assert attrs["alpha"] == 1.5
+    assert attrs["mode"] == b"constant"
+
+
+def test_negative_axis_and_dropout_ratio_round_trip(tmp_path):
+    """Wire-format regression: negative int attributes (softmax axis=-1)
+    must decode signed; Dropout must keep its ratio."""
+    d = mx.sym.Variable("data")
+    sm = mx.sym.softmax(d, axis=-1, name="sm")
+    dr = mx.sym.Dropout(sm, p=0.2, name="dr")
+    f = str(tmp_path / "neg.onnx")
+    export_model(dr, {}, [(2, 6)], onnx_file_path=f)
+    sym2, _, _ = import_model(f)
+    from incubator_mxnet_tpu.symbol import _topo
+    attrs = {n._op: n._attrs for n in _topo(sym2) if n._op}
+    assert attrs["softmax"].get("axis") == -1
+    assert attrs["Dropout"].get("p") == pytest.approx(0.2)

@@ -1,0 +1,42 @@
+"""opperf microbenchmark suite sanity (reference: benchmark/opperf/)."""
+import json
+import subprocess
+import sys
+import os
+
+import incubator_mxnet_tpu  # noqa: F401  (repo on path)
+from benchmark.opperf import run, run_performance_test, op_configs
+
+import numpy as onp
+
+
+def test_run_subset():
+    rows = run(["broadcast_add", "sqrt"], iters=2)
+    assert len(rows) == 2
+    for r in rows:
+        assert "error" not in r, r
+        assert r["fwd_ms"] > 0
+        assert r["bwd_ms"] > 0
+        assert "gflops" in r
+
+
+def test_every_config_entry_is_well_formed():
+    cfg = op_configs()
+    from incubator_mxnet_tpu.ops.registry import OPS
+    for name, cases in cfg.items():
+        assert name in OPS, f"config references unregistered op {name}"
+        for case, builder, flops in cases:
+            args, kwargs = builder()
+            assert isinstance(kwargs, dict)
+
+
+def test_run_performance_test_api():
+    row = run_performance_test(
+        "sqrt", {"data": onp.abs(onp.random.randn(64, 64)).astype("float32")},
+        iters=2)
+    assert row["op"] == "sqrt" and row["fwd_ms"] > 0
+
+
+def test_unknown_op_reports_error_row():
+    rows = run(["definitely_not_an_op"], iters=1)
+    assert rows[0]["error"] == "no benchmark config"

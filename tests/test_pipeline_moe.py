@@ -1,0 +1,175 @@
+"""Pipeline (pp) and expert (ep) parallelism tests — VERDICT r2 #7: the
+advertised mesh axes must have real machinery behind them, correctness-tested
+against single-device execution."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon, nd, parallel
+from incubator_mxnet_tpu.parallel.collectives import shard_map
+from incubator_mxnet_tpu.parallel.pipeline import (pipeline_apply,
+                                                   pipeline_sharded)
+from incubator_mxnet_tpu.parallel.moe import moe_ffn_sharded
+
+
+def _mlp_stage(p, x):
+    return jnp.tanh(x @ p["w"] + p["b"])
+
+
+def _stage_params(n_stages, d, rng):
+    return {"w": rng.randn(n_stages, d, d).astype("float32") * 0.3,
+            "b": rng.randn(n_stages, d).astype("float32") * 0.1}
+
+
+def test_pipeline_matches_sequential():
+    rng = onp.random.RandomState(0)
+    S, d, B, M = 4, 8, 16, 4
+    params = _stage_params(S, d, rng)
+    x = rng.randn(B, d).astype("float32")
+    mesh = parallel.make_mesh(pp=4, dp=1, devices=jax.devices()[:4])
+    got = pipeline_sharded(mesh, params, x, _mlp_stage, n_micro=M)
+    want = x
+    for s in range(S):
+        want = onp.tanh(want @ params["w"][s] + params["b"][s])
+    onp.testing.assert_allclose(onp.asarray(got), want, rtol=1e-5, atol=1e-5)
+
+
+def test_pipeline_with_dp_axis():
+    rng = onp.random.RandomState(1)
+    S, d, B, M = 2, 8, 16, 4
+    params = _stage_params(S, d, rng)
+    x = rng.randn(B, d).astype("float32")
+    mesh = parallel.make_mesh(pp=2, dp=4)
+    got = pipeline_sharded(mesh, params, x, _mlp_stage, n_micro=M,
+                           batch_axis="dp")
+    want = x
+    for s in range(S):
+        want = onp.tanh(want @ params["w"][s] + params["b"][s])
+    onp.testing.assert_allclose(onp.asarray(got), want, rtol=1e-5, atol=1e-5)
+
+
+def test_pipeline_grads_match_sequential():
+    """Autodiff through the schedule = the backward pipeline."""
+    rng = onp.random.RandomState(2)
+    S, d, B, M = 2, 6, 8, 4
+    params = _stage_params(S, d, rng)
+    x = rng.randn(B, d).astype("float32")
+    mesh = parallel.make_mesh(pp=2, dp=1, devices=jax.devices()[:2])
+    pspec = {"w": P("pp"), "b": P("pp")}
+    xspec = P(None, None)
+    fn = shard_map(partial(pipeline_apply, stage_fn=_mlp_stage, axis="pp"),
+                   mesh=mesh, in_specs=(pspec, xspec), out_specs=xspec)
+
+    def loss_pipe(params, xm):
+        return (fn(params, xm) ** 2).sum()
+
+    def loss_seq(params, xm):
+        h = xm.reshape(B, d)
+        for s in range(S):
+            h = jnp.tanh(h @ params["w"][s] + params["b"][s])
+        return (h ** 2).sum()
+
+    xm = x.reshape(M, B // M, d)
+    g_pipe = jax.jit(jax.grad(loss_pipe))(params, jnp.asarray(xm))
+    g_seq = jax.grad(loss_seq)(params, jnp.asarray(xm))
+    for k in params:
+        onp.testing.assert_allclose(onp.asarray(g_pipe[k]),
+                                    onp.asarray(g_seq[k]),
+                                    rtol=1e-4, atol=1e-5)
+
+
+def test_stacked_encoder_pp_matches_single_device():
+    """VERDICT #7 done-criterion: a training step whose encoder runs the
+    microbatched pp=2 pipeline equals the single-device step."""
+    from incubator_mxnet_tpu.models import StackedTransformerEncoder
+    from incubator_mxnet_tpu.parallel.sharding import ShardingRules
+    rng = onp.random.RandomState(3)
+    x = rng.randn(8, 12, 16).astype("float32")
+    y = rng.randn(8, 12, 16).astype("float32")
+    loss_fn = gluon.loss.L2Loss()
+
+    def run(mesh, rules=None):
+        mx.random.seed(5)
+        enc = StackedTransformerEncoder(num_layers=4, units=16,
+                                        hidden_size=32, num_heads=2,
+                                        n_micro=4)
+        enc.initialize()
+        tr = parallel.ShardedTrainer(enc, lambda o, t: loss_fn(o, t).mean(),
+                                     "sgd", {"learning_rate": 0.05},
+                                     mesh=mesh, rules=rules, n_labels=1)
+        return [float(tr.step(x, y).asnumpy()) for _ in range(3)]
+
+    single = run(parallel.make_mesh(devices=jax.devices()[:1]))
+    rules = ShardingRules([(r".*", P("pp"))])   # stack axis over pp
+    piped = run(parallel.make_mesh(pp=2, dp=2, sp=1, tp=1,
+                                   devices=jax.devices()[:4]), rules)
+    onp.testing.assert_allclose(piped, single, rtol=2e-4, atol=2e-5)
+
+
+def test_moe_ffn_sharded_matches_dense_routing():
+    rng = onp.random.RandomState(4)
+    E, T, C, H = 4, 32, 8, 16
+    params = {"w1": rng.randn(E, H, C).astype("float32") * 0.3,
+              "b1": rng.randn(E, H).astype("float32") * 0.1,
+              "w2": rng.randn(E, C, H).astype("float32") * 0.3,
+              "b2": rng.randn(E, C).astype("float32") * 0.1}
+    x = rng.randn(T, C).astype("float32")
+    gate = rng.randn(T, E).astype("float32")
+    mesh = parallel.make_mesh(ep=2, dp=1, devices=jax.devices()[:2])
+    # capacity high enough that nothing drops -> exact match with dense
+    got = onp.asarray(moe_ffn_sharded(mesh, params, x, gate, capacity=T))
+    probs = onp.exp(gate) / onp.exp(gate).sum(-1, keepdims=True)
+    eidx = probs.argmax(-1)
+    want = onp.zeros_like(x)
+    for t in range(T):
+        e = eidx[t]
+        h = onp.maximum(x[t] @ params["w1"][e].T + params["b1"][e], 0)
+        want[t] = (h @ params["w2"][e].T + params["b2"][e]) * probs[t, e]
+    onp.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_moe_block_ep_matches_local():
+    """An ep=2 training step over the MoE block equals single-device."""
+    from incubator_mxnet_tpu.parallel.sharding import ShardingRules
+    rng = onp.random.RandomState(5)
+    x = rng.randn(2, 8, 8).astype("float32")
+    y = rng.randn(2, 8, 8).astype("float32")
+    loss_fn = gluon.loss.L2Loss()
+
+    def run(mesh, rules=None):
+        mx.random.seed(6)
+        blk = parallel.MoEFFN(num_experts=4, hidden=16, units=8,
+                              capacity_factor=100.0)  # no drops
+        blk.initialize()
+        tr = parallel.ShardedTrainer(blk, lambda o, t: loss_fn(o, t).mean(),
+                                     "sgd", {"learning_rate": 0.05},
+                                     mesh=mesh, rules=rules, n_labels=1)
+        return [float(tr.step(x, y).asnumpy()) for _ in range(2)]
+
+    single = run(parallel.make_mesh(devices=jax.devices()[:1]))
+    rules = ShardingRules([(r".*(w1|w2|b1|b2|router)", P("ep"))])
+    shard = run(parallel.make_mesh(ep=2, dp=1, devices=jax.devices()[:2]),
+                rules)
+    onp.testing.assert_allclose(shard, single, rtol=1e-4, atol=1e-5)
+
+
+def test_moe_capacity_drops_are_zero_contribution():
+    rng = onp.random.RandomState(6)
+    E, T, C, H = 2, 8, 4, 8
+    params = {"w1": rng.randn(E, H, C).astype("float32"),
+              "b1": onp.zeros((E, H), "float32"),
+              "w2": rng.randn(E, C, H).astype("float32"),
+              "b2": onp.zeros((E, C), "float32")}
+    x = rng.randn(T, C).astype("float32")
+    gate = onp.zeros((T, E), "float32")
+    gate[:, 0] = 10.0                            # everyone wants expert 0
+    mesh = parallel.make_mesh(ep=2, dp=1, devices=jax.devices()[:2])
+    out = onp.asarray(moe_ffn_sharded(mesh, params, x, gate, capacity=1))
+    # per token-shard of 4, only 1 fits; the rest must be exactly zero
+    nz_rows = (onp.abs(out) > 1e-9).any(-1).sum()
+    assert nz_rows == 2, nz_rows                 # one per shard

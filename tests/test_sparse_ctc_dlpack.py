@@ -222,3 +222,21 @@ def test_stale_grad_ignored_skips_update():
     trainer.step(2, ignore_stale_grad=True)
     # a moved, b (never used) did not
     onp.testing.assert_array_equal(net.b.weight.data().asnumpy(), b_before)
+
+
+def test_eager_backward_uses_stored_pullbacks():
+    """backward() must replay only the reverse computation — every tape
+    node carries the pullback captured at forward time (reference parity:
+    imperative backward reuses stored activations, it does not re-run the
+    forward graph)."""
+    from incubator_mxnet_tpu.autograd import _STATE
+    x = nd.array(onp.array([1.0, 2.0, 3.0], "float32"))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.exp(x * 2.0).sum()
+        assert all(n.vjp_fn is not None for n in _STATE.tape), \
+            "tape node recorded without a forward-time pullback"
+    y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(),
+                                2.0 * onp.exp(2.0 * onp.array([1, 2, 3.0])),
+                                rtol=1e-5)
