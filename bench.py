@@ -39,7 +39,36 @@ def _peak_tflops() -> float:
     return 459.0
 
 
+def _arm_watchdog() -> None:
+    """Fail loudly instead of hanging forever if the TPU tunnel is wedged
+    (device init blocks indefinitely when the pool grant is stuck).
+    MXTPU_BENCH_TIMEOUT seconds, default 1500; 0 disables.
+
+    Uses a daemon timer + os._exit: a Python signal handler could never run
+    while the main thread is blocked inside the C++ device-init call (the
+    exact hang being guarded against).
+    """
+    import threading
+
+    budget = int(os.environ.get("MXTPU_BENCH_TIMEOUT", "1500"))
+    if budget <= 0:
+        return
+
+    def _fire():
+        import sys
+        sys.stderr.write(
+            f"bench.py watchdog: no result within {budget}s — the TPU "
+            "tunnel/device init is likely wedged; aborting.\n")
+        sys.stderr.flush()
+        os._exit(75)  # EX_TEMPFAIL
+
+    t = threading.Timer(budget, _fire)
+    t.daemon = True
+    t.start()
+
+
 def main() -> None:
+    _arm_watchdog()
     import jax
     import incubator_mxnet_tpu as mx
     from incubator_mxnet_tpu import models, parallel

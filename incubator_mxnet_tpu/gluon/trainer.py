@@ -78,6 +78,9 @@ class Trainer:
             from .. import kvstore as kv
             self._kvstore = kv.create(self._kvstore_type)
         if self._kvstore is not None:
+            if self._compression_params:
+                self._kvstore.set_gradient_compression(
+                    self._compression_params)
             for i, param in enumerate(self._params):
                 if param._data is not None:
                     self._kvstore.init(i, param._check_and_get(param._data, None))

@@ -98,3 +98,52 @@ def test_trainer_with_explicit_kvstore():
     with mx.autograd.record():
         l = loss_fn(net(x), y).mean()
     assert float(l.asnumpy()) < l0
+
+
+def test_gradient_compression_2bit_quantizes():
+    """2-bit compression: pushed values quantize to {-t, 0, +t}
+    (reference: TwoBitCompressor)."""
+    kv = mx.kv.create("nccl")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.init(0, mx.nd.zeros((4,)))
+    kv.push(0, mx.nd.array(onp.array([0.7, -0.9, 0.1, 0.0], "float32")))
+    out = kv.pull(0).asnumpy()
+    onp.testing.assert_allclose(out, [0.5, -0.5, 0.0, 0.0])
+
+
+def test_gradient_compression_error_feedback():
+    """Sub-threshold gradients are NOT lost — the residual carries them into
+    later pushes until they cross the threshold."""
+    kv = mx.kv.create("nccl")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.init("g", mx.nd.zeros((1,)))
+    total = 0.0
+    for _ in range(5):
+        kv.push("g", mx.nd.array(onp.array([0.2], "float32")))
+        total += float(kv.pull("g").asnumpy()[0])
+    # 5 x 0.2 = 1.0 of signal; quantized stream must deliver ~1.0 total
+    assert abs(total - 1.0) <= 0.5 + 1e-6, total
+
+
+def test_gradient_compression_rejects_unknown():
+    kv = mx.kv.create("nccl")
+    with pytest.raises(mx.MXNetError):
+        kv.set_gradient_compression({"type": "fancy"})
+
+
+def test_gradient_compression_requires_type_key():
+    kv = mx.kv.create("nccl")
+    with pytest.raises(mx.MXNetError):
+        kv.set_gradient_compression({"threshold": 0.25})
+
+
+def test_trainer_forwards_compression_params():
+    """The reference Trainer(..., compression_params=...) seam must reach
+    the kvstore (regression: stored but never applied)."""
+    net = gluon.nn.Dense(1, in_units=2)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1},
+                       kvstore="dist_sync",
+                       compression_params={"type": "2bit", "threshold": 0.5})
+    tr._init_kvstore()
+    assert tr._kvstore._compression.get("type") == "2bit"
