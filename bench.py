@@ -65,10 +65,11 @@ def _arm_watchdog() -> None:
     t = threading.Timer(budget, _fire)
     t.daemon = True
     t.start()
+    return t
 
 
 def main() -> None:
-    _arm_watchdog()
+    watchdog = _arm_watchdog()
     import jax
     import incubator_mxnet_tpu as mx
     from incubator_mxnet_tpu import models, parallel
@@ -104,6 +105,8 @@ def main() -> None:
     batch = (ids, tt, vl, pos, mlm_lab, mlm_w, nsp)
 
     trainer.step(*batch).asnumpy()  # init + compile
+    if watchdog is not None:
+        watchdog.cancel()           # device is alive; don't cap a long sweep
     batch = trainer.place(*batch)   # resident inputs: steady-state loop
     trainer.step(*batch).asnumpy()  # warm
     t0 = time.perf_counter()

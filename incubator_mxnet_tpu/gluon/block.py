@@ -655,10 +655,45 @@ class HybridBlock(Block):
         return sym_file, params_file
 
     def optimize_for(self, x, *args, backend=None, **kwargs):
-        """Subgraph-backend parity stub: XLA is the only backend; equivalent
-        to hybridize + one warmup call."""
+        """Apply a subgraph backend, then compile (reference:
+        HybridBlock.optimize_for over the subgraph property registry,
+        src/operator/subgraph/). Backends are registered block-rewrite
+        passes (``gluon.block.register_subgraph_backend``); XLA fusion
+        itself needs no pass, so ``backend=None``/"XLA" is hybridize + one
+        warm-up call. The built-in ``"INT8"`` backend runs the quantization
+        layer-swap pass (the quantize_graph_pass counterpart) using ``x``
+        (+ ``calib_data=[...]`` kwarg batches) for calibration."""
+        if backend not in (None, "XLA", "xla"):
+            if backend not in _SUBGRAPH_BACKENDS:
+                raise MXNetError(
+                    f"unknown subgraph backend {backend!r}; registered: "
+                    f"{sorted(_SUBGRAPH_BACKENDS)} (register with "
+                    "gluon.block.register_subgraph_backend)")
+            _SUBGRAPH_BACKENDS[backend](self, x, *args, **kwargs)
         self.hybridize()
         return self(x, *args)
+
+
+#: subgraph-backend registry (reference: SubgraphBackendRegistry)
+_SUBGRAPH_BACKENDS: Dict[str, Callable] = {}
+
+
+def register_subgraph_backend(name: str, fn: Optional[Callable] = None):
+    """Register a block-rewrite pass: ``fn(block, x, *args, **kwargs)``
+    mutates the block tree in place before compilation. Usable as a
+    decorator."""
+    def _do(f):
+        _SUBGRAPH_BACKENDS[name] = f
+        return f
+    return _do(fn) if fn is not None else _do
+
+
+@register_subgraph_backend("INT8")
+def _int8_backend(block, x, *args, calib_data=None, calib_mode="naive",
+                  exclude_layers=(), **kwargs):
+    from ..quantization import quantize_net
+    quantize_net(block, calib_data=list(calib_data or [x]),
+                 calib_mode=calib_mode, exclude_layers=exclude_layers)
 
 
 def params_data(params, ctx):

@@ -71,9 +71,17 @@ class Trainer:
         if self._kvstore_type and not isinstance(self._kvstore_type, str):
             self._kvstore = self._kvstore_type  # explicit KVStore object
         elif self._kvstore_type in (None, "local", "device", "nccl"):
-            # Single-process replica reduce handled inline (CommDevice parity);
-            # mesh-sharded training uses parallel.* + kvstore('mesh').
-            self._kvstore = None
+            if self._compression_params:
+                # the inline replica reduce has no compression stage; route
+                # through a real store rather than silently ignoring the
+                # user's convergence-relevant request
+                from .. import kvstore as kv
+                self._kvstore = kv.create(self._kvstore_type or "device")
+            else:
+                # Single-process replica reduce handled inline (CommDevice
+                # parity); mesh-sharded training uses parallel.* +
+                # kvstore('mesh').
+                self._kvstore = None
         else:
             from .. import kvstore as kv
             self._kvstore = kv.create(self._kvstore_type)

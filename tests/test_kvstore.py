@@ -147,3 +147,15 @@ def test_trainer_forwards_compression_params():
                        compression_params={"type": "2bit", "threshold": 0.5})
     tr._init_kvstore()
     assert tr._kvstore._compression.get("type") == "2bit"
+
+
+def test_trainer_compression_on_default_kvstore_not_dropped():
+    """compression_params with the default ('device') kvstore must engage a
+    real store rather than being silently ignored by the inline reduce."""
+    net = gluon.nn.Dense(1, in_units=2)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1},
+                       compression_params={"type": "2bit", "threshold": 0.5})
+    tr._init_kvstore()
+    assert tr._kvstore is not None
+    assert tr._kvstore._compression.get("type") == "2bit"

@@ -307,3 +307,98 @@ def test_multi_output_ops_record_safe():
     loss.backward()
     g = x.grad.asnumpy()
     assert (g.sum(axis=1) == 2).all()
+
+
+# ---------------------------------------------------------------------------
+# vision ops (round 3): STN family, Correlation, Crop, batch_take, MakeLoss
+# ---------------------------------------------------------------------------
+
+def test_grid_generator_identity_affine():
+    # identity affine: theta = [1,0,0, 0,1,0] -> grid == meshgrid in [-1,1]
+    theta = mx.nd.array(onp.array([[1, 0, 0, 0, 1, 0]], "float32"))
+    g = mx.nd.GridGenerator(theta, transform_type="affine",
+                            target_shape=(3, 4)).asnumpy()
+    assert g.shape == (1, 2, 3, 4)
+    onp.testing.assert_allclose(g[0, 0, 0], onp.linspace(-1, 1, 4), atol=1e-6)
+    onp.testing.assert_allclose(g[0, 1, :, 0], onp.linspace(-1, 1, 3),
+                                atol=1e-6)
+
+
+def test_bilinear_sampler_identity():
+    rng = onp.random.RandomState(0)
+    x = rng.randn(2, 3, 5, 6).astype("float32")
+    theta = onp.tile(onp.array([[1, 0, 0, 0, 1, 0]], "float32"), (2, 1))
+    out = mx.nd.SpatialTransformer(mx.nd.array(x), mx.nd.array(theta),
+                                   target_shape=(5, 6)).asnumpy()
+    onp.testing.assert_allclose(out, x, rtol=1e-4, atol=1e-5)
+
+
+def test_spatial_transformer_translation():
+    # shift sampling one pixel right: out[..., j] == x[..., j+1]
+    x = onp.arange(2 * 1 * 4 * 4, dtype="float32").reshape(2, 1, 4, 4)
+    tx = 2.0 / 3.0   # one pixel in normalized coords for W=4
+    theta = onp.tile(onp.array([[1, 0, tx, 0, 1, 0]], "float32"), (2, 1))
+    out = mx.nd.SpatialTransformer(mx.nd.array(x), mx.nd.array(theta),
+                                   target_shape=(4, 4)).asnumpy()
+    onp.testing.assert_allclose(out[..., :3], x[..., 1:], rtol=1e-4,
+                                atol=1e-4)
+
+
+def test_correlation_reference_geometry_and_values():
+    rng = onp.random.RandomState(1)
+    a = rng.randn(1, 4, 6, 6).astype("float32")
+    b = rng.randn(1, 4, 6, 6).astype("float32")
+    out = mx.nd.Correlation(mx.nd.array(a), mx.nd.array(b),
+                            max_displacement=1).asnumpy()
+    # reference shape: border = max_displacement + (k-1)/2 = 1 -> 4x4
+    assert out.shape == (1, 9, 4, 4)
+    inner = slice(1, -1)
+    onp.testing.assert_allclose(
+        out[0, 4], (a * b).mean(1)[0][inner, inner], rtol=1e-5)
+    # displacement (dy=0, dx=1) = channel index 5: b sampled one col right
+    onp.testing.assert_allclose(
+        out[0, 5], (a[..., :, 1:-1] * b[..., :, 2:]).mean(1)[0][inner],
+        rtol=1e-5)
+
+
+def test_make_loss_valid_normalization_and_dtype():
+    x = mx.nd.array(onp.array([0.5, -1.0, 2.0, 0.2], "float32"))
+    x.attach_grad()
+    with mx.autograd.record():
+        l = mx.nd.MakeLoss(x, grad_scale=6.0, normalization="valid",
+                           valid_thresh=0.3)
+    l.backward()
+    # 2 elements above 0.3 -> scale 6/2 = 3 everywhere
+    onp.testing.assert_allclose(x.grad.asnumpy(), [3.0] * 4)
+    # dtype follows the primal
+    import jax
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu.ops.vision import make_loss
+    g = jax.grad(lambda v: make_loss(v).sum())(
+        jnp.ones((3,), jnp.bfloat16))
+    assert g.dtype == jnp.bfloat16
+
+
+def test_crop_center_and_like():
+    x = mx.nd.array(onp.arange(36, dtype="float32").reshape(1, 1, 6, 6))
+    c = mx.nd.Crop(x, h_w=(2, 2), center_crop=True).asnumpy()
+    onp.testing.assert_array_equal(c[0, 0], [[14, 15], [20, 21]])
+    ref = mx.nd.zeros((1, 1, 3, 3))
+    c2 = mx.nd.Crop(x, ref).asnumpy()
+    assert c2.shape == (1, 1, 3, 3)
+
+
+def test_batch_take():
+    a = mx.nd.array(onp.arange(12, dtype="float32").reshape(3, 4))
+    idx = mx.nd.array(onp.array([1, 3, 0], "float32"))
+    out = mx.nd.batch_take(a, idx).asnumpy()
+    onp.testing.assert_array_equal(out, [1.0, 7.0, 8.0])
+
+
+def test_make_loss_gradient_semantics():
+    x = mx.nd.array(onp.array([2.0, -1.0], "float32"))
+    x.attach_grad()
+    with mx.autograd.record():
+        l = mx.nd.MakeLoss(x, grad_scale=3.0)
+    l.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [3.0, 3.0])

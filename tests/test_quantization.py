@@ -182,3 +182,27 @@ def test_quantize_net_on_hybridized_net():
     got = net(x).asnumpy()     # recompiles the int8 graph
     err = onp.abs(got - want).mean() / (onp.abs(want).mean() + 1e-6)
     assert err < 0.10, err
+
+
+def test_optimize_for_int8_backend():
+    """optimize_for('INT8') runs the quantization pass and compiles
+    (reference: optimize_for over the subgraph backend registry)."""
+    rng = onp.random.RandomState(9)
+    mx.random.seed(44)
+    net = _lenet()
+    x = nd.array(rng.randn(2, 1, 12, 12).astype("float32"))
+    want = net(x).asnumpy()
+    out = net.optimize_for(x, backend="INT8",
+                           calib_data=[x], calib_mode="naive")
+    from incubator_mxnet_tpu.quantization import _QuantizedLayerBase
+    assert any(isinstance(c, _QuantizedLayerBase)
+               for c in net._children.values())
+    err = onp.abs(out.asnumpy() - want).mean() / (onp.abs(want).mean() + 1e-6)
+    assert err < 0.10, err
+
+
+def test_optimize_for_unknown_backend_raises():
+    net = _lenet()
+    x = nd.ones((1, 1, 12, 12))
+    with pytest.raises(mx.MXNetError):
+        net.optimize_for(x, backend="TensorRT")
