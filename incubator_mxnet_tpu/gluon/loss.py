@@ -1,6 +1,8 @@
 """Loss zoo (reference: python/mxnet/gluon/loss.py — SURVEY §2.8)."""
 from __future__ import annotations
 
+import jax
+
 import numpy as onp
 
 from ..base import MXNetError
@@ -10,7 +12,7 @@ __all__ = [
     "Loss", "L2Loss", "L1Loss", "SigmoidBinaryCrossEntropyLoss", "SigmoidBCELoss",
     "SoftmaxCrossEntropyLoss", "SoftmaxCELoss", "KLDivLoss", "CTCLoss",
     "HuberLoss", "HingeLoss", "SquaredHingeLoss", "LogisticLoss",
-    "TripletLoss", "PoissonNLLLoss", "CosineEmbeddingLoss",
+    "TripletLoss", "PoissonNLLLoss", "CosineEmbeddingLoss", "SDMLLoss",
 ]
 
 
@@ -108,6 +110,10 @@ class SoftmaxCrossEntropyLoss(Loss):
         self._from_logits = from_logits
 
     def hybrid_forward(self, F, pred, label, sample_weight=None):
+        if str(pred.dtype) in ("bfloat16", "float16"):
+            # CE over a large vocab needs fp32 log-softmax — bf16 logits
+            # carry ~3 decimal digits; the cast fuses into the same kernel
+            pred = pred.astype("float32")
         if not self._from_logits:
             pred = F.log_softmax(pred, axis=self._axis)
         if self._sparse_label:
@@ -265,3 +271,28 @@ class CosineEmbeddingLoss(Loss):
         loss = F.where(label == 1, 1.0 - cos, F.relu(cos - self._margin))
         loss = _apply_weighting(F, loss, self._weight, sample_weight)
         return loss
+
+
+class SDMLLoss(Loss):
+    """Smoothed deep metric learning loss (reference: gluon/loss.py SDMLLoss):
+    batchwise smoothed-CE over the pairwise SQUARED-euclidean-distance matrix
+    of two embedding batches — row i's positive is column i, every other
+    column a negative (the reference's _compute_distances uses squared
+    distances; no sqrt)."""
+
+    def __init__(self, smoothing_parameter=0.3, weight=1.0, batch_axis=0,
+                 **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._sp = smoothing_parameter
+
+    def hybrid_forward(self, F, x1, x2, sample_weight=None):
+        n = x1.shape[0]
+        sq1 = F.sum(F.square(x1), axis=1, keepdims=True)          # (N, 1)
+        sq2 = F.sum(F.square(x2), axis=1, keepdims=True)          # (N, 1)
+        d2 = sq1 + F.transpose(sq2) - 2.0 * F.dot(x1, x2, transpose_b=True)
+        logp = F.log_softmax(-d2, axis=-1)
+        eye = F.eye(n)
+        smoothed = ((1.0 - self._sp) * eye
+                    + (self._sp / max(n - 1, 1)) * (1.0 - eye))
+        loss = -F.sum(smoothed * logp, axis=-1)
+        return _apply_weighting(F, loss, self._weight, sample_weight)

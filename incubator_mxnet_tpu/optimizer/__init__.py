@@ -1,4 +1,5 @@
 from .optimizer import (  # noqa: F401
-    Optimizer, SGD, NAG, Adam, AdamW, RMSProp, AdaGrad, AdaDelta, FTRL,
-    Signum, LAMB, LARS, Updater, register, create, get_updater,
+    Optimizer, SGD, NAG, Adam, AdamW, RMSProp, AdaGrad, AdaDelta, FTRL, Ftrl,
+    Signum, LAMB, LARS, DCASGD, SGLD, Adamax, Nadam, FTML, Updater, register,
+    create, get_updater,
 )

@@ -23,7 +23,8 @@ from ..base import Registry, MXNetError
 from ..ndarray.ndarray import NDArray
 
 __all__ = ["Optimizer", "SGD", "NAG", "Adam", "AdamW", "RMSProp", "AdaGrad",
-           "AdaDelta", "FTRL", "Signum", "LAMB", "LARS", "Updater",
+           "AdaDelta", "FTRL", "Ftrl", "Signum", "LAMB", "LARS", "DCASGD",
+           "SGLD", "Adamax", "Nadam", "FTML", "Updater",
            "register", "create", "get_updater"]
 
 _registry: Registry = Registry.get("optimizer")
@@ -379,7 +380,130 @@ class LARS(Optimizer):
         return w - mom, (mom,)
 
 
-_registry.alias("sgd", "sgd")
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference: optimizer.py DCASGD —
+    Zheng et al.): compensates gradient staleness with a λ·g²·(w − w_prev)
+    term."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum, self.lamda = momentum, lamda
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, weight._data.dtype)
+        return (z, weight._data)       # (momentum, previous weight)
+
+    def step(self, w, g, state, lr, wd, t):
+        g = self._prep_grad(g) + wd * w
+        mom, prev = state
+        comp = g + self.lamda * jnp.square(g) * (w - prev)
+        mom = self.momentum * mom - lr * comp
+        return w + mom, (mom, w)
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics (reference: optimizer.py SGLD):
+    SGD plus N(0, lr) gradient noise — a Bayesian sampler, not a descent
+    method. Each parameter's state carries its own base key drawn from the
+    global RNG (so mx.random.seed governs it and parameters decorrelate);
+    the step counter folds in per update for jit purity."""
+
+    def create_state(self, index, weight):
+        from .. import random as _rng
+        self._key_impl = _rng._impl()
+        base = jax.random.fold_in(_rng.next_key(), index)
+        # store RAW key data (plain uint32) so optimizer states stay
+        # picklable/serializable like every other state array
+        return (jax.random.key_data(base),)
+
+    def step(self, w, g, state, lr, wd, t):
+        g = self._prep_grad(g) + wd * w
+        base = jax.random.wrap_key_data(
+            state[0], impl=getattr(self, "_key_impl", None) or "threefry2x32")
+        key = jax.random.fold_in(base, t)
+        noise = jax.random.normal(key, w.shape, jnp.float32) * jnp.sqrt(lr)
+        return w - 0.5 * lr * g + noise.astype(w.dtype), state
+
+
+@register
+class Adamax(Optimizer):
+    """Adam with an infinity-norm second moment (reference: Adamax)."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2 = beta1, beta2
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, weight._data.dtype)
+        return (z, z)
+
+    def step(self, w, g, state, lr, wd, t):
+        g = self._prep_grad(g) + wd * w
+        m = self.beta1 * state[0] + (1 - self.beta1) * g
+        u = jnp.maximum(self.beta2 * state[1], jnp.abs(g))
+        lr_t = lr / (1.0 - self.beta1 ** t)
+        return w - lr_t * m / (u + 1e-8), (m, u)
+
+
+@register
+class Nadam(Optimizer):
+    """Adam with Nesterov momentum (reference: Nadam, Dozat 2016)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2 = beta1, beta2
+        self.epsilon, self.schedule_decay = epsilon, schedule_decay
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, weight._data.dtype)
+        return (z, z, jnp.ones((), jnp.float32))   # (m, v, m_schedule)
+
+    def step(self, w, g, state, lr, wd, t):
+        g = self._prep_grad(g) + wd * w
+        m_prev, v_prev, m_schedule = state
+        mu_t = self.beta1 * (1 - 0.5 * 0.96 ** (t * self.schedule_decay))
+        mu_t1 = self.beta1 * (1 - 0.5 * 0.96 ** ((t + 1) * self.schedule_decay))
+        # cumulative momentum schedule (reference: m_schedule *= mu_t)
+        m_schedule = m_schedule * mu_t
+        m_schedule_next = m_schedule * mu_t1
+        m = self.beta1 * m_prev + (1 - self.beta1) * g
+        v = self.beta2 * v_prev + (1 - self.beta2) * jnp.square(g)
+        g_hat = g / (1 - m_schedule)
+        m_hat = m / (1 - m_schedule_next)
+        m_bar = (1 - mu_t) * g_hat + mu_t1 * m_hat
+        v_hat = v / (1 - self.beta2 ** t)
+        return (w - lr * m_bar / (jnp.sqrt(v_hat) + self.epsilon),
+                (m, v, m_schedule))
+
+
+@register
+class FTML(Optimizer):
+    """Follow the moving leader (reference: FTML, Zheng & Kwok 2017)."""
+
+    def __init__(self, learning_rate=0.0025, beta1=0.6, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, weight._data.dtype)
+        return (z, z, z)               # (v, d, z)
+
+    def step(self, w, g, state, lr, wd, t):
+        g = self._prep_grad(g) + wd * w
+        v_prev, d_prev, z_prev = state
+        v_t = self.beta2 * v_prev + (1 - self.beta2) * jnp.square(g)
+        d_t = (1 - self.beta1 ** t) / lr * (
+            jnp.sqrt(v_t / (1 - self.beta2 ** t)) + self.epsilon)
+        sigma_t = d_t - self.beta1 * d_prev
+        z_t = self.beta1 * z_prev + (1 - self.beta1) * g - sigma_t * w
+        return -z_t / d_t, (v_t, d_t, z_t)
+
+
+Ftrl = FTRL  # reference exposes both spellings
 
 
 class Updater:

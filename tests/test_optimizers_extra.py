@@ -1,0 +1,72 @@
+"""Round-3 optimizer additions (reference: python/mxnet/optimizer/optimizer.py
+DCASGD/SGLD/Adamax/Nadam/FTML) + new metric/loss surface."""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon, nd
+
+
+@pytest.mark.parametrize("name,lr,steps", [
+    ("dcasgd", 0.05, 200), ("adamax", 0.05, 200), ("nadam", 0.05, 200),
+    ("ftml", 0.5, 400),    # FTML's adaptive rate is conservative by design
+])
+def test_new_optimizers_minimize_quadratic(name, lr, steps):
+    opt = mx.optimizer.create(name, learning_rate=lr)
+    w = nd.array(onp.array([3.0, -2.0], "float32"))
+    st = opt.create_state(0, w)
+    for _ in range(steps):
+        g = nd.array(2.0 * w.asnumpy())      # d/dw (w²)
+        st = opt.update(0, w, g, st)
+    assert (onp.abs(w.asnumpy()) < 0.1).all(), w.asnumpy()
+
+
+def test_adamax_single_step_reference():
+    # one step from zero state: m=(1-b1)g, u=|g|, w' = w - lr/(1-b1)*m/u
+    lr, b1 = 0.002, 0.9
+    g0 = onp.array([0.5, -1.0], "float32")
+    w = nd.array(onp.array([1.0, 1.0], "float32"))
+    opt = mx.optimizer.create("adamax", learning_rate=lr)
+    st = opt.create_state(0, w)
+    opt.update(0, w, nd.array(g0), st)
+    m = (1 - b1) * g0
+    u = onp.abs(g0)
+    want = 1.0 - lr / (1 - b1) * m / (u + 1e-8)
+    onp.testing.assert_allclose(w.asnumpy(), want, rtol=1e-5)
+
+
+def test_sgld_is_stochastic_but_descends_in_mean():
+    opt = mx.optimizer.create("sgld", learning_rate=0.01)
+    w = nd.array(onp.array([5.0], "float32"))
+    st = opt.create_state(0, w)
+    vals = []
+    for _ in range(300):
+        g = nd.array(2.0 * w.asnumpy())
+        st = opt.update(0, w, g, st)
+        vals.append(float(w.asnumpy()[0]))
+    # noisy, but the trajectory must fall toward the basin
+    assert abs(onp.mean(vals[-50:])) < 1.0
+    assert onp.std(vals[-50:]) > 0.0        # genuinely stochastic
+
+
+def test_mcc_known_value():
+    m = mx.metric.MCC()
+    # tp=2, tn=1, fp=0, fn=1 -> mcc = (2*1-0*1)/sqrt(2*3*1*2)
+    m.update(nd.array([1, 0, 1, 1]),
+             nd.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7], [0.9, 0.1]]))
+    name, val = m.get()
+    onp.testing.assert_allclose(val, 2.0 / onp.sqrt(12.0), rtol=1e-6)
+
+
+def test_sdml_loss_prefers_aligned_pairs():
+    rng = onp.random.RandomState(0)
+    x = rng.randn(6, 16).astype("float32")
+    aligned = gluon.loss.SDMLLoss()(nd.array(x), nd.array(x)).asnumpy().mean()
+    shuffled = gluon.loss.SDMLLoss()(
+        nd.array(x), nd.array(x[::-1].copy())).asnumpy().mean()
+    assert aligned < shuffled
+
+
+def test_hybrid_sequential_rnn_cell_alias():
+    cell = gluon.rnn.HybridSequentialRNNCell()
+    assert isinstance(cell, gluon.rnn.SequentialRNNCell)
