@@ -30,6 +30,8 @@ __all__ = [
     "current_context",
     "num_gpus",
     "num_tpus",
+    "gpu_memory_info",
+    "tpu_memory_info",
 ]
 
 
@@ -191,6 +193,34 @@ def context_for_device(device) -> Context:
 def num_gpus() -> int:
     """Number of accelerator devices visible (alias surface)."""
     return num_tpus()
+
+
+def gpu_memory_info(device_id: int = 0):
+    """``(free, total)`` bytes on the accelerator, reference
+    ``python/mxnet/context.py (gpu_memory_info)`` / C API
+    ``MXGetGPUMemoryInformation64``. On TPU the numbers come from PjRt's
+    ``memory_stats`` (HBM); alias name kept so reference scripts run
+    unchanged. Raises MXNetError when the device exposes no stats
+    (e.g. pure-CPU test runs)."""
+    return tpu_memory_info(device_id)
+
+
+def tpu_memory_info(device_id: int = 0):
+    devs = _devices_for("tpu")
+    if not 0 <= device_id < len(devs):
+        raise MXNetError(
+            f"device_id {device_id} out of range ({len(devs)} devices)")
+    stats = None
+    try:
+        stats = devs[device_id].memory_stats()
+    except Exception:
+        stats = None
+    if not stats:
+        raise MXNetError(
+            f"device {devs[device_id]} exposes no memory stats")
+    total = stats.get("bytes_limit", 0)
+    used = stats.get("bytes_in_use", 0)
+    return (total - used, total)
 
 
 def num_tpus() -> int:

@@ -1,8 +1,6 @@
 """Loss zoo (reference: python/mxnet/gluon/loss.py — SURVEY §2.8)."""
 from __future__ import annotations
 
-import jax
-
 import numpy as onp
 
 from ..base import MXNetError

@@ -21,6 +21,7 @@ from .alexnet import AlexNet, alexnet  # noqa: F401
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19, vgg11_bn, vgg13_bn, vgg16_bn, vgg19_bn  # noqa: F401
 from .squeezenet import SqueezeNet, squeezenet1_0, squeezenet1_1  # noqa: F401
 from .densenet import DenseNet, densenet121, densenet161, densenet169, densenet201  # noqa: F401
+from .inception import Inception3, inception_v3  # noqa: F401
 from .mobilenet import (  # noqa: F401
     MobileNet, MobileNetV2, mobilenet1_0, mobilenet0_75, mobilenet0_5,
     mobilenet0_25, mobilenet_v2_1_0, mobilenet_v2_0_75, mobilenet_v2_0_5,
@@ -38,11 +39,13 @@ def _register_models():
                  "resnet101_v2", "resnet152_v2", "alexnet", "vgg11", "vgg13",
                  "vgg16", "vgg19", "vgg11_bn", "vgg13_bn", "vgg16_bn",
                  "vgg19_bn", "squeezenet1.0", "squeezenet1.1", "densenet121",
-                 "densenet161", "densenet169", "densenet201", "mobilenet1.0",
+                 "densenet161", "densenet169", "densenet201", "inceptionv3",
+                 "mobilenet1.0",
                  "mobilenet0.75", "mobilenet0.5", "mobilenet0.25",
                  "mobilenetv2_1.0", "mobilenetv2_0.75", "mobilenetv2_0.5",
                  "mobilenetv2_0.25"]:
         attr = name.replace(".", "_").replace("mobilenetv2", "mobilenet_v2")
+        attr = attr.replace("inceptionv3", "inception_v3")
         _MODELS[name] = getattr(mod, attr)
 
 

@@ -195,6 +195,54 @@ class CSVIter(NDArrayIter):
         super().__init__(data, label, batch_size=batch_size, **kwargs)
 
 
+class LibSVMIter(NDArrayIter):
+    """LibSVM-format iterator (reference: src/io/iter_libsvm.cc).
+
+    Parses ``label idx:val idx:val ...`` lines. The reference yields CSR
+    batches; on TPU sparse storage is a dense facade (SURVEY §7 sparse
+    scoping), so features densify to ``(n, *data_shape)`` float32 — the
+    iterator surface (provide_data/label, pad/shuffle semantics) matches.
+    """
+
+    def __init__(self, data_libsvm: str, data_shape: Tuple[int, ...],
+                 label_libsvm: Optional[str] = None,
+                 label_shape: Tuple[int, ...] = (1,),
+                 batch_size: int = 1, **kwargs):
+        feat_dim = int(onp.prod(data_shape))
+        labels, rows, cols, vals = [], [], [], []
+        with open(data_libsvm) as f:
+            for line in f:
+                parts = line.split()
+                if not parts:
+                    continue
+                labels.append(float(parts[0]))
+                for tok in parts[1:]:
+                    idx, val = tok.split(":")
+                    rows.append(len(labels) - 1)
+                    cols.append(int(idx))
+                    vals.append(float(val))
+        n = len(labels)
+        data = onp.zeros((n, feat_dim), dtype=onp.float32)
+        if rows:
+            if max(cols) >= feat_dim or min(cols) < 0:
+                raise MXNetError(
+                    f"libsvm feature index out of range [0, {feat_dim}): "
+                    f"[{min(cols)}, {max(cols)}]")
+            data[rows, cols] = vals
+        data = data.reshape((-1,) + tuple(data_shape))
+        if label_libsvm:
+            lab = []
+            with open(label_libsvm) as f:
+                for line in f:
+                    if line.split():
+                        lab.append([float(x) for x in line.split()])
+            label = onp.asarray(lab, dtype=onp.float32)
+            label = label.reshape((-1,) + tuple(label_shape))
+        else:
+            label = onp.asarray(labels, dtype=onp.float32)
+        super().__init__(data, label, batch_size=batch_size, **kwargs)
+
+
 class MNISTIter(NDArrayIter):
     """idx-format MNIST reader (reference: src/io/iter_mnist.cc)."""
 

@@ -87,12 +87,39 @@ def flash_supported(q, k, v, mask=None) -> bool:
     return True
 
 
+def _auto_block(length: int) -> int:
+    """Default tile rows for one grid dimension: 512 or 256 when they divide
+    ``length``, else one whole (possibly unaligned) block for short
+    sequences, else 512 (which won't divide — the caller then routes to the
+    XLA path via ``flash_supported``).
+
+    Measured on v5e (BERT-base, L=512, D=64): (BQ, BK)=(512, 512) runs the
+    step at 40.9ms vs 45.5ms for (256, 512) and a pathological 1066ms for
+    (128, 512) — bigger tiles amortize the grid/recurrence overhead and keep
+    the MXU busier, and VMEM comfortably holds a 512-row block up to D=256.
+    Tiles below 256 rows are never chosen automatically (the 128-row config
+    is the measured-pathological regime; env overrides remain available).
+    """
+    for cand in (512, 256):
+        if cand <= length and length % cand == 0:
+            return cand
+    if length <= 1024:
+        return length  # one unaligned block; VMEM holds it up to D=256
+    return 512  # non-divisible long sequence: caller falls back to XLA
+
+
 def _bq(lq: int) -> int:
-    return min(int(os.environ.get("MXTPU_FLASH_BQ", "256")), lq)
+    env = os.environ.get("MXTPU_FLASH_BQ")
+    if env:
+        return min(int(env), lq)
+    return _auto_block(lq)
 
 
 def _bk(lk: int) -> int:
-    return min(int(os.environ.get("MXTPU_FLASH_BK", "512")), lk)
+    env = os.environ.get("MXTPU_FLASH_BK")
+    if env:
+        return min(int(env), lk)
+    return _auto_block(lk)
 
 
 def _dimsem(n: int = 2):

@@ -391,14 +391,22 @@ class DCASGD(Optimizer):
         self.momentum, self.lamda = momentum, lamda
 
     def create_state(self, index, weight):
+        # reference parity: no momentum buffer at the default momentum=0.0
+        if self.momentum == 0.0:
+            return (weight._data,)     # (previous weight,)
         z = jnp.zeros(weight.shape, weight._data.dtype)
         return (z, weight._data)       # (momentum, previous weight)
 
     def step(self, w, g, state, lr, wd, t):
-        g = self._prep_grad(g) + wd * w
-        mom, prev = state
-        comp = g + self.lamda * jnp.square(g) * (w - prev)
-        mom = self.momentum * mom - lr * comp
+        # Delay compensation uses the RAW (rescaled/clipped) gradient; weight
+        # decay enters the update separately (reference: dcasgd_update's
+        # lamda*grad*grad*(weight - previous_weight) + wd*weight).
+        g = self._prep_grad(g)
+        prev = state[-1]
+        comp = g + wd * w + self.lamda * jnp.square(g) * (w - prev)
+        if self.momentum == 0.0:
+            return w - lr * comp, (w,)
+        mom = self.momentum * state[0] - lr * comp
         return w + mom, (mom, w)
 
 

@@ -84,13 +84,24 @@ def test_flash_cross_length_causal_matches_xla():
 
 
 def test_flash_rejects_non_divisible_lengths():
-    # 300 > the 256 q-block and not a multiple of it; short sequences
-    # (L <= block) are always divisible since the block clamps to L.
+    # Lengths <= 1024 always fit one (possibly unaligned) block; beyond that
+    # a length with no {512..8} divisor has no aligned tiling — reject so the
+    # caller routes to the XLA path.
     rng = onp.random.RandomState(6)
-    q, k, v = (jnp.asarray(rng.randn(1, 1, 300, 32), jnp.float32)
+    q, k, v = (jnp.asarray(rng.randn(1, 1, 1500, 32), jnp.float32)
                for _ in range(3))
     with pytest.raises(ValueError):
         flash_attention(q, k, v)
+
+
+def test_flash_odd_mid_length_single_block():
+    rng = onp.random.RandomState(8)
+    q, k, v = (jnp.asarray(rng.randn(1, 1, 300, 32), jnp.float32)
+               for _ in range(3))
+    out = flash_attention(q, k, v)
+    ref = dot_product_attention(q, k, v, impl="xla")
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                atol=2e-5, rtol=2e-5)
 
 
 def test_flash_odd_short_length_now_supported():

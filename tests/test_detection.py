@@ -181,6 +181,17 @@ def test_model_zoo_get_model_names():
     assert out.shape == (2, 10)
 
 
+def test_model_zoo_inception_v3():
+    from incubator_mxnet_tpu.gluon.model_zoo import vision
+    net = vision.get_model("inceptionv3", classes=7)
+    net.initialize()
+    net.hybridize()
+    with mx.autograd.predict_mode():
+        out = net(mx.nd.array(
+            onp.random.rand(1, 3, 299, 299).astype("float32")))
+    assert out.shape == (1, 7)
+
+
 # ---------------------------------------------------------------------------
 # Faster-RCNN surface (round 3): Proposal / DeformableConvolution / PS-ROI
 # ---------------------------------------------------------------------------

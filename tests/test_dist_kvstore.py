@@ -133,3 +133,31 @@ def test_dist_sync_kvstore_multiprocess(nproc):
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {i} failed:\n{out}"
         assert f"DIST_KV_OK rank={i}" in out
+
+
+def test_launch_py_local_spawns_rendezvoused_workers(tmp_path):
+    """tools/launch.py (reference: tools/launch.py + dmlc tracker): the local
+    launcher wires DMLC_* env vars that dist.initialize maps onto the JAX
+    rendezvous."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import launch
+    finally:
+        sys.path.pop(0)
+    worker = tmp_path / "worker.py"
+    worker.write_text(
+        "import os\n"
+        "from incubator_mxnet_tpu.parallel import dist\n"
+        "dist.initialize()\n"
+        "assert dist.process_count() == 2, dist.process_count()\n"
+        "print('LAUNCH_OK rank=%s' % os.environ['DMLC_WORKER_ID'])\n"
+        "dist.finalize()\n")
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        "PALLAS_AXON_POOL_IPS": "",
+        "PYTHONPATH": REPO,
+    })
+    rc = launch.launch_local(2, [sys.executable, str(worker)], env=env)
+    assert rc == 0

@@ -84,6 +84,25 @@ def test_csv_iter(tmp_path):
     assert b.data[0].shape == (3, 2)
 
 
+def test_libsvm_iter(tmp_path):
+    f = str(tmp_path / "d.libsvm")
+    with open(f, "w") as fh:
+        fh.write("1 0:1.5 3:2.0\n")
+        fh.write("\n")  # blank lines are tolerated, rows stay aligned
+        fh.write("0 1:0.5\n")
+        fh.write("1 2:3.0 3:1.0\n")
+    it = mio.LibSVMIter(f, (4,), batch_size=3, last_batch_handle="discard")
+    b = next(it)
+    d = b.data[0].asnumpy()
+    lab = b.label[0].asnumpy()
+    assert d.shape == (3, 4)
+    onp.testing.assert_allclose(d[0], [1.5, 0, 0, 2.0])
+    onp.testing.assert_allclose(d[1], [0, 0.5, 0, 0])
+    onp.testing.assert_allclose(lab.ravel(), [1, 0, 1])
+    with pytest.raises(mx.MXNetError):
+        mio.LibSVMIter(f, (2,), batch_size=1)
+
+
 def test_image_record_iter(tmp_path):
     rec, idx = str(tmp_path / "im.rec"), str(tmp_path / "im.idx")
     w = recordio.MXIndexedRecordIO(idx, rec, "w")

@@ -108,3 +108,19 @@ def test_engine_independent_vars_parallel():
     eng.wait_all()
     assert time.time() - t0 < 0.45
     eng.close()
+
+
+def test_cpp_unit_suite():
+    """Build and run the in-tree C++ test binary (tests/cpp parity:
+    threaded_engine_test.cc / storage_test.cc analog, native/test_native.cc)."""
+    import os
+    import shutil
+    import subprocess
+    if shutil.which("make") is None or shutil.which("g++") is None:
+        pytest.skip("native toolchain unavailable")
+    native_dir = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "native")
+    out = subprocess.run(["make", "-s", "test"], cwd=native_dir,
+                         capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "all native tests passed" in out.stdout

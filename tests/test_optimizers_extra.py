@@ -21,6 +21,27 @@ def test_new_optimizers_minimize_quadratic(name, lr, steps):
     assert (onp.abs(w.asnumpy()) < 0.1).all(), w.asnumpy()
 
 
+def test_dcasgd_single_step_reference():
+    # reference dcasgd_update: w' = w - lr*(g + wd*w + λ·g²·(w − w_prev)),
+    # with the RAW gradient in the compensation term (wd enters separately)
+    lr, wd, lam = 0.1, 0.01, 0.04
+    w0 = onp.array([1.0, -2.0], "float32")
+    g0 = onp.array([0.5, 0.25], "float32")
+    opt = mx.optimizer.create("dcasgd", learning_rate=lr, lamda=lam, wd=wd)
+    w = nd.array(w0)
+    st = opt.create_state(0, w)
+    assert len(st) == 1  # no momentum buffer at default momentum=0.0
+    st = opt.update(0, w, nd.array(g0), st)
+    # first step: w_prev == w0 so the compensation term vanishes
+    exp = w0 - lr * (g0 + wd * w0)
+    onp.testing.assert_allclose(w.asnumpy(), exp, rtol=1e-6)
+    # second step with the same gradient: compensation λ·g²·(w1 − w0)
+    w1 = w.asnumpy().copy()
+    opt.update(0, w, nd.array(g0), st)
+    exp2 = w1 - lr * (g0 + wd * w1 + lam * g0 * g0 * (w1 - w0))
+    onp.testing.assert_allclose(w.asnumpy(), exp2, rtol=1e-6)
+
+
 def test_adamax_single_step_reference():
     # one step from zero state: m=(1-b1)g, u=|g|, w' = w - lr/(1-b1)*m/u
     lr, b1 = 0.002, 0.9
