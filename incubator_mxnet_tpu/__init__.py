@@ -26,6 +26,7 @@ from . import ndarray as nd  # noqa: F401
 from .ndarray import NDArray  # noqa: F401
 from .engine import waitall  # noqa: F401
 from . import operator  # noqa: F401  (registers the Custom op seam)
+from .attribute import AttrScope  # noqa: F401
 
 # Submodules that build on the core are imported lazily to keep import light
 # and to allow partial builds during bootstrapping.
@@ -62,6 +63,11 @@ _LAZY = {
     "npx": ".numpy_extension",
     "models": ".models",
     "quantization": ".quantization",
+    "attribute": ".attribute",
+    "name": ".name",
+    "monitor": ".monitor",
+    "visualization": ".visualization",
+    "viz": ".visualization",
 }
 
 

@@ -2,4 +2,6 @@
 SyncBatchNorm, the estimator fit loop, and misc experimental blocks."""
 from ..nn.basic_layers import SyncBatchNorm  # noqa: F401
 from . import estimator  # noqa: F401
+from . import nn  # noqa: F401
+from . import rnn  # noqa: F401
 from .estimator import Estimator  # noqa: F401

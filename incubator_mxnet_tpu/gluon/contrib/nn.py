@@ -1,0 +1,67 @@
+"""Experimental gluon layers.
+
+Reference counterpart: ``python/mxnet/gluon/contrib/nn/basic_layers.py`` —
+``Concurrent``/``HybridConcurrent`` (parallel branches concatenated on an
+axis, the Inception building block), ``Identity``, and ``SparseEmbedding``.
+On TPU ``SparseEmbedding`` is the plain dense-gradient Embedding (row_sparse
+gradients are a parameter-server-era optimization; SURVEY §7 scopes sparse
+to a dense facade) — the class exists so reference model code imports
+unchanged.
+"""
+from __future__ import annotations
+
+from ..block import HybridBlock
+from ..nn.basic_layers import Embedding
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity", "SparseEmbedding"]
+
+
+class HybridConcurrent(HybridBlock):
+    """Feed the same input to every child, concat outputs along ``axis``.
+
+    Use ``.add(block)`` like a Sequential::
+
+        net = HybridConcurrent(axis=1)
+        net.add(branch_a)
+        net.add(branch_b)
+    """
+
+    def __init__(self, axis: int = -1, **kwargs):
+        super().__init__(**kwargs)
+        self.axis = axis
+        self._children_order = []
+
+    def add(self, block) -> None:
+        idx = len(self._children_order)
+        self._children_order.append(block)
+        self.register_child(block, f"branch{idx}")
+
+    def hybrid_forward(self, F, x):
+        return F.concat(*[b(x) for b in self._children_order], dim=self.axis)
+
+    def __len__(self):
+        return len(self._children_order)
+
+
+class Concurrent(HybridConcurrent):
+    """Imperative alias (the hybrid version runs eagerly too)."""
+
+
+class Identity(HybridBlock):
+    """Pass-through block (reference: contrib.nn.Identity) — useful as a
+    no-op branch in Concurrent/residual constructions."""
+
+    def hybrid_forward(self, F, x):
+        return x
+
+
+class SparseEmbedding(Embedding):
+    """Reference: contrib.nn.SparseEmbedding (row_sparse gradient
+    embedding). TPU-native: dense gradients (XLA scatter-add); same call
+    signature, documented divergence."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, **kwargs):
+        super().__init__(input_dim, output_dim, dtype=dtype,
+                         weight_initializer=weight_initializer,
+                         sparse_grad=False, **kwargs)

@@ -6,6 +6,7 @@ input 299x299. Every branch is Conv+BN+ReLU so the whole network lowers to
 MXU-tiled convolutions under one jit.
 """
 from ...block import HybridBlock
+from ...contrib.nn import HybridConcurrent
 from ... import nn
 
 __all__ = ["Inception3", "inception_v3"]
@@ -31,21 +32,8 @@ def _branch(*convs):
     return seq
 
 
-class _Concurrent(HybridBlock):
-    """Run child branches on the same input, concat on channels (reference:
-    gluon.contrib.nn.HybridConcurrent(axis=1))."""
-
-    def __init__(self, **kw):
-        super().__init__(**kw)
-        self._branches = []
-
-    def add(self, block):
-        idx = len(self._branches)
-        self._branches.append(block)
-        self.register_child(block, f"branch{idx}")
-
-    def hybrid_forward(self, F, x):
-        return F.concat(*[b(x) for b in self._branches], dim=1)
+def _Concurrent():
+    return HybridConcurrent(axis=1)
 
 
 def _inception_a(pool_features):

@@ -28,7 +28,8 @@ from ..ndarray import NDArray, array
 from .. import recordio as rec_mod
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
-           "MNISTIter", "ImageRecordIter", "PrefetchingIter", "ResizeIter"]
+           "LibSVMIter", "MNISTIter", "ImageRecordIter", "PrefetchingIter",
+           "ResizeIter"]
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape", "dtype", "layout"])):

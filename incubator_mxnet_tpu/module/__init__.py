@@ -60,13 +60,21 @@ class BaseModule:
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
         validation_metric = validation_metric or eval_metric
+        if monitor is not None:
+            self.install_monitor(monitor)
 
         for epoch in range(begin_epoch, num_epoch):
             eval_metric.reset()
             nbatch = 0
             train_data.reset()
             for batch in train_data:
+                if monitor is not None:
+                    monitor.tic()
                 self.forward_backward(batch)
+                if monitor is not None:
+                    # capture BEFORE update(): the stats must reflect the
+                    # weights the monitored forward actually used
+                    monitor.toc_print()
                 self.update()
                 self.update_metric(eval_metric, batch.label)
                 if batch_end_callback is not None:
@@ -162,6 +170,13 @@ class Module(BaseModule):
         self._param_names = [n for n in self._arg_names
                              if n not in self._input_names]
         self.binded = True
+
+    def install_monitor(self, mon) -> None:
+        """Attach a :class:`~incubator_mxnet_tpu.monitor.Monitor` to the
+        bound executor (reference: BaseModule.install_monitor)."""
+        if not self.binded:
+            raise MXNetError("call bind before install_monitor")
+        mon.install(self._exec)
 
     def init_params(self, initializer=None, arg_params=None, aux_params=None,
                     allow_missing: bool = False, force_init: bool = False,

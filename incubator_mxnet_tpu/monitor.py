@@ -1,0 +1,80 @@
+"""Training monitor: per-batch statistics of intermediate outputs.
+
+Reference counterpart: ``python/mxnet/monitor.py (Monitor)`` — installed on
+executors (``mod.fit(..., monitor=mon)``), it records a statistic of every
+op output whose name matches ``pattern`` each ``interval`` batches. The
+reference hooks the engine's per-op callbacks; here the Executor compiles a
+second "capture" program returning every node's primary output (one extra
+jit executable, built lazily on the first monitored batch — the normal
+training step stays a single fused program).
+
+Usage::
+
+    mon = mx.monitor.Monitor(interval=10, pattern='.*fullyconnected.*')
+    mod.fit(train_iter, monitor=mon, ...)
+"""
+from __future__ import annotations
+
+import logging
+import re
+from typing import Callable, List, Optional, Tuple
+
+import numpy as onp
+
+__all__ = ["Monitor"]
+
+
+def _default_stat(arr: onp.ndarray) -> float:
+    """Reference default: ||x|| / sqrt(x.size)."""
+    a = onp.asarray(arr, dtype=onp.float64)
+    return float(onp.linalg.norm(a) / max(onp.sqrt(a.size), 1.0))
+
+
+class Monitor:
+    def __init__(self, interval: int = 1,
+                 stat_func: Optional[Callable] = None,
+                 pattern: str = ".*", sort: bool = False):
+        self.interval = max(1, int(interval))
+        self.stat_func = stat_func or _default_stat
+        self.pattern = re.compile(pattern)
+        self.sort = sort
+        self.step = 0
+        self.activated = False
+        self.queue: List[Tuple[int, str, float]] = []
+        self.logger = logging.getLogger(__name__)
+        self._execs: List = []
+
+    # -- executor side -----------------------------------------------------
+    def install(self, exe) -> None:
+        """Attach to an Executor (called by Module.bind/fit)."""
+        if exe not in self._execs:
+            self._execs.append(exe)
+
+    def tic(self) -> None:
+        """Start of batch: decide whether this batch is monitored."""
+        self.activated = (self.step % self.interval) == 0
+        self.step += 1
+
+    def _collect(self) -> None:
+        for exe in self._execs:
+            for name, val in exe.capture_internals().items():
+                if not self.pattern.match(name):
+                    continue
+                self.queue.append(
+                    (self.step - 1, name, self.stat_func(onp.asarray(val))))
+
+    def toc(self) -> List[Tuple[int, str, float]]:
+        """End of batch: collect stats from installed executors (if this
+        batch was monitored) and return them."""
+        if not self.activated:
+            return []
+        self._collect()
+        self.activated = False
+        res, self.queue = self.queue, []
+        if self.sort:
+            res = sorted(res, key=lambda x: x[1])
+        return res
+
+    def toc_print(self) -> None:
+        for step, name, stat in self.toc():
+            self.logger.info("Batch: %7d %30s %g", step, name, stat)

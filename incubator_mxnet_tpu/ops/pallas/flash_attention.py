@@ -72,6 +72,8 @@ def _interpret_for(x) -> bool:
 
 def flash_supported(q, k, v, mask=None) -> bool:
     """Shape/backend gate used by dot_product_attention(impl='auto')."""
+    if os.environ.get("MXTPU_FLASH_ATTENTION", "1") == "0":
+        return False
     if _interpret_for(q):
         return False
     if q.ndim != 4 or k.shape != v.shape:

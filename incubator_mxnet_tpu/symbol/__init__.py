@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import json
 import sys
+import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
@@ -32,6 +33,9 @@ __all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json",
 
 _this = sys.modules[__name__]
 
+#: set while load_json rebuilds a graph — suppresses AttrScope injection
+_DESERIALIZING = threading.local()
+
 
 class Symbol:
     """A node in the symbolic DAG: either a variable (op None) or an op
@@ -44,6 +48,14 @@ class Symbol:
         self._op = op
         self._inputs = list(inputs)
         self._attrs = dict(attrs or {})
+        # Scope attributes (mx.AttrScope — group2ctx/lr_mult annotations)
+        # ride along under the _attr_ prefix; explicit node attrs win.
+        # Deserialization must NOT re-apply the ambient scope: a reloaded
+        # graph carries exactly the attrs it was saved with.
+        if not getattr(_DESERIALIZING, "flag", False):
+            from ..attribute import current_attrs
+            for k, v in current_attrs().items():
+                self._attrs.setdefault(k, v)
         self._name = name or _auto_name(op)
         self._num_outputs = num_outputs
         self._output_index = output_index
@@ -55,10 +67,17 @@ class Symbol:
         return self._name
 
     def attr(self, key: str):
-        return self._attrs.get(key)
+        """Node attribute; AttrScope-applied attributes resolve by their
+        plain name (stored internally under the ``_attr_`` prefix)."""
+        if key in self._attrs:
+            return self._attrs[key]
+        return self._attrs.get("_attr_" + key)
 
     def list_attr(self) -> Dict[str, Any]:
-        return dict(self._attrs)
+        out = {}
+        for k, v in self._attrs.items():
+            out[k[len("_attr_"):] if k.startswith("_attr_") else k] = v
+        return out
 
     def __repr__(self):
         return f"<Symbol {self._name}>"
@@ -221,12 +240,8 @@ class Symbol:
 def _auto_name(op: Optional[str]) -> str:
     if op is None:
         return "variable"
-    count = _AUTO_COUNT.setdefault(op, 0)
-    _AUTO_COUNT[op] = count + 1
-    return f"{op.lower()}{count}"
-
-
-_AUTO_COUNT: Dict[str, int] = {}
+    from ..name import NameManager
+    return NameManager.current().get(None, op.lower())
 
 
 def _topo(root: Symbol) -> List[Symbol]:
@@ -368,41 +383,47 @@ def _primary(v):
     return v[0] if isinstance(v, (tuple, list)) else v
 
 
-def _compile_fn(root: Symbol, arg_names: List[str]):
-    """Compose the DAG into one pure function of the argument arrays."""
-
-    def fn(*vals):
-        env: Dict[int, Any] = {}
-        name2val = dict(zip(arg_names, vals))
-        for node in _topo(root):
-            if node._base is not None:
-                outs = env[id(node._base)]
-                env[id(node)] = outs[node._output_index]
-                continue
-            if node._op is None:
-                if node._name not in name2val:
-                    raise MXNetError(f"unbound variable {node._name}")
-                env[id(node)] = name2val[node._name]
-                continue
-            if node._op == "_group":
-                env[id(node)] = [_primary(env[id(i)]) for i in node._inputs]
-                continue
-            ins = [_primary(env[id(i)]) for i in node._inputs]
-            attrs = {k: v for k, v in node._attrs.items()
-                     if not k.startswith("_")}
-            if node._op in _SCALAR_OPS:
-                env[id(node)] = _SCALAR_OPS[node._op](ins[0], attrs.pop("scalar"))
-                continue
+def _eval_graph(root: Symbol, arg_names: List[str], vals, sink=None):
+    """Topologically evaluate the DAG on concrete/traced arrays. When
+    ``sink`` is a dict, every op node's primary output is also recorded
+    there by name (the Monitor capture path) — one evaluator serves both so
+    the capture can never diverge from the training forward."""
+    env: Dict[int, Any] = {}
+    name2val = dict(zip(arg_names, vals))
+    for node in _topo(root):
+        if node._base is not None:
+            env[id(node)] = env[id(node._base)][node._output_index]
+            continue
+        if node._op is None:
+            if node._name not in name2val:
+                raise MXNetError(f"unbound variable {node._name}")
+            env[id(node)] = name2val[node._name]
+            continue
+        if node._op == "_group":
+            env[id(node)] = [_primary(env[id(i)]) for i in node._inputs]
+            continue
+        ins = [_primary(env[id(i)]) for i in node._inputs]
+        attrs = {k: v for k, v in node._attrs.items()
+                 if not k.startswith("_")}
+        if node._op in _SCALAR_OPS:
+            out = _SCALAR_OPS[node._op](ins[0], attrs.pop("scalar"))
+        else:
             opdef = OPS.get(node._op)
             if opdef is None:
                 raise MXNetError(f"unknown op {node._op!r} in symbol graph; "
                                  f"known ops: {len(OPS)} registered")
             out = opdef.fn(*ins, **attrs)
-            if node._op == "_group":
-                out = list(out)
-            env[id(node)] = out
-        out = env[id(root)]
-        return out
+        env[id(node)] = out
+        if sink is not None:
+            sink[node._name] = _primary(out)
+    return env[id(root)]
+
+
+def _compile_fn(root: Symbol, arg_names: List[str]):
+    """Compose the DAG into one pure function of the argument arrays."""
+
+    def fn(*vals):
+        return _eval_graph(root, arg_names, vals)
 
     return fn
 
@@ -472,6 +493,22 @@ class Executor:
                 self.arg_dict[k]._set_data(
                     v._data if isinstance(v, NDArray) else jnp.asarray(v))
 
+    def capture_internals(self) -> Dict[str, Any]:
+        """Every op node's primary output for the current arguments, keyed
+        by node name — the mx.monitor.Monitor seam. Compiled lazily as one
+        extra jit program so the normal forward stays a single fused step
+        (reference: Monitor hooks the engine's per-op execution callbacks)."""
+        if getattr(self, "_capture_fn", None) is None:
+            def cap(*vals):
+                sink: Dict[str, Any] = {}
+                _eval_graph(self._symbol, self._arg_names, vals, sink=sink)
+                return sink
+
+            self._capture_fn = jax.jit(cap)
+        vals = [self.arg_dict[n]._data for n in self._arg_names]
+        res = self._capture_fn(*vals)
+        return {k: onp.asarray(v) for k, v in res.items()}
+
 
 # ---------------------------------------------------------------------------
 # constructors + generated op namespace
@@ -492,24 +529,29 @@ def Group(symbols: Sequence[Symbol]) -> Symbol:
 def load_json(s: str) -> Symbol:
     payload = json.loads(s)
     nodes: List[Symbol] = []
-    for nd_ in payload["nodes"]:
-        if nd_["op"] == "null" and nd_.get("base") is None:
-            nodes.append(Variable(nd_["name"]))
-        else:
-            attrs = {}
-            for k, v in nd_.get("attrs", {}).items():
-                try:
-                    attrs[k] = eval(v, {"__builtins__": {}})  # reprs of py literals
-                except Exception:
-                    attrs[k] = v
-            if nd_.get("base") is not None:
-                base = nodes[nd_["base"]]
-                nodes.append(base[nd_["output_index"]])
+    _DESERIALIZING.flag = True
+    try:
+        for nd_ in payload["nodes"]:
+            if nd_["op"] == "null" and nd_.get("base") is None:
+                nodes.append(Variable(nd_["name"]))
             else:
-                ins = [nodes[i[0]] for i in nd_["inputs"]]
-                nodes.append(Symbol(nd_["op"] if nd_["op"] != "null" else None,
-                                    ins, attrs, name=nd_["name"],
-                                    num_outputs=nd_.get("num_outputs", 1)))
+                attrs = {}
+                for k, v in nd_.get("attrs", {}).items():
+                    try:
+                        attrs[k] = eval(v, {"__builtins__": {}})  # py literals
+                    except Exception:
+                        attrs[k] = v
+                if nd_.get("base") is not None:
+                    base = nodes[nd_["base"]]
+                    nodes.append(base[nd_["output_index"]])
+                else:
+                    ins = [nodes[i[0]] for i in nd_["inputs"]]
+                    nodes.append(Symbol(
+                        nd_["op"] if nd_["op"] != "null" else None,
+                        ins, attrs, name=nd_["name"],
+                        num_outputs=nd_.get("num_outputs", 1)))
+    finally:
+        _DESERIALIZING.flag = False
     return nodes[payload["heads"][0][0]]
 
 

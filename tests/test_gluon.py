@@ -437,3 +437,66 @@ def test_clip_global_norm():
     assert norm > 1.0
     total = sum(float((a * a).sum().asnumpy()) for a in arrays) ** 0.5
     assert total == pytest.approx(1.0, rel=1e-4)
+
+
+def test_contrib_nn_layers():
+    from incubator_mxnet_tpu.gluon.contrib import nn as cnn
+    from incubator_mxnet_tpu.gluon import nn
+    net = cnn.HybridConcurrent(axis=1)
+    net.add(nn.Dense(3, flatten=False))
+    net.add(cnn.Identity())
+    net.initialize()
+    net.hybridize()
+    x = mx.nd.ones((2, 4))
+    out = net(x)
+    assert out.shape == (2, 7)  # 3 (dense) + 4 (identity) on axis 1
+    emb = cnn.SparseEmbedding(10, 5)
+    emb.initialize()
+    o = emb(mx.nd.array(onp.array([[1, 2]], "float32")))
+    assert o.shape == (1, 2, 5)
+
+
+def test_contrib_rnn_cells():
+    from incubator_mxnet_tpu.gluon.contrib import rnn as crnn
+    from incubator_mxnet_tpu.gluon import rnn as grnn
+    B, T = 2, 4
+    # LSTMP: projected recurrent state
+    cell = crnn.LSTMPCell(hidden_size=8, projection_size=3, input_size=5)
+    cell.initialize()
+    x = mx.nd.array(onp.random.RandomState(0).randn(B, T, 5).astype("float32"))
+    outs, states = cell.unroll(T, x, layout="NTC", merge_outputs=True)
+    assert outs.shape == (B, T, 3)
+    assert states[0].shape == (B, 3) and states[1].shape == (B, 8)
+
+    # Conv2DLSTM over (C=1, 6, 6) frames
+    conv = crnn.Conv2DLSTMCell(input_shape=(1, 6, 6), hidden_channels=2,
+                               i2h_kernel=3, h2h_kernel=3)
+    conv.initialize()
+    frames = [mx.nd.array(onp.random.rand(B, 1, 6, 6).astype("float32"))
+              for _ in range(3)]
+    out, st = conv.unroll(3, frames, layout="NTC")
+    assert out[-1].shape == (B, 2, 6, 6)
+    assert len(st) == 2
+
+    # Conv1DGRU
+    g = crnn.Conv1DGRUCell(input_shape=(2, 7), hidden_channels=3)
+    g.initialize()
+    seq = [mx.nd.array(onp.random.rand(B, 2, 7).astype("float32"))
+           for _ in range(2)]
+    out, st = g.unroll(2, seq, layout="NTC")
+    assert out[-1].shape == (B, 3, 7)
+
+    # Variational dropout: same mask every step (training mode)
+    base = grnn.RNNCell(hidden_size=4, input_size=4)
+    vd = crnn.VariationalDropoutCell(base, drop_inputs=0.5)
+    vd.initialize()
+    ones = [mx.nd.ones((B, 4)) for _ in range(3)]
+    with mx.autograd.record(train_mode=True):
+        outs, _ = vd.unroll(3, ones, layout="NTC")
+    # masked inputs: i2h contribution identical across steps iff mask frozen.
+    # compare the dropped input the cell saw: reconstruct via mask reuse —
+    # run twice after reset, masks redrawn but within one unroll constant.
+    m1 = vd._mask_i.asnumpy()
+    assert set(onp.unique(m1).tolist()) <= {0.0, 2.0}
+    vd.reset()
+    assert vd._mask_i is None

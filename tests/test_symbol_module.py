@@ -131,3 +131,57 @@ def test_npx_aliases():
     from incubator_mxnet_tpu import numpy_extension as npx
     out = npx.softmax(mx.nd.ones((2, 3)))
     onp.testing.assert_allclose(out.asnumpy().sum(1), onp.ones(2), rtol=1e-6)
+
+
+def test_attr_scope_and_name_prefix():
+    from incubator_mxnet_tpu import name as name_mod
+    with mx.AttrScope(ctx_group="dev1", lr_mult="0.1"):
+        with name_mod.Prefix("enc_"):
+            data = mx.sym.Variable("data")
+            fc = mx.sym.FullyConnected(data, num_hidden=4)
+    assert fc.name.startswith("enc_fullyconnected")
+    assert fc.attr("ctx_group") == "dev1"
+    assert fc.attr("lr_mult") == "0.1"
+    assert fc.list_attr()["ctx_group"] == "dev1"
+    # explicit node attrs win over the scope; outside the scope: no attrs
+    fc2 = mx.sym.FullyConnected(data, num_hidden=4)
+    assert fc2.attr("ctx_group") is None
+    with pytest.raises(ValueError):
+        mx.AttrScope(bad=3)
+    # scope attrs survive the json wire format
+    rt = mx.sym.load_json(fc.tojson())
+    assert rt.attr("ctx_group") == "dev1"
+    # ...and deserializing INSIDE a scope must not stamp the ambient scope
+    # onto a graph that was saved without it
+    with mx.AttrScope(ctx_group="dev9"):
+        clean = mx.sym.load_json(fc2.tojson())
+    assert clean.attr("ctx_group") is None
+
+
+def test_print_summary(capsys):
+    from incubator_mxnet_tpu import visualization as viz
+    total = viz.print_summary(_mlp(), shape={"data": (8, 20),
+                                             "softmax_label": (8,)})
+    out = capsys.readouterr().out
+    assert "fc1 (FullyConnected)" in out
+    assert "Total params" in out
+    # fc1: 20*16+16, fc2: 16*4+4
+    assert total == 20 * 16 + 16 + 16 * 4 + 4
+
+
+def test_monitor_collects_matching_stats():
+    from incubator_mxnet_tpu import monitor as mon_mod
+    rng = onp.random.RandomState(0)
+    x = rng.randn(32, 20).astype("float32")
+    y = (x[:, 0] > 0).astype("float32")
+    it = mio.NDArrayIter(x, y, batch_size=16, label_name="softmax_label")
+    mod = mx.module.Module(_mlp(), data_names=("data",),
+                           label_names=("softmax_label",))
+    mon = mon_mod.Monitor(interval=2, pattern=".*fc.*")
+    collected = []
+    mon.toc_print = lambda: collected.extend(mon.toc())
+    mod.fit(it, num_epoch=1, monitor=mon,
+            optimizer_params=(("learning_rate", 0.01),))
+    names = {n for _, n, _ in collected}
+    assert names == {"fc1", "fc2"}   # pattern filtered, batches 0 of each pair
+    assert all(onp.isfinite(s) for _, _, s in collected)
