@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""Gluon image classification on the vision model zoo.
+
+Reference counterpart: GluonCV
+``scripts/classification/imagenet/train_imagenet.py`` shape (SURVEY §2.9),
+scaled to run anywhere: any zoo model by name, hybridized to one XLA
+program, bf16 AMP optional, kvstore-backed Trainer. Synthesizes a small
+labeled set when no RecordIO file is given.
+
+    python examples/image_classification.py --model resnet18_v1 --epochs 3
+    python examples/image_classification.py --model mobilenet0.25 --amp
+    python examples/image_classification.py --rec data/train.rec ...
+"""
+import argparse
+import os
+import sys
+
+import numpy as onp
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+import incubator_mxnet_tpu as mx  # noqa: E402
+from incubator_mxnet_tpu import gluon, io as mio  # noqa: E402
+from incubator_mxnet_tpu.gluon.model_zoo import vision  # noqa: E402
+
+
+def synthetic_iter(batch_size, classes, size, n=512):
+    rng = onp.random.RandomState(0)
+    protos = rng.rand(classes, 3, size, size).astype("float32")
+    y = rng.randint(0, classes, n)
+    x = protos[y] + 0.1 * rng.randn(n, 3, size, size).astype("float32")
+    return mio.NDArrayIter(x, y.astype("float32"), batch_size=batch_size,
+                           shuffle=True)
+
+
+def main(argv=None) -> float:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet18_v1")
+    ap.add_argument("--classes", type=int, default=8)
+    ap.add_argument("--image-size", type=int, default=32)
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--rec", default=None, help="RecordIO file (ImageRecordIter)")
+    ap.add_argument("--amp", action="store_true", help="bf16 mixed precision")
+    ap.add_argument("--kvstore", default="device")
+    args = ap.parse_args(argv)
+
+    if args.amp:
+        from incubator_mxnet_tpu import amp
+        amp.init()
+
+    kwargs = {"classes": args.classes}
+    if args.model.startswith("resnet"):
+        kwargs["thumbnail"] = args.image_size < 64
+    net = vision.get_model(args.model, **kwargs)
+    net.initialize(mx.init.Xavier(magnitude=2.0))
+    net.hybridize()
+
+    if args.rec:
+        it = mio.ImageRecordIter(
+            path_imgrec=args.rec, batch_size=args.batch_size,
+            data_shape=(3, args.image_size, args.image_size), shuffle=True)
+    else:
+        it = synthetic_iter(args.batch_size, args.classes, args.image_size)
+
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0.9},
+                            kvstore=args.kvstore)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    metric = mx.metric.Accuracy()
+    for epoch in range(args.epochs):
+        it.reset()
+        metric.reset()
+        for batch in it:
+            with mx.autograd.record():
+                out = net(batch.data[0])
+                loss = loss_fn(out, batch.label[0])
+            loss.backward()
+            trainer.step(args.batch_size)
+            metric.update(batch.label[0], out)
+        name, acc = metric.get()
+        print(f"epoch {epoch}: {name}={acc:.4f}")
+    return acc
+
+
+if __name__ == "__main__":
+    main()

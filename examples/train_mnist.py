@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""MNIST training via the legacy Module API.
+
+Reference counterpart: ``example/image-classification/train_mnist.py``
+(SURVEY §2.9 — the in-tree smoke workload). Uses the symbolic frontend +
+``Module.fit`` exactly like the reference script; synthesizes MNIST-shaped
+data when the idx files are absent (this image has no network access to
+download the real set).
+
+    python examples/train_mnist.py [--network mlp|lenet] [--num-epochs N]
+"""
+import argparse
+import os
+import sys
+
+import numpy as onp
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+import incubator_mxnet_tpu as mx  # noqa: E402
+from incubator_mxnet_tpu import io as mio  # noqa: E402
+
+
+def mlp():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=128, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    net = mx.sym.FullyConnected(net, num_hidden=64, name="fc2")
+    net = mx.sym.Activation(net, act_type="relu", name="relu2")
+    net = mx.sym.FullyConnected(net, num_hidden=10, name="fc3")
+    return mx.sym.SoftmaxOutput(net, mx.sym.Variable("softmax_label"),
+                                name="softmax")
+
+
+def lenet():
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, kernel=(5, 5), num_filter=20, name="conv1")
+    net = mx.sym.Activation(net, act_type="tanh", name="tanh1")
+    net = mx.sym.Pooling(net, pool_type="max", kernel=(2, 2), stride=(2, 2),
+                         name="pool1")
+    net = mx.sym.Convolution(net, kernel=(5, 5), num_filter=50, name="conv2")
+    net = mx.sym.Activation(net, act_type="tanh", name="tanh2")
+    net = mx.sym.Pooling(net, pool_type="max", kernel=(2, 2), stride=(2, 2),
+                         name="pool2")
+    net = mx.sym.Flatten(net, name="flatten")
+    net = mx.sym.FullyConnected(net, num_hidden=500, name="fc1")
+    net = mx.sym.Activation(net, act_type="tanh", name="tanh3")
+    net = mx.sym.FullyConnected(net, num_hidden=10, name="fc2")
+    return mx.sym.SoftmaxOutput(net, mx.sym.Variable("softmax_label"),
+                                name="softmax")
+
+
+def get_iters(batch_size: int, flat: bool, data_dir: str, n: int):
+    img = os.path.join(data_dir, "train-images-idx3-ubyte")
+    lab = os.path.join(data_dir, "train-labels-idx1-ubyte")
+    if os.path.exists(img) and os.path.exists(lab):
+        return (mio.MNISTIter(img, lab, batch_size=batch_size, flat=flat,
+                              shuffle=True),
+                None)
+    # Synthetic stand-in: 10 gaussian blobs in pixel space — learnable by
+    # both networks, zero external dependencies.
+    rng = onp.random.RandomState(0)
+    protos = rng.rand(10, 28 * 28).astype("float32")
+    y = rng.randint(0, 10, n)
+    x = protos[y] + 0.15 * rng.randn(n, 28 * 28).astype("float32")
+    x = x if flat else x.reshape(n, 1, 28, 28)
+    split = int(0.9 * n)
+    train = mio.NDArrayIter(x[:split], y[:split].astype("float32"),
+                            batch_size=batch_size, shuffle=True)
+    val = mio.NDArrayIter(x[split:], y[split:].astype("float32"),
+                          batch_size=batch_size)
+    return train, val
+
+
+def main(argv=None) -> float:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--network", choices=("mlp", "lenet"), default="mlp")
+    ap.add_argument("--num-epochs", type=int, default=5)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--data-dir", default="data/mnist")
+    ap.add_argument("--num-synthetic", type=int, default=2000)
+    args = ap.parse_args(argv)
+
+    flat = args.network == "mlp"
+    train, val = get_iters(args.batch_size, flat, args.data_dir,
+                           args.num_synthetic)
+    sym = mlp() if flat else lenet()
+    mod = mx.module.Module(sym, data_names=("data",),
+                           label_names=("softmax_label",))
+    mod.fit(train, eval_data=val, num_epoch=args.num_epochs,
+            optimizer="sgd",
+            optimizer_params=(("learning_rate", args.lr), ("momentum", 0.9)),
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 20))
+    metric = mx.metric.Accuracy()
+    res = mod.score(val if val is not None else train, metric)
+    acc = dict(res)["accuracy"]
+    print(f"final accuracy: {acc:.4f}")
+    return acc
+
+
+if __name__ == "__main__":
+    main()

@@ -29,18 +29,16 @@ class HybridConcurrent(HybridBlock):
     def __init__(self, axis: int = -1, **kwargs):
         super().__init__(**kwargs)
         self.axis = axis
-        self._children_order = []
 
     def add(self, block) -> None:
-        idx = len(self._children_order)
-        self._children_order.append(block)
-        self.register_child(block, f"branch{idx}")
+        self.register_child(block, f"branch{len(self._children)}")
 
     def hybrid_forward(self, F, x):
-        return F.concat(*[b(x) for b in self._children_order], dim=self.axis)
+        return F.concat(*[b(x) for b in self._children.values()],
+                        dim=self.axis)
 
     def __len__(self):
-        return len(self._children_order)
+        return len(self._children)
 
 
 class Concurrent(HybridConcurrent):

@@ -150,10 +150,16 @@ class _ConvRNNCellBase(HybridRecurrentCell):
 
     def __init__(self, input_shape: Sequence[int], hidden_channels: int,
                  i2h_kernel, h2h_kernel, i2h_pad=None, dims: int = 2,
-                 conv_layout: str = "NCHW", activation: str = "tanh",
+                 conv_layout: str = None, activation: str = "tanh",
                  prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
         self._dims = dims
+        expected = ("NCW", "NCHW", "NCDHW")[dims - 1]
+        if conv_layout is not None and conv_layout != expected:
+            raise MXNetError(
+                f"conv_layout {conv_layout!r} unsupported: only the channel-"
+                f"first {expected} layout lowers here (reference NHWC "
+                "layouts are a GPU-era option)")
         self._input_shape = tuple(input_shape)  # (C_in, *spatial)
         if len(self._input_shape) != dims + 1:
             raise MXNetError(

@@ -212,7 +212,19 @@ class Module(BaseModule):
                        optimizer_params=(("learning_rate", 0.01),),
                        force_init: bool = False):
         if isinstance(optimizer, str):
-            optimizer = opt_mod.create(optimizer, **dict(optimizer_params))
+            params = dict(optimizer_params)
+            if "rescale_grad" not in params:
+                # reference parity (module.py init_optimizer): the executor's
+                # backward yields batch-SUMMED gradients, so the optimizer
+                # rescales by 1/batch_size unless the caller overrode it.
+                batch_size = 0
+                if self.binded and self._input_names:
+                    first = self._exec.arg_dict.get(self._input_names[0])
+                    if first is not None and first.ndim > 0:
+                        batch_size = int(first.shape[0])
+                if batch_size:
+                    params["rescale_grad"] = 1.0 / batch_size
+            optimizer = opt_mod.create(optimizer, **params)
         self._optimizer = optimizer
         self.optimizer_initialized = True
 

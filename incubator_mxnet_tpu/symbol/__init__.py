@@ -74,9 +74,15 @@ class Symbol:
         return self._attrs.get("_attr_" + key)
 
     def list_attr(self) -> Dict[str, Any]:
+        # scope attrs (_attr_ prefixed) first, then explicit node attrs so
+        # an explicit attr of the same name wins — matching attr()
         out = {}
         for k, v in self._attrs.items():
-            out[k[len("_attr_"):] if k.startswith("_attr_") else k] = v
+            if k.startswith("_attr_"):
+                out[k[len("_attr_"):]] = v
+        for k, v in self._attrs.items():
+            if not k.startswith("_attr_"):
+                out[k] = v
         return out
 
     def __repr__(self):
@@ -319,9 +325,11 @@ _PARAM_OPS: Dict[str, tuple] = {
 _AUX_SLOTS = {"BatchNorm": ("moving_mean", "moving_var")}
 
 
-def _infer_graph_shapes(root: Symbol, known: Dict[str, tuple]):
+def _infer_graph_shapes(root: Symbol, known: Dict[str, tuple], sink=None):
     """Walk the DAG once, resolving variable shapes (data from ``known``,
-    params from consumer rules) and per-node output specs."""
+    params from consumer rules) and per-node output specs. When ``sink`` is
+    a dict it receives every node's primary output spec keyed by ``id(node)``
+    (single-pass consumer: ``visualization.print_summary``)."""
     shapes: Dict[str, tuple] = {k: tuple(v) for k, v in known.items()}
     env: Dict[int, Any] = {}
     f32 = jnp.float32
@@ -371,6 +379,10 @@ def _infer_graph_shapes(root: Symbol, known: Dict[str, tuple]):
             raise MXNetError(f"unknown op {node._op!r} in symbol graph")
         env[id(node)] = jax.eval_shape(
             lambda *a, _f=opdef.fn, _at=attrs: _f(*a, **_at), *ins)
+    if sink is not None:
+        for nid, v in env.items():
+            spec = v[0] if isinstance(v, (list, tuple)) else v
+            sink[nid] = spec
     out = env[id(root)]
     out_specs = out if isinstance(out, (list, tuple)) else [out]
     return shapes, out_specs
@@ -415,7 +427,14 @@ def _eval_graph(root: Symbol, arg_names: List[str], vals, sink=None):
             out = opdef.fn(*ins, **attrs)
         env[id(node)] = out
         if sink is not None:
-            sink[node._name] = _primary(out)
+            # distinct nodes can share an auto-name (separate NameManager
+            # scopes/threads) — disambiguate instead of silently clobbering
+            key = node._name
+            n = 2
+            while key in sink:
+                key = f"{node._name}#{n}"
+                n += 1
+            sink[key] = _primary(out)
     return env[id(root)]
 
 
@@ -532,24 +551,23 @@ def load_json(s: str) -> Symbol:
     _DESERIALIZING.flag = True
     try:
         for nd_ in payload["nodes"]:
-            if nd_["op"] == "null" and nd_.get("base") is None:
-                nodes.append(Variable(nd_["name"]))
+            attrs = {}
+            for k, v in nd_.get("attrs", {}).items():
+                try:
+                    attrs[k] = eval(v, {"__builtins__": {}})  # py literals
+                except Exception:
+                    attrs[k] = v
+            if nd_.get("base") is not None:
+                base = nodes[nd_["base"]]
+                nodes.append(base[nd_["output_index"]])
             else:
-                attrs = {}
-                for k, v in nd_.get("attrs", {}).items():
-                    try:
-                        attrs[k] = eval(v, {"__builtins__": {}})  # py literals
-                    except Exception:
-                        attrs[k] = v
-                if nd_.get("base") is not None:
-                    base = nodes[nd_["base"]]
-                    nodes.append(base[nd_["output_index"]])
-                else:
-                    ins = [nodes[i[0]] for i in nd_["inputs"]]
-                    nodes.append(Symbol(
-                        nd_["op"] if nd_["op"] != "null" else None,
-                        ins, attrs, name=nd_["name"],
-                        num_outputs=nd_.get("num_outputs", 1)))
+                ins = [nodes[i[0]] for i in nd_["inputs"]]
+                # variable nodes keep their attrs too (AttrScope lr_mult /
+                # ctx_group annotations must survive the wire format)
+                nodes.append(Symbol(
+                    nd_["op"] if nd_["op"] != "null" else None,
+                    ins, attrs, name=nd_["name"],
+                    num_outputs=nd_.get("num_outputs", 1)))
     finally:
         _DESERIALIZING.flag = False
     return nodes[payload["heads"][0][0]]

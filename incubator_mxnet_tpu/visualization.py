@@ -11,8 +11,6 @@ from typing import Dict, Optional
 
 import numpy as onp
 
-from .base import MXNetError
-
 __all__ = ["print_summary", "plot_network"]
 
 
@@ -36,9 +34,9 @@ def print_summary(symbol, shape: Optional[Dict[str, tuple]] = None,
     from .symbol import _infer_graph_shapes, _topo
 
     shapes: Dict[str, tuple] = {}
-    out_shapes_by_node: Dict[int, object] = {}
+    specs_by_node: Dict[int, object] = {}
     if shape:
-        shapes, _ = _infer_graph_shapes(symbol, shape)
+        shapes, _ = _infer_graph_shapes(symbol, shape, sink=specs_by_node)
     data_names = set(shape or ())
 
     positions = [int(line_length * p) for p in positions]
@@ -66,13 +64,8 @@ def print_summary(symbol, shape: Optional[Dict[str, tuple]] = None,
             continue
         out_shape = ""
         if shape:
-            try:
-                _, out_specs = _infer_graph_shapes(node, shapes)
-                out_shape = tuple(out_specs[0].shape)
-            except MXNetError:
-                out_shape = "?"
-            except Exception:
-                out_shape = "?"
+            spec = specs_by_node.get(id(node))
+            out_shape = tuple(spec.shape) if spec is not None else "?"
         n_params = _node_params(node, shapes, data_names) if shape else 0
         total += n_params
         prev = ",".join(i._name for i in node._inputs if i._op is not None

@@ -1,0 +1,37 @@
+"""The example scripts must run end-to-end and learn (reference mechanism:
+tests/python/train/ convergence smoke tests, SURVEY §4.6)."""
+import importlib.util
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load(name):
+    path = os.path.join(REPO, "examples", name)
+    spec = importlib.util.spec_from_file_location(name[:-3], path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_train_mnist_example_converges():
+    acc = _load("train_mnist.py").main(
+        ["--num-epochs", "2", "--num-synthetic", "600"])
+    assert acc > 0.9, acc
+
+
+def test_image_classification_example_learns():
+    acc = _load("image_classification.py").main(
+        ["--model", "mobilenet0.25", "--epochs", "2", "--classes", "4",
+         "--batch-size", "16"])
+    assert acc > 0.5, acc
+
+
+def test_bert_pretraining_example_runs():
+    loss = _load("bert_pretraining.py").main(
+        ["--model", "bert_2_128_2", "--steps", "6", "--batch-size", "4",
+         "--seq-len", "64"])
+    assert loss == loss and loss < 20.0  # finite, sane

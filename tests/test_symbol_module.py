@@ -156,6 +156,16 @@ def test_attr_scope_and_name_prefix():
     with mx.AttrScope(ctx_group="dev9"):
         clean = mx.sym.load_json(fc2.tojson())
     assert clean.attr("ctx_group") is None
+    # variable-node annotations survive the roundtrip too
+    with mx.AttrScope(lr_mult="0.1"):
+        w = mx.sym.Variable("w_annotated")
+    assert mx.sym.load_json(w.tojson()).attr("lr_mult") == "0.1"
+    # explicit node attr beats the ambient scope in list_attr, like attr()
+    with mx.AttrScope(ctx_group="scope"):
+        from incubator_mxnet_tpu.symbol import Symbol
+        n = Symbol(None, [], attrs={"ctx_group": "explicit"}, name="n0")
+    assert n.attr("ctx_group") == "explicit"
+    assert n.list_attr()["ctx_group"] == "explicit"
 
 
 def test_print_summary(capsys):
