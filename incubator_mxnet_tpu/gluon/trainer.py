@@ -106,8 +106,20 @@ class Trainer:
         self._optimizer.set_learning_rate(lr)
 
     def _row_sparse_pull(self, parameter, out, row_id, full_idx=False):
-        # Sparse pull is a PS-era optimization; dense on TPU.
-        pass
+        """Pull the parameter rows named by ``row_id`` into ``out``
+        (reference: Trainer._row_sparse_pull behind sparse Embedding).
+        full_idx=True means the caller wants every row — a plain copy."""
+        if parameter not in self._params:
+            raise MXNetError("parameter is not managed by this Trainer")
+        # This Trainer applies optimizer updates locally (update-on-kvstore
+        # is the mesh/ShardedTrainer path), so the live weight is the
+        # parameter itself — the kvstore copy is only the init snapshot.
+        from ..kvstore import _select_rows
+        w = parameter.data()._data
+        if full_idx:
+            out._set_data(w.astype(out.dtype))
+            return
+        out._set_data(_select_rows(w, row_id).astype(out.dtype))
 
     def allreduce_grads(self):
         """Sum gradients across parameter replicas (kvstore push/pull —

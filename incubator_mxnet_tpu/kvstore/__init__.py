@@ -53,6 +53,15 @@ P = PartitionSpec
 _AR_CACHE: Dict[tuple, Callable] = {}
 
 
+def _select_rows(w: jax.Array, ids) -> jax.Array:
+    """Dense row-select: zeros everywhere except rows named by ``ids``, which
+    carry ``w``'s values — the dense-facade reading of a row_sparse pull.
+    Shared by KVStore.row_sparse_pull and Trainer._row_sparse_pull."""
+    idx = (ids._data if isinstance(ids, NDArray)
+           else jnp.asarray(ids)).astype(jnp.int32).reshape(-1)
+    return jnp.zeros_like(w).at[idx].set(w[idx])
+
+
 def _allreduce_fn(mesh: Mesh, sig: tuple) -> Callable:
     """Compiled all-reduce over the leading (device) axis for a tuple of
     stacked arrays — ONE executable for the whole key batch; XLA emits one
@@ -328,8 +337,42 @@ class KVStore(KVStoreBase):
         return results if isinstance(key, (list, tuple)) else results[0]
 
     def row_sparse_pull(self, key, out=None, priority: int = 0, row_ids=None):
-        # Dense on TPU (SURVEY §7 sparse scoping) — full pull.
-        return self.pull(key, out=out, priority=priority)
+        """Pull only the rows named by ``row_ids`` (reference:
+        KVStore.row_sparse_pull over row_sparse values). Storage here is the
+        dense facade (SURVEY §7 sparse scoping), so the result is a dense
+        array with the requested rows populated and every other row zero —
+        the same values a reference caller reads out of the returned
+        row_sparse array, without the index bookkeeping."""
+        if row_ids is None:
+            return self.pull(key, out=out, priority=priority)
+        keys = self._keys(key)
+        if out is None:
+            raise MXNetError("row_sparse_pull needs out= when row_ids given")
+        if isinstance(key, (list, tuple)):
+            # multi-key: out / row_ids are per-key lists
+            outs = list(out)
+            ids_list = list(row_ids) if isinstance(row_ids, (list, tuple)) \
+                else [row_ids] * len(keys)
+        else:
+            # single key: the reference pairs row_ids with OUT slots —
+            # kv.row_sparse_pull('emb', out=[o1, o2], row_ids=[r1, r2])
+            # fills each out with its own row set
+            keys = keys * (len(out) if isinstance(out, (list, tuple)) else 1)
+            outs = list(out) if isinstance(out, (list, tuple)) else [out]
+            ids_list = list(row_ids) if isinstance(row_ids, (list, tuple)) \
+                else [row_ids] * len(outs)
+        if not len(keys) == len(outs) == len(ids_list):
+            raise MXNetError(
+                f"row_sparse_pull: mismatched lengths — {len(keys)} keys, "
+                f"{len(outs)} outs, {len(ids_list)} row_ids")
+        for k, o, ids in zip(keys, outs, ids_list):
+            src = self._store.get(k)
+            if src is None:
+                raise MXNetError(f"key {k!r} was never initialized")
+            rows = _select_rows(src._data, ids)
+            for oo in (o if isinstance(o, (list, tuple)) else [o]):
+                oo._set_data(rows.astype(oo.dtype))
+        return out
 
     # -- server-side optimizer (update_on_kvstore) -------------------------
     def set_updater(self, updater: Callable):

@@ -513,6 +513,94 @@ def smooth_l1(data, scalar=1.0, **_):
     return jnp.where(a < 1.0 / s2, 0.5 * s2 * jnp.square(data), a - 0.5 / s2)
 
 
+def _loss_output(fwd_fn, grad_fn):
+    """Output-head factory (reference: regression_output-inl.h family):
+    forward applies ``fwd_fn``; backward IGNORES the incoming head gradient
+    and emits the fused loss gradient ``grad_fn(pred, label)`` — Module-era
+    nets end in these and call backward() with no explicit loss."""
+
+    @jax.custom_vjp
+    def _f(x, lab):
+        return fwd_fn(x)
+
+    def _vfwd(x, lab):
+        p = fwd_fn(x)
+        return p, (p, lab)
+
+    def _vbwd(res, g):
+        p, lab = res
+        return grad_fn(p, lab.astype(p.dtype)), jnp.zeros_like(lab)
+
+    _f.defvjp(_vfwd, _vbwd)
+    return _f
+
+
+@register_op("LinearRegressionOutput", aliases=("linear_regression_output",))
+def linear_regression_output(data, label, grad_scale=1.0, **_):
+    """Identity forward; backward = (pred − label)·grad_scale (reference:
+    src/operator/regression_output.cc LinearRegressionOutput)."""
+    return _loss_output(lambda x: x,
+                        lambda p, l: (p - l) * grad_scale)(data, label)
+
+
+@register_op("LogisticRegressionOutput", aliases=("logistic_regression_output",))
+def logistic_regression_output(data, label, grad_scale=1.0, **_):
+    """Sigmoid forward; backward = (σ(x) − label)·grad_scale (reference:
+    regression_output.cc LogisticRegressionOutput)."""
+    return _loss_output(jax.nn.sigmoid,
+                        lambda p, l: (p - l) * grad_scale)(data, label)
+
+
+@register_op("MAERegressionOutput", aliases=("mae_regression_output",))
+def mae_regression_output(data, label, grad_scale=1.0, **_):
+    """Identity forward; backward = sign(pred − label)·grad_scale
+    (reference: regression_output.cc MAERegressionOutput)."""
+    return _loss_output(lambda x: x,
+                        lambda p, l: jnp.sign(p - l) * grad_scale)(data, label)
+
+
+@register_op("SVMOutput", aliases=("svm_output",))
+def svm_output(data, label, margin=1.0, regularization_coefficient=1.0,
+               use_linear=False, **_):
+    """One-vs-all SVM output head (reference: src/operator/svm_output.cc):
+    identity forward over class scores; backward is the hinge-loss gradient —
+    L2-SVM by default, L1-SVM (linear) with ``use_linear``. Per class c the
+    sign is +1 for the labeled class, −1 otherwise."""
+    reg = regularization_coefficient
+
+    def _grad(p, lab):
+        k = p.shape[-1]
+        y = 2.0 * jax.nn.one_hot(lab.astype(jnp.int32), k, dtype=p.dtype) - 1.0
+        viol = margin - y * p          # >0 where the margin is violated
+        active = (viol > 0).astype(p.dtype)
+        if use_linear:
+            return -reg * y * active
+        return -2.0 * reg * y * viol * active
+
+    return _loss_output(lambda x: x, _grad)(data, label)
+
+
+@register_op("LRN", aliases=("lrn",), schema=Schema(
+    alpha=Field(float, 1e-4, "Scale of the squared local sum."),
+    beta=Field(float, 0.75, "Exponent of the normalizer."),
+    knorm=Field(float, 2.0, "Additive constant."),
+    nsize=Field(int, 5, "Channel window (normalization width).", ge=1),
+))
+def lrn(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5, **_):
+    """Across-channel local response normalization over NCHW (reference:
+    src/operator/nn/lrn.cc — the AlexNet normalizer):
+    ``out = x · (knorm + α/n · Σ_{local} x²)^{−β}``. The channel-window sum
+    lowers to reduce_window, which XLA fuses with the pointwise tail."""
+    sq = jnp.square(data).astype(jnp.float32)
+    half = nsize // 2
+    local = jax.lax.reduce_window(
+        sq, 0.0, jax.lax.add,
+        window_dimensions=(1, nsize, 1, 1), window_strides=(1, 1, 1, 1),
+        padding=((0, 0), (half, nsize - 1 - half), (0, 0), (0, 0)))
+    norm = jnp.power(knorm + (alpha / nsize) * local, -beta)
+    return (data.astype(jnp.float32) * norm).astype(data.dtype)
+
+
 # ---------------------------------------------------------------------------
 # Dropout (reference: dropout.cc — cuDNN dropout state ≙ explicit key)
 # ---------------------------------------------------------------------------

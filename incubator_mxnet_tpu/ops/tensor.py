@@ -744,3 +744,56 @@ def linalg_makediag(A, offset=0, **_):
 def embedding(data, weight, input_dim=None, output_dim=None, dtype="float32", sparse_grad=False, **_):
     idx = jnp.clip(data.astype(jnp.int32), 0, weight.shape[0] - 1)
     return jnp.take(weight, idx, axis=0)
+
+
+# ---------------------------------------------------------------------------
+# np-compat additions (reference: tensor/ np ops — cumsum/cumprod/trace/kron/
+# bincount/digamma)
+# ---------------------------------------------------------------------------
+
+@register_op("cumsum")
+def cumsum(a, axis=None, dtype=None, **_):
+    out = jnp.cumsum(a, axis=axis)
+    return out.astype(dtype) if dtype else out
+
+
+@register_op("cumprod")
+def cumprod(a, axis=None, dtype=None, **_):
+    out = jnp.cumprod(a, axis=axis)
+    return out.astype(dtype) if dtype else out
+
+
+@register_op("trace")
+def trace(a, offset=0, axis1=0, axis2=1, **_):
+    return jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@register_op("kron")
+def kron(a, b, **_):
+    return jnp.kron(a, b)
+
+
+@register_op("digamma")
+def digamma(a, **_):
+    return jax.scipy.special.digamma(a)
+
+
+@register_op("bincount")
+def bincount(a, weights=None, minlength=0, **_):
+    """Histogram of non-negative ints. ``minlength`` doubles as the STATIC
+    output length under jit (XLA needs static shapes); eager calls without
+    it size the output from the data like numpy."""
+    x = a.astype(jnp.int32).reshape(-1)
+    try:
+        # eager: numpy semantics — minlength is a FLOOR, the output grows
+        # to hold the largest value (builtins.max: the module-level `max`
+        # is the registered reduction op)
+        length = builtins.max(int(minlength),
+                              (int(jnp.max(x)) + 1) if x.size else 1)
+    except jax.errors.ConcretizationTypeError:
+        if not minlength:
+            raise ValueError(
+                "bincount under jit needs minlength= (static output shape)")
+        length = int(minlength)  # jit: static cap, out-of-range dropped
+    w = None if weights is None else weights.reshape(-1)
+    return jnp.bincount(x, weights=w, minlength=length, length=length)

@@ -159,3 +159,60 @@ def test_trainer_compression_on_default_kvstore_not_dropped():
     tr._init_kvstore()
     assert tr._kvstore is not None
     assert tr._kvstore._compression.get("type") == "2bit"
+
+
+def test_row_sparse_pull_selects_rows():
+    kv = mx.kv.create("local")
+    w = onp.arange(12, dtype="float32").reshape(4, 3)
+    kv.init("emb", mx.nd.array(w))
+    out = mx.nd.zeros((4, 3))
+    kv.row_sparse_pull("emb", out=out, row_ids=mx.nd.array(
+        onp.array([0, 2], "float32")))
+    got = out.asnumpy()
+    onp.testing.assert_allclose(got[0], w[0])
+    onp.testing.assert_allclose(got[2], w[2])
+    onp.testing.assert_allclose(got[1], 0)
+    onp.testing.assert_allclose(got[3], 0)
+    with pytest.raises(mx.MXNetError):
+        kv.row_sparse_pull("emb", row_ids=mx.nd.array([0.0]))  # out required
+
+
+def test_trainer_row_sparse_pull_serves_live_rows():
+    from incubator_mxnet_tpu import gluon
+    net = gluon.nn.Embedding(6, 4)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    param = list(net.collect_params().values())[0]
+    out = mx.nd.zeros((6, 4))
+    trainer._row_sparse_pull(param, out, mx.nd.array([1.0, 3.0]))
+    got = out.asnumpy()
+    ref = param.data().asnumpy()
+    onp.testing.assert_allclose(got[1], ref[1])
+    onp.testing.assert_allclose(got[3], ref[3])
+    onp.testing.assert_allclose(got[0], 0)
+    full = mx.nd.zeros((6, 4))
+    trainer._row_sparse_pull(param, full, None, full_idx=True)
+    onp.testing.assert_allclose(full.asnumpy(), ref)
+
+
+def test_row_sparse_pull_single_key_multi_out():
+    kv = mx.kv.create("local")
+    w = onp.arange(8, dtype="float32").reshape(4, 2)
+    kv.init("emb", mx.nd.array(w))
+    o1, o2 = mx.nd.zeros((4, 2)), mx.nd.zeros((4, 2))
+    kv.row_sparse_pull("emb", out=[o1, o2],
+                       row_ids=[mx.nd.array([0.0]), mx.nd.array([3.0])])
+    onp.testing.assert_allclose(o1.asnumpy()[0], w[0])
+    onp.testing.assert_allclose(o1.asnumpy()[3], 0)
+    onp.testing.assert_allclose(o2.asnumpy()[3], w[3])
+    onp.testing.assert_allclose(o2.asnumpy()[0], 0)
+    with pytest.raises(mx.MXNetError):
+        kv.row_sparse_pull("emb", out=[o1, o2],
+                           row_ids=[mx.nd.array([0.0])] * 3)
+
+
+def test_bincount_eager_grows_past_minlength():
+    out = mx.nd.bincount(mx.nd.array(onp.array([7.0])), minlength=5)
+    ref = onp.bincount(onp.array([7]), minlength=5)
+    onp.testing.assert_allclose(out.asnumpy(), ref)

@@ -402,3 +402,83 @@ def test_make_loss_gradient_semantics():
         l = mx.nd.MakeLoss(x, grad_scale=3.0)
     l.backward()
     onp.testing.assert_allclose(x.grad.asnumpy(), [3.0, 3.0])
+
+
+def test_lrn_matches_manual():
+    x = onp.random.RandomState(3).randn(2, 7, 3, 3).astype("float32")
+    out = mx.nd.LRN(mx.nd.array(x), nsize=5, alpha=1e-4, beta=0.75,
+                    knorm=2.0).asnumpy()
+    ref = onp.empty_like(x)
+    for c in range(7):
+        lo, hi = max(0, c - 2), min(7, c + 3)
+        s = (x[:, lo:hi] ** 2).sum(1)
+        ref[:, c] = x[:, c] * (2.0 + 1e-4 / 5 * s) ** -0.75
+    onp.testing.assert_allclose(out, ref, rtol=2e-5)
+
+
+def test_regression_output_heads():
+    d = mx.nd.array(onp.array([[0.5, -1.0]], "float32"))
+    lab = mx.nd.array(onp.array([[1.0, 0.0]], "float32"))
+    d.attach_grad()
+    with mx.autograd.record():
+        y = mx.nd.LinearRegressionOutput(d, lab, grad_scale=2.0)
+    y.backward()
+    onp.testing.assert_allclose(y.asnumpy(), d.asnumpy())
+    onp.testing.assert_allclose(d.grad.asnumpy(), [[-1.0, -2.0]], rtol=1e-6)
+
+    d2 = mx.nd.array(onp.array([[0.0, 2.0]], "float32"))
+    d2.attach_grad()
+    with mx.autograd.record():
+        y2 = mx.nd.LogisticRegressionOutput(d2, lab)
+    y2.backward()
+    sig = 1 / (1 + onp.exp(-d2.asnumpy()))
+    onp.testing.assert_allclose(y2.asnumpy(), sig, rtol=1e-6)
+    onp.testing.assert_allclose(d2.grad.asnumpy(), sig - lab.asnumpy(),
+                                rtol=1e-6)
+
+    d3 = mx.nd.array(onp.array([[0.5, -1.0]], "float32"))
+    d3.attach_grad()
+    with mx.autograd.record():
+        y3 = mx.nd.MAERegressionOutput(d3, lab)
+    y3.backward()
+    onp.testing.assert_allclose(d3.grad.asnumpy(), [[-1.0, -1.0]])
+
+
+def test_svm_output_hinge_gradients():
+    # class 0 true; scores violate the margin for both classes
+    d = mx.nd.array(onp.array([[0.2, 0.5]], "float32"))
+    d.attach_grad()
+    with mx.autograd.record():
+        y = mx.nd.SVMOutput(d, mx.nd.array(onp.array([0.0], "float32")),
+                            use_linear=True)
+    y.backward()
+    # y0=+1: viol=1-0.2=0.8>0 -> -1; y1=-1: viol=1+0.5=1.5>0 -> +1
+    onp.testing.assert_allclose(d.grad.asnumpy(), [[-1.0, 1.0]])
+    # L2-SVM scales by 2*viol
+    d2 = mx.nd.array(onp.array([[0.2, 0.5]], "float32"))
+    d2.attach_grad()
+    with mx.autograd.record():
+        y2 = mx.nd.SVMOutput(d2, mx.nd.array(onp.array([0.0], "float32")))
+    y2.backward()
+    onp.testing.assert_allclose(d2.grad.asnumpy(), [[-1.6, 3.0]], rtol=1e-6)
+
+
+def test_np_compat_additions():
+    a = mx.nd.array(onp.arange(6, dtype="float32").reshape(2, 3))
+    onp.testing.assert_allclose(mx.nd.cumsum(a, axis=1).asnumpy(),
+                                onp.cumsum(a.asnumpy(), axis=1))
+    onp.testing.assert_allclose(mx.nd.cumprod(a + 1, axis=0).asnumpy(),
+                                onp.cumprod(a.asnumpy() + 1, axis=0))
+    onp.testing.assert_allclose(mx.nd.trace(a).asnumpy(),
+                                onp.trace(a.asnumpy()))
+    b = mx.nd.array(onp.array([[0.0, 1.0], [1.0, 0.0]], "float32"))
+    onp.testing.assert_allclose(mx.nd.kron(b, a).asnumpy(),
+                                onp.kron(b.asnumpy(), a.asnumpy()))
+    onp.testing.assert_allclose(
+        mx.nd.bincount(mx.nd.array(onp.array([0, 1, 1, 3], "float32")),
+                       minlength=5).asnumpy(),
+        onp.bincount(onp.array([0, 1, 1, 3]), minlength=5))
+    from scipy import special as _sp  # scipy ships with jax
+    onp.testing.assert_allclose(
+        mx.nd.digamma(a + 1).asnumpy(), _sp.digamma(a.asnumpy() + 1),
+        rtol=1e-5)
