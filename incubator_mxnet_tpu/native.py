@@ -101,6 +101,27 @@ def _lib() -> ctypes.CDLL:
             ctypes.POINTER(ctypes.c_int64), ctypes.c_int]
         lib.MXTPUEngineWaitAll.argtypes = [ctypes.c_void_p]
         lib.MXTPUEngineFree.argtypes = [ctypes.c_void_p]
+        lib.MXTPUParamsWriterCreate.restype = ctypes.c_void_p
+        lib.MXTPUParamsWriterCreate.argtypes = [ctypes.c_char_p]
+        lib.MXTPUParamsWriterAdd.restype = ctypes.c_int
+        lib.MXTPUParamsWriterAdd.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32, ctypes.c_uint32,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_void_p, ctypes.c_uint64]
+        lib.MXTPUParamsWriterFinish.restype = ctypes.c_int
+        lib.MXTPUParamsWriterFinish.argtypes = [ctypes.c_void_p]
+        lib.MXTPUParamsWriterFree.argtypes = [ctypes.c_void_p]
+        lib.MXTPUParamsReaderCreate.restype = ctypes.c_void_p
+        lib.MXTPUParamsReaderCreate.argtypes = [ctypes.c_char_p]
+        lib.MXTPUParamsReaderCount.restype = ctypes.c_int64
+        lib.MXTPUParamsReaderCount.argtypes = [ctypes.c_void_p]
+        lib.MXTPUParamsReaderGet.restype = ctypes.c_int
+        lib.MXTPUParamsReaderGet.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_int64)),
+            ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_uint64)]
+        lib.MXTPUParamsReaderFree.argtypes = [ctypes.c_void_p]
         _LIB = lib
         return _LIB
 
@@ -304,3 +325,67 @@ class NativeEngine:
             self.close()
         except Exception:
             pass
+
+
+# ---------------------------------------------------------------------------
+# dmlc .params container (NDArray::Save/Load parity)
+# ---------------------------------------------------------------------------
+
+def params_save(path: str, arrays, names, dtype_flags) -> None:
+    """Write the kMXAPINDArrayListMagic container natively. ``arrays`` are
+    C-contiguous numpy arrays, ``dtype_flags`` their mshadow type flags
+    (serialization._DTYPE_TO_FLAG)."""
+    lib = _lib()
+    h = lib.MXTPUParamsWriterCreate(path.encode())
+    if not h:
+        raise MXNetError(last_error())
+    try:
+        for i, (a, flag) in enumerate(zip(arrays, dtype_flags)):
+            # unnamed list saves carry no names section (names may be empty
+            # or shorter than arrays) — NULL name marks "unnamed"
+            name = names[i].encode() if i < len(names) else None
+            shape = (ctypes.c_int64 * max(1, a.ndim))(*a.shape)
+            if lib.MXTPUParamsWriterAdd(
+                    h, name, flag, a.ndim, shape,
+                    a.ctypes.data_as(ctypes.c_void_p), a.nbytes) != 0:
+                raise MXNetError(last_error())
+        if lib.MXTPUParamsWriterFinish(h) != 0:
+            raise MXNetError(last_error())
+    finally:
+        lib.MXTPUParamsWriterFree(h)
+
+
+def params_load(path: str):
+    """Read a dmlc .params container natively → (arrays, names, flags).
+    Raises MXNetError on any layout the C++ reader doesn't cover (V1/legacy/
+    sparse records) — the caller falls back to the Python reader."""
+    import numpy as onp
+    lib = _lib()
+    h = lib.MXTPUParamsReaderCreate(path.encode())
+    if not h:
+        raise MXNetError(last_error())
+    try:
+        n = lib.MXTPUParamsReaderCount(h)
+        arrays, names, flags = [], [], []
+        for i in range(n):
+            name = ctypes.c_char_p()
+            flag = ctypes.c_int32()
+            ndim = ctypes.c_uint32()
+            shape_p = ctypes.POINTER(ctypes.c_int64)()
+            data_p = ctypes.c_void_p()
+            nbytes = ctypes.c_uint64()
+            if lib.MXTPUParamsReaderGet(
+                    h, i, ctypes.byref(name), ctypes.byref(flag),
+                    ctypes.byref(ndim), ctypes.byref(shape_p),
+                    ctypes.byref(data_p), ctypes.byref(nbytes)) != 0:
+                raise MXNetError(last_error())
+            shape = tuple(shape_p[d] for d in range(ndim.value))
+            raw = ctypes.string_at(data_p, nbytes.value) if nbytes.value \
+                else b""
+            arrays.append((shape, raw))
+            if name.value is not None:  # NULL ⇒ unnamed list save
+                names.append(name.value.decode())
+            flags.append(flag.value)
+        return arrays, names, flags
+    finally:
+        lib.MXTPUParamsReaderFree(h)

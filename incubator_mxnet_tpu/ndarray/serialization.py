@@ -82,6 +82,15 @@ def _write_ndarray(f, arr: onp.ndarray) -> None:
 
 
 def _read_exact(f, n: int) -> bytes:
+    # corrupt-size guard: never allocate more than the file can supply
+    # (a crafted record can declare a 2^45-element shape)
+    import os as _os
+    try:
+        remaining = _os.fstat(f.fileno()).st_size - f.tell()
+    except (OSError, AttributeError):
+        remaining = None
+    if remaining is not None and n > remaining:
+        raise MXNetError("truncated dmlc NDArray stream")
     b = f.read(n)
     if len(b) != n:
         raise MXNetError("truncated dmlc NDArray stream")
@@ -124,10 +133,41 @@ def _read_ndarray(f) -> onp.ndarray:
     return onp.frombuffer(data, dtype=dt).reshape(shape).copy()
 
 
+def _native_flags(arrays):
+    """Per-array mshadow type flags for the native writer, or None when an
+    array needs the Python path (unmapped dtype)."""
+    flags = []
+    for a in arrays:
+        name = "bfloat16" if a.dtype.name == "bfloat16" else a.dtype.name
+        if name not in _DTYPE_TO_FLAG:
+            return None
+        flags.append(_DTYPE_TO_FLAG[name])
+    return flags
+
+
 def dmlc_save(fname: str,
               arrays: Sequence[onp.ndarray],
               names: Sequence[str]) -> None:
-    """Write the kMXAPINDArrayListMagic container (upstream `.params`)."""
+    """Write the kMXAPINDArrayListMagic container (upstream `.params`).
+
+    Uses the C++ writer (``native.params_save`` — NDArray::Save parity) when
+    the shim is available; the Python path below is the fallback and the
+    format's executable spec. Both emit byte-identical V2 containers
+    (interop-tested)."""
+    arrays = [onp.ascontiguousarray(a if a.ndim else a.reshape(1))
+              for a in arrays]
+    from .. import native
+    flags = _native_flags(arrays)
+    # the native writer handles all-named or all-unnamed saves; a partial
+    # names list (error case surfaced at load) stays on the python writer
+    if len(names) not in (0, len(arrays)):
+        flags = None
+    if flags is not None and native.available():
+        try:
+            native.params_save(fname, arrays, list(names), flags)
+            return
+        except MXNetError:
+            pass  # fall through to the Python writer
     with open(fname, "wb") as f:
         f.write(struct.pack("<QQ", DMLC_LIST_MAGIC, 0))
         f.write(struct.pack("<Q", len(arrays)))
@@ -144,8 +184,29 @@ def dmlc_load(fname: str):
     """Read an upstream `.params` file → (list_of_arrays, list_of_names).
 
     Raises MXNetError if the list magic doesn't match (caller falls back to
-    the pickle container).
+    the pickle container). The C++ reader handles the common V2/V3 dense
+    layout; V1/legacy/sparse records drop to this Python reader.
     """
+    from .. import native
+    if native.available():
+        try:
+            raw, names, flags = native.params_load(fname)
+            arrays = []
+            for (shape, data), flag in zip(raw, flags):
+                if flag not in _FLAG_TO_DTYPE:
+                    raise MXNetError(f"unknown dmlc type flag {flag}")
+                dt = _np_dtype(_FLAG_TO_DTYPE[flag])
+                if not shape:  # upstream "none" record
+                    arrays.append(onp.zeros((0,), "float32"))
+                    continue
+                arrays.append(onp.frombuffer(data, dtype=dt)
+                              .reshape(shape).copy())
+            return arrays, names
+        except (MXNetError, ValueError):
+            # V1/legacy/sparse, non-dmlc, or corrupt-record payloads: the
+            # python reader below is the arbiter (it raises NotDmlcFile
+            # only on container-magic mismatch, MXNetError otherwise)
+            pass
     with open(fname, "rb") as f:
         head = f.read(16)
         if len(head) != 16:

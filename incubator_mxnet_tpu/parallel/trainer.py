@@ -21,6 +21,7 @@ Usage::
 """
 from __future__ import annotations
 
+import os
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -257,7 +258,21 @@ class ShardedTrainer:
         for p, v in zip(self._params, self._param_vals):
             p.set_data(NDArray(jax.device_get(v), ctx=self._ctx))
 
-    def save_states(self, fname: str) -> None:
+    def save_states(self, fname: str, backend: str = "pickle") -> None:
+        """Checkpoint parameters + optimizer state + step counter.
+
+        ``backend='pickle'`` (default: one host-side file, reference
+        Trainer.save_states shape) or ``'orbax'`` (a DIRECTORY written by
+        orbax/TensorStore — each shard saved from its own device without a
+        full host gather, the multi-controller-safe path SURVEY §5.4's TPU
+        mapping prescribes). Opt-in, so existing extension-less paths keep
+        producing a single pickle file; ``load_states`` auto-detects either.
+        """
+        if backend == "orbax":
+            self._save_states_orbax(fname)
+            return
+        if backend != "pickle":
+            raise MXNetError(f"unknown checkpoint backend {backend!r}")
         import pickle
         state = {
             "t": self._t,
@@ -267,15 +282,39 @@ class ShardedTrainer:
         with open(fname, "wb") as f:
             pickle.dump(state, f)
 
-    def load_states(self, fname: str) -> None:
+    def _ckpt_tree(self):
+        return {"param_vals": list(self._param_vals),
+                "opt_states": [list(s) for s in self._opt_states]}
+
+    def _save_states_orbax(self, path: str) -> None:
+        try:
+            import orbax.checkpoint as ocp
+        except ImportError as e:
+            raise MXNetError(
+                "backend='orbax' needs the orbax-checkpoint package") from e
+        path = os.path.abspath(path)
+        with ocp.Checkpointer(ocp.CompositeCheckpointHandler()) as ckptr:
+            ckptr.save(
+                path,
+                args=ocp.args.Composite(
+                    state=ocp.args.PyTreeSave(self._ckpt_tree()),
+                    meta=ocp.args.JsonSave({"t": self._t})),
+                force=True)
+
+    def load_states(self, fname: str, backend: str = "auto") -> None:
+        if self._params is None:
+            raise MXNetError("call step() once (or _init_state) before "
+                             "load_states so the parameter set exists")
+        if backend == "auto":
+            backend = "orbax" if os.path.isdir(fname) else "pickle"
+        if backend == "orbax":
+            self._load_states_orbax(fname)
+            return
         import pickle
         with open(fname, "rb") as f:
             state = pickle.load(f)
         self._t = state["t"]
         self._t_dev = None  # re-materialized from self._t on next step
-        if self._params is None:
-            raise MXNetError("call step() once (or _init_state) before "
-                             "load_states so the parameter set exists")
         items = sorted(self._block.collect_params().items())
         vals, states = [], []
         for (name, p), v, st in zip(items, state["param_vals"], state["opt_states"]):
@@ -289,3 +328,32 @@ class ShardedTrainer:
                     jnp.asarray(s), NamedSharding(self._mesh, spec)))
             states.append(tuple(placed))
         self._param_vals, self._opt_states = tuple(vals), tuple(states)
+
+    def _load_states_orbax(self, path: str) -> None:
+        """Restore each array DIRECTLY onto its mesh sharding (TensorStore
+        reads only this process's shards — no host-side full gather)."""
+        try:
+            import orbax.checkpoint as ocp
+        except ImportError as e:
+            raise MXNetError(
+                "this checkpoint is an orbax directory; the orbax-checkpoint "
+                "package is required to restore it") from e
+        path = os.path.abspath(path)
+        # restore targets: abstract arrays carrying the CURRENT shardings
+        tpl = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=a.sharding),
+            self._ckpt_tree())
+        with ocp.Checkpointer(ocp.CompositeCheckpointHandler()) as ckptr:
+            restored = ckptr.restore(
+                path,
+                args=ocp.args.Composite(
+                    state=ocp.args.PyTreeRestore(
+                        tpl, restore_args=jax.tree.map(
+                            lambda s: ocp.ArrayRestoreArgs(sharding=s.sharding),
+                            tpl)),
+                    meta=ocp.args.JsonRestore()))
+        state = restored["state"]
+        self._t = int(restored["meta"]["t"])
+        self._t_dev = None
+        self._param_vals = tuple(state["param_vals"])
+        self._opt_states = tuple(tuple(s) for s in state["opt_states"])

@@ -251,6 +251,243 @@ MXTPU_API int64_t MXTPURecordIOIndexBuild(const char* path,
 }
 
 // ---------------------------------------------------------------------------
+// dmlc .params container (NDArray::Save/Load parity, src/ndarray/ndarray.cc
+// behind MXNDArraySave/MXNDArrayLoad). V2 dense records; the exotic legacy
+// layouts (V1 / pre-magic) stay on the Python fallback reader.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+constexpr uint64_t kListMagic = 0x112;
+constexpr uint32_t kNDV2 = 0xF993FAC9;
+constexpr uint32_t kNDV3 = 0xF993FACA;
+
+struct ParamsRecord {
+  std::string name;
+  bool named = false;
+  int32_t type_flag = 0;
+  std::vector<int64_t> shape;
+  std::vector<char> data;
+};
+
+struct ParamsWriter {
+  std::string path;
+  std::vector<ParamsRecord> records;
+};
+
+struct ParamsReader {
+  std::vector<ParamsRecord> records;
+};
+
+template <typename T>
+bool WriteScalar(FILE* fp, T v) {
+  return std::fwrite(&v, sizeof(T), 1, fp) == 1;
+}
+
+template <typename T>
+bool ReadScalar(FILE* fp, T* v) {
+  return std::fread(v, sizeof(T), 1, fp) == 1;
+}
+
+}  // namespace
+
+MXTPU_API void* MXTPUParamsWriterCreate(const char* path) {
+  auto* w = new ParamsWriter();
+  w->path = path;
+  return w;
+}
+
+MXTPU_API int MXTPUParamsWriterAdd(void* handle, const char* name,
+                                   int32_t type_flag, uint32_t ndim,
+                                   const int64_t* shape, const void* data,
+                                   uint64_t nbytes) {
+  auto* w = static_cast<ParamsWriter*>(handle);
+  ParamsRecord rec;
+  rec.name = name ? name : "";
+  rec.named = name != nullptr;  // NULL = unnamed list save (no names section)
+  rec.type_flag = type_flag;
+  rec.shape.assign(shape, shape + ndim);
+  rec.data.assign(static_cast<const char*>(data),
+                  static_cast<const char*>(data) + nbytes);
+  w->records.push_back(std::move(rec));
+  return 0;
+}
+
+MXTPU_API int MXTPUParamsWriterFinish(void* handle) {
+  auto* w = static_cast<ParamsWriter*>(handle);
+  FILE* fp = std::fopen(w->path.c_str(), "wb");
+  if (!fp) {
+    SetError("cannot open for write: " + w->path);
+    return -1;
+  }
+  bool ok = WriteScalar<uint64_t>(fp, kListMagic) &&
+            WriteScalar<uint64_t>(fp, 0) &&
+            WriteScalar<uint64_t>(fp, w->records.size());
+  for (const auto& r : w->records) {
+    if (!ok) break;
+    ok = WriteScalar<uint32_t>(fp, kNDV2) &&
+         WriteScalar<int32_t>(fp, 0) /* kDefaultStorage */ &&
+         WriteScalar<uint32_t>(fp, static_cast<uint32_t>(r.shape.size()));
+    for (int64_t d : r.shape) {
+      if (!ok) break;
+      ok = WriteScalar<int64_t>(fp, d);
+    }
+    ok = ok && WriteScalar<int32_t>(fp, 1) /* cpu */ &&
+         WriteScalar<int32_t>(fp, 0) &&
+         WriteScalar<int32_t>(fp, r.type_flag) &&
+         (r.data.empty() ||
+          std::fwrite(r.data.data(), 1, r.data.size(), fp) == r.data.size());
+  }
+  bool any_named = false;
+  for (const auto& r : w->records) any_named = any_named || r.named;
+  ok = ok && WriteScalar<uint64_t>(fp, any_named ? w->records.size() : 0);
+  if (any_named) {
+    for (const auto& r : w->records) {
+      if (!ok) break;
+      ok = WriteScalar<uint64_t>(fp, r.name.size()) &&
+           (r.name.empty() ||
+            std::fwrite(r.name.data(), 1, r.name.size(), fp) ==
+                r.name.size());
+    }
+  }
+  std::fclose(fp);
+  if (!ok) SetError("params write failed: " + w->path);
+  return ok ? 0 : -1;
+}
+
+MXTPU_API void MXTPUParamsWriterFree(void* handle) {
+  delete static_cast<ParamsWriter*>(handle);
+}
+
+static const uint64_t kTypeBytes[] = {4, 8, 2, 1, 4, 1, 8, 1, 2, 2, 4, 8, 2};
+
+MXTPU_API void* MXTPUParamsReaderCreate(const char* path) try {
+  FILE* fp = std::fopen(path, "rb");
+  if (!fp) {
+    SetError(std::string("cannot open: ") + path);
+    return nullptr;
+  }
+  auto fail = [&](const char* msg) -> void* {
+    SetError(std::string(msg) + ": " + path);
+    std::fclose(fp);
+    return nullptr;
+  };
+  // Corrupt-file guard: a single record's payload may not claim more bytes
+  // than the file could possibly hold.
+  std::fseek(fp, 0, SEEK_END);
+  const uint64_t file_size = static_cast<uint64_t>(std::ftell(fp));
+  std::fseek(fp, 0, SEEK_SET);
+  uint64_t magic = 0, reserved = 0, n = 0;
+  if (!ReadScalar(fp, &magic) || !ReadScalar(fp, &reserved) ||
+      magic != kListMagic || !ReadScalar(fp, &n))
+    return fail("not a dmlc .params file");
+  if (n > file_size)  // every record needs >= 1 byte of header
+    return fail("corrupt record count");
+  auto* r = new ParamsReader();
+  r->records.resize(n);
+  for (uint64_t i = 0; i < n; ++i) {
+    auto& rec = r->records[i];
+    uint32_t nd_magic = 0, ndim = 0;
+    int32_t stype = 0, dev_type = 0, dev_id = 0;
+    if (!ReadScalar(fp, &nd_magic)) { delete r; return fail("truncated"); }
+    if (nd_magic != kNDV2 && nd_magic != kNDV3) {
+      // V1 / legacy / sparse layouts: python fallback handles them
+      delete r;
+      return fail("unsupported NDArray record version (python reader)");
+    }
+    if (!ReadScalar(fp, &stype) || stype != 0) {
+      delete r;
+      return fail("sparse .params record (python reader)");
+    }
+    if (!ReadScalar(fp, &ndim)) { delete r; return fail("truncated"); }
+    if (ndim > 32) { delete r; return fail("corrupt ndim"); }
+    if (ndim == 0) {  // upstream "none" record: no ctx/dtype/data follow
+      rec.type_flag = 0;
+      continue;
+    }
+    rec.shape.resize(ndim);
+    uint64_t count = 1;
+    bool overflow = false;
+    for (uint32_t d = 0; d < ndim; ++d) {
+      if (!ReadScalar(fp, &rec.shape[d])) { delete r; return fail("truncated"); }
+      if (rec.shape[d] < 0) { delete r; return fail("negative dim"); }
+      uint64_t dim = static_cast<uint64_t>(rec.shape[d]);
+      if (dim != 0 && count > file_size / dim) overflow = true;
+      count *= dim;
+    }
+    if (!ReadScalar(fp, &dev_type) || !ReadScalar(fp, &dev_id) ||
+        !ReadScalar(fp, &rec.type_flag) || rec.type_flag < 0 ||
+        rec.type_flag > 12) {
+      delete r;
+      return fail("bad NDArray record header");
+    }
+    if (overflow || count > file_size ||
+        count * kTypeBytes[rec.type_flag] > file_size) {
+      delete r;
+      return fail("corrupt record payload size");
+    }
+    uint64_t nbytes = count * kTypeBytes[rec.type_flag];
+    rec.data.resize(nbytes);
+    if (nbytes && std::fread(rec.data.data(), 1, nbytes, fp) != nbytes) {
+      delete r;
+      return fail("truncated record payload");
+    }
+  }
+  uint64_t n_names = 0;
+  if (ReadScalar(fp, &n_names)) {  // names section is optional (EOF = none)
+    if (n_names > n) { delete r; return fail("corrupt names count"); }
+    for (uint64_t i = 0; i < n_names; ++i) {
+      uint64_t len = 0;
+      if (!ReadScalar(fp, &len) || len > file_size) {
+        delete r;
+        return fail("truncated names section");
+      }
+      std::string s(len, '\0');
+      if (len && std::fread(&s[0], 1, len, fp) != len) {
+        delete r;
+        return fail("truncated names section");
+      }
+      r->records[i].name = std::move(s);
+      r->records[i].named = true;
+    }
+  }
+  std::fclose(fp);
+  return r;
+} catch (const std::exception& e) {
+  // never let C++ exceptions cross the FFI boundary (SIGABRT in Python)
+  SetError(std::string("params read failed: ") + e.what());
+  return nullptr;
+}
+
+MXTPU_API int64_t MXTPUParamsReaderCount(void* handle) {
+  return static_cast<int64_t>(
+      static_cast<ParamsReader*>(handle)->records.size());
+}
+
+MXTPU_API int MXTPUParamsReaderGet(void* handle, int64_t i, const char** name,
+                                   int32_t* type_flag, uint32_t* ndim,
+                                   const int64_t** shape, const void** data,
+                                   uint64_t* nbytes) {
+  auto* r = static_cast<ParamsReader*>(handle);
+  if (i < 0 || i >= static_cast<int64_t>(r->records.size())) {
+    SetError("params record index out of range");
+    return -1;
+  }
+  const auto& rec = r->records[i];
+  *name = rec.named ? rec.name.c_str() : nullptr;  // NULL = unnamed record
+  *type_flag = rec.type_flag;
+  *ndim = static_cast<uint32_t>(rec.shape.size());
+  *shape = rec.shape.data();
+  *data = rec.data.data();
+  *nbytes = static_cast<uint64_t>(rec.data.size());
+  return 0;
+}
+
+MXTPU_API void MXTPUParamsReaderFree(void* handle) {
+  delete static_cast<ParamsReader*>(handle);
+}
+
+// ---------------------------------------------------------------------------
 // Shared-memory storage (CPUSharedStorageManager parity)
 // ---------------------------------------------------------------------------
 

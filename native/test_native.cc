@@ -32,6 +32,19 @@ void* MXTPUShmAttach(const char* name, uint64_t size);
 void* MXTPUShmPtr(void* handle);
 uint64_t MXTPUShmSize(void* handle);
 void MXTPUShmFree(void* handle, int unlink);
+void* MXTPUParamsWriterCreate(const char* path);
+int MXTPUParamsWriterAdd(void* handle, const char* name, int32_t type_flag,
+                         uint32_t ndim, const int64_t* shape,
+                         const void* data, uint64_t nbytes);
+int MXTPUParamsWriterFinish(void* handle);
+void MXTPUParamsWriterFree(void* handle);
+void* MXTPUParamsReaderCreate(const char* path);
+int64_t MXTPUParamsReaderCount(void* handle);
+int MXTPUParamsReaderGet(void* handle, int64_t i, const char** name,
+                         int32_t* type_flag, uint32_t* ndim,
+                         const int64_t** shape, const void** data,
+                         uint64_t* nbytes);
+void MXTPUParamsReaderFree(void* handle);
 void* MXTPUEngineCreate(int num_workers);
 int64_t MXTPUEngineNewVar(void* handle);
 void MXTPUEnginePush(void* handle, void (*fn)(void*), void* ctx,
@@ -207,10 +220,60 @@ static void TestEngine() {
   MXTPUEngineFree(e);
 }
 
+// ---------------------------------------------------------------------------
+// dmlc .params container: write two arrays, read them back byte-identical.
+// ---------------------------------------------------------------------------
+static void TestParams() {
+  char path[] = "/tmp/mxtpu_test_params_XXXXXX";
+  int fd = mkstemp(path);
+  CHECK_MSG(fd >= 0, "mkstemp");
+  close(fd);
+
+  float a[6] = {1.f, 2.f, 3.f, 4.f, 5.f, 6.f};
+  int64_t a_shape[2] = {2, 3};
+  int32_t b[4] = {7, 8, 9, 10};
+  int64_t b_shape[1] = {4};
+
+  void* w = MXTPUParamsWriterCreate(path);
+  CHECK_MSG(w != nullptr, "params writer create");
+  CHECK_MSG(MXTPUParamsWriterAdd(w, "arg:weight", 0, 2, a_shape, a,
+                                 sizeof(a)) == 0, "add a");
+  CHECK_MSG(MXTPUParamsWriterAdd(w, "aux:stat", 4, 1, b_shape, b,
+                                 sizeof(b)) == 0, "add b");
+  CHECK_MSG(MXTPUParamsWriterFinish(w) == 0, "finish");
+  MXTPUParamsWriterFree(w);
+
+  void* r = MXTPUParamsReaderCreate(path);
+  CHECK_MSG(r != nullptr, "params reader create");
+  CHECK_MSG(MXTPUParamsReaderCount(r) == 2, "count");
+  const char* name = nullptr;
+  int32_t flag = 0;
+  uint32_t ndim = 0;
+  const int64_t* shape = nullptr;
+  const void* data = nullptr;
+  uint64_t nbytes = 0;
+  CHECK_MSG(MXTPUParamsReaderGet(r, 0, &name, &flag, &ndim, &shape, &data,
+                                 &nbytes) == 0, "get 0");
+  CHECK_MSG(std::string(name) == "arg:weight" && flag == 0 && ndim == 2 &&
+                shape[0] == 2 && shape[1] == 3 && nbytes == sizeof(a) &&
+                std::memcmp(data, a, sizeof(a)) == 0,
+            "record 0 roundtrip");
+  CHECK_MSG(MXTPUParamsReaderGet(r, 1, &name, &flag, &ndim, &shape, &data,
+                                 &nbytes) == 0, "get 1");
+  CHECK_MSG(std::string(name) == "aux:stat" && flag == 4 && ndim == 1 &&
+                shape[0] == 4 && std::memcmp(data, b, sizeof(b)) == 0,
+            "record 1 roundtrip");
+  CHECK_MSG(MXTPUParamsReaderGet(r, 2, &name, &flag, &ndim, &shape, &data,
+                                 &nbytes) != 0, "oob index rejected");
+  MXTPUParamsReaderFree(r);
+  std::remove(path);
+}
+
 int main() {
   TestRecordIO();
   TestShm();
   TestEngine();
+  TestParams();
   if (g_failures) {
     std::fprintf(stderr, "%d failure(s)\n", g_failures);
     return 1;

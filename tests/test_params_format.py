@@ -162,3 +162,75 @@ def test_truncated_rejected(tmp_path):
     p.write_bytes(raw[: len(raw) // 2])
     with pytest.raises(MXNetError, match="truncated dmlc NDArray stream"):
         mx.nd.load(str(p))
+
+
+def test_native_python_params_interop(tmp_path, monkeypatch):
+    """The C++ writer/reader and the Python writer/reader produce and parse
+    byte-identical V2 containers (NDArray::Save parity, native shim)."""
+    from incubator_mxnet_tpu import native
+    from incubator_mxnet_tpu.ndarray import serialization as ser
+    if not native.available():
+        pytest.skip("native toolchain unavailable")
+    rng = onp.random.RandomState(0)
+    arrays = [rng.randn(3, 4).astype("float32"),
+              rng.randint(0, 9, (5,)).astype("int32")]
+    names = ["arg:w", "aux:s"]
+
+    f_native = str(tmp_path / "n.params")
+    ser.dmlc_save(f_native, arrays, names)       # native fast path
+    f_python = str(tmp_path / "p.params")
+    monkeypatch.setattr(native, "available", lambda: False)
+    ser.dmlc_save(f_python, arrays, names)       # pure-python writer
+    with open(f_native, "rb") as fa, open(f_python, "rb") as fb:
+        assert fa.read() == fb.read()            # byte-identical containers
+
+    # python reader parses the native file...
+    arrs_p, names_p = ser.dmlc_load(f_native)
+    monkeypatch.undo()
+    # ...and the native reader parses the python file
+    arrs_n, names_n = ser.dmlc_load(f_python)
+    assert names_p == names_n == names
+    for a, b, c in zip(arrays, arrs_p, arrs_n):
+        onp.testing.assert_array_equal(a, b)
+        onp.testing.assert_array_equal(a, c)
+
+
+def test_corrupt_params_survive_native_reader(tmp_path):
+    """Adversarial .params records must raise catchable errors — never
+    SIGABRT through the FFI, never silently succeed on overflowed sizes."""
+    # huge dim (would be a ~128TB allocation if trusted)
+    p1 = str(tmp_path / "huge.params")
+    with open(p1, "wb") as f:
+        f.write(struct.pack("<QQQ", 0x112, 0, 1))
+        f.write(struct.pack("<I", 0xF993FAC9))
+        f.write(struct.pack("<i", 0))
+        f.write(struct.pack("<I", 1))
+        f.write(struct.pack("<q", 1 << 45))
+        f.write(struct.pack("<ii", 1, 0))
+        f.write(struct.pack("<i", 0))
+    with pytest.raises(mx.MXNetError):
+        mx.nd.load(p1)
+
+    # overflow-crafted dims: product wraps to a tiny/zero byte count
+    p2 = str(tmp_path / "wrap.params")
+    with open(p2, "wb") as f:
+        f.write(struct.pack("<QQQ", 0x112, 0, 1))
+        f.write(struct.pack("<I", 0xF993FAC9))
+        f.write(struct.pack("<i", 0))
+        f.write(struct.pack("<I", 2))
+        f.write(struct.pack("<qq", 1 << 60, 1 << 4))
+        f.write(struct.pack("<ii", 1, 0))
+        f.write(struct.pack("<i", 0))
+    with pytest.raises(mx.MXNetError):
+        mx.nd.load(p2)
+
+    # truncated names section must not load with silently-dropped names
+    p3 = str(tmp_path / "names.params")
+    arr = onp.ones((2,), "float32")
+    from incubator_mxnet_tpu.ndarray import serialization as ser
+    ser.dmlc_save(p3, [arr], ["weight"])
+    blob = open(p3, "rb").read()
+    with open(p3, "wb") as f:
+        f.write(blob[:-4])  # cut into the name bytes
+    with pytest.raises(mx.MXNetError):
+        mx.nd.load(p3)
