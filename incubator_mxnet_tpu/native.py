@@ -290,6 +290,12 @@ class NativeEngine:
         # thread is still returning through.
         self._keepalive: list = []
         self._lock = threading.Lock()
+        # Async exception propagation (reference:
+        # ThreadedEngine::OnCompleteStatic capture → rethrow in WaitToRead,
+        # SURVEY §5.2): a task's exception is captured on the worker thread
+        # and rethrown at the next wait_all() sync point — never swallowed,
+        # never crashing the worker.
+        self._errors: list = []
 
     def new_var(self) -> int:
         return _lib().MXTPUEngineNewVar(self._h)
@@ -298,7 +304,11 @@ class NativeEngine:
              read_vars: Sequence[int] = (),
              write_vars: Sequence[int] = ()) -> None:
         def trampoline(_ctx, _fn=fn):
-            _fn()
+            try:
+                _fn()
+            except BaseException as e:  # noqa: BLE001 — must cross threads
+                with self._lock:
+                    self._errors.append(e)
 
         cfn = _TASK_FN(trampoline)
         with self._lock:
@@ -313,12 +323,23 @@ class NativeEngine:
         # all pushed tasks have returned through their closures; safe to free
         with self._lock:
             self._keepalive.clear()
+            errors, self._errors = self._errors, []
+        if errors:
+            raise errors[0]
 
     def close(self):
+        """Drain, free, and rethrow any captured task exception — close() is
+        a sync point like wait_all() (the __del__ path swallows, as Python
+        finalizers must)."""
         if self._h:
             _lib().MXTPUEngineWaitAll(self._h)
             _lib().MXTPUEngineFree(self._h)
             self._h = None
+            with self._lock:
+                self._keepalive.clear()
+                errors, self._errors = self._errors, []
+            if errors:
+                raise errors[0]
 
     def __del__(self):
         try:

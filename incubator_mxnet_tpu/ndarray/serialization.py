@@ -82,15 +82,17 @@ def _write_ndarray(f, arr: onp.ndarray) -> None:
 
 
 def _read_exact(f, n: int) -> bytes:
-    # corrupt-size guard: never allocate more than the file can supply
-    # (a crafted record can declare a 2^45-element shape)
-    import os as _os
-    try:
-        remaining = _os.fstat(f.fileno()).st_size - f.tell()
-    except (OSError, AttributeError):
-        remaining = None
-    if remaining is not None and n > remaining:
-        raise MXNetError("truncated dmlc NDArray stream")
+    # Corrupt-size guard for LARGE reads only (a crafted record can declare
+    # a 2^45-element shape): never allocate more than the file can supply.
+    # Small field reads skip the fstat — f.read() itself bounds them.
+    if n > (1 << 20):
+        import os as _os
+        try:
+            remaining = _os.fstat(f.fileno()).st_size - f.tell()
+        except (OSError, AttributeError):
+            remaining = None
+        if remaining is not None and n > remaining:
+            raise MXNetError("truncated dmlc NDArray stream")
     b = f.read(n)
     if len(b) != n:
         raise MXNetError("truncated dmlc NDArray stream")

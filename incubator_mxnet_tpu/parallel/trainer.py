@@ -310,6 +310,8 @@ class ShardedTrainer:
         if backend == "orbax":
             self._load_states_orbax(fname)
             return
+        if backend != "pickle":
+            raise MXNetError(f"unknown checkpoint backend {backend!r}")
         import pickle
         with open(fname, "rb") as f:
             state = pickle.load(f)

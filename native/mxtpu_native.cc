@@ -302,6 +302,12 @@ MXTPU_API int MXTPUParamsWriterAdd(void* handle, const char* name,
                                    const int64_t* shape, const void* data,
                                    uint64_t nbytes) {
   auto* w = static_cast<ParamsWriter*>(handle);
+  if (ndim == 0) {
+    // ndim==0 is the reader's field-less "none" record; writing ctx/dtype/
+    // data for it would desync any reader. Callers promote scalars to (1,).
+    SetError("0-d arrays must be reshaped to (1,) before params_save");
+    return -1;
+  }
   ParamsRecord rec;
   rec.name = name ? name : "";
   rec.named = name != nullptr;  // NULL = unnamed list save (no names section)
@@ -350,7 +356,9 @@ MXTPU_API int MXTPUParamsWriterFinish(void* handle) {
                 r.name.size());
     }
   }
-  std::fclose(fp);
+  // fclose flushes the stdio buffer — a full disk surfaces HERE, not in the
+  // buffered fwrites above; ignoring it would report a truncated file as ok
+  ok = (std::fclose(fp) == 0) && ok;
   if (!ok) SetError("params write failed: " + w->path);
   return ok ? 0 : -1;
 }
@@ -381,7 +389,9 @@ MXTPU_API void* MXTPUParamsReaderCreate(const char* path) try {
   if (!ReadScalar(fp, &magic) || !ReadScalar(fp, &reserved) ||
       magic != kListMagic || !ReadScalar(fp, &n))
     return fail("not a dmlc .params file");
-  if (n > file_size)  // every record needs >= 1 byte of header
+  // every record needs >= 12 header bytes, so a crafted count can't force
+  // a giant records.resize() before the first parse failure
+  if (n > file_size / 12)
     return fail("corrupt record count");
   auto* r = new ParamsReader();
   r->records.resize(n);

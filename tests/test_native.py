@@ -124,3 +124,26 @@ def test_cpp_unit_suite():
                          capture_output=True, text=True, timeout=300)
     assert out.returncode == 0, out.stdout + out.stderr
     assert "all native tests passed" in out.stdout
+
+
+def test_engine_async_exception_rethrown_at_sync_point():
+    """Reference mechanism (SURVEY §5.2 / tests test_exc_handling.py):
+    a task raising on a worker thread must surface at the next wait_all,
+    not crash the worker or vanish."""
+    eng = native.NativeEngine(2)
+    v = eng.new_var()
+    ran = []
+
+    def boom():
+        raise RuntimeError("kaboom-async")
+
+    eng.push(boom, write_vars=[v])
+    eng.push(lambda: ran.append(1), write_vars=[v])  # dependents still run
+    with pytest.raises(RuntimeError, match="kaboom-async"):
+        eng.wait_all()
+    assert ran == [1]
+    # the engine stays usable after the failure surfaced
+    eng.push(lambda: ran.append(2), write_vars=[v])
+    eng.wait_all()
+    assert ran == [1, 2]
+    eng.close()

@@ -482,3 +482,44 @@ def test_np_compat_additions():
     onp.testing.assert_allclose(
         mx.nd.digamma(a + 1).asnumpy(), _sp.digamma(a.asnumpy() + 1),
         rtol=1e-5)
+
+
+_GRAD_CASES = [
+    ("fullyconnected",
+     lambda x, w, b: nd.FullyConnected(x, w, b, num_hidden=4),
+     [(3, 5), (4, 5), (4,)]),
+    ("convolution",
+     lambda x, w, b: nd.Convolution(x, w, b, kernel=(3, 3), num_filter=2,
+                                    pad=(1, 1)),
+     [(2, 3, 5, 5), (2, 3, 3, 3), (2,)]),
+    ("layernorm",
+     lambda x, g, b: nd.LayerNorm(x, g, b, axis=-1),
+     [(4, 6), (6,), (6,)]),
+    ("softmax", lambda x: nd.softmax(x, axis=-1), [(3, 7)]),
+    ("avgpool",
+     lambda x: nd.Pooling(x, pool_type="avg", kernel=(2, 2), stride=(2, 2)),
+     [(2, 2, 4, 4)]),
+    ("lrn", lambda x: nd.LRN(x, nsize=3), [(2, 5, 3, 3)]),
+    ("dot", lambda a, b: nd.dot(a, b), [(3, 4), (4, 2)]),
+    ("broadcast_mul", lambda a, b: nd.broadcast_mul(a, b), [(3, 4), (1, 4)]),
+    ("smooth_l1", lambda x: nd.smooth_l1(x, scalar=1.0), [(6,)]),
+    ("swapaxes", lambda x: nd.SwapAxis(x, dim1=0, dim2=1) * 2.0, [(3, 4)]),
+    ("groupnorm",
+     lambda x, g, b: nd.GroupNorm(x, g, b, num_groups=2),
+     [(2, 4, 3, 3), (4,), (4,)]),
+]
+
+
+@pytest.mark.parametrize("name,fn,shapes",
+                         _GRAD_CASES, ids=[c[0] for c in _GRAD_CASES])
+def test_numeric_gradient_sweep(name, fn, shapes):
+    """Finite-difference autograd checks over the op battery (reference
+    mechanism: test_utils.check_numeric_gradient applied per op in
+    tests/python/unittest/test_operator.py)."""
+    import zlib
+    rng = onp.random.RandomState(zlib.crc32(name.encode()) % (2 ** 31))
+    inputs = [rng.uniform(-1, 1, s).astype("float32") for s in shapes]
+    # conv sums ~27 fp32 products per output: central differences carry a
+    # bit more roundoff than the pointwise ops
+    atol = 5e-3 if name == "convolution" else 2e-3
+    check_numeric_gradient(fn, inputs, rtol=2e-2, atol=atol)
