@@ -23,10 +23,25 @@ from .ndarray import NDArray, array, _unwrap, _dtype_of
 from .op import dispatch_op, make_nd_op
 from . import random  # noqa: F401
 from . import sparse  # noqa: F401
+# legacy flat sampling names (reference generated ops mx.nd.random_* /
+# sample_multinomial / shuffle — src/operator/random/sample_op.cc)
+from .random import (  # noqa: F401
+    uniform as random_uniform, normal as random_normal,
+    randint as random_randint, exponential as random_exponential,
+    poisson as random_poisson, gamma as random_gamma,
+    negative_binomial as random_negative_binomial,
+    generalized_negative_binomial as random_generalized_negative_binomial,
+    multinomial as sample_multinomial, shuffle,
+)
 
 __all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
            "linspace", "eye", "save", "load", "waitall", "concatenate",
-           "imresize", "moveaxis", "from_numpy", "from_dlpack", "to_dlpack_for_read"]
+           "imresize", "moveaxis", "from_numpy", "from_dlpack",
+           "to_dlpack_for_read", "random_uniform", "random_normal",
+           "random_randint", "random_exponential", "random_poisson",
+           "random_gamma", "random_negative_binomial",
+           "random_generalized_negative_binomial", "sample_multinomial",
+           "shuffle"]
 
 _this = sys.modules[__name__]
 

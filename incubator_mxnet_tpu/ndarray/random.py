@@ -177,10 +177,22 @@ def multinomial(data, shape=1, get_prob=False, dtype="int32", **kwargs):
             out = out[:, 0]
     res = NDArray(out.astype(jnp.dtype(dtype)), ctx=ctx)
     if get_prob:
-        logp = jnp.take_along_axis(jnp.log(jnp.maximum(data._data, 1e-30)),
-                                   out.reshape(out.shape + (1,)).astype(jnp.int32), axis=-1)[..., 0] \
-            if data._data.ndim > 1 else jnp.log(jnp.maximum(data._data, 1e-30))[out]
-        return res, NDArray(logp, ctx=ctx)
+        # logp must flow through the autograd tape (dispatch_op) — the
+        # reference's documented use is REINFORCE, where the caller
+        # backprops -logp * reward into the probabilities. The sampled
+        # indices are a closed-over constant; only `data` carries gradient.
+        idx = out
+
+        def pure(d):
+            lg = jnp.log(jnp.maximum(d, 1e-30))
+            if d.ndim > 1:
+                return jnp.take_along_axis(
+                    lg, idx.reshape(idx.shape + (1,)).astype(jnp.int32),
+                    axis=-1)[..., 0]
+            return lg[idx]
+
+        logp = dispatch_op(pure, [data], {}, ctx, name="sample_multinomial")
+        return res, logp
     return res
 
 

@@ -19,7 +19,10 @@ import jax
 
 from .context import Context, current_context
 
-__all__ = ["seed", "next_key", "fork_key", "get_state", "trace_rng"]
+__all__ = ["seed", "next_key", "fork_key", "get_state", "trace_rng",
+           "uniform", "normal", "randn", "randint", "exponential", "poisson",
+           "gamma", "negative_binomial", "generalized_negative_binomial",
+           "multinomial", "shuffle"]
 
 _lock = threading.Lock()
 _keys: Dict[Context, jax.Array] = {}
@@ -117,3 +120,30 @@ def get_state(ctx: Optional[Context] = None) -> jax.Array:
     ctx = ctx or current_context()
     with _lock:
         return _key_for(ctx)
+
+
+# ---------------------------------------------------------------------------
+# module-level sampling API (reference: python/mxnet/random.py delegates to
+# the generated sampling ops; ours live in ndarray/random.py)
+# ---------------------------------------------------------------------------
+
+def _delegate(name):
+    def fn(*args, **kwargs):
+        from .ndarray import random as _ndr
+        return getattr(_ndr, name)(*args, **kwargs)
+    fn.__name__ = name
+    fn.__doc__ = f"mx.random.{name}: see mx.nd.random.{name}."
+    return fn
+
+
+uniform = _delegate("uniform")
+normal = _delegate("normal")
+randn = _delegate("randn")
+randint = _delegate("randint")
+exponential = _delegate("exponential")
+poisson = _delegate("poisson")
+gamma = _delegate("gamma")
+negative_binomial = _delegate("negative_binomial")
+generalized_negative_binomial = _delegate("generalized_negative_binomial")
+multinomial = _delegate("multinomial")
+shuffle = _delegate("shuffle")

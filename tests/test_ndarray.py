@@ -201,3 +201,47 @@ def test_iter_len():
     assert len(x) == 3
     rows = list(x)
     assert len(rows) == 3 and rows[0].shape == (2,)
+
+
+def test_random_sampling_surface():
+    """Flat nd.random_* aliases and mx.random.* delegate to the sampling
+    ops (reference: sample_op.cc generated names + python/mxnet/random.py);
+    seeding makes streams reproducible."""
+    mx.random.seed(11)
+    a = mx.nd.random_uniform(0.0, 1.0, shape=(3, 4))
+    mx.random.seed(11)
+    b = mx.random.uniform(0.0, 1.0, shape=(3, 4))
+    onp.testing.assert_allclose(a.asnumpy(), b.asnumpy())
+    assert a.shape == (3, 4) and (a.asnumpy() >= 0).all()
+
+    n = mx.nd.random_normal(loc=2.0, scale=0.5, shape=(500,))
+    assert abs(float(n.asnumpy().mean()) - 2.0) < 0.15
+
+    r = mx.random.randint(3, 9, shape=(50,))
+    rv = r.asnumpy()
+    assert rv.min() >= 3 and rv.max() < 9 and rv.dtype == onp.int32
+
+    probs = mx.nd.array(onp.array([[0.0, 1.0], [1.0, 0.0]], "float32"))
+    idx = mx.nd.sample_multinomial(probs)
+    onp.testing.assert_array_equal(idx.asnumpy(), [1, 0])
+
+    x = mx.nd.array(onp.arange(6, dtype="float32"))
+    s = mx.nd.shuffle(x)
+    onp.testing.assert_allclose(onp.sort(s.asnumpy()), onp.arange(6))
+
+
+def test_sample_multinomial_logp_gradient_flows():
+    """get_prob's logp must ride the autograd tape (reference use case:
+    REINFORCE backprops -logp*reward into the probabilities)."""
+    mx.random.seed(5)
+    p = mx.nd.array(onp.array([[0.2, 0.8]], "float32"))
+    p.attach_grad()
+    with mx.autograd.record():
+        action, logp = mx.nd.sample_multinomial(p, get_prob=True)
+        loss = -logp
+    loss.backward()
+    g = p.grad.asnumpy()
+    a = int(action.asnumpy()[0])
+    # d(-log p_a)/dp_a = -1/p_a; other entries zero
+    onp.testing.assert_allclose(g[0, a], -1.0 / p.asnumpy()[0, a], rtol=1e-5)
+    onp.testing.assert_allclose(g[0, 1 - a], 0.0)
