@@ -162,34 +162,42 @@ def generalized_negative_binomial(mu=1.0, alpha=1.0, shape=None, dtype=None, ctx
 
 
 def multinomial(data, shape=1, get_prob=False, dtype="int32", **kwargs):
-    """Sample from categorical distributions given probabilities (N, K)."""
+    """Sample from categorical distributions given probabilities ``(K,)`` or
+    ``(N, K)``; ``shape`` draws per distribution (int or tuple, preserved in
+    the output: 1-D data → ``shape``, 2-D data → ``(N,) + shape``; the
+    default int 1 squeezes the sample axis like the reference)."""
     ctx = data.context
     key = _rng.next_key(ctx)
-    n = shape if isinstance(shape, int) else int(jnp.prod(jnp.array(shape)))
+    dims = (shape,) if isinstance(shape, int) else tuple(shape)
+    n = 1
+    for d in dims:
+        n *= int(d)
+    squeeze = isinstance(shape, int) and shape == 1
     logits = jnp.log(jnp.maximum(data._data, 1e-30))
     if data._data.ndim == 1:
-        out = jax.random.categorical(key, logits, shape=(n,))
-        if n == 1 and shape == 1:
-            out = out.reshape(())
+        flat = jax.random.categorical(key, logits, shape=(n,))     # (n,)
+        out = flat.reshape(()) if squeeze else flat.reshape(dims)
     else:
-        out = jax.random.categorical(key, logits[:, None, :], axis=-1, shape=(data.shape[0], n))
-        if shape == 1:
-            out = out[:, 0]
+        N = data.shape[0]
+        flat = jax.random.categorical(key, logits[:, None, :], axis=-1,
+                                      shape=(N, n))                # (N, n)
+        out = flat[:, 0] if squeeze else flat.reshape((N,) + dims)
     res = NDArray(out.astype(jnp.dtype(dtype)), ctx=ctx)
     if get_prob:
         # logp must flow through the autograd tape (dispatch_op) — the
         # reference's documented use is REINFORCE, where the caller
         # backprops -logp * reward into the probabilities. The sampled
         # indices are a closed-over constant; only `data` carries gradient.
-        idx = out
+        idx = flat.astype(jnp.int32)
 
         def pure(d):
             lg = jnp.log(jnp.maximum(d, 1e-30))
             if d.ndim > 1:
-                return jnp.take_along_axis(
-                    lg, idx.reshape(idx.shape + (1,)).astype(jnp.int32),
-                    axis=-1)[..., 0]
-            return lg[idx]
+                picked = jnp.take_along_axis(lg, idx, axis=-1)     # (N, n)
+                return picked[:, 0] if squeeze \
+                    else picked.reshape((d.shape[0],) + dims)
+            picked = lg[idx]                                       # (n,)
+            return picked.reshape(()) if squeeze else picked.reshape(dims)
 
         logp = dispatch_op(pure, [data], {}, ctx, name="sample_multinomial")
         return res, logp

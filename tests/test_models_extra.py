@@ -87,3 +87,21 @@ def test_ssd_loss_trains():
         v = float(l.asnumpy())
         first = v if first is None else first
     assert v < first
+
+
+@pytest.mark.parametrize("name", ["vgg11", "densenet121", "mobilenetv2_1.0",
+                                  "squeezenet1.1"])
+def test_zoo_hybridize_matches_eager(name):
+    """CachedOp correctness across the zoo families: the jit-compiled
+    forward must reproduce the eager forward bit-for-bit at fp32 tolerance
+    (reference mechanism: hybridize-consistency checks in test_gluon.py)."""
+    from incubator_mxnet_tpu.gluon.model_zoo import vision
+    net = vision.get_model(name, classes=5)
+    net.initialize()
+    x = mx.nd.array(onp.random.RandomState(0)
+                    .rand(1, 3, 32, 32).astype("float32"))
+    with mx.autograd.predict_mode():
+        eager = net(x).asnumpy()
+        net.hybridize()
+        compiled = net(x).asnumpy()
+    onp.testing.assert_allclose(compiled, eager, rtol=2e-5, atol=2e-6)

@@ -245,3 +245,30 @@ def test_sample_multinomial_logp_gradient_flows():
     # d(-log p_a)/dp_a = -1/p_a; other entries zero
     onp.testing.assert_allclose(g[0, a], -1.0 / p.asnumpy()[0, a], rtol=1e-5)
     onp.testing.assert_allclose(g[0, 1 - a], 0.0)
+
+
+def test_sample_multinomial_multi_draw_shapes_and_grads():
+    """shape>1 and tuple shapes: output layout (N,)+shape and logp gradient
+    accumulation (regression: 3-D/2-D take_along_axis ndim mismatch)."""
+    mx.random.seed(2)
+    p = mx.nd.array(onp.array([[0.2, 0.8], [0.5, 0.5]], "float32"))
+    p.attach_grad()
+    with mx.autograd.record():
+        idx, logp = mx.nd.sample_multinomial(p, shape=4, get_prob=True)
+        loss = logp.sum()
+    assert idx.shape == (2, 4) and logp.shape == (2, 4)
+    loss.backward()
+    iv = idx.asnumpy()
+    pv = p.asnumpy()
+    # d(sum log p_a)/dp_k = count(draws==k)/p_k per row
+    for r in range(2):
+        for k in range(2):
+            expect = (iv[r] == k).sum() / pv[r, k]
+            onp.testing.assert_allclose(p.grad.asnumpy()[r, k], expect,
+                                        rtol=1e-5)
+    # tuple shape preserved
+    one_d = mx.nd.array(onp.array([0.5, 0.5], "float32"))
+    s = mx.nd.sample_multinomial(one_d, shape=(2, 3))
+    assert s.shape == (2, 3)
+    s2 = mx.nd.sample_multinomial(p, shape=(2, 3))
+    assert s2.shape == (2, 2, 3)
