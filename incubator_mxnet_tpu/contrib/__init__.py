@@ -13,7 +13,7 @@ import types
 from ..ops.registry import OPS
 from ..ndarray.op import make_nd_op
 
-__all__ = ["nd", "sym"]
+__all__ = ["nd", "sym", "summary"]
 
 
 def _contrib_names():
@@ -50,6 +50,13 @@ def __getattr__(name):
     if name == "quantization":
         import importlib
         mod = importlib.import_module("..quantization", __name__)
+        globals()[name] = mod
+        return mod
+    if name == "summary":
+        # mxboard-parity SummaryWriter — lazy so mx.contrib.nd users never
+        # pay the onnx codec import
+        import importlib
+        mod = importlib.import_module(".summary", __name__)
         globals()[name] = mod
         return mod
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

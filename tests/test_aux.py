@@ -89,3 +89,49 @@ def test_do_checkpoint_callback(tmp_path):
     cb = callback.do_checkpoint(prefix, period=1)
     cb(0, None, {"w": mx.nd.ones((2,))}, {})
     assert os.path.exists(prefix + "-0001.params")
+
+
+def test_summary_writer_event_file(tmp_path):
+    """mxboard-parity SummaryWriter: records are TFRecord-framed Event
+    protobufs with valid masked CRC-32C checksums (stock TensorBoard
+    validates both), scalars and histograms parse back."""
+    import struct
+    from incubator_mxnet_tpu.contrib.summary import (
+        SummaryWriter, _crc32c, _masked_crc)
+    from incubator_mxnet_tpu.onnx._proto import _fields
+
+    assert _crc32c(b"123456789") == 0xE3069283  # published test vector
+
+    sw = SummaryWriter(logdir=str(tmp_path))
+    for step in range(3):
+        sw.add_scalar("loss", 2.0 - step, global_step=step)
+    sw.add_histogram("w", onp.random.RandomState(0).randn(256), 3)
+    path = sw.logdir_file
+    sw.close()
+
+    blob = open(path, "rb").read()
+    i, tags, scalars = 0, [], []
+    while i < len(blob):
+        (ln,) = struct.unpack("<Q", blob[i:i + 8])
+        assert struct.unpack("<I", blob[i + 8:i + 12])[0] == \
+            _masked_crc(blob[i:i + 8])
+        ev = blob[i + 12:i + 12 + ln]
+        assert struct.unpack("<I", blob[i + 12 + ln:i + 16 + ln])[0] == \
+            _masked_crc(ev)
+        for fno, _w, val in _fields(ev):
+            if fno == 5:  # Event.summary
+                for f2, _w2, v2 in _fields(val):
+                    if f2 == 1:  # Summary.value
+                        inner = {f: v for f, _, v in _fields(v2)}
+                        tags.append(inner[1].decode())
+                        if 2 in inner:  # simple_value (fixed32 → float)
+                            scalars.append(inner[2])
+        i += 16 + ln
+    assert tags == ["loss", "loss", "loss", "w"]
+    assert scalars == [2.0, 1.0, 0.0]
+    # robustness: empty and NaN inputs must record, not crash the run
+    sw2 = SummaryWriter(logdir=str(tmp_path))
+    sw2.add_histogram("empty", onp.array([]), 0)
+    sw2.add_histogram("nans", onp.array([1.0, onp.nan, 2.0]), 1)
+    assert sw2.logdir_file != path  # same-second writers get distinct files
+    sw2.close()
