@@ -35,25 +35,25 @@ __all__ = ["SummaryWriter"]
 # CRC-32C (Castagnoli), TFRecord masking — TensorBoard validates these
 # ---------------------------------------------------------------------------
 
-_CRC_TABLE = []
+def _build_crc_table():
+    poly = 0x82F63B78
+    table = []
+    for n in range(256):
+        c = n
+        for _ in range(8):
+            c = (c >> 1) ^ poly if c & 1 else c >> 1
+        table.append(c)
+    return tuple(table)
 
 
-def _crc_table():
-    if not _CRC_TABLE:
-        poly = 0x82F63B78
-        for n in range(256):
-            c = n
-            for _ in range(8):
-                c = (c >> 1) ^ poly if c & 1 else c >> 1
-            _CRC_TABLE.append(c)
-    return _CRC_TABLE
+# built at import: a lazily-built list is racy under concurrent first use
+_CRC_TABLE = _build_crc_table()
 
 
 def _crc32c(data: bytes) -> int:
-    table = _crc_table()
     crc = 0xFFFFFFFF
     for b in data:
-        crc = table[(crc ^ b) & 0xFF] ^ (crc >> 8)
+        crc = _CRC_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
     return crc ^ 0xFFFFFFFF
 
 

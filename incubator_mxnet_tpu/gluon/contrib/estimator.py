@@ -16,7 +16,8 @@ from ...ndarray import NDArray
 from ..trainer import Trainer
 
 __all__ = ["Estimator", "TrainBegin", "TrainEnd", "EpochBegin", "EpochEnd",
-           "BatchBegin", "BatchEnd"]
+           "BatchBegin", "BatchEnd", "CheckpointHandler",
+           "EarlyStoppingHandler", "LoggingHandler"]
 
 
 class TrainBegin:
@@ -49,17 +50,138 @@ class BatchEnd:
         pass
 
 
+class CheckpointHandler(EpochEnd, TrainEnd):
+    """Save parameters (+ trainer states) each epoch; optionally keep only
+    the best by a monitored metric (reference:
+    estimator/event_handler.py CheckpointHandler)."""
+
+    def __init__(self, model_dir: str, model_prefix: str = "model",
+                 monitor: Optional[str] = None, mode: str = "min",
+                 save_best: bool = False):
+        import os
+        os.makedirs(model_dir, exist_ok=True)
+        self.model_dir = model_dir
+        self.model_prefix = model_prefix
+        self.monitor = monitor
+        self.save_best = save_best
+        self.best = float("inf") if mode == "min" else -float("inf")
+        self._better = (lambda a, b: a < b) if mode == "min" \
+            else (lambda a, b: a > b)
+        self.saved: List[str] = []
+
+    def _metric_value(self, estimator):
+        for m in estimator.train_metrics:
+            if self.monitor in (None, m.name):
+                return m.get()[1]
+        if not getattr(self, "_warned", False):
+            self._warned = True
+            estimator.logger.warning(
+                "CheckpointHandler: monitor %r matches no train metric "
+                "(available: %s) — no best-checkpoint will be saved",
+                self.monitor,
+                [m.name for m in estimator.train_metrics])
+        return None
+
+    def epoch_end(self, estimator):
+        import os
+        path = os.path.join(
+            self.model_dir, f"{self.model_prefix}-{estimator.epoch:04d}.params")
+        if self.save_best:
+            cur = self._metric_value(estimator)
+            if cur is None or not self._better(cur, self.best):
+                return
+            self.best = cur
+            path = os.path.join(self.model_dir,
+                                f"{self.model_prefix}-best.params")
+        estimator.net.save_parameters(path)
+        estimator.trainer.save_states(path.replace(".params", ".states"))
+        self.saved.append(path)
+        self._last_epoch_saved = estimator.epoch
+
+    def train_end(self, estimator):
+        # final-state safety net; skip when epoch_end already covered it
+        if not self.save_best and \
+                getattr(self, "_last_epoch_saved", None) != estimator.epoch:
+            self.epoch_end(estimator)
+
+
+class EarlyStoppingHandler(EpochEnd):
+    """Stop training when the monitored metric stops improving
+    (reference: EarlyStoppingHandler — sets estimator.stop_training)."""
+
+    def __init__(self, monitor: Optional[str] = None, mode: str = "min",
+                 patience: int = 0, min_delta: float = 0.0):
+        self.monitor = monitor
+        self.patience = patience
+        self.min_delta = min_delta
+        self.best = float("inf") if mode == "min" else -float("inf")
+        self._better = (lambda a, b: a < b - min_delta) if mode == "min" \
+            else (lambda a, b: a > b + min_delta)
+        self.wait = 0
+        self.stopped_epoch: Optional[int] = None
+
+    def epoch_end(self, estimator):
+        cur = None
+        for m in estimator.train_metrics:
+            if self.monitor in (None, m.name):
+                cur = m.get()[1]
+                break
+        if cur is None:
+            if not getattr(self, "_warned", False):
+                self._warned = True
+                estimator.logger.warning(
+                    "EarlyStoppingHandler: monitor %r matches no train "
+                    "metric (available: %s) — early stopping is inactive",
+                    self.monitor,
+                    [m.name for m in estimator.train_metrics])
+            return
+        if self._better(cur, self.best):
+            self.best = cur
+            self.wait = 0
+            return
+        self.wait += 1
+        if self.wait > self.patience:
+            estimator.stop_training = True
+            self.stopped_epoch = estimator.epoch
+
+
+class LoggingHandler(BatchEnd, EpochEnd):
+    """Per-interval batch/epoch logging (reference: LoggingHandler)."""
+
+    def __init__(self, log_interval: int = 50):
+        self.log_interval = log_interval
+        self._batch = 0
+
+    def batch_end(self, estimator, batch, loss):
+        self._batch += 1
+        if self._batch % self.log_interval == 0:
+            estimator.logger.info(
+                "Epoch[%d] Batch[%d] loss=%.4f %s", estimator.epoch,
+                self._batch, float(loss.asnumpy()),
+                " ".join(f"{m.name}={m.get()[1]:.4f}"
+                         for m in estimator.train_metrics))
+
+    def epoch_end(self, estimator):
+        self._batch = 0
+
+
 class Estimator:
     def __init__(self, net, loss, train_metrics=None, trainer: Optional[Trainer] = None,
                  context=None, logger=None):
         self.net = net
         self.loss = loss
+        import copy
         mets = train_metrics or [metric_mod.Accuracy()]
         self.train_metrics = mets if isinstance(mets, (list, tuple)) else [mets]
+        # validation gets its OWN metric instances (reference keeps
+        # val_metrics separate) so evaluate() never clobbers the training
+        # values the epoch_end handlers monitor
+        self.val_metrics = [copy.deepcopy(m) for m in self.train_metrics]
         self.trainer = trainer or Trainer(
             net.collect_params(), "adam", {"learning_rate": 1e-3})
         self.logger = logger or logging.getLogger("estimator")
         self.epoch = 0
+        self.stop_training = False  # handlers may set (EarlyStoppingHandler)
 
     def _batch_fn(self, batch):
         data = batch.data[0] if hasattr(batch, "data") else batch[0]
@@ -67,7 +189,7 @@ class Estimator:
         return data, label
 
     def evaluate(self, val_data, metrics=None):
-        metrics = metrics or self.train_metrics
+        metrics = metrics or self.val_metrics
         for m in metrics:
             m.reset()
         val_data.reset()
@@ -82,10 +204,13 @@ class Estimator:
     def fit(self, train_data, val_data=None, epochs: int = 1,
             event_handlers: Sequence = (), batches: Optional[int] = None):
         handlers = list(event_handlers)
+        self.stop_training = False
         for h in handlers:
             if isinstance(h, TrainBegin):
                 h.train_begin(self)
         for epoch in range(epochs):
+            if self.stop_training:
+                break
             self.epoch = epoch
             for m in self.train_metrics:
                 m.reset()
