@@ -50,9 +50,24 @@ class BatchEnd:
         pass
 
 
-class CheckpointHandler(EpochEnd, TrainEnd):
-    """Save parameters (+ trainer states) each epoch; optionally keep only
-    the best by a monitored metric (reference:
+def _resolve_monitor(handler, estimator, monitor):
+    """Shared monitor→train-metric lookup with a one-shot warning when
+    nothing matches (used by Checkpoint/EarlyStopping handlers)."""
+    for m in estimator.train_metrics:
+        if monitor in (None, m.name):
+            return m.get()[1]
+    if not getattr(handler, "_warned", False):
+        handler._warned = True
+        estimator.logger.warning(
+            "%s: monitor %r matches no train metric (available: %s) — the "
+            "handler is inactive", type(handler).__name__, monitor,
+            [m.name for m in estimator.train_metrics])
+    return None
+
+
+class CheckpointHandler(TrainBegin, EpochEnd):
+    """Save parameters (+ trainer states) each epoch; with ``save_best`` keep
+    only the best by a monitored metric (reference:
     estimator/event_handler.py CheckpointHandler)."""
 
     def __init__(self, model_dir: str, model_prefix: str = "model",
@@ -60,80 +75,66 @@ class CheckpointHandler(EpochEnd, TrainEnd):
                  save_best: bool = False):
         import os
         os.makedirs(model_dir, exist_ok=True)
+        if monitor is not None and not save_best:
+            raise ValueError(
+                "CheckpointHandler: monitor= only takes effect with "
+                "save_best=True (every epoch is saved otherwise)")
         self.model_dir = model_dir
         self.model_prefix = model_prefix
         self.monitor = monitor
         self.save_best = save_best
-        self.best = float("inf") if mode == "min" else -float("inf")
+        self._mode = mode
         self._better = (lambda a, b: a < b) if mode == "min" \
             else (lambda a, b: a > b)
+        self.best = float("inf") if mode == "min" else -float("inf")
         self.saved: List[str] = []
 
-    def _metric_value(self, estimator):
-        for m in estimator.train_metrics:
-            if self.monitor in (None, m.name):
-                return m.get()[1]
-        if not getattr(self, "_warned", False):
-            self._warned = True
-            estimator.logger.warning(
-                "CheckpointHandler: monitor %r matches no train metric "
-                "(available: %s) — no best-checkpoint will be saved",
-                self.monitor,
-                [m.name for m in estimator.train_metrics])
-        return None
+    def train_begin(self, estimator):
+        # handlers are reusable across fit() calls: monitoring state resets
+        self.best = float("inf") if self._mode == "min" else -float("inf")
+        self._warned = False
 
     def epoch_end(self, estimator):
         import os
-        path = os.path.join(
-            self.model_dir, f"{self.model_prefix}-{estimator.epoch:04d}.params")
+        stem = os.path.join(
+            self.model_dir, f"{self.model_prefix}-{estimator.epoch:04d}")
         if self.save_best:
-            cur = self._metric_value(estimator)
+            cur = _resolve_monitor(self, estimator, self.monitor)
             if cur is None or not self._better(cur, self.best):
                 return
             self.best = cur
-            path = os.path.join(self.model_dir,
-                                f"{self.model_prefix}-best.params")
-        estimator.net.save_parameters(path)
-        estimator.trainer.save_states(path.replace(".params", ".states"))
-        self.saved.append(path)
-        self._last_epoch_saved = estimator.epoch
-
-    def train_end(self, estimator):
-        # final-state safety net; skip when epoch_end already covered it
-        if not self.save_best and \
-                getattr(self, "_last_epoch_saved", None) != estimator.epoch:
-            self.epoch_end(estimator)
+            stem = os.path.join(self.model_dir, f"{self.model_prefix}-best")
+        estimator.net.save_parameters(stem + ".params")
+        estimator.trainer.save_states(stem + ".states")
+        self.saved.append(stem + ".params")
 
 
-class EarlyStoppingHandler(EpochEnd):
+class EarlyStoppingHandler(TrainBegin, EpochEnd):
     """Stop training when the monitored metric stops improving
-    (reference: EarlyStoppingHandler — sets estimator.stop_training)."""
+    (reference: EarlyStoppingHandler — sets estimator.stop_training, which
+    the fit loop checks at both batch and epoch boundaries)."""
 
     def __init__(self, monitor: Optional[str] = None, mode: str = "min",
                  patience: int = 0, min_delta: float = 0.0):
         self.monitor = monitor
         self.patience = patience
         self.min_delta = min_delta
-        self.best = float("inf") if mode == "min" else -float("inf")
+        self._mode = mode
         self._better = (lambda a, b: a < b - min_delta) if mode == "min" \
             else (lambda a, b: a > b + min_delta)
+        self.best = float("inf") if mode == "min" else -float("inf")
         self.wait = 0
         self.stopped_epoch: Optional[int] = None
 
+    def train_begin(self, estimator):
+        self.best = float("inf") if self._mode == "min" else -float("inf")
+        self.wait = 0
+        self.stopped_epoch = None
+        self._warned = False
+
     def epoch_end(self, estimator):
-        cur = None
-        for m in estimator.train_metrics:
-            if self.monitor in (None, m.name):
-                cur = m.get()[1]
-                break
+        cur = _resolve_monitor(self, estimator, self.monitor)
         if cur is None:
-            if not getattr(self, "_warned", False):
-                self._warned = True
-                estimator.logger.warning(
-                    "EarlyStoppingHandler: monitor %r matches no train "
-                    "metric (available: %s) — early stopping is inactive",
-                    self.monitor,
-                    [m.name for m in estimator.train_metrics])
             return
         if self._better(cur, self.best):
             self.best = cur
@@ -223,6 +224,8 @@ class Estimator:
             for batch in train_data:
                 if batches is not None and n >= batches:
                     break
+                if self.stop_training:   # a BatchEnd guard (e.g. NaN stop)
+                    break                # must not finish the epoch
                 for h in handlers:
                     if isinstance(h, BatchBegin):
                         h.batch_begin(self, batch)

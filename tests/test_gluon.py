@@ -519,8 +519,10 @@ def test_estimator_fit_with_event_handlers(tmp_path):
     net.initialize()
     est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
                     train_metrics=[metric.Accuracy()])
-    ckpt = CheckpointHandler(str(tmp_path), monitor="accuracy")
+    ckpt = CheckpointHandler(str(tmp_path))
     early = EarlyStoppingHandler(monitor="accuracy", mode="max", patience=1)
+    with pytest.raises(ValueError):
+        CheckpointHandler(str(tmp_path), monitor="accuracy")  # needs save_best
     est.fit(it, epochs=10, event_handlers=[ckpt, early, LoggingHandler(2)])
     import os
     assert ckpt.saved and all(os.path.exists(p) for p in ckpt.saved)

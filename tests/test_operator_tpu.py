@@ -1,0 +1,41 @@
+"""The op corpus re-run under the accelerator context — the reference's key
+portability trick (SURVEY §4: tests/python/gpu/test_operator_gpu.py imports
+the unittest modules and overrides the default context to mx.gpu()).
+
+Gated behind MXTPU_TEST_TPU=1 because the CI/default run pins
+JAX_PLATFORMS=cpu (conftest) and a TPU grab would contend with the
+single-client tunnel. On a TPU host:
+
+    MXTPU_TEST_TPU=1 JAX_PLATFORMS='' python -m pytest tests/test_operator_tpu.py
+
+Every ``test_*`` function of the CPU corpus is re-exported here and runs
+with ``mx.tpu()`` as the default context, exactly like the reference's
+re-import + ctx-override pattern.
+"""
+import os
+
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import test_utils
+
+if os.environ.get("MXTPU_TEST_TPU") != "1":
+    pytest.skip("set MXTPU_TEST_TPU=1 on a TPU host to run the op corpus "
+                "under the accelerator context", allow_module_level=True)
+
+import test_operator  # noqa: E402  (the CPU corpus, re-run under mx.tpu())
+
+
+@pytest.fixture(autouse=True)
+def _tpu_default_context():
+    test_utils.set_default_context(mx.tpu(0))
+    with mx.tpu(0):
+        yield
+    test_utils.set_default_context(None)
+
+
+# re-export the whole corpus; the autouse fixture swaps the context
+for _name in dir(test_operator):
+    if _name.startswith("test_"):
+        globals()[_name] = getattr(test_operator, _name)
+del _name
