@@ -90,9 +90,11 @@ class CheckpointHandler(TrainBegin, EpochEnd):
         self.saved: List[str] = []
 
     def train_begin(self, estimator):
-        # handlers are reusable across fit() calls: monitoring state resets
+        # handlers are reusable across fit() calls: monitoring state resets,
+        # and `saved` reflects THIS run's checkpoints only
         self.best = float("inf") if self._mode == "min" else -float("inf")
         self._warned = False
+        self.saved = []
 
     def epoch_end(self, estimator):
         import os
@@ -146,11 +148,16 @@ class EarlyStoppingHandler(TrainBegin, EpochEnd):
             self.stopped_epoch = estimator.epoch
 
 
-class LoggingHandler(BatchEnd, EpochEnd):
+class LoggingHandler(TrainBegin, BatchEnd, EpochEnd):
     """Per-interval batch/epoch logging (reference: LoggingHandler)."""
 
     def __init__(self, log_interval: int = 50):
         self.log_interval = log_interval
+        self._batch = 0
+
+    def train_begin(self, estimator):
+        # an aborted fit() (stop_training mid-epoch) never reaches epoch_end,
+        # so the counter must also reset here for handler reuse across fits
         self._batch = 0
 
     def batch_end(self, estimator, batch, loss):
@@ -225,7 +232,7 @@ class Estimator:
                 if batches is not None and n >= batches:
                     break
                 if self.stop_training:   # a BatchEnd guard (e.g. NaN stop)
-                    break                # must not finish the epoch
+                    break
                 for h in handlers:
                     if isinstance(h, BatchBegin):
                         h.batch_begin(self, batch)
@@ -242,6 +249,13 @@ class Estimator:
                     if isinstance(h, BatchEnd):
                         h.batch_end(self, batch, loss)
                 n += 1
+            if self.stop_training:
+                # set by a batch handler this epoch (the top-of-epoch check
+                # broke out otherwise) — even on the final/capped batch, where
+                # the in-loop check is never re-reached.  Partial-epoch metrics
+                # must not reach epoch_end handlers: a CheckpointHandler would
+                # save the diverged weights as a healthy per-epoch checkpoint
+                break
             msg = f"Epoch[{epoch}] {time.time() - t0:.1f}s " + " ".join(
                 f"train-{m.name}={m.get()[1]:.4f}" for m in self.train_metrics)
             if val_data is not None:

@@ -35,3 +35,10 @@ def test_bert_pretraining_example_runs():
         ["--model", "bert_2_128_2", "--steps", "6", "--batch-size", "4",
          "--seq-len", "64"])
     assert loss == loss and loss < 20.0  # finite, sane
+
+
+def test_machine_translation_example_beam_decodes():
+    acc = _load("machine_translation.py").main(
+        ["--task", "copy", "--steps", "300", "--seq-len", "5",
+         "--vocab", "12", "--lr", "0.002", "--batch-size", "32"])
+    assert acc > 0.8, acc
