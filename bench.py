@@ -109,6 +109,10 @@ def main() -> None:
         watchdog.cancel()           # device is alive; don't cap a long sweep
     batch = trainer.place(*batch)   # resident inputs: steady-state loop
     trainer.step(*batch).asnumpy()  # warm
+    trace_dir = os.environ.get("MXTPU_BENCH_TRACE")
+    if trace_dir:
+        with jax.profiler.trace(trace_dir):
+            trainer.step(*batch).asnumpy()
     t0 = time.perf_counter()
     for _ in range(steps):
         loss = trainer.step(*batch)

@@ -26,8 +26,13 @@ ENV_VARS: Dict[str, tuple] = {
                                      "rules make the layout decision."),
     "MXNET_TEST_SEED": ("", "Fix the test RNG seed."),
     "MXTPU_BENCH_MODEL": ("bert_12_768_12", "bench.py model config."),
+    "MXTPU_BENCH_TRACE": ("", "bench.py: capture one profiled step into this "
+                          "directory (jax.profiler trace)."),
     "MXTPU_PEAK_TFLOPS": ("", "Override per-chip peak for MFU accounting."),
     "MXTPU_FLASH_ATTENTION": ("1", "Enable the Pallas flash-attention path."),
+    "MXTPU_EMBED_ONEHOT_GRAD": ("0", "Embedding weight gradient as a one-hot "
+                                "MXU matmul instead of scatter-add (sweep "
+                                "candidate; numerically identical)."),
 }
 
 

@@ -103,6 +103,30 @@ def test_one_hot_embedding():
     assert_almost_equal(emb, w_np[[0, 2, 1]])
 
 
+def test_embedding_onehot_grad_matches_scatter():
+    """MXTPU_EMBED_ONEHOT_GRAD=1 swaps the scatter-add weight gradient for a
+    one-hot MXU matmul — values must be identical (incl. repeated indices)."""
+    import os
+    import jax
+    import jax.numpy as jnp
+
+    idx = jnp.array([[0, 2, 2, 5], [1, 1, 9, 0]], jnp.int32)
+    w = jnp.asarray(onp.random.normal(size=(10, 6)).astype(onp.float32))
+    ct = jnp.asarray(onp.random.normal(size=(2, 4, 6)).astype(onp.float32))
+    from incubator_mxnet_tpu.ops import tensor as T
+
+    def loss(weight, use_onehot):
+        os.environ["MXTPU_EMBED_ONEHOT_GRAD"] = "1" if use_onehot else "0"
+        try:
+            return (T.embedding(idx, weight) * ct).sum()
+        finally:
+            os.environ.pop("MXTPU_EMBED_ONEHOT_GRAD", None)
+
+    g_scatter = jax.grad(lambda w: loss(w, False))(w)
+    g_onehot = jax.grad(lambda w: loss(w, True))(w)
+    assert_almost_equal(g_onehot, g_scatter, rtol=1e-6, atol=1e-6)
+
+
 def test_softmax_family():
     x_np = onp.random.normal(size=(3, 6)).astype(onp.float32)
     x = nd.array(x_np)
