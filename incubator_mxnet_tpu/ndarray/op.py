@@ -54,11 +54,27 @@ def make_nd_op(opdef):
 
     fn = opdef.fn
     opname = opdef.name
+    # Ops may flag tensor params whose VALUES shape the output (e.g.
+    # boolean_mask's mask): these must stay concrete, so they are demoted to
+    # trace constants instead of tape inputs — the op remains differentiable
+    # in its other inputs while the flagged one never sees a tracer.
+    static_names = getattr(fn, "static_tensor_inputs", ())
+    if static_names:
+        import inspect
+        argnames = tuple(inspect.signature(fn).parameters)
 
     def nd_op(*args, out=None, **kwargs):
         # `name`/`ctx` are accepted for API parity with generated MXNet ops
         kwargs.pop("name", None)
         ctx = kwargs.pop("ctx", None)
+        if static_names:
+            args = tuple(
+                a._data if (isinstance(a, NDArray) and i < len(argnames)
+                            and argnames[i] in static_names) else a
+                for i, a in enumerate(args))
+            kwargs = {k: (v._data if (k in static_names
+                                      and isinstance(v, NDArray)) else v)
+                      for k, v in kwargs.items()}
         # Normalize: convert raw numpy/lists in tensor positions. NDArrays
         # passed by keyword (e.g. LeakyReLU(x, gamma=alpha)) are tape inputs
         # too — gradients must flow through them.

@@ -110,6 +110,54 @@ def convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
     return out
 
 
+_IM2COL_FIELDS = dict(
+    kernel=Field(Shape, describe="Sliding-window size, e.g. (3, 3)."),
+    stride=Field(Shape, None, "Window stride; defaults to 1 per dim.",
+                 nullable=True),
+    dilate=Field(Shape, None, "Window dilation; defaults to 1 per dim.",
+                 nullable=True),
+    pad=Field(Shape, None, "Zero-padding per spatial dim; defaults to 0.",
+              nullable=True),
+)
+
+
+@register_op("im2col", schema=Schema(**_IM2COL_FIELDS))
+def im2col(data, kernel=None, stride=None, dilate=None, pad=None):
+    """Sliding-window patch extraction (reference: nn/im2col.cc): output
+    (N, C·∏kernel, ∏out_spatial) with channel-major row order — exactly the
+    layout lax.conv_general_dilated_patches produces."""
+    nd = _conv_dims(kernel)
+    stride = _tup(stride, nd)
+    dilate = _tup(dilate, nd)
+    pad = _tup(pad if pad is not None else 0, nd)
+    patches = lax.conv_general_dilated_patches(
+        data, filter_shape=tuple(kernel), window_strides=stride,
+        padding=[(p, p) for p in pad], rhs_dilation=dilate)
+    return patches.reshape(patches.shape[0], patches.shape[1], -1)
+
+
+@register_op("col2im", schema=Schema(
+    output_size=Field(Shape, describe="Spatial shape of the output image."),
+    **_IM2COL_FIELDS))
+def col2im(data, output_size=None, kernel=None, stride=None, dilate=None,
+           pad=None):
+    """Patch scatter-accumulate, the linear transpose of :func:`im2col`
+    (reference: nn/im2col.cc col2im) — derived via jax.linear_transpose from
+    an abstract trace (no forward pass runs) so both ops stay consistent by
+    construction; overlapping positions sum."""
+    import math
+    output_size = tuple(output_size)
+    n, ckk, _ = data.shape
+    kernel = _tup(kernel, len(output_size))
+    channels = ckk // math.prod(kernel)
+    img_shape = (n, channels) + output_size
+    transpose = jax.linear_transpose(
+        lambda img: im2col(img, kernel=kernel, stride=stride, dilate=dilate,
+                           pad=pad),
+        jax.ShapeDtypeStruct(img_shape, data.dtype))
+    return transpose(data)[0]
+
+
 @register_op("Deconvolution", aliases=("deconvolution",), schema=Schema(
     ignore=("cudnn_tune", "cudnn_off", "workspace"),
     kernel=Field(Shape, describe="Deconvolution kernel size."),
@@ -446,6 +494,17 @@ def log_softmax(data, axis=-1, temperature=None, **_):
 @register_op("softmin")
 def softmin(data, axis=-1, **_):
     return jax.nn.softmax(-data, axis=axis)
+
+
+@register_op("SoftmaxActivation")
+def softmax_activation(data, mode="instance", **_):
+    """Deprecated-but-present reference op (softmax_activation-inl.h):
+    ``instance`` normalizes each example over all remaining dims, ``channel``
+    normalizes across axis 1 at every spatial position."""
+    if mode == "channel":
+        return jax.nn.softmax(data, axis=1)
+    flat = data.reshape(data.shape[0], -1)
+    return jax.nn.softmax(flat, axis=-1).reshape(data.shape)
 
 
 @register_op("masked_softmax")

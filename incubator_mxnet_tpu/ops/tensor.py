@@ -728,6 +728,65 @@ def linalg_syrk(A, transpose=False, alpha=1.0, **_):
     return alpha * (jnp.matmul(At, A) if transpose else jnp.matmul(A, At))
 
 
+@register_op("linalg_trmm")
+def linalg_trmm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0, **_):
+    """Triangular matmul (reference: la_op.cc linalg_trmm): only the
+    triangular half of A participates, as in the BLAS trmm contract."""
+    tri = jnp.tril(A) if lower else jnp.triu(A)
+    if transpose:
+        tri = jnp.swapaxes(tri, -1, -2)
+    out = jnp.matmul(B, tri) if rightside else jnp.matmul(tri, B)
+    return alpha * out
+
+
+@register_op("tril")
+def tril(data, k=0, **_):
+    return jnp.tril(data, k=k)
+
+
+@register_op("triu")
+def triu(data, k=0, **_):
+    return jnp.triu(data, k=k)
+
+
+@register_op("all_finite")
+def all_finite(data, init_output=True, **_):
+    """1-element 1/0 array (reference: contrib/all_finite.cc — the AMP
+    dynamic loss-scaler overflow probe)."""
+    return jnp.isfinite(data).all().reshape((1,)).astype(jnp.float32)
+
+
+@register_op("multi_all_finite")
+def multi_all_finite(*arrays, num_arrays=1, init_output=True, **_):
+    ok = jnp.array(True)
+    for a in arrays:
+        ok = jnp.logical_and(ok, jnp.isfinite(a).all())
+    return ok.reshape((1,)).astype(jnp.float32)
+
+
+@register_op("boolean_mask", aliases=("_contrib_boolean_mask",))
+def boolean_mask(data, index, axis=0, **_):
+    """Dynamic row filter (reference: contrib/boolean_mask.cc). Output shape
+    depends on the mask VALUES, so the MASK must be concrete — eager-only
+    with respect to `index`; inside jit/XLA (static shapes) use
+    ``where``/``sequence_mask`` or pre-filter on host, the same restriction
+    the reference documents for TPU-style backends. The concrete mask is
+    frozen into static gather indices, so the op stays differentiable in
+    `data` (autograd's vjp trace sees a plain take)."""
+    if isinstance(index, jax.core.Tracer):
+        raise ValueError(
+            "boolean_mask has a data-dependent output shape and its mask "
+            "cannot be traced/jitted; mask with where()/sequence_mask instead")
+    import numpy as _np
+    keep = jnp.asarray(_np.nonzero(_np.asarray(index) != 0)[0])
+    return jnp.take(data, keep, axis=axis)
+
+
+# the mask's values determine the output shape: keep it out of the autograd
+# tape (trace constant) so the op stays differentiable in `data`
+boolean_mask.static_tensor_inputs = ("index",)
+
+
 @register_op("linalg_extractdiag")
 def linalg_extractdiag(A, offset=0, **_):
     return jnp.diagonal(A, offset=offset, axis1=-2, axis2=-1)

@@ -547,3 +547,89 @@ def test_numeric_gradient_sweep(name, fn, shapes):
     # bit more roundoff than the pointwise ops
     atol = 5e-3 if name == "convolution" else 2e-3
     check_numeric_gradient(fn, inputs, rtol=2e-2, atol=atol)
+
+
+def test_tril_triu_trmm():
+    a = onp.random.RandomState(3).randn(4, 4).astype("float32")
+    x = nd.array(a)
+    assert_almost_equal(nd.tril(x), onp.tril(a))
+    assert_almost_equal(nd.triu(x, k=1), onp.triu(a, k=1))
+    b = onp.random.RandomState(4).randn(4, 3).astype("float32")
+    # trmm uses only the triangular half of A
+    assert_almost_equal(nd.linalg_trmm(x, nd.array(b)), onp.tril(a) @ b,
+                        rtol=1e-5)
+    assert_almost_equal(
+        nd.linalg_trmm(x, nd.array(b.T), transpose=True, rightside=True,
+                       lower=False, alpha=2.0),
+        2.0 * (b.T @ onp.triu(a).T), rtol=1e-5)
+
+
+def test_softmax_activation_modes():
+    x = onp.random.RandomState(5).randn(2, 3, 4).astype("float32")
+    inst = nd.SoftmaxActivation(nd.array(x)).asnumpy()
+    flat = x.reshape(2, -1)
+    e = onp.exp(flat - flat.max(axis=1, keepdims=True))
+    assert_almost_equal(inst.reshape(2, -1), e / e.sum(axis=1, keepdims=True),
+                        rtol=1e-5)
+    chan = nd.SoftmaxActivation(nd.array(x), mode="channel").asnumpy()
+    ec = onp.exp(x - x.max(axis=1, keepdims=True))
+    assert_almost_equal(chan, ec / ec.sum(axis=1, keepdims=True), rtol=1e-5)
+
+
+def test_all_finite():
+    ok = nd.array(onp.ones((3,), "float32"))
+    bad = nd.array(onp.array([1.0, onp.inf], "float32"))
+    assert float(nd.all_finite(ok).asnumpy()[0]) == 1.0
+    assert float(nd.all_finite(bad).asnumpy()[0]) == 0.0
+    out = nd.multi_all_finite(ok, bad, num_arrays=2)
+    assert float(out.asnumpy()[0]) == 0.0
+
+
+def test_boolean_mask_eager_only():
+    import jax
+    x = onp.arange(12, dtype="float32").reshape(4, 3)
+    m = onp.array([1, 0, 1, 0], "float32")
+    out = mx.contrib.nd.boolean_mask(nd.array(x), nd.array(m))
+    assert_almost_equal(out, x[[0, 2]])
+    from incubator_mxnet_tpu.ops import tensor as T
+    import jax.numpy as jnp
+    with pytest.raises(ValueError, match="boolean_mask"):
+        jax.jit(T.boolean_mask)(jnp.asarray(x), jnp.asarray(m))
+    # differentiable in data (the concrete mask freezes into static indices)
+    xv = nd.array(x)
+    xv.attach_grad()
+    with mx.autograd.record():
+        y = mx.contrib.nd.boolean_mask(xv, nd.array(m)).sum()
+    y.backward()
+    expect = onp.zeros_like(x)
+    expect[[0, 2]] = 1.0
+    assert_almost_equal(xv.grad, expect)
+
+
+def test_im2col_col2im():
+    rng = onp.random.RandomState(6)
+    x = rng.randn(2, 3, 5, 5).astype("float32")
+    col = nd.im2col(nd.array(x), kernel=(3, 3), stride=(1, 1)).asnumpy()
+    assert col.shape == (2, 27, 9)
+    # numpy reference, channel-major rows (caffe/mxnet layout)
+    ref = onp.zeros((2, 27, 3, 3), "float32")
+    for c in range(3):
+        for i in range(3):
+            for j in range(3):
+                ref[:, c * 9 + i * 3 + j] = x[:, c, i:i + 3, j:j + 3]
+    assert_almost_equal(col, ref.reshape(2, 27, 9), rtol=1e-6)
+    # col2im is the linear transpose: scattering ones counts the window
+    # overlap multiplicity per pixel
+    counts = nd.col2im(nd.array(onp.ones((2, 27, 9), "float32")),
+                       output_size=(5, 5), kernel=(3, 3),
+                       stride=(1, 1)).asnumpy()
+    expect1d = onp.array([1, 2, 3, 2, 1], "float32")
+    assert_almost_equal(counts[0, 0], onp.outer(expect1d, expect1d) * 1.0)
+    # Schema Shape coercion: the reference frontends emit "(3, 3)" strings
+    col_str = nd.im2col(nd.array(x), kernel="(3, 3)").asnumpy()
+    assert_almost_equal(col_str, col)
+    back = nd.col2im(nd.array(col), output_size="(5, 5)",
+                     kernel=(3, 3)).asnumpy()
+    assert back.shape == (2, 3, 5, 5)
+    with pytest.raises(Exception):  # unknown kwargs now rejected by schema
+        nd.im2col(nd.array(x), kernel=(3, 3), bogus=1)
