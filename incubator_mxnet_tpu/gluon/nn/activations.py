@@ -7,6 +7,8 @@ __all__ = ["Activation", "LeakyReLU", "PReLU", "ELU", "SELU", "Swish", "GELU", "
 
 
 class Activation(HybridBlock):
+    """Element-wise activation by name (relu/sigmoid/tanh/softrelu/...)."""
+
     def __init__(self, activation, **kwargs):
         self._act_type = activation
         super().__init__(**kwargs)
@@ -22,6 +24,8 @@ class Activation(HybridBlock):
 
 
 class LeakyReLU(HybridBlock):
+    """Leaky ReLU: x if x>0 else alpha*x."""
+
     def __init__(self, alpha, **kwargs):
         super().__init__(**kwargs)
         self._alpha = alpha
@@ -31,6 +35,8 @@ class LeakyReLU(HybridBlock):
 
 
 class PReLU(HybridBlock):
+    """Parametric ReLU with a learnable per-channel negative slope."""
+
     def __init__(self, alpha_initializer=None, in_channels=1, **kwargs):
         super().__init__(**kwargs)
         from ... import initializer
@@ -44,6 +50,8 @@ class PReLU(HybridBlock):
 
 
 class ELU(HybridBlock):
+    """Exponential linear unit."""
+
     def __init__(self, alpha=1.0, **kwargs):
         super().__init__(**kwargs)
         self._alpha = alpha
@@ -53,11 +61,15 @@ class ELU(HybridBlock):
 
 
 class SELU(HybridBlock):
+    """Scaled exponential linear unit (self-normalizing nets)."""
+
     def hybrid_forward(self, F, x):
         return F.LeakyReLU(x, act_type="selu")
 
 
 class Swish(HybridBlock):
+    """Swish/SiLU activation: x * sigmoid(beta * x)."""
+
     def __init__(self, beta=1.0, **kwargs):
         super().__init__(**kwargs)
         self._beta = beta
@@ -74,5 +86,7 @@ class GELU(HybridBlock):
 
 
 class SiLU(HybridBlock):
+    """Sigmoid-weighted linear unit, x * sigmoid(x)."""
+
     def hybrid_forward(self, F, x):
         return F.silu(x)

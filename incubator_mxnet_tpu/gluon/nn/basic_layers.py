@@ -335,6 +335,8 @@ class Embedding(HybridBlock):
 
 
 class Flatten(HybridBlock):
+    """Flatten all dims but the batch axis."""
+
     def hybrid_forward(self, F, x):
         return F.flatten(x)
 
@@ -343,6 +345,8 @@ class Flatten(HybridBlock):
 
 
 class Identity(HybridBlock):
+    """Pass-through block."""
+
     def hybrid_forward(self, F, x):
         return x
 
@@ -362,6 +366,8 @@ class Lambda(Block):
 
 
 class HybridLambda(HybridBlock):
+    """Wrap a pure F-style function as a HybridBlock."""
+
     def __init__(self, function, prefix=None):
         super().__init__(prefix=prefix)
         self._func_name = function if isinstance(function, str) else function.__name__

@@ -90,6 +90,8 @@ class _Conv(HybridBlock):
 
 
 class Conv1D(_Conv):
+    """1-D convolution over NCW data (reference: gluon.nn.Conv1D)."""
+
     def __init__(self, channels, kernel_size, strides=1, padding=0, dilation=1,
                  groups=1, layout="NCW", activation=None, use_bias=True,
                  weight_initializer=None, bias_initializer="zeros",
@@ -100,6 +102,8 @@ class Conv1D(_Conv):
 
 
 class Conv2D(_Conv):
+    """2-D convolution over NCHW data (reference: gluon.nn.Conv2D)."""
+
     def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
                  dilation=(1, 1), groups=1, layout="NCHW", activation=None,
                  use_bias=True, weight_initializer=None, bias_initializer="zeros",
@@ -110,6 +114,8 @@ class Conv2D(_Conv):
 
 
 class Conv3D(_Conv):
+    """3-D convolution over NCDHW data (reference: gluon.nn.Conv3D)."""
+
     def __init__(self, channels, kernel_size, strides=(1, 1, 1), padding=(0, 0, 0),
                  dilation=(1, 1, 1), groups=1, layout="NCDHW", activation=None,
                  use_bias=True, weight_initializer=None, bias_initializer="zeros",
@@ -120,6 +126,8 @@ class Conv3D(_Conv):
 
 
 class Conv1DTranspose(_Conv):
+    """Transposed 1-D convolution (reference: gluon.nn.Conv1DTranspose)."""
+
     def __init__(self, channels, kernel_size, strides=1, padding=0,
                  output_padding=0, dilation=1, groups=1, layout="NCW",
                  activation=None, use_bias=True, weight_initializer=None,
@@ -131,6 +139,8 @@ class Conv1DTranspose(_Conv):
 
 
 class Conv2DTranspose(_Conv):
+    """Transposed 2-D convolution (reference: gluon.nn.Conv2DTranspose)."""
+
     def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
                  output_padding=(0, 0), dilation=(1, 1), groups=1, layout="NCHW",
                  activation=None, use_bias=True, weight_initializer=None,
@@ -142,6 +152,8 @@ class Conv2DTranspose(_Conv):
 
 
 class Conv3DTranspose(_Conv):
+    """Transposed 3-D convolution (reference: gluon.nn.Conv3DTranspose)."""
+
     def __init__(self, channels, kernel_size, strides=(1, 1, 1), padding=(0, 0, 0),
                  output_padding=(0, 0, 0), dilation=(1, 1, 1), groups=1,
                  layout="NCDHW", activation=None, use_bias=True,
@@ -180,6 +192,8 @@ class _Pooling(HybridBlock):
 
 
 class MaxPool1D(_Pooling):
+    """Max pooling over NCW data."""
+
     def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
                  ceil_mode=False, **kwargs):
         super().__init__(_tup(pool_size, 1), strides, padding, ceil_mode,
@@ -187,6 +201,8 @@ class MaxPool1D(_Pooling):
 
 
 class MaxPool2D(_Pooling):
+    """Max pooling over NCHW data."""
+
     def __init__(self, pool_size=(2, 2), strides=None, padding=0, layout="NCHW",
                  ceil_mode=False, **kwargs):
         super().__init__(_tup(pool_size, 2), strides, padding, ceil_mode,
@@ -194,6 +210,8 @@ class MaxPool2D(_Pooling):
 
 
 class MaxPool3D(_Pooling):
+    """Max pooling over NCDHW data."""
+
     def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
                  layout="NCDHW", ceil_mode=False, **kwargs):
         super().__init__(_tup(pool_size, 3), strides, padding, ceil_mode,
@@ -201,6 +219,8 @@ class MaxPool3D(_Pooling):
 
 
 class AvgPool1D(_Pooling):
+    """Average pooling over NCW data."""
+
     def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
                  ceil_mode=False, count_include_pad=True, **kwargs):
         super().__init__(_tup(pool_size, 1), strides, padding, ceil_mode,
@@ -208,6 +228,8 @@ class AvgPool1D(_Pooling):
 
 
 class AvgPool2D(_Pooling):
+    """Average pooling over NCHW data."""
+
     def __init__(self, pool_size=(2, 2), strides=None, padding=0, layout="NCHW",
                  ceil_mode=False, count_include_pad=True, **kwargs):
         super().__init__(_tup(pool_size, 2), strides, padding, ceil_mode,
@@ -215,6 +237,8 @@ class AvgPool2D(_Pooling):
 
 
 class AvgPool3D(_Pooling):
+    """Average pooling over NCDHW data."""
+
     def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
                  layout="NCDHW", ceil_mode=False, count_include_pad=True, **kwargs):
         super().__init__(_tup(pool_size, 3), strides, padding, ceil_mode,
@@ -222,36 +246,50 @@ class AvgPool3D(_Pooling):
 
 
 class GlobalMaxPool1D(_Pooling):
+    """Global max pooling to a single value per channel (NCW)."""
+
     def __init__(self, layout="NCW", **kwargs):
         super().__init__((1,), None, 0, True, True, "max", layout, **kwargs)
 
 
 class GlobalMaxPool2D(_Pooling):
+    """Global max pooling to a single value per channel (NCHW)."""
+
     def __init__(self, layout="NCHW", **kwargs):
         super().__init__((1, 1), None, 0, True, True, "max", layout, **kwargs)
 
 
 class GlobalMaxPool3D(_Pooling):
+    """Global max pooling to a single value per channel (NCDHW)."""
+
     def __init__(self, layout="NCDHW", **kwargs):
         super().__init__((1, 1, 1), None, 0, True, True, "max", layout, **kwargs)
 
 
 class GlobalAvgPool1D(_Pooling):
+    """Global average pooling to a single value per channel (NCW)."""
+
     def __init__(self, layout="NCW", **kwargs):
         super().__init__((1,), None, 0, True, True, "avg", layout, **kwargs)
 
 
 class GlobalAvgPool2D(_Pooling):
+    """Global average pooling to a single value per channel (NCHW)."""
+
     def __init__(self, layout="NCHW", **kwargs):
         super().__init__((1, 1), None, 0, True, True, "avg", layout, **kwargs)
 
 
 class GlobalAvgPool3D(_Pooling):
+    """Global average pooling to a single value per channel (NCDHW)."""
+
     def __init__(self, layout="NCDHW", **kwargs):
         super().__init__((1, 1, 1), None, 0, True, True, "avg", layout, **kwargs)
 
 
 class ReflectionPad2D(HybridBlock):
+    """Reflection padding over the spatial dims of NCHW data."""
+
     def __init__(self, padding=0, **kwargs):
         super().__init__(**kwargs)
         if isinstance(padding, int):

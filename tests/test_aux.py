@@ -135,3 +135,17 @@ def test_summary_writer_event_file(tmp_path):
     sw2.add_histogram("nans", onp.array([1.0, onp.nan, 2.0]), 1)
     assert sw2.logdir_file != path  # same-second writers get distinct files
     sw2.close()
+
+
+def test_generated_api_docs_fresh():
+    """docs/api/*.md must match the live registry (reference mechanism: the
+    docs build renders from the same op registry as the runtime)."""
+    import os
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "gen_docs.py"),
+         "--check"], env=env, capture_output=True, text=True, timeout=240)
+    assert r.returncode == 0, r.stdout + r.stderr
