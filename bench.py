@@ -68,8 +68,93 @@ def _arm_watchdog() -> None:
     return t
 
 
+# fwd GFLOPs per image at 224x224 (standard analytic counts, MAC=2 FLOPs);
+# train step ≈ 3x fwd, spatial cost scales with (img/224)^2
+_RESNET_FWD_GFLOPS_224 = {"resnet18_v1": 1.82, "resnet34_v1": 3.67,
+                          "resnet50_v1": 3.87, "resnet101_v1": 7.58,
+                          "resnet50_v2": 4.10}
+
+
+def _measure(trainer, batch, steps, watchdog):
+    """The shared steady-state measurement protocol: compile step (watchdog
+    armed), cancel watchdog once the device proved alive, pre-place resident
+    inputs, warm, optional MXTPU_BENCH_TRACE profiled step, timed loop with
+    one honest sync at the end. Returns (dt_seconds, final_loss)."""
+    import jax
+
+    trainer.step(*batch).asnumpy()  # init + compile
+    if watchdog is not None:
+        watchdog.cancel()           # device is alive; don't cap a long sweep
+    batch = trainer.place(*batch)   # resident inputs: steady-state loop
+    trainer.step(*batch).asnumpy()  # warm
+    trace_dir = os.environ.get("MXTPU_BENCH_TRACE")
+    if trace_dir:
+        with jax.profiler.trace(trace_dir):
+            trainer.step(*batch).asnumpy()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = trainer.step(*batch)
+    loss.asnumpy()
+    return (time.perf_counter() - t0) / steps, loss
+
+
+def run_resnet(watchdog) -> dict:
+    """imgs/sec/chip on a model-zoo ResNet training step (BASELINE.md row:
+    GluonCV train_imagenet.py counterpart). Synthetic NCHW batch; whole step
+    (fwd, CE loss, grads, SGD-momentum) compiled to one XLA executable."""
+    import jax
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import gluon, parallel
+    from incubator_mxnet_tpu.gluon.model_zoo import vision
+
+    model_name = os.environ.get("MXTPU_BENCH_MODEL", "resnet50_v1")
+    if model_name not in _RESNET_FWD_GFLOPS_224:   # before any device work
+        raise SystemExit(
+            f"MXTPU_BENCH_MODEL={model_name!r} has no FLOP table entry; "
+            f"choose one of {sorted(_RESNET_FWD_GFLOPS_224)}")
+    B = int(os.environ.get("MXTPU_BENCH_BATCH", "32"))
+    img = int(os.environ.get("MXTPU_BENCH_IMG", "224"))
+    steps = int(os.environ.get("MXTPU_BENCH_STEPS", "20"))
+    classes = 1000
+    peak_tflops = _peak_tflops()
+
+    net = vision.get_model(model_name, classes=classes)
+    net.initialize(mx.init.Xavier())
+    net.cast("bfloat16")
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()
+    mesh = parallel.make_mesh(devices=jax.devices()[:1])
+    trainer = parallel.ShardedTrainer(
+        net, lambda out, label: ce(out, label), "sgd",
+        {"learning_rate": 0.05, "momentum": 0.9, "multi_precision": True},
+        mesh=mesh, n_labels=1)
+
+    rng = onp.random.RandomState(0)
+    x = rng.randn(B, 3, img, img).astype(onp.float32)
+    y = rng.randint(0, classes, (B,)).astype("float32")
+    import jax.numpy as jnp
+    dt, loss = _measure(trainer, (x.astype(jnp.bfloat16), y), steps, watchdog)
+
+    imgs_per_sec = B / dt
+    fwd_g = _RESNET_FWD_GFLOPS_224[model_name] * (img / 224.0) ** 2
+    flops = 3.0 * fwd_g * 1e9 * B
+    mfu = (flops / dt) / (peak_tflops * 1e12)
+    return {
+        "metric": f"{model_name}_train_imgs_per_sec_per_chip",
+        "value": round(imgs_per_sec, 2),
+        "unit": "imgs/sec/chip",
+        "vs_baseline": round(mfu / 0.40, 4),
+        "extra": {"step_ms": round(dt * 1e3, 2), "mfu": round(mfu, 4),
+                  "batch": B, "img": img,
+                  "backend": jax.default_backend(),
+                  "loss": float(loss.asnumpy())},
+    }
+
+
 def main() -> None:
     watchdog = _arm_watchdog()
+    if os.environ.get("MXTPU_BENCH_WORKLOAD", "bert") == "resnet":
+        print(json.dumps(run_resnet(watchdog)))
+        return
     import jax
     import incubator_mxnet_tpu as mx
     from incubator_mxnet_tpu import models, parallel
@@ -104,20 +189,7 @@ def main() -> None:
     nsp = rng.randint(0, 2, (B,)).astype("float32")
     batch = (ids, tt, vl, pos, mlm_lab, mlm_w, nsp)
 
-    trainer.step(*batch).asnumpy()  # init + compile
-    if watchdog is not None:
-        watchdog.cancel()           # device is alive; don't cap a long sweep
-    batch = trainer.place(*batch)   # resident inputs: steady-state loop
-    trainer.step(*batch).asnumpy()  # warm
-    trace_dir = os.environ.get("MXTPU_BENCH_TRACE")
-    if trace_dir:
-        with jax.profiler.trace(trace_dir):
-            trainer.step(*batch).asnumpy()
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = trainer.step(*batch)
-    loss.asnumpy()
-    dt = (time.perf_counter() - t0) / steps
+    dt, loss = _measure(trainer, batch, steps, watchdog)
 
     tokens_per_sec = B * L / dt
     # Transformer pretraining FLOPs: 6 * n_params * n_tokens for the

@@ -303,17 +303,24 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-5, momentum=0.
     """Returns (out, batch_mean, batch_var). The layer updates running stats
     functionally from the returned batch statistics (aux-state discipline —
     see gluon/nn BatchNorm; reference mutates aux states inside the op)."""
+    # statistics and normalization in fp32 (AMP discipline: the layer keeps
+    # gamma/beta/running stats fp32 under cast('bfloat16')); the output drops
+    # back to the activation dtype so bf16 nets stay bf16 end-to-end
+    x32 = data.astype(jnp.float32)
     axes = tuple(i for i in range(data.ndim) if i != axis)
     if training and not use_global_stats:
-        m = jnp.mean(data, axis=axes)
-        v = jnp.var(data, axis=axes)
+        m = jnp.mean(x32, axis=axes)
+        v = jnp.var(x32, axis=axes)
     else:
-        m, v = moving_mean, moving_var
+        m = moving_mean.astype(jnp.float32)
+        v = moving_var.astype(jnp.float32)
     shape = [1] * data.ndim
     shape[axis] = data.shape[axis]
     g = jnp.ones_like(gamma) if fix_gamma else gamma
-    out = (data - m.reshape(shape)) * lax.rsqrt(v.reshape(shape) + eps) * g.reshape(shape) + beta.reshape(shape)
-    return out, m, v
+    out = ((x32 - m.reshape(shape)) * lax.rsqrt(v.reshape(shape) + eps)
+           * g.reshape(shape).astype(jnp.float32)
+           + beta.reshape(shape).astype(jnp.float32))
+    return out.astype(data.dtype), m, v
 
 
 @register_op("LayerNorm", aliases=("layer_norm",), schema=Schema(

@@ -1,0 +1,41 @@
+"""The headline bench must run end-to-end on any backend (reference
+mechanism: benchmark scripts smoke-run in CI; SURVEY §6). Tiny configs —
+the numbers are meaningless on CPU, the contract (one JSON dict with
+value/unit/extra, finite loss) is what's under test."""
+import json
+import os
+
+import pytest
+
+
+def _run_bench(monkeypatch, capsys, **env):
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
+    monkeypatch.setenv("MXTPU_BENCH_TIMEOUT", "0")  # no watchdog under pytest
+    import importlib.util
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(repo, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod.main()
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    return json.loads(line)
+
+
+def test_bench_bert_contract(monkeypatch, capsys):
+    rec = _run_bench(monkeypatch, capsys, MXTPU_BENCH_MODEL="bert_2_128_2",
+                     MXTPU_BENCH_BATCH="2", MXTPU_BENCH_SEQ="64",
+                     MXTPU_BENCH_STEPS="2")
+    import math
+    assert rec["unit"] == "tokens/sec/chip" and rec["value"] > 0
+    assert math.isfinite(rec["extra"]["loss"])
+
+
+def test_bench_resnet_contract(monkeypatch, capsys):
+    import math
+    rec = _run_bench(monkeypatch, capsys, MXTPU_BENCH_WORKLOAD="resnet",
+                     MXTPU_BENCH_MODEL="resnet18_v1", MXTPU_BENCH_BATCH="2",
+                     MXTPU_BENCH_IMG="64", MXTPU_BENCH_STEPS="2")
+    assert rec["unit"] == "imgs/sec/chip" and rec["value"] > 0
+    assert math.isfinite(rec["extra"]["loss"])
