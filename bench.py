@@ -68,11 +68,12 @@ def _arm_watchdog() -> None:
     return t
 
 
-# fwd GFLOPs per image at 224x224 (standard analytic counts, MAC=2 FLOPs);
-# train step ≈ 3x fwd, spatial cost scales with (img/224)^2
-_RESNET_FWD_GFLOPS_224 = {"resnet18_v1": 1.82, "resnet34_v1": 3.67,
-                          "resnet50_v1": 3.87, "resnet101_v1": 7.58,
-                          "resnet50_v2": 4.10}
+# fwd GMACs per image at 224x224 (the canonical He-et-al. multiply-add
+# counts); FLOPs = 2x MACs, train step ≈ 3x fwd, spatial cost scales with
+# (img/224)^2
+_RESNET_FWD_GMACS_224 = {"resnet18_v1": 1.82, "resnet34_v1": 3.67,
+                         "resnet50_v1": 3.87, "resnet101_v1": 7.58,
+                         "resnet50_v2": 4.10}
 
 
 def _measure(trainer, batch, steps, watchdog):
@@ -108,10 +109,10 @@ def run_resnet(watchdog) -> dict:
     from incubator_mxnet_tpu.gluon.model_zoo import vision
 
     model_name = os.environ.get("MXTPU_BENCH_MODEL", "resnet50_v1")
-    if model_name not in _RESNET_FWD_GFLOPS_224:   # before any device work
+    if model_name not in _RESNET_FWD_GMACS_224:    # before any device work
         raise SystemExit(
             f"MXTPU_BENCH_MODEL={model_name!r} has no FLOP table entry; "
-            f"choose one of {sorted(_RESNET_FWD_GFLOPS_224)}")
+            f"choose one of {sorted(_RESNET_FWD_GMACS_224)}")
     B = int(os.environ.get("MXTPU_BENCH_BATCH", "32"))
     img = int(os.environ.get("MXTPU_BENCH_IMG", "224"))
     steps = int(os.environ.get("MXTPU_BENCH_STEPS", "20"))
@@ -135,8 +136,8 @@ def run_resnet(watchdog) -> dict:
     dt, loss = _measure(trainer, (x.astype(jnp.bfloat16), y), steps, watchdog)
 
     imgs_per_sec = B / dt
-    fwd_g = _RESNET_FWD_GFLOPS_224[model_name] * (img / 224.0) ** 2
-    flops = 3.0 * fwd_g * 1e9 * B
+    fwd_gmacs = _RESNET_FWD_GMACS_224[model_name] * (img / 224.0) ** 2
+    flops = 3.0 * 2.0 * fwd_gmacs * 1e9 * B   # train = 3x fwd, FLOP = 2x MAC
     mfu = (flops / dt) / (peak_tflops * 1e12)
     return {
         "metric": f"{model_name}_train_imgs_per_sec_per_chip",

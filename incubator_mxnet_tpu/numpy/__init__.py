@@ -96,6 +96,41 @@ def _wrap_jnp(name: str):
     return fn
 
 
+def trapz(y, x=None, dx=1.0, axis=-1):
+    """numpy<2 spelling of the trapezoid rule (jnp only has `trapezoid`);
+    routed through dispatch_op like every generated wrapper, so autograd
+    records it and the context is preserved."""
+    f = _wrap_jnp("trapezoid")
+    return f(y, x, dx=dx, axis=axis) if x is not None else f(y, dx=dx,
+                                                             axis=axis)
+
+
+def ascontiguousarray(a, dtype=None):
+    """Layout is XLA's concern; equivalent to asarray here (dispatch-routed
+    so the gradient chain and context survive)."""
+    f = _wrap_jnp("asarray")
+    return f(a, dtype=dtype) if dtype is not None else f(a)
+
+
+def shares_memory(a, b, max_work=None):
+    """NDArray/jax operands: True only when both wrap the SAME device buffer
+    (jax arrays are immutable, so distinct buffers never alias). Raw numpy
+    operands delegate to numpy's own overlap analysis."""
+    av = a._data if isinstance(a, NDArray) else a
+    bv = b._data if isinstance(b, NDArray) else b
+    if isinstance(av, onp.ndarray) and isinstance(bv, onp.ndarray):
+        return bool(onp.shares_memory(av, bv))
+    return av is bv
+
+
+def may_share_memory(a, b, max_work=None):
+    av = a._data if isinstance(a, NDArray) else a
+    bv = b._data if isinstance(b, NDArray) else b
+    if isinstance(av, onp.ndarray) and isinstance(bv, onp.ndarray):
+        return bool(onp.may_share_memory(av, bv))
+    return av is bv
+
+
 def __getattr__(name: str) -> Any:
     if hasattr(jnp, name):
         wrapped = _wrap_jnp(name)
