@@ -20,7 +20,7 @@ from .ndarray.ndarray import NDArray
 
 __all__ = ["Initializer", "Zero", "One", "Constant", "Uniform", "Normal",
            "Orthogonal", "Xavier", "MSRAPrelu", "Bilinear", "LSTMBias",
-           "register", "create"]
+           "Mixed", "Load", "register", "create"]
 
 _registry: Registry = Registry.get("initializer")
 register = _registry.register
@@ -225,3 +225,70 @@ class LSTMBias(Initializer):
 
     _init_bias = _init_weight
     _init_default = _init_weight
+
+
+@register
+class Mixed(Initializer):
+    """Per-name-pattern initializer routing (reference: initializer.Mixed):
+    the FIRST regex that matches the parameter name wins."""
+
+    def __init__(self, patterns, initializers):
+        super().__init__()
+        if len(patterns) != len(initializers):
+            raise ValueError("Mixed needs len(patterns) == len(initializers)")
+        self.map = [(re.compile(p), create(i) if not isinstance(i, Initializer)
+                     else i) for p, i in zip(patterns, initializers)]
+
+    def __call__(self, name, arr):
+        for pat, init in self.map:
+            if pat.match(str(name)):
+                init(name, arr)
+                return
+        raise ValueError(
+            f"Parameter {name!r} matches no pattern in Mixed; add a catch-all "
+            "'.*' entry as the reference requires")
+
+
+@register
+class Load(Initializer):
+    """Initialize parameters by name from a saved param dict / .params file.
+
+    Names missing from the file fall back to ``default_init`` (reference:
+    initializer.Load — warm-starting from a checkpoint with a different
+    head)."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        super().__init__()
+        if isinstance(param, str):
+            from .ndarray import load as _load_params
+            param = _load_params(param)
+        if not isinstance(param, dict):
+            raise ValueError(
+                "Load needs a name->NDArray dict (or a .params file saved "
+                f"from one); got {type(param).__name__} — save with "
+                "nd.save(fname, {name: array, ...})")
+        self.param = {(k.split(":", 1)[1] if k.startswith(("arg:", "aux:"))
+                       else k): v for k, v in param.items()}
+        self.default_init = create(default_init) \
+            if default_init is not None else None
+        self.verbose = verbose
+
+    def __call__(self, name, arr):
+        name = str(name)
+        if name in self.param:
+            src = self.param[name]
+            if tuple(src.shape) != tuple(arr.shape):
+                raise ValueError(
+                    f"Parameter {name!r} has shape {tuple(arr.shape)} but the "
+                    f"loaded value has {tuple(src.shape)}")
+            arr._set_data(jnp.asarray(
+                src.asnumpy() if hasattr(src, "asnumpy") else src,
+                arr._data.dtype))
+            if self.verbose:
+                print(f"Initialized {name} from the loaded file")
+        elif self.default_init is not None:
+            self.default_init(name, arr)
+        else:
+            raise ValueError(
+                f"Parameter {name!r} missing from the loaded file and no "
+                "default_init was given")

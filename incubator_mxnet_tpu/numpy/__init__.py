@@ -96,11 +96,18 @@ def _wrap_jnp(name: str):
     return fn
 
 
+_trapezoid = None
+_asarray_routed = None
+
+
 def trapz(y, x=None, dx=1.0, axis=-1):
     """numpy<2 spelling of the trapezoid rule (jnp only has `trapezoid`);
     routed through dispatch_op like every generated wrapper, so autograd
     records it and the context is preserved."""
-    f = _wrap_jnp("trapezoid")
+    global _trapezoid
+    if _trapezoid is None:
+        _trapezoid = _wrap_jnp("trapezoid")
+    f = _trapezoid
     return f(y, x, dx=dx, axis=axis) if x is not None else f(y, dx=dx,
                                                              axis=axis)
 
@@ -108,7 +115,10 @@ def trapz(y, x=None, dx=1.0, axis=-1):
 def ascontiguousarray(a, dtype=None):
     """Layout is XLA's concern; equivalent to asarray here (dispatch-routed
     so the gradient chain and context survive)."""
-    f = _wrap_jnp("asarray")
+    global _asarray_routed
+    if _asarray_routed is None:
+        _asarray_routed = _wrap_jnp("asarray")
+    f = _asarray_routed
     return f(a, dtype=dtype) if dtype is not None else f(a)
 
 

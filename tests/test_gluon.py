@@ -532,3 +532,41 @@ def test_estimator_fit_with_event_handlers(tmp_path):
     # checkpoint loads back
     net2 = gluon.nn.Dense(2)
     net2.load_parameters(ckpt.saved[-1])
+
+
+def test_initializer_mixed_and_load(tmp_path):
+    """Mixed pattern routing + Load warm-start (reference: initializer.Mixed
+    / initializer.Load in python/mxnet/initializer.py)."""
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import gluon, nd
+
+    # Mixed routes by name pattern; first match wins (weights only — the
+    # base-class suffix routing still sends *_bias to zeros, reference
+    # _legacy_init semantics)
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Dense(4, in_units=3))
+        net.add(gluon.nn.Dense(2, in_units=4))
+    net.initialize(mx.init.Mixed(
+        [".*dense0.*", ".*"], [mx.init.One(), mx.init.Zero()]))
+    assert (net[0].weight.data().asnumpy() == 1.0).all()
+    assert (net[1].weight.data().asnumpy() == 0.0).all()
+
+    # Load: warm-start a second net from saved params; missing names fall
+    # back to default_init
+    fname = str(tmp_path / "warm.params")
+    nd.save(fname, {"dense1_weight": net[0].weight.data()})
+    net2 = gluon.nn.Dense(4, in_units=3, prefix="dense1_")
+    net2.initialize(mx.init.Load(fname, default_init=mx.init.Zero()))
+    assert (net2.weight.data().asnumpy()
+            == net[0].weight.data().asnumpy()).all()     # from the file
+    assert (net2.bias.data().asnumpy() == 0.0).all()     # default_init
+
+    # no-match Mixed raises the reference's catch-all guidance
+    net3 = gluon.nn.Dense(2, in_units=2)
+    try:
+        net3.initialize(mx.init.Mixed([".*gamma"], [mx.init.One()]))
+        raised = False
+    except ValueError:
+        raised = True
+    assert raised
