@@ -96,7 +96,7 @@ def _maybe_ring(query, key, value, mask, causal, scale):
 
 @register_op()
 def dot_product_attention(query, key, value, mask=None, causal=False,
-                          scale=None, impl="auto", **_):
+                          scale=None, impl="auto", window=None, **_):
     """Fused scaled-dot-product attention.
 
     Shapes: ``query (B, H, Lq, D)``, ``key/value (B, H, Lk, D)``,
@@ -106,15 +106,30 @@ def dot_product_attention(query, key, value, mask=None, causal=False,
     ``impl``: "auto" picks the Pallas flash kernel on TPU when shapes allow,
     else the XLA-fused jnp path; "xla" / "flash" force one (env override:
     MXTPU_ATTN_IMPL).
+
+    ``window`` (with ``causal=True``): causal sliding-window attention over
+    the ``window`` most recent keys — O(L·window) on the flash path (dead
+    tiles skipped), a banded mask on the XLA path.
     """
     import os
     impl = os.environ.get("MXTPU_ATTN_IMPL", impl)
     scale = (query.shape[-1] ** -0.5) if scale is None else scale
+    if window is not None:
+        window = int(window)
+        if not causal:
+            raise ValueError("window= requires causal=True")
+        if window <= 0:
+            raise ValueError(f"window must be positive, got {window}")
+        if impl == "ring":
+            raise ValueError(
+                "impl='ring' does not support window= (the band does not "
+                "decompose over ring hops); use impl='auto'/'flash'")
     # Sequence parallelism: when tracing under a mesh with sp>1 (ShardedTrainer
     # binds it via parallel.mesh.active_mesh), lower to ring attention — K/V
     # shards rotate over the sp axis, the per-hop block attention is the
-    # Pallas flash kernel. See parallel/ring.py.
-    if impl in ("auto", "ring"):
+    # Pallas flash kernel. See parallel/ring.py. (A sliding window stays on
+    # the local paths: the band doesn't decompose over ring hops.)
+    if impl in ("auto", "ring") and window is None:
         ring_out = _maybe_ring(query, key, value, mask, causal, scale)
         if ring_out is not None:
             return ring_out
@@ -128,7 +143,7 @@ def dot_product_attention(query, key, value, mask=None, causal=False,
     if use_flash:
         from .pallas.flash_attention import flash_attention
         return flash_attention(query, key, value, mask=mask, causal=causal,
-                               scale=scale)
+                               scale=scale, window=window)
     acc = jnp.float32
     s = jnp.einsum("bhqd,bhkd->bhqk", query, key,
                    preferred_element_type=acc) * scale
@@ -137,6 +152,10 @@ def dot_product_attention(query, key, value, mask=None, causal=False,
     if causal:
         lq, lk = s.shape[-2], s.shape[-1]
         cm = jnp.tril(jnp.ones((lq, lk), bool), k=lk - lq)
+        if window is not None:
+            cm = jnp.logical_and(
+                cm, jnp.triu(jnp.ones((lq, lk), bool),
+                             k=lk - lq - int(window) + 1))
         s = jnp.where(cm, s, jnp.full((), _NEG, acc))
     p = jax.nn.softmax(s, axis=-1).astype(query.dtype)
     return jnp.einsum("bhqk,bhkd->bhqd", p, value,

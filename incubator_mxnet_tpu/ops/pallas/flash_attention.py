@@ -144,12 +144,27 @@ def _as_key_mask(mask, B, H, Lq, Lk):
     return None
 
 
-def _causal_live(iq, jk, bq, bk, causal_off):
+def _causal_live(iq, jk, bq, bk, causal_off, window=None):
     """Does q-block iq intersect any unmasked position of k-block jk?
-    (bottom-right aligned causal: col <= row + causal_off)"""
+    (bottom-right aligned causal: col <= row + causal_off; with a sliding
+    window additionally col > row + causal_off - window). Dead tiles are
+    skipped entirely — a window turns the O(L²) tile grid into O(L·W)."""
     first_row = iq * bq
     first_col = jk * bk
-    return first_col <= first_row + (bq - 1) + causal_off
+    live = first_col <= first_row + (bq - 1) + causal_off
+    if window is not None:
+        last_col = first_col + bk - 1
+        live = jnp.logical_and(
+            live, last_col > first_row + causal_off - window)
+    return live
+
+
+def _band(rows, cols, causal_off, window):
+    """The in-tile visibility mask for causal (+ optional window)."""
+    live = cols <= rows + causal_off
+    if window is not None:
+        live = jnp.logical_and(live, cols > rows + causal_off - window)
+    return live
 
 
 # ---------------------------------------------------------------------------
@@ -157,7 +172,8 @@ def _causal_live(iq, jk, bq, bk, causal_off):
 # ---------------------------------------------------------------------------
 
 def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
-                acc_ref, m_ref, l_ref, *, scale, causal, causal_off):
+                acc_ref, m_ref, l_ref, *, scale, causal, causal_off,
+                window=None):
     bq, d = q_ref.shape[1], q_ref.shape[2]
     bk = k_ref.shape[1]
     iq = pl.program_id(1)
@@ -182,7 +198,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
         if causal:
             rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + iq * bq
             cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + jk * bk
-            s = jnp.where(cols <= rows + causal_off, s, _NEG)
+            s = jnp.where(_band(rows, cols, causal_off, window), s, _NEG)
         m_prev = m_ref[...]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -193,8 +209,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
             preferred_element_type=jnp.float32)
         m_ref[...] = m_new
 
-    if causal:  # skip tiles fully above the diagonal
-        pl.when(_causal_live(iq, jk, bq, bk, causal_off))(_step)
+    if causal:  # skip tiles fully outside the (banded) diagonal
+        pl.when(_causal_live(iq, jk, bq, bk, causal_off, window))(_step)
     else:
         _step()
 
@@ -219,7 +235,7 @@ def _scratch(bq, d):
             pltpu.VMEM((bq, 1), jnp.float32)]
 
 
-def _fwd(q, k, v, key_mask, causal, scale):
+def _fwd(q, k, v, key_mask, causal, scale, window=None):
     B, H, Lq, D = q.shape
     Lk = k.shape[2]
     bq, bk = _bq(Lq), _bk(Lk)
@@ -242,7 +258,7 @@ def _fwd(q, k, v, key_mask, causal, scale):
         args.append(key_mask.astype(jnp.int32).reshape(key_mask.shape[0], 1, Lk))
     kern = functools.partial(
         _fwd_kernel if key_mask is not None else _fwd_kernel_nomask,
-        scale=scale, causal=causal, causal_off=Lk - Lq)
+        scale=scale, causal=causal, causal_off=Lk - Lq, window=window)
     interpret = _interpret_for(q3)
     kwargs = {}
     if not interpret and pltpu is not None:
@@ -275,7 +291,7 @@ def _fwd(q, k, v, key_mask, causal, scale):
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
                     dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
-                    causal_off):
+                    causal_off, window=None):
     bk, d = k_ref.shape[1], k_ref.shape[2]
     bq = q_ref.shape[1]
     jk = pl.program_id(1)
@@ -301,7 +317,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
         if causal:
             rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + iq * bq
             cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + jk * bk
-            s = jnp.where(cols <= rows + causal_off, s, _NEG)
+            s = jnp.where(_band(rows, cols, causal_off, window), s, _NEG)
         # masked entries: exp(s - lse) can overflow for fully-masked rows
         # (lse floors at m + log eps); they carry no gradient — zero them.
         p = jnp.where(s > _NEG * 0.5, jnp.exp(s - lseb[:, None]), 0.0)
@@ -317,7 +333,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
             preferred_element_type=jnp.float32)
 
     if causal:
-        pl.when(_causal_live(iq, jk, bq, bk, causal_off))(_step)
+        pl.when(_causal_live(iq, jk, bq, bk, causal_off, window))(_step)
     else:
         _step()
 
@@ -334,7 +350,7 @@ def _bwd_dkv_kernel_nomask(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
-                   dq_ref, dq_acc, *, scale, causal, causal_off):
+                   dq_ref, dq_acc, *, scale, causal, causal_off, window=None):
     bq, d = q_ref.shape[1], q_ref.shape[2]
     bk = k_ref.shape[1]
     iq = pl.program_id(1)
@@ -359,7 +375,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
         if causal:
             rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + iq * bq
             cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + jk * bk
-            s = jnp.where(cols <= rows + causal_off, s, _NEG)
+            s = jnp.where(_band(rows, cols, causal_off, window), s, _NEG)
         p = jnp.where(s > _NEG * 0.5, jnp.exp(s - lseb[:, None]), 0.0)
         dp = jax.lax.dot_general(dob, vb, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -369,7 +385,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
             preferred_element_type=jnp.float32)
 
     if causal:
-        pl.when(_causal_live(iq, jk, bq, bk, causal_off))(_step)
+        pl.when(_causal_live(iq, jk, bq, bk, causal_off, window))(_step)
     else:
         _step()
 
@@ -384,7 +400,8 @@ def _bwd_dq_kernel_nomask(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    dq_ref, dq_acc, **kw)
 
 
-def _bwd(q, k, v, key_mask, causal, scale, o, lse, do, dlse=None):
+def _bwd(q, k, v, key_mask, causal, scale, o, lse, do, dlse=None,
+         window=None):
     B, H, Lq, D = q.shape
     Lk = k.shape[2]
     bq, bk = _bq(Lq), _bk(Lk)
@@ -422,7 +439,7 @@ def _bwd(q, k, v, key_mask, causal, scale, o, lse, do, dlse=None):
         args = args + [key_mask.astype(jnp.int32).reshape(-1, 1, Lk)]
     dkv_kern = functools.partial(
         _bwd_dkv_kernel if key_mask is not None else _bwd_dkv_kernel_nomask,
-        scale=scale, causal=causal, causal_off=Lk - Lq)
+        scale=scale, causal=causal, causal_off=Lk - Lq, window=window)
     dk, dv = pl.pallas_call(
         dkv_kern,
         grid=(BH, Lk // bk, Lq // bq),
@@ -459,7 +476,7 @@ def _bwd(q, k, v, key_mask, causal, scale, o, lse, do, dlse=None):
                                      memory_space=_VMEM))
     dq_kern = functools.partial(
         _bwd_dq_kernel if key_mask is not None else _bwd_dq_kernel_nomask,
-        scale=scale, causal=causal, causal_off=Lk - Lq)
+        scale=scale, causal=causal, causal_off=Lk - Lq, window=window)
     dq = pl.pallas_call(
         dq_kern,
         grid=(BH, Lq // bq, Lk // bk),
@@ -480,20 +497,21 @@ def _bwd(q, k, v, key_mask, causal, scale, o, lse, do, dlse=None):
 # public entry with custom VJP
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
-def _flash(q, k, v, key_mask, causal, scale):
-    o, _ = _fwd(q, k, v, key_mask, causal, scale)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash(q, k, v, key_mask, causal, scale, window=None):
+    o, _ = _fwd(q, k, v, key_mask, causal, scale, window)
     return o
 
 
-def _flash_fwd(q, k, v, key_mask, causal, scale):
-    o, lse = _fwd(q, k, v, key_mask, causal, scale)
+def _flash_fwd(q, k, v, key_mask, causal, scale, window=None):
+    o, lse = _fwd(q, k, v, key_mask, causal, scale, window)
     return o, (q, k, v, key_mask, o, lse)
 
 
-def _flash_bwd(causal, scale, res, do):
+def _flash_bwd(causal, scale, window, res, do):
     q, k, v, key_mask, o, lse = res
-    dq, dk, dv = _bwd(q, k, v, key_mask, causal, scale, o, lse, do)
+    dq, dk, dv = _bwd(q, k, v, key_mask, causal, scale, o, lse, do,
+                      window=window)
     return dq, dk, dv, None
 
 
@@ -529,13 +547,26 @@ flash_block.defvjp(_flash_block_fwd, _flash_block_bwd)
 
 
 def flash_attention(q, k, v, mask=None, causal: bool = False,
-                    scale: Optional[float] = None):
+                    scale: Optional[float] = None,
+                    window: Optional[int] = None):
     """Blockwise attention, O(L·D) memory. See module docstring for the
     supported mask forms; unsupported ones should be routed to the XLA path
-    by the caller (dot_product_attention does this via flash_supported)."""
+    by the caller (dot_product_attention does this via flash_supported).
+
+    ``window`` (requires ``causal=True``): causal sliding-window attention —
+    position i attends to the ``window`` most recent keys only. Tiles fully
+    outside the band are skipped, so compute is O(L·window) not O(L²): the
+    Mistral-style long-context recipe, native to the tile grid."""
     scale = (q.shape[-1] ** -0.5) if scale is None else float(scale)
     B, H, Lq, D = q.shape
     Lk = k.shape[2]
+    if window is not None:
+        window = int(window)
+        if window <= 0:
+            raise ValueError(f"window must be positive, got {window}")
+        if not causal:
+            raise ValueError("window= requires causal=True (the sliding "
+                             "window is defined over the causal band)")
     if Lq % _bq(Lq) or Lk % _bk(Lk):
         raise ValueError(
             f"flash_attention needs Lq/Lk divisible by the block size "
@@ -545,4 +576,4 @@ def flash_attention(q, k, v, mask=None, causal: bool = False,
     if mask is not None and key_mask is None:
         raise ValueError("flash_attention supports key-padding masks "
                          "(B, Lk) / (B,1,1,Lk); use the XLA path otherwise")
-    return _flash(q, k, v, key_mask, causal, scale)
+    return _flash(q, k, v, key_mask, causal, scale, window)

@@ -165,3 +165,90 @@ def test_nd_contrib_aliases_exposed():
         mx.nd.array(rng.randn(1, 2, 8, 4).astype("float32")),
         mx.nd.array(rng.randn(1, 2, 8, 4).astype("float32")))
     assert out.shape == (1, 2, 8, 4)
+
+
+def _banded_ref(q, k, v, window, mask=None):
+    """Dense reference for causal sliding-window attention."""
+    D = q.shape[-1]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (D ** -0.5)
+    lq, lk = s.shape[-2], s.shape[-1]
+    band = jnp.logical_and(
+        jnp.tril(jnp.ones((lq, lk), bool), lk - lq),
+        jnp.triu(jnp.ones((lq, lk), bool), lk - lq - window + 1))
+    if mask is not None:
+        band = jnp.logical_and(band, mask.astype(bool))
+    s = jnp.where(band, s, -1e30)
+    return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
+
+
+@pytest.mark.parametrize("window", [16, 96, 300])
+def test_flash_sliding_window_matches_banded_dense(window, monkeypatch):
+    # 64-row tiles over L=256 so the band spans several tiles and whole
+    # tiles die on both sides of it (the O(L*W) skip path)
+    monkeypatch.setenv("MXTPU_FLASH_BQ", "64")
+    monkeypatch.setenv("MXTPU_FLASH_BK", "64")
+    rng = onp.random.RandomState(1)
+    B, H, L, D = 2, 2, 256, 16
+    q, k, v = (jnp.asarray(rng.randn(B, H, L, D), jnp.float32)
+               for _ in range(3))
+    out = flash_attention(q, k, v, causal=True, window=window)
+    ref = _banded_ref(q, k, v, window)
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                atol=2e-4)
+
+    # gradients through the banded kernel == gradients through dense
+    def f_flash(q, k, v):
+        return (flash_attention(q, k, v, causal=True, window=window)
+                * 0.1).sum()
+
+    def f_ref(q, k, v):
+        return (_banded_ref(q, k, v, window) * 0.1).sum()
+
+    g1 = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        onp.testing.assert_allclose(onp.asarray(a), onp.asarray(b),
+                                    atol=3e-4)
+
+
+def test_flash_sliding_window_with_key_padding(monkeypatch):
+    monkeypatch.setenv("MXTPU_FLASH_BQ", "64")
+    monkeypatch.setenv("MXTPU_FLASH_BK", "64")
+    rng = onp.random.RandomState(2)
+    B, H, L, D = 2, 2, 128, 16
+    q, k, v = (jnp.asarray(rng.randn(B, H, L, D), jnp.float32)
+               for _ in range(3))
+    vl = onp.array([90, 128])
+    key_mask = jnp.asarray((onp.arange(L)[None, :] < vl[:, None]
+                            ).astype("float32"))
+    out = flash_attention(q, k, v, mask=key_mask, causal=True, window=50)
+    full = onp.broadcast_to(onp.asarray(key_mask)[:, None, None, :],
+                            (B, H, L, L))
+    ref = _banded_ref(q, k, v, 50, mask=jnp.asarray(full))
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                atol=2e-4)
+
+
+def test_window_validation_and_xla_parity():
+    rng = onp.random.RandomState(3)
+    B, H, L, D = 1, 2, 64, 8
+    q, k, v = (jnp.asarray(rng.randn(B, H, L, D), jnp.float32)
+               for _ in range(3))
+    with pytest.raises(ValueError, match="causal"):
+        flash_attention(q, k, v, causal=False, window=8)
+    with pytest.raises(ValueError, match="causal"):
+        dot_product_attention(q, k, v, window=8)
+    out = dot_product_attention(q, k, v, causal=True, window=12, impl="xla")
+    ref = _banded_ref(q, k, v, 12)
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                atol=2e-5)
+
+
+def test_window_rejects_zero_and_ring():
+    rng = onp.random.RandomState(4)
+    q, k, v = (jnp.asarray(rng.randn(1, 1, 16, 8), jnp.float32)
+               for _ in range(3))
+    with pytest.raises(ValueError, match="positive"):
+        dot_product_attention(q, k, v, causal=True, window=0, impl="xla")
+    with pytest.raises(ValueError, match="ring"):
+        dot_product_attention(q, k, v, causal=True, window=8, impl="ring")
