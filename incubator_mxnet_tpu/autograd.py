@@ -14,8 +14,10 @@ Semantics notes (divergences documented per SURVEY §7 "hard parts"):
   an input does not corrupt the recorded graph; mutating an array that is
   *itself* required for gradient (i.e. has been recorded) raises, as MXNet
   does.
-- ``create_graph=True`` (higher-order imperative grad) is not supported on the
-  eager tape; use the functional ``hybridize`` path / ``jax.grad`` for that.
+- ``grad(..., create_graph=True)`` records the backward pass itself (each
+  pullback re-linearized from the original inputs at backward time), so the
+  returned gradients are differentiable — higher-order eager grads, at the
+  cost of one re-linearization per node on that pass.
 """
 from __future__ import annotations
 
@@ -248,6 +250,72 @@ def _deposit(arr, grad_map) -> None:
     arr._grad._fresh_grad = True
 
 
+def _grad_create_graph(heads, variables, head_grads, train_mode):
+    """Differentiable backward: every pullback application is re-recorded as
+    a tape node of the form ``(xs, cotangents) -> input grads`` built from
+    ``jax.vjp(node.fn, *xs)`` at BACKWARD time — so the result depends on the
+    original inputs (not frozen residuals) and a further backward()/grad()
+    differentiates through it. This is the reference's create_graph=True
+    (``Imperative::Backward`` with the grad graph recorded); it pays a
+    re-linearization per node, unlike the fast path's stored pullbacks."""
+    from .ndarray import NDArray
+
+    heads = _as_list(heads)
+    head_grads = _as_list(head_grads) if head_grads is not None \
+        else [None] * len(heads)
+
+    grad_map: Dict[int, NDArray] = {}
+    for h, hg in zip(heads, head_grads):
+        if hg is None:
+            hg = NDArray(jnp.ones(h.shape, h._data.dtype), ctx=h.context)
+        elif not isinstance(hg, NDArray):   # raw numpy/jax seed, as backward()
+            hg = NDArray(jnp.asarray(hg, h._data.dtype), ctx=h.context)
+        acc = grad_map.get(id(h))
+        grad_map[id(h)] = hg if acc is None else acc + hg
+
+    tape_snapshot = list(_STATE.tape)   # new nodes append as we go
+    with _RecordingScope(True, train_mode):
+        for node in reversed(tape_snapshot):
+            out_grads = [grad_map.get(id(o)) for o in node.outputs]
+            if all(g is None for g in out_grads):
+                continue
+            cot_nds = []
+            for o, g in zip(node.outputs, out_grads):
+                if g is None:
+                    g = NDArray(jnp.zeros(o.shape, o._data.dtype),
+                                ctx=o.context)
+                cot_nds.append(g)
+            n_in = len(node.input_values)
+            multi = node.multi
+            fn = node.fn
+
+            def pb(*vals, _fn=fn, _n=n_in, _multi=multi):
+                xs, cots = vals[:_n], vals[_n:]
+                _, f_vjp = jax.vjp(_fn, *xs)
+                return tuple(f_vjp(tuple(cots) if _multi else cots[0]))
+
+            vals = list(node.input_values) + [c._data for c in cot_nds]
+            out, vjp_fn = jax.vjp(pb, *vals)
+            outs = [NDArray(o, ctx=inp.context)   # each grad on ITS input's
+                    for o, inp in zip(out, node.inputs)]
+            _record_node(pb, node.inputs + cot_nds, vals, outs,
+                         name=(node.name or "op") + "_backward",
+                         vjp_fn=vjp_fn, multi=True)
+            for arr, g_nd in zip(node.inputs, outs):
+                if _is_float0(g_nd._data):
+                    continue
+                prev = grad_map.get(id(arr))
+                grad_map[id(arr)] = g_nd if prev is None else prev + g_nd
+
+    out = []
+    for v in variables:
+        g = grad_map.get(id(v))
+        if g is None:
+            g = NDArray(jnp.zeros(v.shape, v._data.dtype), ctx=v.context)
+        out.append(g)
+    return out
+
+
 def grad(
     heads,
     variables,
@@ -257,13 +325,20 @@ def grad(
     train_mode: bool = True,
 ):
     """Return gradients of heads w.r.t. variables (MXAutogradBackwardEx with
-    variable outputs). ``create_graph`` is unsupported on the eager tape."""
-    if create_graph:
-        raise MXNetError(
-            "create_graph=True is not supported on the eager tape; "
-            "use the hybridize/jit path (jax.grad) for higher-order grads"
-        )
+    variable outputs). With ``create_graph=True`` the backward pass itself is
+    recorded, so the returned grads are differentiable (reference semantics:
+    retain_graph defaults to create_graph)."""
     from .ndarray import NDArray  # circular-safe local import
+
+    if create_graph:
+        # reference semantics: retain_graph DEFAULTS to create_graph; an
+        # explicit False still wins (the caller is bounding memory and gives
+        # up differentiating the result)
+        out = _grad_create_graph(_as_list(heads), _as_list(variables),
+                                 head_grads, train_mode)
+        if retain_graph is False:
+            clear_tape()
+        return out
 
     variables = _as_list(variables)
     heads = _as_list(heads)

@@ -293,3 +293,69 @@ def test_np_namespace_tail():
         z = mx.np.trapz(y)
     z.backward()
     assert_almost_equal(y.grad, onp.array([0.5, 1.0, 0.5], "float32"))
+
+
+def test_autograd_create_graph_higher_order():
+    """grad(create_graph=True) returns differentiable grads (reference:
+    autograd.grad with create_graph, tests/python/unittest/test_autograd)."""
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import nd, autograd
+
+    # d/dx (dy/dx)^2 for y = x^3: dy/dx = 3x^2, z = 9x^4, dz/dx = 36x^3
+    x = nd.array(onp.array([2.0], "float32"))
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+        dx, = autograd.grad(y, [x], create_graph=True)
+        z = (dx * dx).sum()
+    z.backward()
+    assert_almost_equal(x.grad, onp.array([288.0], "float32"), rtol=1e-5)
+
+    # mixed second order: f = sin(x*w); d/dw (df/dx) = cos(xw) - xw*sin(xw)
+    xv, wv = 0.7, -1.3
+    x = nd.array(onp.array([xv], "float32"))
+    w = nd.array(onp.array([wv], "float32"))
+    w.attach_grad()
+    with autograd.record():
+        f = nd.sin(x * w)
+        dfdx, = autograd.grad(f, [x], create_graph=True)
+        s = dfdx.sum()
+    s.backward()
+    expect = onp.cos(xv * wv) - xv * wv * onp.sin(xv * wv)
+    assert_almost_equal(w.grad, onp.array([expect], "float32"), rtol=1e-5)
+
+    # third order: y=x^4, g1=4x^3, g2=12x^2, dg2/dx = 24x
+    x = nd.array(onp.array([1.5], "float32"))
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x * x
+        g1, = autograd.grad(y, [x], create_graph=True)
+        g2, = autograd.grad(g1, [x], create_graph=True)
+        s = g2.sum()
+    s.backward()
+    assert_almost_equal(x.grad, onp.array([36.0], "float32"), rtol=1e-5)
+
+
+def test_create_graph_raw_seed_and_retain_false():
+    import jax.numpy as jnp
+    from incubator_mxnet_tpu import autograd
+
+    x = nd.array(onp.array([3.0], "float32"))
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        dx, = autograd.grad(y, [x], head_grads=[jnp.ones((1,))],
+                            create_graph=True)   # raw jax seed accepted
+        z = (dx * dx).sum()                      # (2x)^2
+    z.backward()
+    assert_almost_equal(x.grad, onp.array([24.0], "float32"), rtol=1e-5)
+
+    # explicit retain_graph=False wins: the tape is cleared
+    x2 = nd.array(onp.array([2.0], "float32"))
+    x2.attach_grad()
+    with autograd.record():
+        y2 = x2 * x2
+        g2, = autograd.grad(y2, [x2], create_graph=True, retain_graph=False)
+    assert_almost_equal(g2, onp.array([4.0], "float32"))
+    from incubator_mxnet_tpu.autograd import _STATE
+    assert not _STATE.tape
