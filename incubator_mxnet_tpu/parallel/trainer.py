@@ -60,7 +60,7 @@ class ShardedTrainer:
                  mesh: Optional[Mesh] = None,
                  rules: Optional[ShardingRules] = None,
                  n_labels: int = 1, seq_axis: Optional[int] = None,
-                 donate: bool = True):
+                 donate: bool = True, zero1: bool = False):
         self._block = block
         self._loss_fn = loss_fn
         self._optimizer = opt_mod.create(
@@ -70,9 +70,18 @@ class ShardedTrainer:
         self._n_labels = n_labels
         self._seq_axis = seq_axis
         self._donate = donate
+        #: ZeRO-1 / cross-replica weight-update sharding (Xu et al. 2020,
+        #: arxiv 2004.13336): optimizer states (moments + fp32 masters)
+        #: additionally partition over the ``dp`` axis, so XLA
+        #: reduce-scatters gradients into the sharded update and
+        #: all-gathers the new weights — per-chip optimizer memory drops by
+        #: the dp degree while the numerics are unchanged.
+        self._zero1 = zero1
         self._params = None          # sorted List[Parameter]
         self._param_vals = None      # tuple of sharded jax arrays
         self._opt_states = None      # tuple of per-param state tuples
+        self._param_shardings = None  # per-param NamedSharding (post-init)
+        self._state_shardings = None  # per-param tuple of NamedShardings
         self._step_fn = None
         self._info: Dict[str, Any] = {}
         self._t = 0
@@ -116,24 +125,61 @@ class ShardedTrainer:
         # already-matching array shares the buffer, and step-time donation
         # would otherwise delete the gluon Parameter's live data.
         vals, states = [], []
+        self._param_shardings, self._state_shardings = [], []
         for i, (name, p) in enumerate(items):
             v = p.data(warm_ctx)._data
             sh = self._rules.sharding_for(name, self._mesh, tuple(v.shape))
             vals.append(jax.device_put(jnp.copy(v), sh))
-            placed = []
+            self._param_shardings.append(sh)
+            placed, st_shs = [], []
             for s in opt.create_state_multi_precision(i, p.data(warm_ctx)):
-                spec = (self._rules.spec_for(name, tuple(v.shape), self._mesh)
-                        if tuple(s.shape) == tuple(v.shape) else P())
-                placed.append(jax.device_put(
-                    s, NamedSharding(self._mesh, spec)))
+                st_sh = self._state_sharding(name, tuple(v.shape),
+                                             tuple(s.shape))
+                placed.append(jax.device_put(s, st_sh))
+                st_shs.append(st_sh)
             states.append(tuple(placed))
+            self._state_shardings.append(tuple(st_shs))
         self._param_vals = tuple(vals)
         self._opt_states = tuple(states)
+
+    def _state_sharding(self, name, wshape, sshape) -> NamedSharding:
+        """ONE policy for optimizer-state placement (used by init and
+        restore): weight-shaped states follow the weight's rule spec — plus
+        the zero1 dp-partition when enabled — everything else replicates."""
+        spec = (self._rules.spec_for(name, wshape, self._mesh)
+                if sshape == wshape else P())
+        if self._zero1 and sshape == wshape:
+            spec = self._zero1_spec(spec, sshape)
+        return NamedSharding(self._mesh, spec)
+
+    def _zero1_spec(self, spec, shape):
+        """Extend a weight's PartitionSpec with a ``dp`` factor on the first
+        axis that has room — the optimizer-state layout of ZeRO stage 1."""
+        dp = self._mesh.shape.get("dp", 1)
+        if dp == 1:
+            return spec
+        entries = list(tuple(spec)) + [None] * (len(shape) - len(tuple(spec)))
+        for e in entries:
+            used = e if isinstance(e, tuple) else ((e,) if e else ())
+            if "dp" in used:
+                return P(*entries)      # already dp-partitioned by rule
+        for ax in range(len(shape)):
+            e = entries[ax]
+            used = tuple(e) if isinstance(e, tuple) else ((e,) if e else ())
+            cur = 1
+            for a in used:
+                cur *= self._mesh.shape[a]
+            if shape[ax] % (cur * dp) == 0:
+                entries[ax] = used + ("dp",)
+                return P(*entries)
+        return spec                     # nothing divisible: stay replicated
 
     # ------------------------------------------------------------------
     def _build_step(self, n_data: int) -> Callable:
         blk, params, opt = self._block, self._params, self._optimizer
         loss_fn, ctx, info = self._loss_fn, self._ctx, self._info
+        param_shardings = self._param_shardings
+        state_shardings = self._state_shardings
         lr_mults = [opt._get_lr(i) / max(opt.learning_rate, 1e-30)
                     for i in range(len(params))]
         wds = [opt._get_wd(i) for i in range(len(params))]
@@ -172,18 +218,29 @@ class ShardedTrainer:
 
             (loss, effects), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(param_vals)
+            constrain = jax.lax.with_sharding_constraint
             new_vals, new_states = [], []
             for i, (w, g, s) in enumerate(zip(param_vals, grads, opt_states)):
                 if mp[i]:
                     nm, ns = opt.step(s[0], g.astype(jnp.float32), tuple(s[1:]),
                                       lr * lr_mults[i], wds[i], t)
-                    new_vals.append(nm.astype(w.dtype))
-                    new_states.append((nm,) + tuple(ns))
+                    nv = nm.astype(w.dtype)
+                    nst = (nm,) + tuple(ns)
                 else:
                     nw, ns = opt.step(w, g.astype(w.dtype), s,
                                       lr * lr_mults[i], wds[i], t)
-                    new_vals.append(nw.astype(w.dtype))
-                    new_states.append(tuple(ns))
+                    nv = nw.astype(w.dtype)
+                    nst = tuple(ns)
+                # Pin layouts so step outputs keep the step-input shardings:
+                # under zero1 the update math runs dp-sharded (XLA
+                # reduce-scatters the grads into it) and ONLY the new weight
+                # is gathered back to the rule layout — and the next call
+                # sees identical input shardings (no silent recompile).
+                nv = constrain(nv, param_shardings[i])
+                nst = tuple(constrain(a, sh)
+                            for a, sh in zip(nst, state_shardings[i]))
+                new_vals.append(nv)
+                new_states.append(nst)
             return loss, tuple(new_vals), tuple(new_states), effects, t + 1
 
         donate = (0, 1, 4) if self._donate else ()
@@ -318,17 +375,26 @@ class ShardedTrainer:
         self._t = state["t"]
         self._t_dev = None  # re-materialized from self._t on next step
         items = sorted(self._block.collect_params().items())
+        have_shardings = getattr(self, "_param_shardings", None) is not None
         vals, states = [], []
-        for (name, p), v, st in zip(items, state["param_vals"], state["opt_states"]):
-            sh = self._rules.sharding_for(name, self._mesh, tuple(v.shape))
+        for i, ((name, p), v, st) in enumerate(
+                zip(items, state["param_vals"], state["opt_states"])):
+            # Restore onto the EXACT live placements when the trainer is
+            # initialized (keeps the traced step signature — incl. the zero1
+            # dp-partition of optimizer states); before init, recompute the
+            # same layouts from the rules + zero1 policy.
+            if have_shardings:
+                sh = self._param_shardings[i]
+                st_shs = self._state_shardings[i]
+            else:
+                sh = self._rules.sharding_for(name, self._mesh,
+                                              tuple(v.shape))
+                st_shs = [self._state_sharding(name, tuple(v.shape),
+                                               tuple(s.shape)) for s in st]
             vals.append(jax.device_put(jnp.asarray(v), sh))
-            placed = []
-            for s in st:
-                spec = (self._rules.spec_for(name, tuple(s.shape), self._mesh)
-                        if tuple(s.shape) == tuple(v.shape) else P())
-                placed.append(jax.device_put(
-                    jnp.asarray(s), NamedSharding(self._mesh, spec)))
-            states.append(tuple(placed))
+            states.append(tuple(
+                jax.device_put(jnp.asarray(s), ssh)
+                for s, ssh in zip(st, st_shs)))
         self._param_vals, self._opt_states = tuple(vals), tuple(states)
 
     def _load_states_orbax(self, path: str) -> None:
