@@ -121,6 +121,18 @@ def op_configs() -> Dict[str, List[Tuple[str, Callable, Optional[float]]]]:
                   r.randn(8, 12, 512, 64).astype("float32"),
                   r.randn(8, 12, 512, 64).astype("float32")), {}),
         4.0 * 8 * 12 * 512 * 512 * 64)
+    add("dot_product_attention", "B4_H8_L2048_D64_causal_win256",
+        lambda: ((r.randn(4, 8, 2048, 64).astype("float32"),
+                  r.randn(4, 8, 2048, 64).astype("float32"),
+                  r.randn(4, 8, 2048, 64).astype("float32")),
+                 {"causal": True, "window": 256}),
+        # useful FLOPs ~ 4*B*H*L*W*D inside the band
+        4.0 * 4 * 8 * 2048 * 256 * 64)
+
+    # --- patch extraction ---
+    add("im2col", "B32_C64_HW56_K3",
+        lambda: ((r.randn(32, 64, 56, 56).astype("float32"),),
+                 {"kernel": (3, 3), "stride": (1, 1)}))
 
     # --- indexing ---
     add("take", "emb30k_1024x512",

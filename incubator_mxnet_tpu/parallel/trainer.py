@@ -375,26 +375,18 @@ class ShardedTrainer:
         self._t = state["t"]
         self._t_dev = None  # re-materialized from self._t on next step
         items = sorted(self._block.collect_params().items())
-        have_shardings = getattr(self, "_param_shardings", None) is not None
         vals, states = [], []
         for i, ((name, p), v, st) in enumerate(
                 zip(items, state["param_vals"], state["opt_states"])):
-            # Restore onto the EXACT live placements when the trainer is
-            # initialized (keeps the traced step signature — incl. the zero1
-            # dp-partition of optimizer states); before init, recompute the
-            # same layouts from the rules + zero1 policy.
-            if have_shardings:
-                sh = self._param_shardings[i]
-                st_shs = self._state_shardings[i]
-            else:
-                sh = self._rules.sharding_for(name, self._mesh,
-                                              tuple(v.shape))
-                st_shs = [self._state_sharding(name, tuple(v.shape),
-                                               tuple(s.shape)) for s in st]
-            vals.append(jax.device_put(jnp.asarray(v), sh))
+            # Restore onto the EXACT live placements (guaranteed present:
+            # load_states requires an initialized trainer, and _init_state
+            # always records them) — keeps the traced step signature, incl.
+            # the zero1 dp-partition of optimizer states.
+            vals.append(jax.device_put(jnp.asarray(v),
+                                       self._param_shardings[i]))
             states.append(tuple(
                 jax.device_put(jnp.asarray(s), ssh)
-                for s, ssh in zip(st, st_shs)))
+                for s, ssh in zip(st, self._state_shardings[i])))
         self._param_vals, self._opt_states = tuple(vals), tuple(states)
 
     def _load_states_orbax(self, path: str) -> None:

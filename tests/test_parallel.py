@@ -250,7 +250,7 @@ def test_bert_step_sp4_matches_sp1():
     onp.testing.assert_allclose(l_sp4, l_sp1, rtol=2e-4, atol=2e-5)
 
 
-def test_zero1_optimizer_state_sharding_matches_unsharded():
+def test_zero1_optimizer_state_sharding_matches_unsharded(tmp_path):
     """zero1=True (cross-replica weight-update sharding, arxiv 2004.13336):
     optimizer states partition over dp, numerics identical to the replicated
     layout, and the states really are dp-sharded on the mesh."""
@@ -299,8 +299,7 @@ def test_zero1_optimizer_state_sharding_matches_unsharded():
         assert "dp" not in spec_axes
 
     # save/load keeps the zero1 state layout (and the step keeps working)
-    import tempfile, os
-    fname = os.path.join(tempfile.mkdtemp(), "z1.states")
+    fname = str(tmp_path / "z1.states")
     tr1.save_states(fname)
     before = [(s.sharding, s.ndim) for st in tr1._opt_states for s in st]
     tr1.load_states(fname)
