@@ -47,6 +47,8 @@ def main(argv=None) -> float:
     ap.add_argument("--sp", type=int, default=1)
     ap.add_argument("--remat", action="store_true",
                     help="jax.checkpoint per encoder layer")
+    ap.add_argument("--zero1", action="store_true",
+                    help="shard optimizer states over dp (ZeRO-1)")
     args = ap.parse_args(argv)
 
     vocab = 1000 if args.model == "bert_2_128_2" else 30522
@@ -65,7 +67,7 @@ def main(argv=None) -> float:
         net, models.bert_pretrain_loss, "adamw",
         {"learning_rate": args.lr, "multi_precision": True}, mesh=mesh,
         rules=models.bert_sharding_rules(), n_labels=3,
-        seq_axis=1 if args.sp > 1 else None)
+        seq_axis=1 if args.sp > 1 else None, zero1=args.zero1)
 
     rng = onp.random.RandomState(0)
     batch = synthetic_batch(rng, args.batch_size, args.seq_len, P, vocab)
