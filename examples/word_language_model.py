@@ -92,8 +92,10 @@ def main(argv=None) -> float:
     ap.add_argument("--clip", type=float, default=0.25)
     ap.add_argument("--dropout", type=float, default=0.1)
     ap.add_argument("--no-tied", action="store_true")
+    ap.add_argument("--seed", type=int, default=42)
     args = ap.parse_args(argv)
 
+    mx.random.seed(args.seed)  # deterministic init (reference train.py seeds)
     rng = onp.random.RandomState(7)
     corpus = batchify(
         make_corpus((args.steps * args.bptt + 1) * args.batch_size + 1,
