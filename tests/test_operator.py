@@ -512,6 +512,12 @@ _GRAD_CASES = [
     ("fullyconnected",
      lambda x, w, b: nd.FullyConnected(x, w, b, num_hidden=4),
      [(3, 5), (4, 5), (4,)]),
+    ("im2col",
+     lambda x: nd.im2col(x, kernel=(2, 2)) * 0.5,
+     [(2, 3, 4, 4)]),
+    ("linalg_trmm",
+     lambda a, b: nd.linalg_trmm(a, b, lower=True),
+     [(3, 3), (3, 2)]),
     ("convolution",
      lambda x, w, b: nd.Convolution(x, w, b, kernel=(3, 3), num_filter=2,
                                     pad=(1, 1)),
