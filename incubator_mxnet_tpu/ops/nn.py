@@ -601,28 +601,44 @@ def _loss_output(fwd_fn, grad_fn):
     return _f
 
 
+def _per_example_outputs(label) -> float:
+    """num_output in the reference's regression heads: outputs per example
+    (label.Size()/label.shape[0]); gradients are scaled by
+    grad_scale/num_output so multi-output regression averages, not sums."""
+    n = 1
+    for d in label.shape[1:]:
+        n *= int(d)
+    return float(max(n, 1))
+
+
 @register_op("LinearRegressionOutput", aliases=("linear_regression_output",))
 def linear_regression_output(data, label, grad_scale=1.0, **_):
-    """Identity forward; backward = (pred − label)·grad_scale (reference:
-    src/operator/regression_output.cc LinearRegressionOutput)."""
-    return _loss_output(lambda x: x,
-                        lambda p, l: (p - l) * grad_scale)(data, label)
+    """Identity forward; backward = (pred − label)·grad_scale/num_output
+    (reference: src/operator/regression_output.cc LinearRegressionOutput)."""
+    return _loss_output(
+        lambda x: x,
+        lambda p, l: (p - l) * (grad_scale / _per_example_outputs(l))
+    )(data, label)
 
 
 @register_op("LogisticRegressionOutput", aliases=("logistic_regression_output",))
 def logistic_regression_output(data, label, grad_scale=1.0, **_):
-    """Sigmoid forward; backward = (σ(x) − label)·grad_scale (reference:
-    regression_output.cc LogisticRegressionOutput)."""
-    return _loss_output(jax.nn.sigmoid,
-                        lambda p, l: (p - l) * grad_scale)(data, label)
+    """Sigmoid forward; backward = (σ(x) − label)·grad_scale/num_output
+    (reference: regression_output.cc LogisticRegressionOutput)."""
+    return _loss_output(
+        jax.nn.sigmoid,
+        lambda p, l: (p - l) * (grad_scale / _per_example_outputs(l))
+    )(data, label)
 
 
 @register_op("MAERegressionOutput", aliases=("mae_regression_output",))
 def mae_regression_output(data, label, grad_scale=1.0, **_):
-    """Identity forward; backward = sign(pred − label)·grad_scale
+    """Identity forward; backward = sign(pred − label)·grad_scale/num_output
     (reference: regression_output.cc MAERegressionOutput)."""
-    return _loss_output(lambda x: x,
-                        lambda p, l: jnp.sign(p - l) * grad_scale)(data, label)
+    return _loss_output(
+        lambda x: x,
+        lambda p, l: jnp.sign(p - l) * (grad_scale / _per_example_outputs(l))
+    )(data, label)
 
 
 @register_op("SVMOutput", aliases=("svm_output",))

@@ -91,9 +91,10 @@ def flash_supported(q, k, v, mask=None) -> bool:
 
 def _auto_block(length: int) -> int:
     """Default tile rows for one grid dimension: 512 or 256 when they divide
-    ``length``, else one whole (possibly unaligned) block for short
-    sequences, else 512 (which won't divide — the caller then routes to the
-    XLA path via ``flash_supported``).
+    ``length``, else one whole block for sublane-aligned (length % 8 == 0)
+    short sequences (unaligned ones only with MXTPU_FLASH_UNALIGNED=1),
+    else 512 (which won't divide — the caller then routes to the XLA path
+    via ``flash_supported``).
 
     Measured on v5e (BERT-base, L=512, D=64): (BQ, BK)=(512, 512) runs the
     step at 40.9ms vs 45.5ms for (256, 512) and a pathological 1066ms for
@@ -105,9 +106,15 @@ def _auto_block(length: int) -> int:
     for cand in (512, 256):
         if cand <= length and length % cand == 0:
             return cand
-    if length <= 1024:
-        return length  # one unaligned block; VMEM holds it up to D=256
-    return 512  # non-divisible long sequence: caller falls back to XLA
+    if length <= 1024 and (
+            length % 8 == 0
+            or os.environ.get("MXTPU_FLASH_UNALIGNED", "0") == "1"):
+        # One whole block; VMEM holds it up to D=256. Sublane-unaligned
+        # (length % 8 != 0) block shapes are where Mosaic lowering failures
+        # and perf cliffs live, so they stay env-gated until a hardware run
+        # validates them (MXTPU_FLASH_UNALIGNED=1).
+        return length
+    return 512  # not handled: caller falls back to XLA via flash_supported
 
 
 def _bq(lq: int) -> int:

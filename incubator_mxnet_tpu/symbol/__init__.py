@@ -14,6 +14,7 @@ both namespaces from one registry the same way).
 """
 from __future__ import annotations
 
+import ast
 import json
 import sys
 import threading
@@ -554,8 +555,10 @@ def load_json(s: str) -> Symbol:
             attrs = {}
             for k, v in nd_.get("attrs", {}).items():
                 try:
-                    attrs[k] = eval(v, {"__builtins__": {}})  # py literals
-                except Exception:
+                    # literal_eval only — .json symbol files are an
+                    # untrusted load path, never execute code from them
+                    attrs[k] = ast.literal_eval(v)
+                except (ValueError, SyntaxError):
                     attrs[k] = v
             if nd_.get("base") is not None:
                 base = nodes[nd_["base"]]

@@ -84,9 +84,9 @@ def test_flash_cross_length_causal_matches_xla():
 
 
 def test_flash_rejects_non_divisible_lengths():
-    # Lengths <= 1024 always fit one (possibly unaligned) block; beyond that
-    # a length with no {512..8} divisor has no aligned tiling — reject so the
-    # caller routes to the XLA path.
+    # Sublane-aligned lengths <= 1024 fit one block (unaligned ones are
+    # env-gated); beyond 1024 a length with no 512/256 divisor has no tiling
+    # — reject so the caller routes to the XLA path.
     rng = onp.random.RandomState(6)
     q, k, v = (jnp.asarray(rng.randn(1, 1, 1500, 32), jnp.float32)
                for _ in range(3))
@@ -94,12 +94,24 @@ def test_flash_rejects_non_divisible_lengths():
         flash_attention(q, k, v)
 
 
-def test_flash_odd_mid_length_single_block():
+def test_flash_odd_mid_length_single_block(monkeypatch):
+    # 300 % 8 != 0: sublane-unaligned single blocks are env-gated until
+    # validated on hardware; the default routes such shapes to XLA.
+    from incubator_mxnet_tpu.ops.pallas.flash_attention import (
+        _auto_block, flash_supported)
     rng = onp.random.RandomState(8)
     q, k, v = (jnp.asarray(rng.randn(1, 1, 300, 32), jnp.float32)
                for _ in range(3))
-    out = flash_attention(q, k, v)
+    # backend-independent: the alignment gate itself must reject 300
+    assert 300 % _auto_block(300) != 0
+    assert _auto_block(296) == 296          # 296 % 8 == 0: single block ok
+    assert not flash_supported(q, k, v)
+    out = dot_product_attention(q, k, v)          # auto: falls back to XLA
     ref = dot_product_attention(q, k, v, impl="xla")
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                atol=2e-5, rtol=2e-5)
+    monkeypatch.setenv("MXTPU_FLASH_UNALIGNED", "1")
+    out = flash_attention(q, k, v)                # opt-in single block
     onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
                                 atol=2e-5, rtol=2e-5)
 

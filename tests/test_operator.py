@@ -448,7 +448,8 @@ def test_regression_output_heads():
         y = mx.nd.LinearRegressionOutput(d, lab, grad_scale=2.0)
     y.backward()
     onp.testing.assert_allclose(y.asnumpy(), d.asnumpy())
-    onp.testing.assert_allclose(d.grad.asnumpy(), [[-1.0, -2.0]], rtol=1e-6)
+    # grad = (pred - label) * grad_scale / num_output, num_output = 2
+    onp.testing.assert_allclose(d.grad.asnumpy(), [[-0.5, -1.0]], rtol=1e-6)
 
     d2 = mx.nd.array(onp.array([[0.0, 2.0]], "float32"))
     d2.attach_grad()
@@ -457,15 +458,15 @@ def test_regression_output_heads():
     y2.backward()
     sig = 1 / (1 + onp.exp(-d2.asnumpy()))
     onp.testing.assert_allclose(y2.asnumpy(), sig, rtol=1e-6)
-    onp.testing.assert_allclose(d2.grad.asnumpy(), sig - lab.asnumpy(),
-                                rtol=1e-6)
+    onp.testing.assert_allclose(d2.grad.asnumpy(),
+                                (sig - lab.asnumpy()) / 2.0, rtol=1e-6)
 
     d3 = mx.nd.array(onp.array([[0.5, -1.0]], "float32"))
     d3.attach_grad()
     with mx.autograd.record():
         y3 = mx.nd.MAERegressionOutput(d3, lab)
     y3.backward()
-    onp.testing.assert_allclose(d3.grad.asnumpy(), [[-1.0, -1.0]])
+    onp.testing.assert_allclose(d3.grad.asnumpy(), [[-0.5, -0.5]])
 
 
 def test_svm_output_hinge_gradients():
