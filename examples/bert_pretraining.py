@@ -49,7 +49,14 @@ def main(argv=None) -> float:
                     help="jax.checkpoint per encoder layer")
     ap.add_argument("--zero1", action="store_true",
                     help="shard optimizer states over dp (ZeRO-1)")
+    ap.add_argument("--seed", type=int, default=None,
+                    help="RNG seed; default: MXNET_TEST_SEED or 42")
     args = ap.parse_args(argv)
+
+    # deterministic init (reference train.py seeds) — MXNET_TEST_SEED wins
+    # so the committed seed-sweep actually varies the init across runs
+    mx.random.seed(args.seed if args.seed is not None
+                   else int(os.environ.get("MXNET_TEST_SEED", "42")))
 
     vocab = 1000 if args.model == "bert_2_128_2" else 30522
     P = max(1, round(0.15 * args.seq_len))

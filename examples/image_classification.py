@@ -43,7 +43,14 @@ def main(argv=None) -> float:
     ap.add_argument("--rec", default=None, help="RecordIO file (ImageRecordIter)")
     ap.add_argument("--amp", action="store_true", help="bf16 mixed precision")
     ap.add_argument("--kvstore", default="device")
+    ap.add_argument("--seed", type=int, default=None,
+                    help="RNG seed; default: MXNET_TEST_SEED or 42")
     args = ap.parse_args(argv)
+
+    # deterministic init (reference train.py seeds) — MXNET_TEST_SEED wins
+    # so the committed seed-sweep actually varies the init across runs
+    mx.random.seed(args.seed if args.seed is not None
+                   else int(os.environ.get("MXNET_TEST_SEED", "42")))
 
     if args.amp:
         from incubator_mxnet_tpu import amp

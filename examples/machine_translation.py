@@ -46,7 +46,14 @@ def main(argv=None) -> float:
     ap.add_argument("--beam-size", type=int, default=4)
     ap.add_argument("--smooth-eps", type=float, default=0.1,
                     help="label-smoothing epsilon (0 disables)")
+    ap.add_argument("--seed", type=int, default=None,
+                    help="RNG seed; default: MXNET_TEST_SEED or 42")
     args = ap.parse_args(argv)
+
+    # deterministic init (reference train.py seeds) — MXNET_TEST_SEED wins
+    # so the committed seed-sweep actually varies the init across runs
+    mx.random.seed(args.seed if args.seed is not None
+                   else int(os.environ.get("MXNET_TEST_SEED", "42")))
 
     net = NMTModel(src_vocab=args.vocab, tgt_vocab=args.vocab, units=64,
                    hidden_size=128, num_layers=2, num_heads=4, dropout=0.0,

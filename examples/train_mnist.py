@@ -79,7 +79,14 @@ def main(argv=None) -> float:
     ap.add_argument("--lr", type=float, default=0.1)
     ap.add_argument("--data-dir", default="data/mnist")
     ap.add_argument("--num-synthetic", type=int, default=2000)
+    ap.add_argument("--seed", type=int, default=None,
+                    help="RNG seed; default: MXNET_TEST_SEED or 42")
     args = ap.parse_args(argv)
+
+    # deterministic init (reference train.py seeds) — MXNET_TEST_SEED wins
+    # so the committed seed-sweep actually varies the init across runs
+    mx.random.seed(args.seed if args.seed is not None
+                   else int(os.environ.get("MXNET_TEST_SEED", "42")))
 
     flat = args.network == "mlp"
     train, val = get_iters(args.batch_size, flat, args.data_dir,

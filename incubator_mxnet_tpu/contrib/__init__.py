@@ -27,6 +27,11 @@ def _contrib_names():
 nd = types.ModuleType("incubator_mxnet_tpu.contrib.nd")
 for _short, _opdef in _contrib_names().items():
     setattr(nd, _short, make_nd_op(_opdef))
+# control flow (reference: python/mxnet/ndarray/contrib.py)
+from ..ops import control_flow as _cf  # noqa: E402
+nd.foreach = _cf.foreach
+nd.while_loop = _cf.while_loop
+nd.cond = _cf.cond
 sys.modules[nd.__name__] = nd
 
 
@@ -42,6 +47,10 @@ def _make_sym(opname):
 sym = types.ModuleType("incubator_mxnet_tpu.contrib.sym")
 for _short, _opdef in _contrib_names().items():
     setattr(sym, _short, _make_sym(_opdef.name))
+# control flow (reference: python/mxnet/symbol/contrib.py)
+sym.foreach = _cf.sym_foreach
+sym.while_loop = _cf.sym_while_loop
+sym.cond = _cf.sym_cond
 sys.modules[sym.__name__] = sym
 
 

@@ -290,3 +290,15 @@ class ImageIter:
                         [array(onp.asarray(labels, onp.float32))])
 
     next = __next__
+
+
+# detection augmenters + ImageDetIter (reference:
+# python/mxnet/image/detection.py) — imported at the bottom since the
+# submodule borrows the image-only augmenters defined above
+from .detection import (  # noqa: E402,F401
+    DetAugmenter, DetBorrowAug, DetRandomSelectAug, DetHorizontalFlipAug,
+    DetRandomCropAug, DetRandomPadAug, CreateDetAugmenter, ImageDetIter)
+
+__all__ += ["DetAugmenter", "DetBorrowAug", "DetRandomSelectAug",
+            "DetHorizontalFlipAug", "DetRandomCropAug", "DetRandomPadAug",
+            "CreateDetAugmenter", "ImageDetIter"]

@@ -249,3 +249,12 @@ def _load_pickle(fname: str):
     if isinstance(payload, dict):
         return {k: array(v) for k, v in payload.items()}
     return [array(v) for v in payload]
+
+
+def __getattr__(name):
+    # mx.nd.contrib — lazy to avoid an import cycle (reference:
+    # python/mxnet/ndarray/contrib.py; same module as mx.contrib.nd)
+    if name == "contrib":
+        from ..contrib import nd as _contrib_nd
+        return _contrib_nd
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

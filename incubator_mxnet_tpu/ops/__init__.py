@@ -9,4 +9,6 @@ from . import attention  # noqa: F401
 from . import detection  # noqa: F401
 from . import quantization  # noqa: F401
 from . import vision  # noqa: F401
+from . import control_flow  # noqa: F401
+from . import optimizer_ops  # noqa: F401
 from .registry import OPS, OpDef, register_op, alias_op  # noqa: F401

@@ -1,0 +1,482 @@
+"""Control-flow operators: ``foreach`` / ``while_loop`` / ``cond``.
+
+Reference counterpart: ``src/operator/control_flow.cc`` (``_foreach``,
+``_while_loop``, ``_cond`` subgraph ops) surfaced through
+``python/mxnet/ndarray/contrib.py`` and ``python/mxnet/symbol/contrib.py``
+(SURVEY §2.4 contrib subtree) — the backbone of bucketed/dynamic RNN models.
+
+TPU-native design (NOT a port of the reference's subgraph executor):
+
+- ``_foreach``    ≙ ``lax.scan`` — one compiled loop, MXU-friendly, O(1)
+  program size in the trip count.
+- ``_while_loop`` ≙ a masked ``lax.scan`` over ``max_iterations`` ticks:
+  XLA needs static shapes for the stacked per-step outputs, so the traced /
+  symbolic form pads output rows beyond the executed steps with zeros
+  (the reference's symbolic form also requires ``max_iterations`` for the
+  same reason). The imperative NDArray form runs a true Python loop and
+  returns exactly the executed steps — the reference's eager semantics.
+- ``_cond``       ≙ ``lax.cond`` — both branches traced, one taken at run
+  time; gradients flow through the taken branch only.
+
+Imperative-vs-compiled dispatch mirrors the reference split: eager NDArray
+calls with concrete inputs use Python control flow (gradients flow through
+the tape to everything the body touches, including closed-over arrays);
+under a ``hybridize()`` trace or symbolic execution the registered op
+compiles to the ``lax`` primitive.
+
+Note on stochastic bodies: under a traced ``foreach`` every step sees the
+same RNG key (the scan body closes over the trace key); seed-per-step
+dropout inside a compiled loop needs an explicit key state threaded through
+``init_states``.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as _onp
+from jax import lax
+
+from .registry import register_op
+
+onp_asarray = _onp.asarray
+
+__all__ = ["foreach", "while_loop", "cond",
+           "sym_foreach", "sym_while_loop", "sym_cond"]
+
+
+# ---------------------------------------------------------------------------
+# shared helpers
+# ---------------------------------------------------------------------------
+
+def _as_seq(x) -> Tuple[list, bool]:
+    """Normalize NDArray-or-list to (list, was_single)."""
+    if isinstance(x, (list, tuple)):
+        return list(x), False
+    return [x], True
+
+
+def _repack(lst, single):
+    return lst[0] if single else list(lst)
+
+
+def _sub_step(sub: Dict[str, Any]):
+    """jnp-level step callable from a symbolic subgraph spec:
+    ``vals`` (placeholder order = sub['arg_names']) -> list of primaries of
+    ``sub['roots']``."""
+    from .. import symbol as S
+    roots = S.Group(list(sub["roots"]))
+    names = list(sub["arg_names"])
+
+    def run(vals):
+        return S._eval_graph(roots, names, list(vals))
+
+    return run
+
+
+def _scalar_bool(x):
+    return jnp.reshape(x, ()).astype(bool)
+
+
+# ---------------------------------------------------------------------------
+# registered subgraph ops (probe-able in OPS, used by traced/symbolic paths)
+# ---------------------------------------------------------------------------
+
+@register_op("_foreach")
+def _foreach_op(*arrays, body=None, sub=None, n_data=1, n_states=0,
+                n_outs=1, **_):
+    """Scan ``body`` over axis 0 of the data arrays (reference:
+    src/operator/control_flow.cc ``_foreach``). Inputs are
+    ``data x n_data, init_states x n_states, captured...``; outputs are the
+    per-step outputs stacked along a new axis 0 followed by the final
+    states. Lowered to one ``lax.scan``."""
+    n_data, n_states, n_outs = int(n_data), int(n_states), int(n_outs)
+    data = tuple(arrays[:n_data])
+    states = tuple(arrays[n_data:n_data + n_states])
+    capt = tuple(arrays[n_data + n_states:])
+    if body is None:
+        run = _sub_step(sub)
+
+        def body(xs, st, cp):
+            res = run(tuple(xs) + tuple(st) + tuple(cp))
+            return tuple(res[:n_outs]), tuple(res[n_outs:])
+
+    def scan_body(st, xs):
+        outs, new_st = body(xs, st, capt)
+        return tuple(new_st), tuple(outs)
+
+    final, stacked = lax.scan(scan_body, states, data)
+    return tuple(stacked) + tuple(final)
+
+
+@register_op("_while_loop")
+def _while_loop_op(*arrays, cond_fn=None, step_fn=None, sub=None,
+                   n_states=1, n_outs=1, max_iterations=None, **_):
+    """Bounded while loop (reference: control_flow.cc ``_while_loop``).
+    Inputs ``loop_vars x n_states, captured...``; outputs are per-step
+    outputs stacked over ``max_iterations`` ticks (rows beyond the executed
+    steps are zero — XLA static shapes; the reference's symbolic form also
+    fixes the output extent to max_iterations) followed by the final loop
+    vars. Lowered to one masked ``lax.scan``."""
+    if max_iterations is None:
+        raise ValueError("_while_loop requires max_iterations")
+    n_states, n_outs = int(n_states), int(n_outs)
+    states = tuple(arrays[:n_states])
+    capt = tuple(arrays[n_states:])
+    if step_fn is None:
+        n_cond = len(sub["cond_roots"])
+        assert n_cond == 1
+        run_cond = _sub_step({"roots": sub["cond_roots"],
+                              "arg_names": sub["arg_names"]})
+        run_step = _sub_step({"roots": sub["roots"],
+                              "arg_names": sub["arg_names"]})
+
+        def cond_fn(st, cp):
+            return run_cond(tuple(st) + tuple(cp))[0]
+
+        def step_fn(st, cp):
+            res = run_step(tuple(st) + tuple(cp))
+            return tuple(res[:n_outs]), tuple(res[n_outs:])
+
+    def tick(carry, _):
+        st, active = carry
+        ok = jnp.logical_and(active, _scalar_bool(cond_fn(st, capt)))
+        outs, new_st = step_fn(st, capt)
+        new_st = tuple(jnp.where(ok, n, o) for n, o in zip(new_st, st))
+        outs = tuple(jnp.where(ok, o, jnp.zeros_like(o)) for o in outs)
+        return (new_st, ok), tuple(outs)
+
+    (final, _), stacked = lax.scan(
+        tick, (states, jnp.asarray(True)), None, length=int(max_iterations))
+    return tuple(stacked) + tuple(final)
+
+
+@register_op("_cond")
+def _cond_op(pred, *capt, then_branch=None, else_branch=None, sub=None,
+             n_outs=1, **_):
+    """Two-branch conditional (reference: control_flow.cc ``_cond``).
+    ``pred`` is a scalar; both branches are traced, one executes at run time
+    (``lax.cond``). Branch outputs must agree in count/shape/dtype."""
+    n_outs = int(n_outs)
+    p = _scalar_bool(pred)
+    if then_branch is None:
+        run_t = _sub_step({"roots": sub["then"], "arg_names": sub["arg_names"]})
+        run_e = _sub_step({"roots": sub["else"], "arg_names": sub["arg_names"]})
+
+        def then_branch(cp):
+            return tuple(run_t(tuple(cp)))
+
+        def else_branch(cp):
+            return tuple(run_e(tuple(cp)))
+
+    out = lax.cond(p, lambda c: tuple(then_branch(c)),
+                   lambda c: tuple(else_branch(c)), tuple(capt))
+    return out if len(out) > 1 else out[0]
+
+
+# ---------------------------------------------------------------------------
+# NDArray frontend (mx.nd.contrib / mx.contrib.nd)
+# ---------------------------------------------------------------------------
+
+def _is_traced(ndarrays) -> bool:
+    return any(isinstance(a._data, jax.core.Tracer) for a in ndarrays)
+
+
+def _unwrap_val(a):
+    from ..ndarray import NDArray
+    return a._data if isinstance(a, NDArray) else jnp.asarray(a)
+
+
+def _wrap_step(call_body, ctx, n_states, fmt, who):
+    """NDArray-level user body -> jnp-level step callable shared by the
+    traced foreach/while paths (capt unused in the nd path: Python closures
+    carry constants; under a trace, closed-over tracers are scan
+    constants). ``call_body(xs_nd, st_nd) -> (out, new_states)``."""
+    from ..ndarray import NDArray
+    from .. import autograd
+
+    def step(xs_vals, st_vals, _capt):
+        xs = [NDArray(v, ctx=ctx) for v in xs_vals]
+        st = [NDArray(v, ctx=ctx) for v in st_vals]
+        with autograd.pause(train_mode=autograd.is_training()):
+            out, new_st = call_body(xs, st)
+        out_l, o_single = _as_seq(out if out is not None else [])
+        new_l, _ = _as_seq(new_st)
+        if len(new_l) != n_states:
+            raise ValueError(f"{who}: body must preserve the number of "
+                             f"states ({n_states}), got {len(new_l)}")
+        fmt["o_single"] = o_single
+        fmt["n_outs"] = len(out_l)
+        return (tuple(_unwrap_val(a) for a in out_l),
+                tuple(_unwrap_val(a) for a in new_l))
+
+    return step
+
+
+def foreach(body, data, init_states, name: str = "foreach"):
+    """``mx.nd.contrib.foreach`` (reference:
+    python/mxnet/ndarray/contrib.py foreach): run ``body(data_t, states)``
+    over axis 0 of ``data``; returns (stacked outputs, final states).
+
+    Eager-recording calls run a Python loop (exact reference semantics —
+    gradients reach closed-over arrays through the tape); inference and
+    ``hybridize()``-traced calls compile to one ``lax.scan``."""
+    from .. import ndarray as ndmod
+    from .. import autograd
+
+    data_l, d_single = _as_seq(data)
+    states_l, s_single = _as_seq(init_states)
+    ctx = data_l[0].context
+    traced = _is_traced(data_l + states_l)
+
+    T = data_l[0].shape[0]
+    if autograd.is_recording() and not traced and T > 0:
+        # Python-loop path: reference-imperative semantics on the tape
+        st = _repack(list(states_l), s_single)
+        out_steps: List[list] = []
+        o_single = True
+        for t in range(T):
+            xs = [d[t] for d in data_l]
+            out, st = body(_repack(xs, d_single), st)
+            if len(_as_seq(st)[0]) != len(states_l):
+                raise ValueError(
+                    f"foreach: body must preserve the number of states "
+                    f"({len(states_l)}), got {len(_as_seq(st)[0])}")
+            out_l, o_single = _as_seq(out if out is not None else [])
+            out_steps.append(out_l)
+        stacked = [ndmod.stack(*[row[i] for row in out_steps], axis=0)
+                   for i in range(len(out_steps[0]))] if out_steps[0] else []
+        final_l, _ = _as_seq(st)
+        return (_repack(stacked, o_single) if stacked else [],
+                _repack(list(final_l), s_single))
+
+    fmt: Dict[str, Any] = {}
+    step = _wrap_step(
+        lambda xs, st: body(_repack(xs, d_single), _repack(st, s_single)),
+        ctx, len(states_l), fmt, "foreach")
+    res = ndmod._foreach(*data_l, *states_l, body=step,
+                         n_data=len(data_l), n_states=len(states_l))
+    res = res if isinstance(res, (list, tuple)) else [res]
+    n_outs = fmt["n_outs"]
+    outs = list(res[:n_outs])
+    states_out = list(res[n_outs:])
+    return (_repack(outs, fmt["o_single"]),
+            _repack(states_out, s_single))
+
+
+def while_loop(cond, func, loop_vars, max_iterations: Optional[int] = None,
+               name: str = "while_loop"):
+    """``mx.nd.contrib.while_loop`` (reference:
+    python/mxnet/ndarray/contrib.py while_loop): run
+    ``func(*loop_vars) -> (step_output, new_loop_vars)`` while
+    ``cond(*loop_vars)`` holds, at most ``max_iterations`` times; returns
+    (stacked outputs, final loop vars).
+
+    Eager calls run a Python loop whose stacked outputs have exactly
+    ``steps_executed`` rows; traced calls compile to a masked ``lax.scan``
+    whose output extent is ``max_iterations`` with zero rows beyond the
+    executed steps (XLA static shapes — same constraint as the reference's
+    symbolic form)."""
+    from .. import ndarray as ndmod
+    from ..ndarray import NDArray
+    from .. import autograd
+
+    if max_iterations is None:
+        raise ValueError("while_loop requires max_iterations")
+    vars_l, v_single = _as_seq(loop_vars)
+    ctx = vars_l[0].context
+
+    if not _is_traced(vars_l):
+        # Python-loop path (eager + recording): exact step count
+        st = list(vars_l)
+        out_steps: List[list] = []
+        o_single = True
+        steps = 0
+        while steps < max_iterations:
+            c = cond(*st)
+            c = c.asnumpy() if isinstance(c, NDArray) else onp_asarray(c)
+            if not bool(c.reshape(()).item()):
+                break
+            out, new_st = func(*st)
+            out_l, o_single = _as_seq(out if out is not None else [])
+            new_l, _ = _as_seq(new_st)
+            if len(new_l) != len(st):
+                raise ValueError("while_loop: func must preserve the number "
+                                 "of loop_vars")
+            out_steps.append(out_l)
+            st = list(new_l)
+            steps += 1
+        if out_steps and out_steps[0]:
+            stacked = [ndmod.stack(*[row[i] for row in out_steps], axis=0)
+                       for i in range(len(out_steps[0]))]
+            stacked = _repack(stacked, o_single)
+        else:
+            stacked = []
+        return stacked, _repack(st, v_single)
+
+    # traced: masked scan through the registered op
+    fmt: Dict[str, Any] = {}
+    wrapped = _wrap_step(lambda xs, st: func(*st), ctx, len(vars_l), fmt,
+                         "while_loop")
+
+    def step_fn(st_vals, _capt):
+        return wrapped((), st_vals, _capt)
+
+    def cond_fn(st_vals, _capt):
+        from .. import autograd as ag
+        st = [NDArray(v, ctx=ctx) for v in st_vals]
+        with ag.pause(train_mode=ag.is_training()):
+            r = cond(*st)
+        return _unwrap_val(r)
+
+    res = ndmod._while_loop(*vars_l, cond_fn=cond_fn, step_fn=step_fn,
+                            n_states=len(vars_l),
+                            max_iterations=int(max_iterations))
+    res = res if isinstance(res, (list, tuple)) else [res]
+    n_outs = fmt["n_outs"]
+    outs = list(res[:n_outs])
+    return (_repack(outs, fmt["o_single"]) if n_outs else [],
+            _repack(list(res[n_outs:]), v_single))
+
+
+def cond(pred, then_func, else_func, name: str = "cond"):
+    """``mx.nd.contrib.cond`` (reference: python/mxnet/ndarray/contrib.py
+    cond): if scalar ``pred`` (NDArray or zero-arg callable) is true run
+    ``then_func()`` else ``else_func()``. Concrete predicates take a real
+    Python branch (only that branch executes/records); traced predicates
+    compile to ``lax.cond`` (both branches traced, one executed)."""
+    from ..ndarray import NDArray
+    from .. import autograd
+
+    p = pred if isinstance(pred, NDArray) or not callable(pred) else pred()
+    if not isinstance(p, NDArray):
+        # plain python/numpy scalar: real branch
+        return then_func() if bool(p) else else_func()
+    if not _is_traced([p]):
+        taken = then_func if bool(p.asnumpy().reshape(()).item()) \
+            else else_func
+        return taken()
+
+    ctx = p.context
+    fmt: Dict[str, Any] = {}
+
+    def _branch(fn):
+        def run(_capt):
+            with autograd.pause(train_mode=autograd.is_training()):
+                out = fn()
+            out_l, single = _as_seq(out)
+            fmt["o_single"] = single
+            fmt["n_outs"] = len(out_l)
+            return tuple(a._data if isinstance(a, NDArray) else jnp.asarray(a)
+                         for a in out_l)
+        return run
+
+    from .. import ndarray as ndmod
+    res = ndmod._cond(p, then_branch=_branch(then_func),
+                      else_branch=_branch(else_func))
+    res = res if isinstance(res, (list, tuple)) else [res]
+    return _repack(list(res), fmt["o_single"])
+
+
+# ---------------------------------------------------------------------------
+# Symbol frontend (mx.sym.contrib / mx.contrib.sym)
+# ---------------------------------------------------------------------------
+
+def _free_vars(roots, bound_names):
+    """Variable nodes reachable from ``roots`` that are not placeholders —
+    the subgraph's captured inputs (reference contrib.py does the same
+    free-variable lift when cutting the subgraph)."""
+    from .. import symbol as S
+    seen, out = set(), []
+    for r in roots:
+        for node in S._topo(r):
+            if node._op is None and node._base is None \
+                    and node._name not in bound_names \
+                    and id(node) not in seen:
+                seen.add(id(node))
+                out.append(node)
+    return out
+
+
+def sym_foreach(body, data, init_states, name: str = "foreach"):
+    """``mx.sym.contrib.foreach``: build the ``_foreach`` subgraph node.
+    ``body(data_t, states) -> (outputs, new_states)`` is called once with
+    placeholder Variables to cut the subgraph; its free variables become
+    captured node inputs."""
+    from .. import symbol as S
+    data_l, d_single = _as_seq(data)
+    states_l, s_single = _as_seq(init_states)
+    data_ph = [S.Variable(f"{name}_data{i}") for i in range(len(data_l))]
+    state_ph = [S.Variable(f"{name}_state{i}") for i in range(len(states_l))]
+    out, new_st = body(_repack(list(data_ph), d_single),
+                       _repack(list(state_ph), s_single))
+    out_l, o_single = _as_seq(out if out is not None else [])
+    new_l, _ = _as_seq(new_st)
+    if len(new_l) != len(states_l):
+        raise ValueError("foreach: body must preserve the number of states")
+    ph_names = [p.name for p in data_ph] + [p.name for p in state_ph]
+    capt = _free_vars(out_l + new_l, set(ph_names))
+    sub = {"roots": out_l + new_l,
+           "arg_names": ph_names + [c.name for c in capt]}
+    node = S.Symbol("_foreach", [*data_l, *states_l, *capt],
+                    attrs={"sub": sub, "n_data": len(data_l),
+                           "n_states": len(states_l), "n_outs": len(out_l)},
+                    name=name, num_outputs=len(out_l) + len(new_l))
+    outs = [node[i] for i in range(len(out_l))]
+    states_out = [node[len(out_l) + j] for j in range(len(new_l))]
+    return (_repack(outs, o_single if out_l else True),
+            _repack(states_out, s_single))
+
+
+def sym_while_loop(cond, func, loop_vars, max_iterations: Optional[int] = None,
+                   name: str = "while_loop"):
+    """``mx.sym.contrib.while_loop``: build the ``_while_loop`` subgraph
+    node. Outputs are stacked over ``max_iterations`` ticks (zero-padded
+    beyond the executed steps)."""
+    from .. import symbol as S
+    if max_iterations is None:
+        raise ValueError("while_loop requires max_iterations")
+    vars_l, v_single = _as_seq(loop_vars)
+    ph = [S.Variable(f"{name}_var{i}") for i in range(len(vars_l))]
+    pred = cond(*ph)
+    out, new_st = func(*ph)
+    out_l, o_single = _as_seq(out if out is not None else [])
+    new_l, _ = _as_seq(new_st)
+    if len(new_l) != len(vars_l):
+        raise ValueError("while_loop: func must preserve the number of "
+                         "loop_vars")
+    ph_names = [p.name for p in ph]
+    capt = _free_vars([pred] + out_l + new_l, set(ph_names))
+    sub = {"roots": out_l + new_l, "cond_roots": [pred],
+           "arg_names": ph_names + [c.name for c in capt]}
+    node = S.Symbol("_while_loop", [*vars_l, *capt],
+                    attrs={"sub": sub, "n_states": len(vars_l),
+                           "n_outs": len(out_l),
+                           "max_iterations": int(max_iterations)},
+                    name=name, num_outputs=len(out_l) + len(new_l))
+    outs = [node[i] for i in range(len(out_l))]
+    states_out = [node[len(out_l) + j] for j in range(len(new_l))]
+    return (_repack(outs, o_single if out_l else True),
+            _repack(states_out, v_single))
+
+
+def sym_cond(pred, then_func, else_func, name: str = "cond"):
+    """``mx.sym.contrib.cond``: build the ``_cond`` subgraph node. ``pred``
+    is a Symbol (or zero-arg callable returning one) evaluated in the outer
+    graph; branch subgraphs capture their free variables."""
+    from .. import symbol as S
+    p = pred if isinstance(pred, S.Symbol) else pred()
+    then_l, t_single = _as_seq(then_func())
+    else_l, e_single = _as_seq(else_func())
+    if len(then_l) != len(else_l):
+        raise ValueError("cond: then/else branches must produce the same "
+                         "number of outputs")
+    capt = _free_vars(then_l + else_l, set())
+    sub = {"then": then_l, "else": else_l,
+           "arg_names": [c.name for c in capt]}
+    node = S.Symbol("_cond", [p, *capt],
+                    attrs={"sub": sub, "n_outs": len(then_l)},
+                    name=name, num_outputs=len(then_l))
+    outs = [node[i] for i in range(len(then_l))]
+    return _repack(outs, t_single)

@@ -1,0 +1,359 @@
+"""Optimizer update ops + AMP cast ops — the registered op surface.
+
+Reference counterpart: ``src/operator/optimizer_op.cc`` (``sgd_update``,
+``sgd_mom_update``, ``mp_sgd_*``, ``adam_update``, ``nag_mom_update``,
+``signsgd_update``/``signum_update``, ``ftrl_update``, ``rmsprop_update``,
+the ``multi_sgd_*`` multi-tensor family), ``src/operator/contrib/adamw.cc``
+(``adamw_update``), the LAMB phases (``src/operator/optimizer_op.cc``
+``lamb_update_phase1/2``), and ``src/operator/tensor/amp_cast.cc``
+(``amp_cast``/``amp_multicast``).
+
+The trainer path in this framework never calls these by name — the whole
+optimizer step is fused into one compiled XLA program
+(``parallel/trainer.py``), which is what the reference's multi-tensor ops
+exist to approximate kernel-by-kernel. These registered wrappers exist for
+*op-surface parity*: user code that drives updates through
+``mx.nd.sgd_update(...)`` finds the same names with the same math.
+
+Purity note: the reference mutates ``weight``/state inputs in place; every
+op here is pure and RETURNS the updated tensors (weight first, then
+states). Use ``out=[weight, state...]`` on the ``mx.nd`` wrapper for
+reference-style in-place assignment.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import Field, Schema, register_op
+
+__all__: list = []
+
+
+def _prep(grad, rescale_grad, clip_gradient):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g
+
+
+# ---------------------------------------------------------------------------
+# single-tensor updates
+# ---------------------------------------------------------------------------
+
+@register_op("sgd_update")
+def sgd_update(weight, grad, lr=None, wd=0.0, rescale_grad=1.0,
+               clip_gradient=-1.0, lazy_update=False, **_):
+    """w -= lr * (rescale·clip(grad) + wd·w) (reference:
+    optimizer_op.cc SGDUpdate)."""
+    g = _prep(grad, rescale_grad, clip_gradient)
+    return weight - lr * (g + wd * weight)
+
+
+@register_op("sgd_mom_update")
+def sgd_mom_update(weight, grad, mom, lr=None, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, lazy_update=False,
+                   **_):
+    """Momentum SGD (reference: optimizer_op.cc SGDMomUpdate). Returns
+    (weight, mom)."""
+    g = _prep(grad, rescale_grad, clip_gradient)
+    new_mom = momentum * mom - lr * (g + wd * weight)
+    return weight + new_mom, new_mom
+
+
+@register_op("mp_sgd_update")
+def mp_sgd_update(weight, grad, weight32, lr=None, wd=0.0, rescale_grad=1.0,
+                  clip_gradient=-1.0, lazy_update=False, **_):
+    """Mixed-precision SGD with an fp32 master weight (reference:
+    optimizer_op.cc MP_SGDUpdate). Returns (weight, weight32)."""
+    g = _prep(grad.astype(jnp.float32), rescale_grad, clip_gradient)
+    w32 = weight32 - lr * (g + wd * weight32)
+    return w32.astype(weight.dtype), w32
+
+
+@register_op("mp_sgd_mom_update")
+def mp_sgd_mom_update(weight, grad, mom, weight32, lr=None, momentum=0.0,
+                      wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                      lazy_update=False, **_):
+    """Returns (weight, mom, weight32)."""
+    g = _prep(grad.astype(jnp.float32), rescale_grad, clip_gradient)
+    new_mom = momentum * mom - lr * (g + wd * weight32)
+    w32 = weight32 + new_mom
+    return w32.astype(weight.dtype), new_mom, w32
+
+
+@register_op("adam_update")
+def adam_update(weight, grad, mean, var, lr=None, beta1=0.9, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                lazy_update=False, **_):
+    """Adam (reference: optimizer_op.cc AdamUpdate — the raw step without
+    bias correction, matching the kernel; clip applies to
+    rescale·grad + wd·w as one quantity there). Returns
+    (weight, mean, var)."""
+    g = _prep(grad * rescale_grad + wd * weight, 1.0, clip_gradient)
+    m = beta1 * mean + (1 - beta1) * g
+    v = beta2 * var + (1 - beta2) * jnp.square(g)
+    return weight - lr * m / (jnp.sqrt(v) + epsilon), m, v
+
+
+@register_op("adamw_update", aliases=("_contrib_adamw_update",))
+def adamw_update(weight, grad, mean, var, rescale_grad, lr=None, eta=1.0,
+                 beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0,
+                 clip_gradient=-1.0, **_):
+    """AdamW with decoupled weight decay (reference: contrib/adamw.cc;
+    rescale_grad arrives as a TENSOR there — kept). Returns
+    (weight, mean, var)."""
+    g = _prep(grad, rescale_grad, clip_gradient)
+    m = beta1 * mean + (1 - beta1) * g
+    v = beta2 * var + (1 - beta2) * jnp.square(g)
+    upd = m / (jnp.sqrt(v) + epsilon) + wd * weight
+    return weight - eta * lr * upd, m, v
+
+
+@register_op("nag_mom_update")
+def nag_mom_update(weight, grad, mom, lr=None, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, **_):
+    """Nesterov momentum (reference: optimizer_op.cc NAGMomUpdate; clip
+    applies to rescale·grad + wd·w as one quantity). Returns
+    (weight, mom)."""
+    g = _prep(grad * rescale_grad + wd * weight, 1.0, clip_gradient)
+    new_mom = momentum * mom + g
+    return weight - lr * (g + momentum * new_mom), new_mom
+
+
+@register_op("signsgd_update")
+def signsgd_update(weight, grad, lr=None, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0, **_):
+    """signSGD (reference: optimizer_op.cc SignSGDUpdate)."""
+    g = _prep(grad, rescale_grad, clip_gradient)
+    return weight - lr * (jnp.sign(g) + wd * weight)
+
+
+@register_op("signum_update")
+def signum_update(weight, grad, mom, lr=None, momentum=0.0, wd=0.0,
+                  rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0, **_):
+    """Signum: momentum + sign (reference: optimizer_op.cc SignumUpdate).
+    Returns (weight, mom)."""
+    g = _prep(grad, rescale_grad, clip_gradient)
+    new_mom = momentum * mom - (1 - momentum) * (g + wd * weight)
+    return weight * (1 - lr * wd_lh) + lr * jnp.sign(new_mom), new_mom
+
+
+@register_op("ftrl_update")
+def ftrl_update(weight, grad, z, n, lr=None, lamda1=0.01, beta=1.0, wd=0.0,
+                rescale_grad=1.0, clip_gradient=-1.0, **_):
+    """FTRL-proximal (reference: optimizer_op.cc FTRLUpdate). Returns
+    (weight, z, n)."""
+    g = _prep(grad, rescale_grad, clip_gradient)
+    new_n = n + jnp.square(g)
+    sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / lr
+    new_z = z + g - sigma * weight
+    w = jnp.where(
+        jnp.abs(new_z) <= lamda1, jnp.zeros_like(weight),
+        -(new_z - jnp.sign(new_z) * lamda1)
+        / ((beta + jnp.sqrt(new_n)) / lr + wd))
+    return w, new_z, new_n
+
+
+@register_op("rmsprop_update")
+def rmsprop_update(weight, grad, n, lr=None, gamma1=0.95, epsilon=1e-8,
+                   wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                   clip_weights=-1.0, **_):
+    """RMSProp (reference: optimizer_op.cc RMSPropUpdate; clip applies to
+    rescale·grad + wd·w as one quantity). Returns (weight, n)."""
+    g = _prep(grad * rescale_grad + wd * weight, 1.0, clip_gradient)
+    new_n = gamma1 * n + (1 - gamma1) * jnp.square(g)
+    w = weight - lr * g / jnp.sqrt(new_n + epsilon)
+    if clip_weights is not None and clip_weights >= 0:
+        w = jnp.clip(w, -clip_weights, clip_weights)
+    return w, new_n
+
+
+# ---------------------------------------------------------------------------
+# LAMB phases (reference: optimizer_op.cc lamb_update_phase1/2 — the
+# BERT-large large-batch path; phase1 forms the adaptive direction, the
+# caller computes the layer norms, phase2 applies the trust ratio)
+# ---------------------------------------------------------------------------
+
+@register_op("lamb_update_phase1")
+def lamb_update_phase1(weight, grad, mean, var, beta1=0.9, beta2=0.999,
+                       epsilon=1e-6, t=1, bias_correction=True, wd=0.0,
+                       rescale_grad=1.0, clip_gradient=-1.0, **_):
+    """Returns (g_direction, mean, var)."""
+    g = _prep(grad, rescale_grad, clip_gradient)
+    m = beta1 * mean + (1 - beta1) * g
+    v = beta2 * var + (1 - beta2) * jnp.square(g)
+    t = jnp.asarray(t, jnp.float32)
+    if bias_correction:
+        mhat = m / (1 - beta1 ** t)
+        vhat = v / (1 - beta2 ** t)
+    else:
+        mhat, vhat = m, v
+    return mhat / (jnp.sqrt(vhat) + epsilon) + wd * weight, m, v
+
+
+@register_op("lamb_update_phase2")
+def lamb_update_phase2(weight, g, r1, r2, lr=None, lower_bound=-1.0,
+                       upper_bound=-1.0, **_):
+    """Apply the trust ratio r1/r2 (norms computed by the caller, as the
+    reference does with multi_sum_sq): w -= lr·(r1/r2)·g."""
+    r1 = jnp.reshape(r1, ())
+    r2 = jnp.reshape(r2, ())
+    if lower_bound is not None and lower_bound >= 0:
+        r1 = jnp.maximum(r1, lower_bound)
+    if upper_bound is not None and upper_bound >= 0:
+        r1 = jnp.minimum(r1, upper_bound)
+    ratio = jnp.where((r1 > 0) & (r2 > 0), r1 / r2, 1.0)
+    return weight - lr * ratio * g
+
+
+@register_op("mp_lamb_update_phase1")
+def mp_lamb_update_phase1(weight, grad, mean, var, weight32, **kwargs):
+    """fp32-master variant of phase1 (reference: mp_lamb_update_phase1):
+    the direction is formed against the fp32 master weight."""
+    return lamb_update_phase1(weight32, grad.astype(jnp.float32),
+                              mean, var, **kwargs)
+
+
+@register_op("mp_lamb_update_phase2")
+def mp_lamb_update_phase2(weight, g, r1, r2, weight32, lr=None,
+                          lower_bound=-1.0, upper_bound=-1.0, **_):
+    """Returns (weight, weight32)."""
+    w32 = lamb_update_phase2(weight32, g, r1, r2, lr=lr,
+                             lower_bound=lower_bound,
+                             upper_bound=upper_bound)
+    return w32.astype(weight.dtype), w32
+
+
+# ---------------------------------------------------------------------------
+# multi-tensor family (reference: optimizer_op.cc MultiSGDUpdate — one
+# kernel launch over many params; XLA fuses per-tensor updates anyway, so
+# these are pure API-parity wrappers over the single-tensor math)
+# ---------------------------------------------------------------------------
+
+def _csv_floats(name, v, n):
+    if v is None:
+        raise ValueError(f"multi-tensor update: required parameter "
+                         f"'{name}' is missing (one value per weight, "
+                         f"e.g. {name}='0.1, 0.1')")
+    if isinstance(v, str):
+        v = [float(p) for p in v.replace(",", " ").split()]
+    elif isinstance(v, (int, float)):
+        v = [float(v)] * n
+    v = list(v)
+    if len(v) != n:
+        raise ValueError(f"{name}: expected {n} values, got {len(v)}")
+    return v
+
+
+@register_op("multi_sgd_update")
+def multi_sgd_update(*arrays, lrs=None, wds=None, rescale_grad=1.0,
+                     clip_gradient=-1.0, num_weights=1, **_):
+    """Interleaved (w0, g0, w1, g1, ...) — returns the updated weights
+    (reference: multi_sgd_update)."""
+    n = int(num_weights)
+    lrs = _csv_floats("lrs", lrs, n)
+    wds = _csv_floats("wds", wds, n)
+    outs = []
+    for i in range(n):
+        w, g = arrays[2 * i], arrays[2 * i + 1]
+        outs.append(sgd_update(w, g, lr=lrs[i], wd=wds[i],
+                               rescale_grad=rescale_grad,
+                               clip_gradient=clip_gradient))
+    return tuple(outs) if n > 1 else outs[0]
+
+
+@register_op("multi_sgd_mom_update")
+def multi_sgd_mom_update(*arrays, lrs=None, wds=None, momentum=0.0,
+                         rescale_grad=1.0, clip_gradient=-1.0,
+                         num_weights=1, **_):
+    """Interleaved (w0, g0, m0, ...) — returns (w0', m0', w1', m1', ...)."""
+    n = int(num_weights)
+    lrs = _csv_floats("lrs", lrs, n)
+    wds = _csv_floats("wds", wds, n)
+    outs = []
+    for i in range(n):
+        w, g, m = arrays[3 * i], arrays[3 * i + 1], arrays[3 * i + 2]
+        nw, nm = sgd_mom_update(w, g, m, lr=lrs[i], momentum=momentum,
+                                wd=wds[i], rescale_grad=rescale_grad,
+                                clip_gradient=clip_gradient)
+        outs.extend([nw, nm])
+    return tuple(outs)
+
+
+@register_op("multi_mp_sgd_update")
+def multi_mp_sgd_update(*arrays, lrs=None, wds=None, rescale_grad=1.0,
+                        clip_gradient=-1.0, num_weights=1, **_):
+    """Interleaved (w0, g0, w32_0, ...) — returns (w0', w32_0', ...)."""
+    n = int(num_weights)
+    lrs = _csv_floats("lrs", lrs, n)
+    wds = _csv_floats("wds", wds, n)
+    outs = []
+    for i in range(n):
+        w, g, w32 = arrays[3 * i], arrays[3 * i + 1], arrays[3 * i + 2]
+        nw, nw32 = mp_sgd_update(w, g, w32, lr=lrs[i], wd=wds[i],
+                                 rescale_grad=rescale_grad,
+                                 clip_gradient=clip_gradient)
+        outs.extend([nw, nw32])
+    return tuple(outs)
+
+
+@register_op("multi_mp_sgd_mom_update")
+def multi_mp_sgd_mom_update(*arrays, lrs=None, wds=None, momentum=0.0,
+                            rescale_grad=1.0, clip_gradient=-1.0,
+                            num_weights=1, **_):
+    """Interleaved (w0, g0, m0, w32_0, ...) — returns
+    (w0', m0', w32_0', ...)."""
+    n = int(num_weights)
+    lrs = _csv_floats("lrs", lrs, n)
+    wds = _csv_floats("wds", wds, n)
+    outs = []
+    for i in range(n):
+        w, g, m, w32 = arrays[4 * i:4 * i + 4]
+        nw, nm, nw32 = mp_sgd_mom_update(
+            w, g, m, w32, lr=lrs[i], momentum=momentum, wd=wds[i],
+            rescale_grad=rescale_grad, clip_gradient=clip_gradient)
+        outs.extend([nw, nm, nw32])
+    return tuple(outs)
+
+
+@register_op("multi_sum_sq", aliases=("_contrib_multi_sum_sq",))
+def multi_sum_sq(*arrays, num_arrays=1, **_):
+    """Per-tensor sum of squares, one scalar each (reference:
+    contrib/multi_sum_sq.cc — feeds the LAMB/LARS trust ratios)."""
+    n = int(num_arrays)
+    outs = tuple(jnp.sum(jnp.square(a.astype(jnp.float32)))
+                 for a in arrays[:n])
+    return outs if n > 1 else outs[0]
+
+
+# ---------------------------------------------------------------------------
+# AMP cast ops (reference: src/operator/tensor/amp_cast.cc)
+# ---------------------------------------------------------------------------
+
+_DTYPES = {"float16": jnp.float16, "bfloat16": jnp.bfloat16,
+           "float32": jnp.float32, "float64": jnp.float64}
+
+
+@register_op("amp_cast", schema=Schema(
+    dtype=Field(str, "float32", "Target dtype.",
+                choices=tuple(_DTYPES))))
+def amp_cast(data, dtype="float32"):
+    """Identity-with-cast used by the AMP graph pass (reference:
+    amp_cast.cc AMPCast; gradient casts back — here jax.vjp gives that
+    for free since the cast is linear). On TPU the low dtype is bfloat16."""
+    return data.astype(_DTYPES[dtype])
+
+
+@register_op("amp_multicast")
+def amp_multicast(*data, num_outputs=1, cast_narrow=False, **_):
+    """Cast all inputs to their common widest dtype (narrowest with
+    ``cast_narrow``) — reference: amp_cast.cc AMPMultiCast."""
+    n = int(num_outputs)
+    arrs = data[:n]
+    widths = {jnp.float16: 16, jnp.bfloat16: 16, jnp.float32: 32,
+              jnp.float64: 64}
+    key = min if cast_narrow else max
+    target = key((a.dtype for a in arrs),
+                 key=lambda d: widths.get(jnp.dtype(d).type, 32))
+    outs = tuple(a.astype(target) for a in arrs)
+    return outs if n > 1 else outs[0]

@@ -120,6 +120,20 @@ class Symbol:
     def __neg__(self):
         return self.__mul__(-1.0)
 
+    # comparisons (reference: symbol.py __lt__/__gt__/... via
+    # broadcast_lesser / _lesser_scalar family; result is a 0/1 float sym)
+    def __lt__(self, other):
+        return self._binary(other, "broadcast_lesser" if isinstance(other, Symbol) else "_lesser_scalar")
+
+    def __le__(self, other):
+        return self._binary(other, "broadcast_lesser_equal" if isinstance(other, Symbol) else "_lesser_equal_scalar")
+
+    def __gt__(self, other):
+        return self._binary(other, "broadcast_greater" if isinstance(other, Symbol) else "_greater_scalar")
+
+    def __ge__(self, other):
+        return self._binary(other, "broadcast_greater_equal" if isinstance(other, Symbol) else "_greater_equal_scalar")
+
     def __getitem__(self, index: int) -> "Symbol":
         if self._num_outputs == 1:
             if index != 0:
@@ -183,7 +197,8 @@ class Symbol:
             "nodes": [{
                 "op": n._op or "null",
                 "name": n._name,
-                "attrs": {k: repr(v) for k, v in n._attrs.items()},
+                "attrs": {k: repr(_wire_attr(v))
+                          for k, v in n._attrs.items()},
                 "inputs": [[idx[id(i)], 0, 0] for i in n._inputs],
                 "output_index": n._output_index,
                 "num_outputs": n._num_outputs,
@@ -274,6 +289,12 @@ _SCALAR_OPS = {
     "_minus_scalar": lambda x, s: x - s,
     "_mul_scalar": lambda x, s: x * s,
     "_div_scalar": lambda x, s: x / s,
+    # comparisons keep the operand dtype (0/1 values), matching the
+    # registered `lesser`/`greater` tensor ops
+    "_lesser_scalar": lambda x, s: (x < s).astype(x.dtype),
+    "_lesser_equal_scalar": lambda x, s: (x <= s).astype(x.dtype),
+    "_greater_scalar": lambda x, s: (x > s).astype(x.dtype),
+    "_greater_equal_scalar": lambda x, s: (x >= s).astype(x.dtype),
 }
 
 
@@ -546,9 +567,46 @@ def Group(symbols: Sequence[Symbol]) -> Symbol:
                   num_outputs=len(list(symbols)))
 
 
-def load_json(s: str) -> Symbol:
-    payload = json.loads(s)
+def _wire_attr(v):
+    """Wire-encode one attr value: Symbols (subgraph attrs of the
+    control-flow ops) become nested graph payloads that survive
+    repr -> ast.literal_eval; a LIST of Symbols rides as one Group payload
+    so shared subgraph structure is serialized once (reference: subgraph
+    attrs in the control_flow.cc JSON format)."""
+    if isinstance(v, Symbol):
+        return {"__sym__": json.loads(v.tojson())}
+    if isinstance(v, (list, tuple)):
+        if any(isinstance(x, Symbol) for x in v):
+            return {"__symlist__": json.loads(Group(list(v)).tojson()),
+                    "n": len(v)}
+        if isinstance(v, tuple):
+            # tuples must survive repr->literal_eval distinctly: shape
+            # attrs compared/hased as tuples diverge if lists come back
+            return tuple(_wire_attr(x) for x in v)
+        return [_wire_attr(x) for x in v]
+    if isinstance(v, dict):
+        return {k: _wire_attr(x) for k, x in v.items()}
+    return v
+
+
+def _unwire_attr(v):
+    if isinstance(v, dict):
+        if "__sym__" in v and len(v) == 1:
+            return _symbol_from_payload(v["__sym__"])
+        if "__symlist__" in v:
+            group = _symbol_from_payload(v["__symlist__"])
+            return list(group._inputs)
+        return {k: _unwire_attr(x) for k, x in v.items()}
+    if isinstance(v, list):
+        return [_unwire_attr(x) for x in v]
+    if isinstance(v, tuple):
+        return tuple(_unwire_attr(x) for x in v)
+    return v
+
+
+def _symbol_from_payload(payload: dict) -> Symbol:
     nodes: List[Symbol] = []
+    prev = getattr(_DESERIALIZING, "flag", False)
     _DESERIALIZING.flag = True
     try:
         for nd_ in payload["nodes"]:
@@ -557,7 +615,7 @@ def load_json(s: str) -> Symbol:
                 try:
                     # literal_eval only — .json symbol files are an
                     # untrusted load path, never execute code from them
-                    attrs[k] = ast.literal_eval(v)
+                    attrs[k] = _unwire_attr(ast.literal_eval(v))
                 except (ValueError, SyntaxError):
                     attrs[k] = v
             if nd_.get("base") is not None:
@@ -572,8 +630,12 @@ def load_json(s: str) -> Symbol:
                     ins, attrs, name=nd_["name"],
                     num_outputs=nd_.get("num_outputs", 1)))
     finally:
-        _DESERIALIZING.flag = False
+        _DESERIALIZING.flag = prev
     return nodes[payload["heads"][0][0]]
+
+
+def load_json(s: str) -> Symbol:
+    return _symbol_from_payload(json.loads(s))
 
 
 def load(fname: str) -> Symbol:
@@ -613,3 +675,12 @@ def _make_sym_op(opname: str):
 for _name in list(OPS):
     if not hasattr(_this, _name):
         setattr(_this, _name, _make_sym_op(_name))
+
+
+def __getattr__(name):
+    # mx.sym.contrib — lazy to avoid an import cycle (reference:
+    # python/mxnet/symbol/contrib.py; same module as mx.contrib.sym)
+    if name == "contrib":
+        from ..contrib import sym as _contrib_sym
+        return _contrib_sym
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

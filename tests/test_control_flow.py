@@ -1,0 +1,285 @@
+"""Control-flow operator trio: foreach / while_loop / cond.
+
+Reference test model: tests/python/unittest/test_contrib_control_flow.py
+(src/operator/control_flow.cc ops via mx.nd/sym.contrib — SURVEY §2.4);
+here additionally the hybridize()-traced path, which lowers to
+lax.scan / masked scan / lax.cond.
+"""
+import jax
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon, nd
+from incubator_mxnet_tpu.ops.registry import OPS
+
+
+def test_ops_registered():
+    for name in ("_foreach", "_while_loop", "_cond"):
+        assert name in OPS
+
+
+# ---------------------------------------------------------------------------
+# foreach
+# ---------------------------------------------------------------------------
+
+def test_nd_foreach_cumsum_matches_numpy():
+    x = onp.arange(12, dtype="float32").reshape(4, 3)
+    outs, states = mx.nd.contrib.foreach(
+        lambda d, s: (d + s, d + s), nd.array(x), nd.zeros((3,)))
+    onp.testing.assert_allclose(outs.asnumpy(), onp.cumsum(x, axis=0))
+    onp.testing.assert_allclose(states.asnumpy(), x.sum(axis=0))
+
+
+def test_nd_foreach_multi_data_multi_state():
+    a = onp.ones((5, 2), "float32")
+    b = 2 * onp.ones((5, 2), "float32")
+
+    def body(data, states):
+        da, db = data
+        s1, s2 = states
+        return [da + db, s1], [s1 + da, s2 * 1.0]
+
+    outs, states = mx.nd.contrib.foreach(
+        body, [nd.array(a), nd.array(b)], [nd.zeros((2,)), nd.ones((2,))])
+    assert len(outs) == 2 and len(states) == 2
+    onp.testing.assert_allclose(outs[0].asnumpy(), 3 * onp.ones((5, 2)))
+    onp.testing.assert_allclose(states[0].asnumpy(), 5 * onp.ones(2))
+
+
+def test_nd_foreach_grads_flow_to_closure_and_data():
+    x = onp.arange(6, dtype="float32").reshape(3, 2)
+    data = nd.array(x)
+    data.attach_grad()
+    w = nd.array(onp.array([3.0, 3.0], "float32"))
+    w.attach_grad()
+    with autograd.record():
+        outs, st = mx.nd.contrib.foreach(
+            lambda d, s: (d * w, s + d * w), data, nd.zeros((2,)))
+        loss = st.sum()
+    loss.backward()
+    # d(loss)/dw = sum over t of data_t; d(loss)/ddata = w broadcast
+    onp.testing.assert_allclose(w.grad.asnumpy(), x.sum(axis=0))
+    onp.testing.assert_allclose(data.grad.asnumpy(),
+                                onp.broadcast_to([3.0, 3.0], x.shape))
+
+
+def test_hybridized_foreach_matches_eager():
+    class Cum(gluon.HybridBlock):
+        def hybrid_forward(self, F, x):
+            outs, _ = mx.nd.contrib.foreach(
+                lambda d, s: (d + s, d + s), x, mx.nd.zeros_like(x[0]))
+            return outs
+
+    net = Cum()
+    net.initialize()
+    x = nd.array(onp.random.RandomState(0).randn(4, 3).astype("float32"))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    onp.testing.assert_allclose(net(x).asnumpy(), eager, rtol=1e-6)
+    onp.testing.assert_allclose(net(x).asnumpy(), eager, rtol=1e-6)
+
+
+def test_sym_foreach_eval_and_json_roundtrip():
+    S = mx.sym
+    data, init, w = S.Variable("data"), S.Variable("init"), S.Variable("w")
+    outs, states = S.contrib.foreach(
+        lambda d, s: ((mx.sym.broadcast_mul(d, w) + s,) * 2), data, init)
+    # captured w becomes a real argument of the node
+    assert "w" in outs.list_arguments()
+    kw = dict(data=nd.array(onp.ones((4, 3), "float32")),
+              init=nd.zeros((3,)), w=nd.array(onp.full((3,), 2.0, "float32")))
+    ref = outs.eval(**kw)[0].asnumpy()
+    onp.testing.assert_allclose(ref[-1], onp.full(3, 8.0))
+    back = mx.sym.load_json(outs.tojson())
+    onp.testing.assert_allclose(back.eval(**kw)[0].asnumpy(), ref)
+
+
+def test_sym_foreach_executor_backward():
+    S = mx.sym
+    data, init = S.Variable("data"), S.Variable("init")
+    outs, states = S.contrib.foreach(
+        lambda d, s: (d * 2.0 + s, d * 2.0 + s), data, init)
+    loss = mx.sym.sum(states)
+    x = nd.array(onp.ones((3, 2), "float32"))
+    i0 = nd.zeros((2,))
+    gx = nd.zeros((3, 2))
+    gi = nd.zeros((2,))
+    ex = loss.bind(mx.cpu(), {"data": x, "init": i0},
+                   args_grad={"data": gx, "init": gi})
+    ex.forward(is_train=True)
+    ex.backward()
+    onp.testing.assert_allclose(gx.asnumpy(), 2 * onp.ones((3, 2)))
+    onp.testing.assert_allclose(gi.asnumpy(), onp.ones(2))
+
+
+# ---------------------------------------------------------------------------
+# while_loop
+# ---------------------------------------------------------------------------
+
+def test_nd_while_loop_exact_steps_eager():
+    i = nd.array([0.0])
+    s = nd.array([0.0])
+    outs, fin = mx.nd.contrib.while_loop(
+        cond=lambda i, s: i < 5,
+        func=lambda i, s: (i * 10, [i + 1, s + i]),
+        loop_vars=[i, s], max_iterations=20)
+    # eager path: outputs have exactly the executed number of rows
+    assert outs.shape == (5, 1)
+    onp.testing.assert_allclose(outs.asnumpy().ravel(),
+                                [0., 10., 20., 30., 40.])
+    onp.testing.assert_allclose(fin[0].asnumpy(), [5.0])
+    onp.testing.assert_allclose(fin[1].asnumpy(), [10.0])
+
+
+def test_nd_while_loop_grads_eager():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        outs, fin = mx.nd.contrib.while_loop(
+            cond=lambda v: v < 20,
+            func=lambda v: (v, [v * 2.0]),
+            loop_vars=[x], max_iterations=10)
+        loss = fin[0].sum()
+    loss.backward()
+    # v doubles until >= 20: 2 -> 4 -> 8 -> 16 -> 32 (4 steps), d/dx = 16
+    onp.testing.assert_allclose(x.grad.asnumpy(), [16.0])
+
+
+def test_hybridized_while_loop_zero_pads():
+    class W(gluon.HybridBlock):
+        def hybrid_forward(self, F, x):
+            outs, fin = mx.nd.contrib.while_loop(
+                cond=lambda i: i.sum() < 3.0,
+                func=lambda i: (i + 0.5, [i + 1.0]),
+                loop_vars=[mx.nd.zeros_like(x)], max_iterations=5)
+            return outs
+
+    w = W()
+    w.initialize()
+    w.hybridize()
+    # first call runs eagerly (deferred-init warmup): exact-length rows
+    first = w(nd.array([1.0])).asnumpy()
+    onp.testing.assert_allclose(first.ravel(), [0.5, 1.5, 2.5])
+    # compiled call: static extent = max_iterations, zero rows beyond steps
+    out = w(nd.array([1.0])).asnumpy()
+    onp.testing.assert_allclose(out.ravel(), [0.5, 1.5, 2.5, 0.0, 0.0])
+
+
+def test_sym_while_loop_eval_and_json_roundtrip():
+    S = mx.sym
+    v = S.Variable("v")
+    outs, fin = S.contrib.while_loop(
+        cond=lambda v: mx.sym.sum(v) < 3.0,
+        func=lambda v: (v, [v + 1.0]), loop_vars=[v], max_iterations=6)
+    r = outs.eval(v=nd.array([0.0]))[0].asnumpy().ravel()
+    onp.testing.assert_allclose(r, [0., 1., 2., 0., 0., 0.])
+    back = mx.sym.load_json(outs.tojson())
+    onp.testing.assert_allclose(back.eval(v=nd.array([0.0]))[0].asnumpy().ravel(), r)
+
+
+# ---------------------------------------------------------------------------
+# cond
+# ---------------------------------------------------------------------------
+
+def test_nd_cond_concrete_executes_single_branch():
+    calls = []
+
+    def then_f():
+        calls.append("then")
+        return nd.array([1.0])
+
+    def else_f():
+        calls.append("else")
+        return nd.array([2.0])
+
+    out = mx.nd.contrib.cond(nd.array([0.0]), then_f, else_f)
+    onp.testing.assert_allclose(out.asnumpy(), [2.0])
+    assert calls == ["else"]  # real Python branch: untaken side never runs
+
+
+def test_nd_cond_callable_pred_and_grads():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        out = mx.nd.contrib.cond(lambda: x.sum() > 0,
+                                 lambda: x * 5.0, lambda: x * 7.0)
+    out.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [5.0])
+
+
+def test_sym_cond_eval_and_json_roundtrip():
+    S = mx.sym
+    p, a = S.Variable("p"), S.Variable("a")
+    out = S.contrib.cond(p, lambda: a * 2.0, lambda: a - 1.0)
+    assert out.eval(p=nd.array([1.0]), a=nd.array([5.0]))[0].asnumpy()[0] == 10.0
+    assert out.eval(p=nd.array([0.0]), a=nd.array([5.0]))[0].asnumpy()[0] == 4.0
+    back = mx.sym.load_json(out.tojson())
+    assert back.eval(p=nd.array([0.0]), a=nd.array([5.0]))[0].asnumpy()[0] == 4.0
+
+
+# ---------------------------------------------------------------------------
+# bucketed RNN over foreach (the workload these ops exist for)
+# ---------------------------------------------------------------------------
+
+def test_bucketed_rnn_over_foreach():
+    """Shared-weight RNN cell scanned over buckets of different lengths —
+    the BucketingModule pattern (reference: example/rnn/bucketing, built on
+    _foreach): one cell, one compiled scan per bucket length, identical
+    weights."""
+    cell = gluon.rnn.RNNCell(8, input_size=4)
+    cell.initialize()
+
+    def run_bucket(T, B=2):
+        x = nd.array(onp.random.RandomState(T).randn(T, B, 4)
+                     .astype("float32"))
+        h0 = nd.zeros((B, 8))
+
+        def body(xt, states):
+            out, new_states = cell(xt, [states])
+            return out, new_states[0]
+
+        outs, hN = mx.nd.contrib.foreach(body, x, h0)
+        assert outs.shape == (T, B, 8)
+        # reference check: manual python unroll with the same weights
+        h = h0
+        for t in range(T):
+            o, hs = cell(x[t], [h])
+            h = hs[0]
+        onp.testing.assert_allclose(hN.asnumpy(), h.asnumpy(),
+                                    rtol=2e-5, atol=2e-6)
+        return hN
+
+    for T in (3, 5, 9):   # three buckets, same cell
+        run_bucket(T)
+
+
+def test_bucketed_rnn_foreach_grads_match_unroll():
+    cell = gluon.rnn.RNNCell(5, input_size=3)
+    cell.initialize()
+    params = list(cell.collect_params().values())
+    x = nd.array(onp.random.RandomState(1).randn(4, 2, 3).astype("float32"))
+
+    def loss_foreach():
+        def body(xt, h):
+            out, new_states = cell(xt, [h])
+            return out, new_states[0]
+        outs, hN = mx.nd.contrib.foreach(body, x, nd.zeros((2, 5)))
+        return hN.sum()
+
+    def loss_unroll():
+        h = nd.zeros((2, 5))
+        for t in range(x.shape[0]):
+            _, hs = cell(x[t], [h])
+            h = hs[0]
+        return h.sum()
+
+    grads = []
+    for fn in (loss_foreach, loss_unroll):
+        with autograd.record():
+            loss = fn()
+        loss.backward()
+        grads.append([p.grad().asnumpy().copy() if callable(p.grad)
+                      else p.grad.asnumpy().copy() for p in params])
+    for ga, gb in zip(*grads):
+        onp.testing.assert_allclose(ga, gb, rtol=2e-5, atol=2e-6)

@@ -1,0 +1,121 @@
+"""Detection augmenters + ImageDetIter (reference:
+tests/python/unittest/test_image.py TestImageDetIter / det augmenter cases,
+python/mxnet/image/detection.py — SURVEY §2.6)."""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import image, nd
+from incubator_mxnet_tpu.image import (
+    CreateDetAugmenter, DetBorrowAug, DetHorizontalFlipAug,
+    DetRandomCropAug, DetRandomPadAug, DetRandomSelectAug, ImageDetIter)
+
+
+def _img(h=40, w=60, seed=0):
+    rng = onp.random.RandomState(seed)
+    return nd.array(rng.randint(0, 255, (h, w, 3)).astype("uint8"))
+
+
+def _label():
+    # two objects: [cls, x1, y1, x2, y2] normalized
+    return onp.array([[1, 0.1, 0.2, 0.5, 0.6],
+                      [3, 0.6, 0.1, 0.9, 0.4]], "float32")
+
+
+def test_det_horizontal_flip_flips_boxes():
+    aug = DetHorizontalFlipAug(p=1.0)
+    src, lab = aug(_img(), _label())
+    # x-coords mirrored and still ordered x1 < x2
+    onp.testing.assert_allclose(lab[0, [1, 3]], [0.5, 0.9], atol=1e-6)
+    onp.testing.assert_allclose(lab[1, [1, 3]], [0.1, 0.4], atol=1e-6)
+    assert (lab[:, 1] < lab[:, 3]).all()
+    # flipping twice restores the pixels
+    src2, lab2 = aug(src, lab)
+    onp.testing.assert_allclose(src2.asnumpy(), _img().asnumpy())
+
+
+def test_det_random_crop_keeps_coverage_and_renormalizes():
+    aug = DetRandomCropAug(min_object_covered=0.5, area_range=(0.5, 1.0),
+                           min_eject_coverage=0.3, max_attempts=100)
+    for seed in range(5):
+        import random as pyrandom
+        pyrandom.seed(seed)
+        src, lab = aug(_img(), _label())
+        assert lab.shape[1] == 5 and lab.shape[0] >= 1
+        assert (lab[:, 1:] >= 0).all() and (lab[:, 1:] <= 1).all()
+        assert (lab[:, 1] <= lab[:, 3]).all()
+        assert (lab[:, 2] <= lab[:, 4]).all()
+
+
+def test_det_random_pad_shrinks_boxes():
+    import random as pyrandom
+    pyrandom.seed(0)
+    aug = DetRandomPadAug(area_range=(2.0, 2.5))
+    src, lab = aug(_img(), _label())
+    assert src.shape[0] >= 40 and src.shape[1] >= 60
+    orig = _label()
+    # normalized box area must shrink on the larger canvas
+    area = (lab[:, 3] - lab[:, 1]) * (lab[:, 4] - lab[:, 2])
+    oarea = (orig[:, 3] - orig[:, 1]) * (orig[:, 4] - orig[:, 2])
+    assert (area < oarea).all()
+    assert (lab[:, 1:] >= 0).all() and (lab[:, 1:] <= 1).all()
+
+
+def test_det_random_select_skip_prob_one_is_identity():
+    aug = DetRandomSelectAug([DetHorizontalFlipAug(1.0)], skip_prob=1.0)
+    src, lab = aug(_img(), _label())
+    onp.testing.assert_allclose(src.asnumpy(), _img().asnumpy())
+    onp.testing.assert_allclose(lab, _label())
+
+
+def test_create_det_augmenter_pipeline_runs():
+    augs = CreateDetAugmenter((3, 32, 32), rand_crop=0.5, rand_pad=0.5,
+                              rand_mirror=True, mean=[123, 117, 104],
+                              std=[58, 57, 57])
+    src, lab = _img(), _label()
+    for a in augs:
+        src, lab = a(src, lab)
+    assert src.shape == (32, 32, 3)
+    assert str(src.asnumpy().dtype) == "float32"
+    assert lab.shape[1] == 5
+
+
+def test_image_det_iter_batches_and_pads():
+    items = [(_label(), _img(seed=i).asnumpy()) for i in range(4)] + \
+            [(_label()[:1], _img(seed=9).asnumpy())]
+    it = ImageDetIter(batch_size=2, data_shape=(3, 32, 32), imglist=items,
+                      rand_mirror=True)
+    batch = next(it)
+    data, label = batch.data[0], batch.label[0]
+    assert data.shape == (2, 3, 32, 32)
+    assert label.shape == (2, 2, 5)
+    n = 1
+    for b in it:
+        n += 1
+    assert n == 2  # 5 items, batch 2 -> 2 full batches
+    it.reset()
+    assert next(it).data[0].shape == (2, 3, 32, 32)
+    # the single-object item pads with -1 rows
+    it2 = ImageDetIter(batch_size=1, data_shape=(3, 32, 32),
+                       imglist=[(_label()[:1], _img().asnumpy())],
+                       max_objects=3)
+    lab = next(it2).label[0].asnumpy()
+    assert lab.shape == (1, 3, 5)
+    assert (lab[0, 1:] == -1).all()
+
+
+def test_image_det_iter_parses_flat_lst_format():
+    flat = onp.array([2, 5, 1, 0.1, 0.2, 0.5, 0.6, 3, 0.6, 0.1, 0.9, 0.4],
+                     "float32")
+    parsed = ImageDetIter._parse_label(flat)
+    onp.testing.assert_allclose(parsed, _label())
+    plain = ImageDetIter._parse_label(_label().ravel())
+    onp.testing.assert_allclose(plain, _label())
+
+
+def test_dumps_serializable():
+    import json
+    for a in (DetHorizontalFlipAug(0.5), DetRandomCropAug(),
+              DetRandomPadAug(), DetBorrowAug(image.CastAug())):
+        name, kwargs = json.loads(a.dumps())
+        assert name == type(a).__name__

@@ -1,0 +1,173 @@
+"""Registered optimizer-update / AMP-cast op surface.
+
+Reference test model: tests/python/unittest/test_optimizer.py compares the
+fused update kernels against python reimplementations; here additionally
+each op is checked against the in-tree Optimizer class doing the same math
+(src/operator/optimizer_op.cc, contrib/adamw.cc, tensor/amp_cast.cc).
+"""
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.ops.registry import OPS
+
+
+def test_names_registered():
+    for name in ("sgd_update", "sgd_mom_update", "mp_sgd_update",
+                 "mp_sgd_mom_update", "adam_update", "adamw_update",
+                 "nag_mom_update", "signsgd_update", "signum_update",
+                 "ftrl_update", "rmsprop_update",
+                 "lamb_update_phase1", "lamb_update_phase2",
+                 "mp_lamb_update_phase1", "mp_lamb_update_phase2",
+                 "multi_sgd_update", "multi_sgd_mom_update",
+                 "multi_mp_sgd_update", "multi_mp_sgd_mom_update",
+                 "multi_sum_sq", "amp_cast", "amp_multicast"):
+        assert name in OPS, name
+
+
+def _rand(shape, seed=0):
+    return onp.random.RandomState(seed).randn(*shape).astype("float32")
+
+
+def test_sgd_update_math():
+    w, g = _rand((3, 4), 1), _rand((3, 4), 2)
+    out = mx.nd.sgd_update(nd.array(w), nd.array(g), lr=0.1, wd=0.01,
+                           rescale_grad=0.5)
+    ref = w - 0.1 * (0.5 * g + 0.01 * w)
+    onp.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-6)
+
+
+def test_sgd_update_clip_gradient():
+    w = onp.zeros((4,), "float32")
+    g = onp.array([10.0, -10.0, 0.5, -0.5], "float32")
+    out = mx.nd.sgd_update(nd.array(w), nd.array(g), lr=1.0,
+                           clip_gradient=1.0)
+    onp.testing.assert_allclose(out.asnumpy(), [-1.0, 1.0, -0.5, 0.5])
+
+
+def test_sgd_mom_update_matches_optimizer_class():
+    w, g = _rand((5,), 3), _rand((5,), 4)
+    mom = onp.zeros((5,), "float32")
+    nw, nm = mx.nd.sgd_mom_update(nd.array(w), nd.array(g), nd.array(mom),
+                                  lr=0.1, momentum=0.9, wd=0.01)
+    # two steps through the op == two steps through the SGD class
+    nw2, nm2 = mx.nd.sgd_mom_update(nw, nd.array(g), nm,
+                                    lr=0.1, momentum=0.9, wd=0.01)
+
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9, wd=0.01)
+    wc = nd.array(w)
+    state = opt.create_state(0, wc)
+    for _ in range(2):
+        state = opt.update(0, wc, nd.array(g), state)
+
+    onp.testing.assert_allclose(nw2.asnumpy(), wc.asnumpy(), rtol=1e-5)
+
+
+def test_mp_sgd_update_keeps_fp32_master():
+    w32 = _rand((6,), 5)
+    w16 = w32.astype(onp.float16)
+    g = _rand((6,), 6).astype(onp.float16)
+    nw, nw32 = mx.nd.mp_sgd_update(nd.array(w16), nd.array(g),
+                                   nd.array(w32), lr=0.1)
+    assert nw.asnumpy().dtype == onp.float16
+    assert nw32.asnumpy().dtype == onp.float32
+    onp.testing.assert_allclose(nw32.asnumpy(),
+                                w32 - 0.1 * g.astype("float32"), rtol=1e-6)
+
+
+def test_adam_update_math():
+    w, g = _rand((3,), 7), _rand((3,), 8)
+    m = onp.zeros(3, "float32")
+    v = onp.zeros(3, "float32")
+    nw, nm, nv = mx.nd.adam_update(nd.array(w), nd.array(g), nd.array(m),
+                                   nd.array(v), lr=0.01)
+    mr = 0.1 * g
+    vr = 0.001 * g * g
+    ref = w - 0.01 * mr / (onp.sqrt(vr) + 1e-8)
+    onp.testing.assert_allclose(nw.asnumpy(), ref, rtol=1e-5)
+    onp.testing.assert_allclose(nm.asnumpy(), mr, rtol=1e-5)
+    onp.testing.assert_allclose(nv.asnumpy(), vr, rtol=1e-5)
+
+
+def test_lamb_phase1_phase2_compose_to_lamb_class():
+    w, g = _rand((8,), 9), _rand((8,), 10)
+    m = onp.zeros(8, "float32")
+    v = onp.zeros(8, "float32")
+    d, nm, nv = mx.nd.lamb_update_phase1(
+        nd.array(w), nd.array(g), nd.array(m), nd.array(v),
+        beta1=0.9, beta2=0.999, epsilon=1e-6, t=1, wd=0.01)
+    r1 = nd.array(onp.array([onp.linalg.norm(w)], "float32"))
+    r2 = nd.array(onp.array([onp.linalg.norm(d.asnumpy())], "float32"))
+    nw = mx.nd.lamb_update_phase2(nd.array(w), d, r1, r2, lr=0.01)
+
+    opt = mx.optimizer.LAMB(learning_rate=0.01, wd=0.01)
+    wc = nd.array(w)
+    state = opt.create_state(0, wc)
+    opt.update(0, wc, nd.array(g), state)
+    onp.testing.assert_allclose(nw.asnumpy(), wc.asnumpy(), rtol=1e-5)
+
+
+def test_multi_sgd_update_two_weights():
+    w0, g0 = _rand((3,), 11), _rand((3,), 12)
+    w1, g1 = _rand((2, 2), 13), _rand((2, 2), 14)
+    o0, o1 = mx.nd.multi_sgd_update(
+        nd.array(w0), nd.array(g0), nd.array(w1), nd.array(g1),
+        lrs="0.1, 0.2", wds="0.0, 0.0", num_weights=2)
+    onp.testing.assert_allclose(o0.asnumpy(), w0 - 0.1 * g0, rtol=1e-6)
+    onp.testing.assert_allclose(o1.asnumpy(), w1 - 0.2 * g1, rtol=1e-6)
+
+
+def test_multi_mp_sgd_mom_update_roundtrip():
+    n = 2
+    args = []
+    ws = []
+    for i in range(n):
+        w32 = _rand((4,), 20 + i)
+        ws.append(w32)
+        args += [nd.array(w32.astype("float16")),
+                 nd.array(_rand((4,), 30 + i).astype("float16")),
+                 nd.zeros((4,)), nd.array(w32)]
+    outs = mx.nd.multi_mp_sgd_mom_update(
+        *args, lrs=[0.1, 0.1], wds=[0.0, 0.0], momentum=0.9, num_weights=2)
+    assert len(outs) == 6  # (w, mom, w32) x 2
+    assert outs[2].asnumpy().dtype == onp.float32
+
+
+def test_multi_sum_sq():
+    a, b = _rand((3, 3), 15), _rand((5,), 16)
+    sa, sb = mx.nd.multi_sum_sq(nd.array(a), nd.array(b), num_arrays=2)
+    onp.testing.assert_allclose(sa.asnumpy(), (a * a).sum(), rtol=1e-5)
+    onp.testing.assert_allclose(sb.asnumpy(), (b * b).sum(), rtol=1e-5)
+
+
+def test_amp_cast_and_multicast():
+    x = nd.array(_rand((3,), 17))
+    y = mx.nd.amp_cast(x, dtype="bfloat16")
+    assert str(y._data.dtype) == "bfloat16"
+    lo = mx.nd.amp_cast(x, dtype="float16")
+    a, b = mx.nd.amp_multicast(lo, x, num_outputs=2)
+    assert a.asnumpy().dtype == onp.float32       # widest wins
+    c, d = mx.nd.amp_multicast(lo, x, num_outputs=2, cast_narrow=True)
+    assert c.asnumpy().dtype == onp.float16       # narrowest wins
+    assert d.asnumpy().dtype == onp.float16
+
+
+def test_out_kwarg_inplace_assignment():
+    # reference-style in-place: out=[weight, mom]
+    w = nd.array(_rand((3,), 18))
+    g = nd.array(_rand((3,), 19))
+    mom = nd.zeros((3,))
+    ref = mx.nd.sgd_mom_update(w, g, mom, lr=0.1, momentum=0.9)
+    w2 = nd.array(w.asnumpy())
+    mx.nd.sgd_mom_update(w2, g, mom, lr=0.1, momentum=0.9, out=[w2, mom])
+    onp.testing.assert_allclose(w2.asnumpy(), ref[0].asnumpy())
+    onp.testing.assert_allclose(mom.asnumpy(), ref[1].asnumpy())
+
+
+def test_symbol_frontend_has_update_ops():
+    S = mx.sym
+    w, g = S.Variable("w"), S.Variable("g")
+    out = mx.sym.sgd_update(w, g, lr=0.5)
+    r = out.eval(w=nd.array([1.0]), g=nd.array([0.5]))[0]
+    onp.testing.assert_allclose(r.asnumpy(), [0.75])

@@ -157,8 +157,11 @@ STEPS = [step_op_corpus, step_bert_sweep, step_resnet, step_bert_large,
 
 def run_program() -> bool:
     """Run pending steps in order; re-probe between steps so a mid-program
-    wedge stops the run (resumable next window). True if all steps done."""
+    wedge stops the run (resumable next window). True only when every step
+    has actually succeeded — a deterministic step failure keeps the watch
+    loop alive so a later iteration (or a code fix) can retry it."""
     done = _done_steps()
+    all_ok = True
     for fn in STEPS:
         name = fn.__name__.replace("step_", "")
         if name in done:
@@ -168,11 +171,13 @@ def run_program() -> bool:
         _append(rec)
         print(f"[{_now()}] step {name}: ok={rec['ok']} rc={rec.get('rc')}",
               flush=True)
-        if not rec["ok"] and not probe():
-            print(f"[{_now()}] tunnel died mid-program; back to watching",
-                  flush=True)
-            return False
-    return True
+        if not rec["ok"]:
+            all_ok = False
+            if not probe():
+                print(f"[{_now()}] tunnel died mid-program; back to watching",
+                      flush=True)
+                return False
+    return all_ok
 
 
 def main(argv=None) -> int:
