@@ -151,10 +151,79 @@ def run_resnet(watchdog) -> dict:
     }
 
 
+def _ssd_gmacs(img: int, num_classes: int,
+               filters=(32, 64, 128, 128, 128),
+               anchors_per_pos: int = 4) -> float:
+    """Analytic fwd GMACs for the in-tree SSD (models/ssd.py): VGG-style
+    trunk of two 3x3 convs per scale + per-scale cls/box heads."""
+    macs = 0.0
+    cin, s = 3, img
+    feats = []
+    for f in filters:
+        macs += 9 * cin * f * s * s + 9 * f * f * s * s
+        s //= 2
+        feats.append((f, s))
+        cin = f
+    for f, sp in feats[1:]:   # heads run on all scales but the stem
+        macs += 9 * f * (anchors_per_pos * (num_classes + 1)) * sp * sp
+        macs += 9 * f * (anchors_per_pos * 4) * sp * sp
+    return macs / 1e9
+
+
+def run_ssd(watchdog) -> dict:
+    """imgs/sec/chip on the SSD-300 training step (BASELINE.md row:
+    GluonCV train_ssd.py counterpart; BASELINE.json configs[4]). Whole step
+    — forward, MultiBoxTarget matching, CE+SmoothL1, grads, SGD-momentum —
+    compiled to one XLA executable."""
+    import jax
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import models, parallel
+
+    B = int(os.environ.get("MXTPU_BENCH_BATCH", "16"))
+    img = int(os.environ.get("MXTPU_BENCH_IMG", "300"))
+    steps = int(os.environ.get("MXTPU_BENCH_STEPS", "20"))
+    classes = 20
+    peak_tflops = _peak_tflops()
+
+    net = models.SSD(num_classes=classes)
+    net.initialize(mx.init.Xavier())
+    loss = models.SSDTargetLoss()
+    mesh = parallel.make_mesh(devices=jax.devices()[:1])
+    trainer = parallel.ShardedTrainer(
+        net, lambda out, label: loss(out[0], out[1], out[2], label), "sgd",
+        {"learning_rate": 0.01, "momentum": 0.9}, mesh=mesh, n_labels=1)
+
+    rng = onp.random.RandomState(0)
+    x = rng.rand(B, 3, img, img).astype(onp.float32)
+    lab = onp.zeros((B, 1, 5), onp.float32)
+    lab[:, 0, 0] = rng.randint(0, classes, B)
+    lab[:, 0, 1:3] = 0.2
+    lab[:, 0, 3:5] = 0.7
+    dt, lval = _measure(trainer, (x, lab), steps, watchdog)
+
+    imgs_per_sec = B / dt
+    flops = 3.0 * 2.0 * _ssd_gmacs(img, classes) * 1e9 * B
+    mfu = (flops / dt) / (peak_tflops * 1e12)
+    return {
+        "metric": "ssd300_train_imgs_per_sec_per_chip",
+        "value": round(imgs_per_sec, 2),
+        "unit": "imgs/sec/chip",
+        "vs_baseline": round(mfu / 0.40, 4),
+        "extra": {"step_ms": round(dt * 1e3, 2), "mfu": round(mfu, 4),
+                  "batch": B, "img": img,
+                  "backend": jax.default_backend(),
+                  "loss": float(lval.asnumpy())},
+    }
+
+
 def main() -> None:
     watchdog = _arm_watchdog()
-    if os.environ.get("MXTPU_BENCH_WORKLOAD", "bert") == "resnet":
+    workload = os.environ.get("MXTPU_BENCH_WORKLOAD", "bert")
+    if workload == "resnet":
         print(json.dumps(run_resnet(watchdog)))
+        return
+    if workload == "ssd":
+        print(json.dumps(run_ssd(watchdog)))
         return
     import jax
     import incubator_mxnet_tpu as mx

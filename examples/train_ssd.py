@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""SSD end-to-end detection training on a synthetic shapes dataset.
+
+Reference counterpart: GluonCV ``scripts/detection/ssd/train_ssd.py``
+(SURVEY §2.9, BASELINE.json configs[4]). The pipeline is the full SSD
+recipe — multi-scale anchors (``multibox_prior``), target matching with
+hard-negative mining (``multibox_target``), CE + SmoothL1 loss, NMS decode
+(``multibox_detection``) — on a dataset this image can generate offline:
+one axis-aligned bright rectangle per image, class = which RGB channel is
+lit. Reports a detection-accuracy mAP proxy: the fraction of held-out
+images whose top detection has the right class and IoU > 0.5.
+
+    python examples/train_ssd.py [--steps N] [--image-size 48]
+"""
+import argparse
+import os
+import sys
+
+import numpy as onp
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+import incubator_mxnet_tpu as mx  # noqa: E402
+from incubator_mxnet_tpu import gluon, models, nd  # noqa: E402
+
+
+def make_dataset(rng, n, size):
+    """(images (n, 3, S, S), labels (n, 1, 5)): one colored rectangle on a
+    dim noisy background; class = color channel."""
+    imgs = 0.1 * rng.rand(n, 3, size, size).astype("float32")
+    labels = onp.zeros((n, 1, 5), "float32")
+    for i in range(n):
+        cls = rng.randint(0, 3)
+        w = rng.randint(size // 4, size // 2 + 1)
+        h = rng.randint(size // 4, size // 2 + 1)
+        x0 = rng.randint(0, size - w)
+        y0 = rng.randint(0, size - h)
+        imgs[i, cls, y0:y0 + h, x0:x0 + w] = 1.0
+        labels[i, 0] = [cls, x0 / size, y0 / size,
+                        (x0 + w) / size, (y0 + h) / size]
+    return imgs, labels
+
+
+def _iou(a, b):
+    ix = max(0.0, min(a[2], b[2]) - max(a[0], b[0]))
+    iy = max(0.0, min(a[3], b[3]) - max(a[1], b[1]))
+    inter = ix * iy
+    ua = (a[2] - a[0]) * (a[3] - a[1]) + (b[2] - b[0]) * (b[3] - b[1]) - inter
+    return inter / ua if ua > 0 else 0.0
+
+
+def evaluate(net, imgs, labels, batch_size=16):
+    """mAP proxy: top-detection hit rate (class right, IoU > 0.5)."""
+    hits, total = 0, 0
+    for s in range(0, len(imgs), batch_size):
+        x = nd.array(imgs[s:s + batch_size])
+        det = net.detect(x, threshold=0.01).asnumpy()  # (B, N, 6)
+        for b in range(det.shape[0]):
+            rows = det[b]
+            rows = rows[rows[:, 0] >= 0]
+            total += 1
+            if rows.size == 0:
+                continue
+            best = rows[rows[:, 1].argmax()]
+            truth = labels[s + b, 0]
+            if int(best[0]) == int(truth[0]) and \
+                    _iou(best[2:6], truth[1:5]) > 0.5:
+                hits += 1
+    return hits / max(total, 1)
+
+
+def main(argv=None) -> float:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=300)
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--image-size", type=int, default=48)
+    ap.add_argument("--train-size", type=int, default=256)
+    ap.add_argument("--val-size", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=0.005)
+    ap.add_argument("--momentum", type=float, default=0.9)
+    ap.add_argument("--seed", type=int, default=None,
+                    help="RNG seed; default: MXNET_TEST_SEED or 42")
+    args = ap.parse_args(argv)
+
+    # deterministic init (reference train_ssd.py seeds) — MXNET_TEST_SEED
+    # wins so the committed seed-sweep actually varies the init across runs
+    mx.random.seed(args.seed if args.seed is not None
+                   else int(os.environ.get("MXNET_TEST_SEED", "42")))
+    rng = onp.random.RandomState(0)   # the dataset itself stays fixed
+    tr_x, tr_y = make_dataset(rng, args.train_size, args.image_size)
+    va_x, va_y = make_dataset(rng, args.val_size, args.image_size)
+
+    net = models.SSD(num_classes=3)
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    loss_fn = models.SSDTargetLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr,
+                             "momentum": args.momentum})
+
+    B = args.batch_size
+    for step in range(args.steps):
+        idx = rng.randint(0, args.train_size, B)
+        x, y = nd.array(tr_x[idx]), nd.array(tr_y[idx])
+        with mx.autograd.record():
+            cp, bp, an = net(x)
+            loss = loss_fn(cp, bp, an, y)
+        loss.backward()
+        trainer.step(1)   # SSDTargetLoss already normalizes by num_pos
+        if step % 50 == 0:
+            print(f"step {step:4d} loss {float(loss.asnumpy()):.4f}")
+
+    acc = evaluate(net, va_x, va_y)
+    print(f"detection accuracy (mAP proxy): {acc:.4f}")
+    return acc
+
+
+if __name__ == "__main__":
+    main()

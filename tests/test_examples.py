@@ -58,3 +58,10 @@ def test_dcgan_example_matches_moments():
     stats = _load("dcgan.py").main(["--steps", "150"])
     assert abs(stats["fake_mean"] - stats["real_mean"]) < 0.3, stats
     assert abs(stats["fake_std"] - stats["real_std"]) < 0.4, stats
+
+
+def test_train_ssd_example_detects():
+    # end-to-end SSD recipe: anchors -> target matching -> CE+SmoothL1 ->
+    # NMS decode; the mAP proxy is top-detection (class, IoU>0.5) hit rate
+    acc = _load("train_ssd.py").main(["--steps", "150"])
+    assert acc > 0.8, acc

@@ -45,6 +45,7 @@ METRIC_GATES = [
     # dcgan returns moment stats; the driver reduces them to the worst
     # normalized distance (must stay < 1.0 to pass both test bounds)
     ("dcgan", "dcgan.py", ["--steps", "150"], 1.0, "lower"),
+    ("ssd", "train_ssd.py", ["--steps", "150"], 0.8, "higher"),
 ]
 
 # pytest-only gates (no exposed metric)

@@ -143,6 +143,15 @@ def step_bert_large():
             "result": rec, "err": None if rc == 0 else (err or out)[-300:]}
 
 
+def step_ssd():
+    rc, out, err = _run([sys.executable, "bench.py"],
+                        env_delta={"MXTPU_BENCH_WORKLOAD": "ssd"},
+                        timeout=1800)
+    rec = _last_json(out)
+    return {"step": "ssd", "ok": rc == 0 and rec is not None, "rc": rc,
+            "result": rec, "err": None if rc == 0 else (err or out)[-300:]}
+
+
 def step_int8():
     rc, out, err = _run([sys.executable, "benchmark/int8_probe.py"],
                         timeout=1200)
@@ -152,7 +161,7 @@ def step_int8():
 
 
 STEPS = [step_op_corpus, step_bert_sweep, step_resnet, step_bert_large,
-         step_int8]
+         step_ssd, step_int8]
 
 
 def run_program() -> bool:
