@@ -240,3 +240,24 @@ def test_eager_backward_uses_stored_pullbacks():
     onp.testing.assert_allclose(x.grad.asnumpy(),
                                 2.0 * onp.exp(2.0 * onp.array([1, 2, 3.0])),
                                 rtol=1e-5)
+
+
+def test_sparse_dense_budget_guard(monkeypatch):
+    """The facade must refuse to silently materialize a huge dense array
+    (row_sparse over an embedding-table-sized shape) — MXTPU_SPARSE_DENSE_LIMIT,
+    docs/env_vars.md."""
+    import pytest
+    from incubator_mxnet_tpu.base import MXNetError
+    from incubator_mxnet_tpu.ndarray import sparse as sp
+    with pytest.raises(MXNetError, match="MXTPU_SPARSE_DENSE_LIMIT"):
+        sp.row_sparse_array((onp.ones((2, 1024), "float32"), [0, 1]),
+                            shape=(23_000_000, 1024))
+    # raising the limit (or disabling) permits it for small shapes
+    monkeypatch.setenv("MXTPU_SPARSE_DENSE_LIMIT", "0")
+    arr = sp.row_sparse_array((onp.ones((2, 4), "float32"), [0, 2]),
+                              shape=(5, 4))
+    assert arr.shape == (5, 4)
+    monkeypatch.setenv("MXTPU_SPARSE_DENSE_LIMIT", "16")
+    with pytest.raises(MXNetError):
+        sp.csr_matrix((onp.ones(2, "float32"), [0, 1], [0, 1, 2]),
+                      shape=(64, 64))
