@@ -148,7 +148,7 @@ class DetRandomCropAug(DetAugmenter):
             y = pyrandom.uniform(0, 1 - h)
             crop = (x, y, w, h)
             if label.size == 0:
-                return crop
+                return crop, label
             if _box_coverage(label, crop).max() >= self.min_object_covered:
                 new_label = _update_labels_crop(label, crop,
                                                 self.min_eject_coverage)

@@ -219,11 +219,14 @@ def foreach(body, data, init_states, name: str = "foreach"):
     python/mxnet/ndarray/contrib.py foreach): run ``body(data_t, states)``
     over axis 0 of ``data``; returns (stacked outputs, final states).
 
-    Eager-recording calls run a Python loop (exact reference semantics —
-    gradients reach closed-over arrays through the tape); inference and
-    ``hybridize()``-traced calls compile to one ``lax.scan``."""
+    Concrete (non-traced) calls — recording or inference — run a Python
+    loop, the reference's eager semantics exactly: imperative bodies may
+    call ``.asnumpy()`` / branch on values / mutate closures, each step's
+    side effects fire once, and gradients reach closed-over arrays through
+    the tape. ``hybridize()``-traced calls compile to one ``lax.scan``
+    (as does the T == 0 edge, where only a trace can learn the output
+    shapes)."""
     from .. import ndarray as ndmod
-    from .. import autograd
 
     data_l, d_single = _as_seq(data)
     states_l, s_single = _as_seq(init_states)
@@ -231,8 +234,9 @@ def foreach(body, data, init_states, name: str = "foreach"):
     traced = _is_traced(data_l + states_l)
 
     T = data_l[0].shape[0]
-    if autograd.is_recording() and not traced and T > 0:
-        # Python-loop path: reference-imperative semantics on the tape
+    if not traced and T > 0:
+        # Python-loop path: reference-imperative semantics (matches the
+        # concrete-input while_loop path)
         st = _repack(list(states_l), s_single)
         out_steps: List[list] = []
         o_single = True
@@ -250,6 +254,13 @@ def foreach(body, data, init_states, name: str = "foreach"):
         final_l, _ = _as_seq(st)
         return (_repack(stacked, o_single) if stacked else [],
                 _repack(list(final_l), s_single))
+
+    return _foreach_scan(body, data_l, d_single, states_l, s_single, ctx)
+
+
+def _foreach_scan(body, data_l, d_single, states_l, s_single, ctx):
+    """The compiled foreach path: one ``lax.scan`` via the ``_foreach`` op."""
+    from .. import ndarray as ndmod
 
     fmt: Dict[str, Any] = {}
     step = _wrap_step(
@@ -361,22 +372,38 @@ def cond(pred, then_func, else_func, name: str = "cond"):
     ctx = p.context
     fmt: Dict[str, Any] = {}
 
-    def _branch(fn):
+    def _branch(fn, tag):
         def run(_capt):
             with autograd.pause(train_mode=autograd.is_training()):
                 out = fn()
             out_l, single = _as_seq(out)
-            fmt["o_single"] = single
-            fmt["n_outs"] = len(out_l)
+            fmt[tag] = (single, len(out_l))
             return tuple(a._data if isinstance(a, NDArray) else jnp.asarray(a)
                          for a in out_l)
         return run
 
     from .. import ndarray as ndmod
-    res = ndmod._cond(p, then_branch=_branch(then_func),
-                      else_branch=_branch(else_func))
+    try:
+        res = ndmod._cond(p, then_branch=_branch(then_func, "then"),
+                          else_branch=_branch(else_func, "else"))
+    except TypeError as e:
+        # lax.cond's pytree-structure mismatch, translated (both branches
+        # have traced by the time it compares out_trees, so fmt is full)
+        if "then" in fmt and "else" in fmt and fmt["then"] != fmt["else"]:
+            raise _cond_mismatch_error(fmt) from e
+        raise
+    if "then" in fmt and "else" in fmt and fmt["then"] != fmt["else"]:
+        raise _cond_mismatch_error(fmt)
     res = res if isinstance(res, (list, tuple)) else [res]
-    return _repack(list(res), fmt["o_single"])
+    return _repack(list(res), fmt["then"][0])
+
+
+def _cond_mismatch_error(fmt) -> ValueError:
+    return ValueError(
+        "cond: then/else branches disagree on output structure "
+        f"(then: single={fmt['then'][0]}, n_outs={fmt['then'][1]}; "
+        f"else: single={fmt['else'][0]}, n_outs={fmt['else'][1]}); "
+        "return the same single-array-vs-list style from both branches")
 
 
 # ---------------------------------------------------------------------------

@@ -105,8 +105,7 @@ def adamw_update(weight, grad, mean, var, rescale_grad, lr=None, eta=1.0,
     g = _prep(grad, rescale_grad, clip_gradient)
     m = beta1 * mean + (1 - beta1) * g
     v = beta2 * var + (1 - beta2) * jnp.square(g)
-    upd = m / (jnp.sqrt(v) + epsilon) + wd * weight
-    return weight - eta * lr * upd, m, v
+    return weight - eta * (lr * m / (jnp.sqrt(v) + epsilon) + wd * weight), m, v
 
 
 @register_op("nag_mom_update")

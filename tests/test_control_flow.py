@@ -283,3 +283,64 @@ def test_bucketed_rnn_foreach_grads_match_unroll():
                       else p.grad.asnumpy().copy() for p in params])
     for ga, gb in zip(*grads):
         onp.testing.assert_allclose(ga, gb, rtol=2e-5, atol=2e-6)
+
+
+def test_nd_foreach_imperative_body_in_inference():
+    # concrete (non-recording, non-traced) foreach must run the Python
+    # loop, so reference-legal imperative bodies (.asnumpy(), value-
+    # dependent branching) work in inference mode too
+    x = onp.arange(6, dtype="float32").reshape(3, 2)
+
+    def body(d, s):
+        v = float(d.asnumpy().sum())           # TracerError under lax.scan
+        scale = 2.0 if v > 4 else 1.0
+        return d * scale, s + d
+
+    outs, states = mx.nd.contrib.foreach(body, nd.array(x), nd.zeros((2,)))
+    ref = onp.stack([x[0], x[1] * 2.0, x[2] * 2.0])
+    onp.testing.assert_allclose(outs.asnumpy(), ref)
+    onp.testing.assert_allclose(states.asnumpy(), x.sum(axis=0))
+
+
+def test_traced_cond_branch_structure_mismatch_raises():
+    # then returns a single array, else a 1-element list: repacking must
+    # not silently follow whichever branch traced last
+    class Net(gluon.HybridBlock):
+        def hybrid_forward(self, F, x):
+            return mx.nd.contrib.cond(
+                lambda: (x.sum() > 0), lambda: x * 2, lambda: [x * 3])
+
+    net = Net()
+    net.hybridize()
+    net(nd.ones((3,)))  # first call is the eager warm-up (concrete branch)
+    with pytest.raises(ValueError, match="disagree on output structure"):
+        net(nd.ones((3,)))  # second call traces: both branches are cut
+
+
+def test_traced_cond_branch_count_mismatch_translated():
+    # lax.cond's raw pytree TypeError must be translated to the same
+    # friendly ValueError when the branches return different output counts
+    class Net(gluon.HybridBlock):
+        def hybrid_forward(self, F, x):
+            return mx.nd.contrib.cond(
+                lambda: (x.sum() > 0), lambda: [x * 2, x], lambda: [x * 3])
+
+    net = Net()
+    net.hybridize()
+    net(nd.ones((3,)))  # eager warm-up
+    with pytest.raises(ValueError, match="disagree on output structure"):
+        net(nd.ones((3,)))
+
+
+def test_nd_foreach_side_effects_fire_once_per_step():
+    # reference eager semantics: a closure-mutating body runs exactly once
+    # per step — no speculative trace may leak tracers into the closure
+    acc = []
+
+    def body(d, s):
+        acc.append(float(d.asnumpy().sum()))
+        return d, s + d
+
+    x = onp.arange(6, dtype="float32").reshape(3, 2)
+    mx.nd.contrib.foreach(body, nd.array(x), nd.zeros((2,)))
+    assert acc == [1.0, 5.0, 9.0]

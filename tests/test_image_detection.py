@@ -119,3 +119,13 @@ def test_dumps_serializable():
               DetRandomPadAug(), DetBorrowAug(image.CastAug())):
         name, kwargs = json.loads(a.dumps())
         assert name == type(a).__name__
+
+
+def test_det_random_crop_passes_through_empty_label():
+    # negative images (zero ground-truth boxes) must survive the crop
+    # (reference DetRandomCropAug handles label-free samples)
+    aug = DetRandomCropAug(max_attempts=3)
+    empty = onp.zeros((0, 5), "float32")
+    src, lab = aug(_img(), empty)
+    assert lab.shape == (0, 5)
+    assert src.shape[2] == 3

@@ -171,3 +171,22 @@ def test_symbol_frontend_has_update_ops():
     out = mx.sym.sgd_update(w, g, lr=0.5)
     r = out.eval(w=nd.array([1.0]), g=nd.array([0.5]))[0]
     onp.testing.assert_allclose(r.asnumpy(), [0.75])
+
+
+def test_adamw_update_decoupled_decay_not_scaled_by_lr():
+    # reference contrib/adamw.cc: w -= eta*(lr*m/(sqrt(v)+eps) + wd*w) —
+    # the decay term is NOT multiplied by lr
+    w, g = _rand((4,), 15), _rand((4,), 16)
+    m = onp.zeros(4, "float32")
+    v = onp.zeros(4, "float32")
+    lr, eta, wd = 0.01, 0.5, 0.1
+    nw, nm, nv = mx.nd.adamw_update(nd.array(w), nd.array(g), nd.array(m),
+                                    nd.array(v), nd.array([1.0]), lr=lr,
+                                    eta=eta, wd=wd)
+    mr = 0.1 * g
+    vr = 0.001 * g * g
+    ref = w - eta * (lr * mr / (onp.sqrt(vr) + 1e-8) + wd * w)
+    onp.testing.assert_allclose(nw.asnumpy(), ref, rtol=1e-5)
+    # wrong (lr-coupled) decay must NOT match
+    wrong = w - eta * lr * (mr / (onp.sqrt(vr) + 1e-8) + wd * w)
+    assert not onp.allclose(nw.asnumpy(), wrong)
