@@ -24,10 +24,10 @@ the tape to everything the body touches, including closed-over arrays);
 under a ``hybridize()`` trace or symbolic execution the registered op
 compiles to the ``lax`` primitive.
 
-Note on stochastic bodies: under a traced ``foreach`` every step sees the
-same RNG key (the scan body closes over the trace key); seed-per-step
-dropout inside a compiled loop needs an explicit key state threaded through
-``init_states``.
+Stochastic bodies: under a traced ``foreach``/``while_loop`` an RNG key is
+threaded through the scan carry automatically — each step draws from a
+fresh subkey, so per-step dropout inside a compiled loop matches the
+reference's eager per-step draws (src/resource.cc kRandom discipline).
 """
 from __future__ import annotations
 
@@ -79,6 +79,25 @@ def _scalar_bool(x):
     return jnp.reshape(x, ()).astype(bool)
 
 
+def _loop_rng_key():
+    """Per-loop RNG key when a ``trace_rng`` is active (hybridize trace),
+    else None. Threading it through the scan carry gives every step a fresh
+    subkey — without this, ``next_key()`` inside the body would split once
+    at trace time and every step would reuse that one key (stale dropout
+    masks; the reference's eager loop draws per step from the device
+    stream, src/resource.cc kRandom)."""
+    from .. import random as random_mod
+    if random_mod._TRACE_RNG.stack:
+        return random_mod._TRACE_RNG.stack[-1].split()
+    return None
+
+
+def _step_rng(sub_key):
+    """Context manager installing ``sub_key`` as the body's RNG source."""
+    from .. import random as random_mod
+    return random_mod.trace_rng(sub_key)
+
+
 # ---------------------------------------------------------------------------
 # registered subgraph ops (probe-able in OPS, used by traced/symbolic paths)
 # ---------------------------------------------------------------------------
@@ -102,11 +121,22 @@ def _foreach_op(*arrays, body=None, sub=None, n_data=1, n_states=0,
             res = run(tuple(xs) + tuple(st) + tuple(cp))
             return tuple(res[:n_outs]), tuple(res[n_outs:])
 
-    def scan_body(st, xs):
-        outs, new_st = body(xs, st, capt)
-        return tuple(new_st), tuple(outs)
+    k0 = _loop_rng_key()
+    if k0 is None:
+        def scan_body(st, xs):
+            outs, new_st = body(xs, st, capt)
+            return tuple(new_st), tuple(outs)
 
-    final, stacked = lax.scan(scan_body, states, data)
+        final, stacked = lax.scan(scan_body, states, data)
+    else:
+        def scan_body(carry, xs):
+            st, key = carry
+            key, sub = jax.random.split(key)
+            with _step_rng(sub):
+                outs, new_st = body(xs, st, capt)
+            return (tuple(new_st), key), tuple(outs)
+
+        (final, _), stacked = lax.scan(scan_body, (states, k0), data)
     return tuple(stacked) + tuple(final)
 
 
@@ -139,16 +169,39 @@ def _while_loop_op(*arrays, cond_fn=None, step_fn=None, sub=None,
             res = run_step(tuple(st) + tuple(cp))
             return tuple(res[:n_outs]), tuple(res[n_outs:])
 
-    def tick(carry, _):
-        st, active = carry
-        ok = jnp.logical_and(active, _scalar_bool(cond_fn(st, capt)))
-        outs, new_st = step_fn(st, capt)
+    def _masked(st, outs, new_st, ok):
         new_st = tuple(jnp.where(ok, n, o) for n, o in zip(new_st, st))
         outs = tuple(jnp.where(ok, o, jnp.zeros_like(o)) for o in outs)
-        return (new_st, ok), tuple(outs)
+        return new_st, outs
 
-    (final, _), stacked = lax.scan(
-        tick, (states, jnp.asarray(True)), None, length=int(max_iterations))
+    k0 = _loop_rng_key()
+    if k0 is None:
+        def tick(carry, _):
+            st, active = carry
+            ok = jnp.logical_and(active, _scalar_bool(cond_fn(st, capt)))
+            outs, new_st = step_fn(st, capt)
+            new_st, outs = _masked(st, outs, new_st, ok)
+            return (new_st, ok), tuple(outs)
+
+        (final, _), stacked = lax.scan(
+            tick, (states, jnp.asarray(True)), None,
+            length=int(max_iterations))
+    else:
+        def tick(carry, _):
+            (st, active), key = carry
+            key, sub = jax.random.split(key)
+            with _step_rng(sub):
+                # cond draws under the same per-tick scope as the body
+                # (consecutive splits), so stochastic conditions are fresh
+                # each tick too
+                ok = jnp.logical_and(active, _scalar_bool(cond_fn(st, capt)))
+                outs, new_st = step_fn(st, capt)
+            new_st, outs = _masked(st, outs, new_st, ok)
+            return ((new_st, ok), key), tuple(outs)
+
+        (((final, _), _), stacked) = lax.scan(
+            tick, ((states, jnp.asarray(True)), k0), None,
+            length=int(max_iterations))
     return tuple(stacked) + tuple(final)
 
 

@@ -344,3 +344,55 @@ def test_nd_foreach_side_effects_fire_once_per_step():
     x = onp.arange(6, dtype="float32").reshape(3, 2)
     mx.nd.contrib.foreach(body, nd.array(x), nd.zeros((2,)))
     assert acc == [1.0, 5.0, 9.0]
+
+
+def test_traced_foreach_per_step_dropout_keys():
+    # the scan carry threads an RNG key: each compiled step must draw a
+    # FRESH dropout mask (reference eager loops draw per step from the
+    # device stream)
+    class Net(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.drop = gluon.nn.Dropout(0.5)
+
+        def hybrid_forward(self, F, x):
+            outs, _ = mx.nd.contrib.foreach(
+                lambda d, s: (self.drop(d), s),
+                x, mx.nd.zeros_like(x[0]))
+            return outs
+
+    net = Net()
+    net.initialize()
+    net.hybridize()
+    x = nd.ones((6, 256))
+    with autograd.record(train_mode=True):
+        net(x)                      # eager warm-up
+    with autograd.record(train_mode=True):
+        out = net(x).asnumpy()      # compiled: one lax.scan
+    masks = [tuple(row == 0.0) for row in out]
+    assert len(set(masks)) == len(masks), "steps reused a dropout mask"
+
+
+def test_traced_while_loop_per_step_dropout_keys():
+    class Net(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.drop = gluon.nn.Dropout(0.5)
+
+        def hybrid_forward(self, F, x):
+            outs, fin = mx.nd.contrib.while_loop(
+                cond=lambda i, v: i.sum() < 4.0,
+                func=lambda i, v: (self.drop(v), [i + 1.0, v]),
+                loop_vars=[mx.nd.zeros((1,)), x], max_iterations=4)
+            return outs
+
+    net = Net()
+    net.initialize()
+    net.hybridize()
+    x = nd.ones((256,))
+    with autograd.record(train_mode=True):
+        net(x)
+    with autograd.record(train_mode=True):
+        out = net(x).asnumpy()
+    masks = [tuple(row == 0.0) for row in out]
+    assert len(set(masks)) == len(masks), "ticks reused a dropout mask"
