@@ -54,6 +54,7 @@ _LAZY = {
     "callback": ".callback",
     "model": ".model",
     "module": ".module",
+    "subgraph": ".subgraph",
     "symbol": ".symbol",
     "sym": ".symbol",
     "onnx": ".onnx",

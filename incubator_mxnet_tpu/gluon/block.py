@@ -450,6 +450,12 @@ class HybridBlock(Block):
         if self._active and not _is_tracing() and isinstance(x, NDArray):
             return self._call_cached_op(x, *args)
         if isinstance(x, NDArray):
+            if getattr(self, "_sg_graph", None) is not None and self._active:
+                # optimize_for installed a partitioned graph: while
+                # hybridized it IS the compute (running inside the cached-op
+                # trace compiles it); hybridize(False) falls back to the
+                # original eager forward, reference CachedOp semantics
+                return self._forward_partitioned(x, *args)
             from .. import ndarray as F
             ctx = x.context
             params = self._fetch_params(ctx, (x,) + args)
@@ -657,21 +663,97 @@ class HybridBlock(Block):
     def optimize_for(self, x, *args, backend=None, **kwargs):
         """Apply a subgraph backend, then compile (reference:
         HybridBlock.optimize_for over the subgraph property registry,
-        src/operator/subgraph/). Backends are registered block-rewrite
-        passes (``gluon.block.register_subgraph_backend``); XLA fusion
+        src/operator/subgraph/). Two kinds of backend resolve here:
+        block-rewrite passes (``gluon.block.register_subgraph_backend`` —
+        the built-in ``"INT8"`` quantization swap), and graph-partitioning
+        property backends (``mx.subgraph.register_backend`` — pattern-match
+        and replace regions of the symbolically traced forward). XLA fusion
         itself needs no pass, so ``backend=None``/"XLA" is hybridize + one
-        warm-up call. The built-in ``"INT8"`` backend runs the quantization
-        layer-swap pass (the quantize_graph_pass counterpart) using ``x``
-        (+ ``calib_data=[...]`` kwarg batches) for calibration."""
-        if backend not in (None, "XLA", "xla"):
-            if backend not in _SUBGRAPH_BACKENDS:
+        warm-up call."""
+        if backend in (None, "XLA", "xla"):
+            self._sg_graph = None  # revert any earlier partitioning
+        else:
+            from .. import subgraph as _subgraph
+            if backend in _SUBGRAPH_BACKENDS:
+                self._sg_graph = None  # block rewrite replaces partitioning
+                _SUBGRAPH_BACKENDS[backend](self, x, *args, **kwargs)
+            elif backend in _subgraph._BACKENDS:
+                if kwargs:
+                    raise MXNetError(
+                        f"subgraph property backend {backend!r} takes no "
+                        f"options; got {sorted(kwargs)}")
+                self._install_partitioned_graph(backend, x, *args)
+            else:
                 raise MXNetError(
-                    f"unknown subgraph backend {backend!r}; registered: "
-                    f"{sorted(_SUBGRAPH_BACKENDS)} (register with "
-                    "gluon.block.register_subgraph_backend)")
-            _SUBGRAPH_BACKENDS[backend](self, x, *args, **kwargs)
+                    f"unknown subgraph backend {backend!r}; registered "
+                    f"block passes: {sorted(_SUBGRAPH_BACKENDS)}, property "
+                    f"backends: {_subgraph.list_backends()} (register with "
+                    "gluon.block.register_subgraph_backend or "
+                    "mx.subgraph.register_backend)")
         self.hybridize()
         return self(x, *args)
+
+    def _install_partitioned_graph(self, backend, x, *args):
+        """Trace the forward symbolically, partition it, and make the
+        partitioned graph this block's compute (reference: the in-place
+        CachedOp repartition done by HybridBlock.optimize_for)."""
+        from .. import subgraph as _subgraph
+        from .. import symbol as S
+        bad = self._training_dependent_children()
+        if bad:
+            raise MXNetError(
+                "property-backend partitioning traces the forward once in "
+                "inference mode, which would bake training-time behavior "
+                f"out of {bad}; blocks with training-dependent state "
+                "(Dropout masks, BatchNorm running stats) are not supported "
+                "here yet — use a block-rewrite backend "
+                "(gluon.block.register_subgraph_backend) or plain "
+                "hybridize() for this net")
+        self(x, *args)  # finish deferred init so params have shapes
+        data_vars = [S.Variable(f"data{i}") for i in range(1 + len(args))]
+        out = self.forward(*data_vars)  # Symbol trace path
+        if isinstance(out, (list, tuple)):
+            out = S.Group(list(out))
+        self._sg_graph = (_subgraph.partition(out, backend),
+                          [v.name for v in data_vars])
+        self._clear_cached_op()  # compiled pre-partition graphs are stale
+
+    def _training_dependent_children(self) -> List[str]:
+        """Names of descendant blocks whose forward depends on training
+        mode or mutates running state — unsafe to freeze into a one-shot
+        inference-mode symbolic trace."""
+        from .nn import basic_layers as _bl
+        kinds = (_bl.Dropout, _bl.BatchNorm)
+        bad = []
+
+        def walk(b):
+            for child in b._children.values():
+                if isinstance(child, kinds):
+                    bad.append(f"{type(child).__name__}({child.name})")
+                walk(child)
+
+        walk(self)
+        return bad
+
+    def _forward_partitioned(self, x, *args):
+        part, names = self._sg_graph
+        ctx = x.context
+        vals = dict(zip(names, (x,) + args))
+        for pname, p in self.collect_params().items():
+            vals[pname] = p.data(ctx)
+        arg_names = part.list_arguments()
+        missing = [a for a in arg_names if a not in vals]
+        if missing:
+            raise MXNetError(
+                f"partitioned graph argument(s) {missing} not found among "
+                "data inputs or parameters")
+        from ..ndarray.op import dispatch_op
+        from .. import symbol as S
+        arrays = [vals[a] for a in arg_names]
+        out = dispatch_op(S._compile_fn(part, arg_names), arrays, {}, ctx,
+                          name=f"partitioned_{self._name}")
+        multi = part._op == "_group"
+        return list(out) if multi and isinstance(out, (list, tuple)) else out
 
 
 #: subgraph-backend registry (reference: SubgraphBackendRegistry)

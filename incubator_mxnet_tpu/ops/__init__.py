@@ -11,4 +11,5 @@ from . import quantization  # noqa: F401
 from . import vision  # noqa: F401
 from . import control_flow  # noqa: F401
 from . import optimizer_ops  # noqa: F401
+from . import subgraph_ops  # noqa: F401
 from .registry import OPS, OpDef, register_op, alias_op  # noqa: F401

@@ -131,8 +131,8 @@ def _foreach_op(*arrays, body=None, sub=None, n_data=1, n_states=0,
     else:
         def scan_body(carry, xs):
             st, key = carry
-            key, sub = jax.random.split(key)
-            with _step_rng(sub):
+            key, subkey = jax.random.split(key)
+            with _step_rng(subkey):
                 outs, new_st = body(xs, st, capt)
             return (tuple(new_st), key), tuple(outs)
 
@@ -189,8 +189,8 @@ def _while_loop_op(*arrays, cond_fn=None, step_fn=None, sub=None,
     else:
         def tick(carry, _):
             (st, active), key = carry
-            key, sub = jax.random.split(key)
-            with _step_rng(sub):
+            key, subkey = jax.random.split(key)
+            with _step_rng(subkey):
                 # cond draws under the same per-tick scope as the body
                 # (consecutive splits), so stochastic conditions are fresh
                 # each tick too

@@ -1,0 +1,37 @@
+"""Ops created by the subgraph partitioner (``mx.subgraph``).
+
+Registered eagerly with the rest of the op library so partitioned graphs
+load and evaluate in a fresh process (``sym.load`` of a saved partitioned
+JSON must not depend on ``mx.subgraph`` having been imported).
+
+Reference: the fused node created by ``SubgraphProperty::CreateSubgraphNode``
+(src/operator/subgraph/subgraph_property.h) and the oneDNN FC+eltwise
+post-op fusion (src/operator/subgraph/mkldnn/mkldnn_fc_property.h).
+"""
+from __future__ import annotations
+
+from .registry import register_op
+
+
+@register_op("_subgraph_exec")
+def _subgraph_exec_op(*arrays, sub=None, n_outs=1, prop=None, **_):
+    """Evaluate an embedded subgraph spec (``sub`` wire format shared with
+    the control-flow ops). Differentiable end-to-end: the body is ordinary
+    traced jnp; XLA fuses it into the surrounding computation."""
+    from .. import symbol as S
+    res = S._eval_graph(S.Group(list(sub["roots"])),
+                        list(sub["arg_names"]), list(arrays))
+    res = [S._primary(r) for r in res] if isinstance(res, list) else [res]
+    return tuple(res) if int(n_outs) > 1 else res[0]
+
+
+@register_op("_sg_dense_act")
+def _sg_dense_act_op(data, weight, bias=None, num_hidden=None, no_bias=False,
+                     flatten=True, act_type="relu", **_):
+    """Fused Dense+activation (in-tree ``DENSE_ACT`` backend): one op node,
+    one jnp composition — XLA emits a single MXU matmul with the activation
+    fused into its epilogue."""
+    from . import nn as _nn
+    y = _nn.fully_connected(data, weight, bias, num_hidden=num_hidden,
+                            no_bias=no_bias, flatten=flatten)
+    return _nn.activation(y, act_type=act_type)

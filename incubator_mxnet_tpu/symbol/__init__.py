@@ -227,6 +227,13 @@ class Symbol:
         outs = out if isinstance(out, (list, tuple)) else [out]
         return [NDArray(o, ctx=ctx or current_context()) for o in outs]
 
+    def optimize_for(self, backend) -> "Symbol":
+        """Partition this graph with a registered subgraph backend
+        (reference: symbol.py optimize_for over SubgraphBackendRegistry).
+        Pure: returns the rewritten Symbol."""
+        from .. import subgraph as _subgraph
+        return _subgraph.partition(self, backend)
+
     def bind(self, ctx: Context, args, args_grad=None, grad_req: str = "write",
              aux_states=None, **kwargs) -> "Executor":
         return Executor(self, ctx, args, args_grad, grad_req)
@@ -336,6 +343,9 @@ def _bn_shapes(dshape, attrs):
 #: op -> (ordered param slot names, shape rule)
 _PARAM_OPS: Dict[str, tuple] = {
     "FullyConnected": (("weight", "bias"), _fc_shapes),
+    # the DENSE_ACT partitioner's fused node keeps FullyConnected's
+    # implicit weight/bias creation (mx.subgraph / ops/subgraph_ops.py)
+    "_sg_dense_act": (("weight", "bias"), _fc_shapes),
     "Convolution": (("weight", "bias"), _conv_shapes),
     "Embedding": (("weight",), _embed_shapes),
     "BatchNorm": (("gamma", "beta", "moving_mean", "moving_var"),

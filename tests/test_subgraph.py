@@ -1,0 +1,286 @@
+"""Subgraph-property registry + partitioning pass (reference:
+src/operator/subgraph/subgraph_property.h SubgraphProperty /
+SubgraphBackendRegistry, build_subgraph.cc, tests/python/unittest/
+test_subgraph_op.py — SURVEY §2.4 subgraph framework)."""
+import os
+
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import autograd, gluon, nd
+from incubator_mxnet_tpu.base import MXNetError
+
+
+def _ops_in(sym):
+    from incubator_mxnet_tpu import symbol as S
+    return [n._op for n in S._topo(sym) if n._op is not None]
+
+
+# ---------------------------------------------------------------------------
+# in-tree DENSE_ACT backend
+# ---------------------------------------------------------------------------
+
+def test_dense_act_partition_rewrites_and_matches_numerics():
+    S = mx.sym
+    x = S.Variable("x")
+    y = S.Activation(S.FullyConnected(x, num_hidden=8, name="fc"),
+                     act_type="tanh")
+    part = y.optimize_for("DENSE_ACT")
+    assert "_sg_dense_act" in _ops_in(part)
+    assert "FullyConnected" not in _ops_in(part)
+    # numerics identical to the unfused graph
+    rng = onp.random.RandomState(0)
+    kw = {"x": nd.array(rng.randn(4, 3).astype("float32")),
+          "fc_weight": nd.array(rng.randn(8, 3).astype("float32")),
+          "fc_bias": nd.array(rng.randn(8).astype("float32"))}
+    ref = y.eval(**kw)[0].asnumpy()
+    out = part.eval(**kw)[0].asnumpy()
+    onp.testing.assert_allclose(out, ref, rtol=1e-6)
+
+
+def test_dense_act_partitioned_executor_backward():
+    S = mx.sym
+    x = S.Variable("x")
+    y = S.Activation(S.FullyConnected(x, num_hidden=4, name="fc"),
+                     act_type="relu")
+    part = mx.sym.sum(y.optimize_for("DENSE_ACT"))
+    ref = mx.sym.sum(y)
+    rng = onp.random.RandomState(1)
+    vals = {"x": rng.randn(5, 3).astype("float32"),
+            "fc_weight": rng.randn(4, 3).astype("float32"),
+            "fc_bias": rng.randn(4).astype("float32")}
+
+    def grads(sym):
+        args = {k: nd.array(v) for k, v in vals.items()}
+        gargs = {k: nd.zeros(v.shape) for k, v in vals.items()}
+        ex = sym.bind(mx.cpu(), args, args_grad=gargs)
+        ex.forward(is_train=True)
+        ex.backward()
+        return {k: g.asnumpy() for k, g in gargs.items()}
+
+    g_part, g_ref = grads(part), grads(ref)
+    for k in vals:
+        onp.testing.assert_allclose(g_part[k], g_ref[k], rtol=1e-5,
+                                    err_msg=k)
+
+
+def test_partition_respects_multi_consumer_interior():
+    # fc output feeds BOTH the activation and a second consumer: the chain
+    # must NOT fuse (interior output escapes the region)
+    S = mx.sym
+    x = S.Variable("x")
+    fc = S.FullyConnected(x, num_hidden=4, name="fc")
+    y = S.Activation(fc, act_type="relu") + fc
+    part = y.optimize_for("DENSE_ACT")
+    ops = _ops_in(part)
+    assert "_sg_dense_act" not in ops
+    assert "FullyConnected" in ops
+
+
+def test_unknown_backend_raises():
+    S = mx.sym
+    x = S.Variable("x")
+    with pytest.raises(MXNetError, match="unknown subgraph backend"):
+        (x + 1.0).optimize_for("NOPE_BACKEND")
+
+
+# ---------------------------------------------------------------------------
+# third-party registration: toy external backend, no framework edits
+# ---------------------------------------------------------------------------
+
+def test_external_backend_with_default_subgraph_exec_rewrite():
+    backend_name = "TOY_ADD_RELU"
+
+    @mx.subgraph.register_property(backend_name)
+    class FuseAddRelu(mx.subgraph.SubgraphProperty):
+        op_names = ("broadcast_add", "Activation")
+
+    try:
+        S = mx.sym
+        a, b = S.Variable("a"), S.Variable("b")
+        y = S.Activation(a + b, act_type="relu")
+        part = y.optimize_for(backend_name)
+        ops = _ops_in(part)
+        assert "_subgraph_exec" in ops
+        assert "broadcast_add" not in ops
+
+        rng = onp.random.RandomState(2)
+        kw = {"a": nd.array(rng.randn(3, 4).astype("float32")),
+              "b": nd.array(rng.randn(3, 4).astype("float32"))}
+        onp.testing.assert_allclose(part.eval(**kw)[0].asnumpy(),
+                                    y.eval(**kw)[0].asnumpy(), rtol=1e-6)
+
+        # the opaque node serializes in the shared sub-attr wire format
+        back = mx.sym.load_json(part.tojson())
+        onp.testing.assert_allclose(back.eval(**kw)[0].asnumpy(),
+                                    part.eval(**kw)[0].asnumpy(), rtol=1e-6)
+    finally:
+        mx.subgraph._BACKENDS.pop(backend_name, None)
+
+
+def test_external_backend_custom_rewrite_and_veto():
+    backend_name = "TOY_SCALE"
+    calls = []
+
+    @mx.subgraph.register_property(backend_name)
+    class CollapseDoubleScale(mx.subgraph.SubgraphProperty):
+        # x * s1 * s2 -> x * (s1*s2); veto when the product is 1
+        op_names = ("_mul_scalar", "_mul_scalar")
+
+        def rewrite(self, region, inputs, externs):
+            from incubator_mxnet_tpu import symbol as S
+            s = float(region[0]._attrs["scalar"]) * \
+                float(region[1]._attrs["scalar"])
+            calls.append(s)
+            if s == 1.0:
+                return None  # veto: keep the original nodes
+            return S.Symbol("_mul_scalar", list(inputs),
+                            attrs={"scalar": s, "_scalar_rhs": True})
+
+    try:
+        S = mx.sym
+        x = S.Variable("x")
+        part = ((x * 2.0) * 3.0).optimize_for(backend_name)
+        assert _ops_in(part).count("_mul_scalar") == 1
+        v = nd.array(onp.ones((2, 2), "float32"))
+        onp.testing.assert_allclose(part.eval(x=v)[0].asnumpy(),
+                                    6.0 * onp.ones((2, 2)))
+
+        vetoed = ((x * 4.0) * 0.25).optimize_for(backend_name)
+        assert _ops_in(vetoed).count("_mul_scalar") == 2  # veto kept both
+        assert 1.0 in calls
+    finally:
+        mx.subgraph._BACKENDS.pop(backend_name, None)
+
+
+# ---------------------------------------------------------------------------
+# gluon integration
+# ---------------------------------------------------------------------------
+
+def test_gluon_optimize_for_property_backend():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(8, activation="relu"),
+            gluon.nn.Dense(3))
+    net.initialize()
+    x = nd.array(onp.random.RandomState(3).randn(4, 5).astype("float32"))
+    ref = net(x).asnumpy()
+
+    out = net.optimize_for(x, backend="DENSE_ACT")
+    onp.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-5)
+    part, _ = net._sg_graph
+    assert "_sg_dense_act" in _ops_in(part)
+    # subsequent (compiled) calls keep using the partitioned graph
+    onp.testing.assert_allclose(net(x).asnumpy(), ref, rtol=1e-5)
+
+
+def test_gluon_partitioned_training_step():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(8, activation="relu"), gluon.nn.Dense(1))
+    net.initialize()
+    rng = onp.random.RandomState(4)
+    x = nd.array(rng.randn(16, 4).astype("float32"))
+    yt = nd.array(rng.randn(16, 1).astype("float32"))
+    net.optimize_for(x, backend="DENSE_ACT")
+
+    loss_fn = gluon.loss.L2Loss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    losses = []
+    for _ in range(25):
+        with autograd.record():
+            l = loss_fn(net(x), yt)
+        l.backward()
+        trainer.step(16)
+        losses.append(float(l.mean().asnumpy()))
+    assert losses[-1] < losses[0] * 0.7, losses[::8]
+
+
+def test_partitioned_json_loads_in_fresh_process(tmp_path):
+    # the fused/opaque ops register with the op library eagerly, so a saved
+    # partitioned graph evaluates in a process that never imported
+    # mx.subgraph
+    S = mx.sym
+    x = S.Variable("x")
+    y = S.Activation(S.FullyConnected(x, num_hidden=4, name="fc"),
+                     act_type="relu")
+    part = y.optimize_for("DENSE_ACT")
+    p = tmp_path / "part.json"
+    part.save(str(p))
+    rng = onp.random.RandomState(5)
+    kw = {"x": rng.randn(2, 3).astype("float32"),
+          "fc_weight": rng.randn(4, 3).astype("float32"),
+          "fc_bias": rng.randn(4).astype("float32")}
+    ref = part.eval(**{k: nd.array(v) for k, v in kw.items()})[0].asnumpy()
+
+    import json
+    import subprocess
+    import sys
+    src = (
+        "import os;"
+        "os.environ['XLA_FLAGS']='--xla_force_host_platform_device_count=8';"
+        "import jax; jax.config.update('jax_platforms','cpu');"
+        "import sys, json; import numpy as onp;"
+        f"sys.path.insert(0, {repr(os.getcwd())});"
+        "import incubator_mxnet_tpu as mx;"
+        f"sym = mx.sym.load({repr(str(p))});"
+        f"kw = {{k: mx.nd.array(onp.asarray(v, 'float32')) for k, v in "
+        f"json.loads({repr(json.dumps({k: v.tolist() for k, v in kw.items()}))}).items()}};"
+        "print('RESULT', json.dumps(sym.eval(**kw)[0].asnumpy().tolist()))"
+    )
+    r = subprocess.run([sys.executable, "-c", src], capture_output=True,
+                       text=True, timeout=240)
+    assert r.returncode == 0, r.stderr[-800:]
+    line = [l for l in r.stdout.splitlines() if l.startswith("RESULT ")][0]
+    onp.testing.assert_allclose(
+        onp.array(json.loads(line[len("RESULT "):]), "float32"), ref,
+        rtol=1e-6)
+
+
+def test_gluon_optimize_for_revert_and_kwargs_guard():
+    net = gluon.nn.Dense(4, activation="relu")
+    net.initialize()
+    x = nd.array(onp.random.RandomState(6).randn(2, 3).astype("float32"))
+    ref = net(x).asnumpy()
+
+    with pytest.raises(MXNetError, match="takes no options"):
+        net.optimize_for(x, backend="DENSE_ACT", calib_data=[x])
+
+    net.optimize_for(x, backend="DENSE_ACT")
+    assert net._sg_graph is not None
+    # hybridize(False): back to the original eager forward
+    net.hybridize(False)
+    onp.testing.assert_allclose(net(x).asnumpy(), ref, rtol=1e-6)
+    # backend=None reverts the partitioning entirely
+    net.optimize_for(x, backend=None)
+    assert net._sg_graph is None
+    onp.testing.assert_allclose(net(x).asnumpy(), ref, rtol=1e-6)
+
+
+def test_gluon_block_backend_after_property_backend():
+    # a later block-rewrite backend (INT8) must clear the partitioned graph
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(8, activation="relu"), gluon.nn.Dense(3))
+    net.initialize()
+    x = nd.array(onp.random.RandomState(7).randn(4, 5).astype("float32"))
+    net.optimize_for(x, backend="DENSE_ACT")
+    out = net.optimize_for(x, backend="INT8", calib_data=[x])
+    assert net._sg_graph is None
+    assert out.shape == (4, 3)
+
+
+def test_gluon_property_backend_guards_training_dependent_blocks():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(8, activation="relu"),
+            gluon.nn.Dropout(0.5), gluon.nn.Dense(1))
+    net.initialize()
+    x = nd.ones((2, 3))
+    with pytest.raises(MXNetError, match="Dropout"):
+        net.optimize_for(x, backend="DENSE_ACT")
+
+
+def test_partition_rejects_non_backend():
+    from incubator_mxnet_tpu import subgraph as sg
+    S = mx.sym
+    with pytest.raises(MXNetError, match="backend name or SubgraphBackend"):
+        sg.partition(S.Variable("x") + 1.0, None)
