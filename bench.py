@@ -216,6 +216,87 @@ def run_ssd(watchdog) -> dict:
     }
 
 
+def _frcnn_gmacs(img: int, filters=(32, 64, 128), A: int = 9, R: int = 128,
+                 num_classes: int = 20, roi: int = 7, head: int = 128) -> float:
+    """Analytic fwd GMACs for the in-tree Faster-RCNN (models/rcnn.py):
+    one 3x3 conv per backbone scale, RPN conv + 1x1 heads, per-roi dense
+    head over the ROIAlign crop."""
+    macs = 0.0
+    cin, s = 3, img
+    for f in filters:
+        macs += 9 * cin * f * s * s
+        s //= 2
+        cin = f
+    f = filters[-1]
+    macs += 9 * f * f * s * s                      # rpn trunk conv
+    macs += f * (2 * A + 4 * A) * s * s            # rpn cls/reg 1x1
+    C1 = num_classes + 1
+    macs += R * (f * roi * roi * head + head * C1 + head * 4 * C1)
+    return macs / 1e9
+
+
+def run_frcnn(watchdog) -> dict:
+    """imgs/sec/chip on the Faster-RCNN training step (BASELINE.md row:
+    GluonCV train_faster_rcnn.py counterpart; BASELINE.json configs[4]
+    names Faster-RCNN alongside SSD). Whole two-stage step — backbone, RPN,
+    fixed-shape MultiProposal NMS scan, gt-append, ROIAlign, four-way
+    AnchorTarget/ProposalTarget loss, grads, SGD-momentum — compiled to one
+    XLA executable."""
+    import jax
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import models, parallel
+
+    B = int(os.environ.get("MXTPU_BENCH_BATCH", "8"))
+    img = int(os.environ.get("MXTPU_BENCH_IMG", "224"))
+    steps = int(os.environ.get("MXTPU_BENCH_STEPS", "20"))
+    classes = 20
+    R = 128
+    peak_tflops = _peak_tflops()
+
+    net = models.FasterRCNN(
+        num_classes=classes, scales=(4, 8, 16), ratios=(0.5, 1, 2),
+        feature_stride=8, rpn_pre_nms_top_n=1000, rpn_post_nms_top_n=R,
+        rpn_min_size=4, backbone_filters=(32, 64, 128), output_rpn=True)
+    net.initialize(mx.init.Xavier())
+    loss = models.FasterRCNNTargetLoss(
+        num_classes=classes, scales=(4, 8, 16), ratios=(0.5, 1, 2),
+        feature_stride=8)
+    mesh = parallel.make_mesh(devices=jax.devices()[:1])
+    trainer = parallel.ShardedTrainer(
+        net, lambda out, gt, info: loss(out[0], out[1], out[2], out[3],
+                                        out[4], gt, info),
+        "sgd", {"learning_rate": 0.01, "momentum": 0.9}, mesh=mesh,
+        n_labels=2)
+
+    rng = onp.random.RandomState(0)
+    x = rng.rand(B, 3, img, img).astype(onp.float32)
+    gt = onp.full((B, 4, 5), -1.0, onp.float32)     # up to 4 boxes, padded
+    for b in range(B):
+        for m in range(rng.randint(1, 5)):
+            w, h = rng.randint(img // 4, img // 2 + 1, 2)
+            x0 = rng.randint(0, img - w)
+            y0 = rng.randint(0, img - h)
+            gt[b, m] = [rng.randint(0, classes), x0, y0,
+                        x0 + w - 1, y0 + h - 1]
+    info = onp.tile([img, img, 1.0], (B, 1)).astype(onp.float32)
+    dt, lval = _measure(trainer, (x, info, gt, gt, info), steps, watchdog)
+
+    imgs_per_sec = B / dt
+    gmacs = _frcnn_gmacs(img, A=9, R=R + gt.shape[1], num_classes=classes)
+    flops = 3.0 * 2.0 * gmacs * 1e9 * B
+    mfu = (flops / dt) / (peak_tflops * 1e12)
+    return {
+        "metric": "frcnn_train_imgs_per_sec_per_chip",
+        "value": round(imgs_per_sec, 2),
+        "unit": "imgs/sec/chip",
+        "vs_baseline": round(mfu / 0.40, 4),
+        "extra": {"step_ms": round(dt * 1e3, 2), "mfu": round(mfu, 4),
+                  "batch": B, "img": img, "rois": R,
+                  "backend": jax.default_backend(),
+                  "loss": float(lval.asnumpy())},
+    }
+
+
 def main() -> None:
     watchdog = _arm_watchdog()
     workload = os.environ.get("MXTPU_BENCH_WORKLOAD", "bert")
@@ -224,6 +305,9 @@ def main() -> None:
         return
     if workload == "ssd":
         print(json.dumps(run_ssd(watchdog)))
+        return
+    if workload == "frcnn":
+        print(json.dumps(run_frcnn(watchdog)))
         return
     import jax
     import incubator_mxnet_tpu as mx

@@ -15,7 +15,7 @@ from .nmt import NMTModel, beam_search  # noqa: F401
 from . import ssd  # noqa: F401
 from .ssd import SSD, SSDTargetLoss  # noqa: F401
 from . import rcnn  # noqa: F401
-from .rcnn import FasterRCNN, RPN  # noqa: F401
+from .rcnn import FasterRCNN, RPN, FasterRCNNTargetLoss  # noqa: F401
 from .transformer import (  # noqa: F401
     MultiHeadAttention, PositionwiseFFN, TransformerEncoderCell,
     StackedTransformerEncoder,

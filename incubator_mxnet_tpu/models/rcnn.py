@@ -15,7 +15,7 @@ from typing import Sequence, Tuple
 from ..gluon.block import HybridBlock
 from ..gluon import nn
 
-__all__ = ["FasterRCNN", "RPN"]
+__all__ = ["FasterRCNN", "RPN", "FasterRCNNTargetLoss"]
 
 
 class _Backbone(HybridBlock):
@@ -81,8 +81,10 @@ class FasterRCNN(HybridBlock):
                  rpn_post_nms_top_n: int = 16,
                  rpn_min_size: int = 2,
                  roi_size: Tuple[int, int] = (7, 7),
-                 backbone_filters: Sequence[int] = (16, 32, 64), **kw):
+                 backbone_filters: Sequence[int] = (16, 32, 64),
+                 output_rpn: bool = False, **kw):
         super().__init__(**kw)
+        self._output_rpn = output_rpn
         self._num_classes = num_classes
         self._scales, self._ratios = tuple(scales), tuple(ratios)
         self._stride = feature_stride
@@ -100,7 +102,7 @@ class FasterRCNN(HybridBlock):
             self.bbox_pred = nn.Dense(4 * (num_classes + 1),
                                       prefix="bbox_pred_", flatten=False)
 
-    def hybrid_forward(self, F, x, im_info):
+    def hybrid_forward(self, F, x, im_info, gt=None):
         feat = self.backbone(x)
         rpn_cls, rpn_reg = self.rpn(feat)
         rois = F.MultiProposal(
@@ -108,14 +110,155 @@ class FasterRCNN(HybridBlock):
             rpn_pre_nms_top_n=self._pre, rpn_post_nms_top_n=self._post,
             rpn_min_size=self._min_size, scales=self._scales,
             ratios=self._ratios, feature_stride=self._stride)
+        # proposals are training CONSTANTS for the head (reference: the
+        # Proposal op registers no gradient) — without this, box gradients
+        # would leak into the RPN through roi coordinates
+        rois = F.BlockGrad(rois)
+        B = x.shape[0]
+        R = self._post
+        if gt is not None:
+            # training: append the gt boxes to the roi set so the head
+            # always sees perfect positives (reference proposal_target.py
+            # vstacks gt_boxes onto the sampled rois) — padding gt rows
+            # (cls -1) become zero-area rois at the origin, matched as
+            # background like the RPN's NMS padding
+            M = gt.shape[1]
+            valid = F.broadcast_greater_equal(
+                F.slice_axis(gt, axis=2, begin=0, end=1),
+                F.zeros_like(F.slice_axis(gt, axis=2, begin=0, end=1)))
+            gt_boxes = F.slice_axis(gt, axis=2, begin=1, end=5) * valid
+            rois = F.reshape(rois, (B, R, 5))
+            batch_col = F.slice_axis(rois, axis=2, begin=0,
+                                     end=1)               # (B, R, 1)
+            gt_bidx = F.slice_axis(batch_col, axis=1, begin=0,
+                                   end=1)                 # (B, 1, 1)
+            gt_bidx = F.broadcast_axis(gt_bidx, axis=1, size=M)
+            gt_rois = F.concat(gt_bidx, gt_boxes, dim=2)  # (B, M, 5)
+            rois = F.reshape(F.concat(rois, gt_rois, dim=1),
+                             (B * (R + M), 5))
+            R = R + M
         pooled = F.ROIAlign(feat, rois, pooled_size=self._roi_size,
                             spatial_scale=1.0 / self._stride,
                             sample_ratio=2)                 # (B·R, C, PH, PW)
-        B = x.shape[0]
-        R = self._post
         flat = pooled.reshape((B * R, -1))
         h = self.head_dense(flat)
         cls = F.softmax(self.cls_score(h), axis=-1).reshape(
             (B, R, self._num_classes + 1))
         box = self.bbox_pred(h).reshape((B, R, 4 * (self._num_classes + 1)))
+        if self._output_rpn:
+            # training mode (reference returns the rpn raw outputs group
+            # for the AnchorTarget losses)
+            return cls, box, rois, rpn_cls, rpn_reg
         return cls, box, rois
+
+    def detect(self, x, im_info, threshold=0.05, nms_threshold=0.3,
+               nms_topk=-1):
+        """Full inference: forward + per-class decode + NMS → (B, R·C, 6)
+        ``[class_id, score, x1, y1, x2, y2]`` in pixels, -1 rows invalid
+        (reference: the test-time decode of GluonCV faster_rcnn over the
+        class-specific ``bbox_pred`` slots)."""
+        from .. import autograd
+        import jax.numpy as jnp
+        from ..ndarray import NDArray
+        from ..ops.detection import _bbox_pred, _clip_boxes, box_nms
+
+        with autograd.predict_mode():
+            out = self(x, im_info)
+        cls, box, rois = out[0], out[1], out[2]
+        B, R = x.shape[0], self._post
+        C = self._num_classes
+        probs = cls._data                                  # (B, R, C+1)
+        deltas = box._data.reshape(B, R, C + 1, 4)[:, :, 1:, :]
+        roib = rois._data.reshape(B, R, 5)[..., 1:5]
+        info = im_info._data
+
+        # one batched decode + one batched NMS (box_nms vmaps leading dims)
+        anchors = jnp.broadcast_to(roib[:, :, None, :],
+                                   (B, R, C, 4)).reshape(-1, 4)
+        boxes = _bbox_pred(anchors, deltas.reshape(-1, 4)).reshape(B, R, C, 4)
+        boxes = _clip_boxes(boxes, info[:, None, None, 0],
+                            info[:, None, None, 1])
+        ids = jnp.broadcast_to(
+            jnp.arange(C, dtype=boxes.dtype)[None, None, :, None],
+            (B, R, C, 1))
+        rows = jnp.concatenate(
+            [ids, probs[:, :, 1:, None], boxes], axis=-1)  # (B, R, C, 6)
+        rows = rows.reshape(B, R * C, 6)
+        dets = box_nms(rows, overlap_thresh=nms_threshold,
+                       valid_thresh=threshold, topk=nms_topk,
+                       coord_start=2, score_index=1, id_index=0,
+                       force_suppress=False)
+        return NDArray(dets, ctx=x.context)
+
+
+class FasterRCNNTargetLoss(HybridBlock):
+    """Two-stage training objective (reference: the RPN cls/box +
+    RCNN cls/box loss group of GluonCV train_faster_rcnn.py, built on the
+    AnchorTarget/ProposalTarget stages — ops/detection.py
+    ``rpn_target``/``proposal_target``).
+
+    ``forward(cls, box, rois, rpn_cls, rpn_reg, gt, im_info)`` with the
+    net's 5-output training mode (``output_rpn=True``); ``gt (B, M, 5)``
+    ``[cls, x1, y1, x2, y2]`` in PIXEL coords, -1 padded. Returns the
+    scalar sum of the four normalized losses."""
+
+    def __init__(self, num_classes: int,
+                 scales=(2, 4), ratios=(0.5, 1, 2), feature_stride=8,
+                 rpn_fg_overlap=0.7, rpn_bg_overlap=0.3, head_fg_overlap=0.5,
+                 **kw):
+        super().__init__(**kw)
+        self._num_classes = num_classes
+        self._scales, self._ratios = tuple(scales), tuple(ratios)
+        self._stride = feature_stride
+        self._rpn_fg, self._rpn_bg = rpn_fg_overlap, rpn_bg_overlap
+        self._head_fg = head_fg_overlap
+
+    def hybrid_forward(self, F, cls, box, rois, rpn_cls, rpn_reg, gt,
+                       im_info):
+        eps = 1e-8
+        B, A2 = rpn_cls.shape[0], rpn_cls.shape[1]
+        A = A2 // 2
+        # ---- RPN stage (AnchorTarget) ----------------------------------
+        lbl, rpn_t, rpn_m = F.rpn_target(
+            rpn_cls, gt, im_info, feature_stride=self._stride,
+            scales=self._scales, ratios=self._ratios,
+            fg_overlap=self._rpn_fg, bg_overlap=self._rpn_bg)
+        # probabilities in MultiProposal's (h, w, a) flat order
+        p_bg = F.reshape(F.transpose(
+            F.slice_axis(rpn_cls, axis=1, begin=0, end=A),
+            axes=(0, 2, 3, 1)), (B, -1))
+        p_fg = F.reshape(F.transpose(
+            F.slice_axis(rpn_cls, axis=1, begin=A, end=2 * A),
+            axes=(0, 2, 3, 1)), (B, -1))
+        is_fg = F.equal(lbl, F.ones_like(lbl))
+        is_bg = F.equal(lbl, F.zeros_like(lbl))
+        rpn_cls_loss = -(is_fg * F.log(p_fg + eps)
+                         + is_bg * F.log(p_bg + eps))
+        n_lbl = F.sum(is_fg) + F.sum(is_bg) + 1.0
+        rpn_cls_loss = F.sum(rpn_cls_loss) / n_lbl
+        # deltas in the same flat order (B, HWA, 4)
+        d = F.transpose(
+            F.reshape(rpn_reg,
+                      (B, A, 4, rpn_reg.shape[2], rpn_reg.shape[3])),
+            axes=(0, 3, 4, 1, 2))
+        d = F.reshape(d, (B, -1, 4))
+        n_fg = F.sum(is_fg) + 1.0
+        rpn_box_loss = F.sum(F.smooth_l1((d - rpn_t) * rpn_m,
+                                         scalar=3.0)) / n_fg
+        # ---- RCNN head stage (ProposalTarget) --------------------------
+        cls_t, box_t, box_m = F.proposal_target(
+            rois, gt, num_classes=self._num_classes,
+            fg_overlap=self._head_fg)
+        head_ce = -F.log(F.pick(cls, cls_t, axis=-1) + eps)  # (B, R)
+        # class-balanced CE: background rois dominate the fixed-shape roi
+        # set ~R:1, so fg and bg terms normalize separately (the reference
+        # reaches the same balance by sampling rois at a 1:3 fg:bg ratio)
+        head_is_fg = F.greater(cls_t, F.zeros_like(cls_t))
+        head_is_bg = F.ones_like(head_is_fg) - head_is_fg
+        n_head_fg = F.sum(head_is_fg) + 1.0
+        n_head_bg = F.sum(head_is_bg) + 1.0
+        head_cls_loss = F.sum(head_ce * head_is_fg) / n_head_fg \
+            + F.sum(head_ce * head_is_bg) / n_head_bg
+        head_box_loss = F.sum(F.smooth_l1((box - box_t) * box_m,
+                                          scalar=1.0)) / n_head_fg
+        return rpn_cls_loss + rpn_box_loss + head_cls_loss + head_box_loss

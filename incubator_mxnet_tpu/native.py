@@ -82,6 +82,15 @@ def _lib() -> ctypes.CDLL:
         lib.MXTPURecordIOIndexBuild.restype = ctypes.c_int64
         lib.MXTPURecordIOIndexBuild.argtypes = [
             ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64), ctypes.c_int64]
+        lib.MXTPUIm2RecCreate.restype = ctypes.c_void_p
+        lib.MXTPUIm2RecCreate.argtypes = [ctypes.c_char_p]
+        lib.MXTPUIm2RecWrite.restype = ctypes.c_int
+        lib.MXTPUIm2RecWrite.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.POINTER(ctypes.c_float),
+            ctypes.c_uint32, ctypes.c_int, ctypes.c_uint64, ctypes.c_uint64,
+            ctypes.c_char_p, ctypes.c_uint64]
+        lib.MXTPUIm2RecClose.restype = ctypes.c_int
+        lib.MXTPUIm2RecClose.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         lib.MXTPUShmCreate.restype = ctypes.c_void_p
         lib.MXTPUShmCreate.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
         lib.MXTPUShmAttach.restype = ctypes.c_void_p
@@ -162,6 +171,42 @@ class NativeRecordWriter:
         if self._h:
             _lib().MXTPURecordIOWriterFree(self._h)
             self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class NativeIm2RecWriter:
+    """C++ im2rec packer hot loop (reference: tools/im2rec.cc): per record,
+    IRHeader pack + dmlc framing + index entry happen in one native call;
+    close() writes the ``.idx`` sidecar. Byte-identical to the Python
+    ``recordio.pack`` + ``MXIndexedRecordIO`` path."""
+
+    def __init__(self, rec_path: str, idx_path: str):
+        self._idx_path = idx_path
+        self._h = _lib().MXTPUIm2RecCreate(rec_path.encode())
+        if not self._h:
+            raise MXNetError(last_error())
+
+    def write(self, key: int, label, id_: int, payload: bytes,
+              id2: int = 0) -> None:
+        multi = isinstance(label, (list, tuple))
+        labels = list(label) if multi else [label]
+        arr = (ctypes.c_float * len(labels))(*[float(x) for x in labels])
+        if _lib().MXTPUIm2RecWrite(self._h, key, arr, len(labels),
+                                   int(multi), id_, id2,
+                                   payload, len(payload)) != 0:
+            raise MXNetError(last_error())
+
+    def close(self):
+        if self._h:
+            rc = _lib().MXTPUIm2RecClose(self._h, self._idx_path.encode())
+            self._h = None
+            if rc != 0:
+                raise MXNetError(last_error())
 
     def __del__(self):
         try:

@@ -454,18 +454,23 @@ def _bbox_pred(anchors, deltas, iou_loss=False):
                       pcx + 0.5 * (pw - 1.0), pcy + 0.5 * (ph - 1.0)], axis=1)
 
 
+def _clip_boxes(boxes, imh, imw):
+    """Clamp corner boxes (..., 4) to the image extent (reference:
+    BBoxTransformInv's clip step)."""
+    return jnp.stack([
+        jnp.clip(boxes[..., 0], 0.0, imw - 1.0),
+        jnp.clip(boxes[..., 1], 0.0, imh - 1.0),
+        jnp.clip(boxes[..., 2], 0.0, imw - 1.0),
+        jnp.clip(boxes[..., 3], 0.0, imh - 1.0)], axis=-1)
+
+
 def _proposal_one(fg, deltas, iminfo, anchors, pre, post, thresh,
                   min_size, iou_loss):
     """One sample's RPN → rois. All shapes static: top-k to ``pre``, greedy
     NMS emitting exactly ``post`` slots (padded with zeros when exhausted).
     """
     imh, imw, imscale = iminfo[0], iminfo[1], iminfo[2]
-    boxes = _bbox_pred(anchors, deltas, iou_loss)
-    boxes = jnp.stack([
-        jnp.clip(boxes[:, 0], 0.0, imw - 1.0),
-        jnp.clip(boxes[:, 1], 0.0, imh - 1.0),
-        jnp.clip(boxes[:, 2], 0.0, imw - 1.0),
-        jnp.clip(boxes[:, 3], 0.0, imh - 1.0)], axis=1)
+    boxes = _clip_boxes(_bbox_pred(anchors, deltas, iou_loss), imh, imw)
     ws = boxes[:, 2] - boxes[:, 0] + 1.0
     hs = boxes[:, 3] - boxes[:, 1] + 1.0
     ms = min_size * imscale
@@ -639,3 +644,110 @@ def psroi_pooling(data, rois, output_dim, pooled_size, spatial_scale=1.0,
             f"{output_dim * ps[0] * ps[1]} channels, got {C}")
     return roi_align(data, rois, pooled_size=ps, spatial_scale=spatial_scale,
                      sample_ratio=2, position_sensitive=True)
+
+
+def _encode_boxes(ref_boxes, gt):
+    """Regression targets that invert :func:`_bbox_pred` exactly (the +1
+    pixel convention) — decode of the encode reproduces the matched gt."""
+    ws = ref_boxes[:, 2] - ref_boxes[:, 0] + 1.0
+    hs = ref_boxes[:, 3] - ref_boxes[:, 1] + 1.0
+    cx = ref_boxes[:, 0] + 0.5 * (ws - 1.0)
+    cy = ref_boxes[:, 1] + 0.5 * (hs - 1.0)
+    gw = gt[:, 2] - gt[:, 0] + 1.0
+    gh = gt[:, 3] - gt[:, 1] + 1.0
+    gcx = gt[:, 0] + 0.5 * (gw - 1.0)
+    gcy = gt[:, 1] + 0.5 * (gh - 1.0)
+    ws = jnp.clip(ws, 1.0)
+    hs = jnp.clip(hs, 1.0)
+    return jnp.stack([(gcx - cx) / ws, (gcy - cy) / hs,
+                      jnp.log(jnp.clip(gw, 1.0) / ws),
+                      jnp.log(jnp.clip(gh, 1.0) / hs)], axis=-1)
+
+
+@register_op(aliases=("_contrib_rpn_target", "AnchorTarget"))
+def rpn_target(cls_prob, gt_boxes, im_info, feature_stride=16,
+               scales=(4, 8, 16, 32), ratios=(0.5, 1, 2),
+               fg_overlap=0.7, bg_overlap=0.3, **_):
+    """RPN anchor targets (reference: the AnchorTarget stage of
+    GluonCV faster_rcnn / incubator-mxnet example/rcnn rpn.anchor_target;
+    SURVEY §2.9 Faster-RCNN row).
+
+    ``cls_prob (B, 2A, H, W)`` supplies the feature shape (anchors are
+    re-derived with the same attrs MultiProposal uses); ``gt_boxes
+    (B, M, 5)`` is ``[cls, x1, y1, x2, y2]`` in PIXEL coords with -1
+    padding; ``im_info (B, 3)``. Returns ``(labels (B, HWA) in
+    {1 fg, 0 bg, -1 ignore}, bbox_targets (B, HWA, 4), bbox_mask
+    (B, HWA, 4))`` in the (h, w, a) anchor enumeration MultiProposal
+    flattens to. No fg/bg subsampling (the reference's 256-anchor batch
+    sampling is a GPU-memory concession; the full fixed-shape loss is
+    cheaper on TPU than a gather), so the loss should mean over non-ignored
+    anchors."""
+    B, A2, H, W = cls_prob.shape
+    anchors = jnp.asarray(_shifted_anchors(
+        H, W, feature_stride, _base_anchors(feature_stride, scales, ratios)))
+    N = anchors.shape[0]
+
+    def one(gt, info):
+        valid = gt[:, 0] >= 0
+        boxes = gt[:, 1:5]
+        iou = _corner_iou(anchors, boxes)                 # (N, M)
+        iou = jnp.where(valid[None, :], iou, 0.0)
+        best_gt = jnp.argmax(iou, axis=1)
+        best_iou = jnp.max(iou, axis=1)
+        inside = (anchors[:, 0] >= 0.0) & (anchors[:, 1] >= 0.0) & \
+                 (anchors[:, 2] <= info[1] - 1.0) & \
+                 (anchors[:, 3] <= info[0] - 1.0)
+        # forced per-gt best anchor over INSIDE anchors only (the reference
+        # computes anchor targets on the inside subset): a border gt whose
+        # global argmax anchor straddles the image must still force-match
+        # its best inside anchor, or it contributes no RPN gradient at all
+        iou_in = jnp.where(inside[:, None], iou, -1.0)
+        best_anchor = jnp.argmax(iou_in, axis=0)          # (M,)
+        has_inside = jnp.max(iou_in, axis=0) > 0.0
+        forced = jnp.zeros(N, bool).at[best_anchor].max(valid & has_inside)
+        fg = (forced | (best_iou >= fg_overlap)) & inside
+        bg = (best_iou < bg_overlap) & inside & ~fg
+        labels = jnp.where(fg, 1.0, jnp.where(bg, 0.0, -1.0))
+        t = _encode_boxes(anchors, boxes[best_gt])
+        mask = jnp.broadcast_to(fg[:, None], (N, 4)).astype(cls_prob.dtype)
+        return labels.astype(cls_prob.dtype), t * mask, mask
+
+    lbl, t, m = jax.vmap(one)(gt_boxes, im_info)
+    return lbl, t.astype(cls_prob.dtype), m
+
+
+@register_op(aliases=("_contrib_proposal_target", "ProposalTarget"))
+def proposal_target(rois, gt_boxes, num_classes=None, fg_overlap=0.5, **_):
+    """ROI head targets (reference: the ProposalTarget stage of GluonCV
+    faster_rcnn / example/rcnn rcnn.proposal_target). No roi subsampling —
+    the TPU pipeline's roi count is already static and small, so every roi
+    gets a target (the reference samples 128 of ~2000 to bound GPU memory).
+
+    ``rois (B*R, 5)`` ``[batch_idx, x1, y1, x2, y2]`` pixels; ``gt_boxes
+    (B, M, 5)`` pixels, -1 padded. Returns ``(cls_target (B, R) in
+    {0..num_classes}, box_target (B, R, 4*(C+1)), box_mask
+    (B, R, 4*(C+1)))`` with class-specific regression slots: only the
+    matched class's 4 slots are live; encode inverts _bbox_pred."""
+    B = gt_boxes.shape[0]
+    R = rois.shape[0] // B
+    C1 = int(num_classes) + 1
+    roi_boxes = rois.reshape(B, R, 5)[..., 1:5]
+
+    def one(rb, gt):
+        valid = gt[:, 0] >= 0
+        boxes = gt[:, 1:5]
+        iou = _corner_iou(rb, boxes)                      # (R, M)
+        iou = jnp.where(valid[None, :], iou, 0.0)
+        best = jnp.argmax(iou, axis=1)
+        best_iou = jnp.max(iou, axis=1)
+        fg = best_iou >= fg_overlap
+        cls = jnp.where(fg, gt[best, 0] + 1.0, 0.0)
+        t4 = _encode_boxes(rb, boxes[best])               # (R, 4)
+        onehot = jax.nn.one_hot(cls.astype(jnp.int32), C1)
+        mask = (onehot * fg[:, None]).astype(rois.dtype)  # (R, C1)
+        t = (onehot[:, :, None] * t4[:, None, :]).reshape(R, 4 * C1)
+        mask4 = jnp.repeat(mask, 4, axis=-1).reshape(R, 4 * C1)
+        return cls.astype(rois.dtype), t * mask4, mask4
+
+    cls_t, box_t, box_m = jax.vmap(one)(roi_boxes, gt_boxes)
+    return cls_t, box_t.astype(rois.dtype), box_m

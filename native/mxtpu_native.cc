@@ -251,6 +251,84 @@ MXTPU_API int64_t MXTPURecordIOIndexBuild(const char* path,
 }
 
 // ---------------------------------------------------------------------------
+// im2rec packer hot loop (reference: tools/im2rec.cc). Image ENCODE stays
+// host-side (cv2) — this owns everything after it per record: IRHeader
+// (<IfQQ) + optional multi-label prefix, dmlc frame write, and the .idx
+// index, matching recordio.py pack()/MXIndexedRecordIO byte for byte.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct Im2RecWriter {
+  void* rec = nullptr;  // RecordWriter handle
+  std::vector<std::pair<uint64_t, uint64_t>> index;  // (key, pos)
+  std::vector<char> scratch;
+};
+
+}  // namespace
+
+MXTPU_API void* MXTPUIm2RecCreate(const char* rec_path) {
+  void* rec = MXTPURecordIOWriterCreate(rec_path);
+  if (!rec) return nullptr;
+  auto* w = new Im2RecWriter();
+  w->rec = rec;
+  return w;
+}
+
+MXTPU_API int MXTPUIm2RecWrite(void* handle, uint64_t key,
+                               const float* labels, uint32_t n_labels,
+                               int multi, uint64_t id, uint64_t id2,
+                               const char* payload, uint64_t size) {
+  auto* w = static_cast<Im2RecWriter*>(handle);
+  // IRHeader: flag(u32) label(f32) id(u64) id2(u64), little-endian packed
+  // (x86/TPU hosts are LE; struct layout matches "<IfQQ" with no padding
+  // because we serialize field by field). `multi` mirrors recordio.pack():
+  // a LIST label — even of one element — takes the prepended-floats form.
+  uint32_t flag = multi ? n_labels : 0u;
+  float label = multi ? 0.0f : labels[0];
+  uint64_t extra = multi ? 4ull * n_labels : 0;
+  w->scratch.clear();
+  w->scratch.reserve(24 + extra + size);
+  auto put = [&](const void* p, size_t n) {
+    const char* c = static_cast<const char*>(p);
+    w->scratch.insert(w->scratch.end(), c, c + n);
+  };
+  put(&flag, 4);
+  put(&label, 4);
+  put(&id, 8);
+  put(&id2, 8);
+  if (multi) put(labels, 4ull * n_labels);
+  put(payload, size);
+  uint64_t pos = 0;
+  int rc = MXTPURecordIOWriterWrite(w->rec, w->scratch.data(),
+                                    w->scratch.size(), &pos);
+  if (rc != 0) return rc;
+  w->index.emplace_back(key, pos);
+  return 0;
+}
+
+MXTPU_API int MXTPUIm2RecClose(void* handle, const char* idx_path) {
+  auto* w = static_cast<Im2RecWriter*>(handle);
+  int rc = 0;
+  if (idx_path) {
+    FILE* fp = std::fopen(idx_path, "w");
+    if (!fp) {
+      SetError(std::string("cannot open for write: ") + idx_path);
+      rc = -1;
+    } else {
+      for (const auto& kv : w->index)
+        std::fprintf(fp, "%llu\t%llu\n",
+                     static_cast<unsigned long long>(kv.first),
+                     static_cast<unsigned long long>(kv.second));
+      std::fclose(fp);
+    }
+  }
+  MXTPURecordIOWriterFree(w->rec);
+  delete w;
+  return rc;
+}
+
+// ---------------------------------------------------------------------------
 // dmlc .params container (NDArray::Save/Load parity, src/ndarray/ndarray.cc
 // behind MXNDArraySave/MXNDArrayLoad). V2 dense records; the exotic legacy
 // layouts (V1 / pre-magic) stay on the Python fallback reader.

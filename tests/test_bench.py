@@ -48,3 +48,12 @@ def test_bench_ssd_contract(monkeypatch, capsys):
                      MXTPU_BENCH_STEPS="2")
     assert rec["unit"] == "imgs/sec/chip" and rec["value"] > 0
     assert math.isfinite(rec["extra"]["loss"])
+
+
+def test_bench_frcnn_contract(monkeypatch, capsys):
+    import math
+    rec = _run_bench(monkeypatch, capsys, MXTPU_BENCH_WORKLOAD="frcnn",
+                     MXTPU_BENCH_BATCH="2", MXTPU_BENCH_IMG="64",
+                     MXTPU_BENCH_STEPS="2")
+    assert rec["unit"] == "imgs/sec/chip" and rec["value"] > 0
+    assert math.isfinite(rec["extra"]["loss"])

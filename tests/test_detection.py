@@ -386,3 +386,74 @@ def test_faster_rcnn_smoke():
     cls2, box2, rois2 = net(x, info)
     onp.testing.assert_allclose(cls2.asnumpy(), c, rtol=1e-5, atol=1e-6)
     onp.testing.assert_allclose(rois2.asnumpy(), r, rtol=1e-5, atol=1e-5)
+
+
+def test_rpn_target_matches_and_encodes():
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu.ops.detection import (
+        _base_anchors, _shifted_anchors, _bbox_pred)
+    import jax.numpy as jnp
+    B, A, H, W, stride = 1, 9, 6, 6, 8
+    cls_prob = mx.nd.ones((B, 2 * A, H, W))
+    # one gt box matching anchor scale 4 (32px) at a grid position
+    gt = onp.full((B, 2, 5), -1.0, "float32")
+    gt[0, 0] = [1, 8, 8, 39, 39]   # 32x32 box
+    info = mx.nd.array(onp.array([[48, 48, 1.0]], "float32"))
+    lbl, t, m = mx.nd.rpn_target(cls_prob, mx.nd.array(gt), info,
+                                 feature_stride=stride, scales=(2, 4),
+                                 ratios=(1.0,), fg_overlap=0.5,
+                                 bg_overlap=0.3)
+    lblv = lbl.asnumpy()[0]
+    assert (lblv == 1).sum() >= 1          # at least the forced match
+    assert (lblv == 0).sum() > 0           # background exists
+    # decode of the encode reproduces the gt box for every fg anchor
+    anchors = _shifted_anchors(H, W, stride, _base_anchors(stride, (2, 4),
+                                                           (1.0,)))
+    fg_idx = onp.where(lblv == 1)[0]
+    dec = onp.asarray(_bbox_pred(jnp.asarray(anchors[fg_idx]),
+                                 jnp.asarray(t.asnumpy()[0][fg_idx])))
+    onp.testing.assert_allclose(dec, onp.tile(gt[0, 0, 1:5], (len(fg_idx), 1)),
+                                atol=1e-3)
+    # mask marks exactly the fg rows
+    mv = m.asnumpy()[0]
+    assert (mv[fg_idx] == 1).all()
+    assert (mv[lblv != 1] == 0).all()
+
+
+def test_proposal_target_class_slots_and_encode():
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu.ops.detection import _bbox_pred
+    import jax.numpy as jnp
+    C = 3
+    # 2 rois: one right on the gt (fg), one far away (bg)
+    rois = onp.array([[0, 10, 10, 29, 29],
+                      [0, 40, 40, 47, 47]], "float32")
+    gt = onp.full((1, 2, 5), -1.0, "float32")
+    gt[0, 0] = [2, 10, 10, 29, 29]
+    cls_t, box_t, box_m = mx.nd.proposal_target(
+        mx.nd.array(rois), mx.nd.array(gt), num_classes=C, fg_overlap=0.5)
+    cv = cls_t.asnumpy()[0]
+    assert cv[0] == 3.0 and cv[1] == 0.0   # gt class 2 -> target 3; bg 0
+    mv = box_m.asnumpy()[0]
+    # only the matched class's 4 slots are live, class-major layout
+    assert mv[0, 4 * 3:4 * 4].sum() == 4 and mv[0].sum() == 4
+    assert mv[1].sum() == 0
+    # decode of the live slot reproduces the gt box
+    tv = box_t.asnumpy()[0, 0, 4 * 3:4 * 4]
+    dec = onp.asarray(_bbox_pred(jnp.asarray(rois[None, 0, 1:5]),
+                                 jnp.asarray(tv[None])))
+    onp.testing.assert_allclose(dec[0], gt[0, 0, 1:5], atol=1e-3)
+
+
+def test_rpn_target_border_gt_gets_forced_inside_match():
+    import incubator_mxnet_tpu as mx
+    # gt in the image corner: its global-argmax anchor straddles the
+    # border; the forced match must land on the best INSIDE anchor
+    gt = onp.full((1, 1, 5), -1.0, "float32")
+    gt[0, 0] = [0, 0, 0, 31, 31]
+    info = mx.nd.array(onp.array([[48, 48, 1.0]], "float32"))
+    lbl, t, m = mx.nd.rpn_target(mx.nd.ones((1, 8, 6, 6)), mx.nd.array(gt),
+                                 info, feature_stride=8, scales=(2, 4),
+                                 ratios=(0.5, 1.0), fg_overlap=0.7,
+                                 bg_overlap=0.3)
+    assert (lbl.asnumpy()[0] == 1).sum() >= 1

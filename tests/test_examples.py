@@ -65,3 +65,11 @@ def test_train_ssd_example_detects():
     # NMS decode; the mAP proxy is top-detection (class, IoU>0.5) hit rate
     acc = _load("train_ssd.py").main(["--steps", "150"])
     assert acc > 0.8, acc
+
+
+def test_train_frcnn_example_detects():
+    # end-to-end Faster-RCNN recipe: RPN anchors -> MultiProposal ->
+    # AnchorTarget/ProposalTarget -> 4-way loss -> per-class decode+NMS;
+    # same mAP proxy as the SSD gate
+    acc = _load("train_frcnn.py").main(["--steps", "300"])
+    assert acc > 0.8, acc

@@ -76,3 +76,51 @@ def test_train_val_split(tmp_path):
     n_train = len(list(read_list(files[0])))
     n_val = len(list(read_list(files[1])))
     assert n_train == 2 and n_val == 2
+
+
+def test_native_packer_byte_identical(tmp_path):
+    """The C++ im2rec hot loop (reference: tools/im2rec.cc) must produce
+    byte-identical .rec and .idx files to the Python packer."""
+    from incubator_mxnet_tpu import native
+    if not native.available():
+        pytest.skip("native shim unavailable")
+    root = str(tmp_path / "data")
+    os.makedirs(root)
+    _make_tree(root)
+    py_prefix = str(tmp_path / "py")
+    nat_prefix = str(tmp_path / "nat")
+    make_list(py_prefix, root)
+    make_list(nat_prefix, root)
+    py_rec, py_idx = make_record(py_prefix, root, img_fmt=".png",
+                                 use_native=False)
+    nat_rec, nat_idx = make_record(nat_prefix, root, img_fmt=".png",
+                                   use_native=True)
+    with open(py_rec, "rb") as a, open(nat_rec, "rb") as b:
+        assert a.read() == b.read()
+    with open(py_idx) as a, open(nat_idx) as b:
+        assert a.read() == b.read()
+
+
+def test_native_packer_multi_label_parity(tmp_path):
+    """Multi-label rows (flag = n_labels, floats prepended) frame
+    identically through both packers — including the 1-element-list case."""
+    from incubator_mxnet_tpu import native, recordio
+    if not native.available():
+        pytest.skip("native shim unavailable")
+    payload = b"payload-bytes\x01\x02"
+    for label in ([1.5, -2.0, 3.25], [4.0]):
+        py_path = str(tmp_path / "py.rec")
+        py_idx = str(tmp_path / "py.idx")
+        rec = recordio.MXIndexedRecordIO(py_idx, py_path, "w")
+        rec.write_idx(7, recordio.pack(
+            recordio.IRHeader(0, label, 7, 0), payload))
+        rec.close()
+        nat_path = str(tmp_path / "nat.rec")
+        nat_idx = str(tmp_path / "nat.idx")
+        w = native.NativeIm2RecWriter(nat_path, nat_idx)
+        w.write(7, label, 7, payload)
+        w.close()
+        with open(py_path, "rb") as a, open(nat_path, "rb") as b:
+            assert a.read() == b.read(), label
+        with open(py_idx) as a, open(nat_idx) as b:
+            assert a.read() == b.read(), label

@@ -120,23 +120,46 @@ def _resize(img: onp.ndarray, size: int) -> onp.ndarray:
 
 
 def make_record(prefix: str, root: str, lst_path: Optional[str] = None,
-                resize: int = 0, quality: int = 95,
-                img_fmt: str = ".jpg") -> Tuple[str, str]:
-    """Pack ``prefix.lst`` into ``prefix.rec``/``prefix.idx``."""
-    from incubator_mxnet_tpu import recordio
+                resize: int = 0, quality: int = 95, img_fmt: str = ".jpg",
+                use_native: Optional[bool] = None) -> Tuple[str, str]:
+    """Pack ``prefix.lst`` into ``prefix.rec``/``prefix.idx``.
 
+    The per-record hot loop (IRHeader pack + dmlc framing + index) runs in
+    C++ when the native shim is available (reference: tools/im2rec.cc),
+    byte-identical to the Python path; image encode stays on cv2 either
+    way. Force a path with ``use_native`` (or ``MXTPU_IM2REC_NATIVE=0/1``).
+    """
+    from incubator_mxnet_tpu import native, recordio
+
+    if use_native is None:
+        env = os.environ.get("MXTPU_IM2REC_NATIVE")
+        use_native = native.available() if env is None else env == "1"
     lst_path = lst_path or prefix + ".lst"
     rec_path, idx_path = prefix + ".rec", prefix + ".idx"
-    rec = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    if use_native:
+        rec = native.NativeIm2RecWriter(rec_path, idx_path)
+    else:
+        rec = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
     n = 0
     try:
         for idx, label, rel in read_list(lst_path):
             img = _load_image(os.path.join(root, rel))
             img = _resize(img, resize)
-            header = recordio.IRHeader(0, label, idx, 0)
-            payload = recordio.pack_img(header, img, quality=quality,
-                                        img_fmt=img_fmt)
-            rec.write_idx(idx, payload)
+            if use_native:
+                # encode only; everything after the encode is native
+                import cv2
+                params = [cv2.IMWRITE_JPEG_QUALITY, quality] \
+                    if img_fmt in (".jpg", ".jpeg") \
+                    else [cv2.IMWRITE_PNG_COMPRESSION, quality // 10]
+                ok, buf = cv2.imencode(img_fmt, img, params)
+                if not ok:
+                    raise IOError(f"failed to encode image as {img_fmt}")
+                rec.write(idx, label, idx, buf.tobytes())
+            else:
+                header = recordio.IRHeader(0, label, idx, 0)
+                payload = recordio.pack_img(header, img, quality=quality,
+                                            img_fmt=img_fmt)
+                rec.write_idx(idx, payload)
             n += 1
     finally:
         rec.close()
