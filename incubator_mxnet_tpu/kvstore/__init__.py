@@ -16,8 +16,11 @@ ICI/DCN mesh — behind the same API names:
 ``create('dist_sync')``   same compiled psum, spanning hosts after
                           ``parallel.dist.initialize()`` (ps-lite's
                           scheduler role). Synchronous by construction.
-``create('dist_async')``  accepted with a warning; async PS semantics have
-                          no XLA analog (documented divergence, SURVEY §7).
+``create('dist_async')``  a REAL async parameter server (``async_ps.py``):
+                          TCP PS thread on rank 0, pushes applied in
+                          arrival order with no barrier — ps-lite's role,
+                          host-side beside the XLA path exactly as the
+                          reference's ps-lite sits beside its kernels.
 ========================  =================================================
 
 ``set_optimizer`` enables update-on-kvstore exactly like the reference's
@@ -26,7 +29,6 @@ server-side optimizer (``KVStoreDistServer::DataHandleEx`` sync branch).
 from __future__ import annotations
 
 import pickle
-import warnings
 from typing import Callable, Dict, List, Optional, Sequence, Union
 
 import jax
@@ -151,10 +153,8 @@ def create(name: str = "local", **kwargs) -> "KVStoreBase":
         raise MXNetError(f"KVStore name must be a string, got {type(name)}")
     key = name.lower()
     if key in ("dist_async",):
-        warnings.warn(
-            "dist_async parameter-server semantics have no XLA analog; "
-            "using synchronous mesh all-reduce (dist_sync) instead.")
-        key = "dist_sync"
+        from .async_ps import AsyncKVStore
+        return AsyncKVStore(**kwargs)
     if key in ("local", "device", "local_allreduce_cpu", "local_allreduce_device"):
         return KVStore(comm="local", **kwargs)
     if key in ("nccl", "mesh", "dist", "dist_sync", "dist_device_sync",
@@ -176,6 +176,52 @@ def _twobit_step(g, res, threshold):
                   jnp.where(acc <= -threshold, -threshold, 0.0)
                   ).astype(g.dtype)
     return q, acc - q
+
+
+class GradientCompressionMixin:
+    """2-bit gradient compression with error feedback (reference:
+    src/kvstore/gradient_compression.cc TwoBitCompressor) — shared by the
+    sync store and the async PS so validation/semantics can't diverge.
+    Hosts must initialize ``self._compression = {}`` / ``self._residuals =
+    {}`` and call ``self._compress(key, replica_idx, grad)`` per replica
+    before aggregation."""
+
+    def set_gradient_compression(self, compression_params: dict):
+        """Each replica's push is quantized per key to {-threshold, 0,
+        +threshold} BEFORE aggregation, with the quantization residual
+        carried into the next push (error feedback) — the reference's
+        numerical semantics exactly. Note the wire still moves full-width
+        floats (values are ternary but not bit-packed), so this provides
+        the reference's *convergence semantics*, not byte savings."""
+        params = dict(compression_params or {})
+        ctype = params.get("type", params.get("compression"))
+        if not params or ctype in ("none",):
+            self._compression = {}
+            self._residuals = {}
+            return
+        if ctype is None:
+            raise MXNetError("gradient compression params need a 'type' "
+                             "key (supported: '2bit')")
+        if ctype != "2bit":
+            raise MXNetError(f"unsupported gradient compression {ctype!r}; "
+                             "supported: '2bit'")
+        self._compression = params
+        self._residuals = {}
+
+    def _compress(self, k, rep_idx, g: jnp.ndarray) -> jnp.ndarray:
+        """Quantize one replica's gradient for key ``k`` (error feedback
+        state per (key, replica) — reference: per-worker residual arrays)."""
+        if not self._compression:
+            return g
+        threshold = jnp.asarray(
+            float(self._compression.get("threshold", 0.5)), g.dtype)
+        rkey = (k, rep_idx)
+        res = self._residuals.get(rkey)
+        if res is None or res.shape != g.shape:
+            res = jnp.zeros_like(g)
+        q, new_res = _twobit_step(g, res, threshold)
+        self._residuals[rkey] = new_res
+        return q
 
 
 class KVStoreBase:
@@ -207,7 +253,7 @@ class KVStoreBase:
         return 1
 
 
-class KVStore(KVStoreBase):
+class KVStore(GradientCompressionMixin, KVStoreBase):
     """The aggregating store.
 
     Semantics follow the reference local kvstore: ``init`` seeds a key;
@@ -396,51 +442,6 @@ class KVStore(KVStoreBase):
                 idx, weight)
         self._opt_states[k] = self._optimizer.update(
             idx, weight, NDArray(grad), self._opt_states[k])
-
-    def set_gradient_compression(self, compression_params: dict):
-        """2-bit gradient compression with error feedback (reference:
-        src/kvstore/gradient_compression.cc TwoBitCompressor).
-
-        Each replica's push is quantized per key to {-threshold, 0,
-        +threshold} BEFORE aggregation, with the quantization residual
-        carried into the next push (error feedback) — the reference's
-        numerical semantics exactly. The quantize/residual update is one
-        module-level jitted computation reused across pushes. Note the
-        collective still moves full-width floats (values are ternary but
-        not bit-packed — XLA collectives have no sub-byte wire format), so
-        this provides the reference's *convergence semantics*, not DCN
-        byte savings.
-        """
-        params = dict(compression_params or {})
-        if not params or params.get("type", params.get("compression")) in (
-                "none",):
-            self._compression = {}
-            self._residuals = {}
-            return
-        ctype = params.get("type", params.get("compression"))
-        if ctype is None:
-            raise MXNetError("gradient compression params need a 'type' "
-                             "key (supported: '2bit')")
-        if ctype != "2bit":
-            raise MXNetError(f"unsupported gradient compression {ctype!r}; "
-                             "supported: '2bit'")
-        self._compression = params
-        self._residuals = {}
-
-    def _compress(self, k, rep_idx, g: jnp.ndarray) -> jnp.ndarray:
-        """Quantize one replica's gradient for key ``k`` (error feedback
-        state per (key, replica) — reference: per-worker residual arrays)."""
-        if not self._compression:
-            return g
-        threshold = jnp.asarray(
-            float(self._compression.get("threshold", 0.5)), g.dtype)
-        rkey = (k, rep_idx)
-        res = self._residuals.get(rkey)
-        if res is None or res.shape != g.shape:
-            res = jnp.zeros_like(g)
-        q, new_res = _twobit_step(g, res, threshold)
-        self._residuals[rkey] = new_res
-        return q
 
     # -- persistence (reference: MXKVStoreSaveOptimizerStates) -------------
     def save_optimizer_states(self, fname: str, dump_optimizer: bool = False):

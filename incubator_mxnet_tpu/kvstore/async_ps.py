@@ -1,0 +1,332 @@
+"""Asynchronous parameter server — kvstore type ``dist_async``.
+
+Reference counterpart: ``src/kvstore/kvstore_dist_server.h``
+(``DataHandleEx`` async branch over ps-lite): each worker's push is handled
+IMMEDIATELY in arrival order — no cross-worker barrier — and pull returns
+whatever the server holds right now. Gradient staleness is traded for
+throughput; convergence analysis is the user's problem (same contract as
+the reference).
+
+TPU-native position: the COMPILED training path stays on XLA collectives
+(``dist_sync``) — every XLA collective is a synchronization point by
+construction, so async semantics cannot ride one. Exactly like the
+reference, whose ps-lite is host-side networking beside the device kernels,
+the async store is host-side networking beside the XLA step: a TCP
+parameter server thread on rank 0, length-prefixed pickled messages, pushes
+handled under a store lock in arrival order. ps-lite's scheduler/van roles
+collapse to one listening socket because the worker set is fixed at launch
+(DMLC_* env, SURVEY §2.5).
+
+Semantics, mirroring :class:`~incubator_mxnet_tpu.kvstore.KVStore`:
+
+- no server optimizer: ``push`` REPLACES the key's merged value (each push
+  is its own merge, as in the sync store); concurrent workers interleave
+  latest-wins — the async staleness contract. ``pull`` reads the latest
+  push (or the init value). This is what ``gluon.Trainer``'s
+  push-grad/pull-merged step consumes.
+- with ``set_optimizer`` (shipped pickled, the reference's server-side
+  ``DataHandleEx`` update): every push updates the WEIGHTS immediately and
+  ``pull`` returns them — update-on-kvstore, per-arrival.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+import time
+from typing import Dict, Optional
+
+import numpy as onp
+
+from ..base import MXNetError
+from ..ndarray import NDArray
+from . import GradientCompressionMixin, KVStoreBase
+
+__all__ = ["AsyncPSServer", "AsyncKVStore"]
+
+_LEN = struct.Struct("<Q")
+
+
+def _send_msg(sock: socket.socket, obj) -> None:
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+def _recv_msg(sock: socket.socket):
+    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    return pickle.loads(_recv_exact(sock, n))
+
+
+class AsyncPSServer:
+    """The rank-0 server: weights, latest-merged buffers, and an optional
+    server-side optimizer applied per push in arrival order (DataHandleEx
+    async semantics). One handler thread per worker connection; a single
+    store lock serializes updates — the ordering guarantee the reference
+    gets from ps-lite's per-key server queue."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._store: Dict = {}     # init values / optimizer-updated weights
+        self._merged: Dict = {}    # latest pushed merge per key (no-opt mode)
+        self._opt_states: Dict = {}
+        self._optimizer = None
+        self._lock = threading.Lock()
+        self._push_count = 0
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self.port = self._sock.getsockname()[1]
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    # -- message handling ---------------------------------------------------
+    def _serve(self) -> None:
+        self._sock.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            t = threading.Thread(target=self._handle, args=(conn,),
+                                 daemon=True)
+            t.start()
+        self._sock.close()
+
+    def _handle(self, conn: socket.socket) -> None:
+        try:
+            while True:
+                msg = _recv_msg(conn)
+                stop = False
+                try:
+                    resp = self._dispatch(msg)
+                    stop = msg[0] == "stop"
+                except Exception as e:  # reply, keep the connection alive
+                    resp = ("err", f"{type(e).__name__}: {e}")
+                _send_msg(conn, resp)
+                if stop:
+                    self._stop.set()
+                    return
+        except (ConnectionError, EOFError, OSError):
+            return
+
+    def _dispatch(self, msg):
+        op = msg[0]
+        if op == "init":
+            _, key, arr = msg
+            with self._lock:
+                self._store.setdefault(key, onp.array(arr))
+            return ("ok",)
+        if op == "push":
+            _, key, arr = msg
+            with self._lock:
+                self._apply(key, onp.asarray(arr))
+                self._push_count += 1
+            return ("ok",)
+        if op == "pull":
+            _, key = msg
+            with self._lock:
+                if self._optimizer is not None:
+                    val = self._store.get(key)
+                else:
+                    val = self._merged.get(key, self._store.get(key))
+            if val is None:
+                return ("err", f"key {key!r} not initialized")
+            return ("ok", val)
+        if op == "set_optimizer":
+            _, blob = msg
+            with self._lock:
+                self._optimizer = pickle.loads(blob)
+                self._opt_states.clear()
+            return ("ok",)
+        if op == "stats":
+            with self._lock:
+                return ("ok", {"pushes": self._push_count,
+                               "keys": len(self._store)})
+        if op == "stop":
+            return ("ok",)
+        return ("err", f"unknown op {op!r}")
+
+    def _apply(self, key, grad: onp.ndarray) -> None:
+        """Arrival-order push handling (lock held)."""
+        if key not in self._store:
+            raise MXNetError(f"push before init for key {key!r}")
+        if self._optimizer is None:
+            self._merged[key] = grad  # per-push merge; latest wins
+            return
+        w = NDArray(self._store[key])
+        g = NDArray(grad)
+        idx = key if isinstance(key, int) else abs(hash(key)) % (2 ** 31)
+        state = self._opt_states.get(key)
+        if state is None:
+            state = self._optimizer.create_state(idx, w)
+        self._opt_states[key] = self._optimizer.update(idx, w, g, state)
+        self._store[key] = w.asnumpy()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2)
+
+
+class _Client:
+    def __init__(self, host: str, port: int, timeout: float = 30.0):
+        deadline = time.time() + timeout
+        last = None
+        while True:
+            try:
+                self._sock = socket.create_connection((host, port),
+                                                      timeout=5.0)
+                self._sock.settimeout(60.0)
+                break
+            except OSError as e:  # server not up yet: retry (worker launch
+                last = e           # order is unordered, like ps-lite's van)
+                if time.time() > deadline:
+                    raise MXNetError(
+                        f"cannot reach async PS at {host}:{port}: {last}")
+                time.sleep(0.1)
+        self._lock = threading.Lock()
+
+    def call(self, *msg):
+        with self._lock:
+            _send_msg(self._sock, msg)
+            resp = _recv_msg(self._sock)
+        if resp[0] != "ok":
+            raise MXNetError(resp[1] if len(resp) > 1 else "async PS error")
+        return resp[1] if len(resp) > 1 else None
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class AsyncKVStore(GradientCompressionMixin, KVStoreBase):
+    """``mx.kv.create('dist_async')`` (reference: kvstore_dist.h async mode).
+
+    Rank 0 hosts :class:`AsyncPSServer`; every rank (including 0) talks to
+    it through a socket client. ``push`` is handled at the server the
+    moment it arrives — concurrent workers interleave in arrival order, and
+    ``pull`` observes the freshest state with NO barrier anywhere. Worker
+    topology comes from the dmlc-compatible env (``DMLC_NUM_WORKER`` /
+    ``DMLC_WORKER_ID`` / ``DMLC_PS_ROOT_URI``, SURVEY §2.5); single-process
+    use spins up a local server — same semantics, one worker.
+    """
+
+    #: offset from the rendezvous port so the PS socket never collides with
+    #: the jax.distributed coordinator sharing DMLC_PS_ROOT_URI
+    PORT_OFFSET = 17
+
+    def __init__(self, optimizer=None):
+        self._rank = int(os.environ.get("DMLC_WORKER_ID", "0"))
+        self._num = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+        uri = os.environ.get("DMLC_PS_ROOT_URI")
+        self._server: Optional[AsyncPSServer] = None
+        self._compression: Dict = {}
+        self._residuals: Dict = {}
+        if uri and self._num > 1:
+            port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9000")) + \
+                self.PORT_OFFSET
+            if self._rank == 0:
+                self._server = AsyncPSServer(host="0.0.0.0", port=port)
+            self._client = _Client(uri, port)
+        else:
+            self._server = AsyncPSServer()
+            self._client = _Client("127.0.0.1", self._server.port)
+        if optimizer is not None:
+            self.set_optimizer(optimizer)
+
+    # -- identity -----------------------------------------------------------
+    @property
+    def type(self) -> str:
+        return "dist_async"
+
+    @property
+    def rank(self) -> int:
+        return self._rank
+
+    @property
+    def num_workers(self) -> int:
+        return self._num
+
+    # -- core ops -----------------------------------------------------------
+    def _keys(self, key):
+        return key if isinstance(key, (list, tuple)) else [key]
+
+    def _vals(self, key, value):
+        if isinstance(key, (list, tuple)):
+            if len(key) != len(value):
+                raise MXNetError("key list and value list length mismatch")
+            return list(value)
+        return [value]
+
+    def _merge(self, k, v) -> onp.ndarray:
+        """Device-local replica sum (per-replica compression first, exactly
+        as KVStore.push orders it); the cross-WORKER story is the server's
+        arrival-order handling — no all-reduce, no barrier."""
+        vlist = v if isinstance(v, (list, tuple)) else [v]
+        parts = [self._compress(k, i, x._data) for i, x in enumerate(vlist)]
+        total = parts[0]
+        for x in parts[1:]:
+            total = total + x.astype(total.dtype)
+        return onp.asarray(total)
+
+    def init(self, key, value):
+        for k, v in zip(self._keys(key), self._vals(key, value)):
+            if isinstance(v, (list, tuple)):
+                v = v[0]
+            self._client.call("init", k, v.asnumpy())
+
+    def push(self, key, value, priority: int = 0):
+        for k, v in zip(self._keys(key), self._vals(key, value)):
+            self._client.call("push", k, self._merge(k, v))
+
+    def pull(self, key, out=None, priority: int = 0,
+             ignore_sparse: bool = True):
+        results = [NDArray(self._client.call("pull", k))
+                   for k in self._keys(key)]
+        if out is not None:
+            outs = out if isinstance(key, (list, tuple)) else [out]
+            for o, r in zip(outs, results):
+                for oo in (o if isinstance(o, (list, tuple)) else [o]):
+                    oo._set_data(r._data.astype(oo.dtype))
+            return out
+        return results if isinstance(key, (list, tuple)) else results[0]
+
+    def set_optimizer(self, optimizer) -> None:
+        """Ship the optimizer to the server (reference: the pickled
+        optimizer sent through ps-lite's control channel for server-side
+        DataHandleEx updates). Accepts a name string like the sync store."""
+        from .. import optimizer as opt_mod
+        if isinstance(optimizer, str):
+            optimizer = opt_mod.create(optimizer)
+        self._client.call("set_optimizer", pickle.dumps(optimizer))
+
+    def stats(self) -> dict:
+        return self._client.call("stats")
+
+    def close(self) -> None:
+        self._client.close()
+        if self._server is not None:
+            self._server.stop()
+            self._server = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

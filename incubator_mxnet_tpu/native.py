@@ -193,8 +193,10 @@ class NativeIm2RecWriter:
 
     def write(self, key: int, label, id_: int, payload: bytes,
               id2: int = 0) -> None:
-        multi = isinstance(label, (list, tuple))
-        labels = list(label) if multi else [label]
+        import numpy as _onp
+        multi = isinstance(label, (list, tuple, _onp.ndarray))
+        labels = [float(x) for x in _onp.asarray(label).reshape(-1)] \
+            if multi else [label]
         arr = (ctypes.c_float * len(labels))(*[float(x) for x in labels])
         if _lib().MXTPUIm2RecWrite(self._h, key, arr, len(labels),
                                    int(multi), id_, id2,

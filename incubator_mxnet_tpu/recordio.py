@@ -234,14 +234,20 @@ def unpack(s: bytes):
     return header, payload
 
 
-def pack_img(header: IRHeader, img, quality: int = 95, img_fmt: str = ".jpg") -> bytes:
+def encode_img(img, quality: int = 95, img_fmt: str = ".jpg") -> bytes:
+    """Encode an image to jpg/png bytes (the cv2 half of pack_img; shared
+    with the native im2rec packer so both paths stay byte-identical)."""
     import cv2
     params = [cv2.IMWRITE_JPEG_QUALITY, quality] if img_fmt in (".jpg", ".jpeg") \
         else [cv2.IMWRITE_PNG_COMPRESSION, quality // 10]
     ok, buf = cv2.imencode(img_fmt, img, params)
     if not ok:
         raise MXNetError(f"failed to encode image as {img_fmt}")
-    return pack(header, buf.tobytes())
+    return buf.tobytes()
+
+
+def pack_img(header: IRHeader, img, quality: int = 95, img_fmt: str = ".jpg") -> bytes:
+    return pack(header, encode_img(img, quality=quality, img_fmt=img_fmt))
 
 
 def unpack_img(s: bytes, iscolor: int = -1):

@@ -1,0 +1,82 @@
+"""Worker for the multi-process ASYNC kvstore test (reference:
+tests/nightly/dist_async_kvstore.py — N workers, one ps-lite server, no
+barriers; convergence is eventual).
+
+Spawned by tests/test_dist_kvstore.py. argv: <host> <base_port> <num> <pid>.
+Pure sockets — no jax.distributed rendezvous is needed for the async PS,
+which is exactly the point: the store lives beside the device runtime.
+"""
+import os
+import sys
+import time
+
+import numpy as onp
+
+host, base_port, num, pid = (sys.argv[1], sys.argv[2], int(sys.argv[3]),
+                             int(sys.argv[4]))
+os.environ["DMLC_PS_ROOT_URI"] = host
+os.environ["DMLC_PS_ROOT_PORT"] = base_port
+os.environ["DMLC_NUM_WORKER"] = str(num)
+os.environ["DMLC_WORKER_ID"] = str(pid)
+
+import incubator_mxnet_tpu as mx  # noqa: E402
+from incubator_mxnet_tpu.base import MXNetError  # noqa: E402
+
+kv = mx.kv.create("dist_async")
+assert kv.type == "dist_async"
+assert kv.rank == pid and kv.num_workers == num
+
+PUSHES = 3
+kv.init(1, mx.nd.zeros((4,)))
+if pid == 0:
+    # server-side optimizer (DataHandleEx): sgd(lr=1) makes every push of
+    # grad=1 an exact -1 step, so arrival-order handling is countable
+    kv.set_optimizer(mx.optimizer.create("sgd", learning_rate=1.0))
+    kv.init("ready", mx.nd.zeros((1,)))
+    kv.push("ready", mx.nd.ones((1,)))  # under the optimizer: w = -1
+else:
+    deadline = time.time() + 60
+    while True:
+        try:
+            if float(kv.pull("ready").asnumpy()[0]) <= -1.0:
+                break
+        except MXNetError:
+            pass
+        if time.time() > deadline:
+            raise SystemExit(f"rank {pid}: optimizer never became ready")
+        time.sleep(0.05)
+
+for _ in range(PUSHES):
+    kv.push(1, mx.nd.ones((4,)))
+    # NO barrier between pushes or workers — the async contract
+
+# eventual consistency: poll until every worker's pushes have been applied
+want = float(-PUSHES * num)
+deadline = time.time() + 60
+while True:
+    got = kv.pull(1).asnumpy()
+    if onp.allclose(got, onp.full((4,), want)):
+        break
+    if time.time() > deadline:
+        raise SystemExit(f"rank {pid}: never saw {want}, last {got[0]}")
+    time.sleep(0.05)
+
+# handshake key so rank 0 keeps the server alive until everyone is done
+# (the server optimizer turns push(1) into w -= 1, so "done" reads -1)
+kv.init(f"done_{pid}", mx.nd.zeros((1,)))
+kv.push(f"done_{pid}", mx.nd.ones((1,)))
+if pid == 0:
+    deadline = time.time() + 60
+    others = [i for i in range(num) if i != 0]
+    while others:
+        try:
+            if float(kv.pull(f"done_{others[0]}").asnumpy()[0]) <= -1.0:
+                others.pop(0)
+                continue
+        except MXNetError:
+            pass
+        if time.time() > deadline:
+            raise SystemExit(f"rank 0: worker(s) {others} never finished")
+        time.sleep(0.05)
+    kv.close()
+print(f"DIST_ASYNC_KV_OK rank={pid}")

@@ -161,3 +161,39 @@ def test_launch_py_local_spawns_rendezvoused_workers(tmp_path):
     })
     rc = launch.launch_local(2, [sys.executable, str(worker)], env=env)
     assert rc == 0
+
+
+@pytest.mark.parametrize("nproc", [2])
+def test_dist_async_kvstore_multiprocess(nproc):
+    """N real processes against ONE async PS (reference mechanism:
+    tests/nightly/dist_async_kvstore.py): barrier-free pushes interleave at
+    the server; each worker converges to the total by polling (eventual
+    consistency — the async contract)."""
+    from incubator_mxnet_tpu.kvstore.async_ps import AsyncKVStore
+    base_port = _free_port() - AsyncKVStore.PORT_OFFSET
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        "PALLAS_AXON_POOL_IPS": "",
+        "PYTHONPATH": REPO,
+    })
+    worker = os.path.join(REPO, "tests", "dist_async_kvstore_worker.py")
+    procs = [subprocess.Popen(
+        [sys.executable, worker, "127.0.0.1", str(base_port), str(nproc),
+         str(i)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for i in range(nproc)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail("async kv workers timed out:\n" +
+                    "\n".join(o or "" for o in outs))
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out}"
+        assert f"DIST_ASYNC_KV_OK rank={i}" in out

@@ -69,10 +69,92 @@ def test_set_optimizer_server_side_update():
                                 rtol=1e-6)
 
 
-def test_dist_async_warns_and_degrades():
-    with pytest.warns(UserWarning):
-        kv = mx.kv.create("dist_async")
-    assert kv.type == "dist_sync"
+def test_dist_async_is_a_real_async_ps():
+    """dist_async = an actual parameter server (reference:
+    kvstore_dist_server.h DataHandleEx async branch): pushes handled in
+    arrival order, pull reads the live state, no barrier anywhere."""
+    kv = mx.kv.create("dist_async")
+    try:
+        assert kv.type == "dist_async"
+        kv.init(0, mx.nd.ones((3,)))
+        # no optimizer: each push is its own merge (sync-store semantics);
+        # replica lists sum device-locally before the wire
+        kv.push(0, [mx.nd.full((3,), 2.0), mx.nd.full((3,), 3.0)])
+        onp.testing.assert_allclose(kv.pull(0).asnumpy(),
+                                    onp.full((3,), 5.0))
+        kv.push(0, mx.nd.full((3,), 7.0))    # latest push wins
+        onp.testing.assert_allclose(kv.pull(0).asnumpy(),
+                                    onp.full((3,), 7.0))
+        # server-side optimizer: every push is an immediate weight update
+        kv2 = mx.kv.create("dist_async")
+        try:
+            kv2.init("w", mx.nd.ones((2,)))
+            kv2.set_optimizer(mx.optimizer.create("sgd", learning_rate=0.5))
+            kv2.push("w", mx.nd.ones((2,)))
+            onp.testing.assert_allclose(kv2.pull("w").asnumpy(),
+                                        onp.full((2,), 0.5))
+            kv2.push("w", mx.nd.ones((2,)))
+            onp.testing.assert_allclose(kv2.pull("w").asnumpy(),
+                                        onp.full((2,), 0.0))
+            assert kv2.stats()["pushes"] == 2
+        finally:
+            kv2.close()
+        # errors surface as MXNetError and the connection survives them
+        with pytest.raises(mx.MXNetError, match="push before init"):
+            kv.push(99, mx.nd.ones((1,)))
+        onp.testing.assert_allclose(kv.pull(0).asnumpy(),
+                                    onp.full((3,), 7.0))
+    finally:
+        kv.close()
+
+
+def test_dist_async_concurrent_pushes_serialize_at_server():
+    # arrival-order serialization: with a server-side sgd(lr=1) every push
+    # of grad=1 moves the weight by exactly -1, so 2x50 interleaved pushes
+    # must land on exactly -100 (lost updates would undershoot)
+    import threading
+    kv = mx.kv.create("dist_async")
+    try:
+        kv.init(7, mx.nd.zeros((2,)))
+        kv.set_optimizer(mx.optimizer.create("sgd", learning_rate=1.0))
+
+        def worker():
+            for _ in range(50):
+                kv.push(7, mx.nd.ones((2,)))
+
+        ts = [threading.Thread(target=worker) for _ in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        onp.testing.assert_allclose(kv.pull(7).asnumpy(),
+                                    onp.full((2,), -100.0))
+        assert kv.stats()["pushes"] == 100
+    finally:
+        kv.close()
+
+
+def test_trainer_with_dist_async_kvstore():
+    """gluon.Trainer over the async PS: push-grad/pull-merged per step
+    (single worker: exact local semantics) — training converges."""
+    net = gluon.nn.Dense(1, in_units=2)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1},
+                       kvstore="dist_async")
+    rng = onp.random.RandomState(0)
+    x = mx.nd.array(rng.randn(16, 2).astype("float32"))
+    y = mx.nd.array((x.asnumpy() @ onp.array([[2.0], [-1.0]]) + 0.1
+                     ).astype("float32"))
+    lf = gluon.loss.L2Loss()
+    losses = []
+    for _ in range(60):
+        with mx.autograd.record():
+            l = lf(net(x), y)
+        l.backward()
+        tr.step(16)
+        losses.append(float(l.mean().asnumpy()))
+    assert losses[-1] < losses[0] * 0.1, losses[::20]
+    tr._kvstore.close()
 
 
 def test_unknown_type_raises():

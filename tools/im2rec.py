@@ -147,14 +147,9 @@ def make_record(prefix: str, root: str, lst_path: Optional[str] = None,
             img = _resize(img, resize)
             if use_native:
                 # encode only; everything after the encode is native
-                import cv2
-                params = [cv2.IMWRITE_JPEG_QUALITY, quality] \
-                    if img_fmt in (".jpg", ".jpeg") \
-                    else [cv2.IMWRITE_PNG_COMPRESSION, quality // 10]
-                ok, buf = cv2.imencode(img_fmt, img, params)
-                if not ok:
-                    raise IOError(f"failed to encode image as {img_fmt}")
-                rec.write(idx, label, idx, buf.tobytes())
+                payload = recordio.encode_img(img, quality=quality,
+                                              img_fmt=img_fmt)
+                rec.write(idx, label, idx, payload)
             else:
                 header = recordio.IRHeader(0, label, idx, 0)
                 payload = recordio.pack_img(header, img, quality=quality,

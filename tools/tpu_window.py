@@ -14,7 +14,8 @@ program unattended the moment the tunnel comes back:
                   embedding grad) + XProf trace of the default config
   4. resnet     — MXTPU_BENCH_WORKLOAD=resnet bench.py
   5. bert-large — MXTPU_BENCH_MODEL=bert_24_1024_16 + remat bench.py
-  6. int8       — benchmark/int8_probe.py (MXU int8 evidence)
+  6. ssd/frcnn  — the two detection bench workloads
+  7. int8       — benchmark/int8_probe.py (MXU int8 evidence)
 
 Every step appends to benchmark/tpu_window_results.jsonl (one JSON object
 per line, with a "step" key and ISO timestamp); completed steps are not
@@ -143,13 +144,20 @@ def step_bert_large():
             "result": rec, "err": None if rc == 0 else (err or out)[-300:]}
 
 
-def step_ssd():
-    rc, out, err = _run([sys.executable, "bench.py"],
-                        env_delta={"MXTPU_BENCH_WORKLOAD": "ssd"},
-                        timeout=1800)
-    rec = _last_json(out)
-    return {"step": "ssd", "ok": rc == 0 and rec is not None, "rc": rc,
-            "result": rec, "err": None if rc == 0 else (err or out)[-300:]}
+def _workload_step(name):
+    def step():
+        rc, out, err = _run([sys.executable, "bench.py"],
+                            env_delta={"MXTPU_BENCH_WORKLOAD": name},
+                            timeout=1800)
+        rec = _last_json(out)
+        return {"step": name, "ok": rc == 0 and rec is not None, "rc": rc,
+                "result": rec, "err": None if rc == 0 else (err or out)[-300:]}
+    step.__name__ = f"step_{name}"
+    return step
+
+
+step_ssd = _workload_step("ssd")
+step_frcnn = _workload_step("frcnn")
 
 
 def step_int8():
@@ -161,7 +169,7 @@ def step_int8():
 
 
 STEPS = [step_op_corpus, step_bert_sweep, step_resnet, step_bert_large,
-         step_ssd, step_int8]
+         step_ssd, step_frcnn, step_int8]
 
 
 def run_program() -> bool:
