@@ -888,3 +888,53 @@ def bincount(a, weights=None, minlength=0, **_):
         length = int(minlength)  # jit: static cap, out-of-range dropped
     w = None if weights is None else weights.reshape(-1)
     return jnp.bincount(x, weights=w, minlength=length, length=length)
+
+
+@register_op("index_copy", aliases=("_contrib_index_copy",))
+def index_copy(old_tensor, index_vector, new_tensor, **_):
+    """Copy rows of ``new_tensor`` into ``old_tensor`` at the positions
+    named by ``index_vector`` (reference: contrib index_copy.cc — which
+    rejects out-of-range indices; so does this, whenever the indices are
+    concrete). Pure functional form: returns the updated array."""
+    import numpy as _onp
+    idx = index_vector.astype(jnp.int32).reshape(-1)
+    n = old_tensor.shape[0]
+    try:
+        bad = _onp.asarray((idx < 0) | (idx >= n))
+        if bad.any():
+            raise ValueError(
+                f"index_copy: indices {_onp.asarray(idx)[bad].tolist()} out "
+                f"of range for first dim {n}")
+    except jax.errors.ConcretizationTypeError:
+        pass  # traced: XLA scatter drops out-of-bounds rows (documented)
+    return old_tensor.at[idx].set(new_tensor.astype(old_tensor.dtype))
+
+
+@register_op("index_array", aliases=("_contrib_index_array",))
+def index_array(data, axes=None, **_):
+    """Per-element index coordinates of ``data`` (reference: contrib
+    index_array.cc): output shape ``data.shape + (len(axes),)`` holding
+    each element's position along the selected ``axes`` (all axes when
+    None). Integer dtype is int64 under ``jax_enable_x64``, else int32 —
+    the framework-wide index convention."""
+    nd_ = data.ndim
+    if nd_ == 0:
+        raise ValueError("index_array needs at least a 1-d input")
+    if axes is None:
+        sel = tuple(range(nd_))
+    else:
+        sel = []
+        for a in axes:
+            if not -nd_ <= a < nd_:
+                raise ValueError(
+                    f"index_array: axis {a} out of range for {nd_}-d input")
+            sel.append(a + nd_ if a < 0 else a)
+        if not sel:
+            raise ValueError("index_array: axes must be non-empty")
+    # build only the selected axes' coordinate planes (no full meshgrid)
+    comps = [jnp.broadcast_to(
+        jnp.arange(data.shape[a]).reshape(
+            tuple(data.shape[a] if i == a else 1 for i in range(nd_))),
+        data.shape) for a in sel]
+    dt = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+    return jnp.stack(comps, axis=-1).astype(dt)

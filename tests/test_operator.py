@@ -640,3 +640,41 @@ def test_im2col_col2im():
     assert back.shape == (2, 3, 5, 5)
     with pytest.raises(Exception):  # unknown kwargs now rejected by schema
         nd.im2col(nd.array(x), kernel=(3, 3), bogus=1)
+
+
+def test_index_copy_contrib():
+    old = mx.nd.zeros((5, 3))
+    new = mx.nd.array(onp.arange(6, dtype="float32").reshape(2, 3))
+    idx = mx.nd.array(onp.array([1, 3], "float32"))
+    out = mx.contrib.nd.index_copy(old, idx, new)
+    ref = onp.zeros((5, 3), "float32")
+    ref[[1, 3]] = new.asnumpy()
+    onp.testing.assert_allclose(out.asnumpy(), ref)
+
+
+def test_index_array_contrib():
+    x = mx.nd.zeros((2, 3))
+    out = mx.contrib.nd.index_array(x)
+    assert out.shape == (2, 3, 2)
+    onp.testing.assert_array_equal(out.asnumpy()[1, 2], [1, 2])
+    sel = mx.contrib.nd.index_array(x, axes=(1,))
+    onp.testing.assert_array_equal(sel.asnumpy()[..., 0],
+                                   onp.tile([0, 1, 2], (2, 1)))
+
+
+def test_index_copy_rejects_out_of_range():
+    with pytest.raises(Exception, match="out of range"):
+        mx.contrib.nd.index_copy(mx.nd.zeros((3, 2)),
+                                 mx.nd.array(onp.array([3.0], "float32")),
+                                 mx.nd.ones((1, 2)))
+
+
+def test_index_array_validates_axes():
+    x = mx.nd.zeros((2, 3))
+    with pytest.raises(Exception, match="out of range"):
+        mx.contrib.nd.index_array(x, axes=(-3,))
+    with pytest.raises(Exception, match="non-empty"):
+        mx.contrib.nd.index_array(x, axes=())
+    neg = mx.contrib.nd.index_array(x, axes=(-1,))
+    onp.testing.assert_array_equal(neg.asnumpy()[..., 0],
+                                   onp.tile([0, 1, 2], (2, 1)))
