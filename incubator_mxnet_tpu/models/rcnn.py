@@ -14,6 +14,7 @@ from typing import Sequence, Tuple
 
 from ..gluon.block import HybridBlock
 from ..gluon import nn
+from .. import initializer as _init
 
 __all__ = ["FasterRCNN", "RPN", "FasterRCNNTargetLoss"]
 
@@ -49,10 +50,19 @@ class RPN(HybridBlock):
         super().__init__(**kw)
         self._A = num_anchors
         with self.name_scope():
+            # Normal(0.01) heads (reference: GluonCV rpn.py
+            # weight_initializer=mx.init.Normal(0.01)): tiny initial
+            # weights keep objectness near-uniform and deltas near zero, so
+            # proposals start AT the anchors — Xavier-scale heads can start
+            # the regression far enough off that the RPN never recovers
+            # (observed: seed-dependent localization collapse)
             self.conv = nn.Conv2D(channels, 3, padding=1, activation="relu",
-                                  prefix="conv_")
-            self.cls = nn.Conv2D(2 * num_anchors, 1, prefix="cls_")
-            self.reg = nn.Conv2D(4 * num_anchors, 1, prefix="reg_")
+                                  prefix="conv_",
+                                  weight_initializer=_init.Normal(0.01))
+            self.cls = nn.Conv2D(2 * num_anchors, 1, prefix="cls_",
+                                 weight_initializer=_init.Normal(0.01))
+            self.reg = nn.Conv2D(4 * num_anchors, 1, prefix="reg_",
+                                 weight_initializer=_init.Normal(0.01))
 
     def hybrid_forward(self, F, x):
         h = self.conv(x)
@@ -97,10 +107,14 @@ class FasterRCNN(HybridBlock):
             self.rpn = RPN(backbone_filters[-1], A, prefix="rpn_")
             self.head_dense = nn.Dense(128, activation="relu",
                                        prefix="head_", flatten=False)
+            # reference head init (GluonCV faster_rcnn.py): cls
+            # Normal(0.01), bbox Normal(0.001) — box deltas start at zero
             self.cls_score = nn.Dense(num_classes + 1, prefix="cls_score_",
-                                      flatten=False)
+                                      flatten=False,
+                                      weight_initializer=_init.Normal(0.01))
             self.bbox_pred = nn.Dense(4 * (num_classes + 1),
-                                      prefix="bbox_pred_", flatten=False)
+                                      prefix="bbox_pred_", flatten=False,
+                                      weight_initializer=_init.Normal(0.001))
 
     def hybrid_forward(self, F, x, im_info, gt=None):
         feat = self.backbone(x)

@@ -899,6 +899,12 @@ def index_copy(old_tensor, index_vector, new_tensor, **_):
     import numpy as _onp
     idx = index_vector.astype(jnp.int32).reshape(-1)
     n = old_tensor.shape[0]
+    k = idx.shape[0]
+    want = (k,) + tuple(old_tensor.shape[1:])
+    if tuple(new_tensor.shape) != want:
+        raise ValueError(
+            f"index_copy: new_tensor shape {tuple(new_tensor.shape)} must "
+            f"be (len(index),) + old_tensor.shape[1:] = {want}")
     try:
         bad = _onp.asarray((idx < 0) | (idx >= n))
         if bad.any():
@@ -906,8 +912,15 @@ def index_copy(old_tensor, index_vector, new_tensor, **_):
                 f"index_copy: indices {_onp.asarray(idx)[bad].tolist()} out "
                 f"of range for first dim {n}")
     except jax.errors.ConcretizationTypeError:
-        pass  # traced: XLA scatter drops out-of-bounds rows (documented)
-    return old_tensor.at[idx].set(new_tensor.astype(old_tensor.dtype))
+        pass  # traced: out-of-range rows are dropped (documented)
+    # gather-based rebuild: per target row, the LAST matching update wins —
+    # the reference's sequential-copy semantics, deterministic on every
+    # backend (scatter with duplicate indices is implementation-defined)
+    last_pos = jnp.full((n,), -1, jnp.int32).at[idx].max(
+        jnp.arange(k, dtype=jnp.int32), mode="drop")
+    picked = new_tensor.astype(old_tensor.dtype)[jnp.clip(last_pos, 0)]
+    mask = (last_pos >= 0).reshape((n,) + (1,) * (old_tensor.ndim - 1))
+    return jnp.where(mask, picked, old_tensor)
 
 
 @register_op("index_array", aliases=("_contrib_index_array",))

@@ -678,3 +678,19 @@ def test_index_array_validates_axes():
     neg = mx.contrib.nd.index_array(x, axes=(-1,))
     onp.testing.assert_array_equal(neg.asnumpy()[..., 0],
                                    onp.tile([0, 1, 2], (2, 1)))
+
+
+def test_index_copy_duplicate_indices_last_wins():
+    # reference sequential-copy semantics: the LAST update for a row wins,
+    # deterministically on every backend
+    out = mx.contrib.nd.index_copy(
+        mx.nd.zeros((5,)), mx.nd.array(onp.array([2.0, 2.0], "float32")),
+        mx.nd.array(onp.array([7.0, 9.0], "float32")))
+    onp.testing.assert_allclose(out.asnumpy(), [0, 0, 9, 0, 0])
+
+
+def test_index_copy_rejects_shape_mismatch():
+    with pytest.raises(Exception, match="must be"):
+        mx.contrib.nd.index_copy(mx.nd.zeros((5, 3)),
+                                 mx.nd.array(onp.array([1.0, 3.0], "float32")),
+                                 mx.nd.ones((1, 3)))
