@@ -8,14 +8,20 @@ program unattended the moment the tunnel comes back:
   1. probe      — tiny matmul in a killable subprocess (the tunnel wedge
                   blocks C++ device init forever; only a subprocess with a
                   hard timeout is safe to retry)
-  2. op corpus  — MXTPU_TEST_TPU=1 pytest tests/test_operator_tpu.py
-  3. bert_sweep — benchmark/bert_sweep.py (the staged round-3 follow-up:
+  2. bert_sweep — benchmark/bert_sweep.py (the staged round-3 follow-up:
                   B16/B32+remat under adaptive tiles, BK=256, one-hot
                   embedding grad) + XProf trace of the default config
-  4. resnet     — MXTPU_BENCH_WORKLOAD=resnet bench.py
-  5. bert-large — MXTPU_BENCH_MODEL=bert_24_1024_16 + remat bench.py
-  6. ssd/frcnn  — the two detection bench workloads
-  7. int8       — benchmark/int8_probe.py (MXU int8 evidence)
+  3. resnet     — MXTPU_BENCH_WORKLOAD=resnet bench.py
+  4. bert-large — MXTPU_BENCH_MODEL=bert_24_1024_16 + remat bench.py
+  5. ssd/frcnn  — the two detection bench workloads
+  6. int8       — benchmark/int8_probe.py (MXU int8 evidence)
+  7. op corpus  — MXTPU_TEST_TPU=1 pytest tests/test_operator_tpu.py
+                  (last: headline numbers must bank before the slow corpus)
+
+If benchmark/.pause_during_window.pid names a process group, it is
+SIGSTOPped for the duration of a window program and SIGCONTed after, so a
+CPU-bound background job (the seed sweep) can share the single host core
+without polluting TPU step timings.
 
 Every step appends to benchmark/tpu_window_results.jsonl (one JSON object
 per line, with a "step" key and ISO timestamp); completed steps are not
@@ -105,12 +111,28 @@ def _last_json(stdout: str):
 
 
 def step_op_corpus():
+    # -v so every test name+result streams live: a SIGKILLed timeout's
+    # partial stdout still names what failed and where it wedged (with -q
+    # the -rf summary never prints — pytest dies before exit). The tunneled
+    # chip pays ~1-2 ms dispatch latency per op, so the full corpus is
+    # slow — 2h budget, and it runs LAST so a short window banks the
+    # headline numbers first (the 07-31 03:47 window spent its entire hour
+    # in this step and wedged before bert_sweep could run).
     rc, out, err = _run(
-        [sys.executable, "-m", "pytest", "tests/test_operator_tpu.py", "-q"],
-        env_delta={"MXTPU_TEST_TPU": "1"}, timeout=3600)
-    tail = (out or "").strip().splitlines()[-3:]
+        [sys.executable, "-m", "pytest", "tests/test_operator_tpu.py", "-v",
+         "--tb=line"],
+        env_delta={"MXTPU_TEST_TPU": "1"}, timeout=7200)
+    lines = (out or "").strip().splitlines()
+    # -v progress lines read 'path::test FAILED [ n%]'; the exit summary
+    # repeats them as 'FAILED path::test - msg' — parse both, dedupe.
+    fails = []
+    for l in lines:
+        tid = (l.split()[1] if l.startswith("FAILED")
+               else l.split(" ")[0] if " FAILED" in l else None)
+        if tid and tid not in fails:
+            fails.append(tid)
     return {"step": "op_corpus", "ok": rc == 0, "rc": rc,
-            "tail": " | ".join(tail)}
+            "failures": fails[:40], "tail": " | ".join(lines[-3:])}
 
 
 def step_bert_sweep():
@@ -168,8 +190,31 @@ def step_int8():
             "result": rec, "err": None if rc == 0 else (err or out)[-300:]}
 
 
-STEPS = [step_op_corpus, step_bert_sweep, step_resnet, step_bert_large,
-         step_ssd, step_frcnn, step_int8]
+# Headline numbers first: windows have died mid-program twice (r3, r5);
+# the MFU sweep is the round's P0 and must bank before the slow corpus.
+STEPS = [step_bert_sweep, step_resnet, step_bert_large,
+         step_ssd, step_frcnn, step_int8, step_op_corpus]
+
+PAUSE_PIDFILE = os.path.join(REPO, "benchmark", ".pause_during_window.pid")
+
+
+def _pause_pid(sig) -> None:
+    """SIGSTOP/SIGCONT the process group named in PAUSE_PIDFILE. Lets a
+    CPU-bound background job (the seed sweep) share the single host core
+    with the watch loop without polluting TPU step timings: it is frozen
+    for the duration of the window program and resumed after."""
+    import signal as _signal
+    try:
+        with open(PAUSE_PIDFILE) as f:
+            pid = int(f.read().strip())
+        if pid <= 1 or pid == os.getpgrp():
+            return  # never freeze init or our own group (stale/bad pidfile)
+        os.killpg(pid, sig)
+        name = "SIGSTOP" if sig == _signal.SIGSTOP else "SIGCONT"
+        print(f"[{_now()}] sent {name} to pgid {pid}", flush=True)
+    except (FileNotFoundError, ValueError, ProcessLookupError,
+            PermissionError):
+        pass
 
 
 def run_program() -> bool:
@@ -221,7 +266,28 @@ def main(argv=None) -> int:
               flush=True)
         if healthy:
             _append({"step": "probe", "ok": True})
-            if run_program():
+            import atexit
+            import signal
+
+            def _resume(signum=None, frame=None):
+                _pause_pid(signal.SIGCONT)
+                if signum is not None:
+                    raise SystemExit(128 + signum)
+
+            # A SIGTERM/SIGINT (or normal exit) mid-program must never
+            # leave the paused group frozen forever; SIGKILL/OOM still can —
+            # unfreeze by hand with `kill -CONT -<pgid>` in that case.
+            atexit.register(_pause_pid, signal.SIGCONT)
+            prev_term = signal.signal(signal.SIGTERM, _resume)
+            prev_int = signal.signal(signal.SIGINT, _resume)
+            _pause_pid(signal.SIGSTOP)
+            try:
+                complete = run_program()
+            finally:
+                _pause_pid(signal.SIGCONT)
+                signal.signal(signal.SIGTERM, prev_term)
+                signal.signal(signal.SIGINT, prev_int)
+            if complete:
                 print(f"[{_now()}] TPU window program complete.", flush=True)
                 return 0
         if args.once:
