@@ -25,13 +25,43 @@ if os.environ.get("MXTPU_TEST_TPU") != "1":
 
 import test_operator  # noqa: E402  (the CPU corpus, re-run under mx.tpu())
 
+# The corpus checks NUMERICS: force true-f32 matmuls for the whole run
+# (default TPU matmul precision is bf16 operands, rel-err ~1e-2, which
+# blows the corpus' f32 rtol=1e-4 on every dot/conv/linalg case — the
+# analog of the reference running its GPU corpus on cuBLAS fp32, not
+# tensor-core fp16). Process-wide is right: this pytest process exists
+# only for this corpus (module-level skip above). Perf benches keep the
+# fast default.
+import jax  # noqa: E402
+
+jax.config.update("jax_default_matmul_precision", "highest")
+
 
 @pytest.fixture(autouse=True)
 def _tpu_default_context():
     test_utils.set_default_context(mx.tpu(0))
-    with mx.tpu(0):
-        yield
-    test_utils.set_default_context(None)
+
+    # Per-test budget: the tunneled chip pays ~1-2 ms dispatch latency per
+    # op, so one pathological test (finite-difference sweeps do hundreds of
+    # dispatches) can eat the whole window. SIGALRM fires between
+    # dispatches and fails just that test by name; a hard C++ wedge is
+    # still caught by the watchdog's subprocess kill.
+    import signal
+
+    budget = int(os.environ.get("MXTPU_TPU_TEST_TIMEOUT", "150"))
+
+    def _alarm(signum, frame):
+        raise TimeoutError(f"TPU corpus per-test budget {budget}s exceeded")
+
+    prev_alarm = signal.signal(signal.SIGALRM, _alarm)
+    signal.alarm(budget)
+    try:
+        with mx.tpu(0):
+            yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, prev_alarm)
+        test_utils.set_default_context(None)
 
 
 # re-export the whole corpus; the autouse fixture swaps the context

@@ -124,11 +124,17 @@ def step_op_corpus():
         env_delta={"MXTPU_TEST_TPU": "1"}, timeout=7200)
     lines = (out or "").strip().splitlines()
     # -v progress lines read 'path::test FAILED [ n%]'; the exit summary
-    # repeats them as 'FAILED path::test - msg' — parse both, dedupe.
+    # repeats them as 'FAILED path::test - msg' — parse both (anchored on
+    # a '::'-bearing test id so captured-stdout noise and a mid-line
+    # truncation at SIGKILL can't pollute or crash the parse), dedupe.
     fails = []
     for l in lines:
-        tid = (l.split()[1] if l.startswith("FAILED")
-               else l.split(" ")[0] if " FAILED" in l else None)
+        toks = l.split()
+        tid = None
+        if len(toks) >= 2 and toks[0] == "FAILED" and "::" in toks[1]:
+            tid = toks[1]
+        elif len(toks) >= 2 and toks[1] == "FAILED" and "::" in toks[0]:
+            tid = toks[0]
         if tid and tid not in fails:
             fails.append(tid)
     return {"step": "op_corpus", "ok": rc == 0, "rc": rc,
@@ -196,6 +202,7 @@ STEPS = [step_bert_sweep, step_resnet, step_bert_large,
          step_ssd, step_frcnn, step_int8, step_op_corpus]
 
 PAUSE_PIDFILE = os.path.join(REPO, "benchmark", ".pause_during_window.pid")
+_ATEXIT_ARMED = False
 
 
 def _pause_pid(sig) -> None:
@@ -277,7 +284,10 @@ def main(argv=None) -> int:
             # A SIGTERM/SIGINT (or normal exit) mid-program must never
             # leave the paused group frozen forever; SIGKILL/OOM still can —
             # unfreeze by hand with `kill -CONT -<pgid>` in that case.
-            atexit.register(_pause_pid, signal.SIGCONT)
+            global _ATEXIT_ARMED
+            if not _ATEXIT_ARMED:
+                atexit.register(_pause_pid, signal.SIGCONT)
+                _ATEXIT_ARMED = True
             prev_term = signal.signal(signal.SIGTERM, _resume)
             prev_int = signal.signal(signal.SIGINT, _resume)
             _pause_pid(signal.SIGSTOP)
