@@ -52,6 +52,7 @@ class BERTEncoder(HybridBlock):
         super().__init__(**kwargs)
         self._num_layers = num_layers
         self._remat = remat
+        self._dropout = dropout
         with self.name_scope():
             self.layers = []
             for i in range(num_layers):
@@ -64,16 +65,41 @@ class BERTEncoder(HybridBlock):
 
     def hybrid_forward(self, F, x, mask=None):
         from ..gluon.block import _is_tracing
-        if self._remat and _is_tracing():
-            import jax
+        import jax
+        # checkpoint only under a REAL jit trace: the ShardedTrainer warmup
+        # runs eagerly with the tracing flag set (to finish deferred init),
+        # and an eager jax.checkpoint would trace deferred param init into
+        # its region — the init value would then be a region-local tracer
+        # stored on the Parameter (UnexpectedTracerError on reuse).
+        if self._remat and _is_tracing() \
+                and isinstance(x._data, jax.core.Tracer):
+            from .. import random as random_mod
             from ..ndarray import NDArray
+            need_rng = self._dropout > 0
             for cell in self.layers:
-                # jax.checkpoint over the cell body; params/mask/rng keys are
-                # closed-over tracers (new-style remat closure-converts them,
-                # cotangents flow).
-                def body(xv, cell=cell, mask=mask, ctx=x.context):
-                    return cell(NDArray(xv, ctx=ctx), mask)._data
-                x = NDArray(jax.checkpoint(body)(x._data), ctx=x.context)
+                # jax.checkpoint over the cell body; params/mask are
+                # closed-over tracers (new-style remat closure-converts
+                # them, cotangents flow). RNG must NOT be stateful across
+                # the checkpoint boundary: a next_key() split inside the
+                # region would store a region-local tracer in the ambient
+                # trace_rng (UnexpectedTracerError). Instead draw one key
+                # per layer at the outer trace level and thread it in as a
+                # checkpoint ARGUMENT — backward's recompute then replays
+                # the exact same dropout masks by construction.
+                if need_rng:
+                    layer_key = random_mod.next_key()
+
+                    def body(xv, kv, cell=cell, mask=mask, ctx=x.context):
+                        with random_mod.trace_rng(kv):
+                            return cell(NDArray(xv, ctx=ctx), mask)._data
+
+                    x = NDArray(jax.checkpoint(body)(x._data, layer_key),
+                                ctx=x.context)
+                else:
+                    def body(xv, cell=cell, mask=mask, ctx=x.context):
+                        return cell(NDArray(xv, ctx=ctx), mask)._data
+
+                    x = NDArray(jax.checkpoint(body)(x._data), ctx=x.context)
             return x
         for cell in self.layers:
             x = cell(x, mask)

@@ -32,6 +32,19 @@ def test_bench_bert_contract(monkeypatch, capsys):
     assert math.isfinite(rec["extra"]["loss"])
 
 
+def test_bench_bert_remat_contract(monkeypatch, capsys):
+    # the tpu_window bert_large step = bench.py + MXTPU_BENCH_REMAT=1 on a
+    # bigger config name; contract the remat fork on the tiny config so a
+    # code bug can't kill that window step
+    rec = _run_bench(monkeypatch, capsys, MXTPU_BENCH_MODEL="bert_2_128_2",
+                     MXTPU_BENCH_BATCH="2", MXTPU_BENCH_SEQ="64",
+                     MXTPU_BENCH_STEPS="2", MXTPU_BENCH_REMAT="1")
+    import math
+    assert rec["unit"] == "tokens/sec/chip" and rec["value"] > 0
+    assert rec["extra"]["remat"] is True
+    assert math.isfinite(rec["extra"]["loss"])
+
+
 def test_bench_resnet_contract(monkeypatch, capsys):
     import math
     rec = _run_bench(monkeypatch, capsys, MXTPU_BENCH_WORKLOAD="resnet",

@@ -37,8 +37,18 @@ import jax  # noqa: E402
 jax.config.update("jax_default_matmul_precision", "highest")
 
 
+@pytest.fixture(autouse=True, scope="session")
+def _tpu_warmup():
+    # Pay the one-time tunneled-device client init OUTSIDE any per-test
+    # alarm: on a slow tunnel init alone can exceed the 150s budget and
+    # would spuriously fail (and permanently flap) the first corpus test.
+    import jax.numpy as jnp
+
+    jnp.ones((8, 8)).block_until_ready()
+
+
 @pytest.fixture(autouse=True)
-def _tpu_default_context():
+def _tpu_default_context(_tpu_warmup):
     test_utils.set_default_context(mx.tpu(0))
 
     # Per-test budget: the tunneled chip pays ~1-2 ms dispatch latency per
