@@ -35,6 +35,10 @@ VARIANTS = [
     ("B32-remat", {"MXTPU_BENCH_BATCH": "32", "MXTPU_BENCH_REMAT": "1"}),
     ("B8-onehot+BK256", {"MXTPU_EMBED_ONEHOT_GRAD": "1",
                          "MXTPU_FLASH_BK": "256"}),
+    # same tokens/step as the headline config, doubled sequence: probes
+    # whether the (512,512) flash tiles hold their efficiency as the
+    # attention share of credited FLOPs grows (L divides the tiles)
+    ("B4-L1024", {"MXTPU_BENCH_BATCH": "4", "MXTPU_BENCH_SEQ": "1024"}),
 ]
 
 
