@@ -27,6 +27,12 @@ import numpy as onp
 
 def main() -> None:
     import jax
+
+    # The axon plugin forces jax_platforms='axon,cpu' at interpreter boot,
+    # so the JAX_PLATFORMS env var alone cannot pin this probe to CPU for
+    # smoke runs — honor it in-process (unset → default device, the TPU).
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     import jax.numpy as jnp
 
     B, IN, OUT = (int(os.environ.get(k, d)) for k, d in

@@ -45,6 +45,25 @@ def test_bench_bert_remat_contract(monkeypatch, capsys):
     assert math.isfinite(rec["extra"]["loss"])
 
 
+def test_int8_probe_contract(monkeypatch, capsys):
+    # tiny shapes: the contract (one JSON dict, finite timings, HLO verdict
+    # booleans) is what's under test — the TPU window runs the real sizes
+    for k, v in (("MXTPU_INT8_BATCH", "64"), ("MXTPU_INT8_IN", "64"),
+                 ("MXTPU_INT8_OUT", "64"), ("MXTPU_INT8_ITERS", "2")):
+        monkeypatch.setenv(k, v)
+    import importlib.util
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "int8_probe", os.path.join(repo, "benchmark", "int8_probe.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod.main()
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["metric"] == "int8_dense_vs_bf16"
+    assert rec["int8_ms"] > 0 and rec["bf16_ms"] > 0
+    assert isinstance(rec["hlo_has_int8_dot"], bool)
+
+
 def test_bench_resnet_contract(monkeypatch, capsys):
     import math
     rec = _run_bench(monkeypatch, capsys, MXTPU_BENCH_WORKLOAD="resnet",
