@@ -46,8 +46,10 @@ METRIC_GATES = [
     ("word_language_model", "word_language_model.py",
      ["--steps", "40", "--epochs", "2"], 12.0, "lower"),
     # dcgan returns moment stats; the driver reduces them to the worst
-    # normalized distance (must stay < 1.0 to pass both test bounds)
-    ("dcgan", "dcgan.py", ["--steps", "150"], 1.0, "lower"),
+    # normalized distance (must stay < 1.0 to pass both test bounds).
+    # 300 steps: at 150 the r5 sweep measured worst 0.88 / spread 0.33
+    # (margin < 2x spread); at 300 the worst seed converges to 0.17
+    ("dcgan", "dcgan.py", ["--steps", "300"], 1.0, "lower"),
     ("ssd", "train_ssd.py", ["--steps", "150"], 0.8, "higher"),
     # 400 steps + threshold 0.5: with the reference head init the worst
     # observed seed scores 0.84; 0.5 is a convergence floor (random ~0.08)
