@@ -79,8 +79,9 @@ def test_train_ssd_example_detects():
 def test_train_frcnn_example_detects():
     # end-to-end Faster-RCNN recipe: RPN anchors -> MultiProposal ->
     # AnchorTarget/ProposalTarget -> 4-way loss -> per-class decode+NMS;
-    # same mAP proxy as the SSD gate. 400 steps / floor 0.5: with the
-    # reference Normal(0.01) head init the worst observed seed scores
-    # 0.84 (random ~0.08); the floor keeps margin >= 2x cross-seed spread
+    # same mAP proxy as the SSD gate. 400 steps / floor 0.25: the r5
+    # 20-seed sweep measured 0.75..1.0 (spread 0.25) with the reference
+    # Normal(0.01) head init; 0.25 keeps margin >= 2x that spread while
+    # staying >3x the untrained baseline (~0.08)
     acc = _load("train_frcnn.py").main(["--steps", "400"])
-    assert acc > 0.5, acc
+    assert acc > 0.25, acc

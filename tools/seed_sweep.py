@@ -51,10 +51,10 @@ METRIC_GATES = [
     # (margin < 2x spread); at 300 the worst seed converges to 0.17
     ("dcgan", "dcgan.py", ["--steps", "300"], 1.0, "lower"),
     ("ssd", "train_ssd.py", ["--steps", "150"], 0.8, "higher"),
-    # 400 steps + threshold 0.5: with the reference head init the worst
-    # observed seed scores 0.84; 0.5 is a convergence floor (random ~0.08)
-    # chosen so margin >= 2x the observed cross-seed spread
-    ("frcnn", "train_frcnn.py", ["--steps", "400"], 0.5, "higher"),
+    # 400 steps + threshold 0.25: the r5 20-seed sweep measured 0.75..1.0
+    # (spread 0.25); 0.25 keeps margin >= 2x that spread while staying >3x
+    # the untrained baseline (~0.08)
+    ("frcnn", "train_frcnn.py", ["--steps", "400"], 0.25, "higher"),
 ]
 
 # pytest-only gates (no exposed metric)
