@@ -33,6 +33,7 @@ from .attribute import AttrScope  # noqa: F401
 import importlib as _importlib
 
 _LAZY = {
+    "analysis": ".analysis",
     "gluon": ".gluon",
     "optimizer": ".optimizer",
     "initializer": ".initializer",

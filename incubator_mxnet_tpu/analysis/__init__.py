@@ -1,0 +1,66 @@
+"""``mx.analysis`` — static graph verification and JAX-pitfall linting.
+
+Reference counterpart: the correctness half of the nnvm pass infrastructure
+(``InferShape``/``InferType``, op-attr validation via ``dmlc::Parameter``,
+graph JSON checks) that rejected malformed programs before execution
+(SURVEY §2.2/§2.4) — generalized with the checks a JAX graft newly needs:
+tracer-leak linting, jit-recompilation accounting, and sharding/mesh
+consistency. Four pass families over one registry
+(:mod:`~incubator_mxnet_tpu.analysis.passes`, the ``NNVM_REGISTER_PASS``
+analogue):
+
+========================  ===========================================
+``graph_verify``          structure/registry/Schema/round-trip, MX0xx
+``infer_shapes``          abstract eval with provenance, MX1xx
+tracer lint + recompile   jit hygiene (AST + runtime), MX2xx
+``sharding``              PartitionSpec vs mesh, MX3xx
+========================  ===========================================
+
+Programmatic entry point::
+
+    report = mx.analysis.verify(sym, shapes={"data": (32, 784)})
+    report.raise_if_errors()
+
+CLI (models, examples and saved symbol JSON)::
+
+    python -m tools.mxlint incubator_mxnet_tpu/models examples net.json
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+from .diagnostics import CODES, Diagnostic, Report  # noqa: F401
+from .passes import (  # noqa: F401
+    PASSES, GraphPass, PassContext, get_pass, list_passes, register_pass,
+    run_passes,
+)
+from . import graph_verifier  # noqa: F401  (registers graph_verify)
+from . import shape_check  # noqa: F401  (registers infer_shapes)
+from . import sharding_check  # noqa: F401  (registers sharding)
+from .graph_verifier import tensor_arity  # noqa: F401
+from .sharding_check import check_sharding  # noqa: F401
+from .tracer_lint import lint_file, lint_paths, lint_source  # noqa: F401
+from .recompile import (  # noqa: F401
+    RECOMPILE_WARN_THRESHOLD, RecompileWarning, cache_report, note_compile,
+)
+
+__all__ = ["verify", "Report", "Diagnostic", "CODES", "register_pass",
+           "list_passes", "run_passes", "PassContext", "tensor_arity",
+           "check_sharding", "lint_source", "lint_file", "lint_paths",
+           "cache_report", "RecompileWarning", "RECOMPILE_WARN_THRESHOLD"]
+
+
+def verify(sym, shapes: Optional[Dict[str, tuple]] = None,
+           rules=None, mesh=None,
+           params: Optional[Dict[str, tuple]] = None,
+           passes: Optional[Sequence[str]] = None) -> Report:
+    """Run the analysis passes over one Symbol and return the
+    :class:`Report` (``report.ok`` / ``report.raise_if_errors()``).
+
+    ``shapes`` feeds the ``infer_shapes`` pass (it is skipped when the
+    graph has data variables with no shape given); ``rules`` + ``mesh``
+    (+ optional ``params`` name->shape) activate the ``sharding`` pass.
+    ``passes`` selects a subset by name (default: all registered).
+    """
+    return run_passes(sym, names=passes, shapes=shapes, rules=rules,
+                      mesh=mesh, params=params)

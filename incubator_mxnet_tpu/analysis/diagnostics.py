@@ -1,0 +1,122 @@
+"""Diagnostic records shared by every analysis pass.
+
+Reference counterpart: the error strings nnvm passes throw from
+``InferShape``/``InferType``/graph validation (``src/nnvm/``,
+``CHECK``/``LOG(FATAL)`` with node context). Here diagnostics are *data*
+rather than exceptions: every pass appends :class:`Diagnostic` rows carrying
+a stable machine-readable code plus node provenance, and a :class:`Report`
+aggregates them for programmatic use (``mx.analysis.verify``) and for the
+``mxlint`` CLI exit code.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+__all__ = ["Diagnostic", "Report", "CODES"]
+
+#: Stable diagnostic codes. The MX0xx family is graph structure, MX1xx is
+#: abstract shape/dtype evaluation, MX2xx is jit-cache/tracer hygiene, and
+#: MX3xx is sharding consistency. Codes are append-only: tools and CI grep
+#: for them, so a code's meaning never changes once released.
+CODES = {
+    "MX001": "graph contains a cycle",
+    "MX002": "duplicate node name",
+    "MX003": "unknown operator (not in the op registry)",
+    "MX004": "input arity mismatch vs the registered operator",
+    "MX005": "attribute rejected by the operator's declared Schema",
+    "MX006": "JSON serialization does not round-trip stably",
+    "MX007": "file is not valid JSON or failed to load as a symbol graph",
+    "MX008": "multi-output slice index out of range for its base node",
+    "MX101": "abstract shape/dtype evaluation failed",
+    "MX200": "source file does not parse (nothing in it can be linted)",
+    "MX201": "recompilation hazard: jit cache holds many distinct signatures",
+    "MX202": "print() on a traced value inside a hybridized forward",
+    "MX203": "float()/bool()/int() forces a traced value to a Python scalar",
+    "MX204": "Python control flow (if/while/assert) on a traced value",
+    "MX205": "host numpy call on a traced value",
+    "MX206": "traced value stored on self during trace (leaked tracer)",
+    "MX301": "PartitionSpec names a mesh axis the mesh does not declare",
+    "MX302": "PartitionSpec rank/divisibility mismatch with the parameter",
+    "MX303": "conflicting PartitionSpecs match the same parameter",
+}
+
+
+@dataclass
+class Diagnostic:
+    """One finding: a stable code, a human message, and where it happened.
+
+    ``node`` is the graph-node name (or ``file:line`` for source lints),
+    ``op`` the operator name (or ``Class.method`` for source lints), and
+    ``attrs`` the offending node's public attribute dict — the same
+    provenance triple the shape checker threads through
+    :class:`~incubator_mxnet_tpu.symbol.GraphInferenceError`.
+    """
+
+    code: str
+    message: str
+    node: Optional[str] = None
+    op: Optional[str] = None
+    attrs: Optional[dict] = None
+    pass_name: str = ""
+    severity: str = "error"  # "error" | "warning"
+
+    def __post_init__(self):
+        if self.code not in CODES:
+            raise ValueError(f"unknown diagnostic code {self.code!r}; "
+                             f"register it in analysis.diagnostics.CODES")
+
+    def __str__(self):
+        where = self.node or "<graph>"
+        op = f" (op {self.op!r})" if self.op else ""
+        return f"{where}: {self.code}{op}: {self.message}"
+
+
+@dataclass
+class Report:
+    """Ordered diagnostics from one analysis run."""
+
+    diagnostics: List[Diagnostic] = field(default_factory=list)
+    #: passes that could not run (e.g. shape pass without input shapes)
+    skipped: List[str] = field(default_factory=list)
+
+    def add(self, diag: Diagnostic) -> None:
+        self.diagnostics.append(diag)
+
+    def extend(self, other: "Report") -> None:
+        self.diagnostics.extend(other.diagnostics)
+        self.skipped.extend(other.skipped)
+
+    @property
+    def errors(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity == "error"]
+
+    @property
+    def warnings(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity == "warning"]
+
+    @property
+    def ok(self) -> bool:
+        return not self.errors
+
+    def codes(self) -> List[str]:
+        return [d.code for d in self.diagnostics]
+
+    def raise_if_errors(self) -> "Report":
+        if self.errors:
+            from ..base import MXNetError
+            raise MXNetError(
+                "graph verification failed:\n" +
+                "\n".join(f"  {d}" for d in self.errors))
+        return self
+
+    def __iter__(self):
+        return iter(self.diagnostics)
+
+    def __len__(self):
+        return len(self.diagnostics)
+
+    def __str__(self):
+        if not self.diagnostics:
+            return "clean (0 diagnostics)"
+        return "\n".join(str(d) for d in self.diagnostics)

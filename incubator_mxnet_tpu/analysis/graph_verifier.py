@@ -1,0 +1,240 @@
+"""Whole-graph structural verifier (pass 1).
+
+Reference counterpart: the validation nnvm does piecemeal at pass time —
+``InferShape``/``InferType`` arity checks, op-attr parsing through
+``dmlc::Parameter``, and the JSON loader's index checks
+(``src/nnvm/graph.cc``). Here it is ONE inspection pass over the Symbol DAG:
+
+- **MX001** cycle detection (a malformed graph must fail here, not hang a
+  later walk),
+- **MX002** duplicate node names (serialization and Monitor capture key by
+  name),
+- **MX003** ops missing from the registry,
+- **MX004** input arity vs the registered op's tensor slots (introspected
+  from the op function's signature minus its Schema fields),
+- **MX005** per-node re-validation of attrs against the op's declared
+  ``Schema`` (the dmlc::Parameter contract, checked *after* composition so
+  hand-built or deserialized graphs are covered too),
+- **MX006** JSON wire-format round-trip stability (``tojson`` →
+  ``load_json`` → ``tojson`` must converge, including nested ``sub``-attr
+  subgraphs from the control-flow ops and ``subgraph.py`` partitioning).
+
+Subgraphs riding in node attrs (control flow bodies, ``_subgraph_exec``
+regions) are verified recursively with ``parent/child`` provenance.
+"""
+from __future__ import annotations
+
+import inspect
+import json
+from typing import Dict, List, Optional, Tuple
+
+from .diagnostics import Report
+from .passes import PassContext, register_pass
+
+__all__ = ["verify_graph", "tensor_arity"]
+
+#: structural pseudo-ops that never appear in the op registry
+_STRUCTURAL_OPS = {"_group"}
+
+
+def _children(node) -> List:
+    out = list(node._inputs)
+    if node._base is not None:
+        out.append(node._base)
+    return out
+
+
+def _find_cycle(root) -> Optional[str]:
+    """Iterative three-color DFS; returns the name of a node on a cycle."""
+    GREY, BLACK = 1, 2
+    color: Dict[int, int] = {}
+    stack: List[Tuple[object, iter]] = [(root, iter(_children(root)))]
+    color[id(root)] = GREY
+    while stack:
+        node, it = stack[-1]
+        child = next(it, None)
+        if child is None:
+            color[id(node)] = BLACK
+            stack.pop()
+            continue
+        c = color.get(id(child))
+        if c == GREY:
+            return child._name
+        if c is None:
+            color[id(child)] = GREY
+            stack.append((child, iter(_children(child))))
+    return None
+
+
+def _collect(root) -> List:
+    """All reachable nodes (inputs + base edges), deterministic order."""
+    seen: Dict[int, object] = {}
+    order: List = []
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        if id(node) in seen:
+            continue
+        seen[id(node)] = node
+        order.append(node)
+        stack.extend(reversed(_children(node)))
+    return order
+
+
+def tensor_arity(opdef) -> Optional[Tuple[int, Optional[int]]]:
+    """(min, max) tensor-input slots of a registered op: positional
+    parameters of the op function that are not Schema fields. ``max`` is
+    None for variadic ops (``*arrays``); returns None when the signature
+    cannot be introspected."""
+    try:
+        sig = inspect.signature(opdef.fn)
+    except (TypeError, ValueError):
+        return None
+    fields = opdef.schema.fields if opdef.schema is not None else {}
+    lo, hi = 0, 0
+    for p in sig.parameters.values():
+        if p.kind is p.VAR_POSITIONAL:
+            return lo, None
+        if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD):
+            if p.name in fields:
+                continue
+            hi += 1
+            if p.default is p.empty:
+                lo += 1
+    return lo, hi
+
+
+def _public_attrs(node) -> dict:
+    return {k: v for k, v in node._attrs.items() if not k.startswith("_")}
+
+
+def _sub_symbols(attrs):
+    """(key, root Symbol) pairs for every subgraph riding in an attr dict:
+    a bare Symbol value, a list of Symbols, or the control-flow/partitioner
+    ``sub`` wire shape ``{"roots": [...], "arg_names": [...]}``."""
+    from .. import symbol as S
+    for k, v in attrs.items():
+        if isinstance(v, S.Symbol):
+            yield k, v
+        elif isinstance(v, dict) and isinstance(v.get("roots"), (list, tuple)):
+            for i, r in enumerate(v["roots"]):
+                if isinstance(r, S.Symbol):
+                    yield f"{k}.roots[{i}]", r
+        elif isinstance(v, (list, tuple)):
+            for i, r in enumerate(v):
+                if isinstance(r, S.Symbol):
+                    yield f"{k}[{i}]", r
+
+
+def _check_nodes(ctx: PassContext, root, prefix: str = "") -> None:
+    """Cycle, name, registry, arity and schema checks for one graph level;
+    recurses into attr subgraphs with ``prefix`` provenance."""
+    from .. import symbol as S
+    from ..ops import OPS
+
+    cyc = _find_cycle(root)
+    if cyc is not None:
+        ctx.diag("MX001", "graph contains a cycle (reached its own "
+                 "ancestor); downstream checks skipped for this graph",
+                 node=prefix + cyc, pass_name="graph_verify")
+        return
+
+    nodes = _collect(root)
+
+    # Multi-output slices are counted once per (base, output index):
+    # Symbol.__getitem__ mints a fresh node per access, so the same logical
+    # slice can be reachable several times under one (deterministic) name.
+    by_name: Dict[str, int] = {}
+    slices_seen = set()
+    for n in nodes:
+        if n._base is not None:
+            key = (id(n._base), n._output_index)
+            if key in slices_seen:
+                continue
+            slices_seen.add(key)
+        by_name[n._name] = by_name.get(n._name, 0) + 1
+    for name, count in sorted(by_name.items()):
+        if count > 1:
+            ctx.diag("MX002", f"{count} distinct nodes share the name "
+                     f"{name!r}; serialization and Monitor capture key by "
+                     "name", node=prefix + name, pass_name="graph_verify")
+
+    for n in nodes:
+        if n._base is not None:  # multi-output slice: only the index is its
+            if n._output_index >= n._base._num_outputs:  # own to check
+                ctx.diag("MX008", f"output index {n._output_index} out of "
+                         f"range: base '{n._base._name}' declares "
+                         f"{n._base._num_outputs} output(s)",
+                         node=prefix + n._name, op=n._base._op,
+                         pass_name="graph_verify")
+            continue
+        if n._op is None:
+            if n._inputs:
+                ctx.diag("MX004", "variable node has inputs "
+                         f"({len(n._inputs)}); variables must be leaves",
+                         node=prefix + n._name, op="null",
+                         pass_name="graph_verify")
+            continue
+        if n._op in _STRUCTURAL_OPS:
+            continue
+        if n._op in S._SCALAR_OPS:
+            if len(n._inputs) != 1:
+                ctx.diag("MX004", f"scalar op takes exactly 1 input, got "
+                         f"{len(n._inputs)}", node=prefix + n._name,
+                         op=n._op, pass_name="graph_verify")
+            continue
+        opdef = OPS.get(n._op)
+        if opdef is None:
+            ctx.diag("MX003", f"op {n._op!r} is not in the op registry "
+                     "(unknown or unregistered at load time)",
+                     node=prefix + n._name, op=n._op,
+                     pass_name="graph_verify")
+            continue
+        arity = tensor_arity(opdef)
+        if arity is not None:
+            lo, hi = arity
+            got = len(n._inputs)
+            if got < lo or (hi is not None and got > hi):
+                want = f"{lo}" if hi == lo else (
+                    f"{lo}+" if hi is None else f"{lo}..{hi}")
+                ctx.diag("MX004", f"op expects {want} tensor input(s), "
+                         f"got {got}", node=prefix + n._name, op=n._op,
+                         attrs=_public_attrs(n), pass_name="graph_verify")
+        if opdef.schema is not None:
+            attrs = _public_attrs(n)
+            try:
+                opdef.schema.validate(opdef.name, attrs)
+            except (TypeError, ValueError) as e:
+                ctx.diag("MX005", str(e), node=prefix + n._name, op=n._op,
+                         attrs=attrs, pass_name="graph_verify")
+        for key, sub in _sub_symbols(n._attrs):
+            _check_nodes(ctx, sub, prefix=f"{prefix}{n._name}.{key}/")
+
+
+def _check_roundtrip(ctx: PassContext, root) -> None:
+    from .. import symbol as S
+    try:
+        j1 = root.tojson()
+        j2 = S.load_json(j1).tojson()
+    except Exception as e:  # unserializable attr, loader failure, ...
+        ctx.diag("MX006", f"JSON round-trip raised {type(e).__name__}: {e}",
+                 node=root._name, pass_name="graph_verify")
+        return
+    if json.loads(j1) != json.loads(j2):
+        ctx.diag("MX006", "serialize -> load -> serialize does not "
+                 "converge: an attr value does not survive the wire format "
+                 "(repr/literal_eval round-trip)", node=root._name,
+                 pass_name="graph_verify")
+
+
+@register_pass("graph_verify",
+               describe="structure, registry, arity, Schema and JSON "
+                        "round-trip checks (MX001-MX006)")
+def verify_graph(ctx: PassContext) -> None:
+    """Structural verifier over ``ctx.sym`` — see module docstring."""
+    before = len(ctx.report.diagnostics)
+    _check_nodes(ctx, ctx.sym)
+    cyclic = any(d.code == "MX001"
+                 for d in ctx.report.diagnostics[before:])
+    if not cyclic:  # a cyclic graph cannot be serialized meaningfully
+        _check_roundtrip(ctx, ctx.sym)

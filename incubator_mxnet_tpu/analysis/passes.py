@@ -1,0 +1,96 @@
+"""Analysis pass registry.
+
+Reference counterpart: the nnvm pass registry (``nnvm::PassFunctionReg``,
+``src/nnvm/pass.cc`` — passes are named, registered globally, declare what
+they depend on, and are applied to a Graph by name). Graph passes here are
+pure inspections: ``fn(PassContext) -> None`` appends
+:class:`~.diagnostics.Diagnostic` rows and never mutates the Symbol (rewrites
+live in ``mx.subgraph``; this layer only *judges* graphs).
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence
+
+from .diagnostics import Diagnostic, Report
+
+__all__ = ["PassContext", "GraphPass", "register_pass", "list_passes",
+           "get_pass", "run_passes", "PASSES"]
+
+
+@dataclass
+class PassContext:
+    """Everything a pass may consult. ``sym`` is the graph under analysis;
+    the optional fields parameterize individual passes (the shape pass needs
+    input ``shapes``, the sharding pass needs ``rules`` + ``mesh`` +
+    parameter ``params``) — a pass that lacks its inputs records itself in
+    ``report.skipped`` instead of failing."""
+
+    sym: object = None
+    shapes: Optional[Dict[str, tuple]] = None
+    rules: object = None          # parallel.sharding.ShardingRules
+    mesh: object = None           # jax.sharding.Mesh
+    params: Optional[Dict[str, tuple]] = None  # param name -> shape
+    report: Report = field(default_factory=Report)
+
+    def diag(self, code: str, message: str, node: Optional[str] = None,
+             op: Optional[str] = None, attrs: Optional[dict] = None,
+             pass_name: str = "", severity: str = "error") -> None:
+        self.report.add(Diagnostic(code, message, node=node, op=op,
+                                   attrs=attrs, pass_name=pass_name,
+                                   severity=severity))
+
+
+@dataclass
+class GraphPass:
+    name: str
+    fn: Callable[[PassContext], None]
+    describe: str = ""
+
+    def __call__(self, ctx: PassContext) -> None:
+        self.fn(ctx)
+
+
+#: name -> GraphPass, in registration order (= default execution order, the
+#: nnvm convention: structural validity before semantic passes).
+PASSES: "OrderedDict[str, GraphPass]" = OrderedDict()
+
+
+def register_pass(name: Optional[str] = None, describe: str = ""):
+    """Register an analysis pass; usable as ``@register_pass()`` or
+    ``@register_pass("name", describe="...")`` — the ``NNVM_REGISTER_PASS``
+    analogue."""
+
+    def _do(fn: Callable[[PassContext], None]) -> Callable:
+        pname = name or fn.__name__
+        PASSES[pname] = GraphPass(pname, fn,
+                                  describe or (fn.__doc__ or "").split("\n")[0])
+        return fn
+
+    return _do
+
+
+def list_passes() -> List[str]:
+    return list(PASSES)
+
+
+def get_pass(name: str) -> GraphPass:
+    if name not in PASSES:
+        from ..base import MXNetError
+        raise MXNetError(f"unknown analysis pass {name!r}; registered: "
+                         f"{list_passes()}")
+    return PASSES[name]
+
+
+def run_passes(sym, names: Optional[Sequence[str]] = None,
+               shapes: Optional[Dict[str, tuple]] = None,
+               rules=None, mesh=None,
+               params: Optional[Dict[str, tuple]] = None) -> Report:
+    """Apply the named passes (default: all registered, in order) to one
+    Symbol and return the merged Report."""
+    ctx = PassContext(sym=sym, shapes=shapes, rules=rules, mesh=mesh,
+                      params=params)
+    for name in (names if names is not None else list_passes()):
+        get_pass(name)(ctx)
+    return ctx.report

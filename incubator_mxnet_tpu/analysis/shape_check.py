@@ -1,0 +1,45 @@
+"""Abstract shape/dtype checker (pass 2).
+
+Reference counterpart: the nnvm ``InferShape``/``InferType`` passes
+(SURVEY §2.2) which walk the graph propagating shapes and fail with the
+offending node. Here the walk is ``jax.eval_shape`` over the same evaluator
+the executor uses (``symbol._infer_graph_shapes``): every op's abstract
+evaluation is free, and a failure surfaces as
+:class:`~incubator_mxnet_tpu.symbol.GraphInferenceError` carrying node
+provenance (node name, op name, public attrs) instead of a raw JAX
+traceback. This pass converts that into an **MX101** diagnostic.
+
+The pass needs input shapes (``PassContext.shapes``). When they are absent
+and the graph has unresolved data variables, the pass records itself as
+skipped rather than failing — shape checking without shapes is not a graph
+error.
+"""
+from __future__ import annotations
+
+from .passes import PassContext, register_pass
+
+__all__ = ["check_shapes"]
+
+
+@register_pass("infer_shapes",
+               describe="whole-graph jax.eval_shape walk with node "
+                        "provenance (MX101)")
+def check_shapes(ctx: PassContext) -> None:
+    from ..base import MXNetError
+    from ..symbol import GraphInferenceError
+
+    if any(d.code == "MX001" for d in ctx.report.diagnostics):
+        # structural validity gates semantic passes (the nnvm pass-dependency
+        # rule): a cyclic graph has no topological walk to evaluate
+        ctx.report.skipped.append("infer_shapes: graph is cyclic (MX001)")
+        return
+    shapes = ctx.shapes or {}
+    try:
+        ctx.sym.infer_shape(**{k: tuple(v) for k, v in shapes.items()})
+    except GraphInferenceError as e:
+        ctx.diag("MX101", e.reason, node=e.node_name, op=e.op,
+                 attrs=e.attrs, pass_name="infer_shapes")
+    except MXNetError as e:
+        # unresolved input shapes / unknown op: owned by graph_verify or
+        # by the caller not supplying shapes — not a shape-semantics error
+        ctx.report.skipped.append(f"infer_shapes: {e}")

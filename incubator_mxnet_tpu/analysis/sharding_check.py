@@ -1,0 +1,117 @@
+"""Sharding-consistency pass (pass 4).
+
+Reference counterpart: the kvstore's layout decisions were runtime code
+paths that failed loudly; here a layout is a *declarative*
+``(regex -> PartitionSpec)`` table (``parallel/sharding.py``) matched
+against a named mesh (``parallel/mesh.py``) — and a typo'd axis name or a
+rank-mismatched spec silently degrades to replicated (``spec_for`` falls
+back to ``P()``), which trains correctly but N× slower. This pass makes
+those silent fallbacks visible:
+
+- **MX301** a spec names an axis the mesh does not declare,
+- **MX302** spec rank exceeds the parameter rank, or the mesh axes don't
+  divide the dimension (warning: legal, but silently replicates),
+- **MX303** conflicting specs — the same pattern registered twice with
+  different specs (error), or one parameter matched by several rules with
+  different specs where only the first wins (warning).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+from .diagnostics import Diagnostic, Report
+from .passes import PassContext, register_pass
+
+__all__ = ["check_sharding"]
+
+
+def _spec_axes(spec):
+    """Flat axis-name list of a PartitionSpec entry tuple."""
+    out = []
+    for entry in tuple(spec):
+        if entry is None:
+            continue
+        for a in (entry if isinstance(entry, tuple) else (entry,)):
+            out.append(a)
+    return out
+
+
+def check_sharding(rules, mesh,
+                   params: Optional[Dict[str, Tuple[int, ...]]] = None,
+                   ) -> Report:
+    """Validate a :class:`~incubator_mxnet_tpu.parallel.sharding.ShardingRules`
+    table against ``mesh`` and (optionally) concrete parameter shapes."""
+    report = Report()
+    axis_names = set(mesh.axis_names)
+    seen_patterns: Dict[str, object] = {}
+    for pat, spec in rules._rules:
+        for axis in _spec_axes(spec):
+            if axis not in axis_names:
+                report.add(Diagnostic(
+                    "MX301",
+                    f"spec {spec} names mesh axis {axis!r}, but the mesh "
+                    f"declares {sorted(axis_names)}",
+                    node=pat.pattern, op="sharding_rule",
+                    pass_name="sharding"))
+        if pat.pattern in seen_patterns and \
+                seen_patterns[pat.pattern] != spec:
+            report.add(Diagnostic(
+                "MX303",
+                f"pattern registered twice with different specs: "
+                f"{seen_patterns[pat.pattern]} vs {spec} (first wins)",
+                node=pat.pattern, op="sharding_rule", pass_name="sharding"))
+        seen_patterns.setdefault(pat.pattern, spec)
+
+    for name, shape in (params or {}).items():
+        shape = tuple(shape)
+        matches = [(pat, spec) for pat, spec in rules._rules
+                   if pat.search(name)]
+        if not matches:
+            continue
+        distinct = []
+        for _, spec in matches:
+            if spec not in distinct:
+                distinct.append(spec)
+        if len(distinct) > 1:
+            report.add(Diagnostic(
+                "MX303",
+                f"matched by {len(matches)} rules with different specs "
+                f"{distinct}; first ({distinct[0]}) wins",
+                node=name, op="param", pass_name="sharding",
+                severity="warning"))
+        pat, spec = matches[0]
+        entries = tuple(spec)
+        if len(entries) > len(shape):
+            report.add(Diagnostic(
+                "MX302",
+                f"spec {spec} has rank {len(entries)} but parameter shape "
+                f"{shape} has rank {len(shape)}; spec_for silently "
+                "replicates this parameter",
+                node=name, op="param", pass_name="sharding"))
+            continue
+        for dim, entry in zip(shape, entries):
+            if entry is None:
+                continue
+            size = 1
+            for a in (entry if isinstance(entry, tuple) else (entry,)):
+                size *= mesh.shape.get(a, 1)
+            if size and dim % size:
+                report.add(Diagnostic(
+                    "MX302",
+                    f"dim {dim} not divisible by mesh axes {entry} "
+                    f"(size {size}); spec_for silently replicates this "
+                    "parameter",
+                    node=name, op="param", pass_name="sharding",
+                    severity="warning"))
+    return report
+
+
+@register_pass("sharding",
+               describe="PartitionSpec vs mesh-axis consistency "
+                        "(MX301-MX303)")
+def _sharding_pass(ctx: PassContext) -> None:
+    if ctx.rules is None or ctx.mesh is None:
+        ctx.report.skipped.append(
+            "sharding: needs rules= and mesh= (pass them to verify())")
+        return
+    ctx.report.extend(check_sharding(ctx.rules, ctx.mesh, ctx.params))

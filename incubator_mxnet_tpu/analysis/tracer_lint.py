@@ -1,0 +1,431 @@
+"""JAX-pitfall source linter (pass 3, static half).
+
+The reference's ``hybrid_forward`` contract ("F is mx.nd or mx.sym — write
+code that works under both") maps here to "the body is traced by jax.jit":
+Python side effects on traced values are silent correctness/perf bugs the
+reference never had. This linter walks ``forward``/``hybrid_forward`` bodies
+of ``HybridBlock``-derived classes (``gluon/block.py`` lineage) with a small
+taint analysis — the data arguments (and ``**param`` kwargs) are traced;
+taint propagates through arithmetic, indexing, method calls and assignment,
+and is *dropped* by static accessors (``.shape``/``.ndim``/``.dtype``,
+``len``, ``isinstance``, ``str``) so shape-polymorphic idioms stay clean.
+
+Flagged constructs (the MX2xx tracer-hygiene family):
+
+- **MX202** ``print(traced)`` — executes once at trace time, then never
+  again; the printed value is a tracer, not data.
+- **MX203** ``float()/bool()/int()`` (or ``.item()``/``.asscalar()``) on a
+  traced value — concretization error under jit, silent recompile trigger
+  at best.
+- **MX204** ``if``/``while``/``assert``/ternary on a traced value — Python
+  control flow cannot branch on tracers; use ``F.where``/``lax.cond``.
+- **MX205** host ``numpy`` calls (or ``.asnumpy()``/``.tolist()``) on a
+  traced value — leaves the compiled graph, breaks under jit.
+- **MX206** storing a traced value on ``self`` — the classic leaked-tracer
+  bug: the attribute outlives the trace and poisons the next call
+  (``UnexpectedTracerError``).
+
+Pure-AST: no imports of the linted module, so models and examples lint in
+milliseconds and broken files report a diagnostic instead of crashing.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from .diagnostics import Diagnostic, Report
+
+__all__ = ["lint_source", "lint_file", "lint_paths"]
+
+#: calls whose result is host data regardless of argument taint
+_SANITIZERS = {"isinstance", "issubclass", "len", "hasattr", "getattr",
+               "type", "str", "repr", "id", "callable", "dir", "vars"}
+
+#: attributes that are static under tracing (aval metadata, not data)
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "context", "ctx", "name"}
+
+#: tensor methods that force a host scalar (MX203 when receiver is traced)
+_SCALARIZERS = {"item", "asscalar"}
+
+#: tensor methods that force a host array (MX205 when receiver is traced)
+_HOSTIFIERS = {"asnumpy", "tolist"}
+
+_FORWARD_METHODS = {"forward", "hybrid_forward"}
+
+
+def _base_names(cls: ast.ClassDef) -> List[str]:
+    out = []
+    for b in cls.bases:
+        if isinstance(b, ast.Name):
+            out.append(b.id)
+        elif isinstance(b, ast.Attribute):
+            out.append(b.attr)
+    return out
+
+
+def _hybrid_classes(tree: ast.Module) -> List[ast.ClassDef]:
+    """ClassDefs deriving (transitively, within this file) from
+    HybridBlock. Plain ``Block`` forwards run eagerly and may use numpy
+    freely, so only the hybridizable lineage is linted."""
+    classes = [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]
+    hybrid: Set[str] = {"HybridBlock"}
+    changed = True
+    while changed:
+        changed = False
+        for c in classes:
+            if c.name not in hybrid and any(b in hybrid
+                                            for b in _base_names(c)):
+                hybrid.add(c.name)
+                changed = True
+    return [c for c in classes if c.name in hybrid and c.name != "HybridBlock"]
+
+
+def _numpy_bindings(tree: ast.Module) -> Tuple[Set[str], Set[str]]:
+    """(module aliases bound to numpy, names imported from numpy)."""
+    mods: Set[str] = set()
+    funcs: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "numpy" or a.name.startswith("numpy."):
+                    mods.add(a.asname or a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            if node.module and (node.module == "numpy"
+                                or node.module.startswith("numpy.")):
+                for a in node.names:
+                    funcs.add(a.asname or a.name)
+    return mods, funcs
+
+
+class _MethodLinter:
+    """Single-pass taint walk over one forward/hybrid_forward body."""
+
+    def __init__(self, filename: str, cls: str, fn: ast.FunctionDef,
+                 np_mods: Set[str], np_funcs: Set[str],
+                 report: Report, hybrid: bool):
+        self.filename = filename
+        self.where = f"{cls}.{fn.name}"
+        self.np_mods = np_mods
+        self.np_funcs = np_funcs
+        self.report = report
+        self.tainted: Set[str] = set()
+        args = fn.args
+        names = [a.arg for a in args.posonlyargs + args.args
+                 + args.kwonlyargs]
+        skip = {"self"}
+        if fn.name == "hybrid_forward" and len(names) >= 2:
+            skip.add(names[1])  # F — the nd/sym namespace, not a tensor
+        # defaulted params are config kwargs, not tensors: _call_cached_op
+        # folds non-NDArray args into the static cache key, so
+        # `forward(self, x, training=True)` never traces `training` (the
+        # same heuristic the nested-def branch applies)
+        pos = args.posonlyargs + args.args
+        n_def = len(args.defaults)
+        for a in pos[len(pos) - n_def:] if n_def else ():
+            skip.add(a.arg)
+        for a, d in zip(args.kwonlyargs, args.kw_defaults):
+            if d is not None:
+                skip.add(a.arg)
+        for n in names:
+            if n not in skip:
+                self.tainted.add(n)
+        #: tainted names known to be Python containers *holding* tracers
+        #: (the *args tuple, list literals of tensors): truthiness/len of
+        #: the container itself never touches a tracer, so MX204 must not
+        #: fire on `if args:` — only element access re-enters taint.
+        self.containers: Set[str] = set()
+        for va in (args.vararg, args.kwarg):
+            if va is not None:
+                self.tainted.add(va.arg)
+                self.containers.add(va.arg)
+        self.hybrid = hybrid
+
+    # -- reporting ------------------------------------------------------
+    def _diag(self, code: str, message: str, node: ast.AST) -> None:
+        self.report.add(Diagnostic(
+            code, message,
+            node=f"{self.filename}:{getattr(node, 'lineno', 0)}",
+            op=self.where, pass_name="tracer_lint"))
+
+    # -- taint of an expression ----------------------------------------
+    def taints(self, e: Optional[ast.AST]) -> bool:
+        if e is None or isinstance(e, (ast.Constant, ast.Lambda,
+                                       ast.JoinedStr, ast.FormattedValue)):
+            return False
+        if isinstance(e, ast.Name):
+            return e.id in self.tainted
+        if isinstance(e, ast.Attribute):
+            if e.attr in _STATIC_ATTRS:
+                return False
+            return self.taints(e.value)
+        if isinstance(e, ast.Subscript):
+            return self.taints(e.value)
+        if isinstance(e, ast.Call):
+            if isinstance(e.func, ast.Name) and (
+                    e.func.id in _SANITIZERS
+                    or e.func.id in ("float", "bool", "int")):
+                return False  # result is host data (misuse flagged apart)
+            parts = list(e.args) + [k.value for k in e.keywords]
+            if isinstance(e.func, ast.Attribute):
+                parts.append(e.func.value)
+            return any(self.taints(p) for p in parts)
+        if isinstance(e, ast.BinOp):
+            return self.taints(e.left) or self.taints(e.right)
+        if isinstance(e, ast.UnaryOp):
+            return self.taints(e.operand)
+        if isinstance(e, ast.BoolOp):
+            return any(self.taints(v) for v in e.values)
+        if isinstance(e, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn))
+                   for op in e.ops):
+                return False  # identity/membership, not a tensor compare
+            return any(self.taints(x) for x in [e.left] + e.comparators)
+        if isinstance(e, ast.IfExp):
+            return self.taints(e.body) or self.taints(e.orelse)
+        if isinstance(e, (ast.Tuple, ast.List, ast.Set)):
+            return any(self.taints(x) for x in e.elts)
+        if isinstance(e, ast.Dict):
+            return any(self.taints(v) for v in e.values if v is not None)
+        if isinstance(e, ast.Starred):
+            return self.taints(e.value)
+        if isinstance(e, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            return any(self.taints(g.iter) for g in e.generators) \
+                or self.taints(e.elt)
+        if isinstance(e, ast.DictComp):
+            return any(self.taints(g.iter) for g in e.generators) \
+                or self.taints(e.value)
+        return False  # conservative: unknown constructs don't taint
+
+    def _container_truth(self, test: ast.AST) -> bool:
+        """`if args:` / `if not ys:` where the name is a known container of
+        traced values — truthiness of the container is host data."""
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            return self._container_truth(test.operand)
+        return isinstance(test, ast.Name) and test.id in self.containers
+
+    def _is_numpy_call(self, call: ast.Call) -> bool:
+        f = call.func
+        if isinstance(f, ast.Name):
+            return f.id in self.np_funcs
+        root = f
+        while isinstance(root, ast.Attribute):
+            root = root.value
+        return isinstance(root, ast.Name) and root.id in self.np_mods
+
+    # -- per-statement checks ------------------------------------------
+    @staticmethod
+    def _own_exprs(stmt: ast.stmt) -> List[ast.AST]:
+        """The expressions evaluated by this statement itself — compound
+        bodies are linted by recursion with their own (updated) taint
+        state, so only headers are inspected here."""
+        if isinstance(stmt, (ast.If, ast.While)):
+            return [stmt.test]
+        if isinstance(stmt, ast.For):
+            return [stmt.iter]
+        if isinstance(stmt, ast.With):
+            return [i.context_expr for i in stmt.items]
+        if isinstance(stmt, ast.FunctionDef):
+            return list(stmt.args.defaults) + list(stmt.args.kw_defaults)
+        if isinstance(stmt, ast.Try):
+            return []
+        return [stmt]
+
+    def _check_calls(self, stmt: ast.stmt) -> None:
+        for e in [w for x in self._own_exprs(stmt) if x is not None
+                  for w in ast.walk(x)]:
+            if isinstance(e, ast.IfExp) and self.taints(e.test) \
+                    and not self._container_truth(e.test):
+                self._diag("MX204", "ternary on a traced value; tracers "
+                           "have no truth value — use F.where / lax.cond", e)
+            if isinstance(e, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                              ast.DictComp)):
+                # comp targets bound from a tainted iterable are tainted
+                # while we judge that generator's `if` clauses
+                comp_vars = {n.id for g in e.generators if self.taints(g.iter)
+                             for n in ast.walk(g.target)
+                             if isinstance(n, ast.Name)}
+                for g in e.generators:
+                    for cond in g.ifs:
+                        if self._container_truth(cond):
+                            continue
+                        if self.taints(cond) or any(
+                                isinstance(n, ast.Name) and n.id in comp_vars
+                                for n in ast.walk(cond)):
+                            self._diag("MX204", "comprehension `if` on a "
+                                       "traced value; tracers have no truth "
+                                       "value — use F.where / lax.cond", e)
+            if not isinstance(e, ast.Call):
+                continue
+            arg_tainted = any(self.taints(a) for a in e.args) or any(
+                self.taints(k.value) for k in e.keywords)
+            if isinstance(e.func, ast.Name):
+                if e.func.id == "print" and arg_tainted:
+                    self._diag("MX202", "print() on a traced value runs "
+                               "once at trace time; use jax.debug.print or "
+                               "a Monitor", e)
+                elif e.func.id in ("float", "bool", "int") and arg_tainted:
+                    self._diag("MX203", f"{e.func.id}() concretizes a "
+                               "traced value (ConcretizationTypeError "
+                               "under jit)", e)
+            if isinstance(e.func, ast.Attribute) and self.taints(e.func.value):
+                if e.func.attr in _SCALARIZERS:
+                    self._diag("MX203", f".{e.func.attr}() concretizes a "
+                               "traced value to a host scalar", e)
+                elif e.func.attr in _HOSTIFIERS:
+                    self._diag("MX205", f".{e.func.attr}() pulls a traced "
+                               "value to the host; keep compute in F/jnp",
+                               e)
+            if self._is_numpy_call(e) and arg_tainted:
+                self._diag("MX205", "host numpy call on a traced value "
+                           "breaks under jit; use the F namespace / "
+                           "jax.numpy", e)
+
+    def _assign_target(self, tgt: ast.AST, tainted: bool,
+                       stmt: ast.stmt) -> None:
+        if isinstance(tgt, ast.Name):
+            if tainted:
+                self.tainted.add(tgt.id)
+            else:
+                self.tainted.discard(tgt.id)
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for elt in tgt.elts:
+                self._assign_target(elt, tainted, stmt)
+        elif isinstance(tgt, ast.Starred):
+            self._assign_target(tgt.value, tainted, stmt)
+        elif isinstance(tgt, ast.Attribute):
+            root = tgt
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            if tainted and isinstance(root, ast.Name) and root.id == "self" \
+                    and self.hybrid:
+                self._diag("MX206", f"traced value stored on self."
+                           f"{tgt.attr} escapes the trace (leaked tracer: "
+                           "UnexpectedTracerError on reuse)", stmt)
+
+    def run(self, body: List[ast.stmt]) -> None:
+        for stmt in body:
+            self._stmt(stmt)
+
+    def _stmt(self, stmt: ast.stmt) -> None:
+        self._check_calls(stmt)
+        if isinstance(stmt, ast.Assign):
+            t = self.taints(stmt.value)
+            is_cont = isinstance(stmt.value, (
+                ast.Tuple, ast.List, ast.Set, ast.Dict, ast.ListComp,
+                ast.SetComp, ast.DictComp, ast.GeneratorExp))
+            for tgt in stmt.targets:
+                self._assign_target(tgt, t, stmt)
+                if isinstance(tgt, ast.Name):
+                    if t and is_cont:
+                        self.containers.add(tgt.id)
+                    else:
+                        self.containers.discard(tgt.id)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            self._assign_target(stmt.target, self.taints(stmt.value), stmt)
+        elif isinstance(stmt, ast.AugAssign):
+            t = self.taints(stmt.value) or self.taints(stmt.target)
+            self._assign_target(stmt.target, t, stmt)
+        elif isinstance(stmt, (ast.If, ast.While)):
+            if self.taints(stmt.test) \
+                    and not self._container_truth(stmt.test):
+                kind = "if" if isinstance(stmt, ast.If) else "while"
+                self._diag("MX204", f"Python `{kind}` on a traced value; "
+                           "tracers have no truth value — use F.where / "
+                           "lax.cond / lax.while_loop", stmt)
+            self.run(stmt.body)
+            self.run(stmt.orelse)
+        elif isinstance(stmt, ast.Assert):
+            if self.taints(stmt.test) \
+                    and not self._container_truth(stmt.test):
+                self._diag("MX204", "assert on a traced value; use "
+                           "checkify or a static shape check", stmt)
+        elif isinstance(stmt, ast.For):
+            self._assign_target(stmt.target, self.taints(stmt.iter), stmt)
+            self.run(stmt.body)
+            self.run(stmt.orelse)
+        elif isinstance(stmt, ast.With):
+            for item in stmt.items:
+                if item.optional_vars is not None:
+                    self._assign_target(item.optional_vars,
+                                        self.taints(item.context_expr), stmt)
+            self.run(stmt.body)
+        elif isinstance(stmt, ast.Try):
+            self.run(stmt.body)
+            for h in stmt.handlers:
+                self.run(h.body)
+            self.run(stmt.orelse)
+            self.run(stmt.finalbody)
+        elif isinstance(stmt, ast.FunctionDef):
+            # nested helper traced by the enclosing forward (jax.checkpoint
+            # bodies etc.): its params carry trace values unless defaulted
+            # to something static
+            inner = set(self.tainted)
+            for a in stmt.args.args + stmt.args.posonlyargs:
+                inner.add(a.arg)
+            n_def = len(stmt.args.defaults)
+            if n_def:
+                pos = (stmt.args.posonlyargs + stmt.args.args)[-n_def:]
+                for a, d in zip(pos, stmt.args.defaults):
+                    if not self.taints(d):
+                        inner.discard(a.arg)
+            saved = self.tainted
+            self.tainted = inner
+            try:
+                self.run(stmt.body)
+            finally:
+                self.tainted = saved
+
+    # note: _check_calls walks the whole statement including nested defs,
+    # but call-site taint there uses the *outer* scope; the nested-def
+    # branch above re-lints the body with inner seeds. A duplicate
+    # diagnostic for the same (code, line) is deduped in lint_source.
+
+
+def lint_source(src: str, filename: str = "<string>") -> Report:
+    """Lint one Python source blob; returns a Report of MX2xx findings."""
+    report = Report()
+    try:
+        tree = ast.parse(src, filename=filename)
+    except SyntaxError as e:
+        report.add(Diagnostic("MX200",
+                              f"file does not parse: {e.msg}",
+                              node=f"{filename}:{e.lineno or 0}",
+                              op="<syntax>", pass_name="tracer_lint"))
+        return report
+    np_mods, np_funcs = _numpy_bindings(tree)
+    raw = Report()
+    for cls in _hybrid_classes(tree):
+        for item in cls.body:
+            if isinstance(item, ast.FunctionDef) \
+                    and item.name in _FORWARD_METHODS:
+                linter = _MethodLinter(filename, cls.name, item, np_mods,
+                                       np_funcs, raw, hybrid=True)
+                linter.run(item.body)
+    seen = set()
+    for d in raw.diagnostics:
+        key = (d.code, d.node, d.op)
+        if key not in seen:
+            seen.add(key)
+            report.add(d)
+    return report
+
+
+def lint_file(path: str) -> Report:
+    with open(path) as f:
+        src = f.read()
+    return lint_source(src, filename=path)
+
+
+def lint_paths(paths) -> Report:
+    """Lint files and directories (recursing into ``*.py``)."""
+    report = Report()
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, _, files in os.walk(p):
+                for fname in sorted(files):
+                    if fname.endswith(".py"):
+                        report.extend(lint_file(os.path.join(dirpath, fname)))
+        else:
+            report.extend(lint_file(p))
+    return report

@@ -29,6 +29,7 @@ from ..context import Context, cpu, current_context
 from .. import autograd
 from .. import random as random_mod
 from ..ndarray import NDArray
+from ..analysis.recompile import note_compile
 from . import _trace
 from .parameter import DeferredInitializationError, Parameter, ParameterDict
 
@@ -417,6 +418,10 @@ class HybridBlock(Block):
         self._jit_cache = {}
         self._cache_info = {}
         self._warmed_up = False
+        # recompilation accounting restarts with the cache (mx.analysis)
+        self.__dict__.pop("_compile_log", None)
+        self.__dict__.pop("_compile_sigs", None)
+        self.__dict__.pop("_recompile_warned", None)
 
     def infer_shape(self, *args) -> None:
         """Resolve deferred parameter shapes from input shapes. Layers with
@@ -543,6 +548,12 @@ class HybridBlock(Block):
                 return prim + tuple(scope.effect_values)
 
             self._jit_cache[cache_key] = jax.jit(pure)
+
+        # recompilation accounting: every distinct (static-key, input-aval)
+        # signature is a fresh XLA compile — the block-level cache key alone
+        # undercounts because jax.jit re-traces per shape/dtype inside one
+        # entry. mx.analysis warns past a threshold (MX201).
+        note_compile(self, (cache_key, tuple(self._last_sig[2])))
 
         jit_fn = self._jit_cache[cache_key]
         info = self._cache_info[cache_key]

@@ -271,3 +271,7 @@ def alias_op(existing: str, *names: str) -> None:
     opdef = OPS[existing]
     for n in names:
         OPS[n] = opdef
+        # record on the OpDef so generated docs and the registry audit
+        # (tests/test_op_schema.py) see alias_op names too
+        if n not in opdef.aliases:
+            opdef.aliases = opdef.aliases + (n,)

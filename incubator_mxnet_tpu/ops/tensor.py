@@ -22,6 +22,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .registry import register_op, alias_op
+from .registry import Field as _Field, Schema as _Schema, Shape as _TShape
 
 # ---------------------------------------------------------------------------
 # helpers
@@ -567,6 +568,27 @@ def shape_array(data, **_):
 @register_op("size_array")
 def size_array(data, **_):
     return jnp.array([data.size], dtype=jnp.int32)
+
+
+# Source nodes behind mx.sym.zeros/ones (0 tensor inputs, shape in attrs).
+# Registered so the symbol executor and mx.analysis's graph verifier see
+# them as ordinary ops instead of unknown names (MX003).
+@register_op("_sym_zeros", schema=_Schema(
+    shape=_Field(_TShape, describe="Output shape."),
+    dtype=_Field(str, "float32", "Output dtype."),
+))
+def _sym_zeros(shape, dtype="float32"):
+    """Constant zeros source node (``mx.sym.zeros``)."""
+    return jnp.zeros(tuple(shape), dtype)
+
+
+@register_op("_sym_ones", schema=_Schema(
+    shape=_Field(_TShape, describe="Output shape."),
+    dtype=_Field(str, "float32", "Output dtype."),
+))
+def _sym_ones(shape, dtype="float32"):
+    """Constant ones source node (``mx.sym.ones``)."""
+    return jnp.ones(tuple(shape), dtype)
 
 
 @register_op("zeros_like")

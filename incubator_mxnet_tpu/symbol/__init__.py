@@ -30,7 +30,36 @@ from ..ndarray import NDArray, array
 from ..ops.registry import OPS
 
 __all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json",
-           "zeros", "ones", "FullyConnected", "Activation", "SoftmaxOutput"]
+           "zeros", "ones", "FullyConnected", "Activation", "SoftmaxOutput",
+           "GraphInferenceError"]
+
+
+class GraphInferenceError(MXNetError):
+    """Shape/dtype inference failed at a specific graph node.
+
+    Wraps the raw JAX/schema error from the per-node ``jax.eval_shape``
+    walk with the node's provenance — node name, op name, public attrs —
+    so the failure reads as a graph location, not a tracer traceback.
+    ``mx.analysis``'s ``infer_shapes`` pass converts this into an MX101
+    diagnostic; ``Symbol.infer_shape`` lets it propagate to the user.
+    """
+
+    def __init__(self, node_name: str, op: Optional[str], attrs: Dict,
+                 reason: str):
+        self.node_name = node_name
+        self.op = op
+        self.attrs = attrs
+        self.reason = reason
+        super().__init__(
+            f"shape inference failed at node '{node_name}' "
+            f"(op {op!r}, attrs {attrs}): {reason}")
+
+
+def _node_provenance(node: "Symbol") -> Tuple[str, Optional[str], Dict]:
+    """(name, op, public attrs) triple identifying one graph node in error
+    messages — shared by infer_shape and the mx.analysis shape pass."""
+    attrs = {k: v for k, v in node._attrs.items() if not k.startswith("_")}
+    return node._name, node._op, attrs
 
 _this = sys.modules[__name__]
 
@@ -176,7 +205,8 @@ class Symbol:
         pass for free), with parameter shapes resolved from their consumer's
         input shape + attrs — so implicitly-created weight/bias variables
         (``sym.FullyConnected(data, num_hidden=...)``) infer like the
-        reference."""
+        reference. Failures raise :class:`GraphInferenceError` carrying the
+        offending node's name/op/attrs (mx.analysis reports it as MX101)."""
         args = self.list_arguments()
         shapes, out_specs = _infer_graph_shapes(self, kwargs)
         unknown = [a for a in args if a not in shapes]
@@ -373,7 +403,15 @@ def _infer_graph_shapes(root: Symbol, known: Dict[str, tuple], sink=None):
 
     for node in _topo(root):
         if node._base is not None:
-            env[id(node)] = env[id(node._base)][node._output_index]
+            outs = env[id(node._base)]
+            if not isinstance(outs, (tuple, list)) \
+                    or node._output_index >= len(outs):
+                n_out = len(outs) if isinstance(outs, (tuple, list)) else 1
+                raise GraphInferenceError(
+                    *_node_provenance(node),
+                    f"output index {node._output_index} out of range: base "
+                    f"'{node._base._name}' produces {n_out} output(s)")
+            env[id(node)] = outs[node._output_index]
             continue
         if node._op is None:
             if node._name in shapes:
@@ -402,15 +440,29 @@ def _infer_graph_shapes(root: Symbol, known: Dict[str, tuple], sink=None):
             bad = [i._name for i, s in zip(node._inputs, ins) if s is None]
             raise MXNetError(f"{node._name}: unresolved input shapes {bad}")
         if node._op in _SCALAR_OPS:
-            env[id(node)] = jax.eval_shape(
-                lambda x, s=node._attrs["scalar"], o=node._op:
-                    _SCALAR_OPS[o](x, s), ins[0])
+            try:
+                env[id(node)] = jax.eval_shape(
+                    lambda x, s=node._attrs["scalar"], o=node._op:
+                        _SCALAR_OPS[o](x, s), ins[0])
+            except Exception as e:
+                raise GraphInferenceError(
+                    *_node_provenance(node),
+                    f"{e} [input shapes: "
+                    f"{[tuple(i.shape) for i in ins]}]") from e
             continue
         opdef = OPS.get(node._op)
         if opdef is None:
             raise MXNetError(f"unknown op {node._op!r} in symbol graph")
-        env[id(node)] = jax.eval_shape(
-            lambda *a, _f=opdef.fn, _at=attrs: _f(*a, **_at), *ins)
+        try:
+            env[id(node)] = jax.eval_shape(
+                lambda *a, _f=opdef.fn, _at=attrs: _f(*a, **_at), *ins)
+        except GraphInferenceError:
+            raise  # a nested subgraph walk already located the failure
+        except Exception as e:
+            raise GraphInferenceError(
+                *_node_provenance(node),
+                f"{e} [input shapes: "
+                f"{[tuple(i.shape) for i in ins]}]") from e
     if sink is not None:
         for nid, v in env.items():
             spec = v[0] if isinstance(v, (list, tuple)) else v
@@ -615,6 +667,12 @@ def _unwire_attr(v):
 
 
 def _symbol_from_payload(payload: dict) -> Symbol:
+    # Two-phase rebuild: construct every node first, then wire inputs by
+    # index. tojson emits topological order, but the loader must not rely
+    # on it — a malformed file (forward reference, even a cycle) should
+    # load into a graph that mx.analysis's verifier can judge (MX001),
+    # not die here with an IndexError. Out-of-range indices still raise:
+    # there is no node to wire to.
     nodes: List[Symbol] = []
     prev = getattr(_DESERIALIZING, "flag", False)
     _DESERIALIZING.flag = True
@@ -629,19 +687,45 @@ def _symbol_from_payload(payload: dict) -> Symbol:
                 except (ValueError, SyntaxError):
                     attrs[k] = v
             if nd_.get("base") is not None:
-                base = nodes[nd_["base"]]
-                nodes.append(base[nd_["output_index"]])
+                nodes.append(None)  # multi-output slice: resolved below
             else:
-                ins = [nodes[i[0]] for i in nd_["inputs"]]
                 # variable nodes keep their attrs too (AttrScope lr_mult /
                 # ctx_group annotations must survive the wire format)
                 nodes.append(Symbol(
                     nd_["op"] if nd_["op"] != "null" else None,
-                    ins, attrs, name=nd_["name"],
+                    [], attrs, name=nd_["name"],
                     num_outputs=nd_.get("num_outputs", 1)))
+        def _at(idx):
+            # explicit bounds check: a negative index must not silently
+            # wire to the wrong node via Python wraparound
+            if not isinstance(idx, int) or idx < 0 or idx >= len(nodes):
+                raise MXNetError(
+                    f"symbol JSON: node index {idx!r} out of range "
+                    f"[0, {len(nodes)})")
+            return nodes[idx]
+
+        # Slice nodes may 'base'-reference forward (and chain); resolve
+        # until a full sweep makes no progress.
+        pending = [i for i, s in enumerate(nodes) if s is None]
+        while pending:
+            left = [i for i in pending
+                    if _at(payload["nodes"][i]["base"]) is None]
+            if len(left) == len(pending):
+                raise MXNetError(
+                    "symbol JSON: unresolvable multi-output 'base' "
+                    f"references at node indices {left}")
+            for i in pending:
+                nd_ = payload["nodes"][i]
+                base = _at(nd_["base"])
+                if base is not None:
+                    nodes[i] = base[nd_["output_index"]]
+            pending = left
+        for sym_node, nd_ in zip(nodes, payload["nodes"]):
+            if nd_.get("base") is None:
+                sym_node._inputs = [_at(i[0]) for i in nd_["inputs"]]
     finally:
         _DESERIALIZING.flag = prev
-    return nodes[payload["heads"][0][0]]
+    return _at(payload["heads"][0][0])
 
 
 def load_json(s: str) -> Symbol:
@@ -653,12 +737,14 @@ def load(fname: str) -> Symbol:
         return load_json(f.read())
 
 
-def zeros(shape, **kwargs) -> Symbol:
-    return Symbol("_sym_zeros", [], attrs={"shape": shape})
+def zeros(shape, dtype="float32", **kwargs) -> Symbol:
+    return Symbol("_sym_zeros", [],
+                  attrs={"shape": shape, "dtype": onp.dtype(dtype).name})
 
 
-def ones(shape, **kwargs) -> Symbol:
-    return Symbol("_sym_ones", [], attrs={"shape": shape})
+def ones(shape, dtype="float32", **kwargs) -> Symbol:
+    return Symbol("_sym_ones", [],
+                  attrs={"shape": shape, "dtype": onp.dtype(dtype).name})
 
 
 def _make_sym_op(opname: str):

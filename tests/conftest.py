@@ -34,6 +34,14 @@ import numpy as onp
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running test, excluded from the tier-1 run")
+    config.addinivalue_line(
+        "markers", "lint: mx.analysis / mxlint static-analysis tests "
+        "(select with -m lint, skip with -m 'not lint')")
+
+
 def pytest_collection_modifyitems(config, items):
     if not _TPU_MODE:
         return

@@ -1,0 +1,12 @@
+"""Seeded violation: a traced value stored on ``self`` (exactly one MX206).
+
+Never imported — mxlint's tracer lint is pure-AST.
+"""
+from incubator_mxnet_tpu.gluon import HybridBlock
+
+
+class LeakyCache(HybridBlock):
+    def forward(self, x):
+        y = x * 2.0
+        self.last_activation = y
+        return y

@@ -83,6 +83,70 @@ class TestGeneratedDocs:
         assert "feature_group_count" in mx.nd.Convolution.__doc__
 
 
+class TestRegistryAudit:
+    """Whole-registry self-consistency audit (the mx.analysis companion:
+    graph_verify re-validates node attrs against these schemas, so the
+    schemas themselves must be sound). Reference parity: dmlc::Parameter
+    defaults are typed values that trivially pass their own field checks."""
+
+    @staticmethod
+    def _unique_opdefs():
+        seen, out = set(), []
+        for od in OPS.values():
+            if id(od) not in seen:
+                seen.add(id(od))
+                out.append(od)
+        return out
+
+    def test_schema_defaults_pass_their_own_coercion(self):
+        bad = []
+        for od in self._unique_opdefs():
+            if od.schema is None:
+                continue
+            for fname, f in od.schema.fields.items():
+                if f.default is REQUIRED:
+                    continue
+                try:
+                    f.coerce(od.name, fname, f.default)
+                except (TypeError, ValueError) as e:
+                    bad.append(f"{od.name}.{fname}: {e}")
+        assert not bad, "\n".join(bad)
+
+    def test_empty_kwargs_validate_when_nothing_required(self):
+        # an op with no required fields must accept a bare call's {}
+        bad = []
+        for od in self._unique_opdefs():
+            if od.schema is None:
+                continue
+            if any(f.default is REQUIRED for f in od.schema.fields.values()):
+                continue
+            try:
+                od.schema.validate(od.name, {})
+            except (TypeError, ValueError) as e:
+                bad.append(f"{od.name}: {e}")
+        assert not bad, "\n".join(bad)
+
+    def test_every_alias_resolves_to_its_opdef(self):
+        for od in self._unique_opdefs():
+            for a in od.aliases:
+                assert a in OPS, f"{od.name}: alias {a!r} not in OPS"
+                assert OPS[a] is od, \
+                    f"{od.name}: alias {a!r} resolves to {OPS[a].name}"
+
+    def test_every_registry_key_is_name_or_declared_alias(self):
+        stray = [n for n, od in OPS.items()
+                 if n != od.name and n not in od.aliases]
+        assert not stray, f"undeclared aliases: {stray}"
+
+    def test_tensor_arity_introspectable_for_schema_ops(self):
+        # the analysis arity check (MX004) relies on signature introspection
+        # surviving the schema wrapper; a None here would silently disable it
+        from incubator_mxnet_tpu.analysis import tensor_arity
+        bad = [od.name for od in self._unique_opdefs()
+               if od.schema is not None and tensor_arity(od) is None]
+        assert not bad, f"uninspectable op signatures: {bad}"
+
+
 class TestValidatedOpsStillWork:
     def test_pooling_validates(self):
         with pytest.raises(ValueError, match="pool_type"):

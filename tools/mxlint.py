@@ -1,0 +1,173 @@
+#!/usr/bin/env python
+"""mxlint — static analysis CLI over models, examples and symbol JSON.
+
+Reference counterpart: the graph sanity MXNet ran implicitly inside
+``nnvm::Graph`` passes, surfaced the way modern stacks do it (TVM's pass
+infra, clang-tidy): one command, stable diagnostic codes, non-zero exit on
+findings::
+
+    python -m tools.mxlint                       # models + examples (default)
+    python -m tools.mxlint path/to/file.py dir/  # AST tracer-leak lint (MX2xx)
+    python -m tools.mxlint net-symbol.json       # graph passes (MX0xx/MX1xx)
+    python -m tools.mxlint layout.json           # sharding table (MX3xx)
+    python -m tools.mxlint incubator_mxnet_tpu.models.bert   # dotted module
+
+Python targets get the pure-AST JAX-pitfall lint (no import of the linted
+code); ``.json`` targets are loaded as Symbols and run through the
+``graph_verify`` + ``infer_shapes`` passes (shape pass auto-skips when the
+graph needs input shapes) — unless the file is a sharding table (a top-level
+``"mesh"`` key: ``{"mesh": {axis: size}, "rules": [[pattern, [axes...]]],
+"params": {name: [shape]}}``), which runs the sharding-consistency pass
+instead. Exit status: 0 clean, 1 error diagnostics (``--strict``: warnings
+too), 2 bad invocation.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+# Same dance as tools/gen_docs.py: linting must not claim the single-client
+# TPU tunnel, and only a post-import config update reliably pins cpu.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+DEFAULT_TARGETS = ("incubator_mxnet_tpu/models", "examples")
+
+
+def _resolve_module(name: str):
+    """Dotted module name -> file or package directory to lint."""
+    try:
+        spec = importlib.util.find_spec(name)
+    except (ImportError, ModuleNotFoundError, ValueError):
+        return None
+    if spec is None:
+        return None
+    if spec.submodule_search_locations:
+        return list(spec.submodule_search_locations)[0]
+    return spec.origin
+
+
+class _TableMesh:
+    """Axis-name/size view of a mesh declaration — the sharding pass only
+    consults ``axis_names`` and ``shape``, so a layout file can be linted
+    without claiming real devices."""
+
+    def __init__(self, axes):
+        self.axis_names = tuple(axes)
+        self.shape = dict(axes)
+
+
+def _lint_sharding_json(path: str, payload: dict, analysis):
+    from jax.sharding import PartitionSpec
+
+    def _entry(e):
+        return tuple(e) if isinstance(e, list) else e
+
+    rules = [(pat, PartitionSpec(*[_entry(e) for e in spec]))
+             for pat, spec in payload.get("rules", ())]
+    try:
+        from incubator_mxnet_tpu.parallel.sharding import ShardingRules
+        table = ShardingRules(rules)
+    except Exception as e:  # unparseable regex etc.
+        report = analysis.Report()
+        report.add(analysis.Diagnostic(
+            "MX301", f"sharding table failed to load: "
+            f"{type(e).__name__}: {e}", node=path, pass_name="sharding"))
+        return report
+    params = {k: tuple(v) for k, v in payload.get("params", {}).items()}
+    return analysis.check_sharding(table, _TableMesh(payload["mesh"]),
+                                   params or None)
+
+
+def _lint_json(path: str, analysis):
+    import json
+
+    try:
+        with open(path) as f:
+            payload = json.load(f)
+        is_table = isinstance(payload, dict) and "mesh" in payload
+    except Exception as e:
+        report = analysis.Report()
+        report.add(analysis.Diagnostic(
+            "MX007", f"not valid JSON: {type(e).__name__}: {e}",
+            node=path, pass_name="graph_verify"))
+        return report
+    if is_table:
+        return _lint_sharding_json(path, payload, analysis)
+    from incubator_mxnet_tpu import symbol as S
+    try:
+        sym = S._symbol_from_payload(payload)
+    except Exception as e:
+        report = analysis.Report()
+        report.add(analysis.Diagnostic(
+            "MX007", f"symbol JSON failed to load: {type(e).__name__}: {e}",
+            node=path, pass_name="graph_verify"))
+        return report
+    return analysis.verify(sym, passes=["graph_verify", "infer_shapes"])
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="mxlint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("targets", nargs="*",
+                    help="*.py files, directories, *-symbol.json files, or "
+                         "dotted module names (default: in-tree models + "
+                         "examples)")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="suppress per-diagnostic lines, print summary only")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit non-zero on warnings too (perf hazards like "
+                         "MX201/MX302 gate the build)")
+    args = ap.parse_args(argv)
+
+    import incubator_mxnet_tpu.analysis as analysis
+
+    targets = args.targets or [os.path.join(REPO, t)
+                               for t in DEFAULT_TARGETS]
+    py_targets, json_targets = [], []
+    for t in targets:
+        if t.endswith(".json"):
+            if not os.path.exists(t):
+                print(f"mxlint: no such file: {t}", file=sys.stderr)
+                return 2
+            json_targets.append(t)
+        elif t.endswith(".py") or os.path.isdir(t):
+            if not os.path.exists(t):
+                print(f"mxlint: no such path: {t}", file=sys.stderr)
+                return 2
+            py_targets.append(t)
+        else:
+            resolved = _resolve_module(t)
+            if resolved is None:
+                print(f"mxlint: cannot resolve target {t!r} (not a path, "
+                      "not an importable module)", file=sys.stderr)
+                return 2
+            py_targets.append(resolved)
+
+    report = analysis.Report()
+    if py_targets:
+        report.extend(analysis.lint_paths(py_targets))
+    for jt in json_targets:
+        report.extend(_lint_json(jt, analysis))
+
+    if not args.quiet:
+        for d in report:
+            print(d)
+        for s in report.skipped:
+            print(f"note: skipped {s}", file=sys.stderr)
+    n_err, n_warn = len(report.errors), len(report.warnings)
+    print(f"mxlint: {n_err} error(s), {n_warn} warning(s) "
+          f"across {len(py_targets) + len(json_targets)} target(s)")
+    return 1 if (report.errors or (args.strict and report.warnings)) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
