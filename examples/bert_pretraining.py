@@ -49,6 +49,11 @@ def main(argv=None) -> float:
                     help="jax.checkpoint per encoder layer")
     ap.add_argument("--zero1", action="store_true",
                     help="shard optimizer states over dp (ZeRO-1)")
+    ap.add_argument("--ckpt-dir", default=None,
+                    help="mx.fault checkpoint directory: resumes from the "
+                         "newest verified step on start, saves every "
+                         "--ckpt-every steps (atomic; kill-safe)")
+    ap.add_argument("--ckpt-every", type=int, default=100)
     ap.add_argument("--seed", type=int, default=None,
                     help="RNG seed; default: MXNET_TEST_SEED or 42")
     args = ap.parse_args(argv)
@@ -79,10 +84,24 @@ def main(argv=None) -> float:
     rng = onp.random.RandomState(0)
     batch = synthetic_batch(rng, args.batch_size, args.seq_len, P, vocab)
     loss = trainer.step(*batch)  # compile
+    start = 0
+    if args.ckpt_dir:
+        try:
+            resumed = trainer.restore_checkpoint(args.ckpt_dir)
+            # checkpoint steps count the compile step too; finish only the
+            # REMAINING work instead of re-running the full budget
+            start = min(max(resumed - 1, 0), args.steps)
+            print(f"resumed from checkpoint step {resumed}; "
+                  f"{args.steps - start} step(s) remaining")
+        except mx.fault.CheckpointError:
+            pass  # cold start: nothing saved yet
     placed = trainer.place(*batch)
     last = None
-    for step in range(args.steps):
+    for step in range(start, args.steps):
         loss = trainer.step(*placed)
+        if args.ckpt_dir and (step % args.ckpt_every == 0
+                              or step == args.steps - 1):
+            trainer.save_checkpoint(args.ckpt_dir)
         if step % 5 == 0 or step == args.steps - 1:
             last = float(loss.asnumpy())
             print(f"step {step:4d}  loss {last:.4f}")

@@ -74,6 +74,9 @@ def main(argv=None) -> dict:
     ap.add_argument("--steps", type=int, default=200)
     ap.add_argument("--lr", type=float, default=2e-4)
     ap.add_argument("--beta1", type=float, default=0.5)
+    ap.add_argument("--ckpt-dir", default=None,
+                    help="mx.fault checkpoint root (atomic periodic "
+                         "checkpoints for both trainers; kill-safe)")
     args = ap.parse_args(argv)
 
     # MXNET_TEST_SEED wins so the committed seed-sweep varies the init
@@ -115,6 +118,9 @@ def main(argv=None) -> dict:
         pr = 1.0 / (1.0 + onp.exp(-out_real.asnumpy()))
         pf = 1.0 / (1.0 + onp.exp(-out_fake.asnumpy()))
         d_acc_hist.append(((pr > 0.5).mean() + (pf < 0.5).mean()) / 2)
+        if args.ckpt_dir and (step % 50 == 0 or step == args.steps - 1):
+            trainerD.save_checkpoint(os.path.join(args.ckpt_dir, "D"))
+            trainerG.save_checkpoint(os.path.join(args.ckpt_dir, "G"))
         if step % 50 == 0 or step == args.steps - 1:
             print(f"step {step:4d}  lossD {float(lossD.asnumpy()):.3f}  "
                   f"lossG {float(lossG.asnumpy()):.3f}  "

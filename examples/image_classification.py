@@ -43,6 +43,9 @@ def main(argv=None) -> float:
     ap.add_argument("--rec", default=None, help="RecordIO file (ImageRecordIter)")
     ap.add_argument("--amp", action="store_true", help="bf16 mixed precision")
     ap.add_argument("--kvstore", default="device")
+    ap.add_argument("--ckpt-dir", default=None,
+                    help="mx.fault checkpoint directory (atomic per-epoch "
+                         "checkpoints; kill-safe)")
     ap.add_argument("--seed", type=int, default=None,
                     help="RNG seed; default: MXNET_TEST_SEED or 42")
     args = ap.parse_args(argv)
@@ -85,6 +88,8 @@ def main(argv=None) -> float:
             loss.backward()
             trainer.step(args.batch_size)
             metric.update(batch.label[0], out)
+        if args.ckpt_dir:
+            trainer.save_checkpoint(args.ckpt_dir)
         name, acc = metric.get()
         print(f"epoch {epoch}: {name}={acc:.4f}")
     return acc

@@ -46,6 +46,9 @@ def main(argv=None) -> float:
     ap.add_argument("--beam-size", type=int, default=4)
     ap.add_argument("--smooth-eps", type=float, default=0.1,
                     help="label-smoothing epsilon (0 disables)")
+    ap.add_argument("--ckpt-dir", default=None,
+                    help="mx.fault checkpoint directory (atomic periodic "
+                         "checkpoints; kill-safe)")
     ap.add_argument("--seed", type=int, default=None,
                     help="RNG seed; default: MXNET_TEST_SEED or 42")
     args = ap.parse_args(argv)
@@ -78,6 +81,8 @@ def main(argv=None) -> float:
                            nd.array(smoothed))
         loss.backward()
         trainer.step(args.batch_size)
+        if args.ckpt_dir and (step % 50 == 0 or step == args.steps - 1):
+            trainer.save_checkpoint(args.ckpt_dir)
         if step % 50 == 0 or step == args.steps - 1:
             print(f"step {step:4d}  loss {float(loss.asnumpy().mean()):.4f}")
 

@@ -77,6 +77,9 @@ def main(argv=None) -> float:
     ap.add_argument("--val-size", type=int, default=64)
     ap.add_argument("--lr", type=float, default=0.005)
     ap.add_argument("--momentum", type=float, default=0.9)
+    ap.add_argument("--ckpt-dir", default=None,
+                    help="mx.fault checkpoint directory (atomic periodic "
+                         "checkpoints; kill-safe)")
     ap.add_argument("--seed", type=int, default=None,
                     help="RNG seed; default: MXNET_TEST_SEED or 42")
     args = ap.parse_args(argv)
@@ -107,6 +110,8 @@ def main(argv=None) -> float:
         loss.backward()
         trainer.step(1)   # SSDTargetLoss already normalizes by num_pos
         if step % 50 == 0:
+            if args.ckpt_dir:
+                trainer.save_checkpoint(args.ckpt_dir)
             print(f"step {step:4d} loss {float(loss.asnumpy()):.4f}")
 
     acc = evaluate(net, va_x, va_y)

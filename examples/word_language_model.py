@@ -92,6 +92,9 @@ def main(argv=None) -> float:
     ap.add_argument("--clip", type=float, default=0.25)
     ap.add_argument("--dropout", type=float, default=0.1)
     ap.add_argument("--no-tied", action="store_true")
+    ap.add_argument("--ckpt-dir", default=None,
+                    help="mx.fault checkpoint directory (atomic per-epoch "
+                         "checkpoints; kill-safe)")
     ap.add_argument("--seed", type=int, default=None,
                     help="RNG seed; default: MXNET_TEST_SEED or 42")
     args = ap.parse_args(argv)
@@ -136,6 +139,8 @@ def main(argv=None) -> float:
             total_nll += float(loss.asnumpy()) * data.shape[0] * data.shape[1]
             total_tok += data.shape[0] * data.shape[1]
         ppl = math.exp(total_nll / total_tok)
+        if args.ckpt_dir:
+            trainer.save_checkpoint(args.ckpt_dir)
         if ppl > prev_ppl:  # reference train.py: anneal lr on plateau
             trainer.set_learning_rate(trainer.learning_rate / 4.0)
         prev_ppl = ppl

@@ -79,32 +79,55 @@ def reset() -> None:
 
 class LossScaler:
     """Dynamic loss scaling (reference: amp/loss_scaler.py). Needed for
-    fp16 only; bf16 keeps scale=1 forever."""
+    fp16 only; bf16 keeps scale=1 forever.
+
+    The overflow check is the fault runtime's fused
+    :func:`~incubator_mxnet_tpu.fault.guards.all_finite` (one jitted
+    reduction over every gradient, one scalar transfer — the per-array
+    host-sync loop the reference ran is gone), and an optional
+    :class:`~incubator_mxnet_tpu.fault.StepGuard` escalates: scaler
+    overflow steps are reported to ``guard.decide``, so ``halt`` (or the
+    guard's consecutive-overflow limit) turns a diverging fp16 run into an
+    immediate error instead of a silent scale collapse.
+    """
 
     def __init__(self, init_scale: float = 2 ** 16, scale_factor: float = 2.0,
-                 scale_window: int = 2000):
+                 scale_window: int = 2000, guard=None):
         self.loss_scale = init_scale
         self._scale_factor = scale_factor
         self._scale_window = scale_window
         self._unskipped = 0
+        self._guard = guard
+        #: total overflow (skipped-update) steps
+        self.overflows = 0
+        #: steps observed (one update_scale per training step)
+        self.steps = 0
 
     def has_overflow(self, params) -> bool:
-        import jax.numpy as jnp
-        for p in params:
-            g = getattr(p, "_grad", None)
-            if not g:
-                continue
-            for arr in g.values():
-                if not bool(jnp.isfinite(arr._data).all()):
-                    return True
-        return False
+        from ..fault.guards import all_finite
+        grads = [arr._data for p in params
+                 for arr in (getattr(p, "_grad", None) or {}).values()]
+        if not grads:
+            return False
+        return not all_finite(grads)
 
     def update_scale(self, overflow: bool) -> None:
+        self.steps += 1
         if overflow:
+            self.overflows += 1
             self.loss_scale = max(1.0, self.loss_scale / self._scale_factor)
             self._unskipped = 0
+            if self._guard is not None:
+                # may raise NonFiniteError under policy='halt' or past
+                # max_consecutive; 'skip' is the scaler's own behavior
+                self._guard.decide(
+                    self.steps, "loss-scale overflow",
+                    detail=f"overflow #{self.overflows}, scale now "
+                           f"{self.loss_scale:g}")
         else:
             self._unskipped += 1
+            if self._guard is not None:
+                self._guard.good_step()
             if self._unskipped >= self._scale_window:
                 self.loss_scale *= self._scale_factor
                 self._unskipped = 0

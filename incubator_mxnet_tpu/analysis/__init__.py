@@ -39,10 +39,31 @@ from . import shape_check  # noqa: F401  (registers infer_shapes)
 from . import sharding_check  # noqa: F401  (registers sharding)
 from .graph_verifier import tensor_arity  # noqa: F401
 from .sharding_check import check_sharding  # noqa: F401
-from .tracer_lint import lint_file, lint_paths, lint_source  # noqa: F401
+from . import fault_lint  # noqa: F401
+from . import tracer_lint  # noqa: F401
 from .recompile import (  # noqa: F401
     RECOMPILE_WARN_THRESHOLD, RecompileWarning, cache_report, note_compile,
 )
+
+
+def lint_source(src, filename: str = "<string>") -> Report:
+    """Source lint = tracer hygiene (MX2xx) + fault hygiene (MX4xx), one
+    merged Report (the ``mxlint`` Python-target entry point)."""
+    report = tracer_lint.lint_source(src, filename)
+    report.extend(fault_lint.lint_source(src, filename))
+    return report
+
+
+def lint_file(path: str) -> Report:
+    with open(path) as f:
+        return lint_source(f.read(), filename=path)
+
+
+def lint_paths(paths) -> Report:
+    """Lint files and directories (recursing into ``*.py``) with every
+    source-lint family."""
+    from .diagnostics import walk_lint
+    return walk_lint(paths, lint_file)
 
 __all__ = ["verify", "Report", "Diagnostic", "CODES", "register_pass",
            "list_passes", "run_passes", "PassContext", "tensor_arity",

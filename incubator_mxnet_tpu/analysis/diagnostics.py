@@ -13,12 +13,31 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import List, Optional
 
-__all__ = ["Diagnostic", "Report", "CODES"]
+__all__ = ["Diagnostic", "Report", "CODES", "walk_lint"]
+
+
+def walk_lint(paths, lint_file) -> "Report":
+    """THE file walker every source-lint family shares (tracer MX2xx,
+    fault MX4xx, and the combined ``mx.analysis.lint_paths``): files and
+    directories, recursing into ``*.py``, merged into one Report."""
+    import os
+    report = Report()
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, _, files in os.walk(p):
+                for fname in sorted(files):
+                    if fname.endswith(".py"):
+                        report.extend(lint_file(os.path.join(dirpath,
+                                                             fname)))
+        else:
+            report.extend(lint_file(p))
+    return report
 
 #: Stable diagnostic codes. The MX0xx family is graph structure, MX1xx is
-#: abstract shape/dtype evaluation, MX2xx is jit-cache/tracer hygiene, and
-#: MX3xx is sharding consistency. Codes are append-only: tools and CI grep
-#: for them, so a code's meaning never changes once released.
+#: abstract shape/dtype evaluation, MX2xx is jit-cache/tracer hygiene,
+#: MX3xx is sharding consistency, and MX4xx is fault-tolerance hygiene.
+#: Codes are append-only: tools and CI grep for them, so a code's meaning
+#: never changes once released.
 CODES = {
     "MX001": "graph contains a cycle",
     "MX002": "duplicate node name",
@@ -39,6 +58,8 @@ CODES = {
     "MX301": "PartitionSpec names a mesh axis the mesh does not declare",
     "MX302": "PartitionSpec rank/divisibility mismatch with the parameter",
     "MX303": "conflicting PartitionSpecs match the same parameter",
+    "MX401": "training loop never checkpoints (no save_checkpoint/"
+             "save_states/save_parameters call; a crash loses the run)",
 }
 
 

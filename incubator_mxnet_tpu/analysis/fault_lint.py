@@ -1,0 +1,103 @@
+"""Fault-tolerance hygiene linter (the MX4xx family).
+
+Companion to :mod:`.tracer_lint`: where that pass protects the *compiled
+graph* from Python, this one protects the *run* from the machine. The one
+production incident every long training job eventually hits is dying with
+no checkpoint — so MX401 flags training scripts that construct a
+``ShardedTrainer``/``gluon.Trainer`` and drive it through a step loop
+without ever calling a checkpointing API (``save_checkpoint``,
+``save_states``, ``save_parameters``, or ``fault.checkpoint.*``).
+
+The check is deliberately coarse (pure-AST, per-file, no imports of the
+linted code — same contract as the tracer lint) and reports at
+``warning`` severity: a missing checkpoint is a durability hazard, not a
+correctness error, and short experiment scripts legitimately skip it
+(``mxlint --strict`` promotes warnings to a failing exit).
+
+Heuristics, tuned for zero noise on non-training files:
+
+- a *trainer construction* is any call whose callee name (or trailing
+  attribute) is ``ShardedTrainer`` or ``Trainer``;
+- a *training loop* is a ``for``/``while`` whose body calls ``.step(...)``
+  or a trainer method — files that build a trainer but never loop (unit
+  helpers, factories) are not flagged;
+- *checkpoint evidence* is any call (anywhere in the file, incl. helper
+  functions) to one of the checkpointing APIs above.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .diagnostics import Diagnostic, Report, walk_lint
+
+__all__ = ["lint_source", "lint_file", "lint_paths"]
+
+_TRAINER_NAMES = {"ShardedTrainer", "Trainer"}
+
+#: any of these calls, anywhere in the file, counts as checkpointing
+_CHECKPOINT_CALLS = {
+    "save_checkpoint", "restore_checkpoint", "load_checkpoint",
+    "load_latest", "save_states", "load_states",
+    "save_parameters", "save_params",
+    "save_optimizer_states", "export",
+}
+
+
+def _callee_name(call: ast.Call) -> str:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return ""
+
+
+def _has_step_loop(tree: ast.Module) -> bool:
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.For, ast.While)):
+            continue
+        for inner in ast.walk(node):
+            if isinstance(inner, ast.Call) \
+                    and _callee_name(inner) == "step":
+                return True
+    return False
+
+
+def lint_source(src: str, filename: str = "<string>") -> Report:
+    """Lint one Python source blob for MX4xx findings."""
+    report = Report()
+    try:
+        tree = ast.parse(src, filename=filename)
+    except SyntaxError:
+        return report  # tracer_lint owns the MX200 parse diagnostic
+    trainer_ctors: List[ast.Call] = [
+        n for n in ast.walk(tree)
+        if isinstance(n, ast.Call) and _callee_name(n) in _TRAINER_NAMES]
+    if not trainer_ctors or not _has_step_loop(tree):
+        return report
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) \
+                and _callee_name(node) in _CHECKPOINT_CALLS:
+            return report
+    ctor = trainer_ctors[0]
+    report.add(Diagnostic(
+        "MX401",
+        "this script builds a trainer and runs a step loop but never "
+        "checkpoints — a preemption/NaN/crash loses the whole run; call "
+        "trainer.save_checkpoint(dir) periodically (mx.fault restores "
+        "from the newest verified step)",
+        node=f"{filename}:{getattr(ctor, 'lineno', 0)}",
+        op=_callee_name(ctor), pass_name="fault_lint",
+        severity="warning"))
+    return report
+
+
+def lint_file(path: str) -> Report:
+    with open(path) as f:
+        return lint_source(f.read(), filename=path)
+
+
+def lint_paths(paths) -> Report:
+    """Lint files and directories (recursing into ``*.py``)."""
+    return walk_lint(paths, lint_file)

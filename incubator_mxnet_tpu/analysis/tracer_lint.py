@@ -31,10 +31,9 @@ milliseconds and broken files report a diagnostic instead of crashing.
 from __future__ import annotations
 
 import ast
-import os
 from typing import Dict, List, Optional, Set, Tuple
 
-from .diagnostics import Diagnostic, Report
+from .diagnostics import Diagnostic, Report, walk_lint
 
 __all__ = ["lint_source", "lint_file", "lint_paths"]
 
@@ -419,13 +418,4 @@ def lint_file(path: str) -> Report:
 
 def lint_paths(paths) -> Report:
     """Lint files and directories (recursing into ``*.py``)."""
-    report = Report()
-    for p in paths:
-        if os.path.isdir(p):
-            for dirpath, _, files in os.walk(p):
-                for fname in sorted(files):
-                    if fname.endswith(".py"):
-                        report.extend(lint_file(os.path.join(dirpath, fname)))
-        else:
-            report.extend(lint_file(p))
-    return report
+    return walk_lint(paths, lint_file)

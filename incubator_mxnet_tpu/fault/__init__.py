@@ -1,0 +1,63 @@
+"""``mx.fault`` — the fault-tolerant training runtime.
+
+Reference counterpart: nothing — the reference trusted the hardware, the
+network, and the arithmetic, and production MXNet runs died accordingly
+(ps-lite worker loss, truncated ``nd.save`` files, NaN steps discovered
+hours later). This subsystem makes the framework own the failure modes a
+long TPU training run actually hits (PyGraph's thesis applied to
+robustness: the *runtime* around the compiled graph is where production
+value lives):
+
+=====================  ====================================================
+:mod:`~.checkpoint`    atomic, versioned, checksum-verified checkpoint
+                       dirs with retention — ``save_checkpoint`` /
+                       ``load_latest``; trainers round-trip their full
+                       state (params, optimizer, step, LR position, RNG
+                       base key) through it
+:mod:`~.guards`        jitted finite-checks on loss / global grad norm
+                       with ``warn`` / ``skip_and_rollback`` / ``halt``
+                       policies (:class:`StepGuard`)
+:mod:`~.watchdog`      per-step deadline timer dumping recompile/last-op
+                       diagnostics on hangs (:class:`Watchdog`)
+:mod:`~.retry`         env-tunable exponential backoff
+                       (:class:`RetryPolicy`) behind the reconnecting
+                       ``dist_async`` kvstore client
+:mod:`~.inject`        seeded chaos harness — deterministic NaN batches,
+                       dropped PS connections, slow steps, and named crash
+                       points, so every policy above is a unit test
+=====================  ====================================================
+
+Typical wiring::
+
+    guard = mx.fault.StepGuard(policy="skip_and_rollback")
+    trainer = mx.parallel.ShardedTrainer(net, loss_fn, "adamw", ...,
+                                         guard=guard,
+                                         watchdog=mx.fault.Watchdog(30.0))
+    for step, (x, y) in enumerate(batches):
+        trainer.step(x, y)
+        if step % 100 == 0:
+            trainer.save_checkpoint("ckpts/", keep=3)
+    # after a crash:
+    trainer.restore_checkpoint("ckpts/")     # newest verified step
+"""
+from __future__ import annotations
+
+from . import checkpoint  # noqa: F401
+from . import guards  # noqa: F401
+from . import inject  # noqa: F401
+from . import retry  # noqa: F401
+from . import watchdog as _watchdog_mod  # noqa: F401
+from .checkpoint import (  # noqa: F401
+    CheckpointCorruptError, CheckpointError, list_checkpoints,
+    load_checkpoint, load_latest, save_checkpoint,
+)
+from .guards import NonFiniteError, StepGuard, all_finite  # noqa: F401
+from .retry import RetryExhausted, RetryPolicy, call_with_retry  # noqa: F401
+from .watchdog import Watchdog, WatchdogFlag  # noqa: F401
+
+__all__ = ["checkpoint", "guards", "inject", "retry",
+           "save_checkpoint", "load_checkpoint", "load_latest",
+           "list_checkpoints", "CheckpointError", "CheckpointCorruptError",
+           "StepGuard", "NonFiniteError", "all_finite",
+           "Watchdog", "WatchdogFlag",
+           "RetryPolicy", "RetryExhausted", "call_with_retry"]

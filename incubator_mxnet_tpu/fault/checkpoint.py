@@ -1,0 +1,283 @@
+"""Atomic, versioned, resumable checkpoint directories.
+
+Reference counterpart: the reference checkpointed with bare
+``NDArray::Save`` to a single file (``model.py save_checkpoint``) — a crash
+mid-write truncates the file and loses the run. Here a checkpoint is a
+*directory per step* finalized by one atomic ``os.replace`` rename, with a
+JSON manifest carrying per-array CRC32 checksums, so the invariant is
+binary: a checkpoint directory either exists complete and verified, or it
+does not exist at all. Layout::
+
+    <root>/
+      step-0000000010/
+        manifest.json        # format, step, meta, per-array shape/dtype/crc
+        arrays.params        # one dmlc .params container (upstream format)
+      step-0000000020/
+      .tmp-step-0000000030-<pid>/     # in-flight save (ignored by readers)
+
+Write path: arrays + manifest land in the same-filesystem temp dir, the
+temp dir is fsync'd, then renamed into place; retention prunes to the
+newest ``keep`` completed steps plus any stale temps. Read path:
+:func:`load_checkpoint` verifies the manifest checksums before returning
+and :func:`load_latest` walks backwards past corrupt/incomplete steps to
+the newest checkpoint that verifies — the resume contract a killed run
+needs.
+
+The value layer is intentionally dumb: ``{name: numpy array}`` plus a JSON
+``meta`` dict. Trainer integration (pytree gather/reshard, RNG keys,
+optimizer state naming) lives with the trainers
+(:meth:`parallel.ShardedTrainer.save_checkpoint`,
+:meth:`gluon.Trainer.save_checkpoint`).
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import warnings
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+import numpy as onp
+
+from ..base import MXNetError
+from . import inject
+
+__all__ = ["save_checkpoint", "load_checkpoint", "load_latest",
+           "list_checkpoints", "CheckpointError", "CheckpointCorruptError",
+           "FORMAT_VERSION", "ARRAYS_FILE", "MANIFEST_FILE"]
+
+FORMAT_VERSION = 1
+ARRAYS_FILE = "arrays.params"
+MANIFEST_FILE = "manifest.json"
+_STEP_PREFIX = "step-"
+_TMP_PREFIX = ".tmp-"
+_OLD_SUFFIX = ".replaced"
+
+
+def _recover(root: str) -> None:
+    """Heal a same-step replace that crashed between its two renames: the
+    displaced-but-complete old copy sits at ``step-N.replaced`` with no
+    ``step-N`` — rename it back so the checkpoint is visible again."""
+    if not os.path.isdir(root):
+        return
+    for name in os.listdir(root):
+        if not name.endswith(_OLD_SUFFIX):
+            continue
+        final = os.path.join(root, name[:-len(_OLD_SUFFIX)])
+        old = os.path.join(root, name)
+        if _parse_step(name[:-len(_OLD_SUFFIX)]) is None:
+            continue
+        try:
+            if not os.path.isdir(final) \
+                    and os.path.isfile(os.path.join(old, MANIFEST_FILE)):
+                os.replace(old, final)
+            else:
+                shutil.rmtree(old, ignore_errors=True)
+        except OSError:
+            pass  # best-effort; the next reader retries
+
+
+class CheckpointError(MXNetError):
+    """No usable checkpoint (missing directory / no completed steps)."""
+
+
+class CheckpointCorruptError(CheckpointError):
+    """A checkpoint directory exists but fails verification (bad manifest,
+    checksum mismatch, truncated arrays file)."""
+
+
+def _step_dirname(step: int) -> str:
+    if step < 0:
+        raise CheckpointError(f"checkpoint step must be >= 0, got {step}")
+    return f"{_STEP_PREFIX}{step:010d}"
+
+
+def _parse_step(name: str) -> Optional[int]:
+    if not name.startswith(_STEP_PREFIX):
+        return None
+    try:
+        return int(name[len(_STEP_PREFIX):])
+    except ValueError:
+        return None
+
+
+def _crc(a: onp.ndarray) -> int:
+    return zlib.crc32(onp.ascontiguousarray(a).tobytes()) & 0xFFFFFFFF
+
+
+def _fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return  # platform without O_RDONLY dirs: rename is still atomic
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def list_checkpoints(root: str) -> List[int]:
+    """Completed checkpoint steps under ``root``, ascending. A step counts
+    only if its manifest file exists (the last thing a save writes before
+    the rename — temp dirs never appear here)."""
+    if not os.path.isdir(root):
+        return []
+    _recover(root)
+    steps = []
+    for name in os.listdir(root):
+        step = _parse_step(name)
+        if step is None:
+            continue
+        if os.path.isfile(os.path.join(root, name, MANIFEST_FILE)):
+            steps.append(step)
+    return sorted(steps)
+
+
+def save_checkpoint(root: str, arrays: Dict[str, onp.ndarray],
+                    meta: Optional[dict] = None, *, step: int,
+                    keep: Optional[int] = 3) -> str:
+    """Write one atomic checkpoint for ``step``; returns its directory.
+
+    ``arrays`` maps names to host arrays (callers gather device/sharded
+    values first); ``meta`` must be JSON-serializable. ``keep`` prunes to
+    the newest K completed checkpoints after a successful save (None keeps
+    everything). Re-saving an existing step atomically replaces it.
+    """
+    meta = dict(meta or {})
+    os.makedirs(root, exist_ok=True)
+    final = os.path.join(root, _step_dirname(step))
+    tmp = os.path.join(root, f"{_TMP_PREFIX}{_step_dirname(step)}-{os.getpid()}")
+    if os.path.isdir(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+    try:
+        host: Dict[str, onp.ndarray] = {}
+        entries: Dict[str, dict] = {}
+        for name, a in arrays.items():
+            a = onp.asarray(a)
+            host[name] = a
+            entries[name] = {"shape": list(a.shape), "dtype": a.dtype.name,
+                             "crc32": _crc(a)}
+        from ..ndarray.serialization import dmlc_save
+        dmlc_save(os.path.join(tmp, ARRAYS_FILE),
+                  list(host.values()), list(host.keys()))
+        inject.crash("checkpoint.arrays")   # died after arrays, no manifest
+        manifest = {"format": FORMAT_VERSION, "step": int(step),
+                    "meta": meta, "arrays": entries}
+        mpath = os.path.join(tmp, MANIFEST_FILE)
+        with open(mpath, "w") as f:
+            json.dump(manifest, f, indent=1, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+        _fsync_dir(tmp)
+        inject.crash("checkpoint.finalize")  # died before the atomic rename
+        if os.path.isdir(final):
+            # same-step replace: os.replace cannot clobber a non-empty dir,
+            # so the old copy moves aside first. A crash between the two
+            # renames leaves only the aside dir — named so _recover() can
+            # rename it back (readers self-heal; the good copy is never in
+            # a prunable temp name).
+            old = final + _OLD_SUFFIX
+            shutil.rmtree(old, ignore_errors=True)   # stale from a crash
+            os.replace(final, old)
+            os.replace(tmp, final)
+            shutil.rmtree(old, ignore_errors=True)
+        else:
+            os.replace(tmp, final)
+        _fsync_dir(root)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    if keep is not None:
+        _prune(root, keep)
+    return final
+
+
+def _prune(root: str, keep: int) -> None:
+    steps = list_checkpoints(root)
+    for step in steps[:-keep] if keep > 0 else steps:
+        shutil.rmtree(os.path.join(root, _step_dirname(step)),
+                      ignore_errors=True)
+    for name in os.listdir(root):
+        if name.startswith(_TMP_PREFIX):
+            # stale in-flight dirs from crashed saves — never loadable
+            shutil.rmtree(os.path.join(root, name), ignore_errors=True)
+
+
+def load_checkpoint(root: str, step: int,
+                    verify: bool = True) -> Tuple[Dict[str, onp.ndarray], dict, int]:
+    """Load one step → ``(arrays, meta, step)``; checksum-verifies unless
+    ``verify=False``. Raises :class:`CheckpointCorruptError` on any
+    mismatch between manifest and arrays."""
+    _recover(root)
+    path = os.path.join(root, _step_dirname(step))
+    mpath = os.path.join(path, MANIFEST_FILE)
+    if not os.path.isfile(mpath):
+        raise CheckpointError(f"no completed checkpoint for step {step} "
+                              f"under {root!r}")
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as e:
+        raise CheckpointCorruptError(
+            f"{mpath}: unreadable manifest: {e}") from e
+    if manifest.get("format") != FORMAT_VERSION:
+        raise CheckpointCorruptError(
+            f"{mpath}: unsupported checkpoint format "
+            f"{manifest.get('format')!r} (this build reads "
+            f"{FORMAT_VERSION})")
+    from ..ndarray.serialization import dmlc_load
+    apath = os.path.join(path, ARRAYS_FILE)
+    try:
+        values, names = dmlc_load(apath)
+    except MXNetError as e:
+        raise CheckpointCorruptError(f"{apath}: {e}") from e
+    arrays = dict(zip(names, values))
+    declared = manifest.get("arrays", {})
+    if set(arrays) != set(declared):
+        raise CheckpointCorruptError(
+            f"{path}: manifest declares {sorted(declared)} but arrays file "
+            f"holds {sorted(arrays)}")
+    for name, ent in declared.items():
+        a = arrays[name]
+        # the dmlc container promotes 0-d arrays to shape (1,) on the wire
+        # (upstream has no 0-d records); the manifest keeps the original
+        # shape, so restore it — same bytes, same checksum
+        if list(a.shape) != ent["shape"]:
+            if a.size == int(onp.prod(ent["shape"], dtype=onp.int64)):
+                a = arrays[name] = a.reshape(ent["shape"])
+            else:
+                raise CheckpointCorruptError(
+                    f"{path}: array {name!r} is {a.dtype.name}{a.shape}, "
+                    f"manifest says {ent['dtype']}{tuple(ent['shape'])}")
+        if verify:
+            if a.dtype.name != ent["dtype"]:
+                raise CheckpointCorruptError(
+                    f"{path}: array {name!r} is {a.dtype.name}{a.shape}, "
+                    f"manifest says {ent['dtype']}{tuple(ent['shape'])}")
+            if _crc(a) != ent["crc32"]:
+                raise CheckpointCorruptError(
+                    f"{path}: checksum mismatch for array {name!r}")
+    return arrays, manifest.get("meta", {}), int(manifest["step"])
+
+
+def load_latest(root: str, verify: bool = True
+                ) -> Tuple[Dict[str, onp.ndarray], dict, int]:
+    """Load the newest checkpoint that verifies, walking backwards past
+    corrupt steps (each skip warns). Raises :class:`CheckpointError` when
+    nothing under ``root`` is loadable — the caller decides whether a cold
+    start is acceptable."""
+    steps = list_checkpoints(root)
+    if not steps:
+        raise CheckpointError(f"no completed checkpoints under {root!r}")
+    last_err: Optional[Exception] = None
+    for step in reversed(steps):
+        try:
+            return load_checkpoint(root, step, verify=verify)
+        except CheckpointCorruptError as e:
+            warnings.warn(f"skipping corrupt checkpoint step {step}: {e}")
+            last_err = e
+    raise CheckpointError(
+        f"all {len(steps)} checkpoints under {root!r} failed verification; "
+        f"last error: {last_err}")

@@ -1,0 +1,225 @@
+"""Deterministic chaos injection — the fault runtime's test harness.
+
+Reference counterpart: the reference never shipped one, and that is the
+point — ps-lite reconnect paths, NaN-step handling, and checkpoint
+atomicity were exercised only by real outages. Here every fault the
+``mx.fault`` runtime defends against can be injected *deterministically*
+(seeded, per-site PRNG streams) so the defenses are ordinary unit tests:
+
+- **NaN gradients** (``nan_batch``): the trainer poisons the incoming batch
+  with NaNs, which propagates to loss and every gradient — the same
+  signature a real fp overflow produces, with no special-cased graph.
+- **Dropped / delayed PS connections** (``kv_drop``, ``kv_delay``): the
+  kvstore client closes its own socket before a call, forcing the
+  reconnect/backoff/resend machinery through its full path.
+- **Slow steps** (``slow_step``): the trainer sleeps past the watchdog
+  deadline.
+- **Crash points** (``crash("site")``): hard process-death simulation at
+  named sites (e.g. ``nd.save`` mid-write, ``checkpoint.finalize`` before
+  the atomic rename) raising :class:`ChaosCrash` — the caller's cleanup
+  does NOT run the happy path, exactly like SIGKILL for atomicity purposes
+  within one process.
+
+Determinism: each site draws from its own ``RandomState`` seeded by
+``(seed, site)``, so outcomes depend only on the seed and the per-site call
+count — never on interleaving across sites or threads (a lock guards each
+stream). Enable programmatically::
+
+    with mx.fault.inject.chaos(seed=7, nan_prob=1.0):
+        trainer.step(x, y)          # this step's batch is poisoned
+
+or for a whole run via ``MXTPU_CHAOS="seed=7,nan_prob=0.01,kv_drop=0.1"``
+(parsed by :func:`enable_from_env`, consulted once at first hook hit).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+import zlib
+from typing import Dict, Iterable, Optional
+
+import numpy as onp
+
+from ..base import MXNetError
+
+__all__ = ["ChaosMonkey", "ChaosCrash", "chaos", "enable", "disable",
+           "active", "enable_from_env", "should", "maybe_delay", "crash",
+           "poison"]
+
+
+class ChaosCrash(MXNetError):
+    """Raised at an armed crash point — simulates dying at that site."""
+
+    def __init__(self, site: str):
+        super().__init__(f"chaos: injected crash at {site!r}")
+        self.site = site
+
+
+class ChaosMonkey:
+    """One seeded fault plan. Knobs are per-site probabilities in [0, 1]:
+
+    ``nan_prob``  — ``should('nan_batch')``: poison the next batch
+    ``kv_drop``   — ``should('kv_drop')``: drop the PS connection pre-call
+    ``slow_prob`` — ``maybe_delay('slow_step')`` sleeps ``delay_s``
+    ``kv_delay``  — ``maybe_delay('kv_delay')`` sleeps ``delay_s``
+    ``crash_sites`` — iterable of site names where :meth:`crash` raises;
+    each site fires at most ``crash_count`` times (default 1) then disarms,
+    so a retried save can succeed after the simulated death.
+    """
+
+    def __init__(self, seed: int = 0, nan_prob: float = 0.0,
+                 kv_drop: float = 0.0, slow_prob: float = 0.0,
+                 kv_delay: float = 0.0, delay_s: float = 0.0,
+                 crash_sites: Iterable[str] = (), crash_count: int = 1):
+        self.seed = int(seed)
+        self.probs: Dict[str, float] = {
+            "nan_batch": float(nan_prob), "kv_drop": float(kv_drop),
+            "slow_step": float(slow_prob), "kv_delay": float(kv_delay),
+        }
+        self.delay_s = float(delay_s)
+        self._armed: Dict[str, int] = {s: int(crash_count)
+                                       for s in crash_sites}
+        self._streams: Dict[str, onp.random.RandomState] = {}
+        self._lock = threading.Lock()
+        #: injection log: (site, fired) in per-site call order — lets tests
+        #: assert exactly which faults a seed produced
+        self.log: list = []
+
+    def _stream(self, site: str) -> onp.random.RandomState:
+        rs = self._streams.get(site)
+        if rs is None:
+            rs = onp.random.RandomState(
+                (self.seed ^ zlib.crc32(site.encode())) & 0x7FFFFFFF)
+            self._streams[site] = rs
+        return rs
+
+    def should(self, site: str) -> bool:
+        """Draw this site's next fault decision (thread-safe)."""
+        p = self.probs.get(site, 0.0)
+        with self._lock:
+            fired = bool(p > 0.0 and self._stream(site).uniform() < p)
+            self.log.append((site, fired))
+        return fired
+
+    def maybe_delay(self, site: str) -> float:
+        """Sleep ``delay_s`` when the site's draw fires; returns the delay."""
+        if self.should(site) and self.delay_s > 0:
+            time.sleep(self.delay_s)
+            return self.delay_s
+        return 0.0
+
+    def crash(self, site: str) -> None:
+        """Raise :class:`ChaosCrash` if ``site`` is armed (then disarm)."""
+        with self._lock:
+            left = self._armed.get(site, 0)
+            if left <= 0:
+                return
+            self._armed[site] = left - 1
+        raise ChaosCrash(site)
+
+    def poison(self, arr):
+        """Return a NaN-filled array matching ``arr`` (float dtypes only —
+        integer batches poison the first float downstream instead)."""
+        a = onp.asarray(arr)
+        if a.dtype.kind != "f":
+            return arr
+        return onp.full_like(a, onp.nan)
+
+
+_ACTIVE: Optional[ChaosMonkey] = None
+_ENV_CHECKED = False
+
+
+def enable(seed: int = 0, **knobs) -> ChaosMonkey:
+    """Install a global :class:`ChaosMonkey`; returns it for inspection."""
+    global _ACTIVE
+    _ACTIVE = ChaosMonkey(seed=seed, **knobs)
+    return _ACTIVE
+
+
+def disable() -> None:
+    global _ACTIVE
+    _ACTIVE = None
+
+
+def enable_from_env() -> Optional[ChaosMonkey]:
+    """Parse ``MXTPU_CHAOS`` (``"seed=7,nan_prob=0.01,crash=nd.save"``,
+    comma-separated ``k=v``; ``crash`` may repeat) and enable. No-op when
+    the variable is unset."""
+    spec = os.environ.get("MXTPU_CHAOS")
+    if not spec:
+        return None
+    kw: Dict = {}
+    sites = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise MXNetError(f"MXTPU_CHAOS: cannot parse {part!r}")
+        k, v = part.split("=", 1)
+        k = k.strip()
+        if k == "crash":
+            sites.append(v.strip())
+        elif k == "seed":
+            kw["seed"] = int(v)
+        elif k == "crash_count":
+            kw["crash_count"] = int(v)
+        else:
+            kw[k] = float(v)
+    if sites:
+        kw["crash_sites"] = sites
+    return enable(**kw)
+
+
+def active() -> Optional[ChaosMonkey]:
+    """The installed monkey, or None. Checks ``MXTPU_CHAOS`` once."""
+    global _ENV_CHECKED
+    if _ACTIVE is None and not _ENV_CHECKED:
+        _ENV_CHECKED = True
+        enable_from_env()
+    return _ACTIVE
+
+
+class chaos:
+    """Scoped enable: ``with fault.inject.chaos(seed=7, nan_prob=1.0): ...``
+    (restores whatever was active before — including nothing)."""
+
+    def __init__(self, seed: int = 0, **knobs):
+        self._kw = dict(seed=seed, **knobs)
+        self._prev = None
+        self.monkey: Optional[ChaosMonkey] = None
+
+    def __enter__(self) -> ChaosMonkey:
+        global _ACTIVE
+        self._prev = _ACTIVE
+        self.monkey = _ACTIVE = ChaosMonkey(**self._kw)
+        return self.monkey
+
+    def __exit__(self, *exc):
+        global _ACTIVE
+        _ACTIVE = self._prev
+
+
+# -- zero-cost hook surface (call sites use these; all no-ops when off) ----
+
+def should(site: str) -> bool:
+    m = active()
+    return m.should(site) if m is not None else False
+
+
+def maybe_delay(site: str) -> float:
+    m = active()
+    return m.maybe_delay(site) if m is not None else 0.0
+
+
+def crash(site: str) -> None:
+    m = active()
+    if m is not None:
+        m.crash(site)
+
+
+def poison(arr):
+    m = active()
+    return m.poison(arr) if m is not None else arr

@@ -1,0 +1,123 @@
+"""Step watchdog — flags steps that blow past a wall-clock deadline.
+
+Reference counterpart: the reference engine's only hang story was
+``MXNET_ENGINE_TYPE=NaiveEngine`` bisection after the fact. On TPU the
+classic silent stall is a *recompile storm* (every step re-traces because a
+static arg churns — seconds per step, no error anywhere), or a collective
+waiting on a dead peer. The watchdog is a daemon timer armed around each
+step: past ``deadline`` it fires ONCE for that step and dumps a diagnostic
+— elapsed time, the block's live jit-compile count and most recent
+signatures (from :mod:`..analysis.recompile`'s accounting), i.e. the "last
+op" provenance a hung run needs — via ``warnings.warn`` and the
+``flags`` list. The step is NOT killed: XLA dispatches cannot be safely
+interrupted mid-flight; the watchdog's job is attribution, the recovery
+decision stays with the caller (checkpoint + restart).
+
+Usage (``ShardedTrainer(watchdog=Watchdog(deadline=30))`` does this for
+you)::
+
+    wd = fault.Watchdog(deadline=30.0)
+    with wd.watch(step=trainer.num_update, block=net):
+        trainer.step(x, y)
+    if wd.flags: ...
+"""
+from __future__ import annotations
+
+import threading
+import time
+import warnings
+from typing import Any, Callable, List, Optional
+
+__all__ = ["Watchdog", "WatchdogFlag"]
+
+
+class WatchdogFlag:
+    """One deadline violation: step index, deadline, elapsed-at-fire, and
+    the watched block's compile accounting at fire time."""
+
+    def __init__(self, step: int, deadline: float, elapsed: float,
+                 compiles: int, recent_signatures: List[str]):
+        self.step = step
+        self.deadline = deadline
+        self.elapsed = elapsed
+        self.compiles = compiles
+        self.recent_signatures = recent_signatures
+
+    def __str__(self):
+        sig = (f"; {self.compiles} jit compiles, most recent "
+               f"{self.recent_signatures[-1]}" if self.compiles else
+               "; no compile recorded (likely blocked on data or a "
+               "collective peer)")
+        return (f"step {self.step} exceeded the {self.deadline:.1f}s "
+                f"watchdog deadline ({self.elapsed:.1f}s elapsed{sig})")
+
+
+class Watchdog:
+    """Arms a timer per step; fires at most once per step.
+
+    ``deadline``  seconds a step may take before flagging
+    ``on_flag``   optional callback ``(WatchdogFlag)`` — alerting seam;
+                  the default also ``warnings.warn``\\ s every flag
+    """
+
+    def __init__(self, deadline: float,
+                 on_flag: Optional[Callable[[WatchdogFlag], None]] = None):
+        self.deadline = float(deadline)
+        self.on_flag = on_flag
+        self.flags: List[WatchdogFlag] = []
+        self._timer: Optional[threading.Timer] = None
+        self._lock = threading.Lock()
+
+    # -- accounting ------------------------------------------------------
+    @staticmethod
+    def _compile_state(block: Any):
+        log = []
+        if block is not None:
+            for b in Watchdog._blocks(block):
+                log.extend(b.__dict__.get("_compile_log") or [])
+        return len(log), [repr(s)[:120] for s in log[-3:]]
+
+    @staticmethod
+    def _blocks(block):
+        yield block
+        for child in getattr(block, "_children", {}).values():
+            yield from Watchdog._blocks(child)
+
+    def _fire(self, step: int, t0: float, block: Any) -> None:
+        compiles, recent = self._compile_state(block)
+        flag = WatchdogFlag(step, self.deadline, time.monotonic() - t0,
+                            compiles, recent)
+        with self._lock:
+            self.flags.append(flag)
+            del self.flags[:-100]
+        warnings.warn(f"[fault.watchdog] {flag}")
+        if self.on_flag is not None:
+            self.on_flag(flag)
+
+    # -- arming ----------------------------------------------------------
+    class _Watch:
+        def __init__(self, wd: "Watchdog", step: int, block: Any):
+            self._wd, self._step, self._block = wd, step, block
+
+        def __enter__(self):
+            wd = self._wd
+            t0 = time.monotonic()
+            wd._timer = threading.Timer(
+                wd.deadline, wd._fire, args=(self._step, t0, self._block))
+            wd._timer.daemon = True
+            wd._timer.start()
+            return wd
+
+        def __exit__(self, *exc):
+            t = self._wd._timer
+            self._wd._timer = None
+            if t is not None:
+                t.cancel()
+
+    def watch(self, step: int, block: Any = None) -> "Watchdog._Watch":
+        """Context manager arming the deadline around one step."""
+        return Watchdog._Watch(self, step, block)
+
+    def __repr__(self):
+        return (f"Watchdog(deadline={self.deadline}, "
+                f"flags={len(self.flags)})")

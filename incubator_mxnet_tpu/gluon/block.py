@@ -294,6 +294,19 @@ class Block:
                 continue
             seen[id(param)] = name
             arg_dict[name] = param._check_and_get(param._data, None)
+        # Non-finite weights checkpoint "successfully" and poison every
+        # later restore — surface it at save time (one fused jitted
+        # reduction, mx.fault.guards), where the step that broke them is
+        # still identifiable. Warn-only: saving a diverged model for a
+        # post-mortem is legitimate.
+        from ..fault.guards import all_finite
+        if not all_finite([a._data for a in arg_dict.values()]):
+            import warnings
+            warnings.warn(
+                f"save_parameters({filename!r}): parameters contain "
+                "non-finite values; the saved file will restore a broken "
+                "model (a fault.StepGuard on the trainer catches this at "
+                "the offending step)")
         nd.save(filename, arg_dict)
 
     def load_parameters(self, filename: str, ctx=None, allow_missing: bool = False,

@@ -196,15 +196,28 @@ class Trainer:
                 g._fresh_grad = False
 
     def save_states(self, fname: str):
-        """Serialize optimizer state (reference: Trainer.save_states)."""
+        """Serialize optimizer state (reference: Trainer.save_states).
+        Atomic: a crash mid-write never clobbers an existing states file."""
+        import os
         import numpy as onp
         blob = {
             "num_update": self._optimizer.num_update,
             "states": {i: tuple(onp.asarray(s) for s in st)
                        for i, st in self._states.items()},
         }
-        with open(fname, "wb") as f:
-            pickle.dump(blob, f)
+        tmp = f"{fname}.tmp-{os.getpid()}"
+        try:
+            with open(tmp, "wb") as f:
+                pickle.dump(blob, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, fname)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
 
     def load_states(self, fname: str):
         with open(fname, "rb") as f:
@@ -212,3 +225,70 @@ class Trainer:
         self._optimizer.num_update = blob["num_update"]
         self._states = {i: tuple(jnp.asarray(s) for s in st)
                         for i, st in blob["states"].items()}
+
+    # ------------------------------------------------------------------
+    # resumable checkpoints (mx.fault.checkpoint): unlike save_states —
+    # optimizer state only, reference shape — this covers parameters AND
+    # optimizer state AND the update counter in one atomic versioned
+    # directory, the same layout ShardedTrainer.save_checkpoint writes.
+    # ------------------------------------------------------------------
+    def save_checkpoint(self, root: str, keep=3) -> str:
+        """One atomic checkpoint directory under ``root`` (params +
+        optimizer states + update counter); prunes to the newest ``keep``."""
+        import numpy as onp
+        from ..fault import checkpoint as ckpt
+        arrays = {}
+        for i, p in enumerate(self._params):
+            if p._data is None:
+                raise MXNetError(
+                    f"parameter {p.name!r} is uninitialized; initialize "
+                    "before save_checkpoint")
+            arrays[f"param:{i:04d}"] = p.data().asnumpy()
+            for j, s in enumerate(self._states.get(i, ())):
+                arrays[f"opt:{i:04d}:{j}"] = onp.asarray(s)
+        meta = {
+            "trainer": "Trainer", "format": 1,
+            "num_update": self._optimizer.num_update,
+            "param_names": [p.name for p in self._params],
+            "opt_state_sizes": [len(self._states.get(i, ()))
+                                for i in range(len(self._params))],
+        }
+        return ckpt.save_checkpoint(root, arrays, meta,
+                                    step=self._optimizer.num_update,
+                                    keep=keep)
+
+    def restore_checkpoint(self, root: str, step=None) -> int:
+        """Restore parameters + optimizer state from the newest verified
+        checkpoint under ``root`` (or an explicit ``step``)."""
+        from ..fault import checkpoint as ckpt
+        from ..ndarray import NDArray
+        if step is None:
+            arrays, meta, step = ckpt.load_latest(root)
+        else:
+            arrays, meta, step = ckpt.load_checkpoint(root, step)
+        if meta.get("trainer") != "Trainer" or meta.get("format") != 1:
+            raise MXNetError(
+                f"checkpoint step {step} was not written by "
+                "gluon.Trainer.save_checkpoint")
+        if len(meta.get("param_names", [])) != len(self._params):
+            raise MXNetError(
+                "checkpoint parameter count does not match this Trainer: "
+                f"saved {len(meta.get('param_names', []))}, "
+                f"live {len(self._params)}")
+        sizes = meta["opt_state_sizes"]
+        for i, p in enumerate(self._params):
+            v = arrays[f"param:{i:04d}"]
+            live = p.data()
+            if tuple(v.shape) != tuple(live.shape):
+                raise MXNetError(
+                    f"checkpoint array for parameter {p.name!r} is shape "
+                    f"{tuple(v.shape)}, live parameter is {live.shape}")
+            p.set_data(NDArray(v))
+            if sizes[i]:
+                self._states[i] = tuple(
+                    jnp.asarray(arrays[f"opt:{i:04d}:{j}"])
+                    for j in range(sizes[i]))
+            else:
+                self._states.pop(i, None)
+        self._optimizer.num_update = int(meta["num_update"])
+        return step

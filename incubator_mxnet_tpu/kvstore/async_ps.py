@@ -27,9 +27,33 @@ Semantics, mirroring :class:`~incubator_mxnet_tpu.kvstore.KVStore`:
 - with ``set_optimizer`` (shipped pickled, the reference's server-side
   ``DataHandleEx`` update): every push updates the WEIGHTS immediately and
   ``pull`` returns them — update-on-kvstore, per-arrival.
+
+Fault tolerance (``mx.fault`` wiring — the reference client died on the
+first socket error):
+
+- the client survives connection loss: every call runs under an
+  env-tunable :class:`~incubator_mxnet_tpu.fault.retry.RetryPolicy`
+  (``MXNET_KVSTORE_RETRIES`` / ``MXNET_KVSTORE_RETRY_DELAY``) that
+  reconnects with exponential backoff and resends; the per-op socket
+  timeout comes from ``MXNET_KVSTORE_TIMEOUT`` (default 60s). Exhaustion
+  raises :class:`MXNetError` carrying the op + key, never a bare
+  ``ConnectionError``.
+- resends are safe because pushes are *versioned*: each client stamps a
+  monotonically increasing version per push and the server remembers the
+  last version applied per (worker, key) — a retry of a push whose first
+  copy DID land (the reply was what got lost) is acknowledged without
+  re-applying, so server-side optimizer updates are exactly-once.
+- the server shuts down gracefully (``stop(checkpoint=...)``) and a new
+  one restarts from that checkpoint on the same port
+  (``AsyncPSServer(restore=...)``) — weights, merged buffers, optimizer
+  state, and the applied-version table all survive.
+- chaos hooks (``fault.inject``): ``kv_drop`` severs the client socket
+  before a call, ``kv_delay`` stalls it — the seeded harness drives the
+  full reconnect path in tests.
 """
 from __future__ import annotations
 
+import itertools
 import os
 import pickle
 import socket
@@ -41,12 +65,23 @@ from typing import Dict, Optional
 import numpy as onp
 
 from ..base import MXNetError
+from ..fault import inject as _inject
+from ..fault.retry import RetryExhausted, RetryPolicy
 from ..ndarray import NDArray
 from . import GradientCompressionMixin, KVStoreBase
 
 __all__ = ["AsyncPSServer", "AsyncKVStore"]
 
 _LEN = struct.Struct("<Q")
+
+
+def _io_timeout() -> float:
+    """Per-socket-op timeout (seconds) — MXNET_KVSTORE_TIMEOUT, default 60.
+    Read per connection so tests/jobs can retune without reimporting."""
+    try:
+        return float(os.environ.get("MXNET_KVSTORE_TIMEOUT", "60"))
+    except ValueError as e:
+        raise MXNetError(f"bad MXNET_KVSTORE_TIMEOUT: {e}") from e
 
 
 def _send_msg(sock: socket.socket, obj) -> None:
@@ -76,19 +111,26 @@ class AsyncPSServer:
     store lock serializes updates — the ordering guarantee the reference
     gets from ps-lite's per-key server queue."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 restore: Optional[str] = None):
         self._store: Dict = {}     # init values / optimizer-updated weights
         self._merged: Dict = {}    # latest pushed merge per key (no-opt mode)
         self._opt_states: Dict = {}
         self._optimizer = None
         self._lock = threading.Lock()
         self._push_count = 0
+        #: (worker id, key) -> last applied push version: the resend-dedupe
+        #: table that makes client retries exactly-once
+        self._applied: Dict = {}
+        if restore is not None:
+            self._restore(restore)
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
         self._sock.listen(64)
         self.port = self._sock.getsockname()[1]
         self._stop = threading.Event()
+        self._conns: set = set()       # live worker connections (for stop)
         self._thread = threading.Thread(target=self._serve, daemon=True)
         self._thread.start()
 
@@ -102,6 +144,8 @@ class AsyncPSServer:
                 continue
             except OSError:
                 break
+            with self._lock:
+                self._conns.add(conn)
             t = threading.Thread(target=self._handle, args=(conn,),
                                  daemon=True)
             t.start()
@@ -123,6 +167,13 @@ class AsyncPSServer:
                     return
         except (ConnectionError, EOFError, OSError):
             return
+        finally:
+            with self._lock:
+                self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
 
     def _dispatch(self, msg):
         op = msg[0]
@@ -132,8 +183,14 @@ class AsyncPSServer:
                 self._store.setdefault(key, onp.array(arr))
             return ("ok",)
         if op == "push":
-            _, key, arr = msg
+            # ("push", key, arr) legacy or ("push", key, arr, wid, version)
+            key, arr = msg[1], msg[2]
+            wid, ver = (msg[3], msg[4]) if len(msg) >= 5 else (None, None)
             with self._lock:
+                if wid is not None:
+                    if self._applied.get((wid, key), 0) >= ver:
+                        return ("ok",)  # resend of an applied push: ack only
+                    self._applied[(wid, key)] = ver
                 self._apply(key, onp.asarray(arr))
                 self._push_count += 1
             return ("ok",)
@@ -177,20 +234,97 @@ class AsyncPSServer:
         self._opt_states[key] = self._optimizer.update(idx, w, g, state)
         self._store[key] = w.asnumpy()
 
-    def stop(self) -> None:
+    # -- graceful shutdown / restart ----------------------------------------
+    def state_dict(self) -> dict:
+        """Host-side snapshot of everything a restarted server needs."""
+        import jax
+        with self._lock:
+            return {
+                "format": 1,
+                "store": {k: onp.asarray(v) for k, v in self._store.items()},
+                "merged": {k: onp.asarray(v)
+                           for k, v in self._merged.items()},
+                "opt_states": {k: jax.tree.map(onp.asarray, st)
+                               for k, st in self._opt_states.items()},
+                "optimizer": (pickle.dumps(self._optimizer)
+                              if self._optimizer is not None else None),
+                "push_count": self._push_count,
+                "applied": dict(self._applied),
+            }
+
+    def save_checkpoint(self, path: str) -> None:
+        """Atomically persist :meth:`state_dict` (temp + ``os.replace``)."""
+        blob = pickle.dumps(self.state_dict(),
+                            protocol=pickle.HIGHEST_PROTOCOL)
+        tmp = f"{path}.tmp-{os.getpid()}"
+        try:
+            with open(tmp, "wb") as f:
+                f.write(blob)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    def _restore(self, path: str) -> None:
+        with open(path, "rb") as f:
+            blob = pickle.load(f)
+        if blob.get("format") != 1:
+            raise MXNetError(f"{path}: unknown PS checkpoint format "
+                             f"{blob.get('format')!r}")
+        self._store = dict(blob["store"])
+        self._merged = dict(blob["merged"])
+        self._opt_states = dict(blob["opt_states"])
+        self._optimizer = (pickle.loads(blob["optimizer"])
+                           if blob["optimizer"] is not None else None)
+        self._push_count = int(blob["push_count"])
+        self._applied = dict(blob["applied"])
+
+    def stop(self, checkpoint: Optional[str] = None) -> None:
+        """Graceful shutdown: optionally checkpoint the store first, then
+        stop accepting and join the accept loop (in-flight handler threads
+        finish their current reply; they are daemons)."""
+        if checkpoint is not None:
+            self.save_checkpoint(checkpoint)
         self._stop.set()
         self._thread.join(timeout=2)
+        # Close live worker connections so clients observe the shutdown and
+        # fail over (retry/backoff) to a restarted server instead of
+        # talking to this one's zombie handler threads.
+        with self._lock:
+            conns, self._conns = list(self._conns), set()
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
 
 
 class _Client:
-    def __init__(self, host: str, port: int, timeout: float = 30.0):
+    """Reconnecting PS client. Every call retries under the env retry
+    policy; a lost connection is re-established with exponential backoff
+    before the resend (safe for every op — pushes are versioned, the rest
+    are idempotent reads/replaces)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0,
+                 retry: Optional[RetryPolicy] = None):
+        self._host, self._port = host, port
+        self._retry = retry or RetryPolicy.from_env()
+        self._sock: Optional[socket.socket] = None
+        self._ver = itertools.count(1)
         deadline = time.time() + timeout
         last = None
         while True:
             try:
-                self._sock = socket.create_connection((host, port),
-                                                      timeout=5.0)
-                self._sock.settimeout(60.0)
+                self._connect()
                 break
             except OSError as e:  # server not up yet: retry (worker launch
                 last = e           # order is unordered, like ps-lite's van)
@@ -200,19 +334,64 @@ class _Client:
                 time.sleep(0.1)
         self._lock = threading.Lock()
 
+    def _connect(self) -> None:
+        self.close()
+        self._sock = socket.create_connection((self._host, self._port),
+                                              timeout=5.0)
+        self._sock.settimeout(_io_timeout())
+
     def call(self, *msg):
+        op = msg[0]
+        key = msg[1] if len(msg) > 1 and not isinstance(
+            msg[1], (bytes, bytearray)) else None
         with self._lock:
-            _send_msg(self._sock, msg)
-            resp = _recv_msg(self._sock)
+            if op == "push" and len(msg) >= 5 and msg[4] is None:
+                # stamp the version under the SAME lock that serializes
+                # sends: assigned any earlier, concurrent pushers could
+                # deliver versions out of order and the server's monotone
+                # dedupe would drop real updates as resends
+                msg = msg[:4] + (next(self._ver),)
+            if _inject.should("kv_drop"):   # chaos: sever before the call
+                self.close()
+            _inject.maybe_delay("kv_delay")
+
+            def attempt():
+                if self._sock is None:
+                    self._connect()
+                _send_msg(self._sock, msg)
+                return _recv_msg(self._sock)
+
+            def on_retry(n, exc):
+                self.close()   # force a fresh connection before resending
+                self._connect()
+
+            try:
+                resp = attempt()
+            except self._retry.retry_on:
+                self.close()
+                from ..fault.retry import call_with_retry
+                try:
+                    resp = call_with_retry(
+                        attempt, self._retry, on_retry=on_retry,
+                        describe=f"async PS {op!r} (key {key!r}) at "
+                                 f"{self._host}:{self._port}")
+                except RetryExhausted as e:
+                    self.close()
+                    raise MXNetError(str(e)) from e.last
         if resp[0] != "ok":
-            raise MXNetError(resp[1] if len(resp) > 1 else "async PS error")
+            raise MXNetError(
+                f"async PS {op!r} (key {key!r}) failed: "
+                + (resp[1] if len(resp) > 1 else "unknown server error"))
         return resp[1] if len(resp) > 1 else None
 
     def close(self) -> None:
+        if self._sock is None:
+            return
         try:
             self._sock.close()
         except OSError:
             pass
+        self._sock = None
 
 
 class AsyncKVStore(GradientCompressionMixin, KVStoreBase):
@@ -247,6 +426,9 @@ class AsyncKVStore(GradientCompressionMixin, KVStoreBase):
         else:
             self._server = AsyncPSServer()
             self._client = _Client("127.0.0.1", self._server.port)
+        #: identity stamped on every push (the client adds the monotone
+        #: version) so server-side dedupe makes retried pushes exactly-once
+        self._wid = f"{self._rank}:{os.getpid()}:{id(self):x}"
         if optimizer is not None:
             self.set_optimizer(optimizer)
 
@@ -293,7 +475,8 @@ class AsyncKVStore(GradientCompressionMixin, KVStoreBase):
 
     def push(self, key, value, priority: int = 0):
         for k, v in zip(self._keys(key), self._vals(key, value)):
-            self._client.call("push", k, self._merge(k, v))
+            self._client.call("push", k, self._merge(k, v),
+                              self._wid, None)  # client stamps the version
 
     def pull(self, key, out=None, priority: int = 0,
              ignore_sparse: bool = True):

@@ -155,31 +155,57 @@ def dmlc_save(fname: str,
     Uses the C++ writer (``native.params_save`` — NDArray::Save parity) when
     the shim is available; the Python path below is the fallback and the
     format's executable spec. Both emit byte-identical V2 containers
-    (interop-tested)."""
+    (interop-tested).
+
+    Atomicity: both writers target a same-directory temp file that is
+    ``os.replace``\\ d into place only after a successful flush+fsync, so a
+    crash mid-save (power loss, SIGKILL, a raised exception) can never
+    leave a truncated ``.params`` file where a previous good one stood —
+    the invariant ``Block.save_parameters`` and ``fault.checkpoint`` build
+    on. The temp file lives beside the target (rename must not cross
+    filesystems) and is removed on failure."""
+    import os
     arrays = [onp.ascontiguousarray(a if a.ndim else a.reshape(1))
               for a in arrays]
     from .. import native
+    from ..fault import inject as _inject
     flags = _native_flags(arrays)
     # the native writer handles all-named or all-unnamed saves; a partial
     # names list (error case surfaced at load) stays on the python writer
     if len(names) not in (0, len(arrays)):
         flags = None
-    if flags is not None and native.available():
+    tmp = f"{fname}.tmp-{os.getpid()}"
+    try:
+        if flags is not None and native.available():
+            wrote = True
+            try:
+                native.params_save(tmp, arrays, list(names), flags)
+            except MXNetError:
+                wrote = False  # fall through to the Python writer
+            if wrote:
+                _inject.crash("nd.save")
+                os.replace(tmp, fname)
+                return
+        with open(tmp, "wb") as f:
+            f.write(struct.pack("<QQ", DMLC_LIST_MAGIC, 0))
+            f.write(struct.pack("<Q", len(arrays)))
+            for a in arrays:
+                _write_ndarray(f, a)
+            _inject.crash("nd.save")   # chaos: die with a half-written temp
+            f.write(struct.pack("<Q", len(names)))
+            for s in names:
+                b = s.encode("utf-8")
+                f.write(struct.pack("<Q", len(b)))
+                f.write(b)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, fname)
+    except BaseException:
         try:
-            native.params_save(fname, arrays, list(names), flags)
-            return
-        except MXNetError:
-            pass  # fall through to the Python writer
-    with open(fname, "wb") as f:
-        f.write(struct.pack("<QQ", DMLC_LIST_MAGIC, 0))
-        f.write(struct.pack("<Q", len(arrays)))
-        for a in arrays:
-            _write_ndarray(f, a)
-        f.write(struct.pack("<Q", len(names)))
-        for s in names:
-            b = s.encode("utf-8")
-            f.write(struct.pack("<Q", len(b)))
-            f.write(b)
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
 def dmlc_load(fname: str):

@@ -22,6 +22,7 @@ Usage::
 from __future__ import annotations
 
 import os
+from contextlib import nullcontext as _nullcontext
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -60,7 +61,8 @@ class ShardedTrainer:
                  mesh: Optional[Mesh] = None,
                  rules: Optional[ShardingRules] = None,
                  n_labels: int = 1, seq_axis: Optional[int] = None,
-                 donate: bool = True, zero1: bool = False):
+                 donate: bool = True, zero1: bool = False,
+                 guard=None, watchdog=None):
         self._block = block
         self._loss_fn = loss_fn
         self._optimizer = opt_mod.create(
@@ -89,6 +91,13 @@ class ShardedTrainer:
         self._base_key = None        # device-resident RNG base key
         self._lr_val = None          # python lr the cached device lr mirrors
         self._lr_dev = None
+        #: mx.fault wiring (all optional): a StepGuard syncs loss/grad-norm
+        #: each step and applies its policy (warn / skip_and_rollback /
+        #: halt); a Watchdog flags steps that blow the wall-clock deadline.
+        self._guard = guard
+        self._watchdog = watchdog
+        self._snapshot = None        # (t, param copies, opt-state copies)
+        self.last_grad_norm: Optional[float] = None
         # Work in the mesh's device context: wrapping step outputs/batches in
         # the *default* (cpu) Context would force sync device→host round
         # trips every step (critical over a tunneled TPU).
@@ -218,6 +227,12 @@ class ShardedTrainer:
 
             (loss, effects), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(param_vals)
+            # Global grad norm, fused into the step (fp32 accumulation so a
+            # bf16 overflow can't hide): one scalar out, consumed by the
+            # fault.StepGuard finite/limit check and exposed as
+            # trainer.last_grad_norm.
+            gnorm = jnp.sqrt(sum(
+                jnp.sum(jnp.square(g.astype(jnp.float32))) for g in grads))
             constrain = jax.lax.with_sharding_constraint
             new_vals, new_states = [], []
             for i, (w, g, s) in enumerate(zip(param_vals, grads, opt_states)):
@@ -241,7 +256,8 @@ class ShardedTrainer:
                             for a, sh in zip(nst, state_shardings[i]))
                 new_vals.append(nv)
                 new_states.append(nst)
-            return loss, tuple(new_vals), tuple(new_states), effects, t + 1
+            return (loss, gnorm, tuple(new_vals), tuple(new_states),
+                    effects, t + 1)
 
         donate = (0, 1, 4) if self._donate else ()
         return jax.jit(step, donate_argnums=donate)
@@ -275,6 +291,9 @@ class ShardedTrainer:
         n_data = len(batch) - self._n_labels
         if n_data < 1:
             raise MXNetError("step() needs at least one data argument")
+        from ..fault import inject as _inject
+        if _inject.active() is not None:
+            batch = self._chaos_batch(batch, n_data)
         if self._params is None:
             # Eager warmup runs wherever the parameters were initialized
             # (current context), NOT on the mesh.
@@ -285,6 +304,8 @@ class ShardedTrainer:
         vals = self.place(*batch)
         if self._step_fn is None:
             self._step_fn = self._build_step(n_data)
+        if self._guard is not None:
+            self._maybe_snapshot()
         self._t += 1
         if self._lr_dev is None or self._lr_val != self._optimizer.learning_rate:
             self._lr_val = self._optimizer.learning_rate
@@ -294,18 +315,98 @@ class ShardedTrainer:
         if self._base_key is None:
             self._base_key = random_mod.next_key(self._ctx)
         from .mesh import active_mesh
-        with active_mesh(self._mesh):
-            # bound during (first-call) tracing so mesh-aware ops lower to
-            # mesh collectives — e.g. attention → ring over sp
-            loss, self._param_vals, self._opt_states, effects, self._t_dev = \
-                self._step_fn(self._param_vals, self._opt_states,
-                              self._base_key, self._lr_dev, self._t_dev,
-                              *vals)
+        wd = self._watchdog
+        with wd.watch(step=self._t, block=self._block) if wd is not None \
+                else _nullcontext():
+            _inject.maybe_delay("slow_step")
+            with active_mesh(self._mesh):
+                # bound during (first-call) tracing so mesh-aware ops lower
+                # to mesh collectives — e.g. attention → ring over sp
+                (loss, gnorm, self._param_vals, self._opt_states, effects,
+                 self._t_dev) = \
+                    self._step_fn(self._param_vals, self._opt_states,
+                                  self._base_key, self._lr_dev, self._t_dev,
+                                  *vals)
+            rolled_back = (self._guard is not None
+                           and self._apply_guard(loss, gnorm))
         self._optimizer.num_update = self._t
-        for (p, ectx), val in zip(self._info.get("effects", ()), effects):
-            p._deposit_aux(val._data if isinstance(val, NDArray) else val,
-                           ectx if ectx is not None else self._ctx)
+        if not rolled_back:
+            # aux effects (batchnorm running stats etc.) of a rolled-back
+            # step are part of the bad step — dropping them keeps the
+            # restored state internally consistent
+            for (p, ectx), val in zip(self._info.get("effects", ()),
+                                      effects):
+                p._deposit_aux(val._data if isinstance(val, NDArray)
+                               else val,
+                               ectx if ectx is not None else self._ctx)
         return NDArray(loss, ctx=self._ctx)
+
+    # ------------------------------------------------------------------
+    # fault tolerance (mx.fault wiring)
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _chaos_batch(batch, n_data: int):
+        """Chaos hook: when the active monkey draws ``nan_batch``, the first
+        float data argument is replaced with NaNs — the realistic NaN-step
+        signature (propagates to loss and every grad through the unmodified
+        compiled graph)."""
+        from ..fault import inject as _inject
+        if not _inject.should("nan_batch"):
+            return batch
+        out = list(batch)
+        for i in range(n_data):
+            a = out[i]
+            v = a.asnumpy() if isinstance(a, NDArray) else onp.asarray(a)
+            if v.dtype.kind == "f":
+                out[i] = _inject.poison(v)
+                break
+        return tuple(out)
+
+    def _maybe_snapshot(self) -> None:
+        """Refresh the rollback snapshot (device-side copies — step-time
+        donation consumes the live buffers, so rollback needs its own)."""
+        g = self._guard
+        if self._snapshot is not None \
+                and self._t - self._snapshot[0] < g.snapshot_every:
+            return
+        self._snapshot = (self._t, self._copy_state(self._param_vals),
+                          self._copy_state(self._opt_states))
+
+    @staticmethod
+    def _copy_state(tree):
+        return jax.tree.map(lambda a: a.copy(), tree)
+
+    def _apply_guard(self, loss, gnorm) -> bool:
+        """Returns True when the step was rolled back."""
+        lf = float(jax.device_get(loss))
+        gn = float(jax.device_get(gnorm))
+        self.last_grad_norm = gn
+        g = self._guard
+        reason = g.is_bad(bool(onp.isfinite(lf) and onp.isfinite(gn)), gn)
+        if reason is None:
+            g.good_step()
+            return False
+        action = g.decide(self._t, reason,
+                          detail=f"loss={lf:g}, grad_norm={gn:g}")
+        if action == "rollback":
+            snap_t, pvals, states = self._snapshot
+            # restore COPIES — the snapshot must survive further rollbacks
+            # until the next good-step refresh
+            self._param_vals = self._copy_state(pvals)
+            self._opt_states = self._copy_state(states)
+            self._t = snap_t
+            self._t_dev = None
+            self._optimizer.num_update = snap_t
+            return True
+        return False
+
+    @property
+    def guard(self):
+        return self._guard
+
+    @property
+    def watchdog(self):
+        return self._watchdog
 
     # ------------------------------------------------------------------
     def sync_to_block(self) -> None:
@@ -336,8 +437,19 @@ class ShardedTrainer:
             "opt_states": jax.device_get(self._opt_states),
             "param_vals": jax.device_get(self._param_vals),
         }
-        with open(fname, "wb") as f:
-            pickle.dump(state, f)
+        tmp = f"{fname}.tmp-{os.getpid()}"
+        try:
+            with open(tmp, "wb") as f:
+                pickle.dump(state, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, fname)  # never leave a truncated checkpoint
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
 
     def _ckpt_tree(self):
         return {"param_vals": list(self._param_vals),
@@ -388,6 +500,116 @@ class ShardedTrainer:
                 jax.device_put(jnp.asarray(s), ssh)
                 for s, ssh in zip(st, self._state_shardings[i])))
         self._param_vals, self._opt_states = tuple(vals), tuple(states)
+
+    # ------------------------------------------------------------------
+    # resumable checkpoints (mx.fault.checkpoint — SURVEY §5.4 + ISSUE 2)
+    # ------------------------------------------------------------------
+    _CKPT_FORMAT = 1
+
+    def save_checkpoint(self, root: str, keep: Optional[int] = 3) -> str:
+        """Write one atomic, versioned checkpoint directory under ``root``
+        covering EVERYTHING a bit-identical resume needs: parameters,
+        optimizer state (incl. ZeRO-1 shards — gathered to host, resharded
+        on load), the step counter, the LR-schedule position, and the RNG
+        base key. Returns the checkpoint directory; retention keeps the
+        newest ``keep`` steps. Call it from the training loop::
+
+            if trainer.num_update % 500 == 0:
+                trainer.save_checkpoint("ckpts/")
+        """
+        if self._params is None:
+            raise MXNetError("nothing to checkpoint: run step() at least "
+                             "once so the parameter state exists")
+        from ..fault import checkpoint as ckpt
+        items = sorted(self._block.collect_params().items())
+        arrays: Dict[str, Any] = {}
+        for i, (name, _) in enumerate(items):
+            arrays[f"param:{i:04d}"] = jax.device_get(self._param_vals[i])
+            for j, s in enumerate(self._opt_states[i]):
+                arrays[f"opt:{i:04d}:{j}"] = jax.device_get(s)
+        if self._base_key is not None:
+            arrays["rng:base_key"] = jax.device_get(
+                jax.random.key_data(self._base_key))
+        meta = {
+            "trainer": "ShardedTrainer", "format": self._CKPT_FORMAT,
+            "t": self._t,
+            "num_update": self._optimizer.num_update,
+            "lr": float(self._optimizer.learning_rate),
+            "zero1": self._zero1,
+            "optimizer": type(self._optimizer).__name__,
+            "rng_impl": random_mod._impl(),
+            "param_names": [name for name, _ in items],
+            "opt_state_sizes": [len(s) for s in self._opt_states],
+        }
+        return ckpt.save_checkpoint(root, arrays, meta, step=self._t,
+                                    keep=keep)
+
+    def restore_checkpoint(self, root: str,
+                           step: Optional[int] = None) -> int:
+        """Restore from the newest verified checkpoint under ``root`` (or
+        an explicit ``step``), placing every array DIRECTLY onto its live
+        mesh sharding (load → reshard; the zero1 dp-partition of optimizer
+        states included). Requires an initialized trainer (one ``step()``
+        — its state is fully overwritten). Returns the restored step."""
+        if self._params is None:
+            raise MXNetError("call step() once before restore_checkpoint "
+                             "so the parameter set and shardings exist")
+        from ..fault import checkpoint as ckpt
+        if step is None:
+            arrays, meta, step = ckpt.load_latest(root)
+        else:
+            arrays, meta, step = ckpt.load_checkpoint(root, step)
+        if meta.get("trainer") != "ShardedTrainer" \
+                or meta.get("format") != self._CKPT_FORMAT:
+            raise MXNetError(
+                f"checkpoint step {step} was not written by "
+                f"ShardedTrainer.save_checkpoint (meta: {meta.get('trainer')!r}"
+                f" format {meta.get('format')!r})")
+        items = sorted(self._block.collect_params().items())
+        names = [name for name, _ in items]
+        saved_names = meta.get("param_names", [])
+        if len(saved_names) != len(names):
+            raise MXNetError(
+                "checkpoint parameter set does not match this block: "
+                f"saved {len(saved_names)} parameters, live {len(names)}")
+        if saved_names != names:
+            # auto-incremented gluon prefixes differ across same-process
+            # instances; shapes/dtypes below are the binding contract
+            import warnings
+            warnings.warn(f"checkpoint parameter names differ from the live "
+                          f"block ({saved_names[:2]}... vs {names[:2]}...); "
+                          "restoring by position")
+        vals, states = [], []
+        for i in range(len(items)):
+            try:
+                v = arrays[f"param:{i:04d}"]
+                st = [arrays[f"opt:{i:04d}:{j}"]
+                      for j in range(meta["opt_state_sizes"][i])]
+            except KeyError as e:
+                raise MXNetError(f"checkpoint step {step} is missing "
+                                 f"array {e}") from e
+            live = self._param_vals[i]
+            if tuple(v.shape) != tuple(live.shape) \
+                    or jnp.asarray(v).dtype != live.dtype:
+                raise MXNetError(
+                    f"checkpoint array for parameter {names[i]!r} is "
+                    f"{v.dtype}{tuple(v.shape)}, live parameter is "
+                    f"{live.dtype}{tuple(live.shape)}")
+            vals.append(jax.device_put(jnp.asarray(v),
+                                       self._param_shardings[i]))
+            states.append(tuple(
+                jax.device_put(jnp.asarray(s), ssh)
+                for s, ssh in zip(st, self._state_shardings[i])))
+        self._param_vals, self._opt_states = tuple(vals), tuple(states)
+        self._t = int(meta["t"])
+        self._t_dev = None           # re-materialized from _t on next step
+        self._optimizer.num_update = int(meta["num_update"])
+        if "rng:base_key" in arrays:
+            self._base_key = jax.random.wrap_key_data(
+                jnp.asarray(arrays["rng:base_key"]),
+                impl=meta.get("rng_impl") or random_mod._impl())
+        self._snapshot = None        # stale rollback state from before
+        return step
 
     def _load_states_orbax(self, path: str) -> None:
         """Restore each array DIRECTLY onto its mesh sharding (TensorStore

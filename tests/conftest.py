@@ -40,6 +40,9 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "lint: mx.analysis / mxlint static-analysis tests "
         "(select with -m lint, skip with -m 'not lint')")
+    config.addinivalue_line(
+        "markers", "chaos: seeded fault-injection tests (mx.fault.inject) "
+        "— the CI chaos job runs exactly -m chaos")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,18 @@
+"""Seeded MX401 violation: a training script that builds a trainer and
+runs a step loop but never checkpoints — one crash loses the run."""
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon, parallel
+
+
+def main():
+    net = gluon.nn.Dense(10)
+    net.initialize()
+    trainer = parallel.ShardedTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "adamw",
+        {"learning_rate": 1e-3})
+    for x, y in batches():           # noqa: F821 — fixture, never imported
+        trainer.step(x, y)
+
+
+if __name__ == "__main__":
+    main()
