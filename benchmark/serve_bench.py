@@ -1,0 +1,247 @@
+#!/usr/bin/env python
+"""serve_bench — offline throughput/latency sweep + dynamic-batching demo.
+
+The serving counterpart of ``bench.py`` (which measures training steps):
+one command produces a BENCH-style JSON record covering
+
+1. **offline sweep**: for each batch-bucket size, steady-state
+   ``CompiledModel.predict`` latency and throughput (rows/sec) — the
+   padded-batch replay ceiling;
+2. **dynamic section** (the ISSUE acceptance demo): N mixed-shape single
+   requests pushed through a :class:`DynamicBatcher` from client threads —
+   p50/p95/p99 end-to-end latency, throughput, batch occupancy, queue
+   high-water, and the compile-cache counters with **zero post-warmup
+   recompiles asserted** (rc != 0 on violation);
+3. per-stage wall time from the profiler span recorder
+   (pad / compute / unpad / batch).
+
+Usage::
+
+    python -m benchmark.serve_bench --smoke          # <60 s CPU CI config
+    python -m benchmark.serve_bench --model bert --requests 5000
+    python -m benchmark.serve_bench --out serve_bench.json
+
+Env: ``MXTPU_SERVE_BENCH_MODEL`` (mlp|lenet|bert), ``MXTPU_SERVE_BENCH_N``
+(request count) mirror the flags for harness use.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+import jax  # noqa: E402
+
+import numpy as onp  # noqa: E402
+
+
+def _build(model_name: str, smoke: bool):
+    """Returns (net, table, spec, make_request(rng) -> per-example args)."""
+    from incubator_mxnet_tpu import models, nd, serve
+
+    if model_name == "bert":
+        vocab, max_len = 1000, 64 if smoke else 128
+        net = models.get_bert("bert_2_128_2", vocab_size=vocab,
+                              max_length=max_len, dropout=0.1,
+                              use_decoder=False, use_classifier=False)
+        net.initialize()
+        net.hybridize()
+        rng = onp.random.RandomState(0)
+        L = 16
+        ids = nd.array(rng.randint(1, vocab, (2, L)).astype("int32"))
+        tt = nd.array(onp.zeros((2, L), "int32"))
+        vl = nd.array(onp.full((2,), L, "float32"))
+        net(ids, tt, vl)
+        table = serve.BucketTable({"batch": (1, 8 if smoke else 32),
+                                   "seq": (8, 32 if smoke else max_len)})
+        spec = models.serve_spec("bert_encoder")
+
+        def make_request(rng):
+            L = int(rng.randint(4, (32 if smoke else max_len) - 1))
+            return (rng.randint(1, vocab, (L,)).astype("int32"),
+                    onp.zeros((L,), "int32"), onp.float32(L))
+
+        return net, table, spec, make_request
+
+    if model_name == "lenet":
+        net = models.LeNet()
+        net.initialize()
+        net.hybridize()
+        from incubator_mxnet_tpu import nd
+        x = nd.array(onp.zeros((2, 1, 28, 28), "float32"))
+        net(x)
+        table = serve.BucketTable({"batch": (1, 16 if smoke else 64)})
+        spec = models.serve_spec("lenet")
+
+        def make_request(rng):
+            return (rng.randn(1, 28, 28).astype("float32"),)
+
+        return net, table, spec, make_request
+
+    # mlp: the fastest smoke model
+    from incubator_mxnet_tpu import gluon, nd
+    net = gluon.nn.HybridSequential(prefix="servebench_")
+    with net.name_scope():
+        net.add(gluon.nn.Dense(64, activation="relu", in_units=32))
+        net.add(gluon.nn.Dense(8, in_units=64))
+    net.initialize()
+    net.hybridize()
+    net(nd.array(onp.zeros((2, 32), "float32")))
+    table = serve.BucketTable({"batch": (1, 16 if smoke else 64)})
+    spec = {"input_axes": [{0: "batch"}], "output_axes": [{0: "batch"}],
+            "pad_values": [0]}
+
+    def make_request(rng):
+        return (rng.randn(32).astype("float32"),)
+
+    return net, table, spec, make_request
+
+
+def offline_sweep(model, table, make_request, iters: int):
+    """Steady-state padded-batch latency per batch bucket."""
+    from incubator_mxnet_tpu.serve.batcher import stack_examples
+
+    rows = []
+    rng = onp.random.RandomState(1)
+    axis = model._primary_axis
+    for bucket in table.sizes(axis):
+        reqs = [make_request(rng) for _ in range(bucket)]
+        # mixed per-request lengths (bert): pad to the batch max exactly
+        # like a batcher flush would
+        stacked = stack_examples(model, reqs)
+        model.predict(*stacked)  # steady-state: bucket already warmed
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = model.predict(*stacked)
+        out = out[0] if isinstance(out, tuple) else out
+        out.asnumpy()  # sync
+        dt = (time.perf_counter() - t0) / iters
+        rows.append({"batch": bucket, "latency_ms": round(dt * 1e3, 3),
+                     "rows_per_sec": round(bucket / dt, 1)})
+    return rows
+
+
+def dynamic_run(model, spec, make_request, n_requests: int,
+                clients: int, deadline_ms: float):
+    from incubator_mxnet_tpu import serve
+
+    batcher = serve.DynamicBatcher(model, max_delay_ms=deadline_ms).start()
+    errors = []
+    lock = threading.Lock()
+
+    def client(cid: int):
+        rng = onp.random.RandomState(100 + cid)
+        n = n_requests // clients
+        for _ in range(n):
+            try:
+                fut = batcher.submit(*make_request(rng))
+                fut.result(timeout=120)
+            except Exception as e:  # noqa: BLE001 — collected for the report
+                with lock:
+                    errors.append(f"{type(e).__name__}: {e}")
+                return
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=client, args=(c,))
+               for c in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    snap = batcher.metrics.snapshot(model)
+    batcher.stop()
+    served = snap["requests"]
+    return {
+        "requests": served,
+        "wall_s": round(wall, 3),
+        "throughput_req_per_sec": round(served / wall, 1) if wall else 0.0,
+        "clients": clients,
+        "deadline_ms": deadline_ms,
+        "errors": errors[:5],
+        **{k: snap[k] for k in ("latency", "batch_latency",
+                                "batch_occupancy", "queue_max_depth",
+                                "batches", "rejected")},
+        "compile_cache": snap["compile_cache"],
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--model", default=os.environ.get(
+        "MXTPU_SERVE_BENCH_MODEL", "mlp"), choices=["mlp", "lenet", "bert"])
+    ap.add_argument("--requests", type=int, default=int(os.environ.get(
+        "MXTPU_SERVE_BENCH_N", "1000")))
+    ap.add_argument("--clients", type=int, default=4)
+    ap.add_argument("--iters", type=int, default=20,
+                    help="offline timed iterations per bucket")
+    ap.add_argument("--deadline-ms", type=float, default=None)
+    ap.add_argument("--smoke", action="store_true",
+                    help="<60s CPU config: small buckets, fewer iters")
+    ap.add_argument("--out", default=None, help="also write JSON here")
+    args = ap.parse_args(argv)
+
+    from incubator_mxnet_tpu import profiler, serve
+
+    if args.smoke:
+        args.iters = min(args.iters, 5)
+    deadline = args.deadline_ms if args.deadline_ms is not None else \
+        float(os.environ.get("MXTPU_SERVE_DEADLINE_MS", "5"))
+
+    net, table, spec, make_request = _build(args.model, args.smoke)
+    model = serve.CompiledModel(
+        net, table, spec["input_axes"], output_axes=spec["output_axes"],
+        pad_values=spec["pad_values"])
+    t0 = time.perf_counter()
+    warm = model.warmup()
+    profiler.reset_spans()
+
+    sweep = offline_sweep(model, table, make_request, args.iters)
+    dyn = dynamic_run(model, spec, make_request, args.requests,
+                      args.clients, deadline)
+    spans = profiler.span_records()
+
+    best = max(sweep, key=lambda r: r["rows_per_sec"])
+    recompiles = dyn["compile_cache"]["post_warmup_compiles"]
+    result = {
+        "metric": f"serve_{args.model}_throughput_req_per_sec",
+        "value": dyn["throughput_req_per_sec"],
+        "unit": "req/sec",
+        "vs_baseline": None,
+        "extra": {
+            "model": args.model,
+            "backend": jax.default_backend(),
+            "warmup": warm,
+            "offline_sweep": sweep,
+            "offline_best": best,
+            "dynamic": dyn,
+            "stage_spans": {k: spans[k] for k in sorted(spans)
+                            if k.startswith("serve.")},
+            "wall_total_s": round(time.perf_counter() - t0, 1),
+        },
+    }
+    doc = json.dumps(result)
+    print(doc)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(doc + "\n")
+    if dyn["errors"]:
+        print(f"serve_bench: {len(dyn['errors'])} client error(s): "
+              f"{dyn['errors']}", file=sys.stderr)
+        return 1
+    if recompiles:
+        print(f"serve_bench: ZERO-RECOMPILE CONTRACT VIOLATED: "
+              f"{recompiles} post-warmup compile(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

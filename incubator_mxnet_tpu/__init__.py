@@ -51,6 +51,7 @@ _LAZY = {
     "amp": ".amp",
     "contrib": ".contrib",
     "runtime": ".runtime",
+    "serve": ".serve",
     "test_utils": ".test_utils",
     "util": ".util",
     "callback": ".callback",

@@ -14,6 +14,8 @@ analogue):
 ``infer_shapes``          abstract eval with provenance, MX1xx
 tracer lint + recompile   jit hygiene (AST + runtime), MX2xx
 ``sharding``              PartitionSpec vs mesh, MX3xx
+fault lint                checkpoint hygiene (AST), MX4xx
+serve lint                serving/jit-cache hygiene (AST), MX5xx
 ========================  ===========================================
 
 Programmatic entry point::
@@ -40,6 +42,7 @@ from . import sharding_check  # noqa: F401  (registers sharding)
 from .graph_verifier import tensor_arity  # noqa: F401
 from .sharding_check import check_sharding  # noqa: F401
 from . import fault_lint  # noqa: F401
+from . import serve_lint  # noqa: F401
 from . import tracer_lint  # noqa: F401
 from .recompile import (  # noqa: F401
     RECOMPILE_WARN_THRESHOLD, RecompileWarning, cache_report, note_compile,
@@ -47,10 +50,12 @@ from .recompile import (  # noqa: F401
 
 
 def lint_source(src, filename: str = "<string>") -> Report:
-    """Source lint = tracer hygiene (MX2xx) + fault hygiene (MX4xx), one
-    merged Report (the ``mxlint`` Python-target entry point)."""
+    """Source lint = tracer hygiene (MX2xx) + fault hygiene (MX4xx) +
+    serving hygiene (MX5xx), one merged Report (the ``mxlint``
+    Python-target entry point)."""
     report = tracer_lint.lint_source(src, filename)
     report.extend(fault_lint.lint_source(src, filename))
+    report.extend(serve_lint.lint_source(src, filename))
     return report
 
 

@@ -35,7 +35,8 @@ def walk_lint(paths, lint_file) -> "Report":
 
 #: Stable diagnostic codes. The MX0xx family is graph structure, MX1xx is
 #: abstract shape/dtype evaluation, MX2xx is jit-cache/tracer hygiene,
-#: MX3xx is sharding consistency, and MX4xx is fault-tolerance hygiene.
+#: MX3xx is sharding consistency, MX4xx is fault-tolerance hygiene, and
+#: MX5xx is serving hygiene (jit-per-request / unbucketed shapes).
 #: Codes are append-only: tools and CI grep for them, so a code's meaning
 #: never changes once released.
 CODES = {
@@ -60,6 +61,10 @@ CODES = {
     "MX303": "conflicting PartitionSpecs match the same parameter",
     "MX401": "training loop never checkpoints (no save_checkpoint/"
              "save_states/save_parameters call; a crash loses the run)",
+    "MX501": "inference path compiles/re-traces inside the request loop "
+             "(jit/hybridize/CompiledModel per iteration)",
+    "MX502": "serving entry point jits on raw (unbucketed) request shapes "
+             "— every novel shape is a fresh XLA compile",
 }
 
 

@@ -1,0 +1,183 @@
+"""Serving-hygiene linter (the MX5xx family).
+
+Companion to :mod:`.tracer_lint` (protects the compiled graph from Python)
+and :mod:`.fault_lint` (protects the run from the machine): this pass
+protects the *request path* from the jit cache. On a jit runtime every
+distinct input shape is a fresh XLA compile — seconds of tail latency
+injected into whichever request drew the new shape — so an inference entry
+point must (a) compile once, outside the request loop, and (b) quantize
+request shapes onto warmed buckets (``mx.serve.BucketTable`` /
+``CompiledModel.warmup``). Two pure-AST checks, warning severity
+(perf hazards, same contract as MX201/MX401; ``mxlint --strict`` gates):
+
+- **MX501** — a compile-constructing call (``jax.jit``, ``.hybridize()``,
+  ``serve.CompiledModel``) inside a ``for``/``while`` body:
+  the classic re-trace-per-request bug; hoist it out of the loop and warm
+  up once.
+- **MX502** — a serving entry point (a function named ``predict`` /
+  ``serve`` / ``infer`` / ``handle`` / ``handle_request``) feeds one of
+  its own raw parameters straight to a jitted/hybridized callable, and
+  the file shows no bucketing/warmup evidence at all: every novel request
+  shape will compile. Routing through ``mx.serve`` (``CompiledModel``,
+  ``DynamicBatcher``…) or any ``BucketTable``/``warmup`` use counts as
+  evidence, so the serve runtime and code built on it lint clean.
+
+Heuristics are tuned for zero noise on non-serving files: MX502 requires
+all three legs (entry-point name, jit-bound callee, raw parameter
+argument) before it fires.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from .diagnostics import Diagnostic, Report, walk_lint
+
+__all__ = ["lint_source", "lint_file", "lint_paths"]
+
+#: function/method names treated as request-serving entry points
+_ENTRY_NAMES = {"predict", "serve", "infer", "inference", "handle",
+                "handle_request"}
+
+#: any of these identifiers anywhere in the file = the code already
+#: thinks in buckets / uses the serve runtime — MX502 stays quiet
+_BUCKET_EVIDENCE = {"BucketTable", "bucket", "bucket_for", "assignment",
+                    "round_up_pow2", "warmup", "CompiledModel",
+                    "DynamicBatcher", "ModelRegistry", "export_for_serving"}
+
+#: attribute/function leaf names whose call constructs a compile
+#: (``.lower()`` is deliberately absent — too common on strings)
+_COMPILE_NAMES = {"jit", "hybridize", "CompiledModel"}
+
+
+def _call_is_compile(call: ast.Call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id in _COMPILE_NAMES
+    if isinstance(f, ast.Attribute):
+        return f.attr in _COMPILE_NAMES
+    return False
+
+
+def _jit_bound_names(tree: ast.Module) -> Set[str]:
+    """Names (incl. attribute leaf names) bound to a jit/hybridized
+    callable anywhere in the file: ``model = jax.jit(f)``,
+    ``self.fn = jit(f)``, plus receivers of ``.hybridize()``."""
+    bound: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            f = node.value.func
+            is_jit = (isinstance(f, ast.Name) and f.id == "jit") or \
+                (isinstance(f, ast.Attribute) and f.attr == "jit")
+            if is_jit:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        bound.add(tgt.id)
+                    elif isinstance(tgt, ast.Attribute):
+                        bound.add(tgt.attr)
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "hybridize":
+            recv = node.func.value
+            if isinstance(recv, ast.Name):
+                bound.add(recv.id)
+            elif isinstance(recv, ast.Attribute):
+                bound.add(recv.attr)
+    return bound
+
+
+def _has_bucket_evidence(tree: ast.Module) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name) and node.id in _BUCKET_EVIDENCE:
+            return True
+        if isinstance(node, ast.Attribute) and node.attr in _BUCKET_EVIDENCE:
+            return True
+    return False
+
+
+def _lint_mx501(tree: ast.Module, filename: str, report: Report) -> None:
+    for loop in ast.walk(tree):
+        if not isinstance(loop, (ast.For, ast.While, ast.AsyncFor)):
+            continue
+        for node in ast.walk(loop):
+            if node is loop:
+                continue
+            # nested loops report at their own visit
+            if isinstance(node, ast.Call) and _call_is_compile(node):
+                what = (node.func.attr if isinstance(node.func, ast.Attribute)
+                        else node.func.id)
+                report.add(Diagnostic(
+                    "MX501",
+                    f"{what}() inside a loop compiles/re-traces per "
+                    "iteration — seconds of latency per request; build the "
+                    "compiled callable once outside the loop and warmup() "
+                    "its shape buckets (mx.serve.CompiledModel)",
+                    node=f"{filename}:{getattr(node, 'lineno', 0)}",
+                    op=what, pass_name="serve_lint", severity="warning"))
+
+
+def _lint_mx502(tree: ast.Module, filename: str, report: Report) -> None:
+    if _has_bucket_evidence(tree):
+        return
+    jit_names = _jit_bound_names(tree)
+    if not jit_names:
+        return
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                or fn.name not in _ENTRY_NAMES:
+            continue
+        args = fn.args
+        params = {a.arg for a in args.posonlyargs + args.args
+                  + args.kwonlyargs} - {"self", "cls"}
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            callee = f.id if isinstance(f, ast.Name) else (
+                f.attr if isinstance(f, ast.Attribute) else None)
+            if callee not in jit_names:
+                continue
+            raw = [a.id for a in node.args
+                   if isinstance(a, ast.Name) and a.id in params]
+            if raw:
+                report.add(Diagnostic(
+                    "MX502",
+                    f"serving entry point {fn.name}() feeds raw request "
+                    f"argument(s) {raw} to the jitted callable "
+                    f"{callee!r} — every novel request shape is a fresh "
+                    "XLA compile; pad onto a warmed "
+                    "mx.serve.BucketTable first",
+                    node=f"{filename}:{getattr(node, 'lineno', 0)}",
+                    op=f"{fn.name}", pass_name="serve_lint",
+                    severity="warning"))
+
+
+def lint_source(src: str, filename: str = "<string>") -> Report:
+    """Lint one Python source blob for MX5xx findings."""
+    report = Report()
+    try:
+        tree = ast.parse(src, filename=filename)
+    except SyntaxError:
+        return report  # tracer_lint owns the MX200 parse diagnostic
+    _lint_mx501(tree, filename, report)
+    _lint_mx502(tree, filename, report)
+    # nested-loop duplicates (outer AND inner loop visit the same call)
+    seen = set()
+    deduped = Report()
+    deduped.skipped.extend(report.skipped)
+    for d in report.diagnostics:
+        key = (d.code, d.node, d.op)
+        if key not in seen:
+            seen.add(key)
+            deduped.add(d)
+    return deduped
+
+
+def lint_file(path: str) -> Report:
+    with open(path) as f:
+        return lint_source(f.read(), filename=path)
+
+
+def lint_paths(paths) -> Report:
+    """Lint files and directories (recursing into ``*.py``)."""
+    return walk_lint(paths, lint_file)

@@ -16,9 +16,10 @@ atomicity were exercised only by real outages. Here every fault the
   deadline.
 - **Crash points** (``crash("site")``): hard process-death simulation at
   named sites (e.g. ``nd.save`` mid-write, ``checkpoint.finalize`` before
-  the atomic rename) raising :class:`ChaosCrash` — the caller's cleanup
-  does NOT run the happy path, exactly like SIGKILL for atomicity purposes
-  within one process.
+  the atomic rename, ``serve.registry.load`` mid-model-load — the serving
+  registry must keep the previous version serving through it) raising
+  :class:`ChaosCrash` — the caller's cleanup does NOT run the happy path,
+  exactly like SIGKILL for atomicity purposes within one process.
 
 Determinism: each site draws from its own ``RandomState`` seeded by
 ``(seed, site)``, so outcomes depend only on the seed and the per-site call

@@ -592,44 +592,17 @@ class HybridBlock(Block):
         return tuple(result)
 
     # ------------------------------------------------------------------
-    def export(self, path: str, epoch: int = 0,
-               platforms=None) -> Tuple[str, str]:
-        """Serialize a self-contained deploy artifact (reference:
-        HybridBlock.export → model-symbol.json + model-0000.params).
-
-        TPU-native form: the inference forward is re-traced with
-        ``train_mode=False`` and serialized as **StableHLO** via
-        ``jax.export`` (`<path>-symbol.stablehlo`), alongside the dmlc
-        ``.params`` weights and a JSON manifest that records the calling
-        convention (input avals, parameter order, RNG key wire format,
-        output structure). :meth:`SymbolBlock.imports` reconstructs a
-        runnable block from these files WITHOUT the original Python class.
-
-        Requires one prior hybridized call (the reference requires a forward
-        before export for the same reason — shapes must be known).
-        ``platforms``: optional list (e.g. ``["cpu", "tpu"]``) to make the
-        artifact portable across backends; default = current backend only.
-        """
-        import json
-
-        params_file = f"{path}-{epoch:04d}.params"
-        params = self._collect_params_with_prefix()
-        from .. import ndarray as nd
-        nd.save(params_file, {k: p._check_and_get(p._data, None)
-                              for k, p in params.items() if p._data is not None})
-        sym_file = f"{path}-symbol.json"
-        if getattr(self, "_last_sig", None) is None:
-            raise MXNetError(
-                "export() needs a traced graph: call hybridize() and run one "
-                "forward pass before exporting (reference behavior)")
-        skeleton, n_in, in_avals, ctx = self._last_sig
-        blk_params = self._cached_params
-        name_by_id = {id(p): k for k, p in params.items()}
-        param_order = [name_by_id[id(p)] for p in blk_params]
+    def _make_pure_infer(self, skeleton, n_in: int, ctx):
+        """Build the inference-mode pure function over this block's cached
+        graph: ``pure_infer(key_data, *inputs, *param_values) -> flat outs``
+        traced with ``train_mode=False`` (dropout identity, BatchNorm on
+        running stats). Returns ``(pure_infer, meta)`` — ``meta`` is filled
+        with ``out_fmt``/``multi`` during tracing. Shared by
+        :meth:`export` and the serving compiler
+        (:class:`~incubator_mxnet_tpu.serve.CompiledModel`)."""
         impl = random_mod._impl()
-        key_data_aval = jax.random.key_data(jax.random.key(0, impl=impl))
+        blk_params = self._cached_params
         meta: Dict[str, Any] = {}
-
         block = self
 
         def pure_infer(key_data, *vals):
@@ -654,31 +627,106 @@ class HybridBlock(Block):
             return tuple(o._data if isinstance(o, NDArray) else o
                          for o in flat_out)
 
+        return pure_infer, meta
+
+    def export(self, path: str, epoch: int = 0,
+               platforms=None, signatures=None) -> Tuple[str, str]:
+        """Serialize a self-contained deploy artifact (reference:
+        HybridBlock.export → model-symbol.json + model-0000.params).
+
+        TPU-native form: the inference forward is re-traced with
+        ``train_mode=False`` and serialized as **StableHLO** via
+        ``jax.export`` (`<path>-symbol.stablehlo`), alongside the dmlc
+        ``.params`` weights and a JSON manifest that records the calling
+        convention (input avals, parameter order, RNG key wire format,
+        output structure). :meth:`SymbolBlock.imports` reconstructs a
+        runnable block from these files WITHOUT the original Python class.
+
+        Requires one prior hybridized call (the reference requires a forward
+        before export for the same reason — shapes must be known).
+        ``platforms``: optional list (e.g. ``["cpu", "tpu"]``) to make the
+        artifact portable across backends; default = current backend only.
+
+        ``signatures``: optional list of *additional-shape* input signatures
+        to bake into the artifact — each entry is a list of ``(shape,
+        dtype)`` pairs, one per array input. StableHLO graphs are
+        fixed-shape, so a served model needs one graph per shape bucket;
+        every listed signature is traced and serialized
+        (``<path>-symbol.<i>.stablehlo``) and
+        :meth:`SymbolBlock.forward` dispatches on the call's input shapes.
+        Default: the recorded signature of the last hybridized call only.
+        """
+        import json
+
+        params_file = f"{path}-{epoch:04d}.params"
+        params = self._collect_params_with_prefix()
+        from .. import ndarray as nd
+        nd.save(params_file, {k: p._check_and_get(p._data, None)
+                              for k, p in params.items() if p._data is not None})
+        sym_file = f"{path}-symbol.json"
+        if getattr(self, "_last_sig", None) is None:
+            raise MXNetError(
+                "export() needs a traced graph: call hybridize() and run one "
+                "forward pass before exporting (reference behavior)")
+        skeleton, n_in, in_avals, ctx = self._last_sig
+        blk_params = self._cached_params
+        name_by_id = {id(p): k for k, p in params.items()}
+        param_order = [name_by_id[id(p)] for p in blk_params]
+        impl = random_mod._impl()
+        key_data_aval = jax.random.key_data(jax.random.key(0, impl=impl))
+
+        # additional signatures ADD to the recorded one (deduped), so the
+        # artifact can always replay the shape it was exported after
+        sigs = [[(tuple(s), str(d)) for s, d in in_avals]]
+        for sig in (signatures or []):
+            norm = [(tuple(s), str(d)) for s, d in sig]
+            if len(norm) != n_in:
+                raise MXNetError(
+                    f"export(signatures=...): each signature needs "
+                    f"{n_in} (shape, dtype) input entries, got {len(norm)}")
+            if norm not in sigs:
+                sigs.append(norm)
+
         from jax import export as jax_export
-        args = [jax.ShapeDtypeStruct(key_data_aval.shape,
-                                     key_data_aval.dtype)]
-        args += [jax.ShapeDtypeStruct(s, jnp.dtype(d)) for s, d in in_avals]
-        args += [jax.ShapeDtypeStruct(tuple(p.shape), jnp.dtype(p.dtype))
-                 for p in blk_params]
         kwargs = {"platforms": tuple(platforms)} if platforms else {}
-        exported = jax_export.export(jax.jit(pure_infer), **kwargs)(*args)
-        hlo_file = f"{path}-symbol.stablehlo"
-        with open(hlo_file, "wb") as f:
-            f.write(exported.serialize())
+        sig_entries = []
+        exported_platforms = None
+        for i, sig in enumerate(sigs):
+            pure_infer, meta = self._make_pure_infer(skeleton, n_in, ctx)
+            args = [jax.ShapeDtypeStruct(key_data_aval.shape,
+                                         key_data_aval.dtype)]
+            args += [jax.ShapeDtypeStruct(s, jnp.dtype(d)) for s, d in sig]
+            args += [jax.ShapeDtypeStruct(tuple(p.shape), jnp.dtype(p.dtype))
+                     for p in blk_params]
+            exported = jax_export.export(jax.jit(pure_infer), **kwargs)(*args)
+            hlo_file = (f"{path}-symbol.stablehlo" if i == 0
+                        else f"{path}-symbol.{i}.stablehlo")
+            with open(hlo_file, "wb") as f:
+                f.write(exported.serialize())
+            exported_platforms = list(exported.platforms)
+            sig_entries.append({
+                "in_avals": [[list(s), d] for s, d in sig],
+                "stablehlo": hlo_file.rsplit("/", 1)[-1],
+                "out_fmt": meta["out_fmt"],
+                "multi": meta["multi"],
+            })
+        primary = sig_entries[0]
         arch = {
             "framework": "incubator_mxnet_tpu",
             "block": type(self).__name__,
             "name": self.name,
             "params": sorted(params.keys()),
             "param_order": param_order,
+            "param_prefix_names": [p.name for p in blk_params],
             "n_inputs": n_in,
-            "in_avals": [[list(s), d] for s, d in in_avals],
+            "in_avals": primary["in_avals"],
             "key": {"shape": list(key_data_aval.shape),
                     "dtype": str(key_data_aval.dtype), "impl": impl},
-            "out_fmt": meta["out_fmt"],
-            "multi": meta["multi"],
-            "stablehlo": hlo_file.rsplit("/", 1)[-1],
-            "platforms": list(exported.platforms),
+            "out_fmt": primary["out_fmt"],
+            "multi": primary["multi"],
+            "stablehlo": primary["stablehlo"],
+            "signatures": sig_entries,
+            "platforms": exported_platforms,
         }
         with open(sym_file, "w") as f:
             json.dump(arch, f, indent=2)
@@ -833,6 +881,7 @@ class SymbolBlock(HybridBlock):
         self._outputs = outputs
         self._inputs = inputs
         self._exported = None
+        self._sigs: List[dict] = []
         self._arch = outputs if isinstance(outputs, dict) else None
         self._param_arrays: Dict[str, NDArray] = {}
 
@@ -844,13 +893,24 @@ class SymbolBlock(HybridBlock):
         with open(symbol_file) as f:
             arch = json.load(f)
         blk = SymbolBlock(arch, input_names)
-        hlo_name = arch.get("stablehlo")
-        if hlo_name:
-            hlo_path = os.path.join(os.path.dirname(os.path.abspath(
-                symbol_file)), hlo_name)
-            from jax import export as jax_export
-            with open(hlo_path, "rb") as f:
-                blk._exported = jax_export.deserialize(bytearray(f.read()))
+        base = os.path.dirname(os.path.abspath(symbol_file))
+        from jax import export as jax_export
+        # multi-signature manifest (one fixed-shape StableHLO per shape
+        # bucket); legacy single-graph manifests synthesize one entry
+        entries = arch.get("signatures") or ([{
+            "in_avals": arch["in_avals"], "stablehlo": arch.get("stablehlo"),
+            "out_fmt": arch["out_fmt"], "multi": arch["multi"],
+        }] if arch.get("stablehlo") else [])
+        for ent in entries:
+            with open(os.path.join(base, ent["stablehlo"]), "rb") as f:
+                exported = jax_export.deserialize(bytearray(f.read()))
+            blk._sigs.append({
+                "exported": exported,
+                "in_avals": [(tuple(s), str(d)) for s, d in ent["in_avals"]],
+                "out_fmt": ent["out_fmt"], "multi": ent["multi"],
+            })
+        if blk._sigs:
+            blk._exported = blk._sigs[0]["exported"]
         if param_file:
             from .. import ndarray as nd
             loaded = nd.load(param_file)
@@ -864,8 +924,98 @@ class SymbolBlock(HybridBlock):
                 p._load_init(arr, ctx)
         return blk
 
+    def signatures(self) -> List[Tuple[Tuple[tuple, str], ...]]:
+        """The input (shape, dtype) signatures this artifact can run."""
+        return [tuple(s["in_avals"]) for s in self._sigs]
+
+    def _sig_for(self, ins) -> dict:
+        shapes = [tuple(i.shape) for i in ins]
+        dtypes = [str(i.dtype) for i in ins]
+        shape_hits = [s for s in self._sigs
+                      if [a[0] for a in s["in_avals"]] == shapes]
+        for s in shape_hits:
+            if [a[1] for a in s["in_avals"]] == dtypes:
+                return s
+        if shape_hits:  # shape match, dtype off — let XLA surface the cast
+            return shape_hits[0]
+        have = ", ".join(
+            "(" + ", ".join(f"{a[0]}:{a[1]}" for a in s["in_avals"]) + ")"
+            for s in self._sigs) or "<none>"
+        raise MXNetError(
+            f"no exported graph matches input shapes {shapes}; this "
+            f"artifact was exported for: {have}. Re-export with "
+            "signatures=[...] covering the needed shape buckets "
+            "(serve.export_for_serving does this from a BucketTable).")
+
+    def set_weights(self, mapping, ctx=None, allow_missing: bool = False,
+                    ignore_extra: bool = False) -> int:
+        """Swap parameter values in place (no recompile — shapes must
+        match); returns how many parameters were updated. ``mapping`` maps
+        manifest (dotted) names — or training-time prefix names, via the
+        manifest's ``param_prefix_names`` — to NDArray/numpy values. This
+        is the registry's version-swap path: weights from a newer
+        ``fault.checkpoint`` land on a cold-loaded artifact without
+        touching Python model code."""
+        from .. import ndarray as nd
+        arch = self._arch or {}
+        order = arch.get("param_order", [])
+        prefix_names = arch.get("param_prefix_names", [])
+        by_prefix = dict(zip(prefix_names, order))
+        known = set(order) | set(self._param_arrays)
+        resolved: Dict[str, NDArray] = {}
+        for name, arr in mapping.items():
+            target = name if name in known else by_prefix.get(name)
+            if target is None:
+                if ignore_extra:
+                    continue
+                raise MXNetError(
+                    f"set_weights: {name!r} is not a parameter of this "
+                    f"artifact (known: {sorted(known)[:8]}...)")
+            if not isinstance(arr, NDArray):
+                arr = nd.array(onp.asarray(arr))
+            old = self._param_arrays.get(target)
+            if old is not None and tuple(old.shape) != tuple(arr.shape):
+                raise MXNetError(
+                    f"set_weights: shape mismatch for {target!r}: artifact "
+                    f"has {tuple(old.shape)}, new value is "
+                    f"{tuple(arr.shape)}")
+            resolved[target] = arr
+        if not allow_missing:
+            missing = [n for n in order if n not in resolved
+                       and n not in self._param_arrays]
+            if missing:
+                raise MXNetError(f"set_weights: missing parameters "
+                                 f"{missing}; pass allow_missing=True to "
+                                 "keep current values")
+        for name, arr in resolved.items():
+            self._param_arrays[name] = arr
+            p = self.params._params.get(name)
+            if p is not None:
+                p._load_init(arr, ctx)
+            else:
+                p = self.params.get(name, shape=arr.shape,
+                                    dtype=str(arr._data.dtype))
+                p._load_init(arr, ctx)
+        return len(resolved)
+
+    def load_parameters(self, filename: str, ctx=None,
+                        allow_missing: bool = False,
+                        ignore_extra: bool = False, cast_dtype: bool = False,
+                        dtype_source: str = "current") -> None:
+        """Refresh this artifact's weights from a ``.params`` file (the
+        generic Block implementation walks ``_reg_params``, which an
+        imported artifact does not have)."""
+        from .. import ndarray as nd
+        loaded = nd.load(filename)
+        if not isinstance(loaded, dict):
+            raise MXNetError(f"{filename}: expected a name->array dict")
+        self.set_weights(loaded, ctx=ctx, allow_missing=allow_missing,
+                         ignore_extra=ignore_extra)
+
+    load_params = load_parameters
+
     def forward(self, *inputs):
-        if self._exported is None:
+        if not self._sigs:
             raise MXNetError(
                 "this SymbolBlock was imported from a manifest without a "
                 "StableHLO graph; re-export with HybridBlock.export() on "
@@ -879,6 +1029,7 @@ class SymbolBlock(HybridBlock):
             else current_context()
         ins = [i._data if isinstance(i, NDArray) else jnp.asarray(i)
                for i in inputs]
+        sig = self._sig_for(ins)
         try:
             pvals = [self._param_arrays[n]._data for n in arch["param_order"]]
         except KeyError as e:
@@ -886,10 +1037,10 @@ class SymbolBlock(HybridBlock):
                              "imports()") from e
         key = jax.random.key_data(jax.random.key(0, impl=arch["key"]["impl"]))
         key = key.astype(jnp.dtype(arch["key"]["dtype"]))
-        outs = self._exported.call(key, *ins, *pvals)
+        outs = sig["exported"].call(key, *ins, *pvals)
         flat = [NDArray(o, ctx=ctx) for o in outs]
-        result = _regroup(flat, arch["out_fmt"])
-        return tuple(result) if arch["multi"] else result[0]
+        result = _regroup(flat, sig["out_fmt"])
+        return tuple(result) if sig["multi"] else result[0]
 
     def hybrid_forward(self, F, x, *args, **kwargs):
         return self.forward(x, *args)

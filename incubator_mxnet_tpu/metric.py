@@ -15,7 +15,7 @@ from .ndarray.ndarray import NDArray
 
 __all__ = ["EvalMetric", "Accuracy", "TopKAccuracy", "F1", "MCC", "MAE",
            "MSE", "RMSE", "CrossEntropy", "NegativeLogLikelihood",
-           "Perplexity", "PearsonCorrelation", "Loss",
+           "Perplexity", "PearsonCorrelation", "Loss", "Percentile",
            "CompositeEvalMetric", "create"]
 
 _registry: Registry = Registry.get("metric")
@@ -277,6 +277,59 @@ class PearsonCorrelation(EvalMetric):
         l = onp.concatenate(self._labels)
         p = onp.concatenate(self._preds)
         return (self.name, float(onp.corrcoef(l, p)[0, 1]))
+
+
+@register
+class Percentile(EvalMetric):
+    """Streaming percentile summary over scalar samples (latency metrics).
+
+    ``update(None, values)`` accumulates samples (NDArray / numpy / floats);
+    ``get`` returns ``([name_p50, name_p95, ...], [values...])`` using
+    nearest-rank percentiles over a bounded uniform reservoir (algorithm R:
+    past capacity each new sample replaces a random slot with probability
+    ``reservoir/seen``, so the summary keeps tracking the FULL stream —
+    a late latency regression moves the p99 instead of being dropped).
+    Deterministically seeded; mean/count are exact regardless of the cap.
+    The serving runtime (``mx.serve.metrics``) reports request latency
+    through this metric.
+    """
+
+    def __init__(self, q=(50, 95, 99), name="latency", reservoir=8192, **kw):
+        self.q = tuple(q)
+        self.reservoir = int(reservoir)
+        super().__init__(name, **kw)
+
+    def reset(self):
+        super().reset()
+        self._samples: List[float] = []
+        self._seen = 0
+        self._rng = onp.random.RandomState(0)
+
+    def update(self, labels, preds):
+        for pred in _as_list(preds):
+            vals = _np(pred).reshape(-1)
+            self.sum_metric += float(vals.sum())
+            self.num_inst += vals.size
+            for v in vals:
+                self._seen += 1
+                if len(self._samples) < self.reservoir:
+                    self._samples.append(float(v))
+                else:
+                    j = int(self._rng.randint(0, self._seen))
+                    if j < self.reservoir:
+                        self._samples[j] = float(v)
+
+    def percentile(self, q: float) -> float:
+        from .util import nearest_rank_percentile
+        return nearest_rank_percentile(sorted(self._samples), q)
+
+    def get(self):
+        names = [f"{self.name}_p{q:g}" for q in self.q] + [f"{self.name}_mean"]
+        if self.num_inst == 0:
+            return (names, [float("nan")] * len(names))
+        vals = [self.percentile(q) for q in self.q]
+        vals.append(self.sum_metric / self.num_inst)
+        return (names, vals)
 
 
 @register

@@ -23,3 +23,56 @@ from .transformer import (  # noqa: F401
 from .bert import (  # noqa: F401
     BERTModel, BERTEncoder, bert_sharding_rules, get_bert, bert_pretrain_loss,
 )
+
+#: Serving axis specs per model family — the ``input_axes``/``pad_values``
+#: a ``serve.CompiledModel``/``ModelRegistry.load`` needs to bucket each
+#: input correctly. Indexed by the *call signature* the family's serving
+#: forward uses; ``valid_length`` pads with 0 so attention masks the fake
+#: rows/positions (padding never leaks into real outputs).
+SERVE_SPECS = {
+    # BERTModel(ids, token_types, valid_length, masked_positions)
+    "bert": {
+        "input_axes": [{0: "batch", 1: "seq"}, {0: "batch", 1: "seq"},
+                       {0: "batch"}, {0: "batch"}],
+        "output_axes": [{0: "batch", 1: "seq"}, {0: "batch"},
+                        {0: "batch"}, {0: "batch"}],
+        "pad_values": [0, 0, 0, 0],
+    },
+    # BERTModel(ids, token_types, valid_length) with use_decoder=False,
+    # use_classifier=False — encoder+pooler serving (embedding backends)
+    "bert_encoder": {
+        "input_axes": [{0: "batch", 1: "seq"}, {0: "batch", 1: "seq"},
+                       {0: "batch"}],
+        "output_axes": [{0: "batch", 1: "seq"}, {0: "batch"}],
+        "pad_values": [0, 0, 0],
+    },
+    # LeNet(images) — fixed spatial dims, bucketed batch only
+    "lenet": {
+        "input_axes": [{0: "batch"}],
+        "output_axes": [{0: "batch"}],
+        "pad_values": [0],
+    },
+    # StackedTransformerEncoder(x, mask=None) served unmasked
+    "transformer_encoder": {
+        "input_axes": [{0: "batch", 1: "seq"}],
+        "output_axes": [{0: "batch", 1: "seq"}],
+        "pad_values": [0],
+    },
+    # NMTModel.encode(src_ids, src_len) — the beam-search entry's encoder
+    "nmt_encoder": {
+        "input_axes": [{0: "batch", 1: "seq"}, {0: "batch"}],
+        "output_axes": [{0: "batch", 1: "seq"}],
+        "pad_values": [0, 0],
+    },
+}
+
+
+def serve_spec(family: str) -> dict:
+    """Copy of the named serving spec (see :data:`SERVE_SPECS`)."""
+    if family not in SERVE_SPECS:
+        raise KeyError(f"no serving spec for {family!r}; known: "
+                       f"{sorted(SERVE_SPECS)}")
+    spec = SERVE_SPECS[family]
+    return {"input_axes": [dict(a) for a in spec["input_axes"]],
+            "output_axes": [dict(a) for a in spec["output_axes"]],
+            "pad_values": list(spec["pad_values"])}

@@ -1,4 +1,4 @@
-"""Profiler facade over jax.profiler / XProf.
+"""Profiler facade over jax.profiler / XProf, plus a host-side span recorder.
 
 Reference parity (SURVEY §5.1): ``python/mxnet/profiler.py`` —
 ``set_config(filename=...)``, ``set_state('run'|'stop')``, ``pause``/
@@ -7,20 +7,85 @@ Reference parity (SURVEY §5.1): ``python/mxnet/profiler.py`` —
 TensorBoard trace directory; operator-level aggregation comes from the XLA
 trace instead of hand-instrumented engine events. NVTX ranges map to
 ``jax.profiler.TraceAnnotation``.
+
+Beyond the facade, user scopes now *record*: every ``Scope``/``Task`` exit
+appends a named wall-time span and every ``Marker.mark`` an instant event to
+a process-wide, thread-safe recorder, and :func:`dumps` aggregates them into
+a JSON document (count/total/mean/min/max/p50/p95/p99 per span name). This
+is the per-stage timing surface the serving runtime (``mx.serve``) reports
+through — device-level detail still lives in the XProf trace directory.
 """
 from __future__ import annotations
 
+import json
 import os
+import threading
 import time
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 import jax
 
 __all__ = ["set_config", "set_state", "pause", "resume", "dump", "dumps",
-           "Scope", "Task", "Frame", "Marker", "scope"]
+           "Scope", "Task", "Frame", "Marker", "scope", "span_records",
+           "reset_spans"]
 
 _STATE = {"running": False, "dir": "profile_output", "aggregate": False,
           "started_at": None}
+
+# -- host-side span recorder -------------------------------------------------
+#: cap per span name so a long-lived server cannot grow without bound; the
+#: aggregate counters keep counting past the cap, only raw samples drop
+_MAX_SAMPLES_PER_NAME = 8192
+
+_SPAN_LOCK = threading.Lock()
+_SPANS: Dict[str, dict] = {}          # name -> {count, total_ms, samples[]}
+_MARKERS: List[dict] = []
+_MARKERS_DROPPED = [0]                # overflow count past the sample cap
+
+
+def _record_span(name: str, dur_ms: float, kind: str) -> None:
+    with _SPAN_LOCK:
+        ent = _SPANS.get(name)
+        if ent is None:
+            ent = _SPANS[name] = {"kind": kind, "count": 0, "total_ms": 0.0,
+                                  "min_ms": float("inf"), "max_ms": 0.0,
+                                  "samples": []}
+        ent["count"] += 1
+        ent["total_ms"] += dur_ms
+        ent["min_ms"] = min(ent["min_ms"], dur_ms)
+        ent["max_ms"] = max(ent["max_ms"], dur_ms)
+        if len(ent["samples"]) < _MAX_SAMPLES_PER_NAME:
+            ent["samples"].append(dur_ms)
+
+
+def reset_spans() -> None:
+    """Drop all recorded spans and markers (``dumps(reset=True)`` calls
+    this after rendering)."""
+    with _SPAN_LOCK:
+        _SPANS.clear()
+        _MARKERS.clear()
+        _MARKERS_DROPPED[0] = 0
+
+
+def span_records() -> Dict[str, dict]:
+    """Aggregated span table ``{name: {kind, count, total_ms, mean_ms,
+    min_ms, max_ms, p50_ms, p95_ms, p99_ms}}`` — the programmatic form of
+    what :func:`dumps` serializes."""
+    out: Dict[str, dict] = {}
+    with _SPAN_LOCK:
+        for name, ent in _SPANS.items():
+            samples = sorted(ent["samples"])
+            row = {"kind": ent["kind"], "count": ent["count"],
+                   "total_ms": round(ent["total_ms"], 4),
+                   "mean_ms": round(ent["total_ms"] / max(ent["count"], 1), 4),
+                   "min_ms": round(ent["min_ms"], 4),
+                   "max_ms": round(ent["max_ms"], 4)}
+            from .util import nearest_rank_percentile
+            for q in (50, 95, 99):
+                row[f"p{q}_ms"] = round(nearest_rank_percentile(samples, q),
+                                        4)
+            out[name] = row
+    return out
 
 
 def set_config(filename: str = "profile.json", profile_all: bool = False,
@@ -65,24 +130,46 @@ def dump(finished: bool = True, profile_process: str = "worker") -> None:
 
 
 def dumps(reset: bool = False) -> str:
-    """Aggregate-stats table parity: points at the XProf directory (the
-    per-op table lives in the trace viewer)."""
-    return (f"Profile data in {_STATE['dir']!r} "
-            f"(open with XProf/TensorBoard profile plugin)")
+    """JSON document of every recorded user span and marker, plus a pointer
+    at the XProf trace directory (per-op device detail lives in the trace
+    viewer). ``reset=True`` clears the recorder after rendering — the
+    serving bench uses this to emit per-phase reports."""
+    with _SPAN_LOCK:
+        markers = list(_MARKERS)
+        dropped = _MARKERS_DROPPED[0]
+    doc = {"trace_dir": _STATE["dir"],
+           "note": "device-level op table: open trace_dir with "
+                   "XProf/TensorBoard profile plugin",
+           "spans": span_records(),
+           "markers": markers,
+           "markers_dropped": dropped}
+    if reset:
+        reset_spans()
+    return json.dumps(doc, indent=1, sort_keys=True)
 
 
 class Scope:
-    """User annotation scope (reference: mx.profiler.Scope; NVTX parity)."""
+    """User annotation scope (reference: mx.profiler.Scope; NVTX parity).
+    Exits record a named wall-time span retrievable via :func:`dumps`."""
+
+    _kind = "scope"
 
     def __init__(self, name: str = "<unk>"):
+        self._name = name
         self._ann = jax.profiler.TraceAnnotation(name)
+        self._t0: Optional[float] = None
 
     def __enter__(self):
+        self._t0 = time.perf_counter()
         self._ann.__enter__()
         return self
 
     def __exit__(self, *exc):
         self._ann.__exit__(*exc)
+        if self._t0 is not None:
+            _record_span(self._name,
+                         (time.perf_counter() - self._t0) * 1e3, self._kind)
+            self._t0 = None
 
 
 def scope(name: str = "<unk>") -> Scope:
@@ -91,6 +178,8 @@ def scope(name: str = "<unk>") -> Scope:
 
 class Task(Scope):
     """Named task annotation (reference: profiler.Task)."""
+
+    _kind = "task"
 
     def __init__(self, name: str = "task", domain=None):
         super().__init__(name)
@@ -103,11 +192,13 @@ class Task(Scope):
 
 
 class Frame(Task):
-    pass
+    _kind = "frame"
 
 
 class Marker:
-    """Instant event (reference: profiler.Marker.mark)."""
+    """Instant event (reference: profiler.Marker.mark). Each ``mark``
+    appends a timestamped instant to the recorder (and emits a zero-length
+    TraceAnnotation so it shows in the XProf timeline too)."""
 
     def __init__(self, name: str = "marker", domain=None):
         self._name = name
@@ -115,3 +206,9 @@ class Marker:
     def mark(self, scope_name: str = "process") -> None:
         with jax.profiler.TraceAnnotation(f"{self._name}:{scope_name}"):
             pass
+        with _SPAN_LOCK:
+            if len(_MARKERS) < _MAX_SAMPLES_PER_NAME:
+                _MARKERS.append({"name": self._name, "scope": scope_name,
+                                 "t": time.time()})
+            else:  # bounded like span samples: a long-lived server must
+                _MARKERS_DROPPED[0] += 1  # not grow without limit

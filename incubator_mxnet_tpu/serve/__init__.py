@@ -1,0 +1,55 @@
+"""``mx.serve`` — the compiled inference engine and serving runtime.
+
+Reference counterpart: inference in MXNet 1.x was ``CachedOp`` replay
+(``module.predict`` / exported symbol + MMS outside the framework). On a
+jit-cache runtime the serving problem is different and sharper: **every
+distinct request shape is an XLA compile**, so the subsystem's spine is
+shape discipline (PyGraph's capture/replay argument, arXiv:2503.19779, and
+TVM's ahead-of-time compiled deployment, arXiv:1802.04799, meet here):
+
+========================  =============================================
+:class:`BucketTable`      powers-of-two padded shape buckets per axis
+:class:`CompiledModel`    frozen inference callable; ``warmup()`` AOT-
+                          compiles every bucket; hit/miss/recompile
+                          counters make "zero post-warmup recompiles"
+                          an assertable contract
+:class:`DynamicBatcher`   deadline-bounded coalescing of single requests
+                          into bucket batches; bounded-queue backpressure
+:class:`ModelRegistry`    versioned multi-model load/unload on
+                          ``fault.checkpoint`` + ``fault.retry``; failed
+                          loads never disturb the serving version
+:class:`Server`           in-process + JSON-lines TCP front end
+:class:`ServeMetrics`     p50/p95/p99 latency, queue depth, occupancy,
+                          compile counters — JSON for the bench
+========================  =============================================
+
+Minimal end-to-end::
+
+    table = serve.BucketTable({"batch": (1, 8)})
+    model = serve.CompiledModel(net, table, [{0: "batch"}],
+                                example_args=(x,))
+    model.warmup()                      # compiles every bucket
+    out = model.predict(x)              # zero compiles from here on
+
+    reg = serve.ModelRegistry()
+    reg.load("mnist", table=table, input_axes=[{0: "batch"}],
+             artifacts="deploy/lenet")  # cold start: StableHLO + params
+    srv = serve.Server(reg).start()     # TCP on srv.port
+
+Env knobs: ``MXTPU_SERVE_DEADLINE_MS``, ``MXTPU_SERVE_QUEUE_LIMIT``,
+``MXTPU_SERVE_MAX_BATCH`` (see docs/env_vars.md).
+"""
+from __future__ import annotations
+
+from .buckets import BucketOverflow, BucketTable, round_up_pow2  # noqa: F401
+from .compiled import CompiledModel, export_for_serving  # noqa: F401
+from .batcher import DynamicBatcher, QueueFullError, ServeFuture  # noqa: F401
+from .metrics import ServeMetrics  # noqa: F401
+from .registry import ModelRegistry, ModelVersion  # noqa: F401
+from .server import Server, client_call  # noqa: F401
+
+__all__ = ["BucketTable", "BucketOverflow", "round_up_pow2",
+           "CompiledModel", "export_for_serving",
+           "DynamicBatcher", "QueueFullError", "ServeFuture",
+           "ServeMetrics", "ModelRegistry", "ModelVersion",
+           "Server", "client_call"]

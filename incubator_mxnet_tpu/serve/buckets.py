@@ -1,0 +1,115 @@
+"""Shape-bucket table — the serving answer to jit recompilation.
+
+Reference counterpart: MXNet's bucketing Module (``BucketingModule``) kept
+one executor per sequence-length bucket for variable-length RNN workloads;
+on a jit-cache runtime the same idea is what makes serving viable at all:
+every distinct input shape is a fresh XLA compile (seconds of latency — the
+MX201 hazard ``analysis/recompile.py`` warns about), so raw request shapes
+must be quantized onto a small closed set of padded shapes that
+``CompiledModel.warmup()`` pre-compiles.
+
+A :class:`BucketTable` declares *named* axes (``"batch"``, ``"seq"`` …)
+with an inclusive ``(min, max)`` range each; bucket values are the
+powers-of-two ladder clipped to that range, so the table for
+``{"batch": (1, 8), "seq": (16, 64)}`` compiles exactly
+``{1,2,4,8} x {16,32,64}`` graphs. Requests round *up* to the nearest
+bucket and the pad rows/positions are sliced back off the outputs
+(:meth:`CompiledModel.predict`), so padding is never visible to callers.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Tuple
+
+from ..base import MXNetError
+
+__all__ = ["BucketTable", "BucketOverflow", "round_up_pow2"]
+
+
+class BucketOverflow(MXNetError):
+    """A request dimension exceeds the largest declared bucket — the
+    caller must split the request (or the table must be widened and
+    re-warmed)."""
+
+
+def round_up_pow2(n: int) -> int:
+    """Smallest power of two >= n (n >= 1)."""
+    if n < 1:
+        raise MXNetError(f"bucketed dimensions must be >= 1, got {n}")
+    return 1 << (n - 1).bit_length()
+
+
+class BucketTable:
+    """Named bucketed axes with powers-of-two ladders.
+
+    ``axes`` maps an axis name to its inclusive ``(min, max)`` size range;
+    ``max`` is always a bucket even when it is not a power of two (so a
+    model served at ``seq<=384`` does not silently pad to 512).
+    """
+
+    def __init__(self, axes: Dict[str, Tuple[int, int]]):
+        if not axes:
+            raise MXNetError("BucketTable needs at least one named axis")
+        self.axes: Dict[str, Tuple[int, int]] = {}
+        self._ladders: Dict[str, List[int]] = {}
+        for name, (lo, hi) in axes.items():
+            lo, hi = int(lo), int(hi)
+            if lo < 1 or hi < lo:
+                raise MXNetError(
+                    f"axis {name!r}: need 1 <= min <= max, got ({lo}, {hi})")
+            ladder = []
+            v = round_up_pow2(lo)
+            while v < hi:
+                ladder.append(v)
+                v *= 2
+            ladder.append(hi)  # the declared max always closes the ladder
+            self.axes[name] = (lo, hi)
+            self._ladders[name] = ladder
+
+    def sizes(self, name: str) -> List[int]:
+        """The bucket ladder for one axis, ascending."""
+        return list(self._ladders[name])
+
+    def bucket(self, name: str, n: int) -> int:
+        """Smallest bucket >= ``n`` for axis ``name``."""
+        if name not in self._ladders:
+            raise MXNetError(f"unknown bucket axis {name!r}; declared: "
+                             f"{sorted(self._ladders)}")
+        for v in self._ladders[name]:
+            if v >= n:
+                return v
+        raise BucketOverflow(
+            f"axis {name!r}: size {n} exceeds the largest bucket "
+            f"{self._ladders[name][-1]}; split the request or widen the "
+            "table")
+
+    def assignment(self, sizes: Dict[str, int]) -> Dict[str, int]:
+        """Bucket every named size at once: ``{"batch": 3, "seq": 20}`` →
+        ``{"batch": 4, "seq": 32}``."""
+        return {name: self.bucket(name, n) for name, n in sizes.items()}
+
+    def assignments(self) -> Iterator[Dict[str, int]]:
+        """Every bucket combination (cross product of the ladders) — the
+        set :meth:`CompiledModel.warmup` pre-compiles, in deterministic
+        (sorted-axis, ascending-size) order."""
+        names = sorted(self._ladders)
+
+        def rec(i: int, acc: Dict[str, int]):
+            if i == len(names):
+                yield dict(acc)
+                return
+            for v in self._ladders[names[i]]:
+                acc[names[i]] = v
+                yield from rec(i + 1, acc)
+
+        yield from rec(0, {})
+
+    def num_buckets(self) -> int:
+        n = 1
+        for ladder in self._ladders.values():
+            n *= len(ladder)
+        return n
+
+    def __repr__(self):
+        parts = ", ".join(f"{k}={self._ladders[k]}"
+                          for k in sorted(self._ladders))
+        return f"BucketTable({parts})"

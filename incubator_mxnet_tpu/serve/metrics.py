@@ -1,0 +1,131 @@
+"""Per-request serving observability.
+
+Reference counterpart: the reference profiled *operator* time; a serving
+runtime needs *request* truth — tail latency, queue pressure, padding
+waste, and (the jit-specific one) recompiles. One :class:`ServeMetrics`
+instance aggregates all four families, thread-safe, and renders them as a
+JSON-ready dict (``snapshot()``) the bench harness dumps next to its
+throughput numbers:
+
+- **latency**: p50/p95/p99/mean over a bounded reservoir, via
+  :class:`metric.Percentile` (the same EvalMetric zoo training uses);
+- **queue**: live + high-water depth, rejected (backpressure) count;
+- **batching**: batches flushed, mean/last occupancy (real rows ÷ bucket
+  rows — padding waste), batch compute latency;
+- **compile**: the wrapped :class:`CompiledModel` counters — post-warmup
+  compiles MUST stay 0 in steady state.
+
+Per-stage wall-time (pad / compute / unpad / batch) rides separately on
+``mx.profiler`` spans (``profiler.dumps()``), keeping this module free of
+any device API.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from typing import Dict, Optional
+
+from ..metric import Percentile
+
+__all__ = ["ServeMetrics"]
+
+
+def _j(v, ndigits: int = 3):
+    """JSON-safe number: NaN/inf (empty metrics) become null — the wire
+    protocol must stay strict-JSON parseable on the very first scrape."""
+    try:
+        f = float(v)
+    except (TypeError, ValueError):
+        return None
+    if f != f or f in (float("inf"), float("-inf")):
+        return None
+    return round(f, ndigits)
+
+
+class ServeMetrics:
+    """Thread-safe aggregate serving counters for one model/batcher."""
+
+    def __init__(self, reservoir: int = 8192):
+        self._lock = threading.Lock()
+        self._latency = Percentile(q=(50, 95, 99), name="latency_ms",
+                                   reservoir=reservoir)
+        self._batch_ms = Percentile(q=(50, 95, 99), name="batch_ms",
+                                    reservoir=reservoir)
+        self.requests = 0
+        self.rejected = 0
+        self.failed = 0
+        self.failed_batches = 0
+        self.batches = 0
+        self.rows = 0
+        self.bucket_rows = 0
+        self.depth = 0
+        self.max_depth = 0
+        self.last_occupancy = float("nan")
+
+    # -- recording ------------------------------------------------------
+    def record_request(self, latency_ms: float) -> None:
+        with self._lock:
+            self.requests += 1
+            self._latency.update(None, [latency_ms])
+
+    def record_rejection(self) -> None:
+        with self._lock:
+            self.rejected += 1
+
+    def record_failed_batch(self, size: int) -> None:
+        """A flush that errored: its requests got exceptions, not results
+        — they must not inflate the served-traffic numbers."""
+        with self._lock:
+            self.failed += size
+            self.failed_batches += 1
+
+    def record_depth(self, depth: int) -> None:
+        with self._lock:
+            self.depth = depth
+            self.max_depth = max(self.max_depth, depth)
+
+    def record_batch(self, size: int, bucket: int, dt_ms: float) -> None:
+        with self._lock:
+            self.batches += 1
+            self.rows += size
+            self.bucket_rows += bucket
+            self.last_occupancy = size / bucket if bucket else float("nan")
+            self._batch_ms.update(None, [dt_ms])
+
+    # -- reporting ------------------------------------------------------
+    def snapshot(self, model=None) -> Dict:
+        """JSON-ready dict of everything recorded; pass the served
+        :class:`CompiledModel` to inline its compile-cache counters."""
+        with self._lock:
+            lat_names, lat_vals = self._latency.get()
+            bat_names, bat_vals = self._batch_ms.get()
+            snap = {
+                "requests": self.requests,
+                "rejected": self.rejected,
+                "failed": self.failed,
+                "failed_batches": self.failed_batches,
+                "queue_depth": self.depth,
+                "queue_max_depth": self.max_depth,
+                "batches": self.batches,
+                "batch_occupancy": _j(self.rows / self.bucket_rows, 4)
+                if self.bucket_rows else None,
+                "latency": {n: _j(v) for n, v in zip(lat_names, lat_vals)},
+                "batch_latency": {n: _j(v)
+                                  for n, v in zip(bat_names, bat_vals)},
+            }
+        if model is not None:
+            snap["compile_cache"] = model.cache_info()
+        return snap
+
+    def dumps(self, model=None) -> str:
+        return json.dumps(self.snapshot(model), indent=1, sort_keys=True)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._latency.reset()
+            self._batch_ms.reset()
+            self.requests = self.rejected = self.batches = 0
+            self.failed = self.failed_batches = 0
+            self.rows = self.bucket_rows = 0
+            self.depth = self.max_depth = 0
+            self.last_occupancy = float("nan")

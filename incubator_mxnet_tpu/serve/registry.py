@@ -1,0 +1,245 @@
+"""ModelRegistry — versioned multi-model load/unload for the server.
+
+Reference counterpart: MXNet Model Server (MMS) kept a model store beside
+the framework; here the registry is framework-native so it can reuse the
+fault runtime directly: weight loads go through
+:func:`fault.checkpoint.load_latest` (newest *verified* checkpoint, walking
+past corrupt steps) wrapped in :func:`fault.retry.call_with_retry`
+(env-tunable backoff), and a failed load — including a chaos-injected one
+(site ``"serve.registry.load"``) — NEVER disturbs the currently-serving
+version: the new :class:`CompiledModel` is built and warmed completely
+before the version table is touched.
+
+Model sources per version:
+
+- ``artifacts=`` path prefix of an ``export_for_serving`` artifact —
+  the cold-start path: StableHLO + ``.params``, no Python model code;
+- ``factory=`` zero-arg callable returning a (hybridizable) Block —
+  the co-located path, traced through the same inference pure function;
+- ``ckpt_root=`` optionally overrides either source's weights from the
+  newest verified ``fault.checkpoint`` directory (training-time prefix
+  names are mapped via the artifact manifest).
+
+Version swap contract: same architecture + same bucket table ⇒ the swap
+is :meth:`CompiledModel.refresh_params` — zero recompiles, asserted by the
+serving tests via the compile-cache counters.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as onp
+
+from ..base import MXNetError
+from ..fault import checkpoint as fault_checkpoint
+from ..fault import inject
+from ..fault.retry import RetryPolicy, call_with_retry
+from .buckets import BucketTable
+from .compiled import CompiledModel
+
+__all__ = ["ModelRegistry", "ModelVersion"]
+
+
+class ModelVersion:
+    """One immutable (name, version) entry: the compiled model + source."""
+
+    def __init__(self, name: str, version: int, compiled: CompiledModel,
+                 source: Dict[str, Any]):
+        self.name = name
+        self.version = version
+        self.compiled = compiled
+        self.source = source
+
+    def __repr__(self):
+        return f"ModelVersion({self.name!r}, v{self.version})"
+
+
+def _weights_from_checkpoint(root: str, policy: Optional[RetryPolicy]
+                             ) -> Dict[str, onp.ndarray]:
+    """Newest verified checkpoint under ``root`` → ``{param_name: array}``.
+    Understands the ``gluon.Trainer``/``ShardedTrainer`` layout
+    (``param:<i>`` arrays + ``meta["param_names"]``) as well as plain
+    name-keyed array dicts."""
+    def load():
+        inject.crash("serve.registry.load")
+        return fault_checkpoint.load_latest(root)
+
+    arrays, meta, _step = call_with_retry(
+        load, policy=policy, describe=f"checkpoint load from {root!r}")
+    names = meta.get("param_names")
+    if names:  # trainer layout: positional params + recorded names
+        out = {}
+        for i, name in enumerate(names):
+            key = f"param:{i:04d}"
+            if key in arrays:
+                out[name] = arrays[key]
+        if out:
+            return out
+    return {k: v for k, v in arrays.items() if not k.startswith("opt:")}
+
+
+class ModelRegistry:
+    """Thread-safe, versioned model table. ``get(name)`` returns the
+    active (newest unless pinned) version's :class:`CompiledModel`."""
+
+    def __init__(self, retry_policy: Optional[RetryPolicy] = None):
+        self._lock = threading.RLock()
+        self._models: Dict[str, Dict[int, ModelVersion]] = {}
+        self._active: Dict[str, int] = {}
+        self._policy = retry_policy
+
+    # -- loading --------------------------------------------------------
+    def load(self, name: str, *, table: BucketTable,
+             input_axes: Sequence[Dict[int, str]],
+             artifacts: Optional[str] = None,
+             factory: Optional[Callable[[], Any]] = None,
+             example_args: Optional[Sequence] = None,
+             ckpt_root: Optional[str] = None,
+             version: Optional[int] = None,
+             input_names: Optional[Sequence[str]] = None,
+             epoch: int = 0, warmup: bool = True,
+             output_axes: Optional[Sequence[Dict[int, str]]] = None,
+             pad_values: Any = 0) -> ModelVersion:
+        """Build, (optionally) warm and install one model version.
+
+        Everything that can fail — artifact deserialization, checkpoint
+        load (retried under the registry's policy), compilation, warmup —
+        happens on a staging copy; the registry table is only touched on
+        success, so the previously active version keeps serving through a
+        failed load.
+        """
+        if (artifacts is None) == (factory is None):
+            raise MXNetError("pass exactly one of artifacts= (cold start "
+                             "from an exported prefix) or factory= (live "
+                             "Block constructor)")
+        auto_version = version is None
+        with self._lock:
+            if auto_version:
+                have = self._models.get(name, {})
+                version = max(have) + 1 if have else 1
+            elif version in self._models.get(name, {}):
+                raise MXNetError(f"{name!r} v{version} is already loaded; "
+                                 "unload it first or omit version=")
+
+        # ---- staging: nothing below mutates the registry ----
+        if artifacts is not None:
+            from ..gluon.block import SymbolBlock
+            sym_file = f"{artifacts}-symbol.json"
+            params_file = f"{artifacts}-{epoch:04d}.params"
+            block = call_with_retry(
+                lambda: SymbolBlock.imports(
+                    sym_file, list(input_names or ["data"]), params_file),
+                policy=self._policy,
+                describe=f"artifact import from {artifacts!r}")
+            if ckpt_root is not None:
+                weights = _weights_from_checkpoint(ckpt_root, self._policy)
+                applied = block.set_weights(weights, allow_missing=True,
+                                            ignore_extra=True)
+                if not applied:
+                    # all names fell through the name mapping: the version
+                    # would silently serve stale artifact weights while
+                    # claiming checkpoint provenance
+                    raise MXNetError(
+                        f"checkpoint under {ckpt_root!r} matched 0 of the "
+                        f"artifact's parameters (checkpoint names: "
+                        f"{sorted(weights)[:4]}...; artifact names: "
+                        f"{sorted(block._arch.get('param_order', []))[:4]}"
+                        "...) — was it written by a trainer over a "
+                        "different model or name scope?")
+            source: Dict[str, Any] = {"artifacts": artifacts,
+                                      "ckpt_root": ckpt_root}
+        else:
+            block = factory()
+            if ckpt_root is not None:
+                weights = _weights_from_checkpoint(ckpt_root, self._policy)
+                params = block._collect_params_with_prefix()
+                by_prefix = {p.name: p for p in params.values()}
+                from ..ndarray import array as nd_array
+                applied = 0
+                for wname, val in weights.items():
+                    p = params.get(wname) or by_prefix.get(wname)
+                    if p is not None:
+                        p._load_init(nd_array(onp.asarray(val)), None)
+                        applied += 1
+                if not applied:
+                    raise MXNetError(
+                        f"checkpoint under {ckpt_root!r} matched 0 of the "
+                        f"factory model's parameters (checkpoint names: "
+                        f"{sorted(weights)[:4]}...) — name-scope "
+                        "mismatch?")
+            source = {"factory": getattr(factory, "__name__", "factory"),
+                      "ckpt_root": ckpt_root}
+
+        compiled = CompiledModel(block, table, input_axes,
+                                 example_args=example_args,
+                                 output_axes=output_axes,
+                                 pad_values=pad_values)
+        if warmup:
+            compiled.warmup()
+
+        entry = ModelVersion(name, version, compiled, source)
+        with self._lock:
+            versions = self._models.setdefault(name, {})
+            if version in versions:
+                if not auto_version:
+                    raise MXNetError(
+                        f"{name!r} v{version} was loaded concurrently; "
+                        "unload it first or omit version=")
+                # a concurrent auto-versioned load took this slot during
+                # staging — bump past it instead of overwriting
+                version = max(versions) + 1
+                entry.version = version
+            versions[version] = entry
+            pinned = self._active.get(name)
+            if pinned is None or version > pinned:
+                self._active[name] = version
+        return entry
+
+    # -- lookup ---------------------------------------------------------
+    def get(self, name: str, version: Optional[int] = None) -> CompiledModel:
+        return self.get_version(name, version).compiled
+
+    def get_version(self, name: str,
+                    version: Optional[int] = None) -> ModelVersion:
+        with self._lock:
+            versions = self._models.get(name)
+            if not versions:
+                raise MXNetError(f"no model {name!r} in the registry "
+                                 f"(loaded: {sorted(self._models)})")
+            v = self._active[name] if version is None else version
+            if v not in versions:
+                raise MXNetError(f"{name!r} has no version {v} "
+                                 f"(loaded: {sorted(versions)})")
+            return versions[v]
+
+    def models(self) -> Dict[str, List[int]]:
+        with self._lock:
+            return {n: sorted(vs) for n, vs in self._models.items()}
+
+    def active_version(self, name: str) -> int:
+        with self._lock:
+            if name not in self._active:
+                raise MXNetError(f"no model {name!r} in the registry")
+            return self._active[name]
+
+    # -- unloading ------------------------------------------------------
+    def unload(self, name: str, version: Optional[int] = None) -> None:
+        """Drop one version (or the whole model). Unloading the active
+        version re-activates the newest remaining one."""
+        with self._lock:
+            versions = self._models.get(name)
+            if not versions:
+                raise MXNetError(f"no model {name!r} in the registry")
+            if version is None:
+                del self._models[name]
+                self._active.pop(name, None)
+                return
+            if version not in versions:
+                raise MXNetError(f"{name!r} has no version {version}")
+            del versions[version]
+            if not versions:
+                del self._models[name]
+                self._active.pop(name, None)
+            elif self._active.get(name) == version:
+                self._active[name] = max(versions)

@@ -1,0 +1,177 @@
+"""Server — in-process and TCP front ends over the registry.
+
+Reference counterpart: MXNet Model Server sat *outside* the framework
+(Java frontend, HTTP, process boundary); this front end is deliberately
+minimal and in-tree — enough protocol to smoke-test the full
+request → queue → batch → compiled-bucket → response path over a real
+socket, while production deployments are expected to put their own RPC
+layer in front of :meth:`Server.submit`.
+
+Wire protocol: newline-delimited JSON over TCP, one object per request::
+
+    {"model": "lenet", "inputs": [[...nested lists...], ...],
+     "dtypes": ["float32"], "version": 2}          # version optional
+    -> {"ok": true, "outputs": [...], "latency_ms": 1.8}
+
+    {"cmd": "metrics", "model": "lenet"}   -> {"ok": true, "metrics": {...}}
+    {"cmd": "models"}                      -> {"ok": true, "models": {...}}
+
+Each model gets one :class:`DynamicBatcher` whose model thunk resolves
+through the registry at flush time, so a version swap redirects the very
+next batch without restarting the server.
+"""
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import threading
+import time
+from typing import Dict, Optional
+
+import numpy as onp
+
+from ..base import MXNetError
+from .batcher import DynamicBatcher, ServeFuture
+from .metrics import ServeMetrics
+from .registry import ModelRegistry
+
+__all__ = ["Server", "client_call"]
+
+
+class Server:
+    """Serve every model in ``registry`` — in-process via :meth:`submit`,
+    over TCP via :meth:`start` (``port=0`` picks a free port; read it back
+    from ``server.port``)."""
+
+    def __init__(self, registry: ModelRegistry, host: str = "127.0.0.1",
+                 port: int = 0, max_delay_ms: Optional[float] = None,
+                 queue_limit: Optional[int] = None):
+        self.registry = registry
+        self.host = host
+        self.port = port
+        self._batcher_kw = dict(max_delay_ms=max_delay_ms,
+                                queue_limit=queue_limit)
+        self._batchers: Dict[str, DynamicBatcher] = {}
+        self._lock = threading.Lock()
+        self._tcp: Optional[socketserver.ThreadingTCPServer] = None
+        self._tcp_thread: Optional[threading.Thread] = None
+
+    # -- in-process path ------------------------------------------------
+    def batcher(self, name: str) -> DynamicBatcher:
+        with self._lock:
+            b = self._batchers.get(name)
+            if b is None:
+                self.registry.get(name)  # raise early on unknown model
+                b = DynamicBatcher(lambda: self.registry.get(name),
+                                   metrics=ServeMetrics(),
+                                   **self._batcher_kw)
+                b.start()
+                self._batchers[name] = b
+        return b
+
+    def submit(self, name: str, *arrays) -> ServeFuture:
+        """Enqueue one single-example request for ``name``'s active
+        version; returns the future."""
+        return self.batcher(name).submit(*arrays)
+
+    def metrics(self, name: str) -> dict:
+        b = self.batcher(name)
+        return b.metrics.snapshot(self.registry.get(name))
+
+    # -- TCP front end --------------------------------------------------
+    def start(self) -> "Server":
+        if self._tcp is not None:
+            return self
+        outer = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                for line in self.rfile:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        reply = outer._handle_line(line)
+                    except Exception as e:  # noqa: BLE001 — wire boundary
+                        reply = {"ok": False,
+                                 "error": f"{type(e).__name__}: {e}"}
+                    self.wfile.write(
+                        (json.dumps(reply) + "\n").encode("utf-8"))
+                    self.wfile.flush()
+
+        class TCP(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._tcp = TCP((self.host, self.port), Handler)
+        self.port = self._tcp.server_address[1]
+        self._tcp_thread = threading.Thread(
+            target=self._tcp.serve_forever, name="mx-serve-tcp", daemon=True)
+        self._tcp_thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._tcp is not None:
+            self._tcp.shutdown()
+            self._tcp.server_close()
+            self._tcp = None
+        with self._lock:
+            batchers = list(self._batchers.values())
+            self._batchers.clear()
+        for b in batchers:
+            b.stop()
+
+    # -- protocol -------------------------------------------------------
+    def _handle_line(self, line: bytes) -> dict:
+        msg = json.loads(line.decode("utf-8"))
+        cmd = msg.get("cmd")
+        if cmd == "models":
+            return {"ok": True, "models": self.registry.models()}
+        if cmd == "metrics":
+            return {"ok": True, "metrics": self.metrics(msg["model"])}
+        if cmd is not None:
+            raise MXNetError(f"unknown cmd {cmd!r}")
+        name = msg["model"]
+        version = msg.get("version")
+        model = self.registry.get(name, version)
+        dtypes = msg.get("dtypes")
+        arrays = []
+        for i, payload in enumerate(msg["inputs"]):
+            dtype = (dtypes[i] if dtypes and i < len(dtypes)
+                     else model._in_avals[i][1])
+            arrays.append(onp.asarray(payload, dtype=dtype))
+        t0 = time.perf_counter()
+        if version is not None:
+            # pinned-version requests bypass the shared batcher (which
+            # always serves the active version)
+            outs = model.predict(*[a[None] for a in arrays])
+            outs = outs if isinstance(outs, tuple) else (outs,)
+            result = tuple(o.asnumpy()[0] for o in outs)
+        else:
+            fut = self.submit(name, *arrays)
+            result = fut.result(timeout=30.0)
+            if not isinstance(result, tuple):
+                result = (result,)
+        return {"ok": True,
+                "outputs": [r.tolist() for r in result],
+                "latency_ms": round((time.perf_counter() - t0) * 1e3, 3)}
+
+
+def client_call(host: str, port: int, payload: dict,
+                timeout: float = 30.0) -> dict:
+    """Minimal blocking client for the JSON-lines protocol (used by the
+    tests and the bench; real clients keep the socket open)."""
+    with socket.create_connection((host, port), timeout=timeout) as sock:
+        sock.sendall((json.dumps(payload) + "\n").encode("utf-8"))
+        buf = b""
+        while not buf.endswith(b"\n"):
+            chunk = sock.recv(65536)
+            if not chunk:
+                # surface the transport failure, not a JSON parse error
+                # on a truncated buffer
+                raise ConnectionError(
+                    f"server closed the connection before a complete "
+                    f"reply ({len(buf)} bytes received)")
+            buf += chunk
+    return json.loads(buf.decode("utf-8"))

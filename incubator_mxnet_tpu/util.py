@@ -11,7 +11,19 @@ import os
 from typing import Any, Dict, Optional
 
 __all__ = ["getenv", "setenv", "env_var_doc", "makedirs", "use_np_shape",
-           "is_np_shape", "is_np_array", "set_np", "reset_np", "np_shape"]
+           "is_np_shape", "is_np_array", "set_np", "reset_np", "np_shape",
+           "nearest_rank_percentile"]
+
+
+def nearest_rank_percentile(sorted_vals, q: float) -> float:
+    """Nearest-rank percentile over an already-sorted sample list — THE
+    shared kernel for every host-side latency summary (``metric.
+    Percentile``, the ``profiler`` span recorder). Returns NaN on empty."""
+    if not sorted_vals:
+        return float("nan")
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q / 100.0 * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
 
 #: name -> (default, description). The single catalog, reference
 #: docs/static_site/src/pages/api/faq/env_var.md.
@@ -25,6 +37,19 @@ ENV_VARS: Dict[str, tuple] = {
     "MXNET_KVSTORE_BIGARRAY_BOUND": ("1000000", "Kept for parity; sharding "
                                      "rules make the layout decision."),
     "MXNET_TEST_SEED": ("", "Fix the test RNG seed."),
+    "MXTPU_SERVE_DEADLINE_MS": ("5", "Max milliseconds the oldest queued "
+                                "request waits before the serve "
+                                "DynamicBatcher flushes a partial batch."),
+    "MXTPU_SERVE_QUEUE_LIMIT": ("1024", "Bounded serve request-queue size; "
+                                "a full queue rejects submits "
+                                "(backpressure, QueueFullError)."),
+    "MXTPU_SERVE_MAX_BATCH": ("0", "Cap on the coalesced serve batch size; "
+                              "0 = the bucket table's largest batch "
+                              "bucket."),
+    "MXTPU_SERVE_BENCH_MODEL": ("mlp", "serve_bench workload "
+                                "(mlp|lenet|bert)."),
+    "MXTPU_SERVE_BENCH_N": ("1000", "serve_bench dynamic-section request "
+                            "count."),
     "MXTPU_BENCH_MODEL": ("bert_12_768_12", "bench.py model config."),
     "MXTPU_BENCH_TRACE": ("", "bench.py: capture one profiled step into this "
                           "directory (jax.profiler trace)."),

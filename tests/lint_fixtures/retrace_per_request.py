@@ -1,0 +1,16 @@
+"""Seeded MX501 violation: compiles inside the request loop.
+
+Every iteration builds a fresh jitted callable (and re-hybridizes the
+block), so every request pays a trace + XLA compile instead of replaying
+a warmed bucket. The serve lint must flag both call sites.
+"""
+import jax
+
+
+def handle_requests(net, requests):
+    results = []
+    for req in requests:
+        fn = jax.jit(lambda x: x * 2)     # MX501: jit per iteration
+        net.hybridize()                   # MX501: re-hybridize per iteration
+        results.append(fn(req))
+    return results

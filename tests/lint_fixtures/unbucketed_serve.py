@@ -1,0 +1,14 @@
+"""Seeded MX502 violation: serving entry point jits on raw request shapes.
+
+``predict`` feeds the request array straight to a jitted callable with no
+bucketing/warmup anywhere in the file — every novel request shape is a
+fresh XLA compile in the latency path.
+"""
+import jax
+
+
+model = jax.jit(lambda x: x + 1)
+
+
+def predict(request):
+    return model(request)     # MX502: raw request shape into a jit

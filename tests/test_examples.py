@@ -85,3 +85,11 @@ def test_train_frcnn_example_detects():
     # staying >3x the untrained baseline (~0.08)
     acc = _load("train_frcnn.py").main(["--steps", "400"])
     assert acc > 0.25, acc
+
+
+@pytest.mark.slow
+def test_serving_example_zero_recompiles():
+    # end-to-end serving recipe: export bucketed artifact -> registry
+    # cold-load -> batcher -> metrics JSON; rc enforces the zero
+    # post-warmup-recompile contract
+    assert _load("serving.py").main(["--requests", "60"]) == 0
