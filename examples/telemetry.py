@@ -1,0 +1,146 @@
+"""One observability spine, end to end — train a few steps, serve a
+burst, and read everything back through mx.telemetry.
+
+Flow: a small MLP trains under a guarded ShardedTrainer (step events with
+wall/place/dispatch timings, loss and grad-norm), checkpoints, then the
+same net is bucket-compiled and serves a mixed batch burst through the
+DynamicBatcher (admit/batch/execute/reply events with request ids). The
+whole run lands in:
+
+- a **JSON-lines event stream** (``--jsonl``, strict JSON, one event per
+  line, step/request correlation ids);
+- a **Prometheus text scrape** (``--prom``) with counters from BOTH
+  training (``mxtpu_train_*``) and serving (``mxtpu_serve_*``);
+- the **compile ledger** — every XLA compile with signature/wall-time/
+  call-site, and zero post-warmup compiles asserted;
+- ``telemetry.snapshot()`` — the "what is this job doing right now" dict
+  printed at the end.
+
+    python examples/telemetry.py --steps 5 --requests 40
+    python examples/telemetry.py --jsonl /tmp/events.jsonl --trace /tmp/t.json
+
+The exit code enforces the ledger contract: zero post-warmup compiles
+across trainer AND serving.
+"""
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+import numpy as onp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import incubator_mxnet_tpu as mx  # noqa: E402
+from incubator_mxnet_tpu import (  # noqa: E402
+    fault, gluon, nd, parallel, serve, telemetry,
+)
+
+IN, HIDDEN, CLASSES = 32, 64, 8
+
+
+def build_net():
+    net = gluon.nn.HybridSequential(prefix="tele_")
+    with net.name_scope():
+        net.add(gluon.nn.Dense(HIDDEN, activation="relu", in_units=IN))
+        net.add(gluon.nn.Dense(CLASSES, in_units=HIDDEN))
+    net.initialize()
+    return net
+
+
+def train(net, steps: int, batch: int, ckpt_dir: str):
+    """A short guarded training loop — every step publishes a
+    ``train.step`` event and the step histogram/counters."""
+    guard = fault.StepGuard(policy="warn")
+    trainer = parallel.ShardedTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.05}, guard=guard,
+        watchdog=fault.Watchdog(deadline=120.0))
+    rng = onp.random.RandomState(0)
+    for _ in range(steps):
+        x = rng.randn(batch, IN).astype("float32")
+        y = (x.sum(axis=1) > 0).astype("int32") % CLASSES
+        trainer.step(x, y)
+    trainer.save_checkpoint(ckpt_dir, keep=2)
+    trainer.sync_to_block()
+    return trainer
+
+
+def serve_burst(net, requests: int, max_batch: int):
+    """A batched serve burst over the trained weights — every request
+    rides admit → batch → execute → reply events with its request id."""
+    net.hybridize()
+    net(nd.array(onp.zeros((2, IN), "float32")))
+    table = serve.BucketTable({"batch": (1, max_batch)})
+    model = serve.CompiledModel(net, table, [{0: "batch"}],
+                                output_axes=[{0: "batch"}])
+    model.warmup()
+    batcher = serve.DynamicBatcher(model, max_delay_ms=2.0,
+                                   max_batch=max_batch).start()
+    rng = onp.random.RandomState(1)
+    futures = [batcher.submit(rng.randn(IN).astype("float32"))
+               for _ in range(requests)]
+    for f in futures:
+        f.result(timeout=60)
+    snap = batcher.metrics.snapshot(model)
+    batcher.stop()
+    return snap
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--requests", type=int, default=40)
+    ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--ckpt-dir", default=None)
+    ap.add_argument("--jsonl", default=None,
+                    help="event-stream path (default: a temp file)")
+    ap.add_argument("--prom", default=None,
+                    help="also write the Prometheus scrape here")
+    ap.add_argument("--trace", default=None,
+                    help="also write the merged chrome://tracing JSON")
+    args = ap.parse_args(argv)
+
+    workdir = tempfile.mkdtemp(prefix="mx-telemetry-")
+    jsonl = args.jsonl or os.path.join(workdir, "events.jsonl")
+    sink = telemetry.install_jsonl(jsonl)
+
+    net = build_net()
+    trainer = train(net, args.steps, args.batch,
+                    args.ckpt_dir or os.path.join(workdir, "ckpts"))
+    serve_snap = serve_burst(net, args.requests, args.max_batch)
+
+    prom = telemetry.prometheus_text()
+    if args.prom:
+        with open(args.prom, "w") as f:
+            f.write(prom)
+    if args.trace:
+        with open(args.trace, "w") as f:
+            f.write(telemetry.chrome_trace())
+
+    snapshot = telemetry.snapshot()
+    ledger = snapshot["compiles"]
+    print(json.dumps({
+        "jsonl": jsonl,
+        "jsonl_lines": sink.lines,
+        "event_counts": snapshot["events"]["counts"],
+        "compile_ledger": ledger,
+        "train_last_loss": trainer.last_loss,
+        "serve": {k: serve_snap[k] for k in ("requests", "batches",
+                                             "latency")},
+    }, indent=1, sort_keys=True))
+
+    post_warmup = ledger["post_warmup"]
+    if post_warmup:
+        print(f"telemetry contract violated: {post_warmup} post-warmup "
+              "compile(s) across trainer+serve", file=sys.stderr)
+        return 1
+    assert "mxtpu_train_steps_total" in prom
+    assert "mxtpu_serve_requests_total" in prom
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -52,6 +52,7 @@ _LAZY = {
     "contrib": ".contrib",
     "runtime": ".runtime",
     "serve": ".serve",
+    "telemetry": ".telemetry",
     "test_utils": ".test_utils",
     "util": ".util",
     "callback": ".callback",

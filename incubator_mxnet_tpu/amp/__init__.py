@@ -117,6 +117,10 @@ class LossScaler:
             self.overflows += 1
             self.loss_scale = max(1.0, self.loss_scale / self._scale_factor)
             self._unskipped = 0
+            from ..telemetry import events as _tele
+            _tele.emit("amp.loss_scale", severity="warning",
+                       overflow=True, scale=self.loss_scale,
+                       overflows=self.overflows)
             if self._guard is not None:
                 # may raise NonFiniteError under policy='halt' or past
                 # max_consecutive; 'skip' is the scaler's own behavior
@@ -131,6 +135,9 @@ class LossScaler:
             if self._unskipped >= self._scale_window:
                 self.loss_scale *= self._scale_factor
                 self._unskipped = 0
+                from ..telemetry import events as _tele
+                _tele.emit("amp.loss_scale", overflow=False,
+                           scale=self.loss_scale)
 
 
 _SCALER = None

@@ -16,6 +16,7 @@ tracer lint + recompile   jit hygiene (AST + runtime), MX2xx
 ``sharding``              PartitionSpec vs mesh, MX3xx
 fault lint                checkpoint hygiene (AST), MX4xx
 serve lint                serving/jit-cache hygiene (AST), MX5xx
+telemetry lint            observability hygiene (AST), MX6xx
 ========================  ===========================================
 
 Programmatic entry point::
@@ -43,6 +44,7 @@ from .graph_verifier import tensor_arity  # noqa: F401
 from .sharding_check import check_sharding  # noqa: F401
 from . import fault_lint  # noqa: F401
 from . import serve_lint  # noqa: F401
+from . import telemetry_lint  # noqa: F401
 from . import tracer_lint  # noqa: F401
 from .recompile import (  # noqa: F401
     RECOMPILE_WARN_THRESHOLD, RecompileWarning, cache_report, note_compile,
@@ -51,11 +53,12 @@ from .recompile import (  # noqa: F401
 
 def lint_source(src, filename: str = "<string>") -> Report:
     """Source lint = tracer hygiene (MX2xx) + fault hygiene (MX4xx) +
-    serving hygiene (MX5xx), one merged Report (the ``mxlint``
-    Python-target entry point)."""
+    serving hygiene (MX5xx) + observability hygiene (MX6xx), one merged
+    Report (the ``mxlint`` Python-target entry point)."""
     report = tracer_lint.lint_source(src, filename)
     report.extend(fault_lint.lint_source(src, filename))
     report.extend(serve_lint.lint_source(src, filename))
+    report.extend(telemetry_lint.lint_source(src, filename))
     return report
 
 

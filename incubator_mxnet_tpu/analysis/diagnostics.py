@@ -65,6 +65,9 @@ CODES = {
              "(jit/hybridize/CompiledModel per iteration)",
     "MX502": "serving entry point jits on raw (unbucketed) request shapes "
              "— every novel shape is a fresh XLA compile",
+    "MX601": "training loop / serving entry point builds ad-hoc timing or "
+             "counters instead of mx.telemetry (invisible to the unified "
+             "event bus, metrics scrape, and snapshot)",
 }
 
 

@@ -46,6 +46,14 @@ def note_compile(block, signature) -> None:
     seen.add(signature)
     block.__dict__.setdefault("_compile_log", []).append(signature)
     n = len(seen)
+    # process-wide recompile ledger (mx.telemetry.compile_log): the
+    # hybridize cache reports next to CompiledModel and ShardedTrainer,
+    # so one table answers "what compiled, when, and was it expected" —
+    # mark_warmed("gluon.hybridize") after a warmup loop makes later
+    # signatures count as unexpected
+    from ..telemetry import compile_log as _compile_log
+    _compile_log.note("gluon.hybridize",
+                      (type(block).__name__, signature))
     if n == RECOMPILE_WARN_THRESHOLD and \
             not block.__dict__.get("_recompile_warned"):
         block._recompile_warned = True

@@ -1,0 +1,138 @@
+"""Observability-hygiene linter (the MX6xx family).
+
+Companion to :mod:`.fault_lint` (protects the run from the machine) and
+:mod:`.serve_lint` (protects the request path from the jit cache): this
+pass protects the *operator* from flying blind. Hand-rolled
+``time.time()`` deltas and ad-hoc counters inside a training loop or a
+serving entry point are observability that exists in exactly one
+``print`` statement — invisible to the unified event bus, the Prometheus
+scrape, and ``telemetry.snapshot()``. One pure-AST check, warning
+severity (hygiene, not correctness; ``mxlint --strict`` gates):
+
+- **MX601** — a wall-clock sampling call (``time.time()`` /
+  ``time.perf_counter()`` / ``time.monotonic()``) inside a training loop
+  (a ``for``/``while`` whose body calls ``.step(...)``) or inside a
+  serving entry point (a function named ``predict``/``serve``/``infer``/
+  ``handle``/``handle_request``), in a file that shows NO telemetry
+  evidence at all. Route the measurement through ``mx.telemetry``
+  (``emit`` / ``Histogram`` / ``step_scope``) or ``mx.profiler`` spans
+  instead — then it lands in every sink for free.
+
+Heuristics are tuned for zero noise elsewhere: any use of ``telemetry``,
+``profiler`` scopes, ``emit``, a metrics instrument, or ``ServeMetrics``
+anywhere in the file counts as evidence and silences the pass — code
+already on the spine (including the serve/bench internals that IMPLEMENT
+the spine) lints clean.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from .diagnostics import Diagnostic, Report, walk_lint
+
+__all__ = ["lint_source", "lint_file", "lint_paths"]
+
+#: function/method names treated as request-serving entry points (shared
+#: vocabulary with serve_lint MX502)
+_ENTRY_NAMES = {"predict", "serve", "infer", "inference", "handle",
+                "handle_request"}
+
+#: wall-clock sampling callables (attribute leaf or bare name)
+_CLOCK_NAMES = {"time", "perf_counter", "monotonic", "process_time"}
+
+#: any of these identifiers anywhere in the file = the code already
+#: publishes into the telemetry spine — MX601 stays quiet
+_TELEMETRY_EVIDENCE = {"telemetry", "emit", "step_scope", "request_scope",
+                       "Histogram", "Counter", "Gauge", "profiler",
+                       "Scope", "Task", "Marker", "ServeMetrics",
+                       "record_request", "record_batch", "snapshot"}
+
+
+def _is_clock_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        # time.time() / time.perf_counter(): receiver must be `time`-ish
+        # so .time() methods on arbitrary objects don't fire
+        recv = f.value
+        return f.attr in _CLOCK_NAMES and isinstance(recv, ast.Name) \
+            and recv.id == "time"
+    if isinstance(f, ast.Name):
+        return f.id in {"perf_counter", "monotonic"}
+    return False
+
+
+def _has_telemetry_evidence(tree: ast.Module) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name) and node.id in _TELEMETRY_EVIDENCE:
+            return True
+        if isinstance(node, ast.Attribute) \
+                and node.attr in _TELEMETRY_EVIDENCE:
+            return True
+    return False
+
+
+def _step_loops(tree: ast.Module) -> List[ast.AST]:
+    loops = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.For, ast.While, ast.AsyncFor)):
+            continue
+        for inner in ast.walk(node):
+            if isinstance(inner, ast.Call) \
+                    and isinstance(inner.func, ast.Attribute) \
+                    and inner.func.attr == "step":
+                loops.append(node)
+                break
+    return loops
+
+
+def _entry_functions(tree: ast.Module) -> List[ast.AST]:
+    return [n for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and n.name in _ENTRY_NAMES]
+
+
+def lint_source(src: str, filename: str = "<string>") -> Report:
+    """Lint one Python source blob for MX6xx findings."""
+    report = Report()
+    try:
+        tree = ast.parse(src, filename=filename)
+    except SyntaxError:
+        return report  # tracer_lint owns the MX200 parse diagnostic
+    if _has_telemetry_evidence(tree):
+        return report
+    seen_clocks: Set[int] = set()  # one finding per scope; a clock call
+    for where, scopes in (("training loop", _step_loops(tree)),  # inside
+                          ("serving entry point",  # nested scopes reports
+                           _entry_functions(tree))):  # at the outermost
+        for scope in scopes:
+            clocks = [n for n in ast.walk(scope)
+                      if _is_clock_call(n) and id(n) not in seen_clocks]
+            if not clocks:
+                continue
+            seen_clocks.update(id(n) for n in clocks)
+            name = getattr(scope, "name", None)
+            report.add(Diagnostic(
+                "MX601",
+                f"ad-hoc wall-clock timing inside a {where} "
+                f"({len(clocks)} clock call(s)) — this measurement is "
+                "invisible to the event bus, the Prometheus scrape, and "
+                "telemetry.snapshot(); emit it through mx.telemetry "
+                "(emit()/Histogram/step_scope) or an mx.profiler span "
+                "instead",
+                node=f"{filename}:{getattr(clocks[0], 'lineno', 0)}",
+                op=name or where, pass_name="telemetry_lint",
+                severity="warning"))
+    return report
+
+
+def lint_file(path: str) -> Report:
+    with open(path) as f:
+        return lint_source(f.read(), filename=path)
+
+
+def lint_paths(paths) -> Report:
+    """Lint files and directories (recursing into ``*.py``)."""
+    return walk_lint(paths, lint_file)

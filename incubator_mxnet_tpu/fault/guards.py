@@ -116,6 +116,15 @@ class StepGuard:
                 "consecutive": self._consecutive, "detail": detail}
         if self.on_trip is not None:
             self.on_trip(self, info)
+        # guard verdicts are telemetry: the escalation trail (warn →
+        # rollback → halt) must be reconstructable after the run
+        from ..telemetry import events as _tele
+        from ..telemetry import metrics as _tmetrics
+        _tele.emit("guard", severity="warning", step=step, reason=reason,
+                   policy=self.policy, consecutive=self._consecutive,
+                   detail=detail)
+        _tmetrics.counter("mxtpu_guard_tripped_total",
+                          "Guard-tripped steps", policy=self.policy).inc()
         msg = (f"[fault.guard] step {step}: {reason} "
                f"(policy={self.policy}, consecutive={self._consecutive})"
                + (f" {detail}" if detail else ""))

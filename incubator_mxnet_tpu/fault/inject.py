@@ -96,11 +96,21 @@ class ChaosMonkey:
         return rs
 
     def should(self, site: str) -> bool:
-        """Draw this site's next fault decision (thread-safe)."""
+        """Draw this site's next fault decision (thread-safe). Fired
+        draws publish a ``chaos`` telemetry event carrying the current
+        step/request correlation id, so an injected fault and its
+        downstream symptoms line up on one timeline."""
         p = self.probs.get(site, 0.0)
         with self._lock:
             fired = bool(p > 0.0 and self._stream(site).uniform() < p)
             self.log.append((site, fired))
+        if fired:
+            from ..telemetry import events as _tele
+            from ..telemetry import metrics as _tmetrics
+            _tele.emit("chaos", severity="warning", site=site,
+                       seed=self.seed)
+            _tmetrics.counter("mxtpu_chaos_injected_total",
+                              "Chaos faults fired", site=site).inc()
         return fired
 
     def maybe_delay(self, site: str) -> float:
@@ -117,6 +127,12 @@ class ChaosMonkey:
             if left <= 0:
                 return
             self._armed[site] = left - 1
+        from ..telemetry import events as _tele
+        from ..telemetry import metrics as _tmetrics
+        _tele.emit("chaos", severity="error", site=site, crash=True,
+                   seed=self.seed)
+        _tmetrics.counter("mxtpu_chaos_injected_total",
+                          "Chaos faults fired", site=site).inc()
         raise ChaosCrash(site)
 
     def poison(self, arr):

@@ -90,6 +90,17 @@ class Watchdog:
         with self._lock:
             self.flags.append(flag)
             del self.flags[:-100]
+        # the hang dump goes through the telemetry bus too, so a stalled
+        # job's diagnosis is in telemetry.snapshot() / the JSONL stream,
+        # not only in a warning nobody captured
+        from ..telemetry import events as _tele
+        from ..telemetry import metrics as _tmetrics
+        _tele.emit("watchdog", severity="warning", step=step,
+                   deadline_s=self.deadline,
+                   elapsed_s=round(flag.elapsed, 3),
+                   compiles=compiles, recent_signatures=recent)
+        _tmetrics.counter("mxtpu_watchdog_flags_total",
+                          "Step-deadline violations").inc()
         warnings.warn(f"[fault.watchdog] {flag}")
         if self.on_flag is not None:
             self.on_flag(flag)

@@ -68,6 +68,8 @@ from ..base import MXNetError
 from ..fault import inject as _inject
 from ..fault.retry import RetryExhausted, RetryPolicy
 from ..ndarray import NDArray
+from ..telemetry import events as _tele
+from ..telemetry import metrics as _tmetrics
 from . import GradientCompressionMixin, KVStoreBase
 
 __all__ = ["AsyncPSServer", "AsyncKVStore"]
@@ -320,6 +322,20 @@ class _Client:
         self._retry = retry or RetryPolicy.from_env()
         self._sock: Optional[socket.socket] = None
         self._ver = itertools.count(1)
+        # registry handles resolved ONCE — per-op resolution would take
+        # the registry lock on every push/pull of every tensor
+        self._m = {
+            "push": _tmetrics.counter("mxtpu_kvstore_push_total",
+                                      "kvstore push calls completed"),
+            "pull": _tmetrics.counter("mxtpu_kvstore_pull_total",
+                                      "kvstore pull calls completed"),
+            "retry": _tmetrics.counter(
+                "mxtpu_kvstore_retries_total",
+                "kvstore reconnect/resend attempts"),
+            "reconnect": _tmetrics.counter(
+                "mxtpu_kvstore_reconnects_total",
+                "kvstore client reconnections"),
+        }
         deadline = time.time() + timeout
         last = None
         while True:
@@ -362,8 +378,16 @@ class _Client:
                 return _recv_msg(self._sock)
 
             def on_retry(n, exc):
+                # reconnect + resend is the fault path worth a timeline
+                # entry: a flapping PS shows up as a retry/reconnect
+                # stream correlated with the training step
+                _tele.emit("kvstore", severity="warning", op="retry",
+                           target_op=op, key=key, attempt=n,
+                           error=f"{type(exc).__name__}: {exc}")
+                self._m["retry"].inc()
                 self.close()   # force a fresh connection before resending
                 self._connect()
+                self._m["reconnect"].inc()
 
             try:
                 resp = attempt()
@@ -377,11 +401,16 @@ class _Client:
                                  f"{self._host}:{self._port}")
                 except RetryExhausted as e:
                     self.close()
+                    _tele.emit("kvstore", severity="error", op=op,
+                               key=key, error=str(e.last))
                     raise MXNetError(str(e)) from e.last
         if resp[0] != "ok":
             raise MXNetError(
                 f"async PS {op!r} (key {key!r}) failed: "
                 + (resp[1] if len(resp) > 1 else "unknown server error"))
+        if op in ("push", "pull"):
+            _tele.emit("kvstore", op=op, key=key)
+            self._m[op].inc()
         return resp[1] if len(resp) > 1 else None
 
     def close(self) -> None:

@@ -285,13 +285,13 @@ class Percentile(EvalMetric):
 
     ``update(None, values)`` accumulates samples (NDArray / numpy / floats);
     ``get`` returns ``([name_p50, name_p95, ...], [values...])`` using
-    nearest-rank percentiles over a bounded uniform reservoir (algorithm R:
-    past capacity each new sample replaces a random slot with probability
-    ``reservoir/seen``, so the summary keeps tracking the FULL stream —
-    a late latency regression moves the p99 instead of being dropped).
+    nearest-rank percentiles over a bounded uniform reservoir.
     Deterministically seeded; mean/count are exact regardless of the cap.
-    The serving runtime (``mx.serve.metrics``) reports request latency
-    through this metric.
+
+    The reservoir/percentile math lives in ONE place —
+    :class:`incubator_mxnet_tpu.telemetry.metrics.Histogram` — which this
+    metric and the serving runtime (``mx.serve.metrics``) both delegate
+    to, so training and serving latency summaries cannot drift apart.
     """
 
     def __init__(self, q=(50, 95, 99), name="latency", reservoir=8192, **kw):
@@ -301,9 +301,9 @@ class Percentile(EvalMetric):
 
     def reset(self):
         super().reset()
-        self._samples: List[float] = []
-        self._seen = 0
-        self._rng = onp.random.RandomState(0)
+        from .telemetry.metrics import Histogram
+        self._hist = Histogram(name=self.name, q=self.q,
+                               reservoir=self.reservoir, seed=0)
 
     def update(self, labels, preds):
         for pred in _as_list(preds):
@@ -311,17 +311,10 @@ class Percentile(EvalMetric):
             self.sum_metric += float(vals.sum())
             self.num_inst += vals.size
             for v in vals:
-                self._seen += 1
-                if len(self._samples) < self.reservoir:
-                    self._samples.append(float(v))
-                else:
-                    j = int(self._rng.randint(0, self._seen))
-                    if j < self.reservoir:
-                        self._samples[j] = float(v)
+                self._hist.observe(float(v))
 
     def percentile(self, q: float) -> float:
-        from .util import nearest_rank_percentile
-        return nearest_rank_percentile(sorted(self._samples), q)
+        return self._hist.percentile(q)
 
     def get(self):
         names = [f"{self.name}_p{q:g}" for q in self.q] + [f"{self.name}_mean"]

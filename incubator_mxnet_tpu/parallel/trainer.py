@@ -22,6 +22,7 @@ Usage::
 from __future__ import annotations
 
 import os
+import time
 from contextlib import nullcontext as _nullcontext
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -98,6 +99,22 @@ class ShardedTrainer:
         self._watchdog = watchdog
         self._snapshot = None        # (t, param copies, opt-state copies)
         self.last_grad_norm: Optional[float] = None
+        self.last_loss: Optional[float] = None
+        #: batch (shape, dtype) signatures the compiled step has seen —
+        #: a NEW signature after the first is a silent re-trace inside
+        #: one jit entry, recorded in the telemetry compile ledger
+        self._step_sigs: set = set()
+        # registry handles resolved once, not per step (registry lock)
+        from ..telemetry import metrics as _tmetrics
+        self._m_steps = _tmetrics.counter("mxtpu_train_steps_total",
+                                          "Training steps attempted")
+        self._m_step_ms = _tmetrics.histogram(
+            "mxtpu_train_step_ms", "Training step wall time (ms)")
+        self._m_gnorm = _tmetrics.gauge(
+            "mxtpu_train_grad_norm",
+            "Global gradient norm (guarded steps)")
+        self._m_rollbacks = _tmetrics.counter(
+            "mxtpu_train_rollbacks_total", "Guarded steps rolled back")
         # Work in the mesh's device context: wrapping step outputs/batches in
         # the *default* (cpu) Context would force sync device→host round
         # trips every step (critical over a tunneled TPU).
@@ -292,8 +309,14 @@ class ShardedTrainer:
         if n_data < 1:
             raise MXNetError("step() needs at least one data argument")
         from ..fault import inject as _inject
+        from ..telemetry import compile_log as _clog
+        from ..telemetry import events as _tele
+        t_step0 = time.perf_counter()
         if _inject.active() is not None:
-            batch = self._chaos_batch(batch, n_data)
+            # the poisoned batch belongs to the step about to run — bind
+            # its id so the chaos event and the guard verdict correlate
+            with _tele.step_scope(self._t + 1):
+                batch = self._chaos_batch(batch, n_data)
         if self._params is None:
             # Eager warmup runs wherever the parameters were initialized
             # (current context), NOT on the mesh.
@@ -301,12 +324,15 @@ class ShardedTrainer:
             warm = [a if isinstance(a, NDArray) else NDArray(a, ctx=warm_ctx)
                     for a in batch[:n_data]]
             self._init_state(warm, warm_ctx)
+        t_place0 = time.perf_counter()
         vals = self.place(*batch)
+        place_ms = (time.perf_counter() - t_place0) * 1e3
         if self._step_fn is None:
             self._step_fn = self._build_step(n_data)
         if self._guard is not None:
             self._maybe_snapshot()
         self._t += 1
+        attempted = self._t          # event id even if a rollback resets _t
         if self._lr_dev is None or self._lr_val != self._optimizer.learning_rate:
             self._lr_val = self._optimizer.learning_rate
             self._lr_dev = jnp.asarray(self._lr_val, jnp.float32)
@@ -314,21 +340,48 @@ class ShardedTrainer:
             self._t_dev = jnp.asarray(self._t, jnp.int32)
         if self._base_key is None:
             self._base_key = random_mod.next_key(self._ctx)
+        # a new batch (shape, dtype) signature re-traces inside the jit
+        # entry — the classic silent recompile; the ledger makes it visible
+        sig = tuple((tuple(v.shape), str(v.dtype)) for v in vals)
+        new_sig = sig not in self._step_sigs
+        first_sig = not self._step_sigs
         from .mesh import active_mesh
         wd = self._watchdog
-        with wd.watch(step=self._t, block=self._block) if wd is not None \
-                else _nullcontext():
-            _inject.maybe_delay("slow_step")
-            with active_mesh(self._mesh):
-                # bound during (first-call) tracing so mesh-aware ops lower
-                # to mesh collectives — e.g. attention → ring over sp
-                (loss, gnorm, self._param_vals, self._opt_states, effects,
-                 self._t_dev) = \
-                    self._step_fn(self._param_vals, self._opt_states,
-                                  self._base_key, self._lr_dev, self._t_dev,
-                                  *vals)
-            rolled_back = (self._guard is not None
-                           and self._apply_guard(loss, gnorm))
+        with _tele.step_scope(attempted):
+            with wd.watch(step=self._t, block=self._block) if wd is not None \
+                    else _nullcontext():
+                _inject.maybe_delay("slow_step")
+                t_disp0 = time.perf_counter()
+                with active_mesh(self._mesh):
+                    # bound during (first-call) tracing so mesh-aware ops
+                    # lower to mesh collectives — e.g. attention → ring
+                    # over sp
+                    (loss, gnorm, self._param_vals, self._opt_states,
+                     effects, self._t_dev) = \
+                        self._step_fn(self._param_vals, self._opt_states,
+                                      self._base_key, self._lr_dev,
+                                      self._t_dev, *vals)
+                dispatch_ms = (time.perf_counter() - t_disp0) * 1e3
+                if new_sig:
+                    self._step_sigs.add(sig)
+                    _clog.note("trainer.step", sig, wall_ms=dispatch_ms,
+                               warmup=first_sig)
+                rolled_back = (self._guard is not None
+                               and self._apply_guard(loss, gnorm))
+            wall_ms = (time.perf_counter() - t_step0) * 1e3
+            fields = {"wall_ms": round(wall_ms, 3),
+                      "place_ms": round(place_ms, 3),
+                      "dispatch_ms": round(dispatch_ms, 3)}
+            if self._guard is not None:
+                # guard runs synced loss/grad-norm to host — free to report
+                fields.update(loss=self.last_loss,
+                              grad_norm=self.last_grad_norm,
+                              rolled_back=rolled_back)
+            _tele.emit("train.step", step=attempted, **fields)
+        self._m_steps.inc()
+        self._m_step_ms.observe(wall_ms)
+        if self._guard is not None and self.last_grad_norm is not None:
+            self._m_gnorm.set(self.last_grad_norm)
         self._optimizer.num_update = self._t
         if not rolled_back:
             # aux effects (batchnorm running stats etc.) of a rolled-back
@@ -381,6 +434,7 @@ class ShardedTrainer:
         lf = float(jax.device_get(loss))
         gn = float(jax.device_get(gnorm))
         self.last_grad_norm = gn
+        self.last_loss = lf
         g = self._guard
         reason = g.is_bad(bool(onp.isfinite(lf) and onp.isfinite(gn)), gn)
         if reason is None:
@@ -389,6 +443,7 @@ class ShardedTrainer:
         action = g.decide(self._t, reason,
                           detail=f"loss={lf:g}, grad_norm={gn:g}")
         if action == "rollback":
+            self._m_rollbacks.inc()
             snap_t, pvals, states = self._snapshot
             # restore COPIES — the snapshot must survive further rollbacks
             # until the next good-step refresh

@@ -27,7 +27,7 @@ import jax
 
 __all__ = ["set_config", "set_state", "pause", "resume", "dump", "dumps",
            "Scope", "Task", "Frame", "Marker", "scope", "span_records",
-           "reset_spans"]
+           "reset_spans", "recent_spans"]
 
 _STATE = {"running": False, "dir": "profile_output", "aggregate": False,
           "started_at": None}
@@ -41,9 +41,15 @@ _SPAN_LOCK = threading.Lock()
 _SPANS: Dict[str, dict] = {}          # name -> {count, total_ms, samples[]}
 _MARKERS: List[dict] = []
 _MARKERS_DROPPED = [0]                # overflow count past the sample cap
+#: raw (name, kind, wall_start_s, dur_ms) ring for the chrome-trace merge
+#: (mx.telemetry.chrome_trace) — aggregates cannot be placed on a timeline
+from collections import deque as _deque  # noqa: E402
+
+_RECENT: "_deque" = _deque(maxlen=4096)
 
 
 def _record_span(name: str, dur_ms: float, kind: str) -> None:
+    t_end = time.time()
     with _SPAN_LOCK:
         ent = _SPANS.get(name)
         if ent is None:
@@ -56,6 +62,16 @@ def _record_span(name: str, dur_ms: float, kind: str) -> None:
         ent["max_ms"] = max(ent["max_ms"], dur_ms)
         if len(ent["samples"]) < _MAX_SAMPLES_PER_NAME:
             ent["samples"].append(dur_ms)
+        _RECENT.append((name, kind, t_end - dur_ms / 1e3, dur_ms))
+
+
+def recent_spans() -> List[tuple]:
+    """Newest-last raw spans ``(name, kind, wall_start_s, dur_ms)`` — the
+    timeline form the telemetry chrome-trace export merges with bus
+    events (bounded ring; aggregates in :func:`span_records` keep the
+    full counts)."""
+    with _SPAN_LOCK:
+        return list(_RECENT)
 
 
 def reset_spans() -> None:
@@ -64,6 +80,7 @@ def reset_spans() -> None:
     with _SPAN_LOCK:
         _SPANS.clear()
         _MARKERS.clear()
+        _RECENT.clear()
         _MARKERS_DROPPED[0] = 0
 
 
@@ -75,15 +92,20 @@ def span_records() -> Dict[str, dict]:
     with _SPAN_LOCK:
         for name, ent in _SPANS.items():
             samples = sorted(ent["samples"])
+            # a name with zero completed spans (markers-only usage, or a
+            # started-but-never-stopped Task) would serialize min_ms=inf
+            # as the invalid JSON token Infinity — normalize to 0.0 here
+            # so every consumer sees strict-JSON-safe numbers
+            min_ms = ent["min_ms"] if ent["min_ms"] != float("inf") else 0.0
             row = {"kind": ent["kind"], "count": ent["count"],
                    "total_ms": round(ent["total_ms"], 4),
                    "mean_ms": round(ent["total_ms"] / max(ent["count"], 1), 4),
-                   "min_ms": round(ent["min_ms"], 4),
+                   "min_ms": round(min_ms, 4),
                    "max_ms": round(ent["max_ms"], 4)}
             from .util import nearest_rank_percentile
             for q in (50, 95, 99):
-                row[f"p{q}_ms"] = round(nearest_rank_percentile(samples, q),
-                                        4)
+                p = nearest_rank_percentile(samples, q)
+                row[f"p{q}_ms"] = round(p, 4) if p == p else 0.0
             out[name] = row
     return out
 
@@ -145,7 +167,12 @@ def dumps(reset: bool = False) -> str:
            "markers_dropped": dropped}
     if reset:
         reset_spans()
-    return json.dumps(doc, indent=1, sort_keys=True)
+    # strict JSON: any residual non-finite value (a pathological dur, a
+    # future aggregate) becomes null instead of the Infinity/NaN tokens
+    # json would otherwise emit (allow_nan=False enforces it)
+    from .telemetry.export import sanitize
+    return json.dumps(sanitize(doc), indent=1, sort_keys=True,
+                      allow_nan=False)
 
 
 class Scope:

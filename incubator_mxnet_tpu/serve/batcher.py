@@ -28,6 +28,7 @@ Env knobs (read at construction): ``MXTPU_SERVE_DEADLINE_MS`` (default
 """
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from collections import deque
@@ -37,6 +38,7 @@ import numpy as onp
 
 from ..base import MXNetError
 from .. import profiler
+from ..telemetry import events as _tele
 from .compiled import CompiledModel, _as_numpy
 from .metrics import ServeMetrics
 
@@ -108,13 +110,19 @@ class ServeFuture:
         return self._result
 
 
+#: process-wide serving-request correlation ids (telemetry events carry
+#: them from admit through reply)
+_REQUEST_IDS = itertools.count(1)
+
+
 class _Request:
-    __slots__ = ("arrays", "future", "t_enqueue")
+    __slots__ = ("arrays", "future", "t_enqueue", "rid")
 
     def __init__(self, arrays):
         self.arrays = arrays
         self.future = ServeFuture()
         self.t_enqueue = time.perf_counter()
+        self.rid = f"r{next(_REQUEST_IDS)}"
 
 
 class DynamicBatcher:
@@ -249,11 +257,16 @@ class DynamicBatcher:
                     break
             if time.time() >= deadline:
                 self.metrics.record_rejection()
+                _tele.emit("serve.reject", severity="warning",
+                           request_id=req.rid, model=self.metrics.model,
+                           queue_limit=self.queue_limit)
                 raise QueueFullError(
                     f"serve queue is full ({self.queue_limit} requests); "
                     "backpressure — retry with backoff or raise "
                     "MXTPU_SERVE_QUEUE_LIMIT")
             time.sleep(0.0005)
+        _tele.emit("serve.admit", request_id=req.rid,
+                   model=self.metrics.model, depth=self.depth())
         self._wake.set()
         return req.future
 
@@ -293,6 +306,9 @@ class DynamicBatcher:
 
     def _flush(self, batch: List[_Request]) -> None:
         t0 = time.perf_counter()
+        rids = [req.rid for req in batch]
+        _tele.emit("serve.batch", model=self.metrics.model,
+                   size=len(batch), request_ids=rids)
         try:
             # thunk inside the try: a failed registry resolve (e.g. the
             # model was unloaded) must fail THESE futures, not kill the
@@ -309,13 +325,25 @@ class DynamicBatcher:
                     req.future.set_exception(e)
             # failed batches must NOT count as served traffic
             self.metrics.record_failed_batch(len(batch))
+            _tele.emit("serve.execute", severity="error",
+                       model=self.metrics.model, size=len(batch),
+                       request_ids=rids,
+                       error=f"{type(e).__name__}: {e}")
             return
         dt_ms = (time.perf_counter() - t0) * 1e3
         bucket = model._table.bucket(self._batch_axis_name, len(batch))
         self.metrics.record_batch(len(batch), bucket, dt_ms)
+        _tele.emit("serve.execute", model=self.metrics.model,
+                   size=len(batch), bucket=bucket,
+                   wall_ms=round(dt_ms, 3),
+                   occupancy=round(len(batch) / bucket, 4) if bucket
+                   else None)
         for req in batch:
-            self.metrics.record_request(
-                (time.perf_counter() - req.t_enqueue) * 1e3)
+            lat_ms = (time.perf_counter() - req.t_enqueue) * 1e3
+            self.metrics.record_request(lat_ms)
+            _tele.emit("serve.reply", request_id=req.rid,
+                       model=self.metrics.model,
+                       latency_ms=round(lat_ms, 3))
 
     def _scatter(self, batch: List[_Request], outs, model: CompiledModel
                  ) -> None:

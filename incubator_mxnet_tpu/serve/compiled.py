@@ -197,6 +197,7 @@ class CompiledModel:
 
     # -- compilation ----------------------------------------------------
     def _compile(self, key: tuple, sig) -> Callable:
+        t0 = time.perf_counter()
         avals = [jax.ShapeDtypeStruct(self._key_data.shape,
                                       self._key_data.dtype)]
         avals += [jax.ShapeDtypeStruct(s, jnp.dtype(d)) for s, d in sig]
@@ -218,6 +219,12 @@ class CompiledModel:
             self.stats["post_warmup_compiles"] += 1
         else:
             self.stats["warmup_compiles"] += 1
+        # process-wide recompile ledger: a post-warmup entry here is the
+        # "unbucketed shape reached the model" bug, assertable anywhere
+        from ..telemetry import compile_log
+        compile_log.note("serve.compiled", sig,
+                         wall_ms=(time.perf_counter() - t0) * 1e3,
+                         warmup=not self._warmed)
         return self._exe[key]
 
     def warmup(self, verbose: bool = False) -> Dict[str, Any]:

@@ -7,13 +7,21 @@ instance aggregates all four families, thread-safe, and renders them as a
 JSON-ready dict (``snapshot()``) the bench harness dumps next to its
 throughput numbers:
 
-- **latency**: p50/p95/p99/mean over a bounded reservoir, via
-  :class:`metric.Percentile` (the same EvalMetric zoo training uses);
+- **latency**: p50/p95/p99/mean over a bounded reservoir, via the shared
+  :class:`~incubator_mxnet_tpu.telemetry.metrics.Histogram` (ONE
+  reservoir implementation — ``metric.Percentile`` delegates to the same
+  class, so training and serving summaries cannot drift);
 - **queue**: live + high-water depth, rejected (backpressure) count;
 - **batching**: batches flushed, mean/last occupancy (real rows ÷ bucket
   rows — padding waste), batch compute latency;
 - **compile**: the wrapped :class:`CompiledModel` counters — post-warmup
   compiles MUST stay 0 in steady state.
+
+Every recording ALSO feeds the process-wide ``mx.telemetry`` registry
+(``mxtpu_serve_*`` series labeled by model), so the Prometheus scrape the
+serve Server answers carries serving traffic without extra bookkeeping.
+The instance-local histograms/ints remain the *window* view ``reset()``
+clears; the registry series stay monotonic (Prometheus semantics).
 
 Per-stage wall-time (pad / compute / unpad / batch) rides separately on
 ``mx.profiler`` spans (``profiler.dumps()``), keeping this module free of
@@ -23,9 +31,10 @@ from __future__ import annotations
 
 import json
 import threading
-from typing import Dict, Optional
+from typing import Dict
 
-from ..metric import Percentile
+from ..telemetry import metrics as tmetrics
+from ..telemetry.metrics import Histogram
 
 __all__ = ["ServeMetrics"]
 
@@ -45,12 +54,35 @@ def _j(v, ndigits: int = 3):
 class ServeMetrics:
     """Thread-safe aggregate serving counters for one model/batcher."""
 
-    def __init__(self, reservoir: int = 8192):
+    def __init__(self, reservoir: int = 8192, model: str = "default"):
         self._lock = threading.Lock()
-        self._latency = Percentile(q=(50, 95, 99), name="latency_ms",
+        self.model = model
+        self._latency = Histogram(name="latency_ms", q=(50, 95, 99),
+                                  reservoir=reservoir)
+        self._batch_ms = Histogram(name="batch_ms", q=(50, 95, 99),
                                    reservoir=reservoir)
-        self._batch_ms = Percentile(q=(50, 95, 99), name="batch_ms",
-                                    reservoir=reservoir)
+        # process-wide registry series (shared across instances with the
+        # same model label; monotonic — never reset by this instance)
+        self._g = {
+            "requests": tmetrics.counter(
+                "mxtpu_serve_requests_total",
+                "Requests served (completed batches)", model=model),
+            "rejected": tmetrics.counter(
+                "mxtpu_serve_rejected_total",
+                "Requests rejected by queue backpressure", model=model),
+            "failed": tmetrics.counter(
+                "mxtpu_serve_failed_total",
+                "Requests failed inside an erroring batch", model=model),
+            "batches": tmetrics.counter(
+                "mxtpu_serve_batches_total", "Batches flushed",
+                model=model),
+            "depth": tmetrics.gauge(
+                "mxtpu_serve_queue_depth", "Live request-queue depth",
+                model=model),
+            "latency": tmetrics.histogram(
+                "mxtpu_serve_latency_ms",
+                "End-to-end request latency (ms)", model=model),
+        }
         self.requests = 0
         self.rejected = 0
         self.failed = 0
@@ -66,11 +98,14 @@ class ServeMetrics:
     def record_request(self, latency_ms: float) -> None:
         with self._lock:
             self.requests += 1
-            self._latency.update(None, [latency_ms])
+            self._latency.observe(latency_ms)
+        self._g["requests"].inc()
+        self._g["latency"].observe(latency_ms)
 
     def record_rejection(self) -> None:
         with self._lock:
             self.rejected += 1
+        self._g["rejected"].inc()
 
     def record_failed_batch(self, size: int) -> None:
         """A flush that errored: its requests got exceptions, not results
@@ -78,11 +113,13 @@ class ServeMetrics:
         with self._lock:
             self.failed += size
             self.failed_batches += 1
+        self._g["failed"].inc(size)
 
     def record_depth(self, depth: int) -> None:
         with self._lock:
             self.depth = depth
             self.max_depth = max(self.max_depth, depth)
+        self._g["depth"].set(depth)
 
     def record_batch(self, size: int, bucket: int, dt_ms: float) -> None:
         with self._lock:
@@ -90,15 +127,21 @@ class ServeMetrics:
             self.rows += size
             self.bucket_rows += bucket
             self.last_occupancy = size / bucket if bucket else float("nan")
-            self._batch_ms.update(None, [dt_ms])
+            self._batch_ms.observe(dt_ms)
+        self._g["batches"].inc()
 
     # -- reporting ------------------------------------------------------
+    @staticmethod
+    def _pcts(hist: Histogram) -> Dict:
+        s = hist.summary()
+        out = {f"{hist.name}_p{q:g}": _j(s[f"p{q:g}"]) for q in hist.q}
+        out[f"{hist.name}_mean"] = _j(s["mean"])
+        return out
+
     def snapshot(self, model=None) -> Dict:
         """JSON-ready dict of everything recorded; pass the served
         :class:`CompiledModel` to inline its compile-cache counters."""
         with self._lock:
-            lat_names, lat_vals = self._latency.get()
-            bat_names, bat_vals = self._batch_ms.get()
             snap = {
                 "requests": self.requests,
                 "rejected": self.rejected,
@@ -109,9 +152,8 @@ class ServeMetrics:
                 "batches": self.batches,
                 "batch_occupancy": _j(self.rows / self.bucket_rows, 4)
                 if self.bucket_rows else None,
-                "latency": {n: _j(v) for n, v in zip(lat_names, lat_vals)},
-                "batch_latency": {n: _j(v)
-                                  for n, v in zip(bat_names, bat_vals)},
+                "latency": self._pcts(self._latency),
+                "batch_latency": self._pcts(self._batch_ms),
             }
         if model is not None:
             snap["compile_cache"] = model.cache_info()
@@ -121,6 +163,7 @@ class ServeMetrics:
         return json.dumps(self.snapshot(model), indent=1, sort_keys=True)
 
     def reset(self) -> None:
+        """Reset this instance's window (registry series stay monotonic)."""
         with self._lock:
             self._latency.reset()
             self._batch_ms.reset()

@@ -113,6 +113,7 @@ class ModelRegistry:
             raise MXNetError("pass exactly one of artifacts= (cold start "
                              "from an exported prefix) or factory= (live "
                              "Block constructor)")
+        from ..telemetry import events as _tele
         auto_version = version is None
         with self._lock:
             if auto_version:
@@ -178,6 +179,10 @@ class ModelRegistry:
         if warmup:
             compiled.warmup()
 
+        _tele.emit("serve.load", model=name, version=version,
+                   source=("artifacts" if artifacts is not None
+                           else "factory"),
+                   ckpt_root=ckpt_root, warmed=bool(warmup))
         entry = ModelVersion(name, version, compiled, source)
         with self._lock:
             versions = self._models.setdefault(name, {})

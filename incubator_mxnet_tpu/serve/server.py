@@ -15,6 +15,8 @@ Wire protocol: newline-delimited JSON over TCP, one object per request::
 
     {"cmd": "metrics", "model": "lenet"}   -> {"ok": true, "metrics": {...}}
     {"cmd": "models"}                      -> {"ok": true, "models": {...}}
+    {"cmd": "prometheus"}  -> {"ok": true, "text": "<metrics scrape>"}
+    {"cmd": "telemetry"}   -> {"ok": true, "telemetry": {...snapshot...}}
 
 Each model gets one :class:`DynamicBatcher` whose model thunk resolves
 through the registry at flush time, so a version swap redirects the very
@@ -64,7 +66,7 @@ class Server:
             if b is None:
                 self.registry.get(name)  # raise early on unknown model
                 b = DynamicBatcher(lambda: self.registry.get(name),
-                                   metrics=ServeMetrics(),
+                                   metrics=ServeMetrics(model=name),
                                    **self._batcher_kw)
                 b.start()
                 self._batchers[name] = b
@@ -78,6 +80,13 @@ class Server:
     def metrics(self, name: str) -> dict:
         b = self.batcher(name)
         return b.metrics.snapshot(self.registry.get(name))
+
+    def prometheus(self) -> str:
+        """The process-wide telemetry scrape (Prometheus text exposition
+        0.0.4): every ``mxtpu_*`` series — serving counters/latency by
+        model, training step counters, compile ledger, event totals."""
+        from .. import telemetry
+        return telemetry.prometheus_text()
 
     # -- TCP front end --------------------------------------------------
     def start(self) -> "Server":
@@ -130,6 +139,15 @@ class Server:
             return {"ok": True, "models": self.registry.models()}
         if cmd == "metrics":
             return {"ok": True, "metrics": self.metrics(msg["model"])}
+        if cmd == "prometheus":
+            # text-format scrape over the JSON-lines protocol; a real
+            # Prometheus deployment fronts this with its own HTTP shim
+            return {"ok": True,
+                    "content_type": "text/plain; version=0.0.4",
+                    "text": self.prometheus()}
+        if cmd == "telemetry":
+            from .. import telemetry
+            return {"ok": True, "telemetry": telemetry.snapshot()}
         if cmd is not None:
             raise MXNetError(f"unknown cmd {cmd!r}")
         name = msg["model"]

@@ -1,0 +1,104 @@
+"""``mx.telemetry`` — unified runtime observability.
+
+Reference counterpart: none — the reference had a C++ profiler and log
+lines. This subsystem is the single telemetry spine every runtime layer
+publishes into, designed around the jit-runtime reality that the
+dominant silent failures (recompiles, capture misses, stalls) are
+*measured, not guessed* (PyGraph arXiv:2503.19779; XLA fusion study
+arXiv:2301.13062):
+
+===================  ====================================================
+:mod:`~.events`      bounded, thread-safe structured event bus —
+                     ``emit(kind, **fields)`` with monotonic timestamps,
+                     step/request correlation ids, severity, per-kind
+                     ring buffers. Publishers: ``fault.inject``,
+                     ``fault.watchdog``, ``fault.guards``,
+                     ``kvstore.async_ps``, ``parallel.trainer``,
+                     ``serve`` (admit/batch/execute/reply), ``amp``
+:mod:`~.metrics`     typed Counter/Gauge/Histogram registry; the one
+                     reservoir-percentile implementation ``metric.
+                     Percentile`` and ``serve.ServeMetrics`` delegate to
+:mod:`~.compile_log` recompile ledger over every jit cache
+                     (``CompiledModel``, ``ShardedTrainer.step``,
+                     hybridize) — signature, wall time, call site;
+                     "zero post-warmup compiles" assertable anywhere
+:mod:`~.export`      sinks: rotating JSON-lines file, Prometheus text
+                     scrape (served by ``mx.serve.Server``),
+                     chrome://tracing merge with ``profiler`` spans
+===================  ====================================================
+
+One call answers "what is this job doing right now"::
+
+    mx.telemetry.snapshot()
+    # {"events": {...per-kind counts + recent...},
+    #  "metrics": {...counters/gauges/histograms...},
+    #  "compiles": {...ledger rollup, post_warmup count...},
+    #  "spans": {...profiler wall-time aggregates...}}
+
+Env knobs (catalogued in ``util.ENV_VARS`` / docs/env_vars.md):
+``MXTPU_TELEMETRY`` (master switch), ``MXTPU_TELEMETRY_RING`` (per-kind
+ring size), ``MXTPU_TELEMETRY_JSONL`` (event stream path),
+``MXTPU_TELEMETRY_JSONL_MAX_MB`` (rotation threshold).
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+from . import compile_log  # noqa: F401
+from . import events  # noqa: F401
+from . import export  # noqa: F401
+from . import metrics  # noqa: F401
+from .events import (  # noqa: F401
+    BUS, Event, EventBus, clear, counts, emit, enable, enabled,
+    get_events, request_scope, step_scope, subscribe, unsubscribe,
+)
+from .export import (  # noqa: F401
+    JsonlSink, chrome_trace, dumps_strict, install_from_env, install_jsonl,
+    prometheus_text, sanitize,
+)
+from .metrics import (  # noqa: F401
+    Counter, Gauge, Histogram, MetricsRegistry, REGISTRY, counter, gauge,
+    histogram,
+)
+
+__all__ = ["emit", "events", "get_events", "counts", "clear",
+           "subscribe", "unsubscribe",
+           "enable", "enabled", "step_scope", "request_scope",
+           "Event", "EventBus", "BUS",
+           "Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
+           "counter", "gauge", "histogram",
+           "compile_log", "metrics", "export",
+           "prometheus_text", "chrome_trace", "install_jsonl",
+           "install_from_env", "sanitize", "dumps_strict",
+           "JsonlSink", "snapshot", "reset"]
+
+
+def snapshot(recent: int = 5) -> Dict:
+    """One JSON-ready dict answering "what is this job doing right now":
+    per-kind event counts (+ the newest ``recent`` events per kind), the
+    full metrics table, the compile-ledger rollup, and the profiler's
+    span aggregates. Strict-JSON safe (``export.sanitize`` applied)."""
+    from .. import profiler
+    ev_counts = events.counts()
+    recent_by_kind = {k: [e.to_dict() for e in events.events(k, n=recent)]
+                      for k in sorted(ev_counts)}
+    doc = {
+        "ts": time.time(),
+        "events": {"counts": ev_counts,
+                   "dropped": BUS.dropped(),
+                   "recent": recent_by_kind},
+        "metrics": metrics.to_dict(),
+        "compiles": compile_log.summary(),
+        "spans": profiler.span_records(),
+    }
+    return sanitize(doc)
+
+
+def reset() -> None:
+    """Clear every telemetry surface (events, metrics, compile ledger,
+    installed sinks) — test isolation; production code never needs it."""
+    clear()
+    REGISTRY.clear()
+    compile_log.clear()
+    export.uninstall_all()

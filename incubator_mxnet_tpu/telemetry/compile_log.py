@@ -1,0 +1,160 @@
+"""Recompile ledger — every XLA compile in the process, one table.
+
+Reference counterpart: the reference's ``CachedOp`` captured once per
+(shape, train-mode) bucket and cache misses were visible in the engine
+profile. On a jit runtime a recompile is the *dominant silent failure
+mode* — seconds of latency, growing device memory, no exception anywhere
+(PyGraph, arXiv 2503.19779; the XLA fusion study, arXiv 2301.13062, makes
+the measure-don't-guess argument). Three jit caches already exist
+(``CompiledModel`` buckets, ``ShardedTrainer.step``, the hybridize
+``_call_cached_op`` cache) and each kept private counters; this ledger is
+where they all report, so **"zero unexpected recompiles" is assertable
+anywhere** — not just inside serve.
+
+Every :func:`note` records the triggering (shape, dtype) signature, the
+wall time the compile cost (when the call site measures it), the call
+site, and whether the site considers itself still warming up. Post-warmup
+compiles are the bug signal: ``post_warmup_compiles() == 0`` is the
+steady-state contract the serve bench, the telemetry CI smoke job, and
+``assert_zero_post_warmup()`` all enforce. Each note also publishes a
+``compile`` event on the bus (with the current step/request correlation
+ids) and bumps ``mxtpu_compiles_total{phase=...}``.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+__all__ = ["CompileRecord", "note", "mark_warmed", "is_warmed", "records",
+           "summary", "post_warmup_compiles", "assert_zero_post_warmup",
+           "clear", "MAX_RECORDS"]
+
+#: ledger ring size — a recompile storm must not grow host memory unbounded
+MAX_RECORDS = 4096
+
+
+class CompileRecord:
+    """One compile event: where, what signature, how long, which phase."""
+
+    __slots__ = ("site", "signature", "wall_ms", "warmup", "ts", "step")
+
+    def __init__(self, site: str, signature: str, wall_ms: Optional[float],
+                 warmup: bool, ts: float, step: Optional[int]):
+        self.site = site
+        self.signature = signature
+        self.wall_ms = wall_ms
+        self.warmup = warmup
+        self.ts = ts
+        self.step = step
+
+    def to_dict(self) -> Dict:
+        return {"site": self.site, "signature": self.signature,
+                "wall_ms": self.wall_ms, "warmup": self.warmup,
+                "ts": round(self.ts, 6), "step": self.step}
+
+    def __repr__(self):
+        phase = "warmup" if self.warmup else "POST-WARMUP"
+        ms = f", {self.wall_ms:.1f}ms" if self.wall_ms is not None else ""
+        return f"CompileRecord({self.site}, {phase}{ms}, {self.signature})"
+
+
+_LOCK = threading.Lock()
+_RECORDS: deque = deque(maxlen=MAX_RECORDS)
+_TOTALS = {"warmup": 0, "post_warmup": 0}
+_BY_SITE: Dict[str, Dict[str, int]] = {}
+_WARMED: set = set()
+
+
+def mark_warmed(site: str) -> None:
+    """Declare ``site`` past its warmup phase: compiles noted there
+    without an explicit ``warmup=`` flag count as post-warmup from now on
+    (``CompiledModel.warmup()`` does the equivalent internally; call this
+    after your own warmup loop for hybridize/step sites)."""
+    with _LOCK:
+        _WARMED.add(site)
+
+
+def is_warmed(site: str) -> bool:
+    with _LOCK:
+        return site in _WARMED
+
+
+def note(site: str, signature, wall_ms: Optional[float] = None,
+         warmup: Optional[bool] = None) -> CompileRecord:
+    """Record one compile at ``site``. ``signature`` is any repr-able
+    shape/dtype description; ``warmup=False`` marks it unexpected (the
+    site believed it was past its warmup phase). ``warmup=None`` derives
+    the phase from :func:`mark_warmed` state. Publishes a ``compile``
+    bus event and the ``mxtpu_compiles_total`` counter as side effects."""
+    if warmup is None:
+        warmup = not is_warmed(site)
+    rec = CompileRecord(site, repr(signature)[:300],
+                        None if wall_ms is None else round(wall_ms, 3),
+                        bool(warmup), time.time(),
+                        None)
+    from . import events as _events
+    rec.step = _events.current_step()
+    phase = "warmup" if rec.warmup else "post_warmup"
+    with _LOCK:
+        _RECORDS.append(rec)
+        _TOTALS[phase] += 1
+        ent = _BY_SITE.setdefault(site, {"warmup": 0, "post_warmup": 0})
+        ent[phase] += 1
+    from . import metrics as _metrics
+    _metrics.counter("mxtpu_compiles_total",
+                     "XLA compile events recorded by the telemetry ledger",
+                     site=site, phase=phase).inc()
+    _events.emit("compile",
+                 severity="info" if rec.warmup else "warning",
+                 site=site, signature=rec.signature, wall_ms=rec.wall_ms,
+                 warmup=rec.warmup)
+    return rec
+
+
+def records(site: Optional[str] = None) -> List[CompileRecord]:
+    with _LOCK:
+        out = list(_RECORDS)
+    return [r for r in out if site is None or r.site == site]
+
+
+def summary() -> Dict:
+    """The ledger rollup ``telemetry.snapshot()`` inlines."""
+    with _LOCK:
+        recent = [r.to_dict() for r in list(_RECORDS)[-5:]]
+        return {"total": _TOTALS["warmup"] + _TOTALS["post_warmup"],
+                "warmup": _TOTALS["warmup"],
+                "post_warmup": _TOTALS["post_warmup"],
+                "by_site": {k: dict(v) for k, v in _BY_SITE.items()},
+                "recent": recent}
+
+
+def post_warmup_compiles(site: Optional[str] = None) -> int:
+    with _LOCK:
+        if site is not None:
+            return _BY_SITE.get(site, {}).get("post_warmup", 0)
+        return _TOTALS["post_warmup"]
+
+
+def assert_zero_post_warmup(site: Optional[str] = None) -> None:
+    """Raise ``MXNetError`` if any post-warmup compile was recorded
+    (optionally at one site) — the steady-state contract, assertable from
+    anywhere. Gated on the exact counters (which never age out), with the
+    bounded record ring supplying whatever detail is still held."""
+    n = post_warmup_compiles(site)
+    if n:
+        bad = [r for r in records(site) if not r.warmup]
+        detail = ("\n".join(f"  {r!r}" for r in bad[-10:]) if bad else
+                  "  (records aged out of the ring; counters are exact)")
+        from ..base import MXNetError
+        raise MXNetError(
+            f"{n} unexpected (post-warmup) XLA compile(s):\n" + detail)
+
+
+def clear() -> None:
+    with _LOCK:
+        _RECORDS.clear()
+        _TOTALS["warmup"] = _TOTALS["post_warmup"] = 0
+        _BY_SITE.clear()
+        _WARMED.clear()

@@ -1,0 +1,305 @@
+"""Structured event bus — the spine of ``mx.telemetry``.
+
+Reference counterpart: none. The reference observed itself through the
+C++ profiler and scattered ``LOG(INFO)`` lines; every subsystem here grew
+its own island (profiler spans, serve metrics, watchdog warnings, chaos
+logs). This bus is the one place they all publish *machine-readable*
+events into, so "what is this job doing right now" is a single
+``telemetry.snapshot()`` — the PyGraph position (arXiv 2503.19779)
+generalized: on a jit runtime the interesting failures (recompiles,
+capture misses, silent stalls) leave no exception, only a timeline.
+
+Design:
+
+- ``emit(kind, **fields)`` appends one :class:`Event` carrying a global
+  monotonic sequence number, wall + monotonic timestamps, a severity, and
+  the current **correlation ids** (training step / serving request id)
+  taken from a thread-local context unless passed explicitly. Emission is
+  a lock + deque append — cheap enough for per-request call sites.
+- per-kind **ring buffers** (``MXTPU_TELEMETRY_RING`` entries each) bound
+  memory on a long-lived server; aggregate counts keep counting past the
+  ring, so drops are visible, never silent.
+- **subscribers** (the export sinks) observe every event at emit time; a
+  raising subscriber is counted and skipped, never allowed to break the
+  emitting subsystem.
+- ``MXTPU_TELEMETRY=0`` turns ``emit`` into a no-op (one dict lookup);
+  the first real emission auto-installs env-configured sinks
+  (``export.install_from_env``).
+
+Event kinds in the wired runtime: ``train.step``, ``guard``, ``watchdog``,
+``chaos``, ``kvstore``, ``serve.admit`` / ``serve.batch`` /
+``serve.execute`` / ``serve.reply`` / ``serve.reject`` / ``serve.load``,
+``compile``, ``amp.loss_scale``. Kinds are open — any string works.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["Event", "EventBus", "BUS", "emit", "events", "counts",
+           "clear", "subscribe", "unsubscribe", "enabled", "enable",
+           "step_scope", "request_scope", "current_step",
+           "current_request"]
+
+#: severity ladder (events carry one; sinks/filters may threshold)
+SEVERITIES = ("debug", "info", "warning", "error")
+
+
+class Event:
+    """One telemetry record. Immutable by convention; ``to_dict()`` is the
+    wire form every sink serializes (strict-JSON safe after
+    :func:`~incubator_mxnet_tpu.telemetry.export.sanitize`)."""
+
+    __slots__ = ("seq", "kind", "severity", "ts", "mono", "step",
+                 "request_id", "fields")
+
+    def __init__(self, seq: int, kind: str, severity: str, ts: float,
+                 mono: float, step: Optional[int],
+                 request_id: Optional[str], fields: Dict):
+        self.seq = seq
+        self.kind = kind
+        self.severity = severity
+        self.ts = ts            # wall clock (epoch seconds) — sink ordering
+        self.mono = mono        # monotonic — duration math
+        self.step = step        # training-step correlation id
+        self.request_id = request_id  # serving-request correlation id
+        self.fields = fields
+
+    def to_dict(self) -> Dict:
+        d = {"seq": self.seq, "kind": self.kind, "severity": self.severity,
+             "ts": round(self.ts, 6), "mono": round(self.mono, 6)}
+        if self.step is not None:
+            d["step"] = self.step
+        if self.request_id is not None:
+            d["request_id"] = self.request_id
+        if self.fields:
+            d["fields"] = self.fields
+        return d
+
+    def __repr__(self):
+        corr = (f", step={self.step}" if self.step is not None else "") + \
+            (f", request={self.request_id}" if self.request_id else "")
+        return f"Event(#{self.seq} {self.kind}/{self.severity}{corr})"
+
+
+# -- correlation context (thread-local) -------------------------------------
+_CTX = threading.local()
+
+
+def current_step() -> Optional[int]:
+    return getattr(_CTX, "step", None)
+
+
+def current_request() -> Optional[str]:
+    return getattr(_CTX, "request_id", None)
+
+
+class step_scope:
+    """Bind a training-step id to every event emitted on this thread::
+
+        with telemetry.step_scope(trainer.num_update):
+            ...  # chaos/guard/kvstore events inherit the step id
+    """
+
+    def __init__(self, step: int):
+        self._step = step
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = getattr(_CTX, "step", None)
+        _CTX.step = self._step
+        return self
+
+    def __exit__(self, *exc):
+        _CTX.step = self._prev
+
+
+class request_scope:
+    """Bind a serving-request correlation id (thread-local), mirroring
+    :class:`step_scope`."""
+
+    def __init__(self, request_id: str):
+        self._rid = request_id
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = getattr(_CTX, "request_id", None)
+        _CTX.request_id = self._rid
+        return self
+
+    def __exit__(self, *exc):
+        _CTX.request_id = self._prev
+
+
+# -- the bus ----------------------------------------------------------------
+class EventBus:
+    """Bounded, thread-safe, per-kind ring buffers + subscriber fan-out."""
+
+    def __init__(self, ring: Optional[int] = None):
+        from ..util import getenv
+        self.ring = int(ring if ring is not None
+                        else getenv("MXTPU_TELEMETRY_RING"))
+        self._lock = threading.Lock()
+        self._rings: Dict[str, deque] = {}
+        self._counts: Dict[str, int] = {}
+        self._seq = itertools.count(1)
+        self._subscribers: List[Callable[[Event], None]] = []
+        #: subscriber exceptions swallowed (a sink must never break the
+        #: emitting subsystem)
+        self.subscriber_errors = 0
+
+    def emit(self, kind: str, severity: str = "info",
+             step: Optional[int] = None, request_id: Optional[str] = None,
+             **fields) -> Optional[Event]:
+        if severity not in SEVERITIES:
+            raise ValueError(f"unknown severity {severity!r}; "
+                             f"choose from {SEVERITIES}")
+        ev = Event(next(self._seq), kind, severity, time.time(),
+                   time.monotonic(),
+                   step if step is not None else current_step(),
+                   request_id if request_id is not None
+                   else current_request(),
+                   fields)
+        with self._lock:
+            ring = self._rings.get(kind)
+            if ring is None:
+                ring = self._rings[kind] = deque(maxlen=self.ring)
+            ring.append(ev)
+            self._counts[kind] = self._counts.get(kind, 0) + 1
+            subs = list(self._subscribers)
+        # subscribers run OUTSIDE the lock: a slow sink must not
+        # serialize emitters, and a sink that emits must not deadlock
+        for sub in subs:
+            try:
+                sub(ev)
+            except Exception:  # noqa: BLE001 — sinks must not break emitters
+                with self._lock:  # unlocked += would lose concurrent counts
+                    self.subscriber_errors += 1
+        return ev
+
+    def events(self, kind: Optional[str] = None,
+               n: Optional[int] = None) -> List[Event]:
+        """Newest-last events — one kind's ring, or every ring merged by
+        sequence number. ``n`` keeps only the newest n."""
+        with self._lock:
+            if kind is not None:
+                out = list(self._rings.get(kind, ()))
+            else:
+                out = sorted((e for r in self._rings.values() for e in r),
+                             key=lambda e: e.seq)
+        return out[-n:] if n else out
+
+    def counts(self) -> Dict[str, int]:
+        """Total emitted per kind (keeps counting past the ring cap)."""
+        with self._lock:
+            return dict(self._counts)
+
+    def dropped(self) -> Dict[str, int]:
+        """Events emitted but no longer in the ring, per kind."""
+        with self._lock:
+            return {k: self._counts[k] - len(self._rings.get(k, ()))
+                    for k in self._counts}
+
+    def subscribe(self, fn: Callable[[Event], None]) -> Callable:
+        with self._lock:
+            self._subscribers.append(fn)
+        return fn
+
+    def unsubscribe(self, fn: Callable[[Event], None]) -> None:
+        with self._lock:
+            if fn in self._subscribers:
+                self._subscribers.remove(fn)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._rings.clear()
+            self._counts.clear()
+
+
+#: the process-wide bus every wired subsystem publishes into
+BUS = EventBus()
+
+_ENABLED: Optional[bool] = None
+_ENV_SINKS_INSTALLED = False
+_ENV_SINKS_LOCK = threading.Lock()
+
+
+def _reset_env_sinks_flag() -> None:
+    """Re-arm env-sink installation (``export.uninstall_all`` calls this
+    so a reset bus re-installs ``MXTPU_TELEMETRY_JSONL`` on next emit)."""
+    global _ENV_SINKS_INSTALLED
+    with _ENV_SINKS_LOCK:
+        _ENV_SINKS_INSTALLED = False
+
+
+def enabled() -> bool:
+    """Master switch: ``MXTPU_TELEMETRY`` env (cached) unless overridden
+    by :func:`enable`."""
+    global _ENABLED
+    if _ENABLED is None:
+        from ..util import getenv
+        _ENABLED = getenv("MXTPU_TELEMETRY") not in ("0", "false", "off")
+    return _ENABLED
+
+
+def enable(on: bool = True) -> None:
+    """Programmatic override of the env switch (tests, notebooks)."""
+    global _ENABLED
+    _ENABLED = bool(on)
+
+
+def emit(kind: str, severity: str = "info", step: Optional[int] = None,
+         request_id: Optional[str] = None, **fields) -> Optional[Event]:
+    """Publish one event on the global :data:`BUS` (no-op when telemetry
+    is disabled). The first real emission installs env-configured sinks
+    (``MXTPU_TELEMETRY_JSONL``)."""
+    if not enabled():
+        return None
+    global _ENV_SINKS_INSTALLED
+    if not _ENV_SINKS_INSTALLED:
+        # double-checked under a lock: two threads racing the first
+        # emission must not both run install (a double-installed sink
+        # writes every line twice)
+        with _ENV_SINKS_LOCK:
+            if not _ENV_SINKS_INSTALLED:
+                _ENV_SINKS_INSTALLED = True
+                from . import export
+                try:
+                    export.install_from_env()
+                except Exception as e:  # noqa: BLE001 — a telemetry
+                    # config typo (bad path / MAX_MB) must not crash the
+                    # emitting subsystem's first step/request
+                    import warnings
+                    warnings.warn(f"[telemetry] env sink install failed "
+                                  f"({type(e).__name__}: {e}); the "
+                                  "JSONL stream is disabled for this run")
+    return BUS.emit(kind, severity=severity, step=step,
+                    request_id=request_id, **fields)
+
+
+def events(kind: Optional[str] = None, n: Optional[int] = None):
+    return BUS.events(kind, n)
+
+
+#: package-level alias (``telemetry.events`` is this module, so the
+#: package re-exports the listing function under this name)
+get_events = events
+
+
+def counts() -> Dict[str, int]:
+    return BUS.counts()
+
+
+def clear() -> None:
+    BUS.clear()
+
+
+def subscribe(fn: Callable[[Event], None]) -> Callable:
+    return BUS.subscribe(fn)
+
+
+def unsubscribe(fn: Callable[[Event], None]) -> None:
+    BUS.unsubscribe(fn)

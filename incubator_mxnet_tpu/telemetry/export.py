@@ -1,0 +1,197 @@
+"""Telemetry sinks — JSON-lines file, Prometheus scrape, chrome trace.
+
+Three render targets for the same spine (bus + registry + compile ledger):
+
+- :class:`JsonlSink` — every event as one strict-JSON line in a rotating
+  file (``MXTPU_TELEMETRY_JSONL`` / ``MXTPU_TELEMETRY_JSONL_MAX_MB``);
+  the CI ``telemetry-smoke`` job replays the stream through
+  ``tools/telemetry_check.py`` and fails on any malformed line or
+  post-warmup compile event.
+- :func:`prometheus_text` — the metrics registry in Prometheus text
+  exposition format, plus synthetic ``mxtpu_events_total{kind=...}``
+  series from the bus counts. The serve
+  :class:`~incubator_mxnet_tpu.serve.server.Server` answers
+  ``{"cmd": "prometheus"}`` with exactly this string.
+- :func:`chrome_trace` — a chrome://tracing / Perfetto JSON document
+  merging the profiler's recent wall-time spans (``profiler`` records the
+  raw start/duration pairs) with the bus events as instant markers, so
+  one timeline shows step phases, serve stages, AND the faults/compiles
+  that punctuated them.
+
+Strict JSON everywhere: :func:`sanitize` maps non-finite floats to null
+before serialization and every ``json.dumps`` here passes
+``allow_nan=False`` — an empty histogram must not leak an ``Infinity``
+token into a parser (the bug :func:`profiler.span_records` had).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Dict, Optional
+
+__all__ = ["sanitize", "dumps_strict", "JsonlSink", "install_jsonl",
+           "install_from_env", "uninstall_all", "prometheus_text",
+           "chrome_trace"]
+
+
+def sanitize(obj):
+    """Recursively make ``obj`` strict-JSON serializable: non-finite
+    floats (NaN/±inf) become None, tuples become lists, unknown objects
+    become their repr."""
+    if obj is None or isinstance(obj, (bool, int, str)):
+        return obj
+    if isinstance(obj, float):
+        return obj if obj == obj and obj not in (float("inf"),
+                                                 float("-inf")) else None
+    if isinstance(obj, dict):
+        return {str(k): sanitize(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple, set)):
+        return [sanitize(v) for v in obj]
+    try:  # numpy scalars quack like floats/ints
+        return sanitize(float(obj)) if hasattr(obj, "dtype") else repr(obj)
+    except (TypeError, ValueError):
+        return repr(obj)
+
+
+def dumps_strict(obj, **kw) -> str:
+    """``json.dumps`` with ``allow_nan=False`` over sanitized input — the
+    one serializer every telemetry surface goes through."""
+    return json.dumps(sanitize(obj), allow_nan=False, **kw)
+
+
+class JsonlSink:
+    """Bus subscriber writing one strict-JSON line per event, with
+    size-based rotation (``path`` -> ``path.1``, one generation — bounded
+    disk like the rings bound memory). Thread-safe; install with
+    ``telemetry.subscribe(sink)`` or :func:`install_jsonl`."""
+
+    def __init__(self, path: str, max_mb: Optional[float] = None):
+        from ..util import getenv
+        self.path = path
+        self.max_bytes = int(float(
+            getenv("MXTPU_TELEMETRY_JSONL_MAX_MB")
+            if max_mb is None else max_mb) * 1024 * 1024)
+        self._lock = threading.Lock()
+        self._fh = None
+        self._started = False
+        self.lines = 0
+
+    def __call__(self, event) -> None:
+        line = dumps_strict(event.to_dict(), sort_keys=True)
+        with self._lock:
+            if self._fh is None:
+                d = os.path.dirname(os.path.abspath(self.path))
+                os.makedirs(d, exist_ok=True)
+                # first open truncates: seq numbers restart per process,
+                # so appending to a previous run's file would read as
+                # corruption (duplicate seqs) to tools/telemetry_check.py;
+                # reopens within one run (after rotation/close) append
+                self._fh = open(self.path,
+                                "a" if self._started else "w",
+                                encoding="utf-8")
+                self._started = True
+            self._fh.write(line + "\n")
+            self._fh.flush()
+            self.lines += 1
+            if self.max_bytes and self._fh.tell() >= self.max_bytes:
+                self._rotate()
+
+    def _rotate(self) -> None:
+        self._fh.close()
+        self._fh = None
+        os.replace(self.path, self.path + ".1")
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+
+_INSTALLED: Dict[str, JsonlSink] = {}
+_INSTALL_LOCK = threading.Lock()
+
+
+def install_jsonl(path: str, max_mb: Optional[float] = None) -> JsonlSink:
+    """Create + subscribe a :class:`JsonlSink` (idempotent per path —
+    locked, so two threads racing the first emission cannot double-
+    install and duplicate every line)."""
+    from . import events as _events
+    with _INSTALL_LOCK:
+        sink = _INSTALLED.get(path)
+        if sink is None:
+            sink = _INSTALLED[path] = JsonlSink(path, max_mb=max_mb)
+            _events.subscribe(sink)
+    return sink
+
+
+def install_from_env() -> Optional[JsonlSink]:
+    """Install the sinks ``MXTPU_TELEMETRY_*`` env vars ask for (called
+    automatically on the first emission)."""
+    from ..util import getenv
+    path = getenv("MXTPU_TELEMETRY_JSONL")
+    if path:
+        return install_jsonl(path)
+    return None
+
+
+def uninstall_all() -> None:
+    """Close + unsubscribe every installed sink (``telemetry.reset``)."""
+    from . import events as _events
+    with _INSTALL_LOCK:
+        sinks = list(_INSTALLED.values())
+        _INSTALLED.clear()
+    for sink in sinks:
+        _events.unsubscribe(sink)
+        sink.close()
+    # the next emission re-consults MXTPU_TELEMETRY_* (a reset must not
+    # leave the env-configured stream silently dark for the process rest)
+    _events._reset_env_sinks_flag()
+
+
+def prometheus_text() -> str:
+    """The full scrape: metrics registry + per-kind event totals +
+    subscriber-error count."""
+    from . import events as _events
+    from . import metrics as _metrics
+    out = [_metrics.prometheus_text().rstrip("\n")]
+    counts = _events.counts()
+    if counts:
+        out.append("# TYPE mxtpu_events_total counter")
+        for kind in sorted(counts):
+            out.append(f'mxtpu_events_total{{kind="{kind}"}} '
+                       f"{counts[kind]}")
+    out.append("# TYPE mxtpu_telemetry_subscriber_errors_total counter")
+    out.append("mxtpu_telemetry_subscriber_errors_total "
+               f"{_events.BUS.subscriber_errors}")
+    return "\n".join(out) + "\n"
+
+
+def chrome_trace(include_events: bool = True) -> str:
+    """chrome://tracing JSON merging the profiler's recent raw spans
+    (``ph: "X"`` complete events) with bus events (``ph: "i"`` instants,
+    one track per kind). Timestamps are wall-clock microseconds, so the
+    two sources land on one comparable timeline. Load in
+    chrome://tracing or ui.perfetto.dev."""
+    from .. import profiler
+    trace = []
+    for name, kind, t_start, dur_ms in profiler.recent_spans():
+        trace.append({"name": name, "cat": kind, "ph": "X",
+                      "ts": round(t_start * 1e6, 1),
+                      "dur": round(dur_ms * 1e3, 1),
+                      "pid": 1, "tid": 1})
+    if include_events:
+        from . import events as _events
+        for ev in _events.events():
+            args = dict(ev.fields)
+            if ev.step is not None:
+                args["step"] = ev.step
+            if ev.request_id is not None:
+                args["request_id"] = ev.request_id
+            trace.append({"name": f"{ev.kind}", "cat": ev.severity,
+                          "ph": "i", "s": "p",
+                          "ts": round(ev.ts * 1e6, 1),
+                          "pid": 1, "tid": 2, "args": sanitize(args)})
+    return dumps_strict({"traceEvents": trace,
+                         "displayTimeUnit": "ms"})

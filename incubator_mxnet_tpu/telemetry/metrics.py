@@ -1,0 +1,310 @@
+"""Typed metrics registry — Counter / Gauge / Histogram, one process table.
+
+Reference counterpart: the reference had no metrics plane at all; this
+repo then grew two parallel reservoir-percentile implementations
+(``metric.Percentile`` for training, ``serve.ServeMetrics`` for serving).
+:class:`Histogram` is now THE one implementation both delegate to —
+algorithm-R uniform reservoir (deterministically seeded) + nearest-rank
+percentiles over the full stream, mean/count exact past the cap.
+
+The :class:`MetricsRegistry` keys instruments by ``(name, labels)`` so the
+same series is shared wherever it is requested (Prometheus identity
+semantics), and renders the whole table as
+
+- ``to_dict()``  — JSON-ready nested dict (``telemetry.snapshot()``), and
+- ``prometheus_text()`` — Prometheus text exposition format 0.0.4
+  (the scrape the serve :class:`~incubator_mxnet_tpu.serve.server.Server`
+  answers with ``{"cmd": "prometheus"}``).
+
+Counters are monotonic for Prometheus sanity; per-window views belong to
+the owning subsystem's snapshot (e.g. ``ServeMetrics.reset`` resets its
+window, not the registry series).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as onp
+
+from ..util import nearest_rank_percentile
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
+           "counter", "gauge", "histogram", "prometheus_text", "to_dict"]
+
+
+def _labels_key(labels: Dict[str, str]) -> Tuple:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def _escape_label_value(v: str) -> str:
+    """Prometheus text-exposition escaping for label values (the format
+    requires ``\\`` → ``\\\\``, ``"`` → ``\\"``, newline → ``\\n``) —
+    label values flow from user-controlled model names, and one bad name
+    must not make the whole scrape unparseable."""
+    return (v.replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _labels_str(labels: Tuple) -> str:
+    if not labels:
+        return ""
+    return "{" + ",".join(f'{k}="{_escape_label_value(v)}"'
+                          for k, v in labels) + "}"
+
+
+class Counter:
+    """Monotonically increasing count (requests served, faults injected)."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, help: str = "", labels: Tuple = ()):
+        self.name = name
+        self.help = help
+        self.labels = labels
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError("counters only go up; use a Gauge")
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def reset(self) -> None:
+        with self._lock:
+            self._value = 0.0
+
+
+class Gauge:
+    """Point-in-time value (queue depth, loss scale, grad norm)."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, help: str = "", labels: Tuple = ()):
+        self.name = name
+        self.help = help
+        self.labels = labels
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.inc(-amount)
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def reset(self) -> None:
+        self.set(0.0)
+
+
+class Histogram:
+    """Streaming scalar distribution: bounded uniform reservoir
+    (algorithm R, seeded) + exact count/sum/min/max.
+
+    Past capacity each new sample replaces a random slot with probability
+    ``reservoir/seen`` so the summary tracks the FULL stream — a late
+    latency regression moves the p99 instead of being dropped. This is the
+    shared kernel ``metric.Percentile`` and ``serve.ServeMetrics`` both
+    delegate to (one reservoir implementation in the codebase, by
+    construction).
+    """
+
+    kind = "histogram"
+
+    def __init__(self, name: str = "", help: str = "",
+                 labels: Tuple = (), q=(50, 95, 99),
+                 reservoir: int = 8192, seed: int = 0):
+        self.name = name
+        self.help = help
+        self.labels = labels
+        self.q = tuple(q)
+        self.reservoir = int(reservoir)
+        self._seed = int(seed)
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self) -> None:
+        with self._lock:
+            self._samples: List[float] = []
+            self._seen = 0
+            self._total = 0.0
+            self._min = float("inf")
+            self._max = float("-inf")
+            self._rng = onp.random.RandomState(self._seed)
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        with self._lock:
+            self._seen += 1
+            self._total += v
+            self._min = min(self._min, v)
+            self._max = max(self._max, v)
+            if len(self._samples) < self.reservoir:
+                self._samples.append(v)
+            else:
+                j = int(self._rng.randint(0, self._seen))
+                if j < self.reservoir:
+                    self._samples[j] = v
+
+    # -- summaries ------------------------------------------------------
+    @property
+    def count(self) -> int:
+        return self._seen
+
+    @property
+    def total(self) -> float:
+        return self._total
+
+    def percentile(self, q: float) -> float:
+        """Nearest-rank percentile over the reservoir; NaN when empty."""
+        with self._lock:
+            samples = sorted(self._samples)
+        return nearest_rank_percentile(samples, q)
+
+    def percentiles(self, qs=None) -> Dict[str, float]:
+        with self._lock:
+            samples = sorted(self._samples)
+        return {f"p{q:g}": nearest_rank_percentile(samples, q)
+                for q in (qs or self.q)}
+
+    def summary(self) -> Dict[str, float]:
+        """count/mean/min/max + the configured percentiles (JSON-ready;
+        non-finite values from an empty histogram become None downstream
+        via ``export.sanitize``)."""
+        with self._lock:
+            samples = sorted(self._samples)
+            n, total = self._seen, self._total
+            lo, hi = self._min, self._max
+        out = {"count": n, "total": total,
+               "mean": total / n if n else float("nan"),
+               "min": lo if n else float("nan"),
+               "max": hi if n else float("nan")}
+        for q in self.q:
+            out[f"p{q:g}"] = nearest_rank_percentile(samples, q)
+        return out
+
+
+class MetricsRegistry:
+    """Process-wide instrument table keyed by ``(name, labels)``."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._table: Dict[Tuple[str, Tuple], object] = {}
+
+    def _get(self, cls, name: str, help: str, labels: Dict, **kw):
+        key = (name, _labels_key(labels))
+        with self._lock:
+            inst = self._table.get(key)
+            if inst is None:
+                inst = cls(name=name, help=help, labels=key[1], **kw)
+                self._table[key] = inst
+            elif not isinstance(inst, cls):
+                raise TypeError(
+                    f"metric {name!r}{dict(key[1])} is a "
+                    f"{type(inst).__name__}, requested {cls.__name__}")
+        return inst
+
+    def counter(self, name: str, help: str = "", **labels) -> Counter:
+        return self._get(Counter, name, help, labels)
+
+    def gauge(self, name: str, help: str = "", **labels) -> Gauge:
+        return self._get(Gauge, name, help, labels)
+
+    def histogram(self, name: str, help: str = "", q=(50, 95, 99),
+                  reservoir: int = 8192, **labels) -> Histogram:
+        return self._get(Histogram, name, help, labels, q=q,
+                         reservoir=reservoir)
+
+    def instruments(self) -> List:
+        with self._lock:
+            return list(self._table.values())
+
+    def clear(self) -> None:
+        """Drop every instrument (tests)."""
+        with self._lock:
+            self._table.clear()
+
+    # -- rendering ------------------------------------------------------
+    def to_dict(self) -> Dict:
+        """``{name: {labels_str: value-or-summary}}`` — JSON-ready after
+        ``export.sanitize``."""
+        out: Dict[str, Dict] = {}
+        for inst in self.instruments():
+            ent = out.setdefault(inst.name, {})
+            key = _labels_str(inst.labels) or "_"
+            ent[key] = (inst.summary() if isinstance(inst, Histogram)
+                        else inst.value)
+        return out
+
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition (format 0.0.4). Histograms render as
+        summaries (quantile series + _count/_sum) — the host-side reservoir
+        has true quantiles, which beat lossy fixed buckets."""
+        by_name: Dict[str, List] = {}
+        for inst in self.instruments():
+            by_name.setdefault(inst.name, []).append(inst)
+        lines: List[str] = []
+        for name in sorted(by_name):
+            insts = by_name[name]
+            kind = ("summary" if isinstance(insts[0], Histogram)
+                    else insts[0].kind)
+            if insts[0].help:
+                lines.append(f"# HELP {name} {insts[0].help}")
+            lines.append(f"# TYPE {name} {kind}")
+            for inst in insts:
+                if isinstance(inst, Histogram):
+                    base = dict(inst.labels)
+                    s = inst.summary()
+                    for q in inst.q:
+                        ql = _labels_str(_labels_key(
+                            {**base, "quantile": f"{q / 100:g}"}))
+                        v = s[f"p{q:g}"]
+                        lines.append(f"{name}{ql} "
+                                     f"{'NaN' if v != v else repr(v)}")
+                    ls = _labels_str(inst.labels)
+                    lines.append(f"{name}_count{ls} {s['count']}")
+                    lines.append(f"{name}_sum{ls} {repr(s['total'])}")
+                else:
+                    ls = _labels_str(inst.labels)
+                    lines.append(f"{name}{ls} {repr(inst.value)}")
+        return "\n".join(lines) + "\n"
+
+
+#: the process-wide registry (the Prometheus scrape renders exactly this)
+REGISTRY = MetricsRegistry()
+
+
+def counter(name: str, help: str = "", **labels) -> Counter:
+    return REGISTRY.counter(name, help, **labels)
+
+
+def gauge(name: str, help: str = "", **labels) -> Gauge:
+    return REGISTRY.gauge(name, help, **labels)
+
+
+def histogram(name: str, help: str = "", q=(50, 95, 99),
+              reservoir: int = 8192, **labels) -> Histogram:
+    return REGISTRY.histogram(name, help, q=q, reservoir=reservoir,
+                              **labels)
+
+
+def prometheus_text() -> str:
+    return REGISTRY.prometheus_text()
+
+
+def to_dict() -> Dict:
+    return REGISTRY.to_dict()

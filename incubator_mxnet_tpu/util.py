@@ -58,6 +58,19 @@ ENV_VARS: Dict[str, tuple] = {
     "MXTPU_EMBED_ONEHOT_GRAD": ("0", "Embedding weight gradient as a one-hot "
                                 "MXU matmul instead of scatter-add (sweep "
                                 "candidate; numerically identical)."),
+    "MXTPU_TELEMETRY": ("1", "Master switch for the mx.telemetry event "
+                        "bus; 0 turns every emit() into a no-op."),
+    "MXTPU_TELEMETRY_RING": ("1024", "Per-kind event ring-buffer capacity; "
+                             "aggregate counts keep counting past the "
+                             "ring, only raw events drop."),
+    "MXTPU_TELEMETRY_JSONL": ("", "When set, every telemetry event is "
+                              "appended to this file as one strict-JSON "
+                              "line (rotating sink, installed on first "
+                              "emission)."),
+    "MXTPU_TELEMETRY_JSONL_MAX_MB": ("64", "Rotation threshold for the "
+                                     "JSON-lines sink; past it the file "
+                                     "moves to <path>.1 (one generation "
+                                     "kept)."),
 }
 
 

@@ -1,0 +1,26 @@
+"""Seeded MX601 violation: a training loop hand-rolls wall-clock timing
+(one print, visible to nobody) instead of publishing through
+mx.telemetry — the measurement never reaches the event bus, the
+Prometheus scrape, or the JSONL stream."""
+import time
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon, parallel
+
+
+def main():
+    net = gluon.nn.Dense(10)
+    net.initialize()
+    trainer = parallel.ShardedTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "adamw",
+        {"learning_rate": 1e-3})
+    for step, (x, y) in enumerate(batches()):   # noqa: F821 — fixture
+        t0 = time.perf_counter()
+        trainer.step(x, y)
+        print("step ms:", (time.perf_counter() - t0) * 1e3)
+        if step % 500 == 0:
+            trainer.save_checkpoint("ckpts/")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,614 @@
+"""mx.telemetry — event bus, metrics registry, compile ledger, sinks,
+and the cross-subsystem wiring (trainer / serve / fault / kvstore).
+
+Covers the ISSUE 4 acceptance demo end to end: a short train loop plus a
+batched serve burst must produce a valid strict-JSON event stream with
+step/request correlation ids, a Prometheus scrape carrying counters from
+BOTH training and serving, and a compile ledger with zero post-warmup
+events.
+"""
+import json
+import os
+import threading
+
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import fault, gluon, parallel, serve, telemetry
+from incubator_mxnet_tpu.telemetry import compile_log, events as tevents
+from incubator_mxnet_tpu.telemetry.metrics import Histogram
+
+from tools.telemetry_check import check_stream
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    """Each test sees an empty bus/registry/ledger and an enabled switch."""
+    telemetry.reset()
+    telemetry.enable(True)
+    yield
+    telemetry.reset()
+    telemetry.enable(True)
+
+
+# ---------------------------------------------------------------------------
+# event bus
+# ---------------------------------------------------------------------------
+class TestEventBus:
+    def test_emit_records_envelope_and_fields(self):
+        ev = telemetry.emit("unit.kind", severity="warning", step=11,
+                            request_id="r9", foo=1.5, bar="x")
+        d = ev.to_dict()
+        assert d["kind"] == "unit.kind" and d["severity"] == "warning"
+        assert d["step"] == 11 and d["request_id"] == "r9"
+        assert d["fields"] == {"foo": 1.5, "bar": "x"}
+        assert d["seq"] >= 1 and d["ts"] > 0 and d["mono"] > 0
+
+    def test_ring_bounds_but_counts_keep_counting(self):
+        bus = telemetry.EventBus(ring=4)
+        for i in range(10):
+            bus.emit("k", i=i)
+        assert len(bus.events("k")) == 4
+        assert bus.counts() == {"k": 10}
+        assert bus.dropped() == {"k": 6}
+        # newest survive
+        assert [e.fields["i"] for e in bus.events("k")] == [6, 7, 8, 9]
+
+    def test_merged_view_is_seq_ordered(self):
+        telemetry.emit("a")
+        telemetry.emit("b")
+        telemetry.emit("a")
+        seqs = [e.seq for e in telemetry.get_events()]
+        assert seqs == sorted(seqs) and len(seqs) == 3
+
+    def test_step_and_request_scopes_are_thread_local(self):
+        with telemetry.step_scope(5):
+            ev1 = telemetry.emit("k")
+            with telemetry.request_scope("r1"):
+                ev2 = telemetry.emit("k")
+        seen = {}
+
+        def other():
+            seen["ev"] = telemetry.emit("k")
+
+        t = threading.Thread(target=other)
+        with telemetry.step_scope(7):
+            t.start()
+            t.join()
+        assert ev1.step == 5 and ev1.request_id is None
+        assert ev2.step == 5 and ev2.request_id == "r1"
+        assert seen["ev"].step is None  # scope does not leak across threads
+
+    def test_bad_severity_rejected(self):
+        with pytest.raises(ValueError, match="severity"):
+            telemetry.BUS.emit("k", severity="fatal")
+
+    def test_raising_subscriber_is_counted_not_propagated(self):
+        def bad(_ev):
+            raise RuntimeError("sink died")
+
+        telemetry.subscribe(bad)
+        try:
+            before = telemetry.BUS.subscriber_errors
+            telemetry.emit("k")
+            assert telemetry.BUS.subscriber_errors == before + 1
+        finally:
+            telemetry.unsubscribe(bad)
+
+    def test_disabled_emit_is_noop(self):
+        telemetry.enable(False)
+        assert telemetry.emit("k") is None
+        assert telemetry.counts() == {}
+
+
+# ---------------------------------------------------------------------------
+# metrics registry
+# ---------------------------------------------------------------------------
+class TestMetrics:
+    def test_counter_monotonic(self):
+        c = telemetry.counter("t_total")
+        c.inc()
+        c.inc(2.5)
+        assert c.value == 3.5
+        with pytest.raises(ValueError):
+            c.inc(-1)
+
+    def test_registry_identity_and_kind_conflict(self):
+        a = telemetry.counter("t_x", model="m1")
+        b = telemetry.counter("t_x", model="m1")
+        c = telemetry.counter("t_x", model="m2")
+        assert a is b and a is not c
+        with pytest.raises(TypeError):
+            telemetry.gauge("t_x", model="m1")
+
+    def test_histogram_matches_numpy_percentiles(self):
+        h = Histogram(name="h", q=(50, 95, 99), reservoir=1000)
+        vals = onp.random.RandomState(3).randn(500) * 10
+        for v in vals:
+            h.observe(v)
+        s = h.summary()
+        assert s["count"] == 500
+        assert abs(s["mean"] - vals.mean()) < 1e-9
+        assert s["min"] == vals.min() and s["max"] == vals.max()
+        # nearest-rank over the full (uncapped) sample set
+        ref = sorted(vals)[int(round(0.5 * 499))]
+        assert s["p50"] == ref
+
+    def test_histogram_reservoir_tracks_full_stream(self):
+        h = Histogram(name="h", reservoir=64)
+        for v in range(10000):
+            h.observe(float(v))
+        assert h.count == 10000
+        # late samples must be representable: p50 of the full stream is
+        # ~5000, a drop-after-cap reservoir would report ~32
+        assert h.percentile(50) > 1000
+
+    def test_empty_histogram_is_strict_json_after_sanitize(self):
+        h = Histogram(name="h")
+        doc = telemetry.dumps_strict(h.summary())
+        parsed = json.loads(doc, parse_constant=lambda t: pytest.fail(t))
+        assert parsed["mean"] is None and parsed["p50"] is None
+
+    def test_percentile_metric_delegates_to_histogram(self):
+        p = mx.metric.Percentile(q=(50, 95), name="lat", reservoir=128)
+        h = Histogram(name="lat", q=(50, 95), reservoir=128)
+        vals = onp.random.RandomState(0).rand(1000)
+        p.update(None, [vals])
+        for v in vals:
+            h.observe(float(v))
+        names, got = p.get()
+        assert names == ["lat_p50", "lat_p95", "lat_mean"]
+        # identical reservoir algorithm + seed => identical percentiles
+        assert got[0] == h.percentile(50)
+        assert got[1] == h.percentile(95)
+        assert abs(got[2] - vals.mean()) < 1e-9
+        assert isinstance(p._hist, Histogram)
+
+    def test_prometheus_text_format(self):
+        telemetry.counter("t_reqs", "help text", model="m").inc(3)
+        hg = telemetry.histogram("t_ms", model="m")
+        hg.observe(5.0)
+        telemetry.emit("some.kind")
+        text = telemetry.prometheus_text()
+        assert "# TYPE t_reqs counter" in text
+        assert 't_reqs{model="m"} 3.0' in text
+        assert "# TYPE t_ms summary" in text
+        assert 't_ms{model="m",quantile="0.5"} 5.0' in text
+        assert 't_ms_count{model="m"} 1' in text
+        assert 'mxtpu_events_total{kind="some.kind"} 1' in text
+
+
+# ---------------------------------------------------------------------------
+# compile ledger
+# ---------------------------------------------------------------------------
+class TestCompileLedger:
+    def test_note_phases_and_assert(self):
+        compile_log.note("s1", ((4, 8), "f32"), wall_ms=10.0, warmup=True)
+        assert compile_log.post_warmup_compiles() == 0
+        compile_log.assert_zero_post_warmup()
+        compile_log.note("s1", ((16, 8), "f32"), warmup=False)
+        assert compile_log.post_warmup_compiles() == 1
+        assert compile_log.post_warmup_compiles("s1") == 1
+        with pytest.raises(mx.MXNetError, match="unexpected"):
+            compile_log.assert_zero_post_warmup()
+        s = compile_log.summary()
+        assert s["total"] == 2 and s["warmup"] == 1
+        assert s["by_site"]["s1"] == {"warmup": 1, "post_warmup": 1}
+
+    def test_mark_warmed_switches_default_phase(self):
+        compile_log.note("s2", "sigA")
+        compile_log.mark_warmed("s2")
+        compile_log.note("s2", "sigB")
+        assert compile_log.post_warmup_compiles("s2") == 1
+
+    def test_note_publishes_event_and_counter(self):
+        with telemetry.step_scope(4):
+            compile_log.note("s3", "sig", warmup=False)
+        (ev,) = telemetry.get_events("compile")
+        assert ev.severity == "warning" and ev.step == 4
+        assert ev.fields["site"] == "s3" and ev.fields["warmup"] is False
+        text = telemetry.prometheus_text()
+        assert 'mxtpu_compiles_total{phase="post_warmup",site="s3"} 1' \
+            in text
+
+
+# ---------------------------------------------------------------------------
+# export sinks
+# ---------------------------------------------------------------------------
+class TestExport:
+    def test_jsonl_sink_strict_json_and_checker_clean(self, tmp_path):
+        path = str(tmp_path / "ev.jsonl")
+        sink = telemetry.install_jsonl(path)
+        telemetry.emit("k", value=float("nan"), inf=float("inf"), ok=1)
+        telemetry.emit("k2", step=3)
+        sink.close()
+        lines = open(path).read().splitlines()
+        assert len(lines) == 2
+        first = json.loads(lines[0],
+                           parse_constant=lambda t: pytest.fail(t))
+        assert first["fields"] == {"value": None, "inf": None, "ok": 1}
+        assert check_stream(lines, "t") == []
+
+    def test_jsonl_sink_rotates(self, tmp_path):
+        path = str(tmp_path / "rot.jsonl")
+        sink = telemetry.JsonlSink(path, max_mb=0.0001)  # ~100 bytes
+        telemetry.subscribe(sink)
+        try:
+            for _ in range(10):
+                telemetry.emit("k", pad="x" * 64)
+        finally:
+            telemetry.unsubscribe(sink)
+            sink.close()
+        assert os.path.exists(path + ".1")
+
+    def test_checker_rejects_malformed_and_post_warmup(self):
+        good = ('{"seq": 1, "kind": "k", "ts": 1.0}',)
+        assert check_stream(good) == []
+        bad_token = ('{"seq": 1, "kind": "k", "ts": Infinity}',)
+        assert any("malformed" in p for p in check_stream(bad_token))
+        # concurrent emitters may reorder lines — legal; duplicates are not
+        reordered = ('{"seq": 5, "kind": "k", "ts": 1.0}',
+                     '{"seq": 4, "kind": "k", "ts": 1.0}')
+        assert check_stream(reordered) == []
+        dup_seq = ('{"seq": 5, "kind": "k", "ts": 1.0}',
+                   '{"seq": 5, "kind": "k", "ts": 1.0}')
+        assert any("duplicate seq" in p for p in check_stream(dup_seq))
+        compile_bad = ('{"seq": 1, "kind": "compile", "ts": 1.0, '
+                       '"fields": {"warmup": false, "site": "s"}}',)
+        assert any("POST-WARMUP" in p for p in check_stream(compile_bad))
+        assert check_stream(compile_bad, allow_post_warmup=True) == []
+        assert any("empty" in p for p in check_stream(()))
+
+    def test_chrome_trace_merges_spans_and_events(self):
+        from incubator_mxnet_tpu import profiler
+        profiler.reset_spans()
+        with profiler.Scope("unit.span"):
+            pass
+        telemetry.emit("unit.instant", step=2)
+        doc = json.loads(telemetry.chrome_trace())
+        names = {e["name"]: e["ph"] for e in doc["traceEvents"]}
+        assert names.get("unit.span") == "X"
+        assert names.get("unit.instant") == "i"
+
+    def test_snapshot_shape(self):
+        telemetry.emit("k", x=1)
+        compile_log.note("s", "sig")
+        snap = telemetry.snapshot()
+        assert snap["events"]["counts"]["k"] == 1
+        assert snap["compiles"]["total"] == 1
+        json.dumps(snap, allow_nan=False)  # strict-JSON ready
+
+
+# ---------------------------------------------------------------------------
+# profiler strict-JSON fix (satellite)
+# ---------------------------------------------------------------------------
+class TestProfilerStrictJSON:
+    def test_span_with_no_samples_serializes_strict(self):
+        from incubator_mxnet_tpu import profiler
+        profiler.reset_spans()
+        # the pathological entry: a name with zero completed spans used
+        # to leave min_ms=inf -> json "Infinity" token
+        with profiler._SPAN_LOCK:
+            profiler._SPANS["ghost"] = {
+                "kind": "scope", "count": 0, "total_ms": 0.0,
+                "min_ms": float("inf"), "max_ms": 0.0, "samples": []}
+        rec = profiler.span_records()["ghost"]
+        assert rec["min_ms"] == 0.0 and rec["p50_ms"] == 0.0
+        doc = profiler.dumps()
+        json.loads(doc, parse_constant=lambda t: pytest.fail(
+            f"non-strict token {t}"))
+        profiler.reset_spans()
+
+    def test_markers_only_usage_dumps_strict(self):
+        from incubator_mxnet_tpu import profiler
+        profiler.reset_spans()
+        profiler.Marker("m").mark("process")
+        doc = json.loads(profiler.dumps(reset=True))
+        assert doc["markers"][0]["name"] == "m"
+
+    def test_recent_spans_feed_the_trace(self):
+        from incubator_mxnet_tpu import profiler
+        profiler.reset_spans()
+        with profiler.Scope("raw.span"):
+            pass
+        (name, kind, t0, dur) = profiler.recent_spans()[-1]
+        assert name == "raw.span" and kind == "scope"
+        assert t0 > 0 and dur >= 0
+        profiler.reset_spans()
+        assert profiler.recent_spans() == []
+
+
+# ---------------------------------------------------------------------------
+# wired subsystems
+# ---------------------------------------------------------------------------
+def _tiny_net(prefix):
+    net = gluon.nn.HybridSequential(prefix=prefix)
+    with net.name_scope():
+        net.add(gluon.nn.Dense(16, activation="relu", in_units=8))
+        net.add(gluon.nn.Dense(4, in_units=16))
+    net.initialize()
+    return net
+
+
+class TestTrainerWiring:
+    def test_step_events_ledger_and_metrics(self):
+        net = _tiny_net("tele_tw_")
+        tr = parallel.ShardedTrainer(
+            net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+            {"learning_rate": 0.01}, guard=fault.StepGuard(policy="warn"))
+        x = onp.random.randn(8, 8).astype("float32")
+        y = onp.zeros((8,), "int32")
+        for _ in range(3):
+            tr.step(x, y)
+        evs = telemetry.get_events("train.step")
+        assert [e.step for e in evs] == [1, 2, 3]
+        f = evs[-1].fields
+        assert f["wall_ms"] > 0 and "dispatch_ms" in f
+        assert f["loss"] is not None and f["grad_norm"] is not None
+        # exactly one trainer compile, warmup phase
+        assert compile_log.summary()["by_site"]["trainer.step"] == \
+            {"warmup": 1, "post_warmup": 0}
+        text = telemetry.prometheus_text()
+        assert "mxtpu_train_steps_total 3.0" in text
+
+    def test_batch_shape_churn_is_a_post_warmup_compile(self):
+        net = _tiny_net("tele_tc_")
+        tr = parallel.ShardedTrainer(
+            net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+            {"learning_rate": 0.01})
+        tr.step(onp.random.randn(8, 8).astype("float32"),
+                onp.zeros((8,), "int32"))
+        tr.step(onp.random.randn(16, 8).astype("float32"),
+                onp.zeros((16,), "int32"))  # new batch shape: re-trace
+        assert compile_log.post_warmup_compiles("trainer.step") == 1
+        with pytest.raises(mx.MXNetError):
+            compile_log.assert_zero_post_warmup("trainer.step")
+
+
+@pytest.mark.chaos
+class TestChaosTelemetry:
+    """ISSUE 4 satellite: injected faults surface as correlated events."""
+
+    def test_nan_batch_chaos_correlates_with_guard_rollback(self):
+        net = _tiny_net("tele_cn_")
+        guard = fault.StepGuard(policy="skip_and_rollback")
+        tr = parallel.ShardedTrainer(
+            net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+            {"learning_rate": 0.01}, guard=guard)
+        x = onp.random.randn(8, 8).astype("float32")
+        y = onp.zeros((8,), "int32")
+        tr.step(x, y)  # clean warmup step
+        with fault.inject.chaos(seed=7, nan_prob=1.0):
+            tr.step(x, y)  # poisoned -> guard trips -> rollback
+        chaos_evs = [e for e in telemetry.get_events("chaos")
+                     if e.fields["site"] == "nan_batch"]
+        assert len(chaos_evs) == 1
+        guard_evs = telemetry.get_events("guard")
+        assert len(guard_evs) == 1
+        # the SAME step id ties injection to verdict
+        assert chaos_evs[0].step == guard_evs[0].step == 2
+        assert guard_evs[0].fields["policy"] == "skip_and_rollback"
+        step_ev = [e for e in telemetry.get_events("train.step")
+                   if e.step == 2][-1]
+        assert step_ev.fields["rolled_back"] is True
+
+    def test_slow_step_chaos_correlates_with_watchdog(self):
+        net = _tiny_net("tele_cs_")
+        tr = parallel.ShardedTrainer(
+            net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+            {"learning_rate": 0.01})
+        x = onp.random.randn(8, 8).astype("float32")
+        y = onp.zeros((8,), "int32")
+        tr.step(x, y)  # compile step runs un-watched (it is legally slow)
+        tr._watchdog = fault.Watchdog(deadline=0.15)
+        with pytest.warns(UserWarning, match="watchdog"):
+            with fault.inject.chaos(seed=1, slow_prob=1.0, delay_s=0.4):
+                tr.step(x, y)
+        slow = [e for e in telemetry.get_events("chaos")
+                if e.fields["site"] == "slow_step"]
+        wd = telemetry.get_events("watchdog")
+        assert len(slow) == 1 and len(wd) == 1
+        assert slow[0].step == wd[0].step == 2
+
+    def test_kv_drop_chaos_surfaces_correlated_events(self):
+        from incubator_mxnet_tpu.kvstore.async_ps import AsyncKVStore
+        kv = AsyncKVStore()
+        try:
+            a = mx.nd.array(onp.ones((4,), "float32"))
+            kv.init(0, a)
+            with fault.inject.chaos(seed=3, kv_drop=1.0):
+                with telemetry.step_scope(9):
+                    kv.push(0, a)
+                    kv.pull(0, out=a)
+            drops = [e for e in telemetry.get_events("chaos")
+                     if e.fields["site"] == "kv_drop"]
+            assert drops and all(e.step == 9 for e in drops)
+            ok_ops = {e.fields["op"]
+                      for e in telemetry.get_events("kvstore")}
+            assert {"push", "pull"} <= ok_ops
+        finally:
+            kv.close()
+
+    def test_dead_server_surfaces_retry_then_error_events(self, monkeypatch):
+        from incubator_mxnet_tpu.kvstore.async_ps import AsyncKVStore
+        monkeypatch.setenv("MXNET_KVSTORE_RETRIES", "1")
+        monkeypatch.setenv("MXNET_KVSTORE_RETRY_DELAY", "0.01")
+        kv = AsyncKVStore()
+        a = mx.nd.array(onp.ones((4,), "float32"))
+        kv.init(0, a)
+        kv._server.stop()  # sever: every later call must fail over
+        try:
+            with telemetry.step_scope(4):
+                with pytest.raises(mx.MXNetError, match="push"):
+                    kv.push(0, a)
+            retries = [e for e in telemetry.get_events("kvstore")
+                       if e.fields.get("op") == "retry"]
+            errors = [e for e in telemetry.get_events("kvstore")
+                      if e.severity == "error"]
+            assert retries and errors
+            assert all(e.step == 4 for e in retries + errors)
+        finally:
+            kv._server = None   # already stopped; close() must not re-stop
+            kv._client.close()
+
+
+# ---------------------------------------------------------------------------
+# serving wiring + the end-to-end acceptance demo
+# ---------------------------------------------------------------------------
+class TestServeWiring:
+    def test_request_lifecycle_events_carry_request_ids(self):
+        net = _tiny_net("tele_sv_")
+        net.hybridize()
+        net(mx.nd.array(onp.zeros((2, 8), "float32")))
+        table = serve.BucketTable({"batch": (1, 4)})
+        model = serve.CompiledModel(net, table, [{0: "batch"}],
+                                    output_axes=[{0: "batch"}])
+        model.warmup()
+        batcher = serve.DynamicBatcher(model, max_delay_ms=1.0).start()
+        futs = [batcher.submit(onp.random.randn(8).astype("float32"))
+                for _ in range(6)]
+        for f in futs:
+            f.result(timeout=30)
+        batcher.stop()
+        admits = telemetry.get_events("serve.admit")
+        replies = telemetry.get_events("serve.reply")
+        assert len(admits) == 6 and len(replies) == 6
+        assert {e.request_id for e in admits} == \
+            {e.request_id for e in replies}
+        assert all(e.fields["latency_ms"] > 0 for e in replies)
+        ex = telemetry.get_events("serve.execute")
+        assert ex and all(e.fields["bucket"] >= e.fields["size"]
+                          for e in ex)
+        # serve compiles are all warmup (warmed before traffic)
+        assert compile_log.post_warmup_compiles("serve.compiled") == 0
+
+    def test_server_prometheus_cmd(self):
+        net = _tiny_net("tele_sp_")
+        net.hybridize()
+        net(mx.nd.array(onp.zeros((2, 8), "float32")))
+        table = serve.BucketTable({"batch": (1, 2)})
+        reg = serve.ModelRegistry()
+        reg.load("tiny", table=table, input_axes=[{0: "batch"}],
+                 output_axes=[{0: "batch"}], factory=lambda: net)
+        srv = serve.Server(reg).start()
+        try:
+            srv.submit("tiny",
+                       onp.zeros((8,), "float32")).result(timeout=30)
+            reply = serve.client_call(srv.host, srv.port,
+                                      {"cmd": "prometheus"})
+            assert reply["ok"]
+            assert "mxtpu_serve_requests_total" in reply["text"]
+            assert 'model="tiny"' in reply["text"]
+            tele = serve.client_call(srv.host, srv.port,
+                                     {"cmd": "telemetry"})
+            assert tele["ok"] and "compiles" in tele["telemetry"]
+            assert telemetry.get_events("serve.load")
+        finally:
+            srv.stop()
+
+
+@pytest.mark.lint
+class TestTelemetryLint:
+    """MX601 — ad-hoc timing/counters instead of mx.telemetry."""
+
+    FIXTURES = os.path.join(os.path.dirname(__file__), "lint_fixtures")
+
+    def test_seeded_fixture_exactly_mx601(self):
+        from incubator_mxnet_tpu.analysis import lint_file
+        rep = lint_file(os.path.join(self.FIXTURES, "adhoc_timing.py"))
+        assert rep.codes() == ["MX601"]
+        (d,) = rep.diagnostics
+        assert d.severity == "warning" and d.pass_name == "telemetry_lint"
+        assert "telemetry" in d.message
+
+    def test_telemetry_evidence_silences(self):
+        from incubator_mxnet_tpu.analysis import telemetry_lint
+        src = ("import time\n"
+               "from incubator_mxnet_tpu import telemetry\n"
+               "def loop(trainer, batches):\n"
+               "    for x, y in batches:\n"
+               "        t0 = time.perf_counter()\n"
+               "        trainer.step(x, y)\n"
+               "        telemetry.emit('train.step', wall_ms="
+               "(time.perf_counter() - t0) * 1e3)\n")
+        assert telemetry_lint.lint_source(src).codes() == []
+
+    def test_serving_entry_point_flagged(self):
+        from incubator_mxnet_tpu.analysis import telemetry_lint
+        src = ("import time\n"
+               "def predict(x):\n"
+               "    t0 = time.time()\n"
+               "    out = model(x)\n"
+               "    latency = time.time() - t0\n"
+               "    return out\n")
+        rep = telemetry_lint.lint_source(src)
+        assert rep.codes() == ["MX601"]
+        assert rep.diagnostics[0].op == "predict"
+
+    def test_non_loop_non_entry_timing_is_fine(self):
+        from incubator_mxnet_tpu.analysis import telemetry_lint
+        src = ("import time\n"
+               "def bench():\n"
+               "    t0 = time.perf_counter()\n"
+               "    work()\n"
+               "    return time.perf_counter() - t0\n")
+        assert telemetry_lint.lint_source(src).codes() == []
+
+    def test_in_tree_runtime_is_clean(self):
+        from incubator_mxnet_tpu.analysis import telemetry_lint
+        rep = telemetry_lint.lint_paths(
+            ["incubator_mxnet_tpu/models", "examples", "benchmark"])
+        assert rep.codes() == []
+
+
+class TestEndToEndDemo:
+    """The ISSUE 4 acceptance criterion, asserted on examples/telemetry.py."""
+
+    def test_demo_produces_stream_scrape_and_clean_ledger(self, tmp_path):
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "example_telemetry",
+            os.path.join(os.path.dirname(__file__), os.pardir,
+                         "examples", "telemetry.py"))
+        demo = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(demo)
+
+        jsonl = str(tmp_path / "events.jsonl")
+        prom_path = str(tmp_path / "scrape.prom")
+        trace_path = str(tmp_path / "trace.json")
+        rc = demo.main(["--steps", "3", "--requests", "12",
+                        "--batch", "8", "--max-batch", "4",
+                        "--jsonl", jsonl, "--prom", prom_path,
+                        "--trace", trace_path,
+                        "--ckpt-dir", str(tmp_path / "ckpts")])
+        assert rc == 0
+
+        # 1. valid strict-JSON event stream with correlation ids
+        lines = open(jsonl).read().splitlines()
+        assert check_stream(lines, "demo") == []
+        evs = [json.loads(l) for l in lines]
+        train_steps = {e["step"] for e in evs
+                       if e["kind"] == "train.step"}
+        assert train_steps == {1, 2, 3}
+        reply_ids = {e["request_id"] for e in evs
+                     if e["kind"] == "serve.reply"}
+        admit_ids = {e["request_id"] for e in evs
+                     if e["kind"] == "serve.admit"}
+        assert len(reply_ids) == 12 and reply_ids <= admit_ids
+
+        # 2. one Prometheus scrape carrying training AND serving counters
+        prom = open(prom_path).read()
+        assert "mxtpu_train_steps_total 3.0" in prom
+        assert "mxtpu_serve_requests_total" in prom
+        assert "mxtpu_compiles_total" in prom
+
+        # 3. compile ledger: every compile warmup-phase, zero post-warmup
+        compiles = [e for e in evs if e["kind"] == "compile"]
+        assert compiles and all(e["fields"]["warmup"] for e in compiles)
+        compile_log.assert_zero_post_warmup()
+
+        # the merged chrome trace is loadable and two-source
+        trace = json.loads(open(trace_path).read())
+        phases = {e["ph"] for e in trace["traceEvents"]}
+        assert {"X", "i"} <= phases

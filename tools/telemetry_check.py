@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""Validate a telemetry JSON-lines event stream — the CI gate.
+
+The ``telemetry-smoke`` CI job runs the serving bench with
+``MXTPU_TELEMETRY_JSONL`` set and replays the stream through this
+checker, which fails (exit 1) when:
+
+- any line is not STRICT JSON (``NaN``/``Infinity`` tokens rejected — the
+  bug class the sanitizing serializer exists to prevent), or not an
+  object carrying the event envelope (``seq``/``kind``/``ts``);
+- any ``seq`` repeats (stream corruption / double-installed sinks).
+  Concurrent emitters may land slightly out of file order — that is
+  legal; duplication is not;
+- any ``compile`` event is post-warmup (``fields.warmup == false``) —
+  the zero-unexpected-recompile contract, now enforceable from the
+  *stream*, not just in-process counters.
+
+    python tools/telemetry_check.py events.jsonl [more.jsonl ...]
+    python tools/telemetry_check.py --allow-post-warmup events.jsonl
+
+Exit: 0 clean, 1 violations, 2 bad invocation / unreadable file.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List
+
+REQUIRED_KEYS = ("seq", "kind", "ts")
+
+
+def _reject_nonfinite(token: str):
+    raise ValueError(f"non-finite JSON token {token!r}")
+
+
+def check_stream(lines, name: str = "<stream>",
+                 allow_post_warmup: bool = False) -> List[str]:
+    """Returns a list of violation strings (empty = clean)."""
+    problems: List[str] = []
+    seen_seqs = set()
+    n = 0
+    for i, raw in enumerate(lines, 1):
+        raw = raw.strip()
+        if not raw:
+            continue
+        n += 1
+        try:
+            # parse_constant intercepts NaN/Infinity/-Infinity, which
+            # json.loads would otherwise happily accept
+            ev = json.loads(raw, parse_constant=_reject_nonfinite)
+        except ValueError as e:
+            problems.append(f"{name}:{i}: malformed JSON line: {e}")
+            continue
+        if not isinstance(ev, dict):
+            problems.append(f"{name}:{i}: not a JSON object")
+            continue
+        missing = [k for k in REQUIRED_KEYS if k not in ev]
+        if missing:
+            problems.append(f"{name}:{i}: missing envelope keys {missing}")
+            continue
+        if not isinstance(ev["seq"], int) or ev["seq"] < 1:
+            problems.append(f"{name}:{i}: bad seq {ev['seq']!r}")
+        elif ev["seq"] in seen_seqs:
+            problems.append(f"{name}:{i}: duplicate seq {ev['seq']} "
+                            "(corrupt stream or double-installed sink)")
+        else:
+            seen_seqs.add(ev["seq"])
+        if ev["kind"] == "compile" and not allow_post_warmup \
+                and ev.get("fields", {}).get("warmup") is False:
+            f = ev.get("fields", {})
+            problems.append(
+                f"{name}:{i}: POST-WARMUP COMPILE at site "
+                f"{f.get('site')!r} (signature {f.get('signature')!r}, "
+                f"step {ev.get('step')}) — the zero-unexpected-recompile "
+                "contract is violated")
+    if n == 0:
+        problems.append(f"{name}: stream is empty (telemetry was not "
+                        "emitting — is MXTPU_TELEMETRY_JSONL set and the "
+                        "bus enabled?)")
+    return problems
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("paths", nargs="+", help="JSON-lines files to check")
+    ap.add_argument("--allow-post-warmup", action="store_true",
+                    help="do not fail on post-warmup compile events "
+                         "(streams from warmup-free workloads)")
+    args = ap.parse_args(argv)
+
+    problems: List[str] = []
+    total_lines = 0
+    for path in args.paths:
+        try:
+            with open(path, encoding="utf-8") as f:
+                lines = f.readlines()
+        except OSError as e:
+            print(f"telemetry_check: cannot read {path}: {e}",
+                  file=sys.stderr)
+            return 2
+        total_lines += len(lines)
+        problems.extend(check_stream(
+            lines, name=path, allow_post_warmup=args.allow_post_warmup))
+    for p in problems:
+        print(p, file=sys.stderr)
+    print(f"telemetry_check: {total_lines} line(s) across "
+          f"{len(args.paths)} file(s), {len(problems)} violation(s)")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
