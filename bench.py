@@ -39,8 +39,48 @@ def _peak_tflops() -> float:
     return 459.0
 
 
-def _arm_watchdog() -> None:
-    """Fail loudly instead of hanging forever if the TPU tunnel is wedged
+_DEFAULT_MODEL = {"resnet": "resnet50_v1", "bert": "bert_12_768_12"}
+
+
+def _bench_workload() -> str:
+    """THE workload resolution main() uses, shared with the watchdog
+    abort record so they can't drift."""
+    return os.environ.get("MXTPU_BENCH_WORKLOAD", "bert")
+
+
+def _bench_model(workload: str):
+    """THE workload→model resolution main() uses, shared with the
+    watchdog abort record so they can't drift. ssd/frcnn run the fixed
+    in-tree model and ignore MXTPU_BENCH_MODEL (returns None)."""
+    if workload not in _DEFAULT_MODEL:
+        return None
+    return os.environ.get("MXTPU_BENCH_MODEL", _DEFAULT_MODEL[workload])
+
+
+def _watchdog_record(budget: int) -> dict:
+    """The structured abort record the watchdog prints as its last stdout
+    line: harnesses that parse one-JSON-line-per-run see a machine-readable
+    ``{"error": "device_init_timeout"}`` instead of ``parsed: null``, so a
+    wedged TPU tunnel (rc=75, see BENCH_r05.json) is distinguishable from
+    "produced no data"."""
+    workload = _bench_workload()
+    model = _bench_model(workload)
+    return {
+        "error": "device_init_timeout",
+        "metric": None,
+        "value": None,
+        "unit": None,
+        "vs_baseline": None,
+        "extra": {"timeout_s": budget, "rc": 75, "workload": workload,
+                  "model": model},
+    }
+
+
+def _arm_watchdog():
+    """Arm and return the watchdog timer (None when disabled) — callers
+    cancel it once the device proves alive (see ``_measure``).
+
+    Fail loudly instead of hanging forever if the TPU tunnel is wedged
     (device init blocks indefinitely when the pool grant is stuck).
     MXTPU_BENCH_TIMEOUT seconds, default 1500; 0 disables.
 
@@ -60,6 +100,10 @@ def _arm_watchdog() -> None:
             f"bench.py watchdog: no result within {budget}s — the TPU "
             "tunnel/device init is likely wedged; aborting.\n")
         sys.stderr.flush()
+        # the one JSON line the bench harness parses: a structured abort
+        # record, not silence
+        sys.stdout.write(json.dumps(_watchdog_record(budget)) + "\n")
+        sys.stdout.flush()
         os._exit(75)  # EX_TEMPFAIL
 
     t = threading.Timer(budget, _fire)
@@ -108,7 +152,7 @@ def run_resnet(watchdog) -> dict:
     from incubator_mxnet_tpu import gluon, parallel
     from incubator_mxnet_tpu.gluon.model_zoo import vision
 
-    model_name = os.environ.get("MXTPU_BENCH_MODEL", "resnet50_v1")
+    model_name = _bench_model("resnet")
     if model_name not in _RESNET_FWD_GMACS_224:    # before any device work
         raise SystemExit(
             f"MXTPU_BENCH_MODEL={model_name!r} has no FLOP table entry; "
@@ -299,7 +343,7 @@ def run_frcnn(watchdog) -> dict:
 
 def main() -> None:
     watchdog = _arm_watchdog()
-    workload = os.environ.get("MXTPU_BENCH_WORKLOAD", "bert")
+    workload = _bench_workload()
     if workload == "resnet":
         print(json.dumps(run_resnet(watchdog)))
         return
@@ -313,7 +357,7 @@ def main() -> None:
     import incubator_mxnet_tpu as mx
     from incubator_mxnet_tpu import models, parallel
 
-    model_name = os.environ.get("MXTPU_BENCH_MODEL", "bert_12_768_12")
+    model_name = _bench_model("bert")
     B = int(os.environ.get("MXTPU_BENCH_BATCH", "8"))
     L = int(os.environ.get("MXTPU_BENCH_SEQ", "512"))
     peak_tflops = _peak_tflops()

@@ -199,6 +199,26 @@ def main(argv=None) -> int:
     model = serve.CompiledModel(
         net, table, spec["input_axes"], output_axes=spec["output_axes"],
         pad_values=spec["pad_values"])
+    # staging-time compiled-graph lint (the same gate ModelRegistry.load
+    # applies): trace-only, so it runs before the first warmup compile;
+    # cover every bucket so the record can't claim more than it checked
+    from incubator_mxnet_tpu.analysis import hlo as _hlo
+    analysis_rep = _hlo.verify(model,
+                               max_graphs=max(8, table.num_buckets()))
+    if analysis_rep.errors:
+        # fail in seconds, not after the full warmup + 1k-request run —
+        # same staging semantics as ModelRegistry.load
+        print(json.dumps({
+            "metric": f"serve_{args.model}_throughput_req_per_sec",
+            "value": None, "unit": "req/sec", "vs_baseline": None,
+            "error": "analysis_failed",
+            "extra": {"model": args.model,
+                      "analysis": analysis_rep.summary_dict()}}))
+        print("serve_bench: analysis.hlo found "
+              f"{len(analysis_rep.errors)} error-severity MX7xx "
+              f"finding(s): {[d.code for d in analysis_rep.errors]}",
+              file=sys.stderr)
+        return 1
     t0 = time.perf_counter()
     warm = model.warmup()
     profiler.reset_spans()
@@ -224,6 +244,7 @@ def main(argv=None) -> int:
             "dynamic": dyn,
             "stage_spans": {k: spans[k] for k in sorted(spans)
                             if k.startswith("serve.")},
+            "analysis": analysis_rep.summary_dict(),
             "wall_total_s": round(time.perf_counter() - t0, 1),
         },
     }

@@ -17,7 +17,12 @@ tracer lint + recompile   jit hygiene (AST + runtime), MX2xx
 fault lint                checkpoint hygiene (AST), MX4xx
 serve lint                serving/jit-cache hygiene (AST), MX5xx
 telemetry lint            observability hygiene (AST), MX6xx
+``hlo`` passes            compiled-graph (jaxpr/StableHLO), MX7xx
 ========================  ===========================================
+
+Source lints honor inline suppressions (``# mxlint: disable=MX204`` on
+the flagged line, ``# mxlint: disable-file=MX501`` anywhere) so
+reference-parity idioms the AST rules misread are annotated in place.
 
 Programmatic entry point::
 
@@ -32,7 +37,10 @@ from __future__ import annotations
 
 from typing import Dict, Optional, Sequence
 
-from .diagnostics import CODES, Diagnostic, Report  # noqa: F401
+from .diagnostics import (  # noqa: F401
+    CODES, DEFAULT_SEVERITY, Diagnostic, Report, apply_suppressions,
+    default_severity, parse_suppressions,
+)
 from .passes import (  # noqa: F401
     PASSES, GraphPass, PassContext, get_pass, list_passes, register_pass,
     run_passes,
@@ -49,17 +57,20 @@ from . import tracer_lint  # noqa: F401
 from .recompile import (  # noqa: F401
     RECOMPILE_WARN_THRESHOLD, RecompileWarning, cache_report, note_compile,
 )
+from . import hlo  # noqa: F401  (registers the MX7xx compiled-graph passes)
 
 
 def lint_source(src, filename: str = "<string>") -> Report:
     """Source lint = tracer hygiene (MX2xx) + fault hygiene (MX4xx) +
     serving hygiene (MX5xx) + observability hygiene (MX6xx), one merged
-    Report (the ``mxlint`` Python-target entry point)."""
+    Report (the ``mxlint`` Python-target entry point). Inline
+    ``# mxlint: disable=`` markers are applied once, here, for every
+    family."""
     report = tracer_lint.lint_source(src, filename)
     report.extend(fault_lint.lint_source(src, filename))
     report.extend(serve_lint.lint_source(src, filename))
     report.extend(telemetry_lint.lint_source(src, filename))
-    return report
+    return apply_suppressions(report, src)
 
 
 def lint_file(path: str) -> Report:
@@ -73,10 +84,12 @@ def lint_paths(paths) -> Report:
     from .diagnostics import walk_lint
     return walk_lint(paths, lint_file)
 
-__all__ = ["verify", "Report", "Diagnostic", "CODES", "register_pass",
+__all__ = ["verify", "Report", "Diagnostic", "CODES", "DEFAULT_SEVERITY",
+           "default_severity", "register_pass",
            "list_passes", "run_passes", "PassContext", "tensor_arity",
            "check_sharding", "lint_source", "lint_file", "lint_paths",
-           "cache_report", "RecompileWarning", "RECOMPILE_WARN_THRESHOLD"]
+           "cache_report", "RecompileWarning", "RECOMPILE_WARN_THRESHOLD",
+           "hlo", "parse_suppressions", "apply_suppressions"]
 
 
 def verify(sym, shapes: Optional[Dict[str, tuple]] = None,

@@ -10,10 +10,13 @@ aggregates them for programmatic use (``mx.analysis.verify``) and for the
 """
 from __future__ import annotations
 
+import re
 from dataclasses import dataclass, field
-from typing import List, Optional
+from typing import Dict, List, Optional, Set, Tuple
 
-__all__ = ["Diagnostic", "Report", "CODES", "walk_lint"]
+__all__ = ["Diagnostic", "Report", "CODES", "DEFAULT_SEVERITY",
+           "default_severity", "walk_lint", "parse_suppressions",
+           "apply_suppressions"]
 
 
 def walk_lint(paths, lint_file) -> "Report":
@@ -68,7 +71,45 @@ CODES = {
     "MX601": "training loop / serving entry point builds ad-hoc timing or "
              "counters instead of mx.telemetry (invisible to the unified "
              "event bus, metrics scrape, and snapshot)",
+    "MX701": "host<->device transfer inside a jitted region (callback / "
+             "device_put round-trip per executed step)",
+    "MX702": "unintended f64/widening float promotion in the compiled "
+             "graph (strongly-typed scalar or x64 leak)",
+    "MX703": "dead compute or unused parameter in the compiled graph "
+             "(transferred and compiled, never read by any output)",
+    "MX704": "missed buffer-donation opportunity (input dropped after "
+             "last read but not donated; an output aval matches)",
+    "MX705": "large constant baked into the compiled graph (>1 MiB "
+             "literal; should ride as an argument)",
+    "MX706": "trace-signature divergence: call sites of one model lower "
+             "to different signatures (static twin of the telemetry "
+             "compile ledger)",
 }
+
+#: Default severity per code — THE single source of truth the passes,
+#: the mxlint ``--format=json`` output, and the generated docs share.
+#: A pass may still override per finding (e.g. MX302 is an error for a
+#: rank mismatch but a warning for an indivisible dim); a
+#: :class:`Diagnostic` constructed without an explicit severity takes
+#: the registry value. Audited by tests/test_analysis.py: every code has
+#: exactly one entry, families are contiguous, values are valid.
+DEFAULT_SEVERITY: Dict[str, str] = {
+    "MX001": "error", "MX002": "error", "MX003": "error", "MX004": "error",
+    "MX005": "error", "MX006": "error", "MX007": "error", "MX008": "error",
+    "MX101": "error",
+    "MX200": "error", "MX201": "warning", "MX202": "error", "MX203": "error",
+    "MX204": "error", "MX205": "error", "MX206": "error",
+    "MX301": "error", "MX302": "error", "MX303": "error",
+    "MX401": "warning",
+    "MX501": "warning", "MX502": "warning",
+    "MX601": "warning",
+    "MX701": "error", "MX702": "warning", "MX703": "warning",
+    "MX704": "warning", "MX705": "error", "MX706": "warning",
+}
+
+
+def default_severity(code: str) -> str:
+    return DEFAULT_SEVERITY.get(code, "error")
 
 
 @dataclass
@@ -88,17 +129,39 @@ class Diagnostic:
     op: Optional[str] = None
     attrs: Optional[dict] = None
     pass_name: str = ""
-    severity: str = "error"  # "error" | "warning"
+    #: "error" | "warning"; None = take DEFAULT_SEVERITY[code]
+    severity: Optional[str] = None
 
     def __post_init__(self):
         if self.code not in CODES:
             raise ValueError(f"unknown diagnostic code {self.code!r}; "
                              f"register it in analysis.diagnostics.CODES")
+        if self.severity is None:
+            self.severity = default_severity(self.code)
 
     def __str__(self):
         where = self.node or "<graph>"
         op = f" (op {self.op!r})" if self.op else ""
         return f"{where}: {self.code}{op}: {self.message}"
+
+    def as_dict(self) -> dict:
+        """Machine form for ``mxlint --format=json``: one flat object per
+        finding. ``file``/``line`` are filled only for path-shaped
+        provenance (``file:line`` from source lints, or a lint target
+        path) so a CI annotator never targets a nonexistent path;
+        graph-shaped provenance (``Model[bucket]``, node names) rides in
+        ``node``, which always carries the raw value."""
+        node = self.node or ""
+        file, line = "", 0
+        m = re.match(r"^(.*):(\d+)$", node)
+        if m and not m.group(1).startswith("<"):   # '<string>:4' is not a path
+            file, line = m.group(1), int(m.group(2))
+        elif "/" in node or node.endswith((".py", ".json")):
+            file = node           # a lint target path without a line
+        return {"file": file, "line": line, "node": node,
+                "code": self.code, "severity": self.severity,
+                "message": self.message, "pass": self.pass_name,
+                "op": self.op}
 
 
 @dataclass
@@ -131,6 +194,15 @@ class Report:
     def codes(self) -> List[str]:
         return [d.code for d in self.diagnostics]
 
+    def summary_dict(self) -> dict:
+        """THE machine summary every staging gate records (the registry's
+        ``serve.analysis`` telemetry event, serve_bench's JSON) — one
+        projection, so the records can't drift."""
+        return {"errors": len(self.errors),
+                "warnings": len(self.warnings),
+                "codes": sorted({d.code for d in self.diagnostics}),
+                "skipped": list(self.skipped)}
+
     def raise_if_errors(self) -> "Report":
         if self.errors:
             from ..base import MXNetError
@@ -149,3 +221,73 @@ class Report:
         if not self.diagnostics:
             return "clean (0 diagnostics)"
         return "\n".join(str(d) for d in self.diagnostics)
+
+
+# ---------------------------------------------------------------------------
+# inline suppressions (the clang-tidy NOLINT analogue)
+# ---------------------------------------------------------------------------
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*mxlint:\s*disable(-file)?\s*=\s*([A-Z]{2}\d{3}(?:\s*,\s*[A-Z]{2}\d{3})*)")
+
+
+def parse_suppressions(src: str) -> Tuple[Set[str], Dict[int, Set[str]]]:
+    """Scan one source blob for ``# mxlint: disable=MXnnn[,MXnnn]``
+    (same-line) and ``# mxlint: disable-file=MXnnn[,...]`` (whole file)
+    markers. Returns ``(file_level_codes, {lineno: codes})``.
+
+    Only REAL ``#`` comments count — the marker inside a string literal
+    or docstring (e.g. documentation *about* suppressions) must not
+    disable anything, so the scan tokenizes rather than grepping lines.
+    A trailing comment on a statement wrapped across lines registers for
+    the whole logical line (AST nodes report the statement's FIRST line;
+    the comment sits on the last). A file that cannot be tokenized
+    yields no suppressions (its only diagnostic is MX200 anyway)."""
+    import io
+    import tokenize
+
+    file_level: Set[str] = set()
+    by_line: Dict[int, Set[str]] = {}
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(src).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return file_level, by_line
+    _skip = {tokenize.NEWLINE, tokenize.NL, tokenize.COMMENT,
+             tokenize.INDENT, tokenize.DEDENT, tokenize.ENCODING}
+    logical_start = None
+    for tok in tokens:
+        if tok.type == tokenize.NEWLINE:
+            logical_start = None
+        elif logical_start is None and tok.type not in _skip:
+            logical_start = tok.start[0]
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _SUPPRESS_RE.search(tok.string)
+        if not m:
+            continue
+        codes = {c.strip() for c in m.group(2).split(",")}
+        if m.group(1):
+            file_level |= codes
+        else:
+            for line in {tok.start[0], logical_start or tok.start[0]}:
+                by_line.setdefault(line, set()).update(codes)
+    return file_level, by_line
+
+
+def apply_suppressions(report: "Report", src: str) -> "Report":
+    """Drop diagnostics whose ``file:line`` provenance carries a matching
+    inline suppression. Source-lint families call this once per file so
+    framework-internal idioms (reference-parity code the AST rules
+    misread) stay annotated in place rather than special-cased in the
+    linter."""
+    file_level, by_line = parse_suppressions(src)
+    if not file_level and not by_line:
+        return report
+    kept = Report(skipped=list(report.skipped))
+    for d in report.diagnostics:
+        m = re.match(r"^.*:(\d+)$", d.node or "")
+        line = int(m.group(1)) if m else 0
+        if d.code in file_level or d.code in by_line.get(line, ()):
+            continue
+        kept.add(d)
+    return kept

@@ -1,0 +1,71 @@
+"""``mx.analysis.hlo`` — compiled-graph inspection passes (MX7xx).
+
+mxlint (MX2xx–MX6xx) sees Python ASTs; the telemetry compile ledger sees
+recompiles only after they burn device wall-time. This layer closes the
+gap: it traces any model entry point to the artifact the TPU actually
+runs — a jaxpr plus (lazily) lowered StableHLO — and inspects it *before
+the first device step*. Entry points: a live ``HybridBlock``, a
+``serve.CompiledModel`` (per bucket), a ``SymbolBlock`` export artifact
+(per baked signature), a ``parallel.ShardedTrainer`` step, or any plain
+callable with sample args.
+
+Programmatic entry point (called by ``serve.ModelRegistry.load`` and
+``benchmark/serve_bench.py`` at staging time)::
+
+    report = mx.analysis.hlo.verify(model, sample_args)
+    report.raise_if_errors()
+
+CLI::
+
+    python -m tools.mxlint --hlo all --format=json
+    python -m tools.mxlint --hlo bert_encoder
+    python -m tools.mxlint --hlo my_pkg.my_mod:factory
+
+Pass registry (the compiled-graph sibling of ``analysis/passes.py``):
+``HLO_PASSES``, extendable with :func:`register_hlo_pass`.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from ..diagnostics import Report
+from .passes import (  # noqa: F401
+    HLO_PASSES, HloPassContext, list_hlo_passes, register_hlo_pass,
+    run_hlo_passes,
+)
+from .trace import (  # noqa: F401
+    TracedGraph, TraceResult, trace_entry, walk_eqns,
+)
+
+__all__ = ["verify", "trace_entry", "TracedGraph", "TraceResult",
+           "HLO_PASSES", "register_hlo_pass", "list_hlo_passes",
+           "run_hlo_passes", "walk_eqns"]
+
+
+def verify(model, sample_args=None, *,
+           passes: Optional[Sequence[str]] = None,
+           max_graphs: int = 8,
+           const_limit_bytes: int = 1 << 20,
+           donation_min_bytes: int = 1 << 16) -> Report:
+    """Trace ``model`` (every bucket/signature/call site, capped at
+    ``max_graphs``) and run the registered MX7xx passes; returns the
+    merged :class:`~..diagnostics.Report`.
+
+    ``sample_args``: one tuple of arrays (one call site) or a list of
+    tuples (several call sites — MX706 compares their lowered
+    signatures). Optional for entries that carry their own signatures
+    (a hybridized block with a recorded forward, a CompiledModel's
+    bucket table, an export artifact). A block that has never run a
+    forward is warmed with one eager call on the first sample site —
+    the same signature-establishing contract as
+    ``CompiledModel(example_args=...)`` — which mutates the block
+    (hybridize + deferred parameter init).
+    """
+    result = trace_entry(model, sample_args, max_graphs=max_graphs)
+    report = run_hlo_passes(result.graphs, names=passes,
+                            const_limit_bytes=const_limit_bytes,
+                            donation_min_bytes=donation_min_bytes)
+    for d in result.diags:
+        report.add(d)
+    report.skipped.extend(result.skipped)
+    return report

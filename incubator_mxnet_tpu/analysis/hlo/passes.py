@@ -1,0 +1,435 @@
+"""MX7xx inspection passes over traced compiled graphs.
+
+Each pass is ``fn(HloPassContext) -> None`` over the full list of
+:class:`~.trace.TracedGraph` records (MX706 needs the cross-site view;
+the others iterate per graph), appending
+:class:`~..diagnostics.Diagnostic` rows. Registered in ``HLO_PASSES`` —
+the compiled-graph sibling of the Symbol pass registry in
+``analysis/passes.py``.
+
+==========  =============================================================
+``MX701``   host↔device round-trip inside the jitted region (callbacks;
+            ``device_put`` hints as warnings)
+``MX702``   unintended f64 / widening float promotion in an inference
+            graph (the classic strong-``np.float32``-scalar leak)
+``MX703``   dead compute and unused parameters (wasted transfer + FLOPs)
+``MX704``   droppable input buffer not donated though an output aval
+            matches (serve request buffers, optimizer states)
+``MX705``   large constant baked into the graph (>1 MiB literal)
+``MX706``   trace-signature divergence across call sites — the static
+            twin of the telemetry compile ledger
+==========  =============================================================
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+import numpy as onp
+
+from ..diagnostics import Diagnostic, Report
+from .trace import TracedGraph, _jaxprs_in, _sig_str, walk_eqns
+
+__all__ = ["HLO_PASSES", "HloPassContext", "register_hlo_pass",
+           "list_hlo_passes", "run_hlo_passes"]
+
+#: callback primitives = a host round-trip per executed step
+_CALLBACK_PRIMS = {"pure_callback", "io_callback", "debug_callback",
+                   "callback", "outside_call", "host_callback_call"}
+#: transfer hints worth a warning (placement churn inside jit); plain
+#: `copy` is a device-local buffer copy XLA elides, so it is NOT here
+_TRANSFER_PRIMS = {"device_put"}
+
+
+@dataclass
+class HloPassContext:
+    graphs: List[TracedGraph]
+    report: Report = field(default_factory=Report)
+    #: knobs: const_limit_bytes, donation_min_bytes
+    options: Dict[str, object] = field(default_factory=dict)
+    #: set by run_hlo_passes around each pass (context-local, so
+    #: concurrent verify() calls can't corrupt each other's provenance)
+    pass_name: str = ""
+
+    def opt(self, name: str, default):
+        return self.options.get(name, default)
+
+    def diag(self, code: str, message: str, graph: TracedGraph = None,
+             op: Optional[str] = None, severity: Optional[str] = None,
+             node: Optional[str] = None) -> None:
+        self.report.add(Diagnostic(
+            code, message, node=node or (graph.label if graph else None),
+            op=op, pass_name=self.pass_name, severity=severity))
+
+
+@dataclass
+class HloPass:
+    name: str
+    fn: Callable[[HloPassContext], None]
+    describe: str = ""
+
+    def __call__(self, ctx: HloPassContext) -> None:
+        self.fn(ctx)
+
+
+HLO_PASSES: "OrderedDict[str, HloPass]" = OrderedDict()
+
+
+def register_hlo_pass(name: Optional[str] = None, describe: str = ""):
+    def _do(fn):
+        pname = name or fn.__name__
+        HLO_PASSES[pname] = HloPass(
+            pname, fn, describe or (fn.__doc__ or "").split("\n")[0])
+        return fn
+    return _do
+
+
+def list_hlo_passes() -> List[str]:
+    return list(HLO_PASSES)
+
+
+def run_hlo_passes(graphs: List[TracedGraph], names=None,
+                   **options) -> Report:
+    ctx = HloPassContext(list(graphs), options=options)
+    for name in (names if names is not None else list_hlo_passes()):
+        if name not in HLO_PASSES:
+            from ...base import MXNetError
+            raise MXNetError(f"unknown hlo pass {name!r}; registered: "
+                             f"{list_hlo_passes()}")
+        ctx.pass_name = name
+        try:
+            HLO_PASSES[name](ctx)
+        finally:
+            ctx.pass_name = ""
+    return ctx.report
+
+
+# ---------------------------------------------------------------------------
+# jaxpr utilities
+# ---------------------------------------------------------------------------
+
+def _is_literal(v) -> bool:
+    return type(v).__name__ == "Literal"
+
+
+def _np_dtype(dtype):
+    """numpy dtype or None (extended dtypes like PRNG keys don't map)."""
+    try:
+        return onp.dtype(dtype)
+    except TypeError:
+        return None
+
+
+def _float_bits(dtype) -> int:
+    d = _np_dtype(dtype)
+    if d is None:
+        return 0
+    if d.kind == "f":
+        return d.itemsize * 8
+    if d.kind == "c":
+        return d.itemsize * 4            # per-component width
+    return 0
+
+
+def _liveness(jaxpr):
+    """Backward sweep: (needed var set, dead eqn list). Effectful eqns are
+    always live; literals never carry liveness."""
+    needed = {v for v in jaxpr.outvars if not _is_literal(v)}
+    dead = []
+    for eqn in reversed(jaxpr.eqns):
+        if getattr(eqn, "effects", None) or any(
+                o in needed for o in eqn.outvars):
+            for iv in eqn.invars:
+                if not _is_literal(iv):
+                    needed.add(iv)
+        else:
+            dead.append(eqn)
+    return needed, list(reversed(dead))
+
+
+def _key_reach(jaxpr, seed_invars):
+    """Vars tainted by RNG-key / step-counter plumbing (``random_wrap`` of
+    an unused key, ``fold_in(key, t)``, dtype converts of ``t``) — dead
+    eqns whose outputs all live here are bookkeeping, not wasted model
+    compute."""
+    reach = set(seed_invars)
+    for eqn in jaxpr.eqns:
+        if any(not _is_literal(v) and v in reach for v in eqn.invars):
+            reach.update(eqn.outvars)
+    return reach
+
+
+# ---------------------------------------------------------------------------
+# MX701 — host transfer inside the jitted region
+# ---------------------------------------------------------------------------
+
+@register_hlo_pass("hlo_transfer",
+                   describe="host↔device transfer inside a jitted region "
+                            "(callbacks, device_put), MX701")
+def hlo_transfer(ctx: HloPassContext) -> None:
+    def scan(jaxpr, live, cbs, moves):
+        # forward reach from the invars: a device_put of a *constant* is
+        # materialization XLA hoists once, not a per-step transfer — only
+        # moves of live (invar-derived) data count. Sub-jaxprs (scan/cond
+        # bodies) are entered with their invars live whenever the
+        # enclosing eqn consumes live data (conservative).
+        reach = set(live)
+        for eqn in jaxpr.eqns:
+            live_in = any(not _is_literal(v) and v in reach
+                          for v in eqn.invars)
+            if live_in:
+                reach.update(eqn.outvars)
+            name = eqn.primitive.name
+            if name in _CALLBACK_PRIMS or name.endswith("_callback"):
+                cbs.append(eqn)
+            elif name in _TRANSFER_PRIMS and live_in:
+                moves.append(eqn)
+            for v in eqn.params.values():
+                for sub in _jaxprs_in(v):
+                    scan(sub, set(sub.invars) if live_in else set(),
+                         cbs, moves)
+
+    for g in ctx.graphs:
+        cbs, moves = [], []
+        scan(g.closed.jaxpr, set(g.closed.jaxpr.invars), cbs, moves)
+        for eqn in cbs[:3]:
+            ctx.diag("MX701",
+                     f"'{eqn.primitive.name}' inside the compiled graph: "
+                     "every executed step round-trips to the host "
+                     "(device→host sync + Python + host→device) — move "
+                     "the computation into the graph or outside the jit "
+                     "boundary", g, op=eqn.primitive.name, severity="error")
+        if len(cbs) > 3:
+            ctx.diag("MX701", f"{len(cbs) - 3} more host-callback site(s) "
+                     "in the same graph", g, severity="error")
+        for eqn in moves[:1]:
+            ctx.diag("MX701",
+                     f"'{eqn.primitive.name}' inside the compiled graph "
+                     f"({len(moves)} site(s)): placement/layout churn the "
+                     "compiler must materialize — prefer sharding "
+                     "constraints or pre-placing inputs", g,
+                     op=eqn.primitive.name, severity="warning")
+
+
+# ---------------------------------------------------------------------------
+# MX702 — unintended f64 / widening promotion
+# ---------------------------------------------------------------------------
+
+@register_hlo_pass("hlo_promotion",
+                   describe="unintended f64/widening float promotion, MX702")
+def hlo_promotion(ctx: HloPassContext) -> None:
+    for g in ctx.graphs:
+        jaxpr = g.closed.jaxpr
+        in_bits = [_float_bits(v.aval.dtype)
+                   for v, r in zip(jaxpr.invars, g.roles)
+                   if r in ("input", "param") and hasattr(v.aval, "dtype")]
+        max_in = max([b for b in in_bits if b], default=0)
+        f64 = None
+        for eqn in walk_eqns(jaxpr):
+            for o in eqn.outvars:
+                d = _np_dtype(o.aval.dtype) \
+                    if hasattr(o.aval, "dtype") else None
+                if d is not None and d.name in ("float64", "complex128"):
+                    f64 = eqn
+                    break
+            if f64 is not None:
+                break
+        if f64 is not None and max_in < 64:
+            ctx.diag("MX702",
+                     f"'{f64.primitive.name}' produces float64 but no "
+                     "model input/parameter is 64-bit: an accidental "
+                     "x64 promotion doubles memory traffic and falls off "
+                     "the TPU fast path", g, op=f64.primitive.name,
+                     severity="error")
+            continue
+        if g.kind != "infer" or max_in == 0:
+            continue         # train graphs upcast deliberately (fp32 master)
+        wide = []
+        for eqn in walk_eqns(jaxpr):
+            for o in eqn.outvars:
+                bits = _float_bits(o.aval.dtype) \
+                    if hasattr(o.aval, "dtype") else 0
+                if bits > max_in:
+                    wide.append((eqn, bits))
+                    break
+        if wide:
+            eqn, bits = wide[0]
+            ctx.diag("MX702",
+                     f"'{eqn.primitive.name}' widens to float{bits} in a "
+                     f"float{max_in} graph ({len(wide)} eqn(s) run at the "
+                     "wider dtype): a strongly-typed scalar/constant "
+                     "(np.float32(...) instead of a Python float) promotes "
+                     "every downstream op — use weak Python scalars or "
+                     "cast the constant", g, op=eqn.primitive.name,
+                     severity="warning")
+
+
+# ---------------------------------------------------------------------------
+# MX703 — dead outputs / unused parameters
+# ---------------------------------------------------------------------------
+
+@register_hlo_pass("hlo_dead_code",
+                   describe="dead compute and unused parameters/inputs, "
+                            "MX703")
+def hlo_dead_code(ctx: HloPassContext) -> None:
+    for g in ctx.graphs:
+        jaxpr = g.closed.jaxpr
+        needed, dead = _liveness(jaxpr)
+        seeds = [v for v, r in zip(jaxpr.invars, g.roles)
+                 if r in ("rng_key", "other")]
+        ignorable = _key_reach(jaxpr, seeds)
+        dead = [e for e in dead
+                if not all(o in ignorable for o in e.outvars)]
+        if dead:
+            prims = ", ".join(sorted({e.primitive.name for e in dead})[:4])
+            ctx.diag("MX703",
+                     f"{len(dead)} eqn(s) compute values no output needs "
+                     f"({prims}): dead compute bloats the executable and "
+                     "compile time even when XLA elides it", g, op=prims,
+                     severity="warning")
+        for v, name, role in zip(jaxpr.invars, g.arg_names, g.roles):
+            if role == "rng_key" or v in needed:
+                continue
+            what = "parameter" if role in ("param", "state") else "input"
+            ctx.diag("MX703",
+                     f"{what} '{name}' is never read by the graph: it is "
+                     "still transferred and held on device every call — "
+                     "drop it from the signature or the parameter set", g,
+                     op=name, severity="warning")
+
+
+# ---------------------------------------------------------------------------
+# MX704 — missed buffer-donation opportunity
+# ---------------------------------------------------------------------------
+
+@register_hlo_pass("hlo_donation",
+                   describe="droppable input buffer not donated though an "
+                            "output aval matches, MX704")
+def hlo_donation(ctx: HloPassContext) -> None:
+    min_bytes = int(ctx.opt("donation_min_bytes", 1 << 16))
+    seen = set()             # one finding per (entry, input) across buckets
+    for g in ctx.graphs:
+        if g.donated is None:
+            continue          # no donation info (bare block / artifact)
+        # infer graphs: request buffers (role "input") are the droppable
+        # ones. Train graphs: the params/optimizer states the step
+        # returns updated copies of — a trainer built with donate=False
+        # allocates a second full model's worth of buffers per step.
+        droppable = ("input",) if g.kind != "train" \
+            else ("param", "state", "input")
+        jaxpr = g.closed.jaxpr
+        out_sigs = set()
+        for o in jaxpr.outvars:
+            aval = getattr(o, "aval", None)
+            d = _np_dtype(aval.dtype) if hasattr(aval, "dtype") else None
+            if d is not None and hasattr(aval, "shape"):
+                out_sigs.add((tuple(aval.shape), d.name))
+        hits = []
+        for i, (v, name, role) in enumerate(
+                zip(jaxpr.invars, g.arg_names, g.roles)):
+            if role not in droppable \
+                    or (i < len(g.donated) and g.donated[i]):
+                continue
+            aval = v.aval
+            d = _np_dtype(aval.dtype) if hasattr(aval, "dtype") else None
+            if d is None or not hasattr(aval, "shape"):
+                continue
+            nbytes = int(onp.prod(aval.shape, dtype=onp.int64)
+                         * d.itemsize) if len(aval.shape) else d.itemsize
+            sig = (tuple(aval.shape), d.name)
+            if nbytes >= min_bytes and sig in out_sigs:
+                hits.append((name, nbytes, sig))
+        if g.kind == "train":
+            # one aggregated finding: a real model has hundreds of params
+            if hits:
+                total = sum(n for _, n, _ in hits)
+                names = ", ".join(n for n, _, _ in hits[:3])
+                more = f" (+{len(hits) - 3} more)" if len(hits) > 3 else ""
+                ctx.diag("MX704",
+                         f"{len(hits)} step buffer(s) totalling "
+                         f"{total >> 10} KiB ({names}{more}) are replaced "
+                         "by same-aval outputs but not donated: the step "
+                         "holds two copies of the model/optimizer state — "
+                         "build the trainer with donation enabled",
+                         g, op=names, severity="warning")
+            continue
+        for name, nbytes, sig in hits:
+            if (g.entry, name) in seen:
+                continue
+            seen.add((g.entry, name))
+            ctx.diag("MX704",
+                     f"input '{name}' ({nbytes >> 10} KiB, "
+                     f"{sig[1]}{list(sig[0])}) is dropped after the "
+                     "call and an output has the same aval, but the "
+                     "buffer is not donated: XLA must allocate a "
+                     "second buffer per call — donate request buffers "
+                     "(CompiledModel donate='auto'/True)", g, op=name,
+                     severity="warning")
+
+
+# ---------------------------------------------------------------------------
+# MX705 — large constants baked into the graph
+# ---------------------------------------------------------------------------
+
+@register_hlo_pass("hlo_constants",
+                   describe="large constant baked into the graph "
+                            "(>1 MiB literal), MX705")
+def hlo_constants(ctx: HloPassContext) -> None:
+    limit = int(ctx.opt("const_limit_bytes", 1 << 20))
+    for g in ctx.graphs:
+        for i, c in enumerate(getattr(g.closed, "consts", []) or []):
+            nbytes = getattr(c, "nbytes", None)
+            if nbytes is None:
+                try:
+                    nbytes = onp.asarray(c).nbytes
+                except Exception:
+                    continue
+            if nbytes > limit:
+                shape = tuple(getattr(c, "shape", ()))
+                dtype = getattr(c, "dtype", "?")
+                ctx.diag("MX705",
+                         f"constant #{i} ({nbytes / 2**20:.1f} MiB, "
+                         f"{dtype}{list(shape)}) is baked into the "
+                         "compiled graph: it is re-serialized into every "
+                         "executable and bucket — pass it as an argument "
+                         "(a parameter) instead of closing over it", g,
+                         op=f"const#{i}", severity="error")
+
+
+# ---------------------------------------------------------------------------
+# MX706 — trace-signature divergence across call sites
+# ---------------------------------------------------------------------------
+
+@register_hlo_pass("hlo_signature",
+                   describe="trace-signature divergence across call sites "
+                            "(static twin of the compile ledger), MX706")
+def hlo_signature(ctx: HloPassContext) -> None:
+    by_entry: Dict[str, List[TracedGraph]] = {}
+    for g in ctx.graphs:
+        by_entry.setdefault(g.entry, []).append(g)
+    for entry, graphs in by_entry.items():
+        for g in graphs:
+            if g.expected is False:
+                ctx.diag("MX706",
+                         "call-site signature is not in the declared "
+                         "bucket/export set: this shape reaches the model "
+                         "unbucketed and costs a fresh XLA compile (the "
+                         "telemetry compile ledger will log it as a "
+                         "post-warmup compile at runtime)", g,
+                         severity="error")
+        undeclared = [g for g in graphs if g.expected is None]
+        sigs: Dict[tuple, List[str]] = {}
+        for g in undeclared:
+            sigs.setdefault(g.signature, []).append(g.site)
+        if len(sigs) > 1:
+            sites = "; ".join(
+                f"{'+'.join(v)}→({_sig_str(k)})" for k, v in sigs.items())
+            ctx.diag("MX706",
+                     f"{len(sigs)} distinct lowered signatures across "
+                     f"call sites of one model [{sites}]: each is a "
+                     "separate XLA compile at runtime — route the call "
+                     "sites through one bucketed entry "
+                     "(serve.CompiledModel) or pad to a shared signature",
+                     node=f"{entry}[{len(sigs)} sites]",
+                     severity="warning")

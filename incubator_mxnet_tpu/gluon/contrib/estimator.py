@@ -226,7 +226,9 @@ class Estimator:
                 if isinstance(h, EpochBegin):
                     h.epoch_begin(self)
             train_data.reset()
-            t0 = time.time()
+            # reference-parity epoch speedometer (predates mx.telemetry);
+            # the trainer underneath publishes train.step to the bus
+            t0 = time.time()  # mxlint: disable=MX601
             n = 0
             for batch in train_data:
                 if batches is not None and n >= batches:

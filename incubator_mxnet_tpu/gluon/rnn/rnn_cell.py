@@ -387,7 +387,10 @@ class ZoneoutCell(ModifierCell):
                       if p_out != 0.0 else next_output)
             new_states = ([F.where(mask(p_st, ns), ns, s) for ns, s in
                            zip(next_states, states)] if p_st != 0.0 else next_states)
-            self._prev_output = output
+            # reference-parity zoneout state: reset()/begin_state clears it
+            # before any cross-trace reuse, so the stored value never
+            # outlives its trace (the generic leak MX206 guards against)
+            self._prev_output = output  # mxlint: disable=MX206
             return output, new_states
         return next_output, next_states
 

@@ -6,6 +6,7 @@ All models are HybridBlocks: eager for debugging, one ``hybridize()`` away
 from a single XLA computation, and shardable over the parallel mesh with the
 per-family ``*_sharding_rules()`` helpers.
 """
+from ..gluon.block import HybridBlock
 from . import transformer  # noqa: F401
 from . import bert  # noqa: F401
 from . import lenet  # noqa: F401
@@ -76,3 +77,90 @@ def serve_spec(family: str) -> dict:
     return {"input_axes": [dict(a) for a in spec["input_axes"]],
             "output_axes": [dict(a) for a in spec["output_axes"]],
             "pad_values": list(spec["pad_values"])}
+
+
+class _NMTEncodeEntry(HybridBlock):
+    """The ``nmt_encoder`` serving entry as a traceable block: the
+    embed → masked-encoder half of ``NMTModel.encode``, built WITHOUT the
+    decoder so the serving signature carries no dead decoder parameters
+    (analysis.hlo MX703 would rightly flag them)."""
+
+    def __init__(self, src_vocab=100, units=32, hidden_size=64,
+                 num_layers=2, num_heads=2, max_length=32, **kw):
+        super().__init__(**kw)
+        from ..gluon import nn
+        from .nmt import TransformerEncoder
+        with self.name_scope():
+            self.src_embed = nn.Embedding(src_vocab, units,
+                                          prefix="src_embed_")
+            self.encoder = TransformerEncoder(units, hidden_size,
+                                              num_layers, num_heads, 0.1,
+                                              max_length, prefix="enc_")
+
+    def hybrid_forward(self, F, src, src_len):
+        B, L = src.shape
+        steps = F.arange(0, L, dtype="float32").reshape((1, L))
+        mask = F.broadcast_lesser(steps, src_len.reshape((B, 1)))
+        return self.encoder(self.src_embed(src),
+                            mask.reshape((B, 1, 1, L)))
+
+
+def hlo_smoke(family: str) -> dict:
+    """Small live instance of one serving family for compiled-graph
+    analysis (``mxlint --hlo`` / CI ``hlo-lint``): returns ``{"block",
+    "example_args", "table", "spec", "compiled"}`` sized so every bucket
+    traces in milliseconds on CPU. ``compiled`` is THE un-warmed
+    ``serve.CompiledModel`` every gate analyzes (building it never
+    XLA-compiles — only :meth:`~...serve.CompiledModel.warmup` does), so
+    the CLI target and the tests provably check the same object shape."""
+    import numpy as onp
+
+    from .. import nd, serve
+
+    spec = serve_spec(family)
+    if family in ("bert", "bert_encoder"):
+        vocab, L, P = 1000, 16, 4
+        net = get_bert("bert_2_128_2", vocab_size=vocab, max_length=32,
+                       dropout=0.1, use_decoder=(family == "bert"),
+                       use_classifier=(family == "bert"))
+        net.initialize()
+        net.hybridize()
+        ids = nd.array(onp.ones((2, L), "int32"))
+        tt = nd.array(onp.zeros((2, L), "int32"))
+        vl = nd.array(onp.full((2,), L, "float32"))
+        if family == "bert":
+            pos = nd.array(onp.zeros((2, P), "int32"))
+            args = (ids, tt, vl, pos)
+        else:
+            args = (ids, tt, vl)
+        table = serve.BucketTable({"batch": (1, 4), "seq": (8, 16)})
+    elif family == "lenet":
+        net = LeNet()
+        net.initialize()
+        net.hybridize()
+        args = (nd.array(onp.zeros((2, 1, 28, 28), "float32")),)
+        table = serve.BucketTable({"batch": (1, 4)})
+    elif family == "transformer_encoder":
+        net = StackedTransformerEncoder(num_layers=2, units=32,
+                                        hidden_size=64, num_heads=2)
+        net.initialize()
+        net.hybridize()
+        args = (nd.array(onp.zeros((2, 16, 32), "float32")),)
+        table = serve.BucketTable({"batch": (1, 4), "seq": (8, 16)})
+    elif family == "nmt_encoder":
+        net = _NMTEncodeEntry()
+        net.initialize()
+        net.hybridize()
+        args = (nd.array(onp.ones((2, 16), "int32")),
+                nd.array(onp.full((2,), 16, "float32")))
+        table = serve.BucketTable({"batch": (1, 4), "seq": (8, 16)})
+    else:
+        raise KeyError(f"no hlo smoke model for {family!r}; known: "
+                       f"{sorted(SERVE_SPECS)}")
+    net(*args)
+    compiled = serve.CompiledModel(net, table, spec["input_axes"],
+                                   example_args=args,
+                                   output_axes=spec["output_axes"],
+                                   pad_values=spec["pad_values"])
+    return {"block": net, "example_args": args, "table": table,
+            "spec": spec, "compiled": compiled}

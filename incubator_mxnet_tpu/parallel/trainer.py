@@ -280,6 +280,20 @@ class ShardedTrainer:
         return jax.jit(step, donate_argnums=donate)
 
     # ------------------------------------------------------------------
+    def step_trace_args(self, *batch):
+        """Live argument tuple matching the jitted step's signature, for
+        offline inspection (``mx.analysis.hlo`` traces the full
+        fwd+bwd+optimizer graph without executing it). Requires at least
+        one completed :meth:`step` so the parameter/optimizer state and
+        the step function exist."""
+        if self._step_fn is None or self._base_key is None:
+            raise MXNetError("step_trace_args() needs a built step "
+                             "function: run one step() first")
+        vals = self.place(*batch)
+        return (self._param_vals, self._opt_states, self._base_key,
+                self._lr_dev, self._t_dev) + tuple(vals)
+
+    # ------------------------------------------------------------------
     def place(self, *batch):
         """Place batch arrays onto the mesh with the data sharding (batch
         over ``dp``, sequence over ``sp``). One hop host→mesh; arrays already

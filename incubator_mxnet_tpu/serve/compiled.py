@@ -85,6 +85,10 @@ class CompiledModel:
             "post_warmup_compiles": 0}
         self._warmed = False
         self._block = block
+        # donation *intent* ("auto"/True/False), kept apart from the
+        # backend-resolved argnums so mx.analysis.hlo can reason about the
+        # accelerator deployment even when staging runs on CPU
+        self._donate_requested = donate
 
         if isinstance(block, SymbolBlock):
             arch = block._arch

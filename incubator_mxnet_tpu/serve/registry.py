@@ -100,14 +100,20 @@ class ModelRegistry:
              input_names: Optional[Sequence[str]] = None,
              epoch: int = 0, warmup: bool = True,
              output_axes: Optional[Sequence[Dict[int, str]]] = None,
-             pad_values: Any = 0) -> ModelVersion:
-        """Build, (optionally) warm and install one model version.
+             pad_values: Any = 0, analyze: bool = True) -> ModelVersion:
+        """Build, analyze, (optionally) warm and install one model version.
 
         Everything that can fail — artifact deserialization, checkpoint
-        load (retried under the registry's policy), compilation, warmup —
-        happens on a staging copy; the registry table is only touched on
-        success, so the previously active version keeps serving through a
-        failed load.
+        load (retried under the registry's policy), compiled-graph
+        analysis, compilation, warmup — happens on a staging copy; the
+        registry table is only touched on success, so the previously
+        active version keeps serving through a failed load.
+
+        ``analyze=True`` (default) runs the ``mx.analysis.hlo`` MX7xx
+        passes over the staged model's bucket graphs BEFORE any warmup
+        compile: error-severity findings (host callbacks in the graph,
+        baked >1 MiB constants, unbucketed signatures) abort the load;
+        warnings are published as a ``serve.analysis`` telemetry event.
         """
         if (artifacts is None) == (factory is None):
             raise MXNetError("pass exactly one of artifacts= (cold start "
@@ -176,6 +182,22 @@ class ModelRegistry:
                                  example_args=example_args,
                                  output_axes=output_axes,
                                  pad_values=pad_values)
+        if analyze:
+            # pre-run lint of the artifact the device will execute: cheap
+            # (tracing only, no XLA compile) and still on the staging
+            # copy; max_graphs covers the FULL bucket table so the gate
+            # never silently under-analyzes large tables
+            from ..analysis import hlo as _hlo
+            rep = _hlo.verify(compiled,
+                              max_graphs=max(8, table.num_buckets()))
+            if rep.diagnostics or rep.skipped:
+                _tele.emit("serve.analysis", model=name, version=version,
+                           **rep.summary_dict())
+            if rep.errors:
+                raise MXNetError(
+                    f"analysis.hlo rejected {name!r} v{version} at "
+                    "staging (the active version keeps serving):\n" +
+                    "\n".join(f"  {d}" for d in rep.errors))
         if warmup:
             compiled.warmup()
 

@@ -1,0 +1,20 @@
+"""Clean control: a small MLP that must produce ZERO MX7xx findings —
+no host round-trips, no promotion, no dead compute, no donation miss
+(output aval differs from every input), no baked constants, one
+signature."""
+import numpy as onp
+
+from incubator_mxnet_tpu import gluon, nd
+
+EXPECT = None
+
+
+def model():
+    net = gluon.nn.HybridSequential(prefix="hloclean_")
+    with net.name_scope():
+        net.add(gluon.nn.Dense(16, activation="relu", in_units=32))
+        net.add(gluon.nn.Dense(8, in_units=16))
+    net.initialize()
+    net.hybridize()
+    net(nd.array(onp.zeros((2, 32), "float32")))
+    return net, None

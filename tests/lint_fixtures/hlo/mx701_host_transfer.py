@@ -1,0 +1,26 @@
+"""Seeded MX701: a host callback inside the compiled graph — every
+executed step round-trips device→host→device through Python."""
+import numpy as onp
+
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.gluon.block import HybridBlock
+from incubator_mxnet_tpu.ndarray import NDArray
+
+EXPECT = "MX701"
+
+
+class HostRoundTrip(HybridBlock):
+    def hybrid_forward(self, F, x):
+        import jax
+        y = jax.pure_callback(lambda a: a,
+                              jax.ShapeDtypeStruct(x.shape, x._data.dtype),
+                              x._data)
+        return NDArray(y, ctx=x.context) * 2.0
+
+
+def model():
+    net = HostRoundTrip()
+    net.initialize()
+    net.hybridize()
+    net(nd.array(onp.ones((2, 8), "float32")))
+    return net, None

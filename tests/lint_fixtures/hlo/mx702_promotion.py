@@ -1,0 +1,22 @@
+"""Seeded MX702: a strongly-typed ``np.float32`` scalar in a float16
+graph — JAX promotes every downstream op to f32 (a weak Python ``1.5``
+would have stayed f16)."""
+import numpy as onp
+
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.gluon.block import HybridBlock
+
+EXPECT = "MX702"
+
+
+class Promoting(HybridBlock):
+    def hybrid_forward(self, F, x):
+        return x * onp.float32(1.5)
+
+
+def model():
+    net = Promoting()
+    net.initialize()
+    net.hybridize()
+    net(nd.array(onp.ones((2, 8), "float16")))
+    return net, None

@@ -1,0 +1,29 @@
+"""Seeded MX703 (both shapes): an equation chain no output consumes, and
+a declared parameter the forward never reads — transferred and compiled
+for nothing."""
+import numpy as onp
+
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.gluon.block import HybridBlock
+
+EXPECT = "MX703"
+
+
+class DeadWork(HybridBlock):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.unused_w = self.params.get("unused_w", shape=(8, 8),
+                                            init="ones")
+
+    def hybrid_forward(self, F, x, unused_w=None):
+        waste = F.tanh(x) * 3.0  # noqa: F841 — the seeded dead compute
+        return x + 1.0
+
+
+def model():
+    net = DeadWork()
+    net.initialize()
+    net.hybridize()
+    net(nd.array(onp.ones((2, 8), "float32")))
+    return net, None

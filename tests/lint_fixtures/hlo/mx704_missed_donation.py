@@ -1,0 +1,29 @@
+"""Seeded MX704: an elementwise serving model with donation explicitly
+disabled — the request buffer (same aval as the output) is dropped after
+the call but XLA must still allocate a second buffer."""
+import numpy as onp
+
+from incubator_mxnet_tpu import nd, serve
+from incubator_mxnet_tpu.gluon.block import HybridBlock
+
+EXPECT = "MX704"
+
+
+class Scale(HybridBlock):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.gain = self.params.get("gain", shape=(64,), init="ones")
+
+    def hybrid_forward(self, F, x, gain=None):
+        return x * gain.reshape((1, 1, 64))
+
+
+def model():
+    net = Scale()
+    net.initialize()
+    net.hybridize()
+    net(nd.array(onp.ones((2, 256, 64), "float32")))
+    table = serve.BucketTable({"batch": (1, 4)})
+    return serve.CompiledModel(net, table, [{0: "batch"}],
+                               donate=False), None

@@ -1,0 +1,23 @@
+"""Seeded MX705: a 1.2 MiB host array closed over by the forward — baked
+into every compiled executable instead of riding as a parameter."""
+import numpy as onp
+
+from incubator_mxnet_tpu import nd
+from incubator_mxnet_tpu.gluon.block import HybridBlock
+
+EXPECT = "MX705"
+
+BIG_TABLE = onp.ones((8, 40000), "float32")  # 1.28 MB literal
+
+
+class Baked(HybridBlock):
+    def hybrid_forward(self, F, x):
+        return F.dot(x, nd.array(BIG_TABLE))
+
+
+def model():
+    net = Baked()
+    net.initialize()
+    net.hybridize()
+    net(nd.array(onp.ones((2, 8), "float32")))
+    return net, None

@@ -1,0 +1,20 @@
+"""Seeded MX706: two call sites of one model lowering to different
+signatures — each is a separate XLA compile at runtime (the static twin
+of a post-warmup entry in the telemetry compile ledger)."""
+import numpy as onp
+
+from incubator_mxnet_tpu import gluon, nd
+
+EXPECT = "MX706"
+
+
+def model():
+    net = gluon.nn.HybridSequential(prefix="diverge_")
+    with net.name_scope():
+        net.add(gluon.nn.Dense(8, in_units=16))
+    net.initialize()
+    net.hybridize()
+    a = nd.array(onp.ones((2, 16), "float32"))
+    b = nd.array(onp.ones((5, 16), "float32"))
+    net(a)
+    return net, [(a,), (b,)]

@@ -418,3 +418,366 @@ class TestSavedModelGraphs:
         sym = lenet_symbol()
         rep = mx.analysis.verify(sym, shapes={"data": (2, 1, 28, 28)})
         assert rep.ok, str(rep)
+
+
+class TestDiagnosticRegistryAudit:
+    """Satellite: analysis/diagnostics.py is THE single source of truth
+    for codes and severities — audited so a collision or gap can't ship."""
+
+    def _code_dict_keys(self, name):
+        import ast
+        import incubator_mxnet_tpu.analysis.diagnostics as D
+        tree = ast.parse(open(D.__file__.rstrip("c")).read())
+        for node in ast.walk(tree):
+            targets = node.targets if isinstance(node, ast.Assign) else \
+                [node.target] if isinstance(node, ast.AnnAssign) else []
+            if any(isinstance(t, ast.Name) and t.id == name
+                   for t in targets) and node.value is not None \
+                    and isinstance(node.value, ast.Dict):
+                return [k.value for k in node.value.keys
+                        if isinstance(k, ast.Constant)]
+        raise AssertionError(f"no dict literal for {name}")
+
+    def test_no_duplicate_code_keys_in_source(self):
+        # a duplicate key in a dict literal silently overwrites — only an
+        # AST audit can catch the collision
+        for name in ("CODES", "DEFAULT_SEVERITY"):
+            keys = self._code_dict_keys(name)
+            dupes = [k for k in set(keys) if keys.count(k) > 1]
+            assert not dupes, f"duplicate {name} keys: {dupes}"
+
+    def test_families_are_contiguous(self):
+        # codes are append-only WITHIN a family: MXn00/MXn01..MXnNN with
+        # no gap-jumping, so the next free code is always unambiguous
+        from incubator_mxnet_tpu.analysis.diagnostics import CODES
+        import collections
+        fams = collections.defaultdict(list)
+        for code in CODES:
+            assert len(code) == 5 and code.startswith("MX"), code
+            fams[int(code[2])].append(int(code[2:]))
+        for fam, nums in sorted(fams.items()):
+            nums = sorted(nums)
+            assert nums[0] in (fam * 100, fam * 100 + 1), \
+                f"MX{fam}xx starts at {nums[0]}"
+            assert nums == list(range(nums[0], nums[0] + len(nums))), \
+                f"MX{fam}xx has gaps: {nums}"
+
+    def test_every_code_has_exactly_one_severity(self):
+        from incubator_mxnet_tpu.analysis.diagnostics import (
+            CODES, DEFAULT_SEVERITY)
+        assert set(CODES) == set(DEFAULT_SEVERITY)
+        assert set(DEFAULT_SEVERITY.values()) <= {"error", "warning"}
+
+    def test_diagnostic_defaults_severity_from_registry(self):
+        d = Diagnostic("MX201", "m", node="n")
+        assert d.severity == "warning"   # registry default, not "error"
+        d2 = Diagnostic("MX201", "m", node="n", severity="error")
+        assert d2.severity == "error"    # explicit override still wins
+
+    def test_hlo_family_registered(self):
+        from incubator_mxnet_tpu.analysis.diagnostics import CODES
+        assert {f"MX70{i}" for i in range(1, 7)} <= set(CODES)
+
+
+class TestSuppressions:
+    def test_same_line_suppression(self):
+        src = ("from incubator_mxnet_tpu.gluon import HybridBlock\n"
+               "class Net(HybridBlock):\n"
+               "    def forward(self, x):\n"
+               "        print(x)  # mxlint: disable=MX202\n"
+               "        return x\n")
+        assert lint_source(src, "<f>").codes() == []
+
+    def test_file_level_suppression(self):
+        src = ("# mxlint: disable-file=MX202,MX203\n"
+               "from incubator_mxnet_tpu.gluon import HybridBlock\n"
+               "class Net(HybridBlock):\n"
+               "    def forward(self, x):\n"
+               "        print(x)\n"
+               "        v = float(x)\n"
+               "        return x\n")
+        assert lint_source(src, "<f>").codes() == []
+
+    def test_other_codes_not_suppressed(self):
+        src = ("from incubator_mxnet_tpu.gluon import HybridBlock\n"
+               "class Net(HybridBlock):\n"
+               "    def forward(self, x):\n"
+               "        print(x)  # mxlint: disable=MX203\n"
+               "        return x\n")
+        assert lint_source(src, "<f>").codes() == ["MX202"]
+
+    def test_parse_suppressions(self):
+        from incubator_mxnet_tpu.analysis import parse_suppressions
+        file_level, by_line = parse_suppressions(
+            "# mxlint: disable-file=MX501\nx = 1\n"
+            "y = 2  # mxlint: disable=MX204, MX206\n")
+        assert file_level == {"MX501"}
+        assert by_line == {3: {"MX204", "MX206"}}
+
+    def test_marker_in_string_literal_is_inert(self):
+        # documentation ABOUT suppressions must not disable anything
+        from incubator_mxnet_tpu.analysis import parse_suppressions
+        file_level, by_line = parse_suppressions(
+            'DOC = "use # mxlint: disable-file=MX501 to suppress"\n')
+        assert file_level == set() and by_line == {}
+
+    def test_wrapped_statement_trailing_comment(self):
+        # AST nodes report the statement's FIRST line; the trailing
+        # comment sits on the last — both must be covered
+        src = ("from incubator_mxnet_tpu.gluon import HybridBlock\n"
+               "class Net(HybridBlock):\n"
+               "    def forward(self, x):\n"
+               "        print(\n"
+               "            x)  # mxlint: disable=MX202\n"
+               "        return x\n")
+        assert lint_source(src, "<f>").codes() == []
+
+
+def _hlo_fixture(name):
+    import importlib.util
+    path = os.path.join(FIXTURES, "hlo", name)
+    spec = importlib.util.spec_from_file_location(
+        "hlo_fixture_" + name[:-3], path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestHloPasses:
+    """Tentpole acceptance: each MX701–MX706 is demonstrated by a seeded
+    fixture its pass flags; the clean model produces zero findings."""
+
+    @pytest.mark.parametrize("fixture", [
+        "mx701_host_transfer.py",
+        "mx702_promotion.py",
+        "mx703_dead_code.py",
+        "mx704_missed_donation.py",
+        "mx705_baked_constant.py",
+        "mx706_signature_divergence.py",
+    ])
+    def test_seeded_fixture_flagged(self, fixture):
+        from incubator_mxnet_tpu.analysis import hlo
+        mod = _hlo_fixture(fixture)
+        entry, sample = mod.model()
+        rep = hlo.verify(entry, sample)
+        assert mod.EXPECT in rep.codes(), \
+            f"{fixture}: expected {mod.EXPECT}, got {rep.codes()}"
+        # the seeded violation is the ONLY family present
+        assert {d.code for d in rep} == {mod.EXPECT}
+        from incubator_mxnet_tpu.analysis.diagnostics import DEFAULT_SEVERITY
+        sev = {d.severity for d in rep if d.code == mod.EXPECT}
+        assert DEFAULT_SEVERITY[mod.EXPECT] in sev
+
+    def test_clean_fixture_zero_findings(self):
+        from incubator_mxnet_tpu.analysis import hlo
+        entry, sample = _hlo_fixture("clean.py").model()
+        rep = hlo.verify(entry, sample)
+        assert len(rep) == 0, str(rep)
+
+    def test_error_severities(self):
+        # MX701 (callback) and MX705 gate CI (error); the perf-shaped
+        # findings ride as warnings
+        from incubator_mxnet_tpu.analysis import hlo
+        entry, _ = _hlo_fixture("mx705_baked_constant.py").model()
+        rep = hlo.verify(entry)
+        assert [d.code for d in rep.errors] == ["MX705"]
+        entry, _ = _hlo_fixture("mx704_missed_donation.py").model()
+        rep = hlo.verify(entry)
+        assert rep.errors == [] and [d.code for d in rep.warnings] == ["MX704"]
+
+    def test_pass_registry(self):
+        from incubator_mxnet_tpu.analysis import hlo
+        names = hlo.list_hlo_passes()
+        assert names == ["hlo_transfer", "hlo_promotion", "hlo_dead_code",
+                         "hlo_donation", "hlo_constants", "hlo_signature"]
+        with pytest.raises(MXNetError, match="unknown hlo pass"):
+            hlo.run_hlo_passes([], names=["nope"])
+
+    def test_traced_graph_exposes_stablehlo(self):
+        from incubator_mxnet_tpu.analysis import hlo
+        entry, _ = _hlo_fixture("clean.py").model()
+        res = hlo.trace_entry(entry)
+        assert len(res.graphs) == 1
+        g = res.graphs[0]
+        assert g.roles[0] == "rng_key" and "input:0" in g.arg_names
+        assert "module @jit" in g.hlo_text()
+
+    def test_bucket_overflow_sample_is_mx706_error(self):
+        import numpy as onp
+        from incubator_mxnet_tpu import serve
+        from incubator_mxnet_tpu.analysis import hlo
+        entry, _ = _hlo_fixture("clean.py").model()
+        cm = serve.CompiledModel(entry, serve.BucketTable({"batch": (1, 4)}),
+                                 [{0: "batch"}])
+        rep = hlo.verify(cm, [(onp.zeros((9, 32), "float32"),)])
+        assert [d.code for d in rep.errors] == ["MX706"]
+
+    def test_verify_rejects_untraceable(self):
+        from incubator_mxnet_tpu.analysis import hlo
+        with pytest.raises(MXNetError, match="cannot trace"):
+            hlo.verify(object())
+
+
+class TestHloTrainerAndZoo:
+    def test_sharded_trainer_step_traces_clean(self):
+        import jax
+        import numpy as onp
+        from incubator_mxnet_tpu import gluon, parallel
+        from incubator_mxnet_tpu.analysis import hlo
+        net = gluon.nn.Dense(4, in_units=8)
+        net.initialize()
+        ce = gluon.loss.L2Loss()
+        mesh = parallel.make_mesh(devices=jax.devices()[:1])
+        tr = parallel.ShardedTrainer(
+            net, lambda out, label: ce(out, label), "sgd",
+            {"learning_rate": 0.05}, mesh=mesh, n_labels=1)
+        x = onp.ones((2, 8), "float32")
+        y = onp.ones((2, 4), "float32")
+        tr.step(x, y)
+        rep = hlo.verify(tr, (x, y))
+        assert rep.ok and len(rep) == 0, str(rep)
+        g = hlo.trace_entry(tr, (x, y)).graphs[0]
+        assert g.kind == "train"
+        assert g.donated is not None and any(g.donated)  # (0,1,4) donated
+
+    def test_trainer_donate_false_flags_mx704(self):
+        # "optimizer states especially": a trainer built with
+        # donate=False holds two copies of the model/optimizer state
+        # per step — MX704 must reach the training graph
+        import jax
+        import numpy as onp
+        from incubator_mxnet_tpu import gluon, parallel
+        from incubator_mxnet_tpu.analysis import hlo
+        net = gluon.nn.Dense(64, in_units=512)   # weight = 128 KiB
+        net.initialize()
+        ce = gluon.loss.L2Loss()
+        mesh = parallel.make_mesh(devices=jax.devices()[:1])
+        tr = parallel.ShardedTrainer(
+            net, lambda out, label: ce(out, label), "sgd",
+            {"learning_rate": 0.05}, mesh=mesh, n_labels=1,
+            donate=False)
+        x = onp.ones((2, 512), "float32")
+        y = onp.ones((2, 64), "float32")
+        tr.step(x, y)
+        rep = hlo.verify(tr, (x, y))
+        assert [d.code for d in rep.warnings] == ["MX704"]
+        (d,) = rep.warnings
+        assert "donation" in d.message or "donated" in d.message
+
+    def test_trainer_without_step_raises(self):
+        import jax
+        from incubator_mxnet_tpu import gluon, parallel
+        from incubator_mxnet_tpu.analysis import hlo
+        net = gluon.nn.Dense(4, in_units=8)
+        net.initialize()
+        ce = gluon.loss.L2Loss()
+        mesh = parallel.make_mesh(devices=jax.devices()[:1])
+        tr = parallel.ShardedTrainer(
+            net, lambda out, label: ce(out, label), "sgd",
+            {"learning_rate": 0.05}, mesh=mesh, n_labels=1)
+        with pytest.raises(MXNetError, match="run one step"):
+            hlo.verify(tr, (1,))
+
+    def test_zoo_smoke_models_zero_error_findings(self):
+        # acceptance: mxlint --hlo over the bundled zoo reports zero
+        # error-severity MX7xx findings. Iterating SERVE_SPECS itself
+        # (not a hard-coded list) doubles as the drift audit: a family
+        # added to SERVE_SPECS without an hlo_smoke branch fails here
+        # with its KeyError instead of crashing the CI hlo-lint job.
+        from incubator_mxnet_tpu import models
+        from incubator_mxnet_tpu.analysis import hlo
+        for fam in sorted(models.SERVE_SPECS):
+            # the SAME compiled object mxlint --hlo analyzes in CI
+            rep = hlo.verify(models.hlo_smoke(fam)["compiled"])
+            assert rep.errors == [], f"{fam}: {rep}"
+
+    def test_registry_load_rejects_error_findings(self, ):
+        # serve.ModelRegistry.load calls analysis.hlo.verify at staging:
+        # an error finding aborts the load and the active version keeps
+        # serving (the registry staging contract)
+        import numpy as onp
+        from incubator_mxnet_tpu import serve
+        from incubator_mxnet_tpu.serve.registry import ModelRegistry
+
+        clean_mod = _hlo_fixture("clean.py")
+        baked_mod = _hlo_fixture("mx705_baked_constant.py")
+        reg = ModelRegistry()
+        table = serve.BucketTable({"batch": (1, 2)})
+        v1 = reg.load("m", table=table, input_axes=[{0: "batch"}],
+                      factory=lambda: clean_mod.model()[0], warmup=False)
+        assert reg.active_version("m") == 1
+        with pytest.raises(MXNetError, match="analysis.hlo rejected"):
+            reg.load("m", table=table, input_axes=[{0: "batch"}],
+                     factory=lambda: baked_mod.model()[0], warmup=False)
+        assert reg.active_version("m") == 1
+        assert reg.get("m") is v1.compiled
+        # and the gate is explicit opt-out-able for debugging
+        reg.load("m", table=table, input_axes=[{0: "batch"}],
+                 factory=lambda: baked_mod.model()[0], warmup=False,
+                 analyze=False)
+        assert reg.active_version("m") == 2
+
+
+class TestMxlintFormats:
+    def _main(self, argv):
+        from tools import mxlint
+        return mxlint.main(argv)
+
+    def test_json_format_one_finding_per_line(self, capsys):
+        path = os.path.join(FIXTURES, "leaked_tracer.py")
+        assert self._main(["--format=json", path]) == 1
+        out = capsys.readouterr().out
+        lines = [l for l in out.strip().splitlines() if l]
+        recs = [json.loads(l) for l in lines]
+        assert len(recs) == 1
+        rec = recs[0]
+        assert rec["code"] == "MX206" and rec["severity"] == "error"
+        assert rec["file"].endswith("leaked_tracer.py") and rec["line"] > 0
+        assert rec["pass"] == "tracer_lint"
+
+    def test_as_dict_never_fakes_paths(self):
+        # graph labels and pseudo-files must not land in "file" — a CI
+        # annotator consuming the JSON targets real paths only
+        d = Diagnostic("MX202", "m", node="<string>:4").as_dict()
+        assert d["file"] == "" and d["node"] == "<string>:4"
+        d = Diagnostic("MX706", "m", node="BERTModel[batch=4]").as_dict()
+        assert d["file"] == "" and d["line"] == 0
+        d = Diagnostic("MX206", "m", node="pkg/net.py:7").as_dict()
+        assert d["file"] == "pkg/net.py" and d["line"] == 7
+
+    def test_json_summary_goes_to_stderr(self, capsys):
+        path = os.path.join(FIXTURES, "leaked_tracer.py")
+        self._main(["--format=json", path])
+        captured = capsys.readouterr()
+        assert "mxlint:" in captured.err
+        assert "mxlint:" not in captured.out
+
+    def test_hlo_family_target_clean(self, capsys):
+        assert self._main(["--hlo", "lenet", "--format=json"]) == 0
+        assert "0 error(s)" in capsys.readouterr().err
+
+    def test_hlo_factory_target(self, tmp_path, monkeypatch, capsys):
+        (tmp_path / "hlo_cli_fixture_mod.py").write_text(
+            "import numpy as onp\n"
+            "from incubator_mxnet_tpu import nd\n"
+            "from incubator_mxnet_tpu.gluon.block import HybridBlock\n"
+            "class P(HybridBlock):\n"
+            "    def hybrid_forward(self, F, x):\n"
+            "        return x * onp.float32(1.5)\n"
+            "def factory():\n"
+            "    net = P(); net.initialize(); net.hybridize()\n"
+            "    net(nd.array(onp.ones((2, 8), 'float16')))\n"
+            "    return net, None\n")
+        monkeypatch.syspath_prepend(str(tmp_path))
+        assert self._main(["--hlo", "hlo_cli_fixture_mod:factory",
+                           "--format=json"]) == 0   # MX702 is a warning
+        out = capsys.readouterr().out
+        recs = [json.loads(l) for l in out.strip().splitlines() if l]
+        assert [r["code"] for r in recs] == ["MX702"]
+        # --strict turns the warning into a failing exit
+        assert self._main(["--hlo", "hlo_cli_fixture_mod:factory",
+                           "--strict", "-q"]) == 1
+
+    def test_hlo_bad_target_exit_2(self, capsys):
+        assert self._main(["--hlo", "no_such_family"]) == 2
+        assert "neither a serving family" in capsys.readouterr().err

@@ -89,3 +89,51 @@ def test_bench_frcnn_contract(monkeypatch, capsys):
                      MXTPU_BENCH_STEPS="2")
     assert rec["unit"] == "imgs/sec/chip" and rec["value"] > 0
     assert math.isfinite(rec["extra"]["loss"])
+
+
+def test_watchdog_abort_record_is_structured(monkeypatch):
+    """Satellite: a wedged TPU tunnel (rc=75) must leave a parseable
+    {"error": "device_init_timeout"} JSON record on stdout, not silence
+    (BENCH_r05.json's `parsed: null`)."""
+    import importlib.util
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "bench_wd", os.path.join(repo, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    monkeypatch.setenv("MXTPU_BENCH_WORKLOAD", "frcnn")
+    rec = mod._watchdog_record(1500)
+    # same JSON-line contract as a successful run: one flat record with
+    # the metric keys present (null) plus the structured error
+    assert rec["error"] == "device_init_timeout"
+    assert rec["value"] is None and rec["metric"] is None
+    assert rec["extra"]["timeout_s"] == 1500 and rec["extra"]["rc"] == 75
+    assert rec["extra"]["workload"] == "frcnn"
+    json.loads(json.dumps(rec))  # strictly serializable
+
+
+def test_watchdog_fire_emits_json_line_before_exit(monkeypatch, capsys):
+    """The timer path itself: _fire must print the record as the last
+    stdout line before os._exit(75)."""
+    import importlib.util
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "bench_wd2", os.path.join(repo, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    monkeypatch.setenv("MXTPU_BENCH_TIMEOUT", "1200")
+    exits = []
+    monkeypatch.setattr(mod.os, "_exit", lambda rc: exits.append(rc))
+    timer = mod._arm_watchdog()
+    assert timer is not None
+    try:
+        timer.cancel()            # don't let the real 1200s timer linger
+        timer.function()          # fire the callback synchronously
+    finally:
+        timer.cancel()
+    assert exits == [75]
+    out = capsys.readouterr()
+    rec = json.loads(out.out.strip().splitlines()[-1])
+    assert rec["error"] == "device_init_timeout"
+    assert rec["extra"]["timeout_s"] == 1200
+    assert "watchdog" in out.err

@@ -1,5 +1,6 @@
 #!/usr/bin/env python
-"""mxlint — static analysis CLI over models, examples and symbol JSON.
+"""mxlint — static analysis CLI over models, examples, symbol JSON, and
+compiled graphs.
 
 Reference counterpart: the graph sanity MXNet ran implicitly inside
 ``nnvm::Graph`` passes, surfaced the way modern stacks do it (TVM's pass
@@ -11,6 +12,10 @@ findings::
     python -m tools.mxlint net-symbol.json       # graph passes (MX0xx/MX1xx)
     python -m tools.mxlint layout.json           # sharding table (MX3xx)
     python -m tools.mxlint incubator_mxnet_tpu.models.bert   # dotted module
+    python -m tools.mxlint --hlo all             # MX7xx over models.SERVE_SPECS
+    python -m tools.mxlint --hlo bert_encoder    # one serving family
+    python -m tools.mxlint --hlo pkg.mod:factory # custom entry point
+    python -m tools.mxlint --format=json ...     # one JSON finding per line
 
 Python targets get the pure-AST JAX-pitfall lint (no import of the linted
 code); ``.json`` targets are loaded as Symbols and run through the
@@ -18,8 +23,21 @@ code); ``.json`` targets are loaded as Symbols and run through the
 graph needs input shapes) — unless the file is a sharding table (a top-level
 ``"mesh"`` key: ``{"mesh": {axis: size}, "rules": [[pattern, [axes...]]],
 "params": {name: [shape]}}``), which runs the sharding-consistency pass
-instead. Exit status: 0 clean, 1 error diagnostics (``--strict``: warnings
-too), 2 bad invocation.
+instead.
+
+``--hlo`` targets trace the *compiled* graph (jaxpr/StableHLO) and run the
+MX7xx passes: a serving-family name from ``models.SERVE_SPECS``, ``all``
+(every family), or ``module:factory`` where the zero-arg factory returns a
+traceable entry (HybridBlock / CompiledModel / SymbolBlock / callable) or a
+``(entry, sample_args)`` tuple.
+
+``--format=json`` emits one finding per line
+(``{"file", "line", "node", "code", "severity", "message", "pass",
+"op"}``) on stdout — CI annotates from it instead of grepping — with the
+summary on stderr. ``file``/``line`` are filled only for path-shaped
+provenance; graph findings (MX0xx/MX7xx) carry their location in
+``node``. Exit status: 0 clean, 1 error diagnostics (``--strict``:
+warnings too), 2 bad invocation.
 """
 from __future__ import annotations
 
@@ -113,6 +131,62 @@ def _lint_json(path: str, analysis):
     return analysis.verify(sym, passes=["graph_verify", "infer_shapes"])
 
 
+class _HloTargetError(Exception):
+    """Bad ``--hlo`` invocation (unknown family, unloadable factory) —
+    distinct from exceptions raised INSIDE a user's factory, which
+    propagate with their own traceback."""
+
+
+def _hlo_expand(targets):
+    """``--hlo`` target list → [(label, entry, sample_args)]; families
+    come from models.SERVE_SPECS, ``all`` expands to every family,
+    ``module:factory`` is imported and called."""
+    import importlib
+
+    from incubator_mxnet_tpu import models
+
+    out = []
+    names = []
+    for t in targets:
+        if t == "all":
+            names.extend(sorted(models.SERVE_SPECS))
+        else:
+            names.append(t)
+    for name in names:
+        if ":" in name:
+            mod_name, attr = name.rsplit(":", 1)
+            try:
+                factory = getattr(importlib.import_module(mod_name), attr)
+            except (ImportError, AttributeError) as e:
+                raise _HloTargetError(
+                    f"cannot load --hlo factory {name!r}: "
+                    f"{type(e).__name__}: {e}") from e
+            made = factory()     # user code: its errors traceback as-is
+            entry, sample = made if isinstance(made, tuple) else (made, None)
+            out.append((name, entry, sample))
+        elif name in models.SERVE_SPECS:
+            try:
+                out.append((name, models.hlo_smoke(name)["compiled"],
+                            None))
+            except KeyError as e:
+                # hlo_smoke's own "no smoke model" KeyError means a
+                # family was added to SERVE_SPECS without a smoke
+                # branch — invocation-level drift. Any OTHER KeyError
+                # is a real bug inside model construction: let it
+                # traceback.
+                if not (e.args and str(e.args[0]).startswith(
+                        "no hlo smoke model")):
+                    raise
+                raise _HloTargetError(
+                    f"--hlo target {name!r}: {e.args[0]}") from e
+        else:
+            raise _HloTargetError(
+                f"--hlo target {name!r} is neither a serving family "
+                f"({sorted(models.SERVE_SPECS)}), 'all', nor a "
+                "module:factory")
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="mxlint", description=__doc__,
@@ -121,8 +195,17 @@ def main(argv=None) -> int:
                     help="*.py files, directories, *-symbol.json files, or "
                          "dotted module names (default: in-tree models + "
                          "examples)")
+    ap.add_argument("--hlo", action="append", default=[], metavar="TARGET",
+                    help="compiled-graph MX7xx passes over a serving "
+                         "family from models.SERVE_SPECS, 'all', or "
+                         "module:factory (repeatable)")
+    ap.add_argument("--format", choices=("text", "json"), default="text",
+                    help="finding output: human text (default) or one "
+                         "JSON object per line (summary on stderr)")
     ap.add_argument("-q", "--quiet", action="store_true",
-                    help="suppress per-diagnostic lines, print summary only")
+                    help="suppress per-diagnostic text lines, print "
+                         "summary only (--format=json findings always "
+                         "stream)")
     ap.add_argument("--strict", action="store_true",
                     help="exit non-zero on warnings too (perf hazards like "
                          "MX201/MX302 gate the build)")
@@ -130,8 +213,9 @@ def main(argv=None) -> int:
 
     import incubator_mxnet_tpu.analysis as analysis
 
-    targets = args.targets or [os.path.join(REPO, t)
-                               for t in DEFAULT_TARGETS]
+    targets = args.targets
+    if not targets and not args.hlo:
+        targets = [os.path.join(REPO, t) for t in DEFAULT_TARGETS]
     py_targets, json_targets = [], []
     for t in targets:
         if t.endswith(".json"):
@@ -158,14 +242,41 @@ def main(argv=None) -> int:
     for jt in json_targets:
         report.extend(_lint_json(jt, analysis))
 
-    if not args.quiet:
+    n_hlo = 0
+    if args.hlo:
+        from incubator_mxnet_tpu.base import MXNetError
+        try:
+            hlo_targets = _hlo_expand(args.hlo)
+        except _HloTargetError as e:
+            print(f"mxlint: {e}", file=sys.stderr)
+            return 2
+        for label, entry, sample in hlo_targets:
+            n_hlo += 1
+            try:
+                report.extend(analysis.hlo.verify(entry, sample))
+            except MXNetError as e:
+                # an untraceable factory product is a bad invocation, not
+                # a finding — keep exit 2 distinct from exit 1
+                print(f"mxlint: --hlo target {label!r} is not traceable: "
+                      f"{e}", file=sys.stderr)
+                return 2
+
+    # json mode always streams its findings: -q only silences the human
+    # text path, never the machine contract CI consumes
+    if not args.quiet or args.format == "json":
         for d in report:
-            print(d)
-        for s in report.skipped:
-            print(f"note: skipped {s}", file=sys.stderr)
+            if args.format == "json":
+                import json as _json
+                print(_json.dumps(d.as_dict()))
+            else:
+                print(d)
+        if not args.quiet:
+            for s in report.skipped:
+                print(f"note: skipped {s}", file=sys.stderr)
     n_err, n_warn = len(report.errors), len(report.warnings)
-    print(f"mxlint: {n_err} error(s), {n_warn} warning(s) "
-          f"across {len(py_targets) + len(json_targets)} target(s)")
+    summary = (f"mxlint: {n_err} error(s), {n_warn} warning(s) across "
+               f"{len(py_targets) + len(json_targets) + n_hlo} target(s)")
+    print(summary, file=sys.stderr if args.format == "json" else sys.stdout)
     return 1 if (report.errors or (args.strict and report.warnings)) else 0
 
 
