@@ -12,11 +12,29 @@ Env knobs: MXTPU_BENCH_MODEL (bert_12_768_12|bert_24_1024_16),
 MXTPU_BENCH_BATCH, MXTPU_BENCH_SEQ, MXTPU_BENCH_REMAT (1 = jax.checkpoint
 per encoder layer, frees HBM for bigger batches), MXTPU_PEAK_TFLOPS
 (per-chip bf16 peak, default by device kind).
+
+Device-blind proxy mode (no TPU needed — the CI ``perf-proxy`` gate)::
+
+    python bench.py --proxy                          # every SERVE_SPECS family
+    python bench.py --proxy --families bert,lenet
+    python bench.py --proxy --out PERF_PROXY.json    # (re-)bank the baseline
+    python bench.py --proxy --families bert --check PERF_PROXY.json
+
+``--proxy`` traces every serving family's compiled graphs on CPU, prices
+them with ``analysis.hlo.cost`` (FLOPs/step, bytes/step, fusion counts —
+deterministic functions of the graph), measures the host dispatch gap
+around a few compiled predict calls via ``profiler.step_report``, and
+emits one structured record per family. ``--check`` diffs the
+deterministic metrics against a banked baseline with a tolerance gate
+(default ±5%): regressions fail (rc=1), improvements warn so the
+baseline gets re-banked. A perf regression is caught even when the
+device bench is blind (rc=75 tunnel wedge, BENCH_r03-r05).
 """
 from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 
 import numpy as onp
@@ -341,7 +359,204 @@ def run_frcnn(watchdog) -> dict:
     }
 
 
-def main() -> None:
+# ---------------------------------------------------------------------------
+# --proxy: device-blind perf proxy (trace + cost + host-gap, no TPU)
+# ---------------------------------------------------------------------------
+
+#: banked-baseline metrics the --check gate compares (deterministic
+#: functions of the traced graph only — wall-time metrics like
+#: host_gap_ms vary per machine and are reported, never gated)
+_PROXY_GATE_KEYS = ("flops_per_step", "bytes_per_step")
+#: measured fields excluded from the banked file so re-banking on a
+#: different machine never churns the committed baseline
+_PROXY_VOLATILE_KEYS = ("host_gap_ms", "instrumented_pct")
+
+
+def _proxy_sync(out) -> None:
+    """Block until a predict result is real (host sees the data)."""
+    leaves = out if isinstance(out, (tuple, list)) else (out,)
+    for leaf in leaves:
+        if hasattr(leaf, "asnumpy"):
+            leaf.asnumpy()
+
+
+def _proxy_record(family: str, iters: int = 4) -> dict:
+    """One structured proxy record for a ``models.SERVE_SPECS`` family:
+    the cost table over every bucket graph (via ``models.hlo_smoke`` —
+    the same entry the hlo-lint gate analyzes) plus a measured host-gap
+    probe (compile the example bucket once, then ``iters`` steady-state
+    predict calls attributed by ``profiler.step_report``)."""
+    from incubator_mxnet_tpu import models, profiler, telemetry
+    from incubator_mxnet_tpu.analysis import hlo
+
+    smoke = models.hlo_smoke(family)
+    cm = smoke["compiled"]
+    rep = hlo.cost(cm, max_graphs=max(8, smoke["table"].num_buckets()))
+    head = rep.head
+    if head is None:
+        raise RuntimeError(
+            f"--proxy: family {family!r} traced zero graphs "
+            f"(skipped: {rep.skipped}) — cannot price it")
+    args = smoke["example_args"]
+    _proxy_sync(cm.predict(*args))        # compile the example bucket
+    profiler.reset_spans()
+    for _ in range(iters):
+        _proxy_sync(cm.predict(*args))
+    sr = profiler.step_report(frame="serve.predict")
+    record = {
+        "graphs": len(rep.rows),
+        "flops_per_step": rep.model_flops_per_step(),
+        "bytes_per_step": rep.bytes_per_step(),
+        "param_bytes": head.param_bytes,
+        "activation_bytes": head.activation_bytes,
+        "transcendentals": head.transcendentals,
+        "eqns": head.eqns,
+        "fusible_eqns": head.fusible_eqns,
+        "fusion_groups": head.fusion_groups,
+        "fusion_candidates": head.fusion_candidates,
+        "unknown_eqns": head.unknown_eqns,
+        "host_gap_ms": sr["host_gap_ms_mean"],
+        "instrumented_pct": sr["instrumented_pct"],
+    }
+    telemetry.emit("perf.proxy", family=family, **record)
+    return record
+
+
+def _proxy_compare(current: dict, banked: dict, tol: float):
+    """Gate the deterministic metrics against the banked baseline.
+    Returns ``(failures, warnings)`` — a metric above ``1 + tol`` times
+    the banked value is a regression (fail), below ``1 - tol`` an
+    improvement (warn, so the baseline gets re-banked)."""
+    failures, warnings = [], []
+    for fam in sorted(current):
+        rec, base = current[fam], banked.get(fam)
+        if base is None:
+            warnings.append(f"{fam}: no banked baseline — re-bank "
+                            "PERF_PROXY.json (bench.py --proxy --out)")
+            continue
+        for key in _PROXY_GATE_KEYS:
+            b, c = base.get(key), rec.get(key)
+            if not b or c is None:
+                continue
+            ratio = c / b
+            if ratio > 1.0 + tol:
+                failures.append(
+                    f"{fam}.{key}: {c:.6g} vs banked {b:.6g} "
+                    f"(+{(ratio - 1) * 100:.1f}% > {tol * 100:.0f}% "
+                    "tolerance) — the compiled graph got more expensive")
+            elif ratio < 1.0 - tol:
+                warnings.append(
+                    f"{fam}.{key}: {c:.6g} vs banked {b:.6g} "
+                    f"({(ratio - 1) * 100:.1f}%) — improvement; re-bank "
+                    "the baseline (bench.py --proxy --out PERF_PROXY.json)")
+    return failures, warnings
+
+
+def run_proxy(argv) -> int:
+    """CPU-only proxy bench: one record per serving family, optional
+    banked write (``--out``) and tolerance gate (``--check``)."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="bench.py --proxy",
+        description="device-blind perf proxy over the serving zoo")
+    ap.add_argument("--proxy", action="store_true")
+    ap.add_argument("--families", default="all",
+                    help="comma-separated models.SERVE_SPECS families, "
+                         "or 'all' (default)")
+    ap.add_argument("--out", default=None,
+                    help="write/refresh the banked baseline JSON here")
+    ap.add_argument("--check", default=None,
+                    help="banked baseline JSON to gate against")
+    ap.add_argument("--tolerance", type=float, default=0.05,
+                    help="relative gate tolerance (default 0.05 = ±5%%)")
+    ap.add_argument("--iters", type=int, default=4,
+                    help="steady-state predict calls for the host-gap "
+                         "probe")
+    args = ap.parse_args(argv)
+
+    # the proxy is device-blind by design: pin cpu so it never claims the
+    # single-client TPU tunnel (same dance as tools/mxlint)
+    os.environ.setdefault("XLA_FLAGS",
+                          "--xla_force_host_platform_device_count=1")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from incubator_mxnet_tpu import models
+
+    if args.families == "all":
+        families = sorted(models.SERVE_SPECS)
+    else:
+        families = [f.strip() for f in args.families.split(",") if f.strip()]
+        unknown = [f for f in families if f not in models.SERVE_SPECS]
+        if unknown:
+            print(f"bench.py --proxy: unknown families {unknown}; known: "
+                  f"{sorted(models.SERVE_SPECS)}", file=sys.stderr)
+            return 2
+
+    try:
+        fams = {f: _proxy_record(f, iters=args.iters) for f in families}
+    except RuntimeError as e:
+        print(f"bench.py {e}", file=sys.stderr)
+        return 2
+
+    gate = None
+    failures, warns = [], []
+    if args.check:
+        try:
+            with open(args.check) as f:
+                baseline = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"bench.py --proxy: cannot read baseline {args.check}: "
+                  f"{e}", file=sys.stderr)
+            return 2
+        banked_jax = baseline.get("jax")
+        if banked_jax and banked_jax != jax.__version__:
+            # the cost table is a function of the jaxpr this jax version
+            # emits — a drifted gate result needs this context to diagnose
+            print(f"bench.py --proxy: note: baseline was banked on jax "
+                  f"{banked_jax}, running jax {jax.__version__} — lowering "
+                  "differences can shift the deterministic metrics",
+                  file=sys.stderr)
+        failures, warns = _proxy_compare(
+            fams, baseline.get("families", {}), args.tolerance)
+        gate = {"baseline": args.check, "tolerance": args.tolerance,
+                "failures": failures, "warnings": warns}
+        for w in warns:
+            print(f"bench.py --proxy: WARN {w}", file=sys.stderr)
+        for fl in failures:
+            print(f"bench.py --proxy: FAIL {fl}", file=sys.stderr)
+
+    if args.out:
+        banked = {"format": 1, "tolerance": args.tolerance,
+                  "generated_by": "python bench.py --proxy --out",
+                  "jax": jax.__version__,
+                  "families": {
+                      f: {k: v for k, v in rec.items()
+                          if k not in _PROXY_VOLATILE_KEYS}
+                      for f, rec in sorted(fams.items())}}
+        tmp = f"{args.out}.tmp-{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(banked, f, indent=1, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, args.out)
+
+    total_flops = sum(rec["flops_per_step"] for rec in fams.values())
+    result = {
+        "metric": "perf_proxy_flops_per_step",
+        "value": total_flops,
+        "unit": "flops/step (sum over families)",
+        "vs_baseline": None,
+        "extra": {"families": fams, "gate": gate,
+                  "backend": jax.default_backend()},
+    }
+    print(json.dumps(result))
+    return 1 if failures else 0
+
+
+def main(argv=None) -> None:
+    argv = sys.argv[1:] if argv is None else argv
+    if "--proxy" in argv:
+        raise SystemExit(run_proxy(argv))
     watchdog = _arm_watchdog()
     workload = _bench_workload()
     if workload == "resnet":

@@ -218,20 +218,32 @@ def bench_one(opname: str, case: str, builder: Callable,
 
 def run(ops: Optional[List[str]] = None, iters: int = 10,
         with_bwd: bool = True) -> List[Dict]:
+    """Bench the named ops (default: the curated set). Every finished row
+    is published as one ``opperf.result`` telemetry event, so a run with
+    ``MXTPU_TELEMETRY_JSONL`` set leaves a stream
+    ``tools/telemetry_check.py`` validates exactly like the serve bench's
+    — machine consumers read the JSONL, not scraped stdout."""
+    from incubator_mxnet_tpu import telemetry
+
     cfg = op_configs()
     names = ops if ops else DEFAULT_SET
     rows = []
     for name in names:
         if name not in cfg:
             rows.append({"op": name, "error": "no benchmark config"})
+            telemetry.emit("opperf.result", severity="warning",
+                           **rows[-1])
             continue
         for case, builder, flops in cfg[name]:
             try:
                 rows.append(bench_one(name, case, builder, flops,
                                       iters=iters, with_bwd=with_bwd))
+                telemetry.emit("opperf.result", **rows[-1])
             except Exception as e:  # pragma: no cover - per-op diagnostics
                 rows.append({"op": name, "case": case,
                              "error": f"{type(e).__name__}: {e}"})
+                telemetry.emit("opperf.result", severity="error",
+                               **rows[-1])
     return rows
 
 
@@ -266,10 +278,16 @@ def main(argv=None) -> int:
         names = [s.strip() for s in args.ops.split(",") if s.strip()]
     rows = run(names, iters=args.iters, with_bwd=not args.no_bwd)
     import jax
+    from incubator_mxnet_tpu import telemetry
     report = {"backend": jax.default_backend(),
               "device": str(jax.devices()[0].device_kind),
               "rows": rows}
-    text = json.dumps(report, indent=2)
+    # the summary rides the telemetry stream too (per-row events were
+    # emitted by run()); stdout keeps the one strict-JSON report line
+    telemetry.emit("opperf.report", backend=report["backend"],
+                   device=report["device"], rows=len(rows),
+                   errors=sum(1 for r in rows if "error" in r))
+    text = telemetry.dumps_strict(report, indent=2)
     if args.json:
         with open(args.json, "w") as f:
             f.write(text)

@@ -13,7 +13,11 @@ one command produces a BENCH-style JSON record covering
    high-water, and the compile-cache counters with **zero post-warmup
    recompiles asserted** (rc != 0 on violation);
 3. per-stage wall time from the profiler span recorder
-   (pad / compute / unpad / batch).
+   (pad / compute / unpad / batch), a ``serve.predict`` host-gap
+   attribution (``profiler.step_report``), and a device-blind perf-proxy
+   record (``analysis.hlo.cost`` FLOPs/bytes/fusion per bucket graph —
+   the serving sibling of ``bench.py --proxy``), also emitted as one
+   ``perf.proxy`` telemetry event.
 
 Usage::
 
@@ -219,6 +223,10 @@ def main(argv=None) -> int:
               f"finding(s): {[d.code for d in analysis_rep.errors]}",
               file=sys.stderr)
         return 1
+    # device-blind perf-proxy record (the serving sibling of bench.py
+    # --proxy): price every bucket graph before warmup — trace-only, so
+    # a cost explosion is visible even if warmup would then be slow
+    cost_rep = _hlo.cost(model, max_graphs=max(8, table.num_buckets()))
     t0 = time.perf_counter()
     warm = model.warmup()
     profiler.reset_spans()
@@ -227,6 +235,20 @@ def main(argv=None) -> int:
     dyn = dynamic_run(model, spec, make_request, args.requests,
                       args.clients, deadline)
     spans = profiler.span_records()
+    step_rep = profiler.step_report(frame="serve.predict")
+    proxy = {
+        "graphs": len(cost_rep.rows),
+        "flops_per_step": cost_rep.model_flops_per_step(),
+        "bytes_per_step": cost_rep.bytes_per_step(),
+        "fusion_candidates": (cost_rep.head.fusion_candidates
+                              if cost_rep.head else 0),
+        "transcendentals": (cost_rep.head.transcendentals
+                            if cost_rep.head else 0),
+        "host_gap_ms": step_rep["host_gap_ms_mean"],
+        "instrumented_pct": step_rep["instrumented_pct"],
+    }
+    from incubator_mxnet_tpu import telemetry
+    telemetry.emit("perf.proxy", family=args.model, **proxy)
 
     best = max(sweep, key=lambda r: r["rows_per_sec"])
     recompiles = dyn["compile_cache"]["post_warmup_compiles"]
@@ -244,6 +266,8 @@ def main(argv=None) -> int:
             "dynamic": dyn,
             "stage_spans": {k: spans[k] for k in sorted(spans)
                             if k.startswith("serve.")},
+            "proxy": proxy,
+            "step_report": step_rep,
             "analysis": analysis_rep.summary_dict(),
             "wall_total_s": round(time.perf_counter() - t0, 1),
         },

@@ -84,6 +84,9 @@ CODES = {
     "MX706": "trace-signature divergence: call sites of one model lower "
              "to different signatures (static twin of the telemetry "
              "compile ledger)",
+    "MX707": "informational per-graph cost table entry (FLOPs, bytes, "
+             "transcendentals, fusion groups) from analysis.hlo.cost — "
+             "never gates a build",
 }
 
 #: Default severity per code — THE single source of truth the passes,
@@ -105,6 +108,7 @@ DEFAULT_SEVERITY: Dict[str, str] = {
     "MX601": "warning",
     "MX701": "error", "MX702": "warning", "MX703": "warning",
     "MX704": "warning", "MX705": "error", "MX706": "warning",
+    "MX707": "info",
 }
 
 
@@ -129,7 +133,7 @@ class Diagnostic:
     op: Optional[str] = None
     attrs: Optional[dict] = None
     pass_name: str = ""
-    #: "error" | "warning"; None = take DEFAULT_SEVERITY[code]
+    #: "error" | "warning" | "info"; None = take DEFAULT_SEVERITY[code]
     severity: Optional[str] = None
 
     def __post_init__(self):
@@ -186,6 +190,11 @@ class Report:
     @property
     def warnings(self) -> List[Diagnostic]:
         return [d for d in self.diagnostics if d.severity == "warning"]
+
+    @property
+    def infos(self) -> List[Diagnostic]:
+        """Informational rows (MX707 cost tables) — never gate a build."""
+        return [d for d in self.diagnostics if d.severity == "info"]
 
     @property
     def ok(self) -> bool:

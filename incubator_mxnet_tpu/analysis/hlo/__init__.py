@@ -15,11 +15,19 @@ Programmatic entry point (called by ``serve.ModelRegistry.load`` and
     report = mx.analysis.hlo.verify(model, sample_args)
     report.raise_if_errors()
 
+The same traced graphs feed the device-blind cost model
+(:mod:`~.cost`): ``mx.analysis.hlo.cost(model, sample_args)`` prices
+FLOPs / bytes / transcendentals / fusion groups per graph — the numbers
+``bench.py --proxy`` banks in ``PERF_PROXY.json`` and the CI
+``perf-proxy`` job gates with a ±5% tolerance. ``verify(...,
+cost=True)`` surfaces the table as informational MX707 diagnostics.
+
 CLI::
 
     python -m tools.mxlint --hlo all --format=json
     python -m tools.mxlint --hlo bert_encoder
     python -m tools.mxlint --hlo my_pkg.my_mod:factory
+    python -m tools.mxlint --hlo bert --cost
 
 Pass registry (the compiled-graph sibling of ``analysis/passes.py``):
 ``HLO_PASSES``, extendable with :func:`register_hlo_pass`.
@@ -36,17 +44,42 @@ from .passes import (  # noqa: F401
 from .trace import (  # noqa: F401
     TracedGraph, TraceResult, trace_entry, walk_eqns,
 )
+from .cost import (  # noqa: F401  (importing registers the hlo_cost pass)
+    CostReport, GraphCost, cost, cost_table, graph_cost,
+)
 
-__all__ = ["verify", "trace_entry", "TracedGraph", "TraceResult",
-           "HLO_PASSES", "register_hlo_pass", "list_hlo_passes",
-           "run_hlo_passes", "walk_eqns"]
+__all__ = ["verify", "verify_trace", "trace_entry", "TracedGraph",
+           "TraceResult", "HLO_PASSES", "register_hlo_pass",
+           "list_hlo_passes", "run_hlo_passes", "walk_eqns",
+           "cost", "cost_table", "graph_cost", "CostReport", "GraphCost"]
+
+
+def verify_trace(result: TraceResult, *,
+                 passes: Optional[Sequence[str]] = None,
+                 const_limit_bytes: int = 1 << 20,
+                 donation_min_bytes: int = 1 << 16,
+                 cost: bool = False) -> Report:
+    """Run the MX7xx passes over an already-traced entry and fold in the
+    tracer's own diagnostics/coverage notes — the shared second half of
+    :func:`verify`, exposed so a caller that needs the
+    :class:`~.trace.TraceResult` for something else (``mxlint --cost``
+    prices the same graphs) traces exactly once."""
+    report = run_hlo_passes(result.graphs, names=passes,
+                            const_limit_bytes=const_limit_bytes,
+                            donation_min_bytes=donation_min_bytes,
+                            cost=cost)
+    for d in result.diags:
+        report.add(d)
+    report.skipped.extend(result.skipped)
+    return report
 
 
 def verify(model, sample_args=None, *,
            passes: Optional[Sequence[str]] = None,
            max_graphs: int = 8,
            const_limit_bytes: int = 1 << 20,
-           donation_min_bytes: int = 1 << 16) -> Report:
+           donation_min_bytes: int = 1 << 16,
+           cost: bool = False) -> Report:
     """Trace ``model`` (every bucket/signature/call site, capped at
     ``max_graphs``) and run the registered MX7xx passes; returns the
     merged :class:`~..diagnostics.Report`.
@@ -60,12 +93,12 @@ def verify(model, sample_args=None, *,
     the same signature-establishing contract as
     ``CompiledModel(example_args=...)`` — which mutates the block
     (hybridize + deferred parameter init).
+
+    ``cost=True`` additionally runs the informational ``hlo_cost`` pass,
+    appending one MX707 info row per graph (the
+    :func:`~.cost.graph_cost` table in diagnostic form).
     """
-    result = trace_entry(model, sample_args, max_graphs=max_graphs)
-    report = run_hlo_passes(result.graphs, names=passes,
-                            const_limit_bytes=const_limit_bytes,
-                            donation_min_bytes=donation_min_bytes)
-    for d in result.diags:
-        report.add(d)
-    report.skipped.extend(result.skipped)
-    return report
+    return verify_trace(trace_entry(model, sample_args,
+                                    max_graphs=max_graphs),
+                        passes=passes, const_limit_bytes=const_limit_bytes,
+                        donation_min_bytes=donation_min_bytes, cost=cost)

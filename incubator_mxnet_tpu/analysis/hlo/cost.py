@@ -1,0 +1,436 @@
+"""Analytic cost model over traced compiled graphs — the device-blind
+perf proxy.
+
+The device bench can go blind (a wedged TPU tunnel, no hardware in CI),
+but the *compiled graph* is always available: ``trace.py`` lowers any
+entry point to a jaxpr without an XLA compile. This module walks that
+jaxpr and prices it — FLOPs (dot/conv from dimension numbers, everything
+else per output element), transcendental element counts, parameter /
+input / output / activation bytes, and fusion statistics (maximal
+def-use-connected groups of elementwise ops — the metric "Operator
+Fusion in XLA" (arXiv 2301.13062) shows tracks realized performance).
+Every count is a deterministic function of the traced graph, so two runs
+of the same code produce byte-identical tables — the property the CI
+``perf-proxy`` gate (``bench.py --proxy`` vs the banked
+``PERF_PROXY.json``) relies on.
+
+Entry points::
+
+    rep = mx.analysis.hlo.cost(model, sample_args)   # CostReport
+    rep.model_flops_per_step()                       # derived headline
+    print(rep.text_table())                          # mxlint --hlo --cost
+
+The same numbers surface as an informational MX707 diagnostic per graph
+when the ``hlo_cost`` pass runs with ``cost=True``
+(``mx.analysis.hlo.verify(model, sample_args, cost=True)``) — opt-in so
+staging gates stay signal-only by default.
+
+Accounting rules (documented limits, all deterministic):
+
+- ``scan`` bodies multiply execution metrics (FLOPs/transcendentals/
+  activation bytes) by the trip count; ``while`` bodies count once (trip
+  count unknowable statically — noted per graph); ``cond`` prices its
+  costliest branch.
+- fusion statistics are compile-time metrics: counted once per (sub-)
+  jaxpr, never multiplied by trip counts.
+- unknown primitives price one FLOP per output element and are tallied
+  in ``unknown_eqns`` so a drifting jax version is visible, not silent.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as onp
+
+from ..diagnostics import Diagnostic
+from .trace import TracedGraph, trace_entry
+
+__all__ = ["GraphCost", "CostReport", "graph_cost", "cost_table", "cost"]
+
+
+# -- primitive taxonomy ------------------------------------------------------
+#: one transcendental evaluation per output element (counted separately —
+#: TPUs run these on the slower special-function path)
+_TRANSCENDENTAL = frozenset({
+    "exp", "exp2", "log", "log2", "log1p", "expm1", "tanh", "sin", "cos",
+    "tan", "asin", "acos", "atan", "atan2", "sinh", "cosh", "asinh",
+    "acosh", "atanh", "erf", "erfc", "erf_inv", "logistic", "pow",
+    "rsqrt", "sqrt", "cbrt", "digamma", "lgamma", "igamma", "igammac",
+})
+
+#: one FLOP per output element
+_ELEMENTWISE = frozenset({
+    "add", "sub", "mul", "div", "rem", "max", "min", "neg", "abs",
+    "sign", "floor", "ceil", "round", "clamp", "select_n", "and", "or",
+    "xor", "not", "shift_left", "shift_right_logical",
+    "shift_right_arithmetic", "eq", "ne", "ge", "gt", "le", "lt",
+    "nextafter", "is_finite", "square", "reciprocal", "integer_pow",
+    "add_any", "real", "imag", "conj", "complex", "population_count",
+    "clz", "random_bits",
+})
+
+#: one FLOP per *input* element (a reduction reads everything once)
+_REDUCE = frozenset({
+    "reduce_sum", "reduce_max", "reduce_min", "reduce_prod",
+    "reduce_and", "reduce_or", "reduce_xor", "argmax", "argmin",
+    "cumsum", "cumprod", "cummax", "cummin", "cumlogsumexp",
+    "reduce_window_sum", "reduce_window_max", "reduce_window_min",
+})
+
+#: zero FLOPs — data movement / relabeling XLA lowers to copies or elides
+_MOVEMENT = frozenset({
+    "reshape", "broadcast_in_dim", "transpose", "slice", "dynamic_slice",
+    "dynamic_update_slice", "concatenate", "pad", "rev", "gather",
+    "scatter", "scatter-add", "scatter_add", "squeeze", "expand_dims",
+    "iota", "convert_element_type", "bitcast_convert_type",
+    "stop_gradient", "split", "sort", "top_k", "copy", "device_put",
+    "random_seed", "random_wrap", "random_fold_in", "random_unwrap",
+    "reduce_precision", "sharding_constraint", "broadcast",
+})
+
+#: eqns XLA's fusion pass can merge with their producers/consumers; a
+#: def-use-connected group of these lowers to ~one fused kernel
+_FUSIBLE = (_TRANSCENDENTAL | _ELEMENTWISE
+            | frozenset({"broadcast_in_dim", "convert_element_type",
+                         "reshape", "iota", "copy", "reduce_precision"}))
+
+
+def _is_literal(v) -> bool:
+    return type(v).__name__ == "Literal"
+
+
+def _elems(aval) -> int:
+    shape = getattr(aval, "shape", None)
+    if shape is None:
+        return 1
+    return int(onp.prod(shape, dtype=onp.int64)) if len(shape) else 1
+
+
+def _nbytes(aval) -> int:
+    try:
+        d = onp.dtype(aval.dtype)
+    except (TypeError, AttributeError):
+        return 0                      # extended dtypes (PRNG keys)
+    return _elems(aval) * d.itemsize
+
+
+@dataclass
+class GraphCost:
+    """One traced graph priced. ``flops`` is per executed call — for a
+    ``kind == "train"`` graph that IS the model-FLOPs-per-step."""
+
+    entry: str
+    site: str
+    kind: str = "infer"
+    flops: float = 0.0
+    matmul_flops: float = 0.0        # dot_general + conv share of flops
+    transcendentals: int = 0         # transcendental element evaluations
+    param_bytes: int = 0
+    input_bytes: int = 0
+    output_bytes: int = 0
+    activation_bytes: int = 0        # every eqn output, the traffic proxy
+    eqns: int = 0
+    fusible_eqns: int = 0
+    fusion_groups: int = 0           # def-use components of fusible eqns
+    fusion_candidates: int = 0       # groups of >= 2 eqns (real fusions)
+    unknown_eqns: int = 0
+    notes: List[str] = field(default_factory=list)
+
+    @property
+    def label(self) -> str:
+        return f"{self.entry}[{self.site}]"
+
+    @property
+    def bytes_per_step(self) -> int:
+        """Memory traffic floor per call: params + inputs + outputs."""
+        return self.param_bytes + self.input_bytes + self.output_bytes
+
+    def to_dict(self) -> dict:
+        return {
+            "entry": self.entry, "site": self.site, "kind": self.kind,
+            "flops": float(self.flops),
+            "matmul_flops": float(self.matmul_flops),
+            "transcendentals": int(self.transcendentals),
+            "param_bytes": int(self.param_bytes),
+            "input_bytes": int(self.input_bytes),
+            "output_bytes": int(self.output_bytes),
+            "activation_bytes": int(self.activation_bytes),
+            "bytes_per_step": int(self.bytes_per_step),
+            "eqns": int(self.eqns),
+            "fusible_eqns": int(self.fusible_eqns),
+            "fusion_groups": int(self.fusion_groups),
+            "fusion_candidates": int(self.fusion_candidates),
+            "unknown_eqns": int(self.unknown_eqns),
+            "notes": list(self.notes),
+        }
+
+
+# -- jaxpr walk --------------------------------------------------------------
+
+def _sub_jaxprs(eqn):
+    from .trace import _jaxprs_in
+    for v in eqn.params.values():
+        yield from _jaxprs_in(v)
+
+
+def _fusion_stats(jaxpr):
+    """(fusible_eqns, fusion_groups, fusion_candidates) at ONE jaxpr
+    level: union-find over fusible eqns connected by def-use edges."""
+    fusible = [i for i, e in enumerate(jaxpr.eqns)
+               if e.primitive.name in _FUSIBLE]
+    if not fusible:
+        return 0, 0, 0
+    idx = set(fusible)
+    parent = {i: i for i in fusible}
+
+    def find(i):
+        while parent[i] != i:
+            parent[i] = parent[parent[i]]
+            i = parent[i]
+        return i
+
+    producer = {}
+    for i, eqn in enumerate(jaxpr.eqns):
+        for o in eqn.outvars:
+            producer[o] = i
+    for i in fusible:
+        for v in jaxpr.eqns[i].invars:
+            if _is_literal(v):
+                continue
+            j = producer.get(v)
+            if j is not None and j in idx:
+                parent[find(i)] = find(j)
+    sizes: Dict[int, int] = {}
+    for i in fusible:
+        r = find(i)
+        sizes[r] = sizes.get(r, 0) + 1
+    groups = len(sizes)
+    candidates = sum(1 for s in sizes.values() if s >= 2)
+    return len(fusible), groups, candidates
+
+
+def _eqn_into(eqn, mul: float, acc: dict) -> None:
+    name = eqn.primitive.name
+    out_elems = sum(_elems(o.aval) for o in eqn.outvars
+                    if hasattr(o, "aval"))
+    out_bytes = sum(_nbytes(o.aval) for o in eqn.outvars
+                    if hasattr(o, "aval"))
+    flops = 0.0
+    if name == "dot_general":
+        (lc, _rc), _batch = eqn.params["dimension_numbers"]
+        lhs = eqn.invars[0].aval
+        contract = 1
+        for d in lc:
+            contract *= int(lhs.shape[d])
+        flops = 2.0 * out_elems * contract
+        acc["matmul_flops"] += flops * mul
+    elif name == "conv_general_dilated":
+        dn = eqn.params["dimension_numbers"]
+        rhs = eqn.invars[1].aval
+        rhs_spec = dn.rhs_spec          # (out_ch, in_ch/groups, *spatial)
+        in_ch = int(rhs.shape[rhs_spec[1]])
+        ksp = 1
+        for d in rhs_spec[2:]:
+            ksp *= int(rhs.shape[d])
+        flops = 2.0 * out_elems * in_ch * ksp
+        acc["matmul_flops"] += flops * mul
+    elif name in _TRANSCENDENTAL:
+        flops = float(out_elems)
+        acc["transcendentals"] += int(out_elems * mul)
+    elif name in _ELEMENTWISE:
+        flops = float(out_elems)
+    elif name in _REDUCE:
+        ins = [v for v in eqn.invars
+               if not _is_literal(v) and hasattr(v, "aval")]
+        flops = float(_elems(ins[0].aval)) if ins else float(out_elems)
+    elif name in _MOVEMENT:
+        flops = 0.0
+    else:
+        flops = float(out_elems)
+        acc["unknown_eqns"] += 1
+    acc["flops"] += flops * mul
+    acc["activation_bytes"] += out_bytes * mul
+    acc["eqns"] += 1
+
+
+def _closed_to_open(j):
+    return j.jaxpr if hasattr(j, "jaxpr") and hasattr(j, "consts") else j
+
+
+def _walk_jaxpr(jaxpr, mul: float, acc: dict) -> None:
+    fus = _fusion_stats(jaxpr)
+    acc["fusible_eqns"] += fus[0]
+    acc["fusion_groups"] += fus[1]
+    acc["fusion_candidates"] += fus[2]
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name == "scan":
+            length = int(eqn.params.get("length", 1))
+            _walk_jaxpr(_closed_to_open(eqn.params["jaxpr"]),
+                        mul * max(length, 1), acc)
+            continue
+        if name == "while":
+            _walk_jaxpr(_closed_to_open(eqn.params["body_jaxpr"]), mul, acc)
+            _walk_jaxpr(_closed_to_open(eqn.params["cond_jaxpr"]), mul, acc)
+            note = "while body priced for one trip (count unknowable)"
+            if note not in acc["notes"]:
+                acc["notes"].append(note)
+            continue
+        if name == "cond":
+            branches = eqn.params.get("branches", ())
+            best = None
+            for b in branches:
+                sub = _fresh_acc()
+                _walk_jaxpr(_closed_to_open(b), mul, sub)
+                if best is None or sub["flops"] > best["flops"]:
+                    best = sub
+            if best is not None:
+                for k, v in best.items():
+                    if k == "notes":
+                        acc["notes"].extend(n for n in v
+                                            if n not in acc["notes"])
+                    else:
+                        acc[k] += v
+            continue
+        subs = list(_sub_jaxprs(eqn))
+        if subs:                      # pjit / remat / custom_*_call bodies
+            for s in subs:
+                _walk_jaxpr(s, mul, acc)
+            continue
+        _eqn_into(eqn, mul, acc)
+
+
+def _fresh_acc() -> dict:
+    return {"flops": 0.0, "matmul_flops": 0.0, "transcendentals": 0,
+            "activation_bytes": 0, "eqns": 0, "fusible_eqns": 0,
+            "fusion_groups": 0, "fusion_candidates": 0, "unknown_eqns": 0,
+            "notes": []}
+
+
+def graph_cost(g: TracedGraph) -> GraphCost:
+    """Price one :class:`~.trace.TracedGraph` — THE cost function every
+    surface (``analysis.hlo.cost``, the MX707 pass, ``mxlint --cost``,
+    ``bench.py --proxy``) shares, so they can never disagree."""
+    jaxpr = g.closed.jaxpr
+    acc = _fresh_acc()
+    _walk_jaxpr(jaxpr, 1.0, acc)
+    param_bytes = input_bytes = 0
+    for v, role in zip(jaxpr.invars, g.roles):
+        if role in ("param", "state"):
+            param_bytes += _nbytes(v.aval)
+        elif role == "input":
+            input_bytes += _nbytes(v.aval)
+    output_bytes = sum(_nbytes(o.aval) for o in jaxpr.outvars
+                       if hasattr(o, "aval"))
+    return GraphCost(
+        entry=g.entry, site=g.site, kind=g.kind,
+        flops=acc["flops"], matmul_flops=acc["matmul_flops"],
+        transcendentals=acc["transcendentals"],
+        param_bytes=param_bytes, input_bytes=input_bytes,
+        output_bytes=output_bytes,
+        activation_bytes=int(acc["activation_bytes"]),
+        eqns=acc["eqns"], fusible_eqns=acc["fusible_eqns"],
+        fusion_groups=acc["fusion_groups"],
+        fusion_candidates=acc["fusion_candidates"],
+        unknown_eqns=acc["unknown_eqns"], notes=acc["notes"])
+
+
+def cost_table(graphs: List[TracedGraph]) -> List[GraphCost]:
+    return [graph_cost(g) for g in graphs]
+
+
+@dataclass
+class CostReport:
+    """Cost rows for every traced graph of one entry, plus the derived
+    headline metrics the perf-proxy gate banks."""
+
+    rows: List[GraphCost] = field(default_factory=list)
+    skipped: List[str] = field(default_factory=list)
+
+    @property
+    def head(self) -> Optional[GraphCost]:
+        """The costliest graph — for a bucketed serving model the largest
+        bucket, for a trainer the step graph."""
+        return max(self.rows, key=lambda r: r.flops) if self.rows else None
+
+    def model_flops_per_step(self) -> float:
+        """Derived model-FLOPs-per-step: the costliest graph's FLOPs (one
+        executed step/call runs exactly one bucket's executable)."""
+        return float(self.head.flops) if self.rows else 0.0
+
+    def bytes_per_step(self) -> int:
+        return int(self.head.bytes_per_step) if self.rows else 0
+
+    def to_dict(self) -> dict:
+        return {"rows": [r.to_dict() for r in self.rows],
+                "model_flops_per_step": self.model_flops_per_step(),
+                "bytes_per_step": self.bytes_per_step(),
+                "skipped": list(self.skipped)}
+
+    def text_table(self) -> str:
+        """Aligned human table (``mxlint --hlo <t> --cost``)."""
+        hdr = (f"{'graph':<40} {'kind':<6} {'MFLOP':>10} {'mm%':>5} "
+               f"{'trans':>8} {'par KiB':>9} {'act KiB':>9} "
+               f"{'io KiB':>9} {'eqns':>5} {'fus':>4} {'grp':>4} "
+               f"{'cand':>4}")
+        lines = [hdr, "-" * len(hdr)]
+        for r in self.rows:
+            mm = 100.0 * r.matmul_flops / r.flops if r.flops else 0.0
+            io_kib = (r.input_bytes + r.output_bytes) >> 10
+            lines.append(
+                f"{r.label:<40} {r.kind:<6} {r.flops / 1e6:>10.3f} "
+                f"{mm:>5.1f} {r.transcendentals:>8} "
+                f"{r.param_bytes >> 10:>9} {r.activation_bytes >> 10:>9} "
+                f"{io_kib:>9} {r.eqns:>5} {r.fusible_eqns:>4} "
+                f"{r.fusion_groups:>4} {r.fusion_candidates:>4}")
+        if self.rows:
+            lines.append(
+                f"model_flops_per_step={self.model_flops_per_step():.6g} "
+                f"bytes_per_step={self.bytes_per_step()}")
+        for s in self.skipped:
+            lines.append(f"note: skipped {s}")
+        return "\n".join(lines)
+
+
+def cost(model, sample_args=None, max_graphs: int = 8) -> CostReport:
+    """Trace ``model`` (same dispatch as :func:`~..verify`: CompiledModel
+    buckets, SymbolBlock signatures, ShardedTrainer step, HybridBlock,
+    plain callable) and price every traced graph. Never XLA-compiles."""
+    result = trace_entry(model, sample_args, max_graphs=max_graphs)
+    return CostReport(rows=cost_table(result.graphs),
+                      skipped=list(result.skipped))
+
+
+# -- the informational MX707 pass -------------------------------------------
+
+def _register():
+    from .passes import register_hlo_pass
+
+    @register_hlo_pass("hlo_cost",
+                       describe="per-graph cost table (FLOPs, bytes, "
+                                "transcendentals, fusion groups) as "
+                                "informational MX707 rows — opt-in via "
+                                "cost=True")
+    def hlo_cost(ctx) -> None:
+        """Informational per-graph cost rows (MX707). Opt-in: runs only
+        when the pass context carries ``cost=True``
+        (``verify(model, args, cost=True)`` / ``mxlint --hlo --cost``),
+        so staging gates stay signal-only by default."""
+        if not ctx.opt("cost", False):
+            return
+        for g in ctx.graphs:
+            c = graph_cost(g)
+            ctx.diag(
+                "MX707",
+                f"cost: {c.flops:.6g} FLOPs ({c.matmul_flops:.6g} matmul), "
+                f"{c.transcendentals} transcendental elems, "
+                f"{c.param_bytes >> 10} KiB params, "
+                f"{c.activation_bytes >> 10} KiB activations, "
+                f"{c.input_bytes + c.output_bytes >> 10} KiB in+out, "
+                f"{c.eqns} eqns, {c.fusible_eqns} fusible in "
+                f"{c.fusion_groups} group(s) "
+                f"({c.fusion_candidates} multi-op)", g, severity="info")
+
+
+_register()

@@ -35,6 +35,7 @@ from ..base import MXNetError
 from ..context import current_context
 from ..ndarray import NDArray
 from .. import autograd
+from .. import profiler as _prof
 from .. import optimizer as opt_mod
 from .. import random as random_mod
 from ..gluon import _trace
@@ -380,8 +381,10 @@ class ShardedTrainer:
                     self._step_sigs.add(sig)
                     _clog.note("trainer.step", sig, wall_ms=dispatch_ms,
                                warmup=first_sig)
+                t_sync0 = time.perf_counter()
                 rolled_back = (self._guard is not None
                                and self._apply_guard(loss, gnorm))
+                sync_ms = (time.perf_counter() - t_sync0) * 1e3
             wall_ms = (time.perf_counter() - t_step0) * 1e3
             fields = {"wall_ms": round(wall_ms, 3),
                       "place_ms": round(place_ms, 3),
@@ -390,8 +393,24 @@ class ShardedTrainer:
                 # guard runs synced loss/grad-norm to host — free to report
                 fields.update(loss=self.last_loss,
                               grad_norm=self.last_grad_norm,
-                              rolled_back=rolled_back)
+                              rolled_back=rolled_back,
+                              device_wait_ms=round(sync_ms, 3))
             _tele.emit("train.step", step=attempted, **fields)
+            # one "step" frame + its segments on the profiler timeline —
+            # the raw material of profiler.step_report()'s host-gap
+            # attribution (all from the timings measured above, so the
+            # event fields and the span trace can never disagree)
+            _prof.record_span("step.place", place_ms, parent="step",
+                              step=attempted, t0=t_place0)
+            _prof.record_span("step.dispatch", dispatch_ms, parent="step",
+                              step=attempted, t0=t_disp0)
+            if self._guard is not None:
+                # the guard's loss/grad-norm device_get is the one point
+                # the host provably blocks on the device inside the step
+                _prof.record_span("step.device_wait", sync_ms,
+                                  parent="step", step=attempted, t0=t_sync0)
+            _prof.record_span("step", wall_ms, kind="frame",
+                              step=attempted, t0=t_step0)
         self._m_steps.inc()
         self._m_step_ms.observe(wall_ms)
         if self._guard is not None and self.last_grad_norm is not None:

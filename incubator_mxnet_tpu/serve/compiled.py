@@ -279,31 +279,42 @@ class CompiledModel:
     def predict(self, *args):
         """Run one padded, compiled inference call; padding is sliced back
         off every output so callers never see bucket geometry. Accepts
-        NDArray / numpy / nested-list inputs; returns NDArray(s)."""
-        with profiler.Scope("serve.pad"):
-            arrays = [_as_numpy(a) for a in args]
-            if len(arrays) != self._n_in:
-                raise MXNetError(f"expected {self._n_in} inputs, "
-                                 f"got {len(arrays)}")
-            sizes = self._sizes_of(arrays)
-            assignment = self._table.assignment(sizes)
-            sig = self.signature_for(assignment)
-            key = tuple(sig)
-            padded = self._pad(arrays, assignment)
-        with self._lock:
-            hit = key in self._exe
-            if hit:
-                self.stats["hits"] += 1
-                exe, info = self._exe[key]
-            else:
-                self.stats["misses"] += 1
-                exe, info = self._compile(key, sig)
-            pvals = self._pvals
-        with profiler.Scope("serve.compute"):
-            outs = exe(self._key_data, *padded, *pvals)
-        with profiler.Scope("serve.unpad"):
-            result = self._unpad(list(outs), info, sizes)
-        return result
+        NDArray / numpy / nested-list inputs; returns NDArray(s).
+
+        The whole call is one ``serve.predict`` profiler frame with
+        ``serve.pad`` / ``serve.compute`` / ``serve.unpad`` child spans,
+        so ``profiler.step_report(frame="serve.predict")`` attributes
+        the serving host gap the same way the trainer's ``step`` frame
+        does for training."""
+        with profiler.Frame("serve.predict"):
+            with profiler.Scope("serve.pad"):
+                arrays = [_as_numpy(a) for a in args]
+                if len(arrays) != self._n_in:
+                    raise MXNetError(f"expected {self._n_in} inputs, "
+                                     f"got {len(arrays)}")
+                sizes = self._sizes_of(arrays)
+                assignment = self._table.assignment(sizes)
+                sig = self.signature_for(assignment)
+                key = tuple(sig)
+                padded = self._pad(arrays, assignment)
+            with self._lock:
+                hit = key in self._exe
+                if hit:
+                    self.stats["hits"] += 1
+                    exe, info = self._exe[key]
+                else:
+                    self.stats["misses"] += 1
+                    # a cold-bucket compile is seconds of host work — give
+                    # it its own segment so step_report shows "compile",
+                    # not an inflated python remainder / host gap
+                    with profiler.Scope("serve.compile"):
+                        exe, info = self._compile(key, sig)
+                pvals = self._pvals
+            with profiler.Scope("serve.compute"):
+                outs = exe(self._key_data, *padded, *pvals)
+            with profiler.Scope("serve.unpad"):
+                result = self._unpad(list(outs), info, sizes)
+            return result
 
     __call__ = predict
 

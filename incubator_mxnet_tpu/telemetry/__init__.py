@@ -91,6 +91,11 @@ def snapshot(recent: int = 5) -> Dict:
         "metrics": metrics.to_dict(),
         "compiles": compile_log.summary(),
         "spans": profiler.span_records(),
+        # host-gap attribution over the recorded step frames (trainer
+        # "step", serving "serve.predict") — empty-shaped when no frames
+        "step_report": {"step": profiler.step_report("step"),
+                        "serve.predict":
+                            profiler.step_report("serve.predict")},
     }
     return sanitize(doc)
 

@@ -171,16 +171,23 @@ def prometheus_text() -> str:
 def chrome_trace(include_events: bool = True) -> str:
     """chrome://tracing JSON merging the profiler's recent raw spans
     (``ph: "X"`` complete events) with bus events (``ph: "i"`` instants,
-    one track per kind). Timestamps are wall-clock microseconds, so the
-    two sources land on one comparable timeline. Load in
-    chrome://tracing or ui.perfetto.dev."""
+    one track per kind). Span timestamps all come from the profiler's
+    single anchored clock, so a child scope's interval is contained in
+    its parent's — nested scopes *nest* on the rendered timeline rather
+    than interleaving — and the parent/depth/step metadata rides in
+    ``args``. Load in chrome://tracing or ui.perfetto.dev."""
     from .. import profiler
     trace = []
-    for name, kind, t_start, dur_ms in profiler.recent_spans():
-        trace.append({"name": name, "cat": kind, "ph": "X",
-                      "ts": round(t_start * 1e6, 1),
-                      "dur": round(dur_ms * 1e3, 1),
-                      "pid": 1, "tid": 1})
+    for rec in profiler.recent_spans():
+        args = {"depth": rec.depth}
+        if rec.parent is not None:
+            args["parent"] = rec.parent
+        if rec.step is not None:
+            args["step"] = rec.step
+        trace.append({"name": rec.name, "cat": rec.kind, "ph": "X",
+                      "ts": round(rec.t_start * 1e6, 1),
+                      "dur": round(rec.dur_ms * 1e3, 1),
+                      "pid": 1, "tid": 1, "args": args})
     if include_events:
         from . import events as _events
         for ev in _events.events():

@@ -466,7 +466,9 @@ class TestDiagnosticRegistryAudit:
         from incubator_mxnet_tpu.analysis.diagnostics import (
             CODES, DEFAULT_SEVERITY)
         assert set(CODES) == set(DEFAULT_SEVERITY)
-        assert set(DEFAULT_SEVERITY.values()) <= {"error", "warning"}
+        # "info" = informational families (MX707 cost rows): never gate
+        assert set(DEFAULT_SEVERITY.values()) <= {"error", "warning",
+                                                  "info"}
 
     def test_diagnostic_defaults_severity_from_registry(self):
         d = Diagnostic("MX201", "m", node="n")
@@ -589,7 +591,8 @@ class TestHloPasses:
         from incubator_mxnet_tpu.analysis import hlo
         names = hlo.list_hlo_passes()
         assert names == ["hlo_transfer", "hlo_promotion", "hlo_dead_code",
-                         "hlo_donation", "hlo_constants", "hlo_signature"]
+                         "hlo_donation", "hlo_constants", "hlo_signature",
+                         "hlo_cost"]
         with pytest.raises(MXNetError, match="unknown hlo pass"):
             hlo.run_hlo_passes([], names=["nope"])
 

@@ -40,3 +40,24 @@ def test_run_performance_test_api():
 def test_unknown_op_reports_error_row():
     rows = run(["definitely_not_an_op"], iters=1)
     assert rows[0]["error"] == "no benchmark config"
+
+
+def test_rows_flow_through_telemetry_jsonl(tmp_path):
+    # the satellite contract: opperf results ride the telemetry JSONL
+    # stream, validated by the same checker as the serve bench
+    from incubator_mxnet_tpu import telemetry
+    from tools.telemetry_check import check_stream
+
+    telemetry.reset()
+    path = tmp_path / "opperf_events.jsonl"
+    telemetry.install_jsonl(str(path))
+    try:
+        rows = run(["sqrt"], iters=1)
+        assert rows and "error" not in rows[0]
+        evs = telemetry.get_events("opperf.result")
+        assert evs and evs[-1].fields["op"] == "sqrt"
+        assert evs[-1].fields["fwd_ms"] > 0
+    finally:
+        telemetry.reset()          # closes + unsubscribes the sink
+    problems = check_stream(path.read_text().splitlines(), name=str(path))
+    assert problems == [], problems

@@ -1,0 +1,238 @@
+"""Hierarchical step profiler: nested Scope parenting, step_report
+host-gap attribution, atomic chrome-trace dump, and the chrome-trace
+merge nesting contract (spans must nest, not interleave)."""
+import json
+import os
+import time
+
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon, parallel, profiler, telemetry
+
+
+@pytest.fixture(autouse=True)
+def _clean_spans():
+    profiler.reset_spans()
+    yield
+    profiler.reset_spans()
+
+
+# ---------------------------------------------------------------------------
+# nested Scope parenting
+# ---------------------------------------------------------------------------
+class TestScopeParenting:
+    def test_nested_scopes_record_parent_and_depth(self):
+        with profiler.Scope("outer"):
+            with profiler.Scope("inner"):
+                time.sleep(0.001)
+        recs = {r.name: r for r in profiler.recent_spans()}
+        assert recs["outer"].parent is None and recs["outer"].depth == 0
+        assert recs["inner"].parent == "outer" and recs["inner"].depth == 1
+
+    def test_nested_intervals_are_contained(self):
+        # one anchored clock: the child's [start, end] interval must be
+        # inside the parent's, exactly — no cross-clock drift
+        with profiler.Scope("outer"):
+            with profiler.Scope("inner"):
+                time.sleep(0.001)
+            time.sleep(0.001)
+        recs = {r.name: r for r in profiler.recent_spans()}
+        o, i = recs["outer"], recs["inner"]
+        assert i.t_start >= o.t_start
+        assert i.t_start + i.dur_ms / 1e3 <= o.t_start + o.dur_ms / 1e3
+
+    def test_task_start_stop_participates_in_nesting(self):
+        with profiler.Scope("root"):
+            t = profiler.Task("job")
+            t.start()
+            t.stop()
+        recs = {r.name: r for r in profiler.recent_spans()}
+        assert recs["job"].parent == "root" and recs["job"].kind == "task"
+
+    def test_sibling_scopes_share_parent(self):
+        with profiler.Scope("p"):
+            with profiler.Scope("a"):
+                pass
+            with profiler.Scope("b"):
+                pass
+        recs = {r.name: r for r in profiler.recent_spans()}
+        assert recs["a"].parent == "p" and recs["b"].parent == "p"
+        assert recs["a"].depth == recs["b"].depth == 1
+
+    def test_spans_carry_telemetry_step_scope(self):
+        with telemetry.step_scope(7):
+            with profiler.Scope("in.step"):
+                pass
+        rec = {r.name: r for r in profiler.recent_spans()}["in.step"]
+        assert rec.step == 7
+
+    def test_record_span_explicit_parent_and_step(self):
+        profiler.record_span("step.place", 2.5, parent="step", step=3)
+        rec = profiler.recent_spans()[-1]
+        assert rec.name == "step.place" and rec.parent == "step"
+        assert rec.step == 3 and rec.dur_ms == 2.5
+
+
+# ---------------------------------------------------------------------------
+# step_report segment accounting
+# ---------------------------------------------------------------------------
+class TestStepReport:
+    def _synthetic_steps(self, n=2):
+        for step in range(1, n + 1):
+            t0 = time.perf_counter() - 10e-3
+            profiler.record_span("step.place", 2.0, parent="step",
+                                 step=step, t0=t0)
+            profiler.record_span("step.dispatch", 5.0, parent="step",
+                                 step=step, t0=t0 + 2e-3)
+            profiler.record_span("step.device_wait", 1.0, parent="step",
+                                 step=step, t0=t0 + 7e-3)
+            profiler.record_span("step", 10.0, kind="frame", step=step,
+                                 t0=t0)
+
+    def test_segments_and_python_remainder(self):
+        self._synthetic_steps(2)
+        rep = profiler.step_report()
+        assert rep["steps"] == 2
+        assert rep["wall_ms_total"] == pytest.approx(20.0)
+        segs = rep["segments"]
+        assert segs["place"]["total_ms"] == pytest.approx(4.0)
+        assert segs["dispatch"]["total_ms"] == pytest.approx(10.0)
+        assert segs["device_wait"]["total_ms"] == pytest.approx(2.0)
+        # the un-instrumented remainder is attributed to python
+        assert segs["python"]["total_ms"] == pytest.approx(4.0)
+        # instrumented coverage counts only MEASURED children: 16 of 20
+        assert rep["instrumented_pct"] == pytest.approx(80.0)
+        # host gap = wall minus device-side time (device_wait)
+        assert rep["host_gap_ms_mean"] == pytest.approx(9.0)
+        assert segs["place"]["mean_ms"] == pytest.approx(2.0)
+
+    def test_empty_report_shape(self):
+        rep = profiler.step_report()
+        assert rep["steps"] == 0 and rep["segments"] == {}
+        assert rep["instrumented_pct"] == 0.0
+        json.dumps(rep, allow_nan=False)
+
+    def test_oneoff_compile_segment_excluded_from_host_gap(self):
+        # a cold-bucket compile under a predict frame is real host time
+        # but not steady-state dispatch tax
+        t0 = time.perf_counter() - 100e-3
+        profiler.record_span("serve.compile", 90.0,
+                             parent="serve.predict", t0=t0)
+        profiler.record_span("serve.compute", 5.0,
+                             parent="serve.predict", t0=t0 + 90e-3)
+        profiler.record_span("serve.predict", 100.0, kind="frame", t0=t0)
+        rep = profiler.step_report(frame="serve.predict")
+        assert "serve.compile" in rep["segments"]
+        # gap = 100 - 90 (compile) - 5 (device) = 5
+        assert rep["host_gap_ms_mean"] == pytest.approx(5.0)
+
+    def test_report_emits_telemetry_event(self):
+        telemetry.clear()
+        self._synthetic_steps(1)
+        profiler.step_report(emit=True)
+        evs = telemetry.get_events("perf.step_report")
+        assert evs and evs[-1].fields["steps"] == 1
+        assert "place" in evs[-1].fields["segments"]
+
+    def test_snapshot_embeds_step_report(self):
+        self._synthetic_steps(1)
+        snap = telemetry.snapshot()
+        assert snap["step_report"]["step"]["steps"] == 1
+        json.dumps(snap, allow_nan=False)
+
+
+# ---------------------------------------------------------------------------
+# trainer smoke: the acceptance run — >=95% of step wall attributed
+# ---------------------------------------------------------------------------
+class TestTrainerAttribution:
+    def test_step_report_attributes_trainer_steps(self):
+        import jax
+        net = gluon.nn.HybridSequential(prefix="profsmoke_")
+        with net.name_scope():
+            net.add(gluon.nn.Dense(16, activation="relu", in_units=8))
+            net.add(gluon.nn.Dense(4, in_units=16))
+        net.initialize()
+        l2 = gluon.loss.L2Loss()
+        mesh = parallel.make_mesh(devices=jax.devices()[:1])
+        trainer = parallel.ShardedTrainer(
+            net, lambda out, label: l2(out, label), "sgd",
+            {"learning_rate": 0.01}, mesh=mesh, n_labels=1)
+        x = onp.random.RandomState(0).randn(4, 8).astype("float32")
+        y = onp.zeros((4, 4), "float32")
+        trainer.step(x, y).asnumpy()      # init + compile, outside window
+        profiler.reset_spans()
+        for _ in range(3):
+            trainer.step(x, y).asnumpy()
+        rep = profiler.step_report()
+        assert rep["steps"] == 3
+        # acceptance: >=95% of measured step wall time lands in MEASURED
+        # named segments (place + dispatch; the python remainder is the
+        # framework bookkeeping between them and must stay tiny)
+        assert rep["instrumented_pct"] >= 95.0
+        assert {"place", "dispatch", "python"} <= set(rep["segments"])
+        assert rep["wall_ms_total"] > 0
+        # frames carry the step correlation id of the telemetry scope
+        frames = [r for r in profiler.recent_spans() if r.kind == "frame"]
+        assert all(f.step is not None for f in frames)
+
+
+# ---------------------------------------------------------------------------
+# dump(): set_config(filename=) honored, atomic write
+# ---------------------------------------------------------------------------
+class TestDump:
+    def test_dump_writes_configured_chrome_trace(self, tmp_path):
+        path = tmp_path / "prof.json"
+        profiler.set_config(filename=str(path))
+        with profiler.Scope("dumped.span"):
+            pass
+        out = profiler.dump()
+        assert out == str(path) and path.exists()
+        doc = json.loads(path.read_text())
+        names = [e["name"] for e in doc["traceEvents"]]
+        assert "dumped.span" in names
+        # atomic: no tmp- leftovers next to the written file
+        assert not [p for p in os.listdir(tmp_path) if ".tmp-" in p]
+
+    def test_dump_overwrites_previous_trace(self, tmp_path):
+        path = tmp_path / "prof.json"
+        profiler.set_config(filename=str(path))
+        with profiler.Scope("first"):
+            pass
+        profiler.dump()
+        profiler.reset_spans()
+        with profiler.Scope("second"):
+            pass
+        profiler.dump()
+        names = [e["name"]
+                 for e in json.loads(path.read_text())["traceEvents"]]
+        assert "second" in names and "first" not in names
+
+
+# ---------------------------------------------------------------------------
+# chrome-trace merge: parented spans must nest, not interleave
+# ---------------------------------------------------------------------------
+class TestChromeTraceNesting:
+    def test_merged_trace_nests_parented_spans(self):
+        with profiler.Scope("parent"):
+            with profiler.Scope("child"):
+                time.sleep(0.002)
+            time.sleep(0.001)
+        doc = json.loads(telemetry.chrome_trace(include_events=False))
+        evs = {e["name"]: e for e in doc["traceEvents"]}
+        p, c = evs["parent"], evs["child"]
+        # containment on the rendered timeline (0.1us rounding tolerance)
+        assert p["ts"] <= c["ts"] + 0.2
+        assert c["ts"] + c["dur"] <= p["ts"] + p["dur"] + 0.2
+        assert c["args"]["parent"] == "parent"
+        assert c["args"]["depth"] == 1 and p["args"]["depth"] == 0
+
+    def test_trace_merges_instants_with_step_frames(self):
+        with telemetry.step_scope(5):
+            telemetry.emit("unit.mark")
+            profiler.record_span("step", 1.0, kind="frame")
+        doc = json.loads(telemetry.chrome_trace())
+        by_name = {e["name"]: e for e in doc["traceEvents"]}
+        assert by_name["step"]["args"]["step"] == 5
+        assert by_name["unit.mark"]["ph"] == "i"

@@ -312,9 +312,10 @@ class TestProfilerStrictJSON:
         profiler.reset_spans()
         with profiler.Scope("raw.span"):
             pass
-        (name, kind, t0, dur) = profiler.recent_spans()[-1]
-        assert name == "raw.span" and kind == "scope"
-        assert t0 > 0 and dur >= 0
+        rec = profiler.recent_spans()[-1]
+        assert rec.name == "raw.span" and rec.kind == "scope"
+        assert rec.t_start > 0 and rec.dur_ms >= 0
+        assert rec.parent is None and rec.depth == 0
         profiler.reset_spans()
         assert profiler.recent_spans() == []
 

@@ -15,6 +15,7 @@ findings::
     python -m tools.mxlint --hlo all             # MX7xx over models.SERVE_SPECS
     python -m tools.mxlint --hlo bert_encoder    # one serving family
     python -m tools.mxlint --hlo pkg.mod:factory # custom entry point
+    python -m tools.mxlint --hlo bert --cost     # + per-graph cost table
     python -m tools.mxlint --format=json ...     # one JSON finding per line
 
 Python targets get the pure-AST JAX-pitfall lint (no import of the linted
@@ -199,6 +200,12 @@ def main(argv=None) -> int:
                     help="compiled-graph MX7xx passes over a serving "
                          "family from models.SERVE_SPECS, 'all', or "
                          "module:factory (repeatable)")
+    ap.add_argument("--cost", action="store_true",
+                    help="with --hlo: also print the per-graph cost table "
+                         "(analysis.hlo.cost — FLOPs, bytes, "
+                         "transcendentals, fusion groups; --format=json "
+                         "emits one {\"kind\": \"cost\", ...} object per "
+                         "graph) and run the informational MX707 pass")
     ap.add_argument("--format", choices=("text", "json"), default="text",
                     help="finding output: human text (default) or one "
                          "JSON object per line (summary on stderr)")
@@ -210,6 +217,11 @@ def main(argv=None) -> int:
                     help="exit non-zero on warnings too (perf hazards like "
                          "MX201/MX302 gate the build)")
     args = ap.parse_args(argv)
+
+    if args.cost and not args.hlo:
+        print("mxlint: --cost needs at least one --hlo target "
+              "(the cost table prices compiled graphs)", file=sys.stderr)
+        return 2
 
     import incubator_mxnet_tpu.analysis as analysis
 
@@ -243,6 +255,7 @@ def main(argv=None) -> int:
         report.extend(_lint_json(jt, analysis))
 
     n_hlo = 0
+    cost_rows = []          # (target label, GraphCost) for --cost output
     if args.hlo:
         from incubator_mxnet_tpu.base import MXNetError
         try:
@@ -253,13 +266,40 @@ def main(argv=None) -> int:
         for label, entry, sample in hlo_targets:
             n_hlo += 1
             try:
-                report.extend(analysis.hlo.verify(entry, sample))
+                # one trace per target: the MX7xx passes and the cost
+                # table price the SAME TracedGraph records, so the CLI
+                # and the CI perf-proxy gate can never disagree
+                traced = analysis.hlo.trace_entry(entry, sample)
+                report.extend(analysis.hlo.verify_trace(traced,
+                                                        cost=args.cost))
+                if args.cost:
+                    cost_rows.extend(
+                        (label, c) for c in
+                        analysis.hlo.cost_table(traced.graphs))
             except MXNetError as e:
                 # an untraceable factory product is a bad invocation, not
                 # a finding — keep exit 2 distinct from exit 1
                 print(f"mxlint: --hlo target {label!r} is not traceable: "
                       f"{e}", file=sys.stderr)
                 return 2
+
+    if cost_rows:
+        if args.format == "json":
+            import json as _json
+            for label, c in cost_rows:
+                row = c.to_dict()
+                # the graph's infer/train kind must not mask the record
+                # discriminator CI switches on
+                row["graph_kind"] = row.pop("kind")
+                print(_json.dumps({"kind": "cost", "target": label, **row}))
+        else:
+            from incubator_mxnet_tpu.analysis.hlo import CostReport
+            by_target = {}
+            for label, c in cost_rows:
+                by_target.setdefault(label, []).append(c)
+            for label, rows in by_target.items():
+                print(f"== cost: {label} ==")
+                print(CostReport(rows=rows).text_table())
 
     # json mode always streams its findings: -q only silences the human
     # text path, never the machine contract CI consumes
