@@ -153,7 +153,8 @@ def dynamic_run(model, spec, make_request, n_requests: int,
                 return
 
     t0 = time.perf_counter()
-    threads = [threading.Thread(target=client, args=(c,))
+    threads = [threading.Thread(target=client, args=(c,),
+                                name=f"bench-client-{c}", daemon=False)
                for c in range(clients)]
     for t in threads:
         t.start()

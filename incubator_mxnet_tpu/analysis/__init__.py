@@ -18,6 +18,8 @@ fault lint                checkpoint hygiene (AST), MX4xx
 serve lint                serving/jit-cache hygiene (AST), MX5xx
 telemetry lint            observability hygiene (AST), MX6xx
 ``hlo`` passes            compiled-graph (jaxpr/StableHLO), MX7xx
+``concurrency`` passes    race/deadlock/lock-order (AST, whole-package
+                          lock graph + runtime sanitizer twin), MX8xx
 ========================  ===========================================
 
 Source lints honor inline suppressions (``# mxlint: disable=MX204`` on
@@ -58,6 +60,7 @@ from .recompile import (  # noqa: F401
     RECOMPILE_WARN_THRESHOLD, RecompileWarning, cache_report, note_compile,
 )
 from . import hlo  # noqa: F401  (registers the MX7xx compiled-graph passes)
+from . import concurrency  # noqa: F401  (MX8xx + the lockcheck twin)
 
 
 def lint_source(src, filename: str = "<string>") -> Report:
@@ -89,7 +92,8 @@ __all__ = ["verify", "Report", "Diagnostic", "CODES", "DEFAULT_SEVERITY",
            "list_passes", "run_passes", "PassContext", "tensor_arity",
            "check_sharding", "lint_source", "lint_file", "lint_paths",
            "cache_report", "RecompileWarning", "RECOMPILE_WARN_THRESHOLD",
-           "hlo", "parse_suppressions", "apply_suppressions"]
+           "hlo", "concurrency", "parse_suppressions",
+           "apply_suppressions"]
 
 
 def verify(sym, shapes: Optional[Dict[str, tuple]] = None,

@@ -1,0 +1,170 @@
+"""``mx.analysis.concurrency`` — race/deadlock passes for the threaded
+runtime tier (the MX8xx family).
+
+Third pass registry beside the MX0xx graph passes and the MX7xx
+compiled-graph passes, aimed at the package's *own* threading layer
+(DynamicBatcher, the serve TCP front end, AsyncKVStore/AsyncPSServer,
+the telemetry bus, watchdog, chaos injector — ~100 ``threading`` sites):
+PyGraph's argument (PAPERS.md) applied to locks instead of graphs — move
+the failure detection from "the deadlock you hit in production" into a
+static check that runs in CI.
+
+=====================  ===================================================
+``conc_shared_state``   MX801 unlocked mutation of a lock-bound attribute
+``conc_lock_order``     MX802 lock-order inversion (whole-package
+                        acquisition-graph cycle)
+``conc_blocking_hold``  MX803 blocking call while holding a lock
+``conc_thread_lifecycle`` MX804 Thread hygiene (name=/daemon=/join/
+                        start-in-``__init__``)
+``conc_cache_sync``     MX805 unsynchronized jit/bucket compile caches
+=====================  ===================================================
+
+Unlike the per-file AST lints, MX802 is *whole-package*: every file's
+``with``-regions and cross-module calls merge into one lock-acquisition
+graph before cycle detection (a deadlock needs two sites that never share
+a file). Run it via ``python -m tools.mxlint --concurrency`` (defaults to
+the installed package) or programmatically::
+
+    report = mx.analysis.concurrency.lint_paths(["incubator_mxnet_tpu"])
+
+The **dynamic twin** is :mod:`incubator_mxnet_tpu.lockcheck` (re-exported
+here as ``concurrency.lockcheck``): under ``MXTPU_LOCKCHECK=1`` every
+lock created through ``lockcheck.make_lock`` records real acquisition
+order, flags inversions as ``concurrency.inversion`` telemetry events,
+and bounds inverted acquires so a genuine deadlock fails instead of
+hanging. :func:`crosscheck` joins the two graphs by lock name: runtime
+edges the static pass never derived are its blind spots; static cycle
+edges observed live corroborate an MX802 finding.
+
+Inline suppressions work as everywhere else: annotate intentional sites
+(``# mxlint: disable=MX803`` on the flagged ``with`` line) so the package
+self-lints clean under ``--strict``.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..diagnostics import Report, apply_suppressions
+from .checks import CONCURRENCY_PASSES, PackageModel, run_checks
+from .extract import FileFacts, extract_file, extract_source
+from ... import lockcheck  # noqa: F401  (the runtime sanitizer twin)
+
+__all__ = ["lint_source", "lint_file", "lint_paths", "static_lock_graph",
+           "crosscheck", "CONCURRENCY_PASSES", "lockcheck",
+           "list_concurrency_passes"]
+
+
+def list_concurrency_passes() -> List[str]:
+    return list(CONCURRENCY_PASSES)
+
+
+def _collect_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, _dirs, files in os.walk(p):
+                for fname in sorted(files):
+                    if fname.endswith(".py"):
+                        out.append(os.path.join(dirpath, fname))
+        else:
+            out.append(p)
+    return out
+
+
+def _apply_file_suppressions(report: Report,
+                             sources: Dict[str, str]) -> Report:
+    """Apply each file's inline ``# mxlint: disable=`` markers to the
+    findings anchored in it (the merged whole-package report spans many
+    files, so suppression is applied per provenance file)."""
+    by_file: Dict[str, List] = {}
+    for d in report.diagnostics:
+        node = d.node or ""
+        path = node.rsplit(":", 1)[0] if ":" in node else node
+        by_file.setdefault(path, []).append(d)
+    kept = Report(skipped=list(report.skipped))
+    for path, diags in by_file.items():
+        sub = Report(diagnostics=diags)
+        src = sources.get(path)
+        kept.extend(apply_suppressions(sub, src) if src else sub)
+    kept.diagnostics.sort(key=lambda d: (d.node or "", d.code))
+    return kept
+
+
+def lint_paths(paths: Sequence[str]) -> Report:
+    """The MX8xx passes over files/directories as ONE merged model (the
+    ``mxlint --concurrency`` entry point)."""
+    sources: Dict[str, str] = {}
+    facts: List[FileFacts] = []
+    for path in _collect_files(paths):
+        try:
+            with open(path) as f:
+                src = f.read()
+        except OSError:
+            continue
+        ff = extract_source(src, path)
+        if ff is not None:
+            sources[path] = src
+            facts.append(ff)
+    return _apply_file_suppressions(run_checks(facts), sources)
+
+
+def lint_source(src: str, filename: str = "<string>") -> Report:
+    """Single-blob variant (fixtures, tests): the file is its own
+    package model, so MX802 sees only its own lock graph."""
+    ff = extract_source(src, filename)
+    if ff is None:
+        return Report()  # tracer_lint owns the MX200 parse diagnostic
+    report = run_checks([ff])
+    return _apply_file_suppressions(report, {filename: src})
+
+
+def lint_file(path: str) -> Report:
+    with open(path) as f:
+        return lint_source(f.read(), filename=path)
+
+
+# ---------------------------------------------------------------------------
+# static graph export + runtime cross-check
+# ---------------------------------------------------------------------------
+
+def static_lock_graph(paths: Sequence[str]) -> Dict[Tuple[str, str], Dict]:
+    """The MX802 acquisition graph as ``{(src, dst): provenance}`` —
+    lock ids match the names runtime ``lockcheck`` locks carry."""
+    from .checks import _build_edges
+    facts = [ff for ff in (extract_file(p)
+                           for p in _collect_files(paths))
+             if ff is not None]
+    return _build_edges(PackageModel(facts))
+
+
+def crosscheck(paths: Optional[Sequence[str]] = None,
+               runtime_edges: Optional[List[Dict]] = None) -> Dict:
+    """Join the static MX802 graph with the runtime sanitizer's observed
+    edges (``lockcheck.edges()``) by lock name.
+
+    Returns ``{"confirmed": [...], "static_only": [...],
+    "runtime_only": [...], "inversions": [...]}`` — ``runtime_only``
+    edges are static blind spots (calls the resolver could not follow);
+    ``confirmed`` inversion pairs corroborate an MX802 finding with a
+    live observation.
+    """
+    if paths is None:
+        pkg = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        paths = [pkg]
+    static = set(static_lock_graph(paths))
+    runtime = {(e["held"], e["acquired"])
+               for e in (runtime_edges if runtime_edges is not None
+                         else lockcheck.edges())}
+    inv = lockcheck.inversions()
+    return {
+        "confirmed": sorted(static & runtime),
+        "static_only": sorted(static - runtime),
+        "runtime_only": sorted(runtime - static),
+        "inversions": inv,
+        "confirmed_inversions": sorted(
+            {(d["held"], d["acquiring"]) for d in inv
+             if (d["held"], d["acquiring"]) in static
+             or (d["acquiring"], d["held"]) in static}),
+    }

@@ -1,0 +1,552 @@
+"""The MX8xx concurrency checks over a merged package model.
+
+Whole-package by design: MX802's lock-acquisition graph only means
+something when every module's ``with``-regions and cross-module calls
+land in ONE graph (a deadlock needs two sites that never appear in the
+same file). The other four checks are per-class/per-file but share the
+same extracted facts and the same inter-procedural refinements:
+
+- **lock-held closure**: a method whose every visible intra-class call
+  site sits inside a lock region is analyzed as if its whole body held
+  that lock (``CompiledModel._compile`` is only ever called under the
+  model lock — flagging its cache write would be a false positive);
+- **init-only closure**: a method only reachable from ``__init__`` runs
+  before any thread exists (happens-before via ``Thread.start``), so its
+  unlocked mutations are construction, not races.
+"""
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..diagnostics import Diagnostic, Report
+from .extract import FileFacts, UnitFacts
+
+__all__ = ["PackageModel", "run_checks", "CONCURRENCY_PASSES"]
+
+#: pass name -> description (rendered into docs/api/analysis.md by
+#: tools/gen_docs.py, mirroring PASSES / HLO_PASSES)
+CONCURRENCY_PASSES = {
+    "conc_shared_state": "MX801 shared-attribute mutation without the "
+                         "lock that guards it elsewhere (binding "
+                         "inferred from `with self._lock:` dominance), "
+                         "in classes that run threads",
+    "conc_lock_order": "MX802 lock-order inversion: cycle in the "
+                       "whole-package static lock-acquisition graph "
+                       "(incl. non-reentrant re-acquisition); the "
+                       "static twin of the MXTPU_LOCKCHECK runtime "
+                       "sanitizer",
+    "conc_blocking_hold": "MX803 blocking call (socket/queue/sleep/"
+                          "join/XLA compile) while holding a lock",
+    "conc_thread_lifecycle": "MX804 thread hygiene: Thread() without "
+                             "name=/daemon=, non-daemon threads never "
+                             "joined, start() in __init__ before state "
+                             "is fully assigned",
+    "conc_cache_sync": "MX805 jit/bucket compile caches (the ones "
+                       "telemetry.compile_log tracks) accessed outside "
+                       "the owning class's lock",
+}
+
+_CACHE_NAME_RE = re.compile(r"^_?(exe|jit_cache|cache|caches)$")
+
+
+class PackageModel:
+    """Merged facts + derived tables for one lint invocation."""
+
+    def __init__(self, files: Sequence[FileFacts]):
+        self.files = list(files)
+        #: "stem.func" / unit qname -> [UnitFacts]
+        self.func_table: Dict[str, List[UnitFacts]] = {}
+        #: "Class::method" -> [UnitFacts]
+        self.method_table: Dict[str, List[UnitFacts]] = {}
+        #: lock id -> kind ("Lock" | "RLock")
+        self.lock_kinds: Dict[str, str] = {}
+        self.unit_file: Dict[str, FileFacts] = {}
+        for ff in self.files:
+            self.lock_kinds.update(ff.module_locks)
+            for cf in ff.classes.values():
+                for attr, kind in cf.lock_attrs.items():
+                    self.lock_kinds[f"{cf.name}.{attr}"] = kind
+            for qname, unit in ff.units.items():
+                self.unit_file[qname] = ff
+                self.func_table.setdefault(qname, []).append(unit)
+                parts = qname.split(".")
+                if len(parts) >= 2:
+                    # "stem.func" and "stem.Class.m" both index under
+                    # their dotted key; class methods also under ::
+                    self.func_table.setdefault(
+                        ".".join(parts[-2:]), []).append(unit)
+                if unit.cls is not None:
+                    self.method_table.setdefault(
+                        f"{unit.cls}::{unit.name}", []).append(unit)
+        self._trans_acquires: Optional[Dict[str, Set[str]]] = None
+        self._trans_blocking: Optional[Dict[str, Set[str]]] = None
+
+    # -- call resolution ------------------------------------------------
+    def resolve(self, target: str) -> List[UnitFacts]:
+        """One call-target candidate -> unit(s). ``Cls::m`` hits the
+        method table; ``Cls::__init__``-style falls back to the class
+        constructor when a bare class call was recorded."""
+        if "::" in target:
+            hits = self.method_table.get(target, [])
+            if hits:
+                return hits
+            return []
+        hits = self.func_table.get(target, [])
+        if hits:
+            return hits
+        # a Name call may be a CLASS: route to its __init__
+        tail = target.rsplit(".", 1)[-1]
+        return self.method_table.get(f"{tail}::__init__", [])
+
+    def resolve_call(self, targets: Tuple[str, ...]) -> List[UnitFacts]:
+        out: List[UnitFacts] = []
+        for t in targets:
+            out.extend(self.resolve(t))
+        return out
+
+    # -- fixed points ---------------------------------------------------
+    def trans_acquires(self) -> Dict[str, Set[str]]:
+        """unit qname -> every lock id the unit may acquire, transitively
+        through resolved calls (the reachability MX802's edges need)."""
+        if self._trans_acquires is not None:
+            return self._trans_acquires
+        acq: Dict[str, Set[str]] = {
+            q: {r.lock_id for u in us for r in u.regions}
+            for q, us in self.func_table.items()}
+        changed = True
+        iters = 0
+        while changed and iters < 50:
+            changed = False
+            iters += 1
+            for q, us in self.func_table.items():
+                cur = acq[q]
+                for u in us:
+                    for call in u.calls:
+                        for callee in self.resolve_call(call.targets):
+                            extra = acq.get(callee.qname, set())
+                            if not extra <= cur:
+                                cur |= extra
+                                changed = True
+        self._trans_acquires = acq
+        return acq
+
+    def trans_blocking(self) -> Dict[str, Set[str]]:
+        """unit qname -> blocking-operation kinds reachable from it."""
+        if self._trans_blocking is not None:
+            return self._trans_blocking
+        blk: Dict[str, Set[str]] = {
+            q: {b.what for u in us for b in u.blocks}
+            for q, us in self.func_table.items()}
+        changed = True
+        iters = 0
+        while changed and iters < 50:
+            changed = False
+            iters += 1
+            for q, us in self.func_table.items():
+                cur = blk[q]
+                for u in us:
+                    for call in u.calls:
+                        for callee in self.resolve_call(call.targets):
+                            extra = blk.get(callee.qname, set())
+                            if not extra <= cur:
+                                cur |= extra
+                                changed = True
+        self._trans_blocking = blk
+        return blk
+
+    # -- per-class closures ---------------------------------------------
+    def class_units(self, ff: FileFacts, cname: str) -> List[UnitFacts]:
+        prefix = f"{ff.stem}.{cname}."
+        return [u for q, u in ff.units.items() if q.startswith(prefix)]
+
+    def _method_call_sites(self, ff: FileFacts, cname: str
+                           ) -> Dict[str, List]:
+        """method bare name -> [(caller unit, CallSite)] for visible
+        intra-class ``self.m()`` calls."""
+        sites: Dict[str, List] = {}
+        key_prefix = f"{cname}::"
+        for u in self.class_units(ff, cname):
+            for call in u.calls:
+                for t in call.targets:
+                    if t.startswith(key_prefix):
+                        sites.setdefault(t[len(key_prefix):], []).append(
+                            (u, call))
+        return sites
+
+    def lock_held_methods(self, ff: FileFacts, cname: str) -> Set[str]:
+        """Methods whose every visible intra-class call site holds one of
+        the class's locks (computed to a fixed point so helper chains
+        under the lock stay covered)."""
+        cf = ff.classes[cname]
+        sites = self._method_call_sites(ff, cname)
+        held: Set[str] = set()
+        own = {f"{cname}.{a}" for a in cf.lock_attrs}
+        for _ in range(6):
+            new = set(held)
+            for m in cf.methods:
+                ss = sites.get(m)
+                if not ss:
+                    continue
+                if all(bool(set(call.held) & own)
+                       or caller.name in held
+                       for caller, call in ss):
+                    new.add(m)
+            if new == held:
+                break
+            held = new
+        return held
+
+    def init_only_methods(self, ff: FileFacts, cname: str) -> Set[str]:
+        """Methods only reachable (visibly) from ``__init__`` — their
+        unlocked mutations happen before any thread can exist."""
+        cf = ff.classes[cname]
+        sites = self._method_call_sites(ff, cname)
+        init_only: Set[str] = set()
+        for _ in range(6):
+            new = set(init_only)
+            for m in cf.methods:
+                if m == "__init__":
+                    continue
+                ss = sites.get(m)
+                if not ss:
+                    continue
+                if all(caller.name == "__init__"
+                       or caller.name in init_only
+                       for caller, _call in ss):
+                    new.add(m)
+            if new == init_only:
+                break
+            init_only = new
+        return init_only
+
+
+_PASS_OF = {"MX801": "conc_shared_state", "MX802": "conc_lock_order",
+            "MX803": "conc_blocking_hold",
+            "MX804": "conc_thread_lifecycle", "MX805": "conc_cache_sync"}
+
+
+def _diag(code: str, msg: str, ff: FileFacts, lineno: int,
+          op: Optional[str] = None) -> Diagnostic:
+    return Diagnostic(code, msg, node=f"{ff.path}:{lineno}", op=op,
+                      pass_name=_PASS_OF[code])
+
+
+# ---------------------------------------------------------------------------
+# MX801 — unlocked mutation of a lock-bound shared attribute
+# ---------------------------------------------------------------------------
+
+def _check_shared_state(model: PackageModel, report: Report) -> None:
+    for ff in model.files:
+        for cname, cf in ff.classes.items():
+            if not cf.lock_attrs:
+                continue
+            units = model.class_units(ff, cname)
+            if not any(u.threads for u in units):
+                continue  # no threads born here: no cross-thread sharing
+            own = {f"{cname}.{a}" for a in cf.lock_attrs}
+            lock_held = model.lock_held_methods(ff, cname)
+            init_only = model.init_only_methods(ff, cname)
+            # binding: attr -> locks it was ever mutated under
+            bound: Dict[str, Set[str]] = {}
+            for u in units:
+                for m in u.muts:
+                    if m.kind != "mut":
+                        continue
+                    guards = set(m.held) & own
+                    if guards:
+                        bound.setdefault(m.attr, set()).update(guards)
+            if not bound:
+                continue
+            seen: Set[Tuple[str, str]] = set()
+            for u in units:
+                exempt = (u.name == "__init__" or u.name in init_only
+                          or u.name in lock_held)
+                if exempt:
+                    continue
+                for m in u.muts:
+                    if m.kind != "mut" or m.attr not in bound:
+                        continue
+                    if set(m.held) & bound[m.attr]:
+                        continue
+                    key = (u.qname, m.attr)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    locks = "/".join(sorted(bound[m.attr]))
+                    report.add(_diag(
+                        "MX801",
+                        f"self.{m.attr} is mutated here without "
+                        f"{locks}, but other sites mutate it under that "
+                        f"lock — and {cname} runs threads, so both sides "
+                        "can interleave",
+                        ff, m.lineno, op=f"{cname}.{u.name}"))
+
+
+# ---------------------------------------------------------------------------
+# MX802 — lock-order inversion (cycle in the acquisition graph)
+# ---------------------------------------------------------------------------
+
+def _build_edges(model: PackageModel):
+    """(src lock, dst lock) -> provenance {file, line, via}."""
+    acq = model.trans_acquires()
+    edges: Dict[Tuple[str, str], Dict] = {}
+
+    def add(src, dst, ff, line, via):
+        if src == dst:
+            # same-lock edge: only meaningful for non-reentrant locks,
+            # and reported directly (a cycle of length 1)
+            if model.lock_kinds.get(src) == "RLock":
+                return
+        edges.setdefault((src, dst), {
+            "file": ff.path, "line": line, "via": via})
+
+    for ff in model.files:
+        for u in ff.units.values():
+            # lexical with-in-with nesting, recorded by the scanner
+            for outer, inner, line in u.nestings:
+                add(outer, inner, ff, line, "nested with")
+            # calls made while holding: every lock the callee may
+            # transitively acquire orders after every held lock
+            for call in u.calls:
+                if not call.held:
+                    continue
+                for callee in model.resolve_call(call.targets):
+                    for dst in acq.get(callee.qname, ()):
+                        for src in call.held:
+                            add(src, dst, ff, call.lineno,
+                                f"call to {callee.qname}")
+    return edges
+
+
+def _find_cycles(edges: Dict[Tuple[str, str], Dict]) -> List[List[str]]:
+    """Simple cycles (as node lists) — Tarjan SCCs, then one witness
+    cycle per nontrivial SCC plus explicit self-loops."""
+    graph: Dict[str, Set[str]] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    stack: List[str] = []
+    on: Set[str] = set()
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    def strongconnect(v):
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on.add(v)
+        for w in graph[v]:
+            if w not in index:
+                strongconnect(w)
+                low[v] = min(low[v], low[w])
+            elif w in on:
+                low[v] = min(low[v], index[w])
+        if low[v] == index[v]:
+            comp = []
+            while True:
+                w = stack.pop()
+                on.discard(w)
+                comp.append(w)
+                if w == v:
+                    break
+            sccs.append(comp)
+
+    for v in sorted(graph):
+        if v not in index:
+            strongconnect(v)
+    cycles: List[List[str]] = []
+    for comp in sccs:
+        if len(comp) > 1:
+            cycles.append(sorted(comp))
+    for (a, b) in edges:
+        if a == b:
+            cycles.append([a])
+    return cycles
+
+
+def _check_lock_order(model: PackageModel, report: Report) -> None:
+    edges = _build_edges(model)
+    cycles = _find_cycles(edges)
+    for cyc in cycles:
+        if len(cyc) == 1:
+            a = cyc[0]
+            prov = edges[(a, a)]
+            report.add(Diagnostic(
+                "MX802",
+                f"non-reentrant lock {a} can be re-acquired while "
+                f"already held (via {prov['via']}) — certain "
+                "self-deadlock on that path",
+                node=f"{prov['file']}:{prov['line']}",
+                op=a, pass_name="conc_lock_order"))
+            continue
+        # one finding per cycle, anchored at its first edge's site
+        cyc_edges = [(a, b) for (a, b) in edges
+                     if a in cyc and b in cyc and a != b]
+        detail = "; ".join(
+            f"{a}→{b} at {os.path.basename(edges[(a, b)]['file'])}:"
+            f"{edges[(a, b)]['line']} ({edges[(a, b)]['via']})"
+            for a, b in sorted(cyc_edges)[:6])
+        first = edges[sorted(cyc_edges)[0]]
+        report.add(Diagnostic(
+            "MX802",
+            f"lock-order cycle among {{{', '.join(cyc)}}} — threads "
+            f"taking these locks in different orders can deadlock; "
+            f"edges: {detail}",
+            node=f"{first['file']}:{first['line']}",
+            op=" -> ".join(cyc), pass_name="conc_lock_order"))
+
+
+# ---------------------------------------------------------------------------
+# MX803 — blocking while holding a lock
+# ---------------------------------------------------------------------------
+
+def _check_blocking_hold(model: PackageModel, report: Report) -> None:
+    blk = model.trans_blocking()
+    for ff in model.files:
+        for u in ff.units.values():
+            per_region: Dict[int, Set[str]] = {}
+            for b in u.blocks:
+                if b.held:
+                    per_region.setdefault(b.region_line, set()).add(b.what)
+            for call in u.calls:
+                if not call.held:
+                    continue
+                for callee in model.resolve_call(call.targets):
+                    kinds = blk.get(callee.qname, set())
+                    if kinds:
+                        per_region.setdefault(
+                            call.region_line, set()).update(
+                            f"{k} via {callee.qname.rsplit('.', 1)[-1]}()"
+                            for k in sorted(kinds)[:3])
+            for rline, kinds in sorted(per_region.items()):
+                report.add(_diag(
+                    "MX803",
+                    "blocking operation(s) while holding a lock: "
+                    + ", ".join(sorted(kinds)[:4]) +
+                    " — every other thread contending for this lock "
+                    "stalls behind the slow call",
+                    ff, rline, op=u.qname))
+
+
+# ---------------------------------------------------------------------------
+# MX804 — thread lifecycle hygiene
+# ---------------------------------------------------------------------------
+
+def _check_thread_lifecycle(model: PackageModel, report: Report) -> None:
+    for ff in model.files:
+        for u in ff.units.values():
+            for tc in u.threads:
+                if tc.ctor != "Thread":
+                    continue  # Timer's ctor takes neither name nor daemon
+                missing = [k for k in ("name", "daemon")
+                           if k not in tc.kwargs]
+                if missing:
+                    report.add(_diag(
+                        "MX804",
+                        "threading.Thread without explicit "
+                        + "/".join(f"{k}=" for k in missing) +
+                        " — anonymous threads make hang dumps and the "
+                        "lockcheck timeline unreadable, and implicit "
+                        "daemon-ness inherits the spawner's by accident",
+                        ff, tc.lineno, op=u.qname))
+                if tc.daemon_false and not ff.joins_anywhere:
+                    report.add(_diag(
+                        "MX804",
+                        "non-daemon thread is never joined anywhere in "
+                        "this file — process shutdown will block on it",
+                        ff, tc.lineno, op=u.qname))
+            # start() in __init__ before state is fully assigned
+            if u.name != "__init__" or u.cls is None:
+                continue
+            thread_dests = {tc.assigned_to for tc in u.threads
+                            if tc.assigned_to}
+            cf = ff.classes.get(u.cls)
+            if cf:
+                thread_dests |= {f"self.{a}" for a, t in
+                                 cf.attr_types.items()
+                                 if t in ("Thread", "Timer")}
+            if not thread_dests:
+                continue
+            start_lines = [c.lineno for c in u.calls
+                           if any(t.endswith("::start") or
+                                  t.endswith(".start") for t in c.targets)]
+            # also catch `self._thread.start()` / `t.start()` that did
+            # not resolve: scan blocks? cheap re-scan via muts is not
+            # possible — record from calls with unresolved targets is
+            # not kept, so approximate with resolved ones plus the
+            # conventional pattern below.
+            last_mut = max((m.lineno for m in u.muts if m.kind == "mut"),
+                           default=0)
+            for sl in start_lines:
+                if last_mut > sl:
+                    report.add(_diag(
+                        "MX804",
+                        "thread started inside __init__ before the "
+                        "instance finished assigning its state (a "
+                        f"mutation follows on line {last_mut}) — the "
+                        "thread can observe a half-built object",
+                        ff, sl, op=f"{u.cls}.__init__"))
+
+
+# ---------------------------------------------------------------------------
+# MX805 — unsynchronized compile-cache access
+# ---------------------------------------------------------------------------
+
+def _check_cache_sync(model: PackageModel, report: Report) -> None:
+    for ff in model.files:
+        for cname, cf in ff.classes.items():
+            if not cf.lock_attrs:
+                continue
+            units = model.class_units(ff, cname)
+            own = {f"{cname}.{a}" for a in cf.lock_attrs}
+            # compile-backed cache attrs: subscript-mutated in a unit
+            # that also performs a compile-ish op, or canonically named
+            cache_attrs: Set[str] = set()
+            for u in units:
+                if not u.compileish:
+                    continue
+                for m in u.muts:
+                    if m.kind == "mut" and _CACHE_NAME_RE.match(m.attr):
+                        cache_attrs.add(m.attr)
+            if not cache_attrs:
+                continue
+            lock_held = model.lock_held_methods(ff, cname)
+            init_only = model.init_only_methods(ff, cname)
+            seen: Set[Tuple[str, str]] = set()
+            for u in units:
+                if u.name == "__init__" or u.name in init_only \
+                        or u.name in lock_held:
+                    continue
+                for m in u.muts:
+                    if m.attr not in cache_attrs:
+                        continue
+                    if set(m.held) & own:
+                        continue
+                    key = (u.qname, m.attr)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    report.add(_diag(
+                        "MX805",
+                        f"compile cache self.{m.attr} accessed without "
+                        f"{'/'.join(sorted(own))} — a racing thread can "
+                        "see a half-installed executable or trigger a "
+                        "duplicate XLA compile (exactly what the "
+                        "telemetry compile ledger would flag at runtime)",
+                        ff, m.lineno, op=f"{cname}.{u.name}"))
+
+
+def run_checks(files: Sequence[FileFacts]) -> Report:
+    """All five MX8xx checks over one merged model."""
+    model = PackageModel(files)
+    report = Report()
+    _check_shared_state(model, report)
+    _check_lock_order(model, report)
+    _check_blocking_hold(model, report)
+    _check_thread_lifecycle(model, report)
+    _check_cache_sync(model, report)
+    return report

@@ -1,0 +1,507 @@
+"""AST fact extraction for the MX8xx concurrency passes.
+
+One :class:`FileFacts` per source file, merged into a :class:`PackageModel`
+by the checks. Everything here is *syntactic*: no imports of the linted
+code ever execute (same contract as the MX2xx tracer lint). The model
+captures exactly the facts the five checks need:
+
+- **locks**: ``self._x = threading.Lock()`` / ``RLock`` /
+  ``lockcheck.make_lock("...")`` sites, identified as ``Class._attr``
+  (instance locks) or ``module._VAR`` (module-level locks) — the same ids
+  the runtime sanitizer (:mod:`incubator_mxnet_tpu.lockcheck`) stamps on
+  its tracked locks, so static and dynamic graphs cross-check by name;
+- **units**: every function-like body (module functions, methods, nested
+  defs, the module toplevel) with its lock-acquisition regions, resolved
+  calls (and which locks were held lexically at each call), attribute
+  mutations/reads, directly-blocking operations, and thread constructions;
+- **classes**: lock attributes, attribute constructor types (for
+  ``self._x.m()`` resolution), thread-target methods.
+
+Call resolution is deliberately conservative: ``self.m()``, bare local /
+module functions, ``alias.f()`` through recorded imports, module-level
+singletons (``BUS = EventBus()``), and typed self-attributes. Anything
+else stays unresolved — the lock graph under-approximates rather than
+inventing edges.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+__all__ = ["FileFacts", "UnitFacts", "CallSite", "MutSite", "BlockSite",
+           "ThreadCtor", "LockRegion", "extract_file", "extract_source"]
+
+#: constructor callables that create a lock (attr or bare name)
+_LOCK_CTORS = {"Lock": "Lock", "RLock": "RLock",
+               "make_lock": "Lock", "make_rlock": "RLock"}
+
+#: attr names whose call is a directly-blocking operation when the
+#: receiver matches the guard in _blocking_kind
+_SOCKET_OPS = {"accept", "recv", "recv_into", "sendall",
+               "create_connection"}
+_MUTATORS = {"append", "appendleft", "add", "pop", "popleft", "clear",
+             "update", "remove", "discard", "extend", "insert",
+             "setdefault", "popitem"}
+_COMPILEISH = {"jit", "lower", "compile"}
+
+
+@dataclass
+class LockRegion:
+    lock_id: str
+    lineno: int
+
+
+@dataclass
+class CallSite:
+    #: candidate callee keys ("stem.func", "stem.Class.m", nested qname)
+    targets: Tuple[str, ...]
+    lineno: int
+    held: Tuple[str, ...]          # lock ids held lexically at the call
+    region_line: int               # innermost with-lock line (0 = none)
+
+
+@dataclass
+class MutSite:
+    attr: str
+    lineno: int
+    held: Tuple[str, ...]
+    kind: str                      # "mut" | "read"
+
+
+@dataclass
+class BlockSite:
+    what: str                      # e.g. "time.sleep", "socket.recv"
+    lineno: int
+    held: Tuple[str, ...]
+    region_line: int
+
+
+@dataclass
+class ThreadCtor:
+    ctor: str                      # "Thread" | "Timer"
+    lineno: int
+    kwargs: Set[str]
+    daemon_false: bool
+    target: Optional[str]          # resolved candidate key or None
+    assigned_to: Optional[str]     # local name or "self.<attr>"
+
+
+@dataclass
+class UnitFacts:
+    qname: str
+    cls: Optional[str]
+    name: str                      # bare function/method name
+    lineno: int
+    regions: List[LockRegion] = field(default_factory=list)
+    #: (outer lock id, inner lock id, lineno): lexical with-in-with
+    nestings: List[Tuple[str, str, int]] = field(default_factory=list)
+    calls: List[CallSite] = field(default_factory=list)
+    muts: List[MutSite] = field(default_factory=list)
+    blocks: List[BlockSite] = field(default_factory=list)
+    threads: List[ThreadCtor] = field(default_factory=list)
+    #: linenos of jit/lower/compile calls (MX805's compile evidence —
+    #: ``jax.jit`` itself is deferred tracing, not a blocking op)
+    compileish: List[int] = field(default_factory=list)
+
+
+@dataclass
+class ClassFacts:
+    name: str
+    lineno: int
+    lock_attrs: Dict[str, str] = field(default_factory=dict)  # attr->kind
+    attr_types: Dict[str, str] = field(default_factory=dict)  # attr->Class
+    methods: Dict[str, str] = field(default_factory=dict)     # name->qname
+
+
+@dataclass
+class FileFacts:
+    path: str
+    stem: str
+    module_locks: Dict[str, str] = field(default_factory=dict)  # id->kind
+    classes: Dict[str, ClassFacts] = field(default_factory=dict)
+    units: Dict[str, UnitFacts] = field(default_factory=dict)   # qname->
+    #: import alias -> module stem (``_tele`` -> ``events``)
+    mod_aliases: Dict[str, str] = field(default_factory=dict)
+    #: from-imported bare name -> (module stem, name)
+    name_imports: Dict[str, Tuple[str, str]] = field(default_factory=dict)
+    #: module-level singleton -> class name in this file (``BUS`` ->
+    #: ``EventBus``)
+    singletons: Dict[str, str] = field(default_factory=dict)
+    joins_anywhere: bool = False   # any ``.join(`` call in the file
+
+
+def _lock_ctor_kind(value: ast.AST) -> Optional[str]:
+    """``threading.Lock()`` / ``make_rlock("...")`` → "Lock"/"RLock"."""
+    if not isinstance(value, ast.Call):
+        return None
+    f = value.func
+    name = f.attr if isinstance(f, ast.Attribute) else \
+        f.id if isinstance(f, ast.Name) else None
+    return _LOCK_CTORS.get(name)
+
+
+def _is_thread_ctor(call: ast.Call) -> Optional[str]:
+    f = call.func
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+            and f.value.id == "threading" and f.attr in ("Thread", "Timer"):
+        return f.attr
+    if isinstance(f, ast.Name) and f.id in ("Thread", "Timer"):
+        return f.id
+    return None
+
+
+class _Scanner:
+    """Walks one file; produces :class:`FileFacts`."""
+
+    def __init__(self, path: str, tree: ast.Module):
+        base = os.path.basename(path)
+        stem = os.path.splitext(base)[0]
+        if stem == "__init__":  # a package's module identity is its dir
+            stem = os.path.basename(os.path.dirname(path)) or stem
+        self.facts = FileFacts(path=path, stem=stem)
+        self._tree = tree
+
+    # -- imports / module level ----------------------------------------
+    def scan(self) -> FileFacts:
+        for node in ast.walk(self._tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    stem = a.name.rsplit(".", 1)[-1]
+                    self.facts.mod_aliases[a.asname or stem] = stem
+            elif isinstance(node, ast.ImportFrom):
+                for a in node.names:
+                    # ``from ..telemetry import events as _tele`` imports a
+                    # MODULE; ``from ..fault.retry import call_with_retry``
+                    # imports a NAME. Record both readings — the checks
+                    # resolve against what actually exists in the package.
+                    self.facts.mod_aliases.setdefault(a.asname or a.name,
+                                                      a.name)
+                    src = (node.module or "").rsplit(".", 1)[-1]
+                    if src:
+                        self.facts.name_imports[a.asname or a.name] = \
+                            (src, a.name)
+            elif isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute) and node.func.attr == "join":
+                # only thread-shaped receivers count: `t.join()` /
+                # `self._thread.join()`. `", ".join(...)` (Constant) and
+                # `os.path.join(...)` (dotted module) must not satisfy
+                # the MX804 unjoined-thread check for the whole file.
+                recv = node.func.value
+                if isinstance(recv, ast.Name) or (
+                        isinstance(recv, ast.Attribute)
+                        and isinstance(recv.value, ast.Name)
+                        and recv.value.id == "self"):
+                    self.facts.joins_anywhere = True
+        # module-level locks / singletons / classes / functions
+        for node in self._tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                var = node.targets[0].id
+                kind = _lock_ctor_kind(node.value)
+                if kind:
+                    self.facts.module_locks[
+                        f"{self.facts.stem}.{var}"] = kind
+                elif isinstance(node.value, ast.Call) and isinstance(
+                        node.value.func, ast.Name):
+                    self.facts.singletons[var] = node.value.func.id
+        for node in self._tree.body:
+            if isinstance(node, ast.ClassDef):
+                self._scan_class(node, prefix=self.facts.stem)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._scan_unit(node, cls=None,
+                                qname=f"{self.facts.stem}.{node.name}")
+        # the module toplevel is a unit too (module-level with-locks)
+        top = ast.Module(body=[n for n in self._tree.body
+                               if not isinstance(
+                                   n, (ast.ClassDef, ast.FunctionDef,
+                                       ast.AsyncFunctionDef))],
+                         type_ignores=[])
+        self._scan_unit_body(top.body, cls=None,
+                             qname=f"{self.facts.stem}.<module>",
+                             name="<module>", lineno=0)
+        return self.facts
+
+    def _scan_class(self, node: ast.ClassDef, prefix: str) -> None:
+        cname = node.name
+        cf = self.facts.classes.setdefault(
+            cname, ClassFacts(name=cname, lineno=node.lineno))
+        # pass 1: lock attrs + attr constructor types, wherever assigned
+        # (nested ClassDef subtrees excluded — their self is not ours)
+        def _walk_own(n):
+            for child in ast.iter_child_nodes(n):
+                if isinstance(child, ast.ClassDef):
+                    continue
+                yield child
+                yield from _walk_own(child)
+
+        for sub in _walk_own(node):
+            if isinstance(sub, ast.Assign):
+                for tgt in sub.targets:
+                    if isinstance(tgt, ast.Attribute) and isinstance(
+                            tgt.value, ast.Name) and tgt.value.id == "self":
+                        kind = _lock_ctor_kind(sub.value)
+                        if kind:
+                            cf.lock_attrs[tgt.attr] = kind
+                        elif isinstance(sub.value, ast.Call) and isinstance(
+                                sub.value.func, ast.Name):
+                            cf.attr_types.setdefault(tgt.attr,
+                                                     sub.value.func.id)
+                        tk = _is_thread_ctor(sub.value) \
+                            if isinstance(sub.value, ast.Call) else None
+                        if tk:
+                            cf.attr_types[tgt.attr] = tk
+        # pass 2: methods (incl. nested classes, qualified)
+        for child in node.body:
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{prefix}.{cname}.{child.name}"
+                cf.methods[child.name] = q
+                self._scan_unit(child, cls=cname, qname=q)
+            elif isinstance(child, ast.ClassDef):
+                self._scan_class(child, prefix=f"{prefix}.{cname}")
+
+    # -- function bodies ------------------------------------------------
+    def _scan_unit(self, node, cls: Optional[str], qname: str) -> None:
+        self._scan_unit_body(node.body, cls=cls, qname=qname,
+                             name=node.name, lineno=node.lineno)
+
+    def _scan_unit_body(self, body, cls, qname, name, lineno) -> None:
+        unit = UnitFacts(qname=qname, cls=cls, name=name, lineno=lineno)
+        self.facts.units[qname] = unit
+        nested: Dict[str, str] = {}
+        for stmt in body:
+            self._visit(stmt, unit, held=(), region_line=0, nested=nested)
+
+    def _lock_id(self, expr: ast.AST, cls: Optional[str]) -> Optional[str]:
+        if isinstance(expr, ast.Attribute) and isinstance(
+                expr.value, ast.Name) and expr.value.id == "self" and cls:
+            cf = self.facts.classes.get(cls)
+            if cf and expr.attr in cf.lock_attrs:
+                return f"{cls}.{expr.attr}"
+        if isinstance(expr, ast.Name):
+            mid = f"{self.facts.stem}.{expr.id}"
+            if mid in self.facts.module_locks:
+                return mid
+        return None
+
+    def _visit(self, node, unit: UnitFacts, held, region_line, nested):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            q = f"{unit.qname}.{node.name}"
+            nested[node.name] = q
+            # nested def bodies run at CALL time: scan as their own unit
+            # with an empty held stack (the caller's held locks apply at
+            # the call site via trans-acquire propagation)
+            self._scan_unit(node, cls=unit.cls, qname=q)
+            return
+        if isinstance(node, ast.ClassDef):
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            new_held = list(held)
+            rline = region_line
+            for item in node.items:
+                lid = self._lock_id(item.context_expr, unit.cls)
+                if lid is not None:
+                    unit.regions.append(LockRegion(lid, node.lineno))
+                    for outer in new_held:
+                        unit.nestings.append((outer, lid, node.lineno))
+                    new_held.append(lid)
+                    rline = node.lineno
+                else:
+                    # `with SomeClass(...):` — model __enter__/__exit__
+                    # as calls so a CM that takes locks contributes edges
+                    if isinstance(item.context_expr, ast.Call):
+                        self._visit(item.context_expr, unit, held,
+                                    region_line, nested)
+                        tgts = self._call_targets(item.context_expr,
+                                                  unit, nested)
+                        for suffix in ("__enter__", "__exit__"):
+                            cand = tuple(f"{t}.{suffix}" for t in tgts
+                                         if t)
+                            if cand:
+                                unit.calls.append(CallSite(
+                                    cand, node.lineno, tuple(held),
+                                    region_line))
+            for stmt in node.body:
+                self._visit(stmt, unit, tuple(new_held), rline, nested)
+            return
+        if isinstance(node, ast.Call):
+            self._record_call(node, unit, held, region_line, nested)
+            for child in ast.iter_child_nodes(node):
+                self._visit(child, unit, held, region_line, nested)
+            return
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) else \
+                [node.target]
+            for tgt in targets:
+                self._record_mut_target(tgt, unit, held)
+            # thread ctor assigned to a name (for MX804 join tracking):
+            # the ctor is recorded when the Call node is visited below,
+            # so stash the assignment target for it to pick up
+            value = getattr(node, "value", None)
+            if isinstance(value, ast.Call) and _is_thread_ctor(value):
+                tgt0 = targets[0]
+                dest = None
+                if isinstance(tgt0, ast.Name):
+                    dest = tgt0.id
+                elif isinstance(tgt0, ast.Attribute) and isinstance(
+                        tgt0.value, ast.Name) and tgt0.value.id == "self":
+                    dest = f"self.{tgt0.attr}"
+                self._pending_thread_dest = dest
+            for child in ast.iter_child_nodes(node):
+                self._visit(child, unit, held, region_line, nested)
+            self._pending_thread_dest = None
+            return
+        if isinstance(node, ast.Delete):
+            for tgt in node.targets:
+                base = tgt.value if isinstance(tgt, ast.Subscript) else tgt
+                self._record_mut_target(base, unit, held)
+            return
+        if isinstance(node, ast.Lambda):
+            return  # runs at call time; attributing its body here would
+            # invent lock context the lambda never executes under
+        if isinstance(node, ast.Attribute) and isinstance(
+                node.value, ast.Name) and node.value.id == "self" \
+                and isinstance(node.ctx, ast.Load):
+            unit.muts.append(MutSite(node.attr, node.lineno, tuple(held),
+                                     "read"))
+        for child in ast.iter_child_nodes(node):
+            self._visit(child, unit, held, region_line, nested)
+
+    def _record_mut_target(self, tgt, unit, held) -> None:
+        base = tgt
+        if isinstance(base, ast.Subscript):
+            base = base.value
+        if isinstance(base, ast.Attribute) and isinstance(
+                base.value, ast.Name) and base.value.id == "self":
+            unit.muts.append(MutSite(base.attr, base.lineno, tuple(held),
+                                     "mut"))
+        elif isinstance(tgt, ast.Tuple):
+            for el in tgt.elts:
+                self._record_mut_target(el, unit, held)
+
+    # -- call handling --------------------------------------------------
+    def _call_targets(self, call: ast.Call, unit: UnitFacts,
+                      nested: Dict[str, str]) -> Tuple[str, ...]:
+        f = call.func
+        if isinstance(f, ast.Name):
+            if f.id in nested:
+                return (nested[f.id],)
+            if f.id in self.facts.name_imports:
+                src, name = self.facts.name_imports[f.id]
+                return (f"{src}.{name}",)
+            return (f"{self.facts.stem}.{f.id}",)
+        if isinstance(f, ast.Attribute):
+            recv = f.value
+            if isinstance(recv, ast.Name):
+                if recv.id == "self" and unit.cls:
+                    return (f"{unit.cls}::{f.attr}",)
+                if recv.id in self.facts.singletons:
+                    return (f"{self.facts.singletons[recv.id]}::{f.attr}",)
+                if recv.id in self.facts.mod_aliases:
+                    stem = self.facts.mod_aliases[recv.id].rsplit(
+                        ".", 1)[-1]
+                    return (f"{stem}.{f.attr}",)
+            elif isinstance(recv, ast.Attribute) and isinstance(
+                    recv.value, ast.Name) and recv.value.id == "self" \
+                    and unit.cls:
+                cf = self.facts.classes.get(unit.cls)
+                t = cf.attr_types.get(recv.attr) if cf else None
+                if t:
+                    return (f"{t}::{f.attr}",)
+        return ()
+
+    def _blocking_kind(self, call: ast.Call, unit: UnitFacts
+                       ) -> Optional[str]:
+        f = call.func
+        if not isinstance(f, ast.Attribute):
+            return None
+        recv = f.value
+        recv_name = recv.id if isinstance(recv, ast.Name) else None
+        if f.attr == "sleep" and recv_name == "time":
+            return "time.sleep"
+        if f.attr in _SOCKET_OPS and recv_name != "self":
+            return f"socket.{f.attr}"
+        if f.attr in ("lower", "compile") and recv_name != "re":
+            return f"xla.{f.attr}"
+        # join/wait/get/put only on receivers we can type as
+        # Thread/Event/Queue (string.join / dict.get must not fire)
+        typed = None
+        if isinstance(recv, ast.Attribute) and isinstance(
+                recv.value, ast.Name) and recv.value.id == "self" \
+                and unit.cls:
+            cf = self.facts.classes.get(unit.cls)
+            typed = cf.attr_types.get(recv.attr) if cf else None
+        if f.attr == "join" and typed in ("Thread", "Timer"):
+            return "Thread.join"
+        if f.attr == "wait" and typed in ("Event", "Condition"):
+            return "Event.wait"
+        if f.attr in ("get", "put") and typed == "Queue":
+            for kw in call.keywords:
+                if kw.arg == "block" and isinstance(
+                        kw.value, ast.Constant) and kw.value.value is False:
+                    return None
+            return f"Queue.{f.attr}"
+        return None
+
+    _pending_thread_dest: Optional[str] = None
+
+    def _record_call(self, call: ast.Call, unit: UnitFacts, held,
+                     region_line, nested) -> None:
+        ctor = _is_thread_ctor(call)
+        if ctor:
+            kwargs = {kw.arg for kw in call.keywords if kw.arg}
+            daemon_false = any(
+                kw.arg == "daemon" and isinstance(kw.value, ast.Constant)
+                and kw.value.value is False for kw in call.keywords)
+            target = None
+            tnode = next((kw.value for kw in call.keywords
+                          if kw.arg == "target"), None)
+            if tnode is None and ctor == "Timer" and len(call.args) >= 2:
+                tnode = call.args[1]
+            if isinstance(tnode, ast.Attribute) and isinstance(
+                    tnode.value, ast.Name) and tnode.value.id == "self" \
+                    and unit.cls:
+                target = f"{unit.cls}::{tnode.attr}"
+            elif isinstance(tnode, ast.Name):
+                target = nested.get(tnode.id,
+                                    f"{self.facts.stem}.{tnode.id}")
+            unit.threads.append(ThreadCtor(
+                ctor, call.lineno, kwargs, daemon_false, target,
+                self._pending_thread_dest))
+        tgts = self._call_targets(call, unit, nested)
+        if tgts:
+            unit.calls.append(CallSite(tgts, call.lineno, tuple(held),
+                                       region_line))
+        blk = self._blocking_kind(call, unit)
+        if blk:
+            unit.blocks.append(BlockSite(blk, call.lineno, tuple(held),
+                                         region_line))
+        f = call.func
+        if isinstance(f, ast.Attribute):
+            recv = f.value
+            recv_name = recv.id if isinstance(recv, ast.Name) else None
+            if f.attr in _COMPILEISH and recv_name != "re":
+                unit.compileish.append(call.lineno)
+            # mutator-method calls are attribute mutations too
+            # (self._queue.append(x), self._conns.discard(c), ...)
+            if f.attr in _MUTATORS and isinstance(recv, ast.Attribute) \
+                    and isinstance(recv.value, ast.Name) \
+                    and recv.value.id == "self":
+                unit.muts.append(MutSite(recv.attr, call.lineno,
+                                         tuple(held), "mut"))
+
+
+def extract_source(src: str, path: str = "<string>") -> Optional[FileFacts]:
+    """Parse + scan one source blob; None when it does not parse (the
+    tracer lint owns the MX200 diagnostic)."""
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError:
+        return None
+    return _Scanner(path, tree).scan()
+
+
+def extract_file(path: str) -> Optional[FileFacts]:
+    with open(path) as f:
+        return extract_source(f.read(), path)

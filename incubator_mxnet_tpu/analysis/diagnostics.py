@@ -87,6 +87,21 @@ CODES = {
     "MX707": "informational per-graph cost table entry (FLOPs, bytes, "
              "transcendentals, fusion groups) from analysis.hlo.cost — "
              "never gates a build",
+    "MX801": "shared attribute mutated without the lock that guards it "
+             "elsewhere, in a class that runs threads (attribute→lock "
+             "binding inferred from `with self._lock:` dominance)",
+    "MX802": "lock-order inversion: the static lock-acquisition graph "
+             "has a cycle (or a non-reentrant lock re-acquired while "
+             "held) — a deadlock waiting for the right interleaving",
+    "MX803": "blocking call (socket/queue/sleep/join/XLA compile) while "
+             "holding a lock — serializes every other thread behind one "
+             "slow operation",
+    "MX804": "thread-lifecycle hygiene: threading.Thread without "
+             "explicit name=/daemon=, a non-daemon thread never joined, "
+             "or start() in __init__ before state is fully assigned",
+    "MX805": "jit/bucket compile cache accessed without the owning "
+             "class's lock (the caches telemetry.compile_log tracks "
+             "must be synchronized wherever threads can reach them)",
 }
 
 #: Default severity per code — THE single source of truth the passes,
@@ -109,6 +124,8 @@ DEFAULT_SEVERITY: Dict[str, str] = {
     "MX701": "error", "MX702": "warning", "MX703": "warning",
     "MX704": "warning", "MX705": "error", "MX706": "warning",
     "MX707": "info",
+    "MX801": "warning", "MX802": "error", "MX803": "warning",
+    "MX804": "warning", "MX805": "warning",
 }
 
 

@@ -43,6 +43,7 @@ from typing import Dict, Iterable, Optional
 import numpy as onp
 
 from ..base import MXNetError
+from ..lockcheck import make_lock
 
 __all__ = ["ChaosMonkey", "ChaosCrash", "chaos", "enable", "disable",
            "active", "enable_from_env", "should", "maybe_delay", "crash",
@@ -82,7 +83,7 @@ class ChaosMonkey:
         self._armed: Dict[str, int] = {s: int(crash_count)
                                        for s in crash_sites}
         self._streams: Dict[str, onp.random.RandomState] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("ChaosMonkey._lock")
         #: injection log: (site, fired) in per-site call order — lets tests
         #: assert exactly which faults a seed produced
         self.log: list = []

@@ -28,6 +28,8 @@ import time
 import warnings
 from typing import Any, Callable, List, Optional
 
+from ..lockcheck import make_lock
+
 __all__ = ["Watchdog", "WatchdogFlag"]
 
 
@@ -66,7 +68,7 @@ class Watchdog:
         self.on_flag = on_flag
         self.flags: List[WatchdogFlag] = []
         self._timer: Optional[threading.Timer] = None
-        self._lock = threading.Lock()
+        self._lock = make_lock("Watchdog._lock")
 
     # -- accounting ------------------------------------------------------
     @staticmethod
@@ -115,6 +117,10 @@ class Watchdog:
             t0 = time.monotonic()
             wd._timer = threading.Timer(
                 wd.deadline, wd._fire, args=(self._step, t0, self._block))
+            # Timer's ctor takes neither name nor daemon: set both as
+            # attributes before start() so hang dumps and the lockcheck
+            # timeline can attribute the firing thread
+            wd._timer.name = f"mx-fault-watchdog-step{self._step}"
             wd._timer.daemon = True
             wd._timer.start()
             return wd

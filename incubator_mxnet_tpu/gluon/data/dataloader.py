@@ -227,7 +227,8 @@ class DataLoader:
             finally:
                 q.put(sentinel)
 
-        t = threading.Thread(target=producer, daemon=True)
+        t = threading.Thread(target=producer,
+                             name="mx-dataloader-prefetch", daemon=True)
         t.start()
         while True:
             item = q.get()

@@ -435,7 +435,8 @@ class PrefetchingIter(DataIter):
                 if done and gen == self._gen:
                     q.put(None)
 
-        self._worker = threading.Thread(target=run, daemon=True)
+        self._worker = threading.Thread(target=run, name="mx-io-prefetch",
+                                        daemon=True)
         self._worker.start()
 
     def reset(self):

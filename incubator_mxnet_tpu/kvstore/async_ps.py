@@ -67,6 +67,7 @@ import numpy as onp
 from ..base import MXNetError
 from ..fault import inject as _inject
 from ..fault.retry import RetryExhausted, RetryPolicy
+from ..lockcheck import make_lock
 from ..ndarray import NDArray
 from ..telemetry import events as _tele
 from ..telemetry import metrics as _tmetrics
@@ -119,7 +120,7 @@ class AsyncPSServer:
         self._merged: Dict = {}    # latest pushed merge per key (no-opt mode)
         self._opt_states: Dict = {}
         self._optimizer = None
-        self._lock = threading.Lock()
+        self._lock = make_lock("AsyncPSServer._lock")
         self._push_count = 0
         #: (worker id, key) -> last applied push version: the resend-dedupe
         #: table that makes client retries exactly-once
@@ -133,7 +134,9 @@ class AsyncPSServer:
         self.port = self._sock.getsockname()[1]
         self._stop = threading.Event()
         self._conns: set = set()       # live worker connections (for stop)
-        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread = threading.Thread(target=self._serve,
+                                        name="mx-kvstore-ps-accept",
+                                        daemon=True)
         self._thread.start()
 
     # -- message handling ---------------------------------------------------
@@ -148,8 +151,10 @@ class AsyncPSServer:
                 break
             with self._lock:
                 self._conns.add(conn)
-            t = threading.Thread(target=self._handle, args=(conn,),
-                                 daemon=True)
+            t = threading.Thread(
+                target=self._handle, args=(conn,),
+                name=f"mx-kvstore-ps-handler-{conn.fileno()}",
+                daemon=True)
             t.start()
         self._sock.close()
 
@@ -348,7 +353,7 @@ class _Client:
                     raise MXNetError(
                         f"cannot reach async PS at {host}:{port}: {last}")
                 time.sleep(0.1)
-        self._lock = threading.Lock()
+        self._lock = make_lock("_Client._lock")
 
     def _connect(self) -> None:
         self.close()
@@ -360,7 +365,10 @@ class _Client:
         op = msg[0]
         key = msg[1] if len(msg) > 1 and not isinstance(
             msg[1], (bytes, bytearray)) else None
-        with self._lock:
+        # the client lock deliberately serializes the SOCKET (one
+        # request/reply in flight per connection, like ps-lite's van);
+        # blocking I/O under it is the design
+        with self._lock:  # mxlint: disable=MX803
             if op == "push" and len(msg) >= 5 and msg[4] is None:
                 # stamp the version under the SAME lock that serializes
                 # sends: assigned any earlier, concurrent pushers could

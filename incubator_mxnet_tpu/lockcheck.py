@@ -1,0 +1,356 @@
+"""Runtime lock-order sanitizer — the dynamic twin of ``mx.analysis.
+concurrency``'s static MX802 lock graph.
+
+Reference counterpart: none — the reference's ThreadedEngine ordered all
+mutation through its dependency engine, so lock discipline was the
+engine's problem. Here the production tier (DynamicBatcher, the TCP
+server, AsyncKVStore/AsyncPSServer, the telemetry bus, watchdog, chaos
+injector) holds ~100 ``threading.Thread``/``Lock`` sites, and a
+lock-order inversion between any two of them is a deadlock that no test
+fails and no exception reports — the same "silent failure" class the
+recompile ledger closes for jit caches (MX706 ↔ ``telemetry.
+compile_log``); this module closes it for locks (MX802 ↔ lockcheck).
+
+Mechanics (opt-in; zero overhead when off):
+
+- every lock in the package is created through :func:`make_lock` /
+  :func:`make_rlock` with a stable name matching the static analysis'
+  lock id (``"DynamicBatcher._lock"``, ``"compile_log._LOCK"``). When
+  lockcheck is OFF (the default) these return plain ``threading.Lock``/
+  ``RLock`` objects — the production fast path is untouched.
+- under ``MXTPU_LOCKCHECK=1`` (or any ``MXTPU_CHAOS`` run — stress runs
+  get the sanitizer for free) they return :class:`TrackedLock` /
+  :class:`TrackedRLock`: each acquisition records the *edge* from every
+  lock the thread already holds to the lock being acquired, into one
+  process-wide order graph.
+- an acquisition whose reversed edge is already in the graph is a
+  **lock-order inversion**: it is recorded (:func:`inversions`),
+  published as a ``concurrency.inversion`` telemetry event (severity
+  error), counted in ``mxtpu_lockcheck_inversions_total`` — and the
+  acquire proceeds with a bounded timeout (``MXTPU_LOCKCHECK_TIMEOUT_S``)
+  instead of blocking forever, raising :class:`LockOrderError` on
+  expiry, so a real deadlock flags and *fails* rather than hanging the
+  process (the seeded two-lock fixture test relies on this bound).
+- re-acquiring a non-reentrant :class:`TrackedLock` on the same thread
+  is certain self-deadlock: flagged and raised immediately.
+- releases longer than ``MXTPU_LOCKCHECK_HOLD_MS`` after acquisition
+  publish a ``concurrency.hold`` warning event (lock-hold latency is the
+  serving tail's favourite hiding place).
+
+Cross-checking against the static graph lives in
+``mx.analysis.concurrency.crosscheck()``: runtime edges the static MX802
+pass never derived are its blind spots; static cycle edges observed live
+corroborate the finding.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from threading import get_ident
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["make_lock", "make_rlock", "TrackedLock", "TrackedRLock",
+           "LockOrderError", "enabled", "enable", "edges", "inversions",
+           "hold_stats", "held_now", "assert_no_inversions", "reset"]
+
+
+class LockOrderError(RuntimeError):
+    """A tracked acquisition that is certain (same-thread re-acquire of a
+    non-reentrant lock) or strongly suspected (bounded-timeout expiry on
+    an inverted order) to deadlock."""
+
+
+# -- global state (guarded by a PLAIN lock: the meta-lock must never be
+# tracked, or recording an edge would itself record edges) ------------------
+_META = threading.Lock()
+_EDGES: Dict[Tuple[str, str], Dict] = {}       # (held, acquired) -> first seen
+_INVERSIONS: List[Dict] = []
+_FLAGGED_PAIRS: set = set()                    # dedupe: one report per pair
+_HOLDS: Dict[str, Dict] = {}                   # name -> count/max_ms/total_ms
+
+_HELD = threading.local()                      # per-thread [(name, t0), ...]
+
+_ENABLED: Optional[bool] = None
+
+
+def enabled() -> bool:
+    """True when new locks should be tracked: ``MXTPU_LOCKCHECK`` truthy,
+    or a ``MXTPU_CHAOS`` spec is present (chaos stress runs always get the
+    sanitizer), unless overridden by :func:`enable`. Consulted at lock
+    *creation* time, so flipping it mid-run only affects new locks."""
+    if _ENABLED is not None:
+        return _ENABLED
+    from .util import getenv  # ENV_VARS is the one defaults catalog
+    if getenv("MXTPU_LOCKCHECK") not in ("", "0", "false", "off"):
+        return True
+    return bool(os.environ.get("MXTPU_CHAOS"))
+
+
+def enable(on: bool = True) -> None:
+    """Programmatic override of the env switch (tests)."""
+    global _ENABLED
+    _ENABLED = bool(on)
+
+
+def _hold_threshold_ms() -> float:
+    from .util import getenv
+    try:
+        return float(getenv("MXTPU_LOCKCHECK_HOLD_MS"))
+    except (TypeError, ValueError):
+        return 250.0
+
+
+def _acquire_timeout_s() -> float:
+    from .util import getenv
+    try:
+        return float(getenv("MXTPU_LOCKCHECK_TIMEOUT_S"))
+    except (TypeError, ValueError):
+        return 5.0
+
+
+def _held_stack() -> List[Tuple[str, float, "TrackedLock"]]:
+    """The calling thread's (name, t0, lock) entries, outermost first.
+    Entries whose lock a DIFFERENT thread has since released (cross-
+    thread ``Lock.release`` is legal) are purged lazily here, so a stale
+    entry can neither fake a self-deadlock nor feed bogus edges."""
+    stack = getattr(_HELD, "stack", None)
+    if stack is None:
+        stack = _HELD.stack = []
+    me = get_ident()
+    stale = [i for i, (_n, _t, lk) in enumerate(stack)
+             if lk._owner != me]
+    for i in reversed(stale):
+        del stack[i]
+    return stack
+
+
+def held_now() -> List[str]:
+    """Names of tracked locks the calling thread holds, outermost first."""
+    return [name for name, _t, _lk in _held_stack()]
+
+
+def _emit(kind: str, severity: str, **fields) -> None:
+    """Publish on the telemetry bus. Lazy import (this module is a leaf
+    every runtime package imports) and re-entrancy guarded: the bus's own
+    lock is tracked, and a hold/inversion fired while reporting one must
+    not recurse."""
+    if getattr(_HELD, "reporting", False):
+        return
+    _HELD.reporting = True
+    try:
+        from .telemetry import events as _tele
+        from .telemetry import metrics as _tmetrics
+        _tele.emit(kind, severity=severity, **fields)
+        if kind == "concurrency.inversion":
+            _tmetrics.counter("mxtpu_lockcheck_inversions_total",
+                              "Lock-order inversions observed live").inc()
+    except Exception:  # noqa: BLE001 — the sanitizer must never crash
+        pass           # the locking subsystem it observes
+    finally:
+        _HELD.reporting = False
+
+
+class TrackedLock:
+    """Order-tracking wrapper with ``threading.Lock`` semantics."""
+
+    _reentrant = False
+
+    def __init__(self, name: str):
+        self.name = name
+        self._inner = self._make_inner()
+        #: ident of the thread currently holding this lock (None = free);
+        #: lets the per-thread held stacks detect cross-thread releases
+        self._owner: Optional[int] = None
+
+    @staticmethod
+    def _make_inner():
+        return threading.Lock()
+
+    # -- bookkeeping ----------------------------------------------------
+    def _check_order(self) -> bool:
+        """Record edges held→self; returns True when this acquisition
+        reverses an already-recorded order (inversion)."""
+        held = held_now()
+        thread = threading.current_thread().name
+        inverted = False
+        with _META:
+            for h in held:
+                if h == self.name:
+                    continue
+                fwd = (h, self.name)
+                rev = (self.name, h)
+                if fwd not in _EDGES:
+                    _EDGES[fwd] = {"thread": thread,
+                                   "ts": round(time.time(), 6)}
+                if rev in _EDGES:
+                    inverted = True
+                    if frozenset(fwd) not in _FLAGGED_PAIRS:
+                        _FLAGGED_PAIRS.add(frozenset(fwd))
+                        _INVERSIONS.append({
+                            "held": h, "acquiring": self.name,
+                            "thread": thread,
+                            "reverse_seen_on": _EDGES[rev]["thread"],
+                            "ts": round(time.time(), 6)})
+        return inverted
+
+    def _note_inversion(self, held_name: str) -> None:
+        _emit("concurrency.inversion", "error",
+              held=held_name, acquiring=self.name,
+              thread=threading.current_thread().name)
+
+    # -- Lock protocol --------------------------------------------------
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        stack = _held_stack()
+        if not self._reentrant and any(lk is self for _n, _t, lk in stack):
+            with _META:
+                _INVERSIONS.append({
+                    "held": self.name, "acquiring": self.name,
+                    "thread": threading.current_thread().name,
+                    "self_deadlock": True, "ts": round(time.time(), 6)})
+            self._note_inversion(self.name)
+            raise LockOrderError(
+                f"lock {self.name!r} re-acquired on the same thread — "
+                "certain self-deadlock (use an RLock if re-entry is "
+                "intended)")
+        inverted = self._check_order()
+        if inverted:
+            held = [n for n in held_now() if n != self.name]
+            self._note_inversion(held[-1] if held else "?")
+        if not blocking:
+            ok = self._inner.acquire(False)
+        elif inverted:
+            # an inverted acquire may be the losing half of a real
+            # deadlock: bound it so the process flags-and-fails instead
+            # of hanging (the two-lock fixture test's contract)
+            bound = _acquire_timeout_s() if timeout in (-1, None) \
+                else min(timeout, _acquire_timeout_s())
+            ok = self._inner.acquire(True, bound)
+            if not ok:
+                raise LockOrderError(
+                    f"lock {self.name!r} not acquired within "
+                    f"{bound:.1f}s after a lock-order inversion while "
+                    f"holding {held_now()!r} — likely deadlock")
+        else:
+            ok = (self._inner.acquire(True) if timeout in (-1, None)
+                  else self._inner.acquire(True, timeout))
+        if ok:
+            self._owner = get_ident()
+            stack.append((self.name, time.perf_counter(), self))
+        return ok
+
+    def release(self):
+        # release the inner lock FIRST: contenders must not additionally
+        # stall behind the hold-time bookkeeping/telemetry below (the
+        # sanitizer must not inflate the very latency it measures), and
+        # an illegal release raises before any state is touched
+        stack = _held_stack()
+        idx = next((i for i in range(len(stack) - 1, -1, -1)
+                    if stack[i][2] is self), None)
+        self._inner.release()
+        mine = idx is not None
+        if mine:
+            _name, t0, _lk = stack.pop(idx)
+        if self._owner == get_ident() or not mine:
+            # freed by its owner, or a legal cross-thread hand-off: the
+            # previous owner's stale stack entry purges lazily via
+            # _held_stack() once _owner no longer matches it
+            if not (self._reentrant
+                    and any(lk is self for _n, _t, lk in stack)):
+                self._owner = None
+        if mine:
+            hold_ms = (time.perf_counter() - t0) * 1e3
+            with _META:
+                ent = _HOLDS.setdefault(self.name, {
+                    "count": 0, "max_ms": 0.0, "total_ms": 0.0})
+                ent["count"] += 1
+                ent["max_ms"] = max(ent["max_ms"], hold_ms)
+                ent["total_ms"] += hold_ms
+            if hold_ms >= _hold_threshold_ms():
+                _emit("concurrency.hold", "warning", lock=self.name,
+                      hold_ms=round(hold_ms, 3))
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.name!r})"
+
+
+class TrackedRLock(TrackedLock):
+    """Order-tracking wrapper with ``threading.RLock`` semantics (same-
+    thread re-acquisition is legal and records no self edge)."""
+
+    _reentrant = True
+
+    @staticmethod
+    def _make_inner():
+        return threading.RLock()
+
+    def _check_order(self) -> bool:
+        if any(lk is self for _n, _t, lk in _held_stack()):
+            return False   # re-entry: no new edges, no inversion
+        return super()._check_order()
+
+
+def make_lock(name: str):
+    """A named lock: plain ``threading.Lock`` normally, a
+    :class:`TrackedLock` under lockcheck. ``name`` should match the
+    static analysis' lock id (``Class._attr`` / ``module._VAR``) so the
+    runtime graph and the MX802 graph cross-check by name."""
+    return TrackedLock(name) if enabled() else threading.Lock()
+
+
+def make_rlock(name: str):
+    """Reentrant variant of :func:`make_lock`."""
+    return TrackedRLock(name) if enabled() else threading.RLock()
+
+
+# -- inspection -------------------------------------------------------------
+
+def edges() -> List[Dict]:
+    """Observed acquisition-order edges ``{held, acquired, thread, ts}``."""
+    with _META:
+        return [{"held": a, "acquired": b, **info}
+                for (a, b), info in _EDGES.items()]
+
+
+def inversions() -> List[Dict]:
+    """Recorded lock-order inversions (one per unordered lock pair)."""
+    with _META:
+        return [dict(d) for d in _INVERSIONS]
+
+
+def hold_stats() -> Dict[str, Dict]:
+    """Per-lock hold accounting ``{name: {count, max_ms, total_ms}}``."""
+    with _META:
+        return {k: dict(v) for k, v in _HOLDS.items()}
+
+
+def assert_no_inversions() -> None:
+    """Raise if any inversion was observed — the chaos/lockcheck CI
+    smoke's in-process gate (the stream-level twin greps the telemetry
+    JSONL for ``concurrency.inversion`` via ``tools/telemetry_check.py
+    --forbid``)."""
+    inv = inversions()
+    if inv:
+        raise LockOrderError(
+            f"{len(inv)} lock-order inversion(s) observed:\n" +
+            "\n".join(f"  {d}" for d in inv[:10]))
+
+
+def reset() -> None:
+    """Drop recorded edges/inversions/hold stats (tests). Live locks keep
+    tracking; per-thread held stacks are untouched."""
+    with _META:
+        _EDGES.clear()
+        _INVERSIONS.clear()
+        _FLAGGED_PAIRS.clear()
+        _HOLDS.clear()

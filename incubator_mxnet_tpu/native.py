@@ -23,13 +23,14 @@ import threading
 from typing import Callable, List, Optional, Sequence
 
 from .base import MXNetError
+from .lockcheck import make_lock
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
 _SO_PATH = os.path.join(_NATIVE_DIR, "libmxtpu_native.so")
 
 _LIB: Optional[ctypes.CDLL] = None
-_LOAD_LOCK = threading.Lock()
+_LOAD_LOCK = make_lock("native._LOAD_LOCK")
 _LOAD_FAILED = False
 
 _TASK_FN = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
@@ -336,7 +337,7 @@ class NativeEngine:
         # inside its own trampoline would unmap the ffi closure the C worker
         # thread is still returning through.
         self._keepalive: list = []
-        self._lock = threading.Lock()
+        self._lock = make_lock("NativeEngine._lock")
         # Async exception propagation (reference:
         # ThreadedEngine::OnCompleteStatic capture → rethrow in WaitToRead,
         # SURVEY §5.2): a task's exception is captured on the worker thread

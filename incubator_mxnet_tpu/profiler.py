@@ -44,6 +44,8 @@ from typing import Dict, List, Optional
 
 import jax
 
+from .lockcheck import make_lock
+
 __all__ = ["set_config", "set_state", "pause", "resume", "dump", "dumps",
            "Scope", "Task", "Frame", "Marker", "scope", "span_records",
            "reset_spans", "recent_spans", "record_span", "step_report",
@@ -57,7 +59,7 @@ _STATE = {"running": False, "dir": "profile_output", "aggregate": False,
 #: aggregate counters keep counting past the cap, only raw samples drop
 _MAX_SAMPLES_PER_NAME = 8192
 
-_SPAN_LOCK = threading.Lock()
+_SPAN_LOCK = make_lock("profiler._SPAN_LOCK")
 _SPANS: Dict[str, dict] = {}          # name -> {count, total_ms, samples[]}
 _MARKERS: List[dict] = []
 _MARKERS_DROPPED = [0]                # overflow count past the sample cap

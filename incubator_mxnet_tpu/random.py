@@ -18,13 +18,14 @@ from typing import Dict, Optional
 import jax
 
 from .context import Context, current_context
+from .lockcheck import make_lock
 
 __all__ = ["seed", "next_key", "fork_key", "get_state", "trace_rng",
            "uniform", "normal", "randn", "randint", "exponential", "poisson",
            "gamma", "negative_binomial", "generalized_negative_binomial",
            "multinomial", "shuffle"]
 
-_lock = threading.Lock()
+_lock = make_lock("random._lock")
 _keys: Dict[Context, jax.Array] = {}
 _root_seed = 0
 

@@ -37,6 +37,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 import numpy as onp
 
 from ..base import MXNetError
+from ..lockcheck import make_lock
 from .. import profiler
 from ..telemetry import events as _tele
 from .compiled import CompiledModel, _as_numpy
@@ -163,7 +164,7 @@ class DynamicBatcher:
         self.block_secs = float(block_secs)
         self.metrics = metrics or ServeMetrics()
         self._queue: deque = deque()
-        self._lock = threading.Lock()
+        self._lock = make_lock("DynamicBatcher._lock")
         self._wake = threading.Event()
         self._stop = False
         self._closed = False

@@ -26,7 +26,6 @@ versions in place with **zero** recompiles.
 """
 from __future__ import annotations
 
-import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -36,6 +35,7 @@ import numpy as onp
 
 from ..base import MXNetError
 from ..context import current_context
+from ..lockcheck import make_rlock
 from ..ndarray import NDArray
 from .. import profiler
 from .buckets import BucketTable
@@ -78,7 +78,7 @@ class CompiledModel:
         self._output_axes = ([dict(a) for a in output_axes]
                              if output_axes is not None else None)
         self._ctx = ctx or current_context()
-        self._lock = threading.RLock()
+        self._lock = make_rlock("CompiledModel._lock")
         self._exe: Dict[tuple, Callable] = {}
         self.stats: Dict[str, int] = {
             "hits": 0, "misses": 0, "compiles": 0, "warmup_compiles": 0,
@@ -238,7 +238,10 @@ class CompiledModel:
         zero-recompile serving contract asserts on."""
         t0 = time.perf_counter()
         n = 0
-        with self._lock:
+        # holding the model lock across the AOT compiles is the warmup
+        # CONTRACT: predict() callers block until every bucket is ready
+        # instead of racing half a table
+        with self._lock:  # mxlint: disable=MX803
             for assignment in self._table.assignments():
                 sig = self.signature_for(assignment)
                 key = tuple(sig)
@@ -297,7 +300,10 @@ class CompiledModel:
                 sig = self.signature_for(assignment)
                 key = tuple(sig)
                 padded = self._pad(arrays, assignment)
-            with self._lock:
+            # a cold-bucket compile intentionally blocks peers: two
+            # threads racing the same missing bucket must produce ONE
+            # executable, not two XLA compiles
+            with self._lock:  # mxlint: disable=MX803
                 hit = key in self._exe
                 if hit:
                     self.stats["hits"] += 1

@@ -30,9 +30,9 @@ any device API.
 from __future__ import annotations
 
 import json
-import threading
 from typing import Dict
 
+from ..lockcheck import make_lock
 from ..telemetry import metrics as tmetrics
 from ..telemetry.metrics import Histogram
 
@@ -55,7 +55,7 @@ class ServeMetrics:
     """Thread-safe aggregate serving counters for one model/batcher."""
 
     def __init__(self, reservoir: int = 8192, model: str = "default"):
-        self._lock = threading.Lock()
+        self._lock = make_lock("ServeMetrics._lock")
         self.model = model
         self._latency = Histogram(name="latency_ms", q=(50, 95, 99),
                                   reservoir=reservoir)

@@ -26,13 +26,13 @@ serving tests via the compile-cache counters.
 """
 from __future__ import annotations
 
-import threading
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as onp
 
 from ..base import MXNetError
 from ..fault import checkpoint as fault_checkpoint
+from ..lockcheck import make_rlock
 from ..fault import inject
 from ..fault.retry import RetryPolicy, call_with_retry
 from .buckets import BucketTable
@@ -84,7 +84,7 @@ class ModelRegistry:
     active (newest unless pinned) version's :class:`CompiledModel`."""
 
     def __init__(self, retry_policy: Optional[RetryPolicy] = None):
-        self._lock = threading.RLock()
+        self._lock = make_rlock("ModelRegistry._lock")
         self._models: Dict[str, Dict[int, ModelVersion]] = {}
         self._active: Dict[str, int] = {}
         self._policy = retry_policy

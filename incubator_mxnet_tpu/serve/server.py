@@ -34,6 +34,7 @@ from typing import Dict, Optional
 import numpy as onp
 
 from ..base import MXNetError
+from ..lockcheck import make_lock
 from .batcher import DynamicBatcher, ServeFuture
 from .metrics import ServeMetrics
 from .registry import ModelRegistry
@@ -55,7 +56,7 @@ class Server:
         self._batcher_kw = dict(max_delay_ms=max_delay_ms,
                                 queue_limit=queue_limit)
         self._batchers: Dict[str, DynamicBatcher] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("Server._lock")
         self._tcp: Optional[socketserver.ThreadingTCPServer] = None
         self._tcp_thread: Optional[threading.Thread] = None
 

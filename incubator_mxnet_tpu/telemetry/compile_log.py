@@ -22,10 +22,11 @@ ids) and bumps ``mxtpu_compiles_total{phase=...}``.
 """
 from __future__ import annotations
 
-import threading
 import time
 from collections import deque
 from typing import Dict, List, Optional
+
+from ..lockcheck import make_lock
 
 __all__ = ["CompileRecord", "note", "mark_warmed", "is_warmed", "records",
            "summary", "post_warmup_compiles", "assert_zero_post_warmup",
@@ -60,7 +61,7 @@ class CompileRecord:
         return f"CompileRecord({self.site}, {phase}{ms}, {self.signature})"
 
 
-_LOCK = threading.Lock()
+_LOCK = make_lock("compile_log._LOCK")
 _RECORDS: deque = deque(maxlen=MAX_RECORDS)
 _TOTALS = {"warmup": 0, "post_warmup": 0}
 _BY_SITE: Dict[str, Dict[str, int]] = {}

@@ -39,6 +39,8 @@ import time
 from collections import deque
 from typing import Callable, Dict, List, Optional
 
+from ..lockcheck import make_lock
+
 __all__ = ["Event", "EventBus", "BUS", "emit", "events", "counts",
            "clear", "subscribe", "unsubscribe", "enabled", "enable",
            "step_scope", "request_scope", "current_step",
@@ -142,7 +144,7 @@ class EventBus:
         from ..util import getenv
         self.ring = int(ring if ring is not None
                         else getenv("MXTPU_TELEMETRY_RING"))
-        self._lock = threading.Lock()
+        self._lock = make_lock("EventBus._lock")
         self._rings: Dict[str, deque] = {}
         self._counts: Dict[str, int] = {}
         self._seq = itertools.count(1)
@@ -157,6 +159,13 @@ class EventBus:
         if severity not in SEVERITIES:
             raise ValueError(f"unknown severity {severity!r}; "
                              f"choose from {SEVERITIES}")
+        # events born on worker threads carry the thread name: a serve
+        # flush, a PS handler, and the watchdog all publish into one
+        # stream, and "which thread said this" is the first question a
+        # concurrency timeline gets asked
+        tname = threading.current_thread().name
+        if tname != "MainThread" and "thread" not in fields:
+            fields["thread"] = tname
         ev = Event(next(self._seq), kind, severity, time.time(),
                    time.monotonic(),
                    step if step is not None else current_step(),
@@ -224,7 +233,7 @@ BUS = EventBus()
 
 _ENABLED: Optional[bool] = None
 _ENV_SINKS_INSTALLED = False
-_ENV_SINKS_LOCK = threading.Lock()
+_ENV_SINKS_LOCK = make_lock("events._ENV_SINKS_LOCK")
 
 
 def _reset_env_sinks_flag() -> None:

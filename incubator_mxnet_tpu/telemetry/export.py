@@ -27,8 +27,9 @@ from __future__ import annotations
 
 import json
 import os
-import threading
 from typing import Dict, Optional
+
+from ..lockcheck import make_lock
 
 __all__ = ["sanitize", "dumps_strict", "JsonlSink", "install_jsonl",
            "install_from_env", "uninstall_all", "prometheus_text",
@@ -72,7 +73,7 @@ class JsonlSink:
         self.max_bytes = int(float(
             getenv("MXTPU_TELEMETRY_JSONL_MAX_MB")
             if max_mb is None else max_mb) * 1024 * 1024)
-        self._lock = threading.Lock()
+        self._lock = make_lock("JsonlSink._lock")
         self._fh = None
         self._started = False
         self.lines = 0
@@ -110,7 +111,7 @@ class JsonlSink:
 
 
 _INSTALLED: Dict[str, JsonlSink] = {}
-_INSTALL_LOCK = threading.Lock()
+_INSTALL_LOCK = make_lock("export._INSTALL_LOCK")
 
 
 def install_jsonl(path: str, max_mb: Optional[float] = None) -> JsonlSink:

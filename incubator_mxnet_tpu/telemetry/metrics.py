@@ -22,11 +22,11 @@ window, not the registry series).
 """
 from __future__ import annotations
 
-import threading
 from typing import Dict, List, Optional, Tuple
 
 import numpy as onp
 
+from ..lockcheck import make_lock
 from ..util import nearest_rank_percentile
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
@@ -62,7 +62,7 @@ class Counter:
         self.name = name
         self.help = help
         self.labels = labels
-        self._lock = threading.Lock()
+        self._lock = make_lock("Counter._lock")
         self._value = 0.0
 
     def inc(self, amount: float = 1.0) -> None:
@@ -89,7 +89,7 @@ class Gauge:
         self.name = name
         self.help = help
         self.labels = labels
-        self._lock = threading.Lock()
+        self._lock = make_lock("Gauge._lock")
         self._value = 0.0
 
     def set(self, value: float) -> None:
@@ -134,7 +134,7 @@ class Histogram:
         self.q = tuple(q)
         self.reservoir = int(reservoir)
         self._seed = int(seed)
-        self._lock = threading.Lock()
+        self._lock = make_lock("Histogram._lock")
         self.reset()
 
     def reset(self) -> None:
@@ -202,7 +202,7 @@ class MetricsRegistry:
     """Process-wide instrument table keyed by ``(name, labels)``."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("MetricsRegistry._lock")
         self._table: Dict[Tuple[str, Tuple], object] = {}
 
     def _get(self, cls, name: str, help: str, labels: Dict, **kw):

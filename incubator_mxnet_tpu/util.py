@@ -71,6 +71,20 @@ ENV_VARS: Dict[str, tuple] = {
                                      "JSON-lines sink; past it the file "
                                      "moves to <path>.1 (one generation "
                                      "kept)."),
+    "MXTPU_LOCKCHECK": ("0", "Runtime lock-order sanitizer: locks "
+                        "created through lockcheck.make_lock become "
+                        "order-tracking wrappers that flag inversions "
+                        "as concurrency.inversion telemetry events "
+                        "(also auto-enabled whenever MXTPU_CHAOS is "
+                        "set)."),
+    "MXTPU_LOCKCHECK_HOLD_MS": ("250", "Lock-hold duration past which a "
+                                "tracked lock's release publishes a "
+                                "concurrency.hold warning event."),
+    "MXTPU_LOCKCHECK_TIMEOUT_S": ("5", "Bound on an acquire that "
+                                  "crosses a recorded lock-order "
+                                  "inversion; expiry raises "
+                                  "LockOrderError instead of "
+                                  "deadlocking the process."),
 }
 
 

@@ -1,0 +1,35 @@
+"""Control fixture: a disciplined threaded worker — named daemon
+thread, every shared mutation under the one lock, no blocking or nested
+locks while held. Must produce ZERO MX8xx findings."""
+import threading
+import time
+
+EXPECT = None
+
+
+class CleanWorker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+        self._stopped = False
+        self._t = threading.Thread(target=self._run, name="clean-worker",
+                                   daemon=True)
+
+    def start(self):
+        self._t.start()
+
+    def _run(self):
+        while True:
+            with self._lock:
+                if self._stopped:
+                    return
+                self._items.append(time.monotonic())
+            time.sleep(0.01)   # sleeps OUTSIDE the lock
+
+    def stop(self):
+        with self._lock:
+            self._stopped = True
+
+    def snapshot(self):
+        with self._lock:
+            return list(self._items)

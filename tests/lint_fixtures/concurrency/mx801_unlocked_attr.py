@@ -1,0 +1,20 @@
+"""Seeded MX801: a shared attribute is mutated under the lock on the
+thread path but mutated bare on the public path — the binding the pass
+infers from `with self._lock:` dominance."""
+import threading
+
+EXPECT = "MX801"
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+        self._t = threading.Thread(target=self._run, name="w", daemon=True)
+
+    def _run(self):
+        with self._lock:
+            self._items.append(1)   # binds _items -> Worker._lock
+
+    def drop(self):
+        self._items.clear()         # MX801: same attr, no lock held

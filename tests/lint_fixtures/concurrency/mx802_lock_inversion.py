@@ -1,0 +1,21 @@
+"""Seeded MX802: two functions take the same two locks in opposite
+orders — the classic deadlock cycle the whole-package acquisition graph
+must detect."""
+import threading
+
+EXPECT = "MX802"
+
+_A = threading.Lock()
+_B = threading.Lock()
+
+
+def forward():
+    with _A:
+        with _B:
+            pass
+
+
+def backward():
+    with _B:
+        with _A:
+            pass

@@ -1,0 +1,13 @@
+"""Seeded MX803: sleeping while holding a lock — every contending
+thread stalls behind the slow call."""
+import threading
+import time
+
+EXPECT = "MX803"
+
+_LOCK = threading.Lock()
+
+
+def slow_path():
+    with _LOCK:
+        time.sleep(0.5)

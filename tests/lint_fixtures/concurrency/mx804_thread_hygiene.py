@@ -1,0 +1,10 @@
+"""Seeded MX804: an anonymous thread with implicit daemon-ness."""
+import threading
+
+EXPECT = "MX804"
+
+
+def spawn():
+    t = threading.Thread(target=print)   # no name=, no daemon=
+    t.start()
+    return t

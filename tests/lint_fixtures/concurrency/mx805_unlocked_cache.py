@@ -1,0 +1,20 @@
+"""Seeded MX805: a jit compile cache written under the class lock but
+read bare — exactly the race the telemetry compile ledger would surface
+at runtime as a duplicate compile."""
+import threading
+
+import jax
+
+EXPECT = "MX805"
+
+
+class ExecutableCache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._exe = {}
+
+    def get(self, key, fn):
+        with self._lock:
+            if key not in self._exe:
+                self._exe[key] = jax.jit(fn)
+        return self._exe[key]        # MX805: read outside the lock

@@ -16,6 +16,8 @@ findings::
     python -m tools.mxlint --hlo bert_encoder    # one serving family
     python -m tools.mxlint --hlo pkg.mod:factory # custom entry point
     python -m tools.mxlint --hlo bert --cost     # + per-graph cost table
+    python -m tools.mxlint --concurrency         # MX8xx over the package
+    python -m tools.mxlint --concurrency dir/    # ... or given targets
     python -m tools.mxlint --format=json ...     # one JSON finding per line
 
 Python targets get the pure-AST JAX-pitfall lint (no import of the linted
@@ -31,6 +33,13 @@ MX7xx passes: a serving-family name from ``models.SERVE_SPECS``, ``all``
 (every family), or ``module:factory`` where the zero-arg factory returns a
 traceable entry (HybridBlock / CompiledModel / SymbolBlock / callable) or a
 ``(entry, sample_args)`` tuple.
+
+``--concurrency`` runs the MX8xx race/deadlock passes
+(``mx.analysis.concurrency``) over the given Python targets — default:
+the installed ``incubator_mxnet_tpu`` package — as ONE merged model, so
+the MX802 lock-acquisition graph spans every module. It replaces the
+per-file AST families for those targets (the two lint modes answer
+different questions; run both commands to get both).
 
 ``--format=json`` emits one finding per line
 (``{"file", "line", "node", "code", "severity", "message", "pass",
@@ -200,6 +209,11 @@ def main(argv=None) -> int:
                     help="compiled-graph MX7xx passes over a serving "
                          "family from models.SERVE_SPECS, 'all', or "
                          "module:factory (repeatable)")
+    ap.add_argument("--concurrency", action="store_true",
+                    help="run the MX8xx race/deadlock passes "
+                         "(mx.analysis.concurrency) over the Python "
+                         "targets as one whole-package lock graph "
+                         "(default target: the installed package)")
     ap.add_argument("--cost", action="store_true",
                     help="with --hlo: also print the per-graph cost table "
                          "(analysis.hlo.cost — FLOPs, bytes, "
@@ -226,7 +240,9 @@ def main(argv=None) -> int:
     import incubator_mxnet_tpu.analysis as analysis
 
     targets = args.targets
-    if not targets and not args.hlo:
+    if args.concurrency and not targets:
+        targets = [os.path.join(REPO, "incubator_mxnet_tpu")]
+    elif not targets and not args.hlo:
         targets = [os.path.join(REPO, t) for t in DEFAULT_TARGETS]
     py_targets, json_targets = [], []
     for t in targets:
@@ -250,7 +266,12 @@ def main(argv=None) -> int:
 
     report = analysis.Report()
     if py_targets:
-        report.extend(analysis.lint_paths(py_targets))
+        if args.concurrency:
+            # MX8xx wants ONE merged model over every target (the lock
+            # graph is whole-package), not a per-file walk
+            report.extend(analysis.concurrency.lint_paths(py_targets))
+        else:
+            report.extend(analysis.lint_paths(py_targets))
     for jt in json_targets:
         report.extend(_lint_json(jt, analysis))
 

@@ -13,10 +13,14 @@ checker, which fails (exit 1) when:
   legal; duplication is not;
 - any ``compile`` event is post-warmup (``fields.warmup == false``) —
   the zero-unexpected-recompile contract, now enforceable from the
-  *stream*, not just in-process counters.
+  *stream*, not just in-process counters;
+- any event of a ``--forbid``\\ den kind appears (the concurrency-lint
+  CI job forbids ``concurrency.inversion`` on its lockcheck-enabled
+  chaos smoke — one observed lock-order inversion fails the build).
 
     python tools/telemetry_check.py events.jsonl [more.jsonl ...]
     python tools/telemetry_check.py --allow-post-warmup events.jsonl
+    python tools/telemetry_check.py --forbid concurrency.inversion ev.jsonl
 
 Exit: 0 clean, 1 violations, 2 bad invocation / unreadable file.
 """
@@ -35,9 +39,11 @@ def _reject_nonfinite(token: str):
 
 
 def check_stream(lines, name: str = "<stream>",
-                 allow_post_warmup: bool = False) -> List[str]:
+                 allow_post_warmup: bool = False,
+                 forbid=()) -> List[str]:
     """Returns a list of violation strings (empty = clean)."""
     problems: List[str] = []
+    forbid = set(forbid)
     seen_seqs = set()
     n = 0
     for i, raw in enumerate(lines, 1):
@@ -66,6 +72,11 @@ def check_stream(lines, name: str = "<stream>",
                             "(corrupt stream or double-installed sink)")
         else:
             seen_seqs.add(ev["seq"])
+        if ev["kind"] in forbid:
+            problems.append(
+                f"{name}:{i}: FORBIDDEN EVENT KIND {ev['kind']!r} "
+                f"(fields {ev.get('fields')}) — this stream is gated on "
+                "zero such events")
         if ev["kind"] == "compile" and not allow_post_warmup \
                 and ev.get("fields", {}).get("warmup") is False:
             f = ev.get("fields", {})
@@ -87,6 +98,11 @@ def main(argv=None) -> int:
     ap.add_argument("--allow-post-warmup", action="store_true",
                     help="do not fail on post-warmup compile events "
                          "(streams from warmup-free workloads)")
+    ap.add_argument("--forbid", action="append", default=[],
+                    metavar="KIND",
+                    help="fail on ANY event of this kind (repeatable); "
+                         "the concurrency CI smoke forbids "
+                         "concurrency.inversion")
     args = ap.parse_args(argv)
 
     problems: List[str] = []
@@ -101,7 +117,8 @@ def main(argv=None) -> int:
             return 2
         total_lines += len(lines)
         problems.extend(check_stream(
-            lines, name=path, allow_post_warmup=args.allow_post_warmup))
+            lines, name=path, allow_post_warmup=args.allow_post_warmup,
+            forbid=args.forbid))
     for p in problems:
         print(p, file=sys.stderr)
     print(f"telemetry_check: {total_lines} line(s) across "
