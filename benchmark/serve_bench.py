@@ -23,7 +23,20 @@ Usage::
 
     python -m benchmark.serve_bench --smoke          # <60 s CPU CI config
     python -m benchmark.serve_bench --model bert --requests 5000
+    python -m benchmark.serve_bench --replicas 3     # HA tier in front
+    python -m benchmark.serve_bench --smoke --chaos-replicas  # restart drill
     python -m benchmark.serve_bench --out serve_bench.json
+
+``--replicas N`` runs the dynamic section through the HA serve tier —
+N :class:`Replica` workers prewarmed from a shared on-disk artifact
+cache behind the health-checked failover :class:`Router` — and records
+failover-path p99 latency and the shed rate. ``--chaos-replicas`` is the
+restart drill (seeded ``replica_kill`` + ``corrupt_artifact`` mid-run),
+gated on zero silent drops, full replica recovery, zero steady-state
+compiles on the process-wide ledger, the prewarm-from-cache contract
+(restarts load verified artifacts — exactly one cold miss plus the one
+injected corruption across the whole run), and (under
+``MXTPU_LOCKCHECK=1``) zero lock-order inversions.
 
 Env: ``MXTPU_SERVE_BENCH_MODEL`` (mlp|lenet|bert), ``MXTPU_SERVE_BENCH_N``
 (request count) mirror the flags for harness use.
@@ -132,6 +145,131 @@ def offline_sweep(model, table, make_request, iters: int):
     return rows
 
 
+def replicated_run(net, table, spec, make_request, n_requests: int,
+                   clients: int, deadline_ms: float, n_replicas: int,
+                   chaos: bool, cache_root: str, chaos_seed: int = 23):
+    """Dynamic section behind the HA tier: N replicas prewarmed from one
+    shared artifact cache, a health-checked failover Router in front.
+
+    ``chaos=True`` is the restart drill: once ~25% of the traffic is in,
+    a seeded ``replica_kill`` (one replica dies mid-request) and one
+    ``corrupt_artifact`` (the restart's cache read is bit-flipped on
+    disk) are armed. Gates, asserted by the caller from the returned
+    record: zero silent drops (every accepted request completes or is
+    explicitly shed with ``retry_after``), the killed replica rejoins
+    healthy, and the compile ledger stays at zero post-warmup compiles.
+    """
+    from incubator_mxnet_tpu import serve
+    from incubator_mxnet_tpu.fault import inject
+    from incubator_mxnet_tpu.util import nearest_rank_percentile
+
+    cache = serve.ArtifactCache(cache_root)
+    # each client issues n//clients requests; account against what was
+    # actually ISSUED or the silent-drop gate false-positives whenever
+    # n_requests is not divisible by clients
+    issued = (n_requests // clients) * clients
+    input_names = [f"d{i}" for i in range(len(spec["input_axes"]))]
+
+    def loader(rep):
+        rep.load("bench", table=table, input_axes=spec["input_axes"],
+                 factory=lambda: net, cache=cache,
+                 input_names=input_names,
+                 output_axes=spec["output_axes"],
+                 pad_values=spec["pad_values"])
+
+    replicas = [serve.Replica(f"r{i}", loader, max_delay_ms=deadline_ms)
+                for i in range(n_replicas)]
+    router = serve.Router(replicas, heartbeat_ms=50,
+                          retries=max(3, n_replicas)).start()
+
+    lock = threading.Lock()
+    lat_ms, failover_lat_ms, shed_after, errors = [], [], [], []
+    progress = {"done": 0}
+
+    def client(cid: int):
+        rng = onp.random.RandomState(100 + cid)
+        for _ in range(n_requests // clients):
+            try:
+                _, info = router.call_detailed(
+                    "bench", *make_request(rng), tenant=f"tenant{cid % 2}")
+                with lock:
+                    lat_ms.append(info["latency_ms"])
+                    if info["failovers"] or info["retries"]:
+                        failover_lat_ms.append(info["latency_ms"])
+            except (serve.ShedError, serve.DeadlineExceeded) as e:
+                with lock:  # explicit rejection WITH a backoff hint —
+                    shed_after.append(e.retry_after)  # never a silent drop
+            except Exception as e:  # noqa: BLE001 — gate evidence
+                with lock:
+                    errors.append(f"{type(e).__name__}: {e}")
+            with lock:
+                progress["done"] += 1
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=client, args=(c,),
+                                name=f"bench-client-{c}", daemon=False)
+               for c in range(clients)]
+    for t in threads:
+        t.start()
+    chaos_at = None
+    if chaos:
+        arm_at = issued // 4
+        while True:
+            with lock:
+                if progress["done"] >= arm_at or errors:
+                    break
+            time.sleep(0.002)
+        inject.enable(seed=chaos_seed,
+                      crash_sites=["replica_kill", "corrupt_artifact"])
+        chaos_at = time.perf_counter()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+
+    # recovery: every replica (incl. the killed one) back to healthy —
+    # states snapshot BEFORE stop(), which winds the tier down to stopped
+    recovery_s = None
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        final_states = router.replicas.states()
+        if all(s == "healthy" for s in final_states.values()):
+            if chaos_at is not None:
+                recovery_s = round(time.perf_counter() - chaos_at, 3)
+            break
+        time.sleep(0.05)
+    if chaos:
+        inject.disable()
+    snap = router.snapshot()
+    router.stop()
+    ok = len(lat_ms)
+    lat_sorted = sorted(lat_ms)
+    fo_sorted = sorted(failover_lat_ms)
+    return {
+        "replicas": n_replicas,
+        "requests": issued,
+        "ok": ok,
+        "shed": len(shed_after),
+        "shed_rate": round(len(shed_after) / issued, 4) if issued else 0.0,
+        "errors": errors[:5],
+        "silent_drops": issued - ok - len(shed_after) - len(errors),
+        "wall_s": round(wall, 3),
+        "throughput_req_per_sec": round(ok / wall, 1) if wall else 0.0,
+        "latency_ms_p50": round(nearest_rank_percentile(lat_sorted, 50), 3)
+        if lat_sorted else None,
+        "latency_ms_p99": round(nearest_rank_percentile(lat_sorted, 99), 3)
+        if lat_sorted else None,
+        "failover_latency_ms_p99":
+            round(nearest_rank_percentile(fo_sorted, 99), 3)
+            if fo_sorted else None,
+        "failover_requests": len(failover_lat_ms),
+        "chaos": chaos,
+        "recovery_s": recovery_s,
+        "replica_states": final_states,
+        "router": snap["stats"],
+        "prewarm_cache": cache.snapshot(),
+    }
+
+
 def dynamic_run(model, spec, make_request, n_requests: int,
                 clients: int, deadline_ms: float):
     from incubator_mxnet_tpu import serve
@@ -190,8 +328,22 @@ def main(argv=None) -> int:
     ap.add_argument("--deadline-ms", type=float, default=None)
     ap.add_argument("--smoke", action="store_true",
                     help="<60s CPU config: small buckets, fewer iters")
+    ap.add_argument("--replicas", type=int, default=0,
+                    help="N>0: run the dynamic section through the HA "
+                    "tier (N replicas prewarmed from a shared artifact "
+                    "cache behind the failover Router)")
+    ap.add_argument("--chaos-replicas", action="store_true",
+                    help="the replica restart drill: seeded replica_kill "
+                    "+ corrupt_artifact mid-run, gated on zero silent "
+                    "drops, full recovery, and zero post-warmup compiles "
+                    "(implies --replicas 3)")
+    ap.add_argument("--cache-dir", default=None,
+                    help="artifact-cache root for --replicas (default: "
+                    "a fresh temp dir)")
     ap.add_argument("--out", default=None, help="also write JSON here")
     args = ap.parse_args(argv)
+    if args.chaos_replicas and args.replicas <= 0:
+        args.replicas = 3
 
     from incubator_mxnet_tpu import profiler, serve
 
@@ -229,12 +381,27 @@ def main(argv=None) -> int:
     # a cost explosion is visible even if warmup would then be slow
     cost_rep = _hlo.cost(model, max_graphs=max(8, table.num_buckets()))
     t0 = time.perf_counter()
-    warm = model.warmup()
-    profiler.reset_spans()
-
-    sweep = offline_sweep(model, table, make_request, args.iters)
-    dyn = dynamic_run(model, spec, make_request, args.requests,
-                      args.clients, deadline)
+    replicated = None
+    if args.replicas > 0:
+        # HA mode: the replicas warm their own compiled models (prewarmed
+        # from the shared artifact cache), so the proxy model stays
+        # un-warmed — its cost record is trace-only either way
+        import tempfile
+        profiler.reset_spans()
+        warm, sweep = None, []
+        cache_root = args.cache_dir or tempfile.mkdtemp(
+            prefix="serve_bench_cache_")
+        replicated = replicated_run(
+            net, table, spec, make_request, args.requests, args.clients,
+            deadline, args.replicas, chaos=args.chaos_replicas,
+            cache_root=cache_root)
+        dyn = replicated
+    else:
+        warm = model.warmup()
+        profiler.reset_spans()
+        sweep = offline_sweep(model, table, make_request, args.iters)
+        dyn = dynamic_run(model, spec, make_request, args.requests,
+                          args.clients, deadline)
     spans = profiler.span_records()
     step_rep = profiler.step_report(frame="serve.predict")
     proxy = {
@@ -251,8 +418,7 @@ def main(argv=None) -> int:
     from incubator_mxnet_tpu import telemetry
     telemetry.emit("perf.proxy", family=args.model, **proxy)
 
-    best = max(sweep, key=lambda r: r["rows_per_sec"])
-    recompiles = dyn["compile_cache"]["post_warmup_compiles"]
+    best = (max(sweep, key=lambda r: r["rows_per_sec"]) if sweep else None)
     result = {
         "metric": f"serve_{args.model}_throughput_req_per_sec",
         "value": dyn["throughput_req_per_sec"],
@@ -264,7 +430,7 @@ def main(argv=None) -> int:
             "warmup": warm,
             "offline_sweep": sweep,
             "offline_best": best,
-            "dynamic": dyn,
+            "dynamic": dyn,  # in HA mode this IS the replicated record
             "stage_spans": {k: spans[k] for k in sorted(spans)
                             if k.startswith("serve.")},
             "proxy": proxy,
@@ -282,10 +448,54 @@ def main(argv=None) -> int:
         print(f"serve_bench: {len(dyn['errors'])} client error(s): "
               f"{dyn['errors']}", file=sys.stderr)
         return 1
-    if recompiles:
-        print(f"serve_bench: ZERO-RECOMPILE CONTRACT VIOLATED: "
-              f"{recompiles} post-warmup compile(s)", file=sys.stderr)
-        return 1
+    # zero-recompile contract: per-model counters on the classic path,
+    # the process-wide compile ledger over every replica in HA mode
+    if replicated is not None:
+        from incubator_mxnet_tpu.telemetry import compile_log
+        try:
+            compile_log.assert_zero_post_warmup()
+        except Exception as e:  # noqa: BLE001 — the gate's evidence
+            print(f"serve_bench: ZERO-RECOMPILE CONTRACT VIOLATED "
+                  f"(compile ledger): {e}", file=sys.stderr)
+            return 1
+        if replicated["silent_drops"]:
+            print(f"serve_bench: {replicated['silent_drops']} accepted "
+                  "request(s) vanished without a result, a shed, or an "
+                  "error — the zero-silent-drop contract is violated",
+                  file=sys.stderr)
+            return 1
+        if args.chaos_replicas:
+            states = replicated["replica_states"]
+            if not all(s == "healthy" for s in states.values()):
+                print(f"serve_bench: replica(s) did not rejoin healthy "
+                      f"after the chaos drill: {states}", file=sys.stderr)
+                return 1
+            # prewarm-from-cache contract: the ledger cannot see a
+            # restart retrace (a fresh CompiledModel's compiles are
+            # warmup-phase by construction), so gate on the cache
+            # outcomes themselves — exactly one cold miss (first boot),
+            # exactly the injected corruption, and every other load a
+            # verified HIT (no source-model retrace anywhere else)
+            pc = replicated["prewarm_cache"]
+            if pc["misses"] != 1 or pc["corrupt"] != 1 \
+                    or pc["hits"] < args.replicas - 1:
+                print("serve_bench: PREWARM-FROM-CACHE CONTRACT "
+                      f"VIOLATED: {pc} (want exactly 1 cold miss, the 1 "
+                      "injected corruption, and verified hits "
+                      "everywhere else)", file=sys.stderr)
+                return 1
+            from incubator_mxnet_tpu import lockcheck
+            try:
+                lockcheck.assert_no_inversions()
+            except lockcheck.LockOrderError as e:
+                print(f"serve_bench: {e}", file=sys.stderr)
+                return 1
+    else:
+        recompiles = dyn["compile_cache"]["post_warmup_compiles"]
+        if recompiles:
+            print(f"serve_bench: ZERO-RECOMPILE CONTRACT VIOLATED: "
+                  f"{recompiles} post-warmup compile(s)", file=sys.stderr)
+            return 1
     return 0
 
 

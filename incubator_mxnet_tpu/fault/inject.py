@@ -14,6 +14,15 @@ atomicity were exercised only by real outages. Here every fault the
   reconnect/backoff/resend machinery through its full path.
 - **Slow steps** (``slow_step``): the trainer sleeps past the watchdog
   deadline.
+- **Serving-tier faults** (``replica_kill``, ``slow_replica``,
+  ``corrupt_artifact``): a serve replica dies mid-request (the router must
+  fail over with zero lost accepted requests), a replica's request path
+  slows past its deadline (hedging/failover territory), or a cached AOT
+  artifact is bit-flipped on disk before verification (the prewarm path
+  must detect the CRC mismatch and repair, never serve corrupt weights).
+  Each works both as a seeded probability knob and as a one-shot armed
+  site (``crash=replica_kill`` arms the kill; :func:`armed` consumes
+  non-raising sites like ``corrupt_artifact``).
 - **Crash points** (``crash("site")``): hard process-death simulation at
   named sites (e.g. ``nd.save`` mid-write, ``checkpoint.finalize`` before
   the atomic rename, ``serve.registry.load`` mid-model-load — the serving
@@ -47,7 +56,7 @@ from ..lockcheck import make_lock
 
 __all__ = ["ChaosMonkey", "ChaosCrash", "chaos", "enable", "disable",
            "active", "enable_from_env", "should", "maybe_delay", "crash",
-           "poison"]
+           "armed", "poison"]
 
 
 class ChaosCrash(MXNetError):
@@ -65,19 +74,31 @@ class ChaosMonkey:
     ``kv_drop``   — ``should('kv_drop')``: drop the PS connection pre-call
     ``slow_prob`` — ``maybe_delay('slow_step')`` sleeps ``delay_s``
     ``kv_delay``  — ``maybe_delay('kv_delay')`` sleeps ``delay_s``
-    ``crash_sites`` — iterable of site names where :meth:`crash` raises;
-    each site fires at most ``crash_count`` times (default 1) then disarms,
-    so a retried save can succeed after the simulated death.
+    ``replica_kill``     — ``should('replica_kill')``: a serve replica
+    dies on its next request (the router's failover path)
+    ``slow_replica``     — ``maybe_delay('slow_replica')`` sleeps
+    ``delay_s`` in a replica's request path
+    ``corrupt_artifact`` — ``should('corrupt_artifact')``: the artifact
+    cache bit-flips a cached file before CRC verification
+    ``crash_sites`` — iterable of site names where :meth:`crash` raises
+    (and :meth:`armed` consumes without raising); each site fires at most
+    ``crash_count`` times (default 1) then disarms, so a retried save can
+    succeed after the simulated death.
     """
 
     def __init__(self, seed: int = 0, nan_prob: float = 0.0,
                  kv_drop: float = 0.0, slow_prob: float = 0.0,
                  kv_delay: float = 0.0, delay_s: float = 0.0,
+                 replica_kill: float = 0.0, slow_replica: float = 0.0,
+                 corrupt_artifact: float = 0.0,
                  crash_sites: Iterable[str] = (), crash_count: int = 1):
         self.seed = int(seed)
         self.probs: Dict[str, float] = {
             "nan_batch": float(nan_prob), "kv_drop": float(kv_drop),
             "slow_step": float(slow_prob), "kv_delay": float(kv_delay),
+            "replica_kill": float(replica_kill),
+            "slow_replica": float(slow_replica),
+            "corrupt_artifact": float(corrupt_artifact),
         }
         self.delay_s = float(delay_s)
         self._armed: Dict[str, int] = {s: int(crash_count)
@@ -123,10 +144,18 @@ class ChaosMonkey:
 
     def crash(self, site: str) -> None:
         """Raise :class:`ChaosCrash` if ``site`` is armed (then disarm)."""
+        if self.armed(site):
+            raise ChaosCrash(site)
+
+    def armed(self, site: str) -> bool:
+        """Consume one armed count for ``site`` (then disarm) — the
+        non-raising twin of :meth:`crash` for faults that corrupt rather
+        than kill (the caller applies the fault itself, e.g. the artifact
+        cache flipping a byte on disk)."""
         with self._lock:
             left = self._armed.get(site, 0)
             if left <= 0:
-                return
+                return False
             self._armed[site] = left - 1
         from ..telemetry import events as _tele
         from ..telemetry import metrics as _tmetrics
@@ -134,7 +163,7 @@ class ChaosMonkey:
                    seed=self.seed)
         _tmetrics.counter("mxtpu_chaos_injected_total",
                           "Chaos faults fired", site=site).inc()
-        raise ChaosCrash(site)
+        return True
 
     def poison(self, arr):
         """Return a NaN-filled array matching ``arr`` (float dtypes only —
@@ -236,6 +265,11 @@ def crash(site: str) -> None:
     m = active()
     if m is not None:
         m.crash(site)
+
+
+def armed(site: str) -> bool:
+    m = active()
+    return m.armed(site) if m is not None else False
 
 
 def poison(arr):

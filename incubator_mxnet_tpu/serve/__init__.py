@@ -21,6 +21,14 @@ TVM's ahead-of-time compiled deployment, arXiv:1802.04799, meet here):
 :class:`Server`           in-process + JSON-lines TCP front end
 :class:`ServeMetrics`     p50/p95/p99 latency, queue depth, occupancy,
                           compile counters — JSON for the bench
+:class:`Replica`          one independent worker (private registry +
+                          batchers); crash/restart lifecycle
+:class:`Router`           health-checked failover routing, retries +
+                          hedging, admission control & load shedding,
+                          training→serving weight pipe
+:class:`ArtifactCache`    CRC-verified on-disk AOT artifacts so a
+                          restarted replica prewarms with zero
+                          post-restore compiles
 ========================  =============================================
 
 Minimal end-to-end::
@@ -45,11 +53,21 @@ from .buckets import BucketOverflow, BucketTable, round_up_pow2  # noqa: F401
 from .compiled import CompiledModel, export_for_serving  # noqa: F401
 from .batcher import DynamicBatcher, QueueFullError, ServeFuture  # noqa: F401
 from .metrics import ServeMetrics  # noqa: F401
-from .registry import ModelRegistry, ModelVersion  # noqa: F401
+from .registry import (ModelRegistry, ModelVersion,  # noqa: F401
+                       apply_weights, map_checkpoint_arrays)
 from .server import Server, client_call  # noqa: F401
+from .artifact_cache import (ArtifactCache,  # noqa: F401
+                             ArtifactCorruptError, signature_key)
+from .replica import Replica, ReplicaCrashed, ReplicaUnavailable  # noqa: F401
+from .router import (DeadlineExceeded, ReplicaSet,  # noqa: F401
+                     Router, ShedError)
 
 __all__ = ["BucketTable", "BucketOverflow", "round_up_pow2",
            "CompiledModel", "export_for_serving",
            "DynamicBatcher", "QueueFullError", "ServeFuture",
            "ServeMetrics", "ModelRegistry", "ModelVersion",
-           "Server", "client_call"]
+           "apply_weights", "map_checkpoint_arrays",
+           "Server", "client_call",
+           "ArtifactCache", "ArtifactCorruptError", "signature_key",
+           "Replica", "ReplicaUnavailable", "ReplicaCrashed",
+           "Router", "ReplicaSet", "ShedError", "DeadlineExceeded"]

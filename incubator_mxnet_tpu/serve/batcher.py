@@ -44,7 +44,19 @@ from .compiled import CompiledModel, _as_numpy
 from .metrics import ServeMetrics
 
 __all__ = ["DynamicBatcher", "ServeFuture", "QueueFullError",
-           "stack_examples"]
+           "stack_examples", "make_registry_batcher"]
+
+
+def make_registry_batcher(registry, name: str, **batcher_kw
+                          ) -> "DynamicBatcher":
+    """One started per-model batcher whose thunk resolves through
+    ``registry`` at flush time (so a version swap redirects the very next
+    batch) — the shared creation path of ``Server.batcher`` and
+    ``Replica._batcher``. An unknown model raises at construction (the
+    ctor resolves the thunk once for the model signature)."""
+    return DynamicBatcher(lambda: registry.get(name),
+                          metrics=ServeMetrics(model=name),
+                          **batcher_kw).start()
 
 
 def stack_examples(model: CompiledModel,
@@ -102,6 +114,12 @@ class ServeFuture:
 
     def done(self) -> bool:
         return self._event.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until the result/exception lands (or ``timeout``);
+        returns whether it did — the non-raising poll the router's
+        hedged wait loop uses."""
+        return self._event.wait(timeout)
 
     def result(self, timeout: Optional[float] = None):
         if not self._event.wait(timeout):
@@ -187,12 +205,16 @@ class DynamicBatcher:
         a never-started batcher — fails with "batcher stopped" rather than
         leaving its future unresolved, and later submits are rejected
         immediately (a future enqueued onto a dead worker would never
-        resolve)."""
+        resolve). The drain deadline runs on the monotonic clock (a
+        wall-clock step must not wedge — or instantly expire — shutdown),
+        and the outcome publishes as one ``serve.drain`` event with the
+        drained/abandoned split."""
+        t0 = time.monotonic()
+        served_before = self.metrics.requests
         self._closed = True  # reject new submits from this point on
         if self._worker is not None:
             if drain:
-                t0 = time.time()
-                while self.depth() and time.time() - t0 < timeout:
+                while self.depth() and time.monotonic() - t0 < timeout:
                     time.sleep(0.005)
             self._stop = True
             self._wake.set()
@@ -202,6 +224,25 @@ class DynamicBatcher:
             self._queue.clear()
         for req in leftovers:
             req.future.set_exception(MXNetError("batcher stopped"))
+        _tele.emit("serve.drain",
+                   severity="warning" if leftovers else "info",
+                   model=self.metrics.model, drain=bool(drain),
+                   drained=self.metrics.requests - served_before,
+                   abandoned=len(leftovers),
+                   wall_ms=round((time.monotonic() - t0) * 1e3, 3))
+
+    def worker_alive(self) -> bool:
+        """True while the flush worker thread is running — the liveness
+        bit a replica heartbeat reports."""
+        w = self._worker
+        return w is not None and w.is_alive()
+
+    def retry_after_s(self) -> float:
+        """Backoff hint for rejected/timed-out requests: roughly the time
+        for the current queue to drain at one deadline-flush per batch."""
+        batches = max(1, (self.depth() + self.max_batch - 1)
+                      // self.max_batch)
+        return round(max(0.05, batches * self.max_delay_ms / 1e3), 3)
 
     def depth(self) -> int:
         with self._lock:

@@ -26,6 +26,7 @@ serving tests via the compile-cache counters.
 """
 from __future__ import annotations
 
+import threading
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as onp
@@ -38,7 +39,8 @@ from ..fault.retry import RetryPolicy, call_with_retry
 from .buckets import BucketTable
 from .compiled import CompiledModel
 
-__all__ = ["ModelRegistry", "ModelVersion"]
+__all__ = ["ModelRegistry", "ModelVersion", "map_checkpoint_arrays",
+           "apply_weights"]
 
 
 class ModelVersion:
@@ -55,18 +57,13 @@ class ModelVersion:
         return f"ModelVersion({self.name!r}, v{self.version})"
 
 
-def _weights_from_checkpoint(root: str, policy: Optional[RetryPolicy]
-                             ) -> Dict[str, onp.ndarray]:
-    """Newest verified checkpoint under ``root`` → ``{param_name: array}``.
-    Understands the ``gluon.Trainer``/``ShardedTrainer`` layout
-    (``param:<i>`` arrays + ``meta["param_names"]``) as well as plain
-    name-keyed array dicts."""
-    def load():
-        inject.crash("serve.registry.load")
-        return fault_checkpoint.load_latest(root)
-
-    arrays, meta, _step = call_with_retry(
-        load, policy=policy, describe=f"checkpoint load from {root!r}")
+def map_checkpoint_arrays(arrays: Dict[str, onp.ndarray],
+                          meta: dict) -> Dict[str, onp.ndarray]:
+    """Checkpoint arrays → ``{param_name: array}``. Understands the
+    ``gluon.Trainer``/``ShardedTrainer`` layout (``param:<i>`` arrays +
+    ``meta["param_names"]``) as well as plain name-keyed array dicts
+    (optimizer state is dropped either way). Shared by the registry's
+    ``ckpt_root=`` loads and the router's live weight pipe."""
     names = meta.get("param_names")
     if names:  # trainer layout: positional params + recorded names
         out = {}
@@ -77,6 +74,40 @@ def _weights_from_checkpoint(root: str, policy: Optional[RetryPolicy]
         if out:
             return out
     return {k: v for k, v in arrays.items() if not k.startswith("opt:")}
+
+
+def apply_weights(block, weights: Dict[str, onp.ndarray]) -> int:
+    """Apply ``{param_name: array}`` onto a block — ``SymbolBlock``
+    artifacts via ``set_weights`` (training-prefix name mapping included),
+    live blocks via their collected parameters. Returns how many
+    parameters were updated; the CALLER decides whether 0 is an error and
+    must ``refresh_params()`` the wrapping :class:`CompiledModel`."""
+    if hasattr(block, "set_weights"):
+        return block.set_weights(weights, allow_missing=True,
+                                 ignore_extra=True)
+    params = block._collect_params_with_prefix()
+    by_prefix = {p.name: p for p in params.values()}
+    from ..ndarray import array as nd_array
+    applied = 0
+    for wname, val in weights.items():
+        p = params.get(wname) or by_prefix.get(wname)
+        if p is not None:
+            p._load_init(nd_array(onp.asarray(val)), None)
+            applied += 1
+    return applied
+
+
+def _weights_from_checkpoint(root: str, policy: Optional[RetryPolicy]
+                             ) -> Dict[str, onp.ndarray]:
+    """Newest verified checkpoint under ``root`` → ``{param_name: array}``
+    via :func:`map_checkpoint_arrays`, retried under ``policy``."""
+    def load():
+        inject.crash("serve.registry.load")
+        return fault_checkpoint.load_latest(root)
+
+    arrays, meta, _step = call_with_retry(
+        load, policy=policy, describe=f"checkpoint load from {root!r}")
+    return map_checkpoint_arrays(arrays, meta)
 
 
 class ModelRegistry:
@@ -100,7 +131,8 @@ class ModelRegistry:
              input_names: Optional[Sequence[str]] = None,
              epoch: int = 0, warmup: bool = True,
              output_axes: Optional[Sequence[Dict[int, str]]] = None,
-             pad_values: Any = 0, analyze: bool = True) -> ModelVersion:
+             pad_values: Any = 0, analyze: bool = True,
+             deadline_s: Optional[float] = None) -> ModelVersion:
         """Build, analyze, (optionally) warm and install one model version.
 
         Everything that can fail — artifact deserialization, checkpoint
@@ -114,6 +146,13 @@ class ModelRegistry:
         compile: error-severity findings (host callbacks in the graph,
         baked >1 MiB constants, unbucketed signatures) abort the load;
         warnings are published as a ``serve.analysis`` telemetry event.
+
+        ``deadline_s`` bounds the whole staging build under a
+        ``fault.watchdog`` deadline: a *hung* loader (not just a raising
+        one — a wedged artifact read, a stuck factory) aborts with the
+        active version still serving and a ``serve.load`` event with
+        ``outcome="timeout"``. The stuck staging thread is left detached
+        (daemon) — like an XLA dispatch, it cannot be safely interrupted.
         """
         if (artifacts is None) == (factory is None):
             raise MXNetError("pass exactly one of artifacts= (cold start "
@@ -129,7 +168,51 @@ class ModelRegistry:
                 raise MXNetError(f"{name!r} v{version} is already loaded; "
                                  "unload it first or omit version=")
 
-        # ---- staging: nothing below mutates the registry ----
+        def stage():
+            return self._stage(
+                name, version, table=table, input_axes=input_axes,
+                artifacts=artifacts, factory=factory,
+                example_args=example_args, ckpt_root=ckpt_root,
+                input_names=input_names, epoch=epoch, warmup=warmup,
+                output_axes=output_axes, pad_values=pad_values,
+                analyze=analyze)
+
+        if deadline_s is None:
+            compiled, source = stage()
+        else:
+            compiled, source = self._stage_with_deadline(
+                stage, name, version, deadline_s)
+
+        entry = ModelVersion(name, version, compiled, source)
+        with self._lock:
+            versions = self._models.setdefault(name, {})
+            if version in versions:
+                if not auto_version:
+                    raise MXNetError(
+                        f"{name!r} v{version} was loaded concurrently; "
+                        "unload it first or omit version=")
+                # a concurrent auto-versioned load took this slot during
+                # staging — bump past it instead of overwriting
+                version = max(versions) + 1
+                entry.version = version
+            versions[version] = entry
+            pinned = self._active.get(name)
+            if pinned is None or version > pinned:
+                self._active[name] = version
+        # emitted AFTER install so a concurrent auto-version bump cannot
+        # put a version number on the stream the registry never held
+        _tele.emit("serve.load", model=name, version=entry.version,
+                   source=("artifacts" if artifacts is not None
+                           else "factory"),
+                   ckpt_root=ckpt_root, warmed=bool(warmup), outcome="ok")
+        return entry
+
+    def _stage(self, name: str, version: int, *, table, input_axes,
+               artifacts, factory, example_args, ckpt_root, input_names,
+               epoch, warmup, output_axes, pad_values, analyze):
+        """The failable half of :meth:`load` — builds, analyzes and warms
+        one :class:`CompiledModel` without touching the registry."""
+        from ..telemetry import events as _tele
         if artifacts is not None:
             from ..gluon.block import SymbolBlock
             sym_file = f"{artifacts}-symbol.json"
@@ -160,16 +243,7 @@ class ModelRegistry:
             block = factory()
             if ckpt_root is not None:
                 weights = _weights_from_checkpoint(ckpt_root, self._policy)
-                params = block._collect_params_with_prefix()
-                by_prefix = {p.name: p for p in params.values()}
-                from ..ndarray import array as nd_array
-                applied = 0
-                for wname, val in weights.items():
-                    p = params.get(wname) or by_prefix.get(wname)
-                    if p is not None:
-                        p._load_init(nd_array(onp.asarray(val)), None)
-                        applied += 1
-                if not applied:
+                if not apply_weights(block, weights):
                     raise MXNetError(
                         f"checkpoint under {ckpt_root!r} matched 0 of the "
                         f"factory model's parameters (checkpoint names: "
@@ -200,28 +274,45 @@ class ModelRegistry:
                     "\n".join(f"  {d}" for d in rep.errors))
         if warmup:
             compiled.warmup()
+        return compiled, source
 
-        _tele.emit("serve.load", model=name, version=version,
-                   source=("artifacts" if artifacts is not None
-                           else "factory"),
-                   ckpt_root=ckpt_root, warmed=bool(warmup))
-        entry = ModelVersion(name, version, compiled, source)
-        with self._lock:
-            versions = self._models.setdefault(name, {})
-            if version in versions:
-                if not auto_version:
-                    raise MXNetError(
-                        f"{name!r} v{version} was loaded concurrently; "
-                        "unload it first or omit version=")
-                # a concurrent auto-versioned load took this slot during
-                # staging — bump past it instead of overwriting
-                version = max(versions) + 1
-                entry.version = version
-            versions[version] = entry
-            pinned = self._active.get(name)
-            if pinned is None or version > pinned:
-                self._active[name] = version
-        return entry
+    @staticmethod
+    def _stage_with_deadline(stage: Callable, name: str, version: int,
+                             deadline_s: float):
+        """Run ``stage`` on a named daemon thread under a
+        ``fault.watchdog`` deadline. A stuck loader — not raising, just
+        never returning — aborts the load (``serve.load`` event with
+        ``outcome="timeout"``) while the registry, and therefore the
+        active version, stays untouched."""
+        from ..fault.watchdog import Watchdog
+        from ..telemetry import events as _tele
+        box: Dict[str, Any] = {}
+
+        def run():
+            try:
+                box["result"] = stage()
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                box["exc"] = e
+
+        t = threading.Thread(target=run,
+                             name=f"mx-serve-stage-{name}-v{version}",
+                             daemon=True)
+        wd = Watchdog(deadline=deadline_s)
+        with wd.watch(step=version):
+            t.start()
+            t.join(deadline_s)
+        if t.is_alive():
+            _tele.emit("serve.load", severity="error", model=name,
+                       version=version, outcome="timeout",
+                       deadline_s=deadline_s)
+            raise MXNetError(
+                f"staged load of {name!r} v{version} exceeded its "
+                f"{deadline_s:.1f}s deadline; the active version keeps "
+                f"serving (stuck loader thread {t.name!r} left detached "
+                "— like an XLA dispatch it cannot be safely interrupted)")
+        if "exc" in box:
+            raise box["exc"]
+        return box["result"]
 
     # -- lookup ---------------------------------------------------------
     def get(self, name: str, version: Optional[int] = None) -> CompiledModel:

@@ -1,0 +1,345 @@
+"""Replica — one independent serving worker in the HA tier.
+
+Reference counterpart: MMS scaled by running N model-server *processes*
+behind a fronting load balancer; the framework itself had no replica
+concept. Here a :class:`Replica` is the in-process unit of failure the
+:class:`~incubator_mxnet_tpu.serve.router.Router` spreads traffic over:
+it owns a **private** :class:`ModelRegistry`, one
+:class:`DynamicBatcher` per model, and therefore its own
+:class:`CompiledModel` executables — nothing is shared with its peers,
+so a crash, a wedged batcher, or a poisoned lock order in one replica
+cannot take the tier down.
+
+Lifecycle state machine (transitions publish ``router.health`` events)::
+
+    new ──start()──▶ loading ──▶ healthy ◀──────────────┐
+                        │           │ kill()/worker died │
+                        ▼           ▼                    │
+                    unhealthy ◀─ crashed ──restart()──▶ restarting
+                        │                                │ (loader +
+                        ▼                                │  prewarm)
+                     stopped ◀──stop()── draining ◀──────┘
+
+- ``kill()`` simulates process death (the ``replica_kill`` chaos site
+  raises it from the request path): pending futures FAIL FAST so the
+  router can retry them on a surviving replica — zero lost accepted
+  requests is the router's contract, failing fast is this class's half.
+- ``restart()`` rebuilds from scratch — a fresh registry, fresh
+  batchers — exactly what a respawned process would do; with an
+  :class:`~incubator_mxnet_tpu.serve.artifact_cache.ArtifactCache`
+  attached to the loader, the rebuild prewarms from verified StableHLO
+  artifacts (no Python-model retrace) and the compile ledger proves the
+  restore added zero post-warmup compiles.
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Optional, Sequence
+
+from ..base import MXNetError
+from ..fault import inject
+from ..fault.inject import ChaosCrash
+from ..lockcheck import make_lock
+from .artifact_cache import ArtifactCache
+from .batcher import DynamicBatcher, QueueFullError, ServeFuture
+from .buckets import BucketTable
+from .registry import ModelRegistry, ModelVersion
+
+__all__ = ["Replica", "ReplicaUnavailable", "ReplicaCrashed"]
+
+#: legal lifecycle states (see the module docstring's state machine)
+STATES = ("new", "loading", "healthy", "unhealthy", "draining",
+          "restarting", "crashed", "stopped")
+
+
+class ReplicaUnavailable(MXNetError):
+    """The replica cannot take this request right now (not healthy,
+    mid-restart, or its batcher closed underneath the submit) — an
+    infrastructure failure the router may retry elsewhere."""
+
+
+class ReplicaCrashed(ReplicaUnavailable):
+    """The replica died taking this request (chaos ``replica_kill`` or a
+    real worker death) — failover territory."""
+
+
+class Replica:
+    """One serving worker: private registry + batchers, health surface,
+    crash/restart lifecycle.
+
+    ``loader`` is a callable ``(replica) -> None`` that loads every model
+    this replica serves (via :meth:`load`); it runs on :meth:`start` AND
+    on every :meth:`restart`, so it must be idempotent from a fresh
+    registry — which it is for free when it goes through the artifact
+    cache.
+    """
+
+    def __init__(self, name: str, loader: Callable[["Replica"], None],
+                 max_delay_ms: Optional[float] = None,
+                 queue_limit: Optional[int] = None,
+                 load_deadline_s: Optional[float] = None):
+        self.name = name
+        self._loader = loader
+        #: staging deadline handed to every registry.load this replica's
+        #: loader performs — a HUNG loader during an unattended router
+        #: restart aborts (replica lands unhealthy, retried next
+        #: heartbeat) instead of wedging the restarter thread forever
+        self.load_deadline_s = load_deadline_s
+        self._batcher_kw = dict(max_delay_ms=max_delay_ms,
+                                queue_limit=queue_limit)
+        self._lock = make_lock("Replica._lock")
+        self.registry = ModelRegistry()
+        self._batchers: Dict[str, DynamicBatcher] = {}
+        self._state = "new"
+        self._reason = ""
+        self.restarts = 0
+        self.kills = 0
+
+    # -- state ----------------------------------------------------------
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def healthy(self) -> bool:
+        return self.state == "healthy"
+
+    def _transition(self, to: str, reason: str = "") -> None:
+        assert to in STATES, to
+        with self._lock:
+            frm = self._state
+            self._state = to
+            self._reason = reason
+        self._emit_transition(frm, to, reason)
+
+    def _emit_transition(self, frm: str, to: str, reason: str) -> None:
+        from ..telemetry import events as _tele
+        _tele.emit("router.health",
+                   severity=("warning" if to in ("crashed", "unhealthy")
+                             else "info"),
+                   replica=self.name, **{"from": frm, "to": to},
+                   reason=reason)
+
+    # -- loading --------------------------------------------------------
+    def start(self) -> "Replica":
+        """Run the loader (first boot from ``new``). From ``stopped``
+        this routes through :meth:`restart` — the old registry still
+        holds its versions, so only a fresh rebuild can re-run the
+        loader."""
+        if self.state == "stopped":
+            return self.restart()
+        self._transition("loading")
+        try:
+            self._loader(self)
+        except BaseException as e:
+            self._transition("unhealthy", f"load failed: {e}")
+            raise
+        self._transition("healthy")
+        return self
+
+    def load(self, name: str, *, table: BucketTable,
+             input_axes: Sequence[Dict[int, str]],
+             factory: Optional[Callable] = None,
+             artifacts: Optional[str] = None,
+             cache: Optional[ArtifactCache] = None,
+             version: int = 1,
+             input_names: Optional[Sequence[str]] = None,
+             output_axes: Optional[Sequence[Dict[int, str]]] = None,
+             pad_values=0, analyze: bool = True,
+             warmup: bool = True) -> ModelVersion:
+        """Load one model into this replica's registry — through the
+        artifact cache when one is attached.
+
+        With ``cache`` + ``factory``: a verified cache hit loads the
+        StableHLO artifact directly (**no Python-model retrace** — the
+        prewarm path a restart takes); a miss or corrupt entry builds
+        from ``factory()`` (which must return a hybridized block with one
+        forward recorded), repairs the cache with :meth:`ArtifactCache
+        .put`, and then loads from the freshly written artifact, so every
+        boot serves the exact bytes a restart will.
+        """
+        if cache is not None and factory is not None:
+            names = list(input_names or ["data"])
+            got = cache.get(name, version, table, input_axes)
+            if got is None:
+                block = factory()
+                prefix = cache.put(name, version, block, table, input_axes,
+                                   input_names=names)
+            else:
+                prefix, manifest = got
+                names = list(manifest.get("input_names", names))
+            return self.registry.load(
+                name, table=table, input_axes=input_axes, artifacts=prefix,
+                version=version, input_names=names, output_axes=output_axes,
+                pad_values=pad_values, analyze=analyze, warmup=warmup,
+                deadline_s=self.load_deadline_s)
+        return self.registry.load(
+            name, table=table, input_axes=input_axes, factory=factory,
+            artifacts=artifacts, version=version, input_names=input_names,
+            output_axes=output_axes, pad_values=pad_values,
+            analyze=analyze, warmup=warmup,
+            deadline_s=self.load_deadline_s)
+
+    # -- request path ---------------------------------------------------
+    def _batcher(self, name: str) -> DynamicBatcher:
+        from .batcher import make_registry_batcher
+        with self._lock:
+            # state re-checked under the SAME lock that kill()/restart()
+            # clear _batchers under: a submit racing a kill must not
+            # resurrect a fresh batcher on a crashed replica
+            if self._state != "healthy":
+                raise ReplicaUnavailable(
+                    f"replica {self.name!r} is {self._state}"
+                    + (f" ({self._reason})" if self._reason else ""))
+            b = self._batchers.get(name)
+            if b is None:
+                b = make_registry_batcher(self.registry, name,
+                                          **self._batcher_kw)
+                self._batchers[name] = b
+        return b
+
+    def submit(self, model: str, *arrays) -> ServeFuture:
+        """Enqueue one single-example request on this replica.
+
+        Chaos probes run first: an armed/seeded ``replica_kill`` kills
+        THIS replica (pending futures fail fast) and surfaces as
+        :class:`ReplicaCrashed`; ``slow_replica`` injects latency. State
+        and batcher failures surface as :class:`ReplicaUnavailable`;
+        anything else is the request's own fault and is not retryable.
+        """
+        try:
+            inject.crash("replica_kill")
+            if inject.should("replica_kill"):
+                raise ChaosCrash("replica_kill")
+        except ChaosCrash as e:
+            self.kill(reason="chaos: replica_kill")
+            raise ReplicaCrashed(
+                f"replica {self.name!r} killed mid-request") from e
+        inject.maybe_delay("slow_replica")
+        try:
+            # _batcher() enforces state=="healthy" under the replica lock
+            return self._batcher(model).submit(*arrays)
+        except (QueueFullError, ReplicaUnavailable):
+            raise
+        except MXNetError as e:
+            # a kill/restart racing this submit closes the batcher or
+            # empties the registry under us — that is replica
+            # unavailability, not a malformed request
+            if not self.healthy() or "batcher stopped" in str(e):
+                raise ReplicaUnavailable(
+                    f"replica {self.name!r} became unavailable "
+                    f"mid-submit: {e}") from e
+            raise
+
+    def push_weights(self, model: str, weights: Dict) -> int:
+        """Swap the active version's weights in place — the router's
+        training→serving pipe. Shapes must match the compiled graphs, so
+        this is ``refresh_params``: **zero recompiles**, assertable on
+        the compile ledger. Returns how many parameters were updated."""
+        from .registry import apply_weights
+        cm = self.registry.get(model)
+        applied = apply_weights(cm._block, weights)
+        if not applied:
+            raise MXNetError(
+                f"weight push onto replica {self.name!r} matched 0 of "
+                f"{model!r}'s parameters — name-scope mismatch?")
+        cm.refresh_params()
+        return applied
+
+    # -- health surface -------------------------------------------------
+    def queue_depth(self) -> int:
+        with self._lock:
+            batchers = list(self._batchers.values())
+        return sum(b.depth() for b in batchers)
+
+    def heartbeat(self) -> Dict:
+        """One health probe: state, aggregate queue depth, flush progress
+        (total batches), and worker-thread liveness. A healthy replica
+        whose batcher worker died is reported (and marked) crashed —
+        deadline/stall judgement is the router's, from progress deltas."""
+        with self._lock:
+            state = self._state
+            batchers = list(self._batchers.values())
+        depth = sum(b.depth() for b in batchers)
+        batches = sum(b.metrics.batches + b.metrics.failed_batches
+                      for b in batchers)
+        alive = all(b.worker_alive() for b in batchers)
+        if state == "healthy" and batchers and not alive:
+            self.kill(reason="batcher worker died")
+            state = self.state
+        return {"replica": self.name, "state": state, "depth": depth,
+                "batches": batches, "workers_alive": alive,
+                "ts": time.monotonic()}
+
+    # -- lifecycle ------------------------------------------------------
+    def kill(self, reason: str = "") -> None:
+        """Simulated process death: serving stops NOW, queued/in-flight
+        futures fail fast (the router retries them elsewhere), state
+        becomes ``crashed`` for the health loop to restart."""
+        with self._lock:
+            # only a serving(ish) replica can crash: a kill racing a
+            # deliberate drain/restart/stop must not resurrect it via
+            # the health loop's crashed→restart path
+            if self._state not in ("healthy", "loading", "unhealthy"):
+                return
+            frm = self._state
+            self._state = "crashed"  # guard + flip atomically: two
+            self._reason = reason    # racing kills must count once
+            batchers = list(self._batchers.values())
+            self._batchers.clear()
+            self.kills += 1
+        self._emit_transition(frm, "crashed", reason)
+        for b in batchers:
+            b.stop(drain=False, timeout=0.5)
+
+    def restart(self) -> "Replica":
+        """Full rebuild — fresh registry, fresh batchers, loader re-run
+        (prewarming from the artifact cache when attached) — then rejoin
+        as healthy. The router calls this from its restarter thread."""
+        with self._lock:
+            if self._state == "restarting":
+                return self
+            frm = self._state
+            self._state = "restarting"  # guard + flip atomically
+            stale = list(self._batchers.values())
+            self._batchers.clear()
+            self.registry = ModelRegistry()
+            self.restarts += 1
+        self._emit_transition(frm, "restarting", "")
+        for b in stale:
+            b.stop(drain=False, timeout=0.5)
+        try:
+            self._loader(self)
+        except BaseException as e:
+            self._transition("unhealthy", f"restart load failed: {e}")
+            raise
+        self._transition("healthy", "restarted")
+        return self
+
+    def drain(self, timeout: float = 10.0) -> None:
+        """Graceful: serve what is queued, then stop the batchers."""
+        with self._lock:
+            frm = self._state
+            self._state = "draining"  # flip INSIDE the lock that clears
+            batchers = list(self._batchers.values())  # _batchers, or a
+            self._batchers.clear()  # racing submit resurrects a batcher
+        self._emit_transition(frm, "draining", "")
+        for b in batchers:
+            b.stop(drain=True, timeout=timeout)
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self.drain(timeout=timeout)
+        self._transition("stopped")
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            state = self._state
+            batchers = dict(self._batchers)
+        return {"replica": self.name, "state": state,
+                "restarts": self.restarts, "kills": self.kills,
+                "queue_depth": sum(b.depth() for b in batchers.values()),
+                "models": {n: b.metrics.snapshot() for n, b in
+                           sorted(batchers.items())}}
+
+    def __repr__(self):
+        return f"Replica({self.name!r}, {self.state})"

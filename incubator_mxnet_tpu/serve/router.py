@@ -1,0 +1,685 @@
+"""Router — health-checked failover routing over a set of replicas.
+
+Reference counterpart: none in-framework — MMS deployments put a cloud
+load balancer in front of N server processes and hoped. Here the routing
+tier is framework-native so it can close the loop with the runtime it
+fronts: the heartbeat reads real batcher progress, a failover retries the
+*exact* queued request (futures fail fast on a killed replica), the
+prewarm path is the compile ledger's zero-recompile contract, and the
+training→serving weight pipe reuses ``fault.checkpoint``'s CRC-verified
+``load_latest``.
+
+Policies, all env-tunable (``MXTPU_SERVE_*``, see docs/env_vars.md):
+
+- **Health**: a heartbeat loop (``MXTPU_SERVE_HEARTBEAT_MS``) probes each
+  replica; a crash (chaos ``replica_kill``, dead batcher worker,
+  ``LockOrderError`` from the request path) or a stall (queued requests
+  with no flush progress for ``MXTPU_SERVE_STALL_S``) marks it unhealthy
+  and a restarter thread rebuilds it — prewarming from the
+  :class:`~incubator_mxnet_tpu.serve.artifact_cache.ArtifactCache` when
+  the loader is wired through one.
+- **Failover**: idempotent requests retry on a surviving replica with
+  capped exponential backoff (``MXTPU_SERVE_RETRIES`` ×
+  ``MXTPU_SERVE_RETRY_BACKOFF_MS``); per-request deadlines bound the
+  total wait. One optional **hedged** attempt (``MXTPU_SERVE_HEDGE_MS``)
+  races a duplicate on a second replica when the first is slow.
+- **Admission / shedding**: per-tenant inflight caps
+  (``MXTPU_SERVE_TENANT_INFLIGHT``) and a queue-depth overload threshold
+  (``MXTPU_SERVE_SHED_DEPTH``) reject with :class:`ShedError` carrying
+  ``retry_after`` — explicit load shedding instead of unbounded queueing,
+  layered ON TOP of the per-replica ``DynamicBatcher`` backpressure.
+- **Weight pipe**: :meth:`Router.sync_weights_once` pulls the newest
+  **verified** checkpoint (CRC via ``fault.checkpoint.load_latest``),
+  staging-checks it (every float array finite, names resolvable), and
+  pushes it to every healthy replica via ``refresh_params`` — zero
+  recompiles; a checkpoint that fails verification or staging is
+  reported and never swapped in.
+
+Every decision publishes telemetry: ``router.health`` (transitions),
+``router.failover``, ``router.shed``, ``router.hedge``,
+``router.weight_sync``, plus ``mxtpu_router_*`` counters and the
+``mxtpu_serve_replicas_healthy`` gauge.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as onp
+
+from ..base import MXNetError
+from ..lockcheck import LockOrderError, make_lock
+from .batcher import QueueFullError, ServeFuture
+from .replica import Replica, ReplicaUnavailable
+
+__all__ = ["Router", "ReplicaSet", "ShedError", "DeadlineExceeded"]
+
+
+class ShedError(MXNetError):
+    """Request explicitly rejected by admission control / overload
+    shedding / placement exhaustion. ``retry_after`` (seconds) is the
+    client's backoff hint — the structured alternative to queueing
+    unboundedly or dropping silently."""
+
+    def __init__(self, msg: str, retry_after: float, reason: str = "shed"):
+        super().__init__(f"{msg} (retry_after={retry_after:.3f}s)")
+        self.retry_after = retry_after
+        self.reason = reason
+
+
+class DeadlineExceeded(MXNetError):
+    """The per-request deadline expired before any replica produced a
+    result. Carries ``retry_after`` like :class:`ShedError` so clients
+    handle both rejection shapes uniformly."""
+
+    def __init__(self, msg: str, retry_after: float):
+        super().__init__(f"{msg} (retry_after={retry_after:.3f}s)")
+        self.retry_after = retry_after
+        self.reason = "deadline"
+
+
+class ReplicaSet:
+    """Fixed set of uniquely-named replicas with least-loaded pick."""
+
+    def __init__(self, replicas: Sequence[Replica]):
+        if not replicas:
+            raise MXNetError("ReplicaSet needs at least one replica")
+        names = [r.name for r in replicas]
+        if len(set(names)) != len(names):
+            raise MXNetError(f"replica names must be unique, got {names}")
+        self._replicas: Tuple[Replica, ...] = tuple(replicas)
+        self._rr = itertools.count()
+
+    def __iter__(self):
+        return iter(self._replicas)
+
+    def __len__(self):
+        return len(self._replicas)
+
+    def get(self, name: str) -> Replica:
+        for r in self._replicas:
+            if r.name == name:
+                return r
+        raise MXNetError(f"no replica {name!r} (have "
+                         f"{[r.name for r in self._replicas]})")
+
+    def healthy(self) -> List[Replica]:
+        return [r for r in self._replicas if r.healthy()]
+
+    def pick(self, exclude: Sequence[str] = ()) -> Optional[Replica]:
+        """Healthy replica with the shallowest queue; ties rotate
+        round-robin so equal-depth replicas share the load."""
+        cands = [r for r in self._replicas
+                 if r.healthy() and r.name not in exclude]
+        if not cands:
+            return None
+        rot = next(self._rr)
+        return min(((r.queue_depth(), (i + rot) % len(cands), r)
+                    for i, r in enumerate(cands)),
+                   key=lambda t: (t[0], t[1]))[2]
+
+    def states(self) -> Dict[str, str]:
+        return {r.name: r.state for r in self._replicas}
+
+
+class Router:
+    """Front door of the HA tier: admission → placement → deadline-bound
+    wait → failover/hedge, plus the health loop and the weight pipe."""
+
+    def __init__(self, replicas, *,
+                 heartbeat_ms: Optional[float] = None,
+                 stall_s: Optional[float] = None,
+                 retries: Optional[int] = None,
+                 backoff_ms: Optional[float] = None,
+                 hedge_ms: Optional[float] = None,
+                 shed_depth: Optional[int] = None,
+                 tenant_inflight: Optional[int] = None,
+                 request_timeout_s: Optional[float] = None,
+                 restart_backoff_s: float = 0.5):
+        from ..util import getenv
+        self.replicas = (replicas if isinstance(replicas, ReplicaSet)
+                         else ReplicaSet(replicas))
+        self.heartbeat_ms = float(getenv("MXTPU_SERVE_HEARTBEAT_MS")
+                                  if heartbeat_ms is None else heartbeat_ms)
+        self.stall_s = float(getenv("MXTPU_SERVE_STALL_S")
+                             if stall_s is None else stall_s)
+        self.retries = int(getenv("MXTPU_SERVE_RETRIES")
+                           if retries is None else retries)
+        self.backoff_ms = float(getenv("MXTPU_SERVE_RETRY_BACKOFF_MS")
+                                if backoff_ms is None else backoff_ms)
+        self.hedge_ms = float(getenv("MXTPU_SERVE_HEDGE_MS")
+                              if hedge_ms is None else hedge_ms)
+        self.shed_depth = int(getenv("MXTPU_SERVE_SHED_DEPTH")
+                              if shed_depth is None else shed_depth)
+        self.tenant_inflight = int(
+            getenv("MXTPU_SERVE_TENANT_INFLIGHT")
+            if tenant_inflight is None else tenant_inflight)
+        self.request_timeout_s = float(
+            getenv("MXTPU_SERVE_REQUEST_TIMEOUT_S")
+            if request_timeout_s is None else request_timeout_s)
+        self.restart_backoff_s = float(restart_backoff_s)
+        self._lock = make_lock("Router._lock")
+        self._stop_evt = threading.Event()
+        self._health_thread: Optional[threading.Thread] = None
+        self._sync_threads: Dict[str, threading.Thread] = {}
+        self._restarting: set = set()
+        self._restart_threads: Dict[str, threading.Thread] = {}
+        self._inflight: Dict[str, int] = {}
+        #: (model, ckpt_root) -> {"step", "fleet": {replica: restarts}}
+        self._synced_steps: Dict[Tuple[str, str], Dict] = {}
+        #: health-thread-private stall accounting {name: {batches, since}}
+        self._progress: Dict[str, Dict] = {}
+        self.health_errors = 0
+        self.stats: Dict[str, int] = {
+            "accepted": 0, "completed": 0, "shed": 0, "failed": 0,
+            "deadline_exceeded": 0, "retries": 0, "failovers": 0,
+            "hedges": 0, "hedge_wins": 0, "restarts": 0,
+            "weight_syncs": 0}
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> "Router":
+        for rep in self.replicas:
+            state = rep.state
+            if state == "new":
+                rep.start()
+            elif state == "stopped":
+                # a stopped replica's registry still holds its versions;
+                # rebooting it is the restart path (fresh registry, the
+                # loader re-runs against the artifact cache)
+                rep.restart()
+        if self._health_thread is None or not self._health_thread.is_alive():
+            self._stop_evt.clear()
+            self._health_thread = threading.Thread(
+                target=self._health_loop, name="mx-serve-router-health",
+                daemon=True)
+            self._health_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+        # join the HEALTH thread first: only it spawns restarters, and
+        # _schedule_restart no-ops once the stop event is set — so after
+        # this join the restarter set can only shrink
+        if self._health_thread is not None and self._health_thread.is_alive():
+            self._health_thread.join(timeout=30.0)
+        self._health_thread = None
+        with self._lock:
+            syncers = list(self._sync_threads.values())
+            self._sync_threads.clear()
+            restarters = list(self._restart_threads.values())
+            self._restart_threads.clear()
+        # restarter threads are joined BEFORE stopping the replicas so a
+        # restart in flight cannot flip a member back to healthy under a
+        # stopped tier (or race module teardown in tests)
+        for t in syncers + restarters:
+            if t.is_alive():
+                t.join(timeout=30.0)
+        for rep in self.replicas:
+            rep.stop()
+
+    # -- telemetry helpers ----------------------------------------------
+    def _bump(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self.stats[key] += n
+
+    @staticmethod
+    def _counter(name: str, help_: str, **labels):
+        from ..telemetry import metrics as _tmetrics
+        return _tmetrics.counter(name, help_, **labels)
+
+    def _emit(self, kind: str, severity: str = "info", **fields) -> None:
+        from ..telemetry import events as _tele
+        _tele.emit(kind, severity=severity, **fields)
+
+    def retry_after_s(self) -> float:
+        """Client backoff hint: scales with the shallowest healthy queue
+        (roughly the wait for it to drain); 1s when nothing is healthy
+        (a restart is in flight)."""
+        healthy = self.replicas.healthy()
+        if not healthy:
+            return 1.0
+        depth = min(r.queue_depth() for r in healthy)
+        return round(min(5.0, 0.05 * (1 + depth)), 3)
+
+    # -- admission ------------------------------------------------------
+    def _shed(self, reason: str, msg: str, model: str,
+              tenant: Optional[str]) -> ShedError:
+        err = ShedError(msg, retry_after=self.retry_after_s(), reason=reason)
+        self._bump("shed")
+        self._counter("mxtpu_router_sheds_total",
+                      "Requests explicitly shed by the router",
+                      reason=reason).inc()
+        self._emit("router.shed", severity="warning", model=model,
+                   tenant=tenant, reason=reason,
+                   retry_after=err.retry_after)
+        return err
+
+    def _deadline(self, msg: str) -> DeadlineExceeded:
+        """Accounted constructor: an accepted request that times out must
+        show up in the stats like its ShedError sibling, not read as
+        permanently in flight."""
+        self._bump("deadline_exceeded")
+        self._counter("mxtpu_router_deadline_exceeded_total",
+                      "Accepted requests that hit their deadline").inc()
+        return DeadlineExceeded(msg, self.retry_after_s())
+
+    def _admit(self, model: str, tenant: Optional[str]) -> None:
+        healthy = self.replicas.healthy()
+        if not healthy:
+            raise self._shed("no_healthy_replica",
+                             "no healthy replica to accept the request",
+                             model, tenant)
+        if self.shed_depth and all(r.queue_depth() >= self.shed_depth
+                                   for r in healthy):
+            raise self._shed(
+                "overloaded",
+                f"every healthy replica is at/over the shed depth "
+                f"({self.shed_depth})", model, tenant)
+        key = tenant or "default"
+        if self.tenant_inflight:
+            with self._lock:
+                if self._inflight.get(key, 0) >= self.tenant_inflight:
+                    over = True
+                else:
+                    self._inflight[key] = self._inflight.get(key, 0) + 1
+                    over = False
+            if over:
+                raise self._shed(
+                    "tenant_limit",
+                    f"tenant {key!r} is at its inflight cap "
+                    f"({self.tenant_inflight})", model, tenant)
+        self._bump("accepted")
+
+    def _release(self, tenant: Optional[str]) -> None:
+        if self.tenant_inflight:
+            key = tenant or "default"
+            with self._lock:
+                self._inflight[key] = max(0, self._inflight.get(key, 0) - 1)
+
+    # -- request path ---------------------------------------------------
+    def call(self, model: str, *arrays, timeout_s: Optional[float] = None,
+             tenant: Optional[str] = None, idempotent: bool = True):
+        """Route one single-example request; returns the model output(s).
+
+        Raises :class:`ShedError` (admission/overload/placement, with
+        ``retry_after``), :class:`DeadlineExceeded` (per-request deadline,
+        with ``retry_after``), or the request's own validation error.
+        Every infrastructure failure in between is retried on a surviving
+        replica when ``idempotent`` (the default) — an accepted request
+        is never silently dropped.
+        """
+        return self.call_detailed(model, *arrays, timeout_s=timeout_s,
+                                  tenant=tenant, idempotent=idempotent)[0]
+
+    def call_detailed(self, model: str, *arrays,
+                      timeout_s: Optional[float] = None,
+                      tenant: Optional[str] = None,
+                      idempotent: bool = True) -> Tuple[object, Dict]:
+        """:meth:`call` plus a per-request info dict — ``{replica,
+        failovers, retries, hedged, latency_ms}`` — so benches can split
+        failover-path tail latency from the happy path."""
+        t0 = time.perf_counter()
+        timeout_s = (self.request_timeout_s if timeout_s is None
+                     else float(timeout_s))
+        t_deadline = time.monotonic() + timeout_s
+        info: Dict = {"replica": None, "failovers": 0, "retries": 0,
+                      "hedged": False}
+        self._admit(model, tenant)
+        try:
+            val = self._call_admitted(model, arrays, t_deadline,
+                                      tenant, idempotent, info)
+        finally:
+            self._release(tenant)
+        info["latency_ms"] = round((time.perf_counter() - t0) * 1e3, 3)
+        return val, info
+
+    def _call_admitted(self, model: str, arrays, t_deadline: float,
+                       tenant: Optional[str], idempotent: bool,
+                       info: Dict):
+        exclude: set = set()
+        attempt = 0
+        last_err: Optional[BaseException] = None
+        while True:
+            now = time.monotonic()
+            if now >= t_deadline:
+                raise self._deadline(
+                    f"request deadline expired before completion "
+                    f"(last error: {last_err})")
+            rep = self.replicas.pick(exclude)
+            if rep is None:
+                # nowhere to place RIGHT NOW: a restart may rejoin, so
+                # back off and re-open excluded replicas — up to the
+                # retry cap, then shed explicitly
+                if attempt >= self.retries:
+                    raise self._shed(
+                        "placement_exhausted",
+                        f"no replica completed the request after "
+                        f"{attempt} retries (last error: {last_err})",
+                        model, tenant)
+                attempt += 1
+                self._bump("retries")
+                info["retries"] += 1
+                self._backoff(attempt, t_deadline)
+                exclude.clear()
+                continue
+            try:
+                fut = rep.submit(model, *arrays)
+            except QueueFullError as e:
+                last_err = e
+                exclude.add(rep.name)
+                continue
+            except ReplicaUnavailable as e:
+                last_err = e
+                self._note_failover(rep, model, e)
+                info["failovers"] += 1
+                exclude.add(rep.name)
+                continue
+            try:
+                return self._await_result(rep, fut, model, arrays,
+                                          exclude, t_deadline, info,
+                                          idempotent)
+            except _InfraFailure as e:
+                last_err = e.cause
+                self._note_failover(rep, model, e.cause)
+                info["failovers"] += 1
+                if isinstance(e.cause, LockOrderError):
+                    # a lock-order inversion poisons the whole replica,
+                    # not just this request
+                    rep.kill(reason=f"lock-order: {e.cause}")
+                if not idempotent:
+                    self._bump("failed")
+                    raise e.cause
+                exclude.add(rep.name)
+                if attempt < self.retries:
+                    attempt += 1
+                    self._bump("retries")
+                    info["retries"] += 1
+                    self._backoff(attempt, t_deadline)
+                    continue
+                raise self._shed(
+                    "retries_exhausted",
+                    f"request failed on {attempt + 1} replica(s); "
+                    f"last error: {e.cause}", model, tenant)
+
+    def _backoff(self, attempt: int, t_deadline: float) -> None:
+        """Capped exponential backoff, never sleeping past the request
+        deadline."""
+        delay = min(self.backoff_ms * (2 ** (attempt - 1)), 200.0) / 1e3
+        delay = min(delay, max(0.0, t_deadline - time.monotonic()))
+        if delay > 0:
+            time.sleep(delay)
+
+    @staticmethod
+    def _is_infra(exc: BaseException) -> bool:
+        """Classify a placed request's failure: infrastructure failures
+        (crashed/stopped replica, chaos kill, lock-order poison, plumbing
+        I/O) are retryable on a surviving replica; anything else is the
+        request's own error and must surface to the caller unchanged —
+        retrying a deterministically bad request would fail every replica
+        and mislead the client into backing off forever."""
+        from ..fault.inject import ChaosCrash
+        if isinstance(exc, (ReplicaUnavailable, ChaosCrash, LockOrderError,
+                            TimeoutError, ConnectionError, OSError)):
+            return True
+        return isinstance(exc, MXNetError) and "batcher stopped" in str(exc)
+
+    def _await_result(self, rep: Replica, fut: ServeFuture, model: str,
+                      arrays, exclude: set, t_deadline: float,
+                      info: Dict, idempotent: bool):
+        """Wait for ``fut`` under the request deadline, optionally racing
+        ONE hedged duplicate on a second replica after ``hedge_ms`` —
+        only for idempotent requests (a hedge IS a duplicate execution)."""
+        hedge_at = (time.monotonic() + self.hedge_ms / 1e3
+                    if self.hedge_ms > 0 and idempotent else None)
+        racers: List[Tuple[Replica, ServeFuture]] = [(rep, fut)]
+        hedged = False
+        while True:
+            now = time.monotonic()
+            if now >= t_deadline:
+                raise self._deadline(
+                    f"replica {rep.name!r} produced no result within the "
+                    "request deadline")
+            if not hedged and hedge_at is not None and now >= hedge_at:
+                hedged = True
+                h = self.replicas.pick(exclude | {rep.name})
+                if h is not None:
+                    try:
+                        racers.append((h, h.submit(model, *arrays)))
+                        info["hedged"] = True
+                        self._bump("hedges")
+                        self._counter("mxtpu_router_hedges_total",
+                                      "Hedged duplicate attempts").inc()
+                        self._emit("router.hedge", model=model,
+                                   primary=rep.name, hedge=h.name,
+                                   after_ms=self.hedge_ms)
+                    except MXNetError:
+                        pass  # hedging is best-effort by definition
+            done = [(r, f) for r, f in racers if f.done()]
+            for r, f in done:
+                try:
+                    val = f.result(timeout=0)
+                except BaseException as e:  # noqa: BLE001 — classified here
+                    if not self._is_infra(e):
+                        raise  # the request's own error — not retryable
+                    racers = [(rr, ff) for rr, ff in racers if ff is not f]
+                    if not racers:
+                        raise _InfraFailure(e)
+                    continue
+                if f is not fut:
+                    self._bump("hedge_wins")
+                info["replica"] = r.name
+                self._bump("completed")
+                return val
+            # block on the oldest outstanding racer up to the next event
+            # (hedge arm time, request deadline) instead of spinning
+            horizon = t_deadline
+            if hedge_at is not None and not hedged:
+                horizon = min(horizon, hedge_at)
+            elif len(racers) > 1:
+                horizon = min(horizon, now + 0.005)
+            racers[0][1].wait(max(0.0, horizon - time.monotonic()))
+
+    def _note_failover(self, rep: Replica, model: str,
+                       err: BaseException) -> None:
+        self._bump("failovers")
+        self._counter("mxtpu_router_failovers_total",
+                      "Requests failed over to another replica",
+                      replica=rep.name).inc()
+        self._emit("router.failover", severity="warning", model=model,
+                   replica=rep.name,
+                   error=f"{type(err).__name__}: {err}"[:200])
+
+    # -- health loop ----------------------------------------------------
+    def _health_loop(self) -> None:
+        interval = self.heartbeat_ms / 1e3
+        while not self._stop_evt.wait(interval):
+            try:
+                self.health_check_once()
+            except Exception:  # noqa: BLE001 — the loop must outlive bugs
+                with self._lock:
+                    self.health_errors += 1
+
+    def health_check_once(self) -> Dict[str, str]:
+        """One heartbeat sweep (the loop body, callable from tests):
+        stall-checks healthy replicas, schedules restarts for crashed/
+        unhealthy ones, refreshes the healthy gauge. Returns the state
+        map."""
+        from ..telemetry import metrics as _tmetrics
+        n_healthy = 0
+        for rep in self.replicas:
+            hb = rep.heartbeat()
+            state = hb["state"]
+            if state == "healthy":
+                n_healthy += 1
+                self._check_stall(rep, hb)
+            elif state in ("crashed", "unhealthy"):
+                self._schedule_restart(rep)
+        _tmetrics.gauge("mxtpu_serve_replicas_healthy",
+                        "Replicas currently serving").set(n_healthy)
+        return self.replicas.states()
+
+    def _check_stall(self, rep: Replica, hb: Dict) -> None:
+        """Deadline-missed detection: queued requests with zero flush
+        progress for ``stall_s`` means the replica is wedged (hung
+        compile, deadlocked worker) — kill it so the restart path and the
+        request retries take over. ``_progress`` is touched only by the
+        health thread."""
+        prev = self._progress.get(rep.name)
+        if prev is None or hb["batches"] != prev["batches"] \
+                or hb["depth"] == 0:
+            self._progress[rep.name] = {"batches": hb["batches"],
+                                        "since": hb["ts"]}
+            return
+        if hb["ts"] - prev["since"] >= self.stall_s:
+            self._progress.pop(rep.name, None)
+            rep.kill(reason=f"stalled: {hb['depth']} queued, no flush "
+                            f"for {self.stall_s:.1f}s")
+
+    def _schedule_restart(self, rep: Replica) -> None:
+        if self._stop_evt.is_set():
+            return  # a stopping tier must not spawn new restarters
+        with self._lock:
+            if rep.name in self._restarting:
+                return
+            self._restarting.add(rep.name)
+            t = threading.Thread(target=self._restart_replica, args=(rep,),
+                                 name=f"mx-serve-restart-{rep.name}",
+                                 daemon=True)
+            self._restart_threads[rep.name] = t
+        t.start()
+
+    def _restart_replica(self, rep: Replica) -> None:
+        try:
+            rep.restart()
+            self._bump("restarts")
+            self._counter("mxtpu_serve_replica_restarts_total",
+                          "Replica restarts by the router",
+                          replica=rep.name).inc()
+        except Exception:  # noqa: BLE001 — replica already marked
+            # unhealthy; pace the retry so a permanently broken loader
+            # cannot hot-loop the restarter
+            self._stop_evt.wait(self.restart_backoff_s)
+        finally:
+            with self._lock:
+                self._restarting.discard(rep.name)
+                self._restart_threads.pop(rep.name, None)
+
+    # -- training→serving weight pipe -----------------------------------
+    def sync_weights_once(self, model: str, ckpt_root: str) -> Dict:
+        """Pull the newest **verified** checkpoint under ``ckpt_root``
+        and push it to every healthy replica with zero recompiles.
+
+        Never swaps in bad weights: ``load_latest`` already walks past
+        CRC-corrupt checkpoints, and the staging check here rejects
+        non-finite float arrays and checkpoints whose names match no
+        parameter. Returns an outcome dict (also published as a
+        ``router.weight_sync`` event)."""
+        from ..fault import checkpoint as fault_checkpoint
+        from .registry import map_checkpoint_arrays
+        try:
+            arrays, meta, step = fault_checkpoint.load_latest(ckpt_root)
+        except fault_checkpoint.CheckpointError as e:
+            out = {"outcome": "no_checkpoint", "error": str(e)[:200]}
+            self._emit("router.weight_sync", severity="warning",
+                       model=model, **out)
+            return out
+        # "unchanged" must mean unchanged FLEET, not just an unchanged
+        # step: a replica that failed the last push or restarted since
+        # (its rebuild prewarms from the artifact cache's original
+        # weights) needs the step re-pushed or it serves stale weights
+        # until training produces a new checkpoint
+        fleet = {r.name: r.restarts for r in self.replicas}
+        with self._lock:
+            prev = self._synced_steps.get((model, ckpt_root))
+            if prev is not None and prev["step"] == step \
+                    and prev["fleet"] == fleet:
+                return {"outcome": "unchanged", "step": step}
+        weights = map_checkpoint_arrays(arrays, meta)
+        bad = sorted(k for k, v in weights.items()
+                     if v.dtype.kind == "f" and not onp.isfinite(v).all())
+        if bad:
+            out = {"outcome": "rejected", "step": step,
+                   "reason": "non_finite",
+                   "arrays": bad[:4]}
+            self._emit("router.weight_sync", severity="error", model=model,
+                       **out)
+            return out
+        applied, failed, skipped = [], [], []
+        for rep in self.replicas:
+            if not rep.healthy():
+                skipped.append(rep.name)
+                continue
+            try:
+                rep.push_weights(model, weights)
+                applied.append(rep.name)
+            except MXNetError as e:
+                failed.append({"replica": rep.name,
+                               "error": str(e)[:200]})
+        if applied:
+            with self._lock:
+                # record the fleet shape only when EVERY replica took the
+                # push — a partial fleet keeps re-syncing each cadence
+                # until it converges
+                if not failed and not skipped:
+                    self._synced_steps[(model, ckpt_root)] = {
+                        "step": step, "fleet": fleet}
+                else:
+                    self._synced_steps.pop((model, ckpt_root), None)
+                self.stats["weight_syncs"] += 1
+        out = {"outcome": "applied" if applied else "rejected",
+               "step": step, "replicas": applied, "failed": failed}
+        self._emit("router.weight_sync",
+                   severity="info" if applied else "error",
+                   model=model, **out)
+        return out
+
+    def start_weight_sync(self, model: str, ckpt_root: str,
+                          interval_s: float) -> None:
+        """Background cadence for :meth:`sync_weights_once` (one thread
+        per model; stops with the router)."""
+        def loop():
+            while not self._stop_evt.wait(interval_s):
+                try:
+                    self.sync_weights_once(model, ckpt_root)
+                except Exception as e:  # noqa: BLE001 — cadence survives
+                    self._emit("router.weight_sync", severity="error",
+                               model=model, outcome="error",
+                               error=f"{type(e).__name__}: {e}"[:200])
+
+        with self._lock:
+            have = self._sync_threads.get(model)
+            if have is not None and have.is_alive():
+                return
+            t = threading.Thread(target=loop,
+                                 name=f"mx-serve-weight-sync-{model}",
+                                 daemon=True)
+            self._sync_threads[model] = t
+        t.start()
+
+    # -- reporting ------------------------------------------------------
+    def snapshot(self) -> Dict:
+        with self._lock:
+            stats = dict(self.stats)
+            inflight = dict(self._inflight)
+        return {"replicas": self.replicas.states(),
+                "stats": stats, "tenants_inflight": inflight,
+                "policy": {"retries": self.retries,
+                           "backoff_ms": self.backoff_ms,
+                           "hedge_ms": self.hedge_ms,
+                           "shed_depth": self.shed_depth,
+                           "tenant_inflight": self.tenant_inflight,
+                           "heartbeat_ms": self.heartbeat_ms,
+                           "stall_s": self.stall_s,
+                           "request_timeout_s": self.request_timeout_s}}
+
+
+class _InfraFailure(Exception):
+    """Internal: a placed request failed for infrastructure reasons
+    (crashed replica, stopped batcher, lock-order poison) — retryable
+    when the request is idempotent."""
+
+    def __init__(self, cause: BaseException):
+        super().__init__(str(cause))
+        self.cause = cause

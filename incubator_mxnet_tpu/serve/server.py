@@ -36,7 +36,6 @@ import numpy as onp
 from ..base import MXNetError
 from ..lockcheck import make_lock
 from .batcher import DynamicBatcher, ServeFuture
-from .metrics import ServeMetrics
 from .registry import ModelRegistry
 
 __all__ = ["Server", "client_call"]
@@ -62,14 +61,12 @@ class Server:
 
     # -- in-process path ------------------------------------------------
     def batcher(self, name: str) -> DynamicBatcher:
+        from .batcher import make_registry_batcher
         with self._lock:
             b = self._batchers.get(name)
             if b is None:
-                self.registry.get(name)  # raise early on unknown model
-                b = DynamicBatcher(lambda: self.registry.get(name),
-                                   metrics=ServeMetrics(model=name),
-                                   **self._batcher_kw)
-                b.start()
+                b = make_registry_batcher(self.registry, name,
+                                          **self._batcher_kw)
                 self._batchers[name] = b
         return b
 
@@ -106,6 +103,11 @@ class Server:
                     except Exception as e:  # noqa: BLE001 — wire boundary
                         reply = {"ok": False,
                                  "error": f"{type(e).__name__}: {e}"}
+                        # shed/overload errors carry a client backoff
+                        # hint — surface it structurally, not in prose
+                        retry_after = getattr(e, "retry_after", None)
+                        if retry_after is not None:
+                            reply["retry_after"] = retry_after
                     self.wfile.write(
                         (json.dumps(reply) + "\n").encode("utf-8"))
                     self.wfile.flush()
@@ -168,8 +170,18 @@ class Server:
             outs = outs if isinstance(outs, tuple) else (outs,)
             result = tuple(o.asnumpy()[0] for o in outs)
         else:
-            fut = self.submit(name, *arrays)
-            result = fut.result(timeout=30.0)
+            b = self.batcher(name)
+            fut = b.submit(*arrays)
+            from ..util import getenv
+            timeout_s = float(getenv("MXTPU_SERVE_REQUEST_TIMEOUT_S"))
+            try:
+                result = fut.result(timeout=timeout_s)
+            except TimeoutError:
+                # structured, retryable reply — a deadline miss is an
+                # operational state, not a stack trace
+                return {"ok": False, "error": "deadline_exceeded",
+                        "model": name, "timeout_s": timeout_s,
+                        "retry_after": b.retry_after_s()}
             if not isinstance(result, tuple):
                 result = (result,)
         return {"ok": True,

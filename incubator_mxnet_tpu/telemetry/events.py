@@ -28,8 +28,11 @@ Design:
 
 Event kinds in the wired runtime: ``train.step``, ``guard``, ``watchdog``,
 ``chaos``, ``kvstore``, ``serve.admit`` / ``serve.batch`` /
-``serve.execute`` / ``serve.reply`` / ``serve.reject`` / ``serve.load``,
-``compile``, ``amp.loss_scale``. Kinds are open — any string works.
+``serve.execute`` / ``serve.reply`` / ``serve.reject`` / ``serve.load`` /
+``serve.drain`` / ``serve.prewarm``, ``router.health`` /
+``router.failover`` / ``router.shed`` / ``router.hedge`` /
+``router.weight_sync`` (the HA serve tier), ``compile``,
+``amp.loss_scale``. Kinds are open — any string works.
 """
 from __future__ import annotations
 

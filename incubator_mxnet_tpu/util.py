@@ -50,6 +50,44 @@ ENV_VARS: Dict[str, tuple] = {
                                 "(mlp|lenet|bert)."),
     "MXTPU_SERVE_BENCH_N": ("1000", "serve_bench dynamic-section request "
                             "count."),
+    "MXTPU_SERVE_REQUEST_TIMEOUT_S": ("30", "Per-request deadline: the "
+                                      "TCP front end and the HA router "
+                                      "wait this long for a result, then "
+                                      "return a structured "
+                                      "deadline_exceeded reply with "
+                                      "retry_after instead of a bare "
+                                      "exception."),
+    "MXTPU_SERVE_HEARTBEAT_MS": ("100", "Router health-check interval: "
+                                 "each sweep probes every replica's "
+                                 "state, queue depth and flush "
+                                 "progress."),
+    "MXTPU_SERVE_STALL_S": ("2", "Queued requests with zero flush "
+                            "progress for this long mark a replica "
+                            "wedged — it is killed and restarted by the "
+                            "router's health loop."),
+    "MXTPU_SERVE_RETRIES": ("2", "Failover retries per idempotent "
+                            "request: each retry moves to a surviving "
+                            "replica with capped exponential backoff; "
+                            "exhaustion sheds explicitly with "
+                            "retry_after."),
+    "MXTPU_SERVE_RETRY_BACKOFF_MS": ("10", "Base backoff between router "
+                                     "failover retries (doubles per "
+                                     "attempt, capped at 200 ms, never "
+                                     "past the request deadline)."),
+    "MXTPU_SERVE_HEDGE_MS": ("0", "After this many ms without a result "
+                             "the router races ONE hedged duplicate on "
+                             "a second healthy replica (first result "
+                             "wins); 0 disables hedging."),
+    "MXTPU_SERVE_SHED_DEPTH": ("0", "Overload shedding: when EVERY "
+                               "healthy replica's queue is at/over this "
+                               "depth, new requests are rejected with "
+                               "retry_after instead of queueing; 0 "
+                               "disables (per-replica backpressure "
+                               "still applies)."),
+    "MXTPU_SERVE_TENANT_INFLIGHT": ("0", "Per-tenant admission cap: "
+                                    "concurrent router requests a single "
+                                    "tenant may hold before being shed "
+                                    "with retry_after; 0 = unlimited."),
     "MXTPU_BENCH_MODEL": ("bert_12_768_12", "bench.py model config."),
     "MXTPU_BENCH_TRACE": ("", "bench.py: capture one profiled step into this "
                           "directory (jax.profiler trace)."),

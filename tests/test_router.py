@@ -1,0 +1,467 @@
+"""HA serve tier — replicated workers, failover router, AOT prewarm cache.
+
+Covers the ISSUE 8 acceptance surface: CRC-verified artifact-cache
+put/get/corrupt-evict, replica kill/restart lifecycle with cache prewarm
+(zero post-restore compiles on the compile ledger), router failover with
+zero lost accepted requests, admission control + load shedding with
+``retry_after``, hedged attempts, the training→serving weight pipe, and
+the satellite hardening (env-tunable server timeout with a structured
+reply, monotonic drain + ``serve.drain`` event, hung-loader staging
+deadline).
+"""
+import json
+import os
+import threading
+import time
+
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon, nd, serve
+from incubator_mxnet_tpu.fault import checkpoint as fault_checkpoint
+from incubator_mxnet_tpu.fault import inject
+from incubator_mxnet_tpu.telemetry import compile_log
+from incubator_mxnet_tpu.telemetry import events as tele
+
+
+def _mlp(prefix):
+    net = gluon.nn.HybridSequential(prefix=prefix)
+    with net.name_scope():
+        net.add(gluon.nn.Dense(16, activation="relu", in_units=8))
+        net.add(gluon.nn.Dense(4, in_units=16))
+    net.initialize()
+    net.hybridize()
+    net(nd.array(onp.zeros((2, 8), "float32")))
+    return net
+
+
+@pytest.fixture(scope="module")
+def ha(tmp_path_factory):
+    """One shared artifact cache + source model for the whole module:
+    the first replica load pays the export, every later load is a
+    verified cache hit — exactly the fleet-restart economics the tier is
+    for (and it keeps this module inside the tier-1 budget)."""
+    root = tmp_path_factory.mktemp("ha")
+    net = _mlp("harouter_")
+    table = serve.BucketTable({"batch": (1, 2)})
+    cache = serve.ArtifactCache(str(root / "cache"))
+
+    def loader(rep):
+        rep.load("mlp", table=table, input_axes=[{0: "batch"}],
+                 factory=lambda: net, cache=cache,
+                 output_axes=[{0: "batch"}], analyze=False)
+
+    return {"net": net, "table": table, "cache": cache, "loader": loader,
+            "root": root}
+
+
+def _router(ha_env, n=2, **kw):
+    reps = [serve.Replica(f"r{i}", ha_env["loader"], max_delay_ms=2)
+            for i in range(n)]
+    kw.setdefault("heartbeat_ms", 40)
+    kw.setdefault("request_timeout_s", 15)
+    return serve.Router(reps, **kw).start(), reps
+
+
+def _wait_states(router, want="healthy", timeout=20.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        states = router.replicas.states()
+        if all(s == want for s in states.values()):
+            return states
+        time.sleep(0.05)
+    raise AssertionError(f"replicas never all {want}: "
+                         f"{router.replicas.states()}")
+
+
+# ---------------------------------------------------------------------------
+# ArtifactCache
+# ---------------------------------------------------------------------------
+class TestArtifactCache:
+    def test_put_get_roundtrip_keyed_by_geometry(self, ha):
+        cache, table, net = ha["cache"], ha["table"], ha["net"]
+        prefix = cache.put("rt", 1, net, table, [{0: "batch"}])
+        assert os.path.isfile(f"{prefix}-symbol.json")
+        got = cache.get("rt", 1, table, [{0: "batch"}])
+        assert got is not None
+        hit_prefix, manifest = got
+        assert hit_prefix == prefix
+        assert manifest["input_names"] == ["data"]
+        # the loaded artifact serves the same function
+        blk = gluon.SymbolBlock.imports(f"{hit_prefix}-symbol.json",
+                                        ["data"], f"{hit_prefix}-0000.params")
+        x = onp.random.RandomState(0).randn(1, 8).astype("float32")
+        net.hybridize(False)
+        onp.testing.assert_allclose(blk(nd.array(x)).asnumpy(),
+                                    net(nd.array(x)).asnumpy(),
+                                    rtol=1e-5, atol=1e-5)
+        net.hybridize()
+        net(nd.array(onp.zeros((2, 8), "float32")))
+        # a different bucket geometry is a different key → miss
+        other = serve.BucketTable({"batch": (1, 4)})
+        assert serve.signature_key(other, [{0: "batch"}]) \
+            != serve.signature_key(table, [{0: "batch"}])
+        assert cache.get("rt", 1, other, [{0: "batch"}]) is None
+
+    def test_corrupt_entry_detected_and_evicted(self, ha):
+        cache, table, net = ha["cache"], ha["table"], ha["net"]
+        prefix = cache.put("corrupt", 1, net, table, [{0: "batch"}])
+        params = f"{prefix}-0000.params"
+        with open(params, "r+b") as f:
+            f.seek(os.path.getsize(params) // 2)
+            b = f.read(1)
+            f.seek(-1, os.SEEK_CUR)
+            f.write(bytes([b[0] ^ 0xFF]))
+        before = cache.snapshot()["corrupt"]
+        assert cache.get("corrupt", 1, table, [{0: "batch"}]) is None
+        assert cache.snapshot()["corrupt"] == before + 1
+        # evicted: the entry is gone, a re-put repairs it
+        assert not os.path.isdir(os.path.dirname(prefix))
+        cache.put("corrupt", 1, net, table, [{0: "batch"}])
+        assert cache.get("corrupt", 1, table, [{0: "batch"}]) is not None
+        outcomes = [e.fields["outcome"] for e in tele.events("serve.prewarm")
+                    if e.fields.get("model") == "corrupt"]
+        assert outcomes == ["put", "corrupt", "put", "hit"]
+
+    @pytest.mark.chaos
+    def test_chaos_corrupt_artifact_site(self, ha):
+        cache, table, net = ha["cache"], ha["table"], ha["net"]
+        cache.put("chaoscorrupt", 1, net, table, [{0: "batch"}])
+        with inject.chaos(seed=3, crash_sites=["corrupt_artifact"]):
+            # armed once: first get is bit-flipped on disk → detected
+            assert cache.get("chaoscorrupt", 1, table,
+                             [{0: "batch"}]) is None
+            cache.put("chaoscorrupt", 1, net, table, [{0: "batch"}])
+            assert cache.get("chaoscorrupt", 1, table,
+                             [{0: "batch"}]) is not None
+
+
+# ---------------------------------------------------------------------------
+# Replica lifecycle
+# ---------------------------------------------------------------------------
+class TestReplica:
+    def test_kill_fails_fast_and_restart_prewarms(self, ha):
+        rep = serve.Replica("solo", ha["loader"], max_delay_ms=2)
+        rep.start()
+        assert rep.healthy()
+        out = rep.submit("mlp", onp.ones((8,), "float32")).result(timeout=10)
+        assert out.shape == (4,)
+        first_registry = rep.registry
+        rep.kill("test kill")
+        assert rep.state == "crashed"
+        with pytest.raises(serve.ReplicaUnavailable):
+            rep.submit("mlp", onp.ones((8,), "float32"))
+        hits_before = ha["cache"].snapshot()["hits"]
+        rep.restart()
+        assert rep.healthy() and rep.registry is not first_registry
+        # the rebuild prewarmed from the verified artifact cache ...
+        assert ha["cache"].snapshot()["hits"] == hits_before + 1
+        out = rep.submit("mlp", onp.ones((8,), "float32")).result(timeout=10)
+        assert out.shape == (4,)
+        # ... and the restore added ZERO post-warmup compiles
+        compile_log.assert_zero_post_warmup("serve.compiled")
+        rep.stop()
+        assert rep.state == "stopped"
+
+    @pytest.mark.chaos
+    def test_chaos_replica_kill_site(self, ha):
+        rep = serve.Replica("chaoskill", ha["loader"], max_delay_ms=2)
+        rep.start()
+        with inject.chaos(seed=5, crash_sites=["replica_kill"]):
+            with pytest.raises(serve.ReplicaCrashed):
+                rep.submit("mlp", onp.ones((8,), "float32"))
+        assert rep.state == "crashed"
+        trans = [(e.fields["from"], e.fields["to"])
+                 for e in tele.events("router.health")
+                 if e.fields.get("replica") == "chaoskill"]
+        assert ("healthy", "crashed") in trans
+
+
+# ---------------------------------------------------------------------------
+# Router: failover, shedding, hedging, weight pipe
+# ---------------------------------------------------------------------------
+class TestRouter:
+    @pytest.mark.chaos
+    def test_failover_zero_lost_accepted_requests(self, ha):
+        router, reps = _router(ha, n=2, retries=3)
+        try:
+            ok, rejected, errors = [], [], []
+            lock = threading.Lock()
+
+            def client(cid):
+                rng = onp.random.RandomState(cid)
+                for _ in range(25):
+                    try:
+                        router.call("mlp", rng.randn(8).astype("float32"))
+                        with lock:
+                            ok.append(1)
+                    except (serve.ShedError, serve.DeadlineExceeded) as e:
+                        assert e.retry_after > 0
+                        with lock:
+                            rejected.append(e)
+                    except Exception as e:  # noqa: BLE001 — gate evidence
+                        with lock:
+                            errors.append(repr(e))
+
+            threads = [threading.Thread(target=client, args=(c,),
+                                        name=f"t-client-{c}", daemon=False)
+                       for c in range(4)]
+            for t in threads:
+                t.start()
+            # one replica dies mid-traffic (armed chaos kill)
+            inject.enable(seed=11, crash_sites=["replica_kill"])
+            for t in threads:
+                t.join()
+            inject.disable()
+            # zero silent drops: every accepted request completed or was
+            # explicitly rejected with a retry_after
+            assert len(ok) + len(rejected) == 100 and not errors
+            stats = router.snapshot()["stats"]
+            assert stats["accepted"] == 100
+            assert stats["completed"] == len(ok)
+            # the killed replica rejoined via the health loop
+            _wait_states(router)
+            assert stats["failovers"] >= 1 or len(rejected) >= 1
+            compile_log.assert_zero_post_warmup("serve.compiled")
+        finally:
+            inject.disable()
+            router.stop()
+
+    def test_admission_shedding_with_retry_after(self, ha):
+        router, reps = _router(ha, n=1, tenant_inflight=1, retries=0)
+        try:
+            # tenant cap: a second concurrent request for the same tenant
+            # sheds; the router lock is held only for the counter, so
+            # fake the occupancy directly
+            with router._lock:
+                router._inflight["t0"] = 1
+            with pytest.raises(serve.ShedError) as ei:
+                router.call("mlp", onp.ones((8,), "float32"), tenant="t0")
+            assert ei.value.reason == "tenant_limit"
+            assert ei.value.retry_after > 0
+            with router._lock:
+                router._inflight["t0"] = 0
+            # a different tenant is unaffected
+            router.call("mlp", onp.ones((8,), "float32"), tenant="t1")
+            # no healthy replica: immediate explicit shed
+            reps[0].kill("test")
+            with pytest.raises(serve.ShedError) as ei:
+                router.call("mlp", onp.ones((8,), "float32"))
+            assert ei.value.reason in ("no_healthy_replica",
+                                       "placement_exhausted")
+            shed_events = [e for e in tele.events("router.shed")]
+            assert shed_events and all(
+                e.fields["retry_after"] > 0 for e in shed_events)
+            _wait_states(router)  # health loop restarts the killed one
+        finally:
+            router.stop()
+
+    def test_hedged_attempt_wins_on_slow_replica(self, ha):
+        router, reps = _router(ha, n=2, hedge_ms=30, retries=1)
+        try:
+            # wedge r0's submit path: its future never resolves
+            real_submit = reps[0].submit
+
+            def dead_submit(model, *arrays):
+                return serve.ServeFuture()  # never set → primary stalls
+
+            reps[0].submit = dead_submit
+            try:
+                # route deterministically to r0 first: r1 busy-looking is
+                # hard to fake, so try until the primary was the dead one
+                for _ in range(8):
+                    val, info = router.call_detailed(
+                        "mlp", onp.ones((8,), "float32"), timeout_s=5)
+                    assert val.shape == (4,)
+                    if info["hedged"]:
+                        break
+                assert router.snapshot()["stats"]["hedges"] >= 1
+                assert [e for e in tele.events("router.hedge")]
+            finally:
+                reps[0].submit = real_submit
+        finally:
+            router.stop()
+
+    def test_stall_detection_kills_and_restarts(self, ha):
+        router, reps = _router(ha, n=1, stall_s=0.15, heartbeat_ms=30)
+        try:
+            cm = reps[0].registry.get("mlp")
+            real_predict = cm.predict
+            started = threading.Event()
+
+            def wedged(*args):
+                started.set()
+                time.sleep(1.0)
+                return real_predict(*args)
+
+            cm.predict = wedged
+            fut = reps[0].submit("mlp", onp.ones((8,), "float32"))
+            started.wait(5)
+            # pile a second request behind the wedged flush so depth > 0
+            try:
+                reps[0].submit("mlp", onp.ones((8,), "float32"))
+            except serve.ReplicaUnavailable:
+                pass  # the health loop may kill first — that's the point
+            deadline = time.monotonic() + 10
+            while reps[0].kills == 0 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert reps[0].kills == 1, "stall was never detected"
+            _wait_states(router)
+            del fut
+        finally:
+            router.stop()
+
+    def test_stop_start_revives_the_tier(self, ha):
+        router, reps = _router(ha, n=1)
+        try:
+            assert router.call("mlp", onp.ones((8,), "float32")).shape \
+                == (4,)
+            router.stop()
+            assert reps[0].state == "stopped"
+            router.start()  # stopped replicas reboot via the restart path
+            assert router.call("mlp", onp.ones((8,), "float32")).shape \
+                == (4,)
+        finally:
+            router.stop()
+
+    def test_weight_sync_applies_verified_checkpoint(self, ha, tmp_path):
+        router, reps = _router(ha, n=2)
+        try:
+            x = onp.ones((8,), "float32")
+            before = router.call("mlp", x)
+            assert onp.abs(before).sum() > 0
+            net = ha["net"]
+            params = sorted(net.collect_params().items())
+            root = str(tmp_path / "ckpts")
+            meta = {"param_names": [p.name for _, p in params]}
+
+            def save(step, scale):
+                arrays = {f"param:{i:04d}": p.data().asnumpy() * scale
+                          for i, (_, p) in enumerate(params)}
+                fault_checkpoint.save_checkpoint(root, arrays, meta,
+                                                 step=step)
+
+            save(10, 0.0)  # zeroed weights, verified CRC
+            out = router.sync_weights_once("mlp", root)
+            assert out["outcome"] == "applied" and out["step"] == 10
+            assert sorted(out["replicas"]) == ["r0", "r1"]
+            for rep in reps:
+                got = rep.submit("mlp", x).result(timeout=10)
+                assert onp.abs(got).sum() == 0.0
+            # zero recompiles — the refresh_params contract
+            compile_log.assert_zero_post_warmup("serve.compiled")
+            # same step again: no-op
+            assert router.sync_weights_once("mlp", root)["outcome"] \
+                == "unchanged"
+            # a restarted replica prewarms from the cache's ORIGINAL
+            # weights — the next cadence must re-push the synced step,
+            # never leave it stale behind its peers
+            reps[0].restart()
+            assert router.sync_weights_once("mlp", root)["outcome"] \
+                == "applied"
+            got = reps[0].submit("mlp", x).result(timeout=10)
+            assert onp.abs(got).sum() == 0.0
+            # a non-finite checkpoint is rejected at staging — weights
+            # keep serving the last good step
+            arrays = {f"param:{i:04d}":
+                      onp.full(p.shape, onp.nan, "float32")
+                      for i, (_, p) in enumerate(params)}
+            fault_checkpoint.save_checkpoint(root, arrays, meta, step=20)
+            out = router.sync_weights_once("mlp", root)
+            assert out["outcome"] == "rejected" \
+                and out["reason"] == "non_finite"
+            got = reps[0].submit("mlp", x).result(timeout=10)
+            assert not onp.isnan(onp.asarray(got)).any()
+        finally:
+            router.stop()
+
+
+# ---------------------------------------------------------------------------
+# satellite: batcher drain (monotonic deadline + serve.drain event)
+# ---------------------------------------------------------------------------
+class TestDrainEvent:
+    def test_abandoned_requests_counted(self, ha):
+        rep = serve.Replica("drain", ha["loader"], max_delay_ms=2)
+        rep.start()
+        b = rep._batcher("mlp")
+        b2 = serve.DynamicBatcher(lambda: rep.registry.get("mlp"),
+                                  max_delay_ms=5,
+                                  metrics=serve.ServeMetrics(
+                                      model="drain-test"))
+        # never started: queued requests can only be abandoned
+        futs = [b2.submit(onp.ones((8,), "float32")) for _ in range(3)]
+        b2.stop(drain=True, timeout=0.2)
+        for f in futs:
+            with pytest.raises(mx.MXNetError, match="batcher stopped"):
+                f.result(timeout=1)
+        ev = [e for e in tele.events("serve.drain")
+              if e.fields.get("model") == "drain-test"]
+        assert ev and ev[-1].fields["abandoned"] == 3
+        assert ev[-1].fields["drained"] == 0
+        assert ev[-1].severity == "warning"
+        # a served-then-stopped batcher drains cleanly
+        rep.submit("mlp", onp.ones((8,), "float32")).result(timeout=10)
+        rep.stop()
+        ev = [e for e in tele.events("serve.drain")
+              if e.fields.get("model") == "mlp"]
+        assert ev and ev[-1].fields["abandoned"] == 0
+
+
+# ---------------------------------------------------------------------------
+# satellite: registry staged-load deadline (hung, not failing, loader)
+# ---------------------------------------------------------------------------
+class TestHungLoader:
+    def test_hung_staged_load_aborts_and_keeps_active(self, ha):
+        rep = serve.Replica("hung", ha["loader"], max_delay_ms=2)
+        rep.start()
+        reg = rep.registry
+        x = onp.ones((8,), "float32")
+        want = onp.asarray(rep.submit("mlp", x).result(timeout=10))
+
+        def hung_factory():
+            time.sleep(30)  # never returns within the deadline
+
+        t0 = time.monotonic()
+        with pytest.raises(mx.MXNetError, match="deadline"):
+            reg.load("mlp", table=ha["table"], input_axes=[{0: "batch"}],
+                     factory=hung_factory, deadline_s=0.3)
+        assert time.monotonic() - t0 < 5
+        # the active version kept serving, untouched
+        assert reg.models() == {"mlp": [1]}
+        got = onp.asarray(rep.submit("mlp", x).result(timeout=10))
+        onp.testing.assert_allclose(got, want, rtol=1e-6)
+        ev = [e for e in tele.events("serve.load")
+              if e.fields.get("outcome") == "timeout"]
+        assert ev and ev[-1].fields["model"] == "mlp"
+        rep.stop()
+
+
+# ---------------------------------------------------------------------------
+# satellite: env-tunable server request timeout, structured reply
+# ---------------------------------------------------------------------------
+def test_server_timeout_structured_reply(ha, monkeypatch):
+    monkeypatch.setenv("MXTPU_SERVE_REQUEST_TIMEOUT_S", "0.2")
+    rep = serve.Replica("srv", ha["loader"], max_delay_ms=2)
+    rep.start()
+    srv = serve.Server(rep.registry, max_delay_ms=2).start()
+    try:
+        x = onp.ones((8,), "float32")
+        reply = serve.client_call("127.0.0.1", srv.port,
+                                  {"model": "mlp", "inputs": [x.tolist()]})
+        assert reply["ok"], reply
+        # wedge the model so the request can't finish inside the deadline
+        cm = rep.registry.get("mlp")
+        real_predict = cm.predict
+        cm.predict = lambda *a: (time.sleep(1.0), real_predict(*a))[1]
+        reply = serve.client_call("127.0.0.1", srv.port,
+                                  {"model": "mlp", "inputs": [x.tolist()]})
+        assert reply["ok"] is False
+        assert reply["error"] == "deadline_exceeded"
+        assert reply["timeout_s"] == pytest.approx(0.2)
+        assert reply["retry_after"] > 0
+        assert json.dumps(reply)  # structured, strict-JSON serializable
+        cm.predict = real_predict
+    finally:
+        srv.stop()
+        rep.stop()
