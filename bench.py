@@ -19,6 +19,8 @@ Device-blind proxy mode (no TPU needed — the CI ``perf-proxy`` gate)::
     python bench.py --proxy --families bert,lenet
     python bench.py --proxy --out PERF_PROXY.json    # (re-)bank the baseline
     python bench.py --proxy --families bert --check PERF_PROXY.json
+    python bench.py --proxy --mesh-step              # + 8-forced-host-device
+                                                     #   compiled mesh-step probe
 
 ``--proxy`` traces every serving family's compiled graphs on CPU, prices
 them with ``analysis.hlo.cost`` (FLOPs/step, bytes/step, fusion counts —
@@ -366,7 +368,8 @@ def run_frcnn(watchdog) -> dict:
 #: banked-baseline metrics the --check gate compares (deterministic
 #: functions of the traced graph only — wall-time metrics like
 #: host_gap_ms vary per machine and are reported, never gated)
-_PROXY_GATE_KEYS = ("flops_per_step", "bytes_per_step")
+_PROXY_GATE_KEYS = ("flops_per_step", "bytes_per_step",
+                    "comm_bytes_per_step")
 #: measured fields excluded from the banked file so re-banking on a
 #: different machine never churns the committed baseline
 _PROXY_VOLATILE_KEYS = ("host_gap_ms", "instrumented_pct")
@@ -407,6 +410,8 @@ def _proxy_record(family: str, iters: int = 4) -> dict:
         "graphs": len(rep.rows),
         "flops_per_step": rep.model_flops_per_step(),
         "bytes_per_step": rep.bytes_per_step(),
+        "comm_bytes_per_step": rep.comm_bytes_per_step(),
+        "collective_ops": rep.collective_ops_per_step(),
         "param_bytes": head.param_bytes,
         "activation_bytes": head.activation_bytes,
         "transcendentals": head.transcendentals,
@@ -436,7 +441,16 @@ def _proxy_compare(current: dict, banked: dict, tol: float):
             continue
         for key in _PROXY_GATE_KEYS:
             b, c = base.get(key), rec.get(key)
-            if not b or c is None:
+            if b is None or c is None:
+                continue
+            if not b:
+                # a zero baseline has no ratio: any appearance IS the
+                # regression (e.g. collectives sneaking into a
+                # single-device serving graph, comm 0 -> N bytes)
+                if c:
+                    failures.append(
+                        f"{fam}.{key}: {c:.6g} vs banked 0 — the metric "
+                        "appeared from zero (new per-step cost)")
                 continue
             ratio = c / b
             if ratio > 1.0 + tol:
@@ -452,6 +466,84 @@ def _proxy_compare(current: dict, banked: dict, tol: float):
     return failures, warnings
 
 
+def _mesh_step_record(steps: int = 6) -> dict:
+    """Device-blind probe of the compiled mesh training step on forced
+    host devices: the SAME tiny model stepped on an 8-device dp×tp mesh
+    (the default pjit path) and on one device, host dispatch gap measured
+    by ``profiler.step_report`` over the trainer's own ``step`` frames,
+    the mesh step graph priced by ``analysis.hlo.cost`` (collective verbs
+    + comm bytes included). ``host_gap_ms_unsharded`` probes the PRE-pjit
+    execution path — unsharded (one device), gradients through the
+    per-parameter kvstore Python loop (``MXTPU_KVSTORE_FALLBACK=1``) —
+    the acceptance signal is ``host_gap_ms_mesh`` at or below it: the
+    compiled mesh step does strictly less host work than the loop it
+    replaced."""
+    import jax
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import gluon, parallel, profiler, telemetry
+    from incubator_mxnet_tpu.analysis import hlo
+
+    if len(jax.devices()) < 8:
+        raise RuntimeError(
+            "--mesh-step needs 8 forced host devices "
+            "(XLA_FLAGS=--xla_force_host_platform_device_count=8)")
+    rng = onp.random.RandomState(0)
+    x = rng.randn(16, 64).astype("float32")
+    y = rng.randint(0, 8, (16,)).astype("float32")
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def probe(mesh, fallback=False):
+        # pin the path EXPLICITLY both ways: a user-set
+        # MXTPU_KVSTORE_FALLBACK=1 in the environment must not turn the
+        # "mesh" half of the comparison into a second loop measurement
+        prev = os.environ.get("MXTPU_KVSTORE_FALLBACK")
+        os.environ["MXTPU_KVSTORE_FALLBACK"] = "1" if fallback else "0"
+        try:
+            mx.random.seed(7)
+            net = gluon.nn.HybridSequential()
+            net.add(gluon.nn.Dense(128, activation="relu", in_units=64),
+                    gluon.nn.Dense(8, in_units=128))
+            net.initialize(mx.init.Xavier())
+            tr = parallel.ShardedTrainer(net, loss_fn, "adamw",
+                                         {"learning_rate": 1e-3}, mesh=mesh)
+            tr.step(x, y).asnumpy()        # init + compile
+            batch = tr.place(x, y)         # steady state: resident inputs
+            tr.step(*batch).asnumpy()      # warm
+            profiler.reset_spans()
+            for _ in range(steps):
+                tr.step(*batch)
+            tr.sync_to_block()             # one honest sync at the end
+            sr = profiler.step_report(frame="step")
+            return tr, sr
+        finally:
+            if prev is None:
+                os.environ.pop("MXTPU_KVSTORE_FALLBACK", None)
+            else:
+                os.environ["MXTPU_KVSTORE_FALLBACK"] = prev
+
+    tr_mesh, sr_mesh = probe(parallel.make_mesh(dp=4, tp=2))
+    # the pre-pjit path: unsharded, per-parameter kvstore loop
+    _, sr_one = probe(parallel.make_mesh(devices=jax.devices()[:1]),
+                      fallback=True)
+    rep = hlo.cost(tr_mesh, sample_args=(x, y))
+    head = rep.head
+    record = {
+        "mesh": "dp=4,tp=2", "steps": steps,
+        "flops_per_step": rep.model_flops_per_step(),
+        "bytes_per_step": rep.bytes_per_step(),
+        "comm_bytes_per_step": rep.comm_bytes_per_step(),
+        # int total under the SAME key shape as the family records; the
+        # verb split rides under its own name
+        "collective_ops": rep.collective_ops_per_step(),
+        "collective_ops_by_verb": dict(head.collective_ops) if head else {},
+        "host_gap_ms_mesh": sr_mesh["host_gap_ms_mean"],
+        "host_gap_ms_unsharded": sr_one["host_gap_ms_mean"],
+        "path": tr_mesh.last_path,
+    }
+    telemetry.emit("perf.proxy", family="mesh_step", **record)
+    return record
+
+
 def run_proxy(argv) -> int:
     """CPU-only proxy bench: one record per serving family, optional
     banked write (``--out``) and tolerance gate (``--check``)."""
@@ -464,6 +556,10 @@ def run_proxy(argv) -> int:
     ap.add_argument("--families", default="all",
                     help="comma-separated models.SERVE_SPECS families, "
                          "or 'all' (default)")
+    ap.add_argument("--mesh-step", action="store_true",
+                    help="also probe the compiled mesh training step on 8 "
+                         "forced host devices (host-gap vs unsharded + "
+                         "collective comm record; reported, never banked)")
     ap.add_argument("--out", default=None,
                     help="write/refresh the banked baseline JSON here")
     ap.add_argument("--check", default=None,
@@ -476,9 +572,15 @@ def run_proxy(argv) -> int:
     args = ap.parse_args(argv)
 
     # the proxy is device-blind by design: pin cpu so it never claims the
-    # single-client TPU tunnel (same dance as tools/mxlint)
-    os.environ.setdefault("XLA_FLAGS",
-                          "--xla_force_host_platform_device_count=1")
+    # single-client TPU tunnel (same dance as tools/mxlint); the mesh-step
+    # probe needs the 8-device virtual mesh. APPEND the device-count flag
+    # when absent (same dance as tools/multichip_smoke) — setdefault would
+    # let any pre-set XLA_FLAGS silently defeat it.
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count="
+            + ("8" if args.mesh_step else "1")).strip()
     import jax
     jax.config.update("jax_platforms", "cpu")
     from incubator_mxnet_tpu import models
@@ -498,6 +600,15 @@ def run_proxy(argv) -> int:
     except RuntimeError as e:
         print(f"bench.py {e}", file=sys.stderr)
         return 2
+    mesh_step = None
+    if args.mesh_step:
+        try:
+            mesh_step = _mesh_step_record()
+        except RuntimeError as e:
+            # the probe needs 8 forced host devices; a device shortfall
+            # must not void the family gate that needed nothing from it
+            print(f"bench.py --mesh-step: {e}", file=sys.stderr)
+            mesh_step = {"error": str(e)}
 
     gate = None
     failures, warns = [], []
@@ -549,6 +660,8 @@ def run_proxy(argv) -> int:
         "extra": {"families": fams, "gate": gate,
                   "backend": jax.default_backend()},
     }
+    if mesh_step is not None:
+        result["extra"]["mesh_step"] = mesh_step
     print(json.dumps(result))
     return 1 if failures else 0
 

@@ -87,6 +87,10 @@ CODES = {
     "MX707": "informational per-graph cost table entry (FLOPs, bytes, "
              "transcendentals, fusion groups) from analysis.hlo.cost — "
              "never gates a build",
+    "MX708": "mesh-configured trainer step breaks the compiled-collective "
+             "contract: a per-parameter host round-trip (callback / live "
+             "device_put) or a non-donated >=64KiB parameter/optimizer "
+             "buffer survives in the step graph",
     "MX801": "shared attribute mutated without the lock that guards it "
              "elsewhere, in a class that runs threads (attribute→lock "
              "binding inferred from `with self._lock:` dominance)",
@@ -123,7 +127,7 @@ DEFAULT_SEVERITY: Dict[str, str] = {
     "MX601": "warning",
     "MX701": "error", "MX702": "warning", "MX703": "warning",
     "MX704": "warning", "MX705": "error", "MX706": "warning",
-    "MX707": "info",
+    "MX707": "info", "MX708": "error",
     "MX801": "warning", "MX802": "error", "MX803": "warning",
     "MX804": "warning", "MX805": "warning",
 }

@@ -95,6 +95,37 @@ _FUSIBLE = (_TRANSCENDENTAL | _ELEMENTWISE
             | frozenset({"broadcast_in_dim", "convert_element_type",
                          "reshape", "iota", "copy", "reduce_precision"}))
 
+#: explicit collective primitives (shard_map / pmap regions) → verb.
+#: Priced per device with the standard ring-algorithm byte counts.
+_COLLECTIVE_VERBS = {
+    "psum": "all_reduce", "pmax": "all_reduce", "pmin": "all_reduce",
+    "all_gather": "all_gather",
+    "psum_scatter": "reduce_scatter", "reduce_scatter": "reduce_scatter",
+    "all_to_all": "all_to_all",
+    "ppermute": "ppermute", "collective_permute": "ppermute",
+}
+
+
+def _collective_axes(eqn) -> tuple:
+    """Named mesh axes a collective eqn reduces/gathers over."""
+    ax = eqn.params.get("axes", eqn.params.get("axis_name", ()))
+    if isinstance(ax, (str, int)):
+        ax = (ax,)
+    return tuple(a for a in ax if isinstance(a, str))
+
+
+def _spec_axes(spec) -> frozenset:
+    """Mesh axis names a PartitionSpec partitions over."""
+    if spec is None:
+        return frozenset()
+    axes = set()
+    for e in tuple(spec):
+        if e is None:
+            continue
+        for a in ((e,) if isinstance(e, str) else tuple(e)):
+            axes.add(a)
+    return frozenset(axes)
+
 
 def _is_literal(v) -> bool:
     return type(v).__name__ == "Literal"
@@ -135,6 +166,14 @@ class GraphCost:
     fusion_groups: int = 0           # def-use components of fusible eqns
     fusion_candidates: int = 0       # groups of >= 2 eqns (real fusions)
     unknown_eqns: int = 0
+    #: collective verb → executed count: explicit shard_map/pmap prims in
+    #: the jaxpr PLUS, for a mesh-configured train graph, the implied SPMD
+    #: gradient exchange (all-reduce over ``dp``; reduce-scatter +
+    #: all-gather under ZeRO-1) derived from the in-resource specs
+    collective_ops: Dict[str, int] = field(default_factory=dict)
+    #: per-device communication bytes per executed call (ring-algorithm
+    #: accounting: all-reduce 2(N-1)/N·B, gather/scatter (N-1)/N·B)
+    comm_bytes: float = 0.0
     notes: List[str] = field(default_factory=list)
 
     @property
@@ -162,6 +201,9 @@ class GraphCost:
             "fusion_groups": int(self.fusion_groups),
             "fusion_candidates": int(self.fusion_candidates),
             "unknown_eqns": int(self.unknown_eqns),
+            "collective_ops": {k: int(v)
+                               for k, v in sorted(self.collective_ops.items())},
+            "comm_bytes": int(self.comm_bytes),
             "notes": list(self.notes),
         }
 
@@ -210,12 +252,49 @@ def _fusion_stats(jaxpr):
     return len(fusible), groups, candidates
 
 
-def _eqn_into(eqn, mul: float, acc: dict) -> None:
+def _axis_prod(axes: tuple, mesh_axes: Optional[Dict[str, int]]) -> int:
+    """Product of the named axis sizes, 0 when any size is unknown."""
+    n = 1
+    for a in axes:
+        size = (mesh_axes or {}).get(a)
+        if not size:
+            return 0
+        n *= size
+    return n
+
+
+def _comm_into(verb: str, nbytes: float, n: int, count: float,
+               acc: dict) -> None:
+    """Accumulate one collective: ring-algorithm per-device bytes —
+    all-reduce moves 2(N-1)/N·B, gather/scatter-family (N-1)/N·B,
+    ppermute B. Unknown axis size (n=0) prices the full payload."""
+    factor = (n - 1) / n if n > 1 else (0.0 if n == 1 else 1.0)
+    if verb == "all_reduce":
+        factor *= 2.0
+    if verb == "ppermute":
+        factor = 1.0
+    acc["collectives"][verb] = acc["collectives"].get(verb, 0) + count
+    acc["comm_bytes"] += factor * nbytes * count
+
+
+def _eqn_into(eqn, mul: float, acc: dict,
+              mesh_axes: Optional[Dict[str, int]] = None) -> None:
     name = eqn.primitive.name
     out_elems = sum(_elems(o.aval) for o in eqn.outvars
                     if hasattr(o, "aval"))
     out_bytes = sum(_nbytes(o.aval) for o in eqn.outvars
                     if hasattr(o, "aval"))
+    if name in _COLLECTIVE_VERBS:
+        verb = _COLLECTIVE_VERBS[name]
+        n = _axis_prod(_collective_axes(eqn), mesh_axes)
+        payload = out_bytes
+        if verb == "reduce_scatter":      # input is the full array
+            payload = sum(_nbytes(v.aval) for v in eqn.invars
+                          if not _is_literal(v) and hasattr(v, "aval"))
+        _comm_into(verb, payload, n, mul, acc)
+        acc["activation_bytes"] += out_bytes * mul
+        acc["eqns"] += 1
+        return
     flops = 0.0
     if name == "dot_general":
         (lc, _rc), _batch = eqn.params["dimension_numbers"]
@@ -258,7 +337,8 @@ def _closed_to_open(j):
     return j.jaxpr if hasattr(j, "jaxpr") and hasattr(j, "consts") else j
 
 
-def _walk_jaxpr(jaxpr, mul: float, acc: dict) -> None:
+def _walk_jaxpr(jaxpr, mul: float, acc: dict,
+                mesh_axes: Optional[Dict[str, int]] = None) -> None:
     fus = _fusion_stats(jaxpr)
     acc["fusible_eqns"] += fus[0]
     acc["fusion_groups"] += fus[1]
@@ -268,11 +348,13 @@ def _walk_jaxpr(jaxpr, mul: float, acc: dict) -> None:
         if name == "scan":
             length = int(eqn.params.get("length", 1))
             _walk_jaxpr(_closed_to_open(eqn.params["jaxpr"]),
-                        mul * max(length, 1), acc)
+                        mul * max(length, 1), acc, mesh_axes)
             continue
         if name == "while":
-            _walk_jaxpr(_closed_to_open(eqn.params["body_jaxpr"]), mul, acc)
-            _walk_jaxpr(_closed_to_open(eqn.params["cond_jaxpr"]), mul, acc)
+            _walk_jaxpr(_closed_to_open(eqn.params["body_jaxpr"]), mul, acc,
+                        mesh_axes)
+            _walk_jaxpr(_closed_to_open(eqn.params["cond_jaxpr"]), mul, acc,
+                        mesh_axes)
             note = "while body priced for one trip (count unknowable)"
             if note not in acc["notes"]:
                 acc["notes"].append(note)
@@ -282,7 +364,7 @@ def _walk_jaxpr(jaxpr, mul: float, acc: dict) -> None:
             best = None
             for b in branches:
                 sub = _fresh_acc()
-                _walk_jaxpr(_closed_to_open(b), mul, sub)
+                _walk_jaxpr(_closed_to_open(b), mul, sub, mesh_axes)
                 if best is None or sub["flops"] > best["flops"]:
                     best = sub
             if best is not None:
@@ -290,22 +372,61 @@ def _walk_jaxpr(jaxpr, mul: float, acc: dict) -> None:
                     if k == "notes":
                         acc["notes"].extend(n for n in v
                                             if n not in acc["notes"])
+                    elif k == "collectives":
+                        for verb, c in v.items():
+                            acc[k][verb] = acc[k].get(verb, 0) + c
                     else:
                         acc[k] += v
             continue
         subs = list(_sub_jaxprs(eqn))
         if subs:                      # pjit / remat / custom_*_call bodies
             for s in subs:
-                _walk_jaxpr(s, mul, acc)
+                _walk_jaxpr(s, mul, acc, mesh_axes)
             continue
-        _eqn_into(eqn, mul, acc)
+        _eqn_into(eqn, mul, acc, mesh_axes)
 
 
 def _fresh_acc() -> dict:
     return {"flops": 0.0, "matmul_flops": 0.0, "transcendentals": 0,
             "activation_bytes": 0, "eqns": 0, "fusible_eqns": 0,
             "fusion_groups": 0, "fusion_candidates": 0, "unknown_eqns": 0,
-            "notes": []}
+            "collectives": {}, "comm_bytes": 0.0, "notes": []}
+
+
+def _implied_spmd_comm(g: TracedGraph, acc: dict) -> None:
+    """Price the gradient exchange XLA's SPMD partitioner inserts at
+    compile time (invisible in the jaxpr): for a train graph on a mesh
+    with a real ``dp`` axis, every ``dp``-replicated parameter's gradient
+    is all-reduced over ``dp`` — or, when its optimizer states are
+    ``dp``-partitioned (ZeRO-1), reduce-scattered into the sharded update
+    with the new weight all-gathered back. Both move the same
+    2(N-1)/N·B bytes; only the verb split differs. Deterministic: a pure
+    function of the in-resource specs and the mesh axis sizes."""
+    dp = (g.mesh_axes or {}).get("dp", 1)
+    if g.kind != "train" or dp <= 1 or not g.in_specs:
+        return
+    zero1 = any(r == "state" and "dp" in _spec_axes(s)
+                for r, s in zip(g.roles, g.in_specs))
+    jaxpr = g.closed.jaxpr
+    priced = 0
+    for v, role, spec in zip(jaxpr.invars, g.roles, g.in_specs):
+        if role != "param" or "dp" in _spec_axes(spec):
+            continue                  # dp-sharded params exchange no grad
+        b = _nbytes(v.aval)
+        if not b:
+            continue
+        priced += 1
+        if zero1:
+            _comm_into("reduce_scatter", b, dp, 1.0, acc)
+            _comm_into("all_gather", b, dp, 1.0, acc)
+        else:
+            _comm_into("all_reduce", b, dp, 1.0, acc)
+    if priced:
+        acc["notes"].append(
+            f"implied SPMD gradient exchange priced for {priced} "
+            f"parameter(s) over dp={dp}"
+            + (" (zero1: reduce-scatter + all-gather)" if zero1 else
+               " (all-reduce)"))
 
 
 def graph_cost(g: TracedGraph) -> GraphCost:
@@ -314,7 +435,8 @@ def graph_cost(g: TracedGraph) -> GraphCost:
     ``bench.py --proxy``) shares, so they can never disagree."""
     jaxpr = g.closed.jaxpr
     acc = _fresh_acc()
-    _walk_jaxpr(jaxpr, 1.0, acc)
+    _walk_jaxpr(jaxpr, 1.0, acc, g.mesh_axes)
+    _implied_spmd_comm(g, acc)
     param_bytes = input_bytes = 0
     for v, role in zip(jaxpr.invars, g.roles):
         if role in ("param", "state"):
@@ -333,7 +455,11 @@ def graph_cost(g: TracedGraph) -> GraphCost:
         eqns=acc["eqns"], fusible_eqns=acc["fusible_eqns"],
         fusion_groups=acc["fusion_groups"],
         fusion_candidates=acc["fusion_candidates"],
-        unknown_eqns=acc["unknown_eqns"], notes=acc["notes"])
+        unknown_eqns=acc["unknown_eqns"],
+        collective_ops={k: int(round(v))
+                        for k, v in sorted(acc["collectives"].items())},
+        comm_bytes=float(acc["comm_bytes"]),
+        notes=acc["notes"])
 
 
 def cost_table(graphs: List[TracedGraph]) -> List[GraphCost]:
@@ -362,18 +488,30 @@ class CostReport:
     def bytes_per_step(self) -> int:
         return int(self.head.bytes_per_step) if self.rows else 0
 
+    def comm_bytes_per_step(self) -> int:
+        """Per-device collective communication bytes of the costliest
+        graph (explicit collective prims + implied SPMD gradient
+        exchange) — 0 for a single-device graph."""
+        return int(self.head.comm_bytes) if self.rows else 0
+
+    def collective_ops_per_step(self) -> int:
+        return (sum(self.head.collective_ops.values())
+                if self.rows else 0)
+
     def to_dict(self) -> dict:
         return {"rows": [r.to_dict() for r in self.rows],
                 "model_flops_per_step": self.model_flops_per_step(),
                 "bytes_per_step": self.bytes_per_step(),
+                "comm_bytes_per_step": self.comm_bytes_per_step(),
+                "collective_ops_per_step": self.collective_ops_per_step(),
                 "skipped": list(self.skipped)}
 
     def text_table(self) -> str:
         """Aligned human table (``mxlint --hlo <t> --cost``)."""
         hdr = (f"{'graph':<40} {'kind':<6} {'MFLOP':>10} {'mm%':>5} "
                f"{'trans':>8} {'par KiB':>9} {'act KiB':>9} "
-               f"{'io KiB':>9} {'eqns':>5} {'fus':>4} {'grp':>4} "
-               f"{'cand':>4}")
+               f"{'io KiB':>9} {'comm KiB':>9} {'coll':>4} {'eqns':>5} "
+               f"{'fus':>4} {'grp':>4} {'cand':>4}")
         lines = [hdr, "-" * len(hdr)]
         for r in self.rows:
             mm = 100.0 * r.matmul_flops / r.flops if r.flops else 0.0
@@ -382,12 +520,15 @@ class CostReport:
                 f"{r.label:<40} {r.kind:<6} {r.flops / 1e6:>10.3f} "
                 f"{mm:>5.1f} {r.transcendentals:>8} "
                 f"{r.param_bytes >> 10:>9} {r.activation_bytes >> 10:>9} "
-                f"{io_kib:>9} {r.eqns:>5} {r.fusible_eqns:>4} "
+                f"{io_kib:>9} {int(r.comm_bytes) >> 10:>9} "
+                f"{sum(r.collective_ops.values()):>4} "
+                f"{r.eqns:>5} {r.fusible_eqns:>4} "
                 f"{r.fusion_groups:>4} {r.fusion_candidates:>4}")
         if self.rows:
             lines.append(
                 f"model_flops_per_step={self.model_flops_per_step():.6g} "
-                f"bytes_per_step={self.bytes_per_step()}")
+                f"bytes_per_step={self.bytes_per_step()} "
+                f"comm_bytes_per_step={self.comm_bytes_per_step()}")
         for s in self.skipped:
             lines.append(f"note: skipped {s}")
         return "\n".join(lines)
@@ -421,6 +562,10 @@ def _register():
             return
         for g in ctx.graphs:
             c = graph_cost(g)
+            coll = (f", {int(c.comm_bytes) >> 10} KiB comm over "
+                    f"{sum(c.collective_ops.values())} collective(s) "
+                    f"({', '.join(f'{k}x{v}' for k, v in sorted(c.collective_ops.items()))})"
+                    if c.collective_ops else "")
             ctx.diag(
                 "MX707",
                 f"cost: {c.flops:.6g} FLOPs ({c.matmul_flops:.6g} matmul), "
@@ -430,7 +575,7 @@ def _register():
                 f"{c.input_bytes + c.output_bytes >> 10} KiB in+out, "
                 f"{c.eqns} eqns, {c.fusible_eqns} fusible in "
                 f"{c.fusion_groups} group(s) "
-                f"({c.fusion_candidates} multi-op)", g, severity="info")
+                f"({c.fusion_candidates} multi-op){coll}", g, severity="info")
 
 
 _register()

@@ -433,3 +433,104 @@ def hlo_signature(ctx: HloPassContext) -> None:
                      "(serve.CompiledModel) or pad to a shared signature",
                      node=f"{entry}[{len(sigs)} sites]",
                      severity="warning")
+
+
+# ---------------------------------------------------------------------------
+# MX708 — mesh-configured trainer step: no per-parameter host work, full
+#         donation (the compiled-collective contract of the pjit step)
+# ---------------------------------------------------------------------------
+
+@register_hlo_pass("hlo_mesh_step",
+                   describe="mesh-configured trainer step contains a "
+                            "per-parameter host round-trip or a "
+                            "non-donated >=64KiB gradient/state buffer, "
+                            "MX708")
+def hlo_mesh_step(ctx: HloPassContext) -> None:
+    """The hard contract behind ``ShardedTrainer``'s default pjit path:
+    a training step traced on a real mesh (any axis > 1) must run as ONE
+    compiled call — gradient exchange inside the graph as XLA collectives,
+    parameter/optimizer buffers donated. A host callback or a live-data
+    ``device_put`` in the step graph is the per-parameter push/pull loop
+    sneaking back in (errors); so is a >=64KiB parameter/state input the
+    step replaces-but-does-not-donate (two resident copies of the model,
+    errors). The per-parameter loop is legal ONLY behind the named
+    ``MXTPU_KVSTORE_FALLBACK=1`` opt-in — which never traces as a single
+    step graph, so this pass cannot fire on it."""
+    min_bytes = int(ctx.opt("donation_min_bytes", 1 << 16))
+    for g in ctx.graphs:
+        if g.kind != "train":
+            continue
+        axes = g.mesh_axes or {}
+        if not axes or max(axes.values(), default=1) <= 1:
+            continue                  # single-device "mesh": no contract
+        mesh_s = ",".join(f"{k}={v}" for k, v in sorted(axes.items())
+                          if v > 1)
+        # forward reach from the ARGUMENT invars only (constvars are
+        # trace-time constants XLA materializes once — same liveness rule
+        # MX701 applies): a device_put is a per-step transfer only when
+        # it moves argument-derived data
+        hosty = []
+
+        def scan(jaxpr, live):
+            reach = set(live)
+            for eqn in jaxpr.eqns:
+                live_in = any(not _is_literal(v) and v in reach
+                              for v in eqn.invars)
+                if live_in:
+                    reach.update(eqn.outvars)
+                name = eqn.primitive.name
+                if name in _CALLBACK_PRIMS or name.endswith("_callback"):
+                    hosty.append(name)
+                elif name in _TRANSFER_PRIMS and live_in:
+                    hosty.append(name)
+                for v in eqn.params.values():
+                    for sub in _jaxprs_in(v):
+                        scan(sub, set(sub.invars) if live_in else set())
+
+        scan(g.closed.jaxpr, set(g.closed.jaxpr.invars))
+        if hosty:
+            uniq = sorted(set(hosty))
+            ctx.diag("MX708",
+                     f"mesh step ({mesh_s}) contains {len(hosty)} host "
+                     f"round-trip op(s) ({', '.join(uniq[:4])}): every "
+                     "executed step pays a device→host→device transfer "
+                     "inside the compiled graph — gradient exchange must "
+                     "lower to XLA collectives (the pjit step), with the "
+                     "per-parameter loop only behind "
+                     "MXTPU_KVSTORE_FALLBACK=1", g,
+                     op=uniq[0], severity="error")
+        if g.donated is None:
+            continue
+        jaxpr = g.closed.jaxpr
+        out_sigs = set()
+        for o in jaxpr.outvars:
+            aval = getattr(o, "aval", None)
+            d = _np_dtype(aval.dtype) if hasattr(aval, "dtype") else None
+            if d is not None and hasattr(aval, "shape"):
+                out_sigs.add((tuple(aval.shape), d.name))
+        hits = []
+        for i, (v, name, role) in enumerate(
+                zip(jaxpr.invars, g.arg_names, g.roles)):
+            if role not in ("param", "state") \
+                    or (i < len(g.donated) and g.donated[i]):
+                continue
+            aval = v.aval
+            d = _np_dtype(aval.dtype) if hasattr(aval, "dtype") else None
+            if d is None or not hasattr(aval, "shape"):
+                continue
+            nbytes = int(onp.prod(aval.shape, dtype=onp.int64)
+                         * d.itemsize) if len(aval.shape) else d.itemsize
+            if nbytes >= min_bytes and (tuple(aval.shape), d.name) in out_sigs:
+                hits.append((name, nbytes))
+        if hits:
+            total = sum(n for _, n in hits)
+            names = ", ".join(n for n, _ in hits[:3])
+            more = f" (+{len(hits) - 3} more)" if len(hits) > 3 else ""
+            ctx.diag("MX708",
+                     f"mesh step ({mesh_s}) holds {len(hits)} non-donated "
+                     f">=64KiB parameter/optimizer buffer(s) totalling "
+                     f"{total >> 10} KiB ({names}{more}) that same-aval "
+                     "outputs replace: the step keeps two copies of the "
+                     "sharded state resident — build the trainer with "
+                     "donation enabled (donate=True, the default)", g,
+                     op=names, severity="error")

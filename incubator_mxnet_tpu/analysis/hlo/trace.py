@@ -27,7 +27,7 @@ of a fresh hybridized block runs eagerly, outside the jit cache).
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Any, Callable, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as onp
 
@@ -65,6 +65,13 @@ class TracedGraph:
     donated: Optional[Tuple[bool, ...]] = None
     signature: tuple = ()
     expected: Optional[bool] = None
+    #: named mesh axis sizes the graph was traced under (``None`` = no
+    #: mesh context) — lets the cost model price collectives
+    mesh_axes: Optional[Dict[str, int]] = None
+    #: per-invar PartitionSpec (``None`` entries = unknown/replicated),
+    #: aligned with ``closed.jaxpr.invars`` — the SPMD resource contract
+    #: the cost model derives implied gradient-exchange collectives from
+    in_specs: Optional[List] = None
     _lower: Optional[Callable[[], str]] = None
 
     def hlo_text(self) -> str:
@@ -372,20 +379,29 @@ def _trace_trainer(trainer, sample_args) -> TraceResult:
     args = trainer.step_trace_args(*sites[0])
     param_vals, opt_states, key, lr, t = args[:5]
     batch_vals = args[5:]
-    names, roles = [], []
+    names, roles, specs = [], [], []
     pnames = [p.name for p in trainer._params]
+    param_shardings = list(trainer._param_shardings or [])
+    state_shardings = [sh for tup in (trainer._state_shardings or [])
+                       for sh in tup]
     for i, _ in enumerate(jax.tree_util.tree_leaves(tuple(param_vals))):
         names.append(pnames[i] if i < len(pnames) else f"param:{i}")
         roles.append("param")
+        specs.append(param_shardings[i].spec
+                     if i < len(param_shardings) else None)
     for i, _ in enumerate(jax.tree_util.tree_leaves(tuple(opt_states))):
         names.append(f"opt:{i}")
         roles.append("state")
+        specs.append(state_shardings[i].spec
+                     if i < len(state_shardings) else None)
     for n, r in [("rng_key", "rng_key"), ("lr", "other"), ("t", "other")]:
         names.append(n)
         roles.append(r)
-    for i, _ in enumerate(batch_vals):
+        specs.append(None)
+    for i, v in enumerate(batch_vals):
         names.append(f"input:{i}")
         roles.append("input")
+        specs.append(getattr(getattr(v, "sharding", None), "spec", None))
     with active_mesh(trainer._mesh):
         closed = jax.make_jaxpr(trainer._step_fn)(*args)
     closed, donated = _unwrap_pjit(closed)
@@ -393,11 +409,14 @@ def _trace_trainer(trainer, sample_args) -> TraceResult:
         # flattening mismatch (exotic optimizer state): degrade gracefully
         names = [f"arg:{i}" for i in range(len(closed.jaxpr.invars))]
         roles = ["other"] * len(names)
+        specs = None
     res.graphs.append(TracedGraph(
         entry=type(trainer._block).__name__ + ".step", site="step",
         closed=closed, arg_names=names, roles=roles, kind="train",
         donated=donated,
         signature=tuple(_aval_of(v) for v in batch_vals),
+        mesh_axes=dict(trainer._mesh.shape),
+        in_specs=specs,
         _lower=(lambda fn=trainer._step_fn, av=args, m=trainer._mesh:
                 _lower_in_mesh(fn, av, m))))
     return res
@@ -412,12 +431,18 @@ def _lower_in_mesh(fn, args, mesh):
 def _trace_callable(fn, sample_args, entry=None) -> TraceResult:
     import jax
 
+    from ...parallel.mesh import current_active_mesh
+
     res = TraceResult()
     sites = _sites_of(sample_args)
     if not sites:
         raise MXNetError("analysis.hlo over a plain callable needs "
                          "sample_args")
     name = entry or getattr(fn, "__name__", type(fn).__name__)
+    # tracing inside `with active_mesh(mesh):` gives the cost model the
+    # axis sizes it needs to price explicit (shard_map) collectives
+    mesh = current_active_mesh()
+    mesh_axes = dict(mesh.shape) if mesh is not None else None
     for i, site in enumerate(sites):
         avals = [_sds(*_aval_of(a)) for a in site]
         closed = jax.make_jaxpr(fn)(*avals)
@@ -428,6 +453,7 @@ def _trace_callable(fn, sample_args, entry=None) -> TraceResult:
             arg_names=[f"input:{j}" for j in range(n)],
             roles=["input"] * n, donated=donated,
             signature=tuple(_aval_of(a) for a in site),
+            mesh_axes=mesh_axes,
             # lazy lowering hook, invoked at most once per graph
             _lower=(lambda f=fn, av=tuple(avals):
                     jax.jit(f).lower(*av).as_text())))  # mxlint: disable=MX501

@@ -123,16 +123,37 @@ class Trainer:
 
     def allreduce_grads(self):
         """Sum gradients across parameter replicas (kvstore push/pull —
-        reference stack §3.4; local CommDevice reduce when single-process)."""
+        reference stack §3.4; local CommDevice reduce when single-process).
+
+        With a kvstore attached, ALL eligible keys go through ONE batched
+        ``push``/``pull`` pair — the store runs a single compiled
+        collective for the whole key batch (grouped ncclAllReduce parity)
+        instead of a per-parameter Python loop of host round trips. The
+        per-key loop survives only for the async parameter server (whose
+        client applies retry/exactly-once semantics per key) and under
+        the explicit ``MXTPU_KVSTORE_FALLBACK=1`` opt-in."""
         if not self._kv_initialized:
             self._init_kvstore()
-        for i, param in enumerate(self._params):
+        if self._kvstore is not None:
+            items = [(i, p.list_grad()) for i, p in enumerate(self._params)
+                     if p.grad_req != "null" and p._data is not None]
+            if not items:
+                return
+            from ..kvstore import kv_fallback_active
+            from ..kvstore.async_ps import AsyncKVStore
+            if kv_fallback_active() or isinstance(self._kvstore,
+                                                  AsyncKVStore):
+                for i, grads in items:
+                    self._kvstore.push(i, grads)
+                    self._kvstore.pull(i, grads)
+            else:
+                keys = [i for i, _ in items]
+                grads = [g for _, g in items]
+                self._kvstore.push(keys, grads)
+                self._kvstore.pull(keys, out=grads)
+            return
+        for param in self._params:
             if param.grad_req == "null" or param._data is None:
-                continue
-            if self._kvstore is not None:
-                grads = param.list_grad()
-                self._kvstore.push(i, grads)
-                self._kvstore.pull(i, grads)
                 continue
             grads = param.list_grad()
             if len(grads) > 1:

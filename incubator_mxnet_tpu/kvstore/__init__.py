@@ -40,9 +40,20 @@ from ..base import MXNetError
 from ..ndarray import NDArray
 from ..context import current_context
 
-__all__ = ["KVStore", "KVStoreBase", "create"]
+__all__ = ["KVStore", "KVStoreBase", "create", "kv_fallback_active"]
 
 P = PartitionSpec
+
+
+def kv_fallback_active() -> bool:
+    """True when ``MXTPU_KVSTORE_FALLBACK=1`` opts into the per-parameter
+    Python push/pull loop (the async-PS scenario, retry/exactly-once
+    semantics per key). Default off: gradient exchange runs as ONE
+    compiled collective per key batch — inside the pjit step for
+    ``parallel.ShardedTrainer``, via the batched store push/pull for
+    ``gluon.Trainer``."""
+    from ..util import getenv
+    return getenv("MXTPU_KVSTORE_FALLBACK", "0") == "1"
 
 
 # ---------------------------------------------------------------------------

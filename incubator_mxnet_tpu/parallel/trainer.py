@@ -4,12 +4,24 @@ Reference counterpart: the whole inner loop of SURVEY §3.2 fused into one XLA
 executable. What the reference runs as four separate engine phases —
 ``CachedOp::Forward``, ``Imperative::Backward``, kvstore push/pull
 (``KVStoreNCCL`` all-reduce), and per-parameter optimizer ops
-(``src/operator/optimizer_op.cc``) — is here a single jit-compiled pure
-function ``(params, opt_state, batch) -> (loss, params', opt_state')`` whose
-gradient collectives are inserted by XLA's SPMD partitioner from the sharding
-annotations: batch over ``dp`` ⇒ grad psum over ``dp`` rides ICI exactly
-where ncclAllReduce sat. Parameter donation gives the in-place-update memory
-behavior of ``FMutateInputs``.
+(``src/operator/optimizer_op.cc``) — is here a single pjit-compiled pure
+function ``(params, opt_state, batch) -> (loss, params', opt_state')`` with
+*explicit* ``PartitionSpec`` in/out resources: every parameter, optimizer
+shard and batch argument carries its :class:`~jax.sharding.NamedSharding`
+into ``jax.jit`` (the pjit formulation), so gradient exchange lowers to XLA
+all-reduce over the mesh axes and — under ZeRO-1 — the optimizer update
+executes cross-replica sharded (reduce-scatter into the ``dp``-partitioned
+update, all-gather of the new weights; Xu et al. 2020, arXiv 2004.13336).
+Parameter donation gives the in-place-update memory behavior of
+``FMutateInputs``.
+
+This compiled step is THE default execution path whenever a mesh is
+configured. The reference's per-parameter kvstore push/pull loop survives
+only as a *named fallback* for the async parameter-server scenario: setting
+``MXTPU_KVSTORE_FALLBACK=1`` routes :meth:`ShardedTrainer.step` through a
+host-side per-parameter exchange over a kvstore backend (``dist_async``
+keeps its reconnect/exactly-once-resend semantics untouched) — every other
+configuration runs ONE compiled call with zero per-parameter host work.
 
 Usage::
 
@@ -63,8 +75,8 @@ class ShardedTrainer:
                  mesh: Optional[Mesh] = None,
                  rules: Optional[ShardingRules] = None,
                  n_labels: int = 1, seq_axis: Optional[int] = None,
-                 donate: bool = True, zero1: bool = False,
-                 guard=None, watchdog=None):
+                 donate: bool = True, zero1: Optional[bool] = None,
+                 kvstore=None, guard=None, watchdog=None):
         self._block = block
         self._loss_fn = loss_fn
         self._optimizer = opt_mod.create(
@@ -79,8 +91,20 @@ class ShardedTrainer:
         #: additionally partition over the ``dp`` axis, so XLA
         #: reduce-scatters gradients into the sharded update and
         #: all-gathers the new weights — per-chip optimizer memory drops by
-        #: the dp degree while the numerics are unchanged.
-        self._zero1 = zero1
+        #: the dp degree while the numerics are unchanged. Default (None):
+        #: on whenever the mesh has a real ``dp`` axis — the compiled
+        #: cross-replica-sharded weight update IS the default path.
+        self._zero1 = (self._mesh.shape.get("dp", 1) > 1
+                       if zero1 is None else bool(zero1))
+        #: named fallback backend for the async-PS scenario: the
+        #: per-parameter host push/pull loop, active only under
+        #: MXTPU_KVSTORE_FALLBACK=1 (``kvstore`` names/carries the store —
+        #: 'dist_async' keeps its retry/exactly-once client semantics).
+        self._kvstore_req = kvstore
+        self._kv = None              # resolved lazily on first fallback step
+        self._grad_fn = None         # compiled fwd+bwd (fallback path)
+        self._step_ndims = None      # batch ranks the built step was pinned to
+        self.last_path: Optional[str] = None
         self._params = None          # sorted List[Parameter]
         self._param_vals = None      # tuple of sharded jax arrays
         self._opt_states = None      # tuple of per-param state tuples
@@ -202,11 +226,11 @@ class ShardedTrainer:
         return spec                     # nothing divisible: stay replicated
 
     # ------------------------------------------------------------------
-    def _build_step(self, n_data: int) -> Callable:
-        blk, params, opt = self._block, self._params, self._optimizer
-        loss_fn, ctx, info = self._loss_fn, self._ctx, self._info
-        param_shardings = self._param_shardings
-        state_shardings = self._state_shardings
+    def _per_param_hparams(self):
+        """(lr_mults, wds, mp) — the per-parameter hyperparameter vectors
+        shared by the compiled pjit step and the kvstore-fallback update,
+        so the two paths can never apply different schedules."""
+        opt, params = self._optimizer, self._params
         lr_mults = [opt._get_lr(i) / max(opt.learning_rate, 1e-30)
                     for i in range(len(params))]
         wds = [opt._get_wd(i) for i in range(len(params))]
@@ -218,8 +242,39 @@ class ShardedTrainer:
                    and self._opt_states[i][0].dtype == jnp.float32
                    and self._opt_states[i][0].shape == self._param_vals[i].shape)
               for i in range(len(params))]
+        return lr_mults, wds, mp
 
-        def step(param_vals, opt_states, key, lr, t, *batch_vals):
+    def step_shardings(self, batch_ndims: Sequence[int]):
+        """The explicit pjit resource contract of the compiled step:
+        ``(in_shardings, out_shardings)`` NamedSharding pytrees matching
+        ``step(param_vals, opt_states, key, lr, t, *batch)`` →
+        ``(loss, gnorm, new_vals, new_states, effects, t+1)``. Scalars and
+        the RNG key replicate; parameters/optimizer shards carry their
+        rule (+ zero1 ``dp``) layouts in AND out, so the optimizer update
+        is compiled cross-replica sharded and the next call sees identical
+        placements (no silent re-trace); batch arguments take the
+        batch-over-``dp`` / seq-over-``sp`` data sharding."""
+        repl = NamedSharding(self._mesh, P())
+        batch_sh = tuple(
+            data_sharding(self._mesh, batch_axis=0, seq_axis=self._seq_axis,
+                          ndim=nd) for nd in batch_ndims)
+        params_sh = tuple(self._param_shardings)
+        states_sh = tuple(tuple(s) for s in self._state_shardings)
+        in_shardings = (params_sh, states_sh, repl, repl, repl) + batch_sh
+        # effects (aux state: batchnorm running stats) replicate — a repl
+        # prefix broadcasts over that subtree whatever its arity
+        out_shardings = (repl, repl, params_sh, states_sh, repl, repl)
+        return in_shardings, out_shardings
+
+    def _make_loss_grads(self, n_data: int) -> Callable:
+        """``(param_vals, key, t, *batch) -> (loss, gnorm, grads, effects)``
+        — the fwd+bwd half of the step, shared verbatim by the compiled
+        pjit step and the kvstore-fallback path so their gradients are the
+        same function of the same inputs."""
+        blk, params = self._block, self._params
+        loss_fn, ctx, info = self._loss_fn, self._ctx, self._info
+
+        def loss_grads(param_vals, key, t, *batch_vals):
             # Per-step randomness is derived ON DEVICE from one resident base
             # key — the host passes the same array every step, so there is no
             # eager key-split or host→device key transfer in the loop (those
@@ -251,6 +306,20 @@ class ShardedTrainer:
             # trainer.last_grad_norm.
             gnorm = jnp.sqrt(sum(
                 jnp.sum(jnp.square(g.astype(jnp.float32))) for g in grads))
+            return loss, gnorm, grads, effects
+
+        return loss_grads
+
+    def _build_step(self, n_data: int, batch_ndims: Sequence[int]) -> Callable:
+        opt = self._optimizer
+        param_shardings = self._param_shardings
+        state_shardings = self._state_shardings
+        lr_mults, wds, mp = self._per_param_hparams()
+        loss_grads = self._make_loss_grads(n_data)
+
+        def step(param_vals, opt_states, key, lr, t, *batch_vals):
+            loss, gnorm, grads, effects = loss_grads(
+                param_vals, key, t, *batch_vals)
             constrain = jax.lax.with_sharding_constraint
             new_vals, new_states = [], []
             for i, (w, g, s) in enumerate(zip(param_vals, grads, opt_states)):
@@ -277,8 +346,86 @@ class ShardedTrainer:
             return (loss, gnorm, tuple(new_vals), tuple(new_states),
                     effects, t + 1)
 
+        # The explicit pjit contract: named in/out resources + donation.
+        # With out_shardings pinned, XLA's SPMD partitioner OWNS the
+        # gradient exchange (all-reduce over dp — reduce-scatter +
+        # all-gather under zero1) and the donated param/state buffers are
+        # updated in place: zero per-parameter host work on the hot path.
+        in_shardings, out_shardings = self.step_shardings(batch_ndims)
         donate = (0, 1, 4) if self._donate else ()
-        return jax.jit(step, donate_argnums=donate)
+        return jax.jit(step, in_shardings=in_shardings,
+                       out_shardings=out_shardings, donate_argnums=donate)
+
+    # ------------------------------------------------------------------
+    # named fallback: the per-parameter kvstore push/pull loop (async-PS)
+    # ------------------------------------------------------------------
+    @staticmethod
+    def kv_fallback_active() -> bool:
+        """True when MXTPU_KVSTORE_FALLBACK=1 routes the step through the
+        host-side per-parameter kvstore exchange (the async parameter-
+        server scenario). Explicit opt-in: every other configuration runs
+        the compiled pjit step. Read straight off the environment — this
+        sits on the hot step path, where an import + catalog lookup per
+        step is measurable dispatch tax (profiler-gated at >=95%
+        instrumented); the catalog entry lives in util.ENV_VARS."""
+        return os.environ.get("MXTPU_KVSTORE_FALLBACK", "0") == "1"
+
+    def _resolve_kvstore(self):
+        if self._kv is None:
+            if self._kvstore_req is None or isinstance(self._kvstore_req, str):
+                from .. import kvstore as kv_mod
+                self._kv = kv_mod.create(self._kvstore_req or "device")
+            else:
+                self._kv = self._kvstore_req    # explicit store object
+            for i, v in enumerate(self._param_vals):
+                self._kv.init(i, NDArray(jax.device_get(v)))
+        return self._kv
+
+    def _kv_step(self, vals, n_data: int):
+        """One fallback step: compiled fwd+bwd, then a PER-PARAMETER
+        Python push/pull loop through the kvstore (host round trip per
+        key — exactly the dispatch tax the pjit path removes), then the
+        eager optimizer update. The kvstore client's semantics ride along
+        untouched: a ``dist_async`` store keeps its reconnect, bounded
+        retry and versioned exactly-once resend behavior per key."""
+        if self._grad_fn is None:
+            self._grad_fn = jax.jit(self._make_loss_grads(n_data))
+        kv = self._resolve_kvstore()
+        loss, gnorm, grads, effects = self._grad_fn(
+            self._param_vals, self._base_key, self._t_dev, *vals)
+        lr_mults, wds, mp = self._per_param_hparams()
+        opt = self._optimizer
+        # the whole update runs host-side: every operand comes off the
+        # mesh (the per-parameter device→host sync IS this path's cost)
+        t = jnp.asarray(jax.device_get(self._t_dev))
+        lr = jnp.asarray(jax.device_get(self._lr_dev))
+        new_vals, new_states = [], []
+        for i, (wm, g, sm) in enumerate(zip(self._param_vals, grads,
+                                            self._opt_states)):
+            # the reference Trainer.step shape: push grad i, pull the
+            # merged value back — one host round trip per parameter
+            merged = kv.pushpull(i, NDArray(jax.device_get(g)))
+            gm = jnp.asarray(merged._data)
+            w = jnp.asarray(jax.device_get(wm))
+            s = tuple(jnp.asarray(jax.device_get(a)) for a in sm)
+            if mp[i]:
+                nm, ns = opt.step(s[0], gm.astype(jnp.float32), tuple(s[1:]),
+                                  lr * lr_mults[i], wds[i], t)
+                nv = nm.astype(w.dtype)
+                nst = (nm,) + tuple(ns)
+            else:
+                nw, ns = opt.step(w, gm.astype(w.dtype), s,
+                                  lr * lr_mults[i], wds[i], t)
+                nv = nw.astype(w.dtype)
+                nst = tuple(ns)
+            new_vals.append(jax.device_put(nv, self._param_shardings[i]))
+            new_states.append(tuple(
+                jax.device_put(a, sh)
+                for a, sh in zip(nst, self._state_shardings[i])))
+        self._param_vals = tuple(new_vals)
+        self._opt_states = tuple(new_states)
+        self._t_dev = self._t_dev + 1
+        return loss, gnorm, effects
 
     # ------------------------------------------------------------------
     def step_trace_args(self, *batch):
@@ -342,8 +489,20 @@ class ShardedTrainer:
         t_place0 = time.perf_counter()
         vals = self.place(*batch)
         place_ms = (time.perf_counter() - t_place0) * 1e3
-        if self._step_fn is None:
-            self._step_fn = self._build_step(n_data)
+        # Dispatch: a configured mesh runs the ONE compiled pjit step
+        # (explicit in/out PartitionSpecs, donated buffers) — the default
+        # path. The per-parameter kvstore loop survives only behind the
+        # MXTPU_KVSTORE_FALLBACK=1 opt-in (async-PS scenario).
+        fallback = self.kv_fallback_active()
+        if not fallback:
+            # the jit entry's batch in_shardings are rank-pinned; a batch
+            # of NEW ranks rebuilds the entry (a fresh compile, noted in
+            # the ledger via its new signature — the same cost the
+            # re-trace paid before shardings were explicit)
+            ndims = tuple(v.ndim for v in vals)
+            if self._step_fn is None or ndims != self._step_ndims:
+                self._step_fn = self._build_step(n_data, ndims)
+                self._step_ndims = ndims
         if self._guard is not None:
             self._maybe_snapshot()
         self._t += 1
@@ -371,11 +530,15 @@ class ShardedTrainer:
                     # bound during (first-call) tracing so mesh-aware ops
                     # lower to mesh collectives — e.g. attention → ring
                     # over sp
-                    (loss, gnorm, self._param_vals, self._opt_states,
-                     effects, self._t_dev) = \
-                        self._step_fn(self._param_vals, self._opt_states,
-                                      self._base_key, self._lr_dev,
-                                      self._t_dev, *vals)
+                    if fallback:
+                        loss, gnorm, effects = self._kv_step(vals, n_data)
+                    else:
+                        (loss, gnorm, self._param_vals, self._opt_states,
+                         effects, self._t_dev) = \
+                            self._step_fn(self._param_vals, self._opt_states,
+                                          self._base_key, self._lr_dev,
+                                          self._t_dev, *vals)
+                self.last_path = "kvstore_fallback" if fallback else "pjit"
                 dispatch_ms = (time.perf_counter() - t_disp0) * 1e3
                 if new_sig:
                     self._step_sigs.add(sig)
@@ -388,7 +551,8 @@ class ShardedTrainer:
             wall_ms = (time.perf_counter() - t_step0) * 1e3
             fields = {"wall_ms": round(wall_ms, 3),
                       "place_ms": round(place_ms, 3),
-                      "dispatch_ms": round(dispatch_ms, 3)}
+                      "dispatch_ms": round(dispatch_ms, 3),
+                      "path": self.last_path}
             if self._guard is not None:
                 # guard runs synced loss/grad-norm to host — free to report
                 fields.update(loss=self.last_loss,

@@ -36,6 +36,15 @@ ENV_VARS: Dict[str, tuple] = {
     "MXNET_GPU_MEM_POOL_RESERVE": ("0", "PjRt manages HBM pooling."),
     "MXNET_KVSTORE_BIGARRAY_BOUND": ("1000000", "Kept for parity; sharding "
                                      "rules make the layout decision."),
+    "MXTPU_KVSTORE_FALLBACK": ("0", "1 opts into the per-parameter Python "
+                               "kvstore push/pull loop (the async-PS "
+                               "scenario): ShardedTrainer.step exchanges "
+                               "gradients host-side per key with the "
+                               "store client's retry/exactly-once "
+                               "semantics intact. Default 0: gradient "
+                               "exchange is compiled XLA collectives — "
+                               "the pjit step (ShardedTrainer) or one "
+                               "batched store collective (gluon.Trainer)."),
     "MXNET_TEST_SEED": ("", "Fix the test RNG seed."),
     "MXTPU_SERVE_DEADLINE_MS": ("5", "Max milliseconds the oldest queued "
                                 "request waits before the serve "

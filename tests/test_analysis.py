@@ -592,7 +592,7 @@ class TestHloPasses:
         names = hlo.list_hlo_passes()
         assert names == ["hlo_transfer", "hlo_promotion", "hlo_dead_code",
                          "hlo_donation", "hlo_constants", "hlo_signature",
-                         "hlo_cost"]
+                         "hlo_mesh_step", "hlo_cost"]
         with pytest.raises(MXNetError, match="unknown hlo pass"):
             hlo.run_hlo_passes([], names=["nope"])
 
