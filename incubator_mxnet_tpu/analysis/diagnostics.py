@@ -71,6 +71,9 @@ CODES = {
     "MX601": "training loop / serving entry point builds ad-hoc timing or "
              "counters instead of mx.telemetry (invisible to the unified "
              "event bus, metrics scrape, and snapshot)",
+    "MX602": "request-path code emits bus events outside any request/step "
+             "correlation scope (uncorrelated telemetry — the event can "
+             "never be stitched into a request or step story)",
     "MX701": "host<->device transfer inside a jitted region (callback / "
              "device_put round-trip per executed step)",
     "MX702": "unintended f64/widening float promotion in the compiled "
@@ -124,7 +127,7 @@ DEFAULT_SEVERITY: Dict[str, str] = {
     "MX301": "error", "MX302": "error", "MX303": "error",
     "MX401": "warning",
     "MX501": "warning", "MX502": "warning",
-    "MX601": "warning",
+    "MX601": "warning", "MX602": "warning",
     "MX701": "error", "MX702": "warning", "MX703": "warning",
     "MX704": "warning", "MX705": "error", "MX706": "warning",
     "MX707": "info", "MX708": "error",

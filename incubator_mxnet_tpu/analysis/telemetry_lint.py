@@ -17,12 +17,25 @@ severity (hygiene, not correctness; ``mxlint --strict`` gates):
   evidence at all. Route the measurement through ``mx.telemetry``
   (``emit`` / ``Histogram`` / ``step_scope``) or ``mx.profiler`` spans
   instead — then it lands in every sink for free.
+- **MX602** — an ``emit(...)`` bus call inside a *request-path* function
+  (``submit``/``call``/``call_detailed``/``predict``/``_flush``/
+  ``handle*``/...) with no correlation whatsoever: the call neither
+  passes ``request_id=``/``step=`` nor sits lexically inside a
+  correlation ``with`` block (``request_scope``/``step_scope``/
+  ``trace.span``/``trace.use``). Such an event lands on the timeline as
+  a free-floating fact that can never be stitched into any request or
+  step story — the uncorrelated telemetry this PR's tracing layer
+  exists to eliminate.
 
-Heuristics are tuned for zero noise elsewhere: any use of ``telemetry``,
-``profiler`` scopes, ``emit``, a metrics instrument, or ``ServeMetrics``
-anywhere in the file counts as evidence and silences the pass — code
-already on the spine (including the serve/bench internals that IMPLEMENT
-the spine) lints clean.
+Heuristics are tuned for zero noise elsewhere: for MX601, any use of
+``telemetry``, ``profiler`` scopes, ``emit``, a metrics instrument, or
+``ServeMetrics`` anywhere in the file counts as evidence and silences
+the pass — code already on the spine (including the serve/bench
+internals that IMPLEMENT the spine) lints clean. MX602 is the opposite
+polarity (``emit`` IS its subject), so it runs regardless of file-level
+evidence; lifecycle emits outside request-path functions (health
+transitions, drain, load outcomes) are legitimately uncorrelated and
+out of its vocabulary by construction.
 """
 from __future__ import annotations
 
@@ -94,6 +107,107 @@ def _entry_functions(tree: ast.Module) -> List[ast.AST]:
             and n.name in _ENTRY_NAMES]
 
 
+# -- MX602: uncorrelated telemetry on the request path -----------------------
+
+#: functions that handle one request/step — the paths where an
+#: uncorrelated event is a stitching failure, not a lifecycle fact
+_REQUEST_PATH_NAMES = {"submit", "call", "call_detailed", "predict",
+                       "infer", "inference", "serve", "_flush",
+                       "_predict", "handle", "handle_request"}
+_REQUEST_PATH_PREFIXES = ("handle_", "_handle")
+
+#: with-context callables that establish correlation for everything
+#: lexically inside them
+_CORRELATION_CTX = {"request_scope", "step_scope", "span", "use",
+                    "watch"}
+
+#: emit kwargs that correlate the single event explicitly
+_CORRELATION_KWARGS = {"request_id", "step"}
+
+
+def _is_request_path(name: str) -> bool:
+    return name in _REQUEST_PATH_NAMES \
+        or name.startswith(_REQUEST_PATH_PREFIXES)
+
+
+def _is_emit_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    leaf = f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else None)
+    return leaf == "emit"
+
+
+def _correlation_withs(func: ast.AST) -> List[ast.With]:
+    out = []
+    for node in ast.walk(func):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        for item in node.items:
+            expr = item.context_expr
+            if not isinstance(expr, ast.Call):
+                continue
+            f = expr.func
+            leaf = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else None)
+            if leaf in _CORRELATION_CTX:
+                out.append(node)
+                break
+    return out
+
+
+def _inside(node: ast.AST, blocks: List[ast.With]) -> bool:
+    """Lexical containment by line span (ast has no parent links; the
+    end_lineno span is exact for our purpose)."""
+    line = getattr(node, "lineno", None)
+    if line is None:
+        return False
+    for blk in blocks:
+        if blk.lineno <= line <= (getattr(blk, "end_lineno", blk.lineno)):
+            return True
+    return False
+
+
+def _lint_uncorrelated(tree: ast.Module, filename: str,
+                       report: Report) -> None:
+    """MX602 over every request-path function in the module."""
+    funcs = [n for n in ast.walk(tree)
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+             and _is_request_path(n.name)]
+    # drop request-path functions nested inside another collected one:
+    # ast.walk(outer) already reaches the inner's emits, so keeping both
+    # would report the same call twice under two op= names
+    spans = [(f.lineno, getattr(f, "end_lineno", f.lineno)) for f in funcs]
+    funcs = [f for i, f in enumerate(funcs)
+             if not any(j != i and lo < f.lineno <= hi
+                        for j, (lo, hi) in enumerate(spans))]
+    for func in funcs:
+        blocks = _correlation_withs(func)
+        for node in ast.walk(func):
+            if not _is_emit_call(node):
+                continue
+            kwargs = {kw.arg for kw in node.keywords if kw.arg}
+            if kwargs & _CORRELATION_KWARGS:
+                continue
+            if _inside(node, blocks):
+                continue
+            kind = ""
+            if node.args and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                kind = f" ({node.args[0].value!r})"
+            report.add(Diagnostic(
+                "MX602",
+                f"bus event{kind} emitted on the request path "
+                f"({func.name}()) outside any correlation scope — pass "
+                "request_id=/step=, or wrap the path in "
+                "telemetry.request_scope()/step_scope()/trace.span() so "
+                "the event stitches into a request or step story",
+                node=f"{filename}:{getattr(node, 'lineno', 0)}",
+                op=func.name, pass_name="telemetry_lint",
+                severity="warning"))
+
+
 def lint_source(src: str, filename: str = "<string>") -> Report:
     """Lint one Python source blob for MX6xx findings."""
     report = Report()
@@ -101,6 +215,9 @@ def lint_source(src: str, filename: str = "<string>") -> Report:
         tree = ast.parse(src, filename=filename)
     except SyntaxError:
         return report  # tracer_lint owns the MX200 parse diagnostic
+    # MX602 runs unconditionally: emit() is its subject, so file-level
+    # telemetry evidence cannot excuse it
+    _lint_uncorrelated(tree, filename, report)
     if _has_telemetry_evidence(tree):
         return report
     seen_clocks: Set[int] = set()  # one finding per scope; a clock call

@@ -129,8 +129,10 @@ class StepGuard:
                f"(policy={self.policy}, consecutive={self._consecutive})"
                + (f" {detail}" if detail else ""))
         if self.policy == "halt":
+            self._flight_dump(info)
             raise NonFiniteError(msg)
         if self._consecutive > self.max_consecutive:
+            self._flight_dump(info, escalated=True)
             raise NonFiniteError(
                 msg + f"; {self._consecutive} consecutive bad steps exceeds "
                 f"max_consecutive={self.max_consecutive}, halting anyway")
@@ -139,6 +141,13 @@ class StepGuard:
             self.skipped += 1
             return "rollback"
         return "keep"
+
+    @staticmethod
+    def _flight_dump(info: dict, escalated: bool = False) -> None:
+        """A halting guard is about to take the process down — the last
+        moment the event rings, trace ring, and ledger still exist."""
+        from ..telemetry import flight as _flight
+        _flight.dump("guard_halt", escalated=escalated, **info)
 
     def good_step(self) -> None:
         self._consecutive = 0

@@ -142,9 +142,20 @@ class ChaosMonkey:
             return self.delay_s
         return 0.0
 
-    def crash(self, site: str) -> None:
-        """Raise :class:`ChaosCrash` if ``site`` is armed (then disarm)."""
+    def crash(self, site: str, dump: bool = True) -> None:
+        """Raise :class:`ChaosCrash` if ``site`` is armed (then disarm).
+        A firing crash site is a flight-recorder trigger: the bundle is
+        written *before* the raise, exactly what a real SIGKILL handler
+        cannot do — except at the recorder's own ``flight.dump`` site,
+        which simulates dying mid-dump and must not recurse. A caller
+        whose ChaosCrash HANDLER already writes a bundle (the replica
+        kill path) passes ``dump=False``: one death, one bundle, and no
+        synchronous fsync ahead of the failover that rescues the
+        request."""
         if self.armed(site):
+            if dump and site != "flight.dump":
+                from ..telemetry import flight as _flight
+                _flight.dump("chaos_crash", site=site)
             raise ChaosCrash(site)
 
     def armed(self, site: str) -> bool:
@@ -261,10 +272,10 @@ def maybe_delay(site: str) -> float:
     return m.maybe_delay(site) if m is not None else 0.0
 
 
-def crash(site: str) -> None:
+def crash(site: str, dump: bool = True) -> None:
     m = active()
     if m is not None:
-        m.crash(site)
+        m.crash(site, dump=dump)
 
 
 def armed(site: str) -> bool:

@@ -103,6 +103,13 @@ class Watchdog:
                    compiles=compiles, recent_signatures=recent)
         _tmetrics.counter("mxtpu_watchdog_flags_total",
                           "Step-deadline violations").inc()
+        # a tripped watchdog is a primary flight-recorder trigger: the
+        # step is wedged and the operator's next move may be kill -9 —
+        # capture the rings NOW, while they still exist
+        from ..telemetry import flight as _flight
+        _flight.dump("watchdog", step=step, deadline_s=self.deadline,
+                     elapsed_s=round(flag.elapsed, 3),
+                     compiles=compiles, recent_signatures=recent)
         warnings.warn(f"[fault.watchdog] {flag}")
         if self.on_flag is not None:
             self.on_flag(flag)

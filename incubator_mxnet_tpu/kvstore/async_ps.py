@@ -71,6 +71,7 @@ from ..lockcheck import make_lock
 from ..ndarray import NDArray
 from ..telemetry import events as _tele
 from ..telemetry import metrics as _tmetrics
+from ..telemetry import trace as _trace
 from . import GradientCompressionMixin, KVStoreBase
 
 __all__ = ["AsyncPSServer", "AsyncKVStore"]
@@ -183,6 +184,29 @@ class AsyncPSServer:
                 pass
 
     def _dispatch(self, msg):
+        # a trailing {"_meta": 1, ...} dict is the carried trace context
+        # (see _Client.call): pop it, resume the worker's trace, and span
+        # the server-side handling — the client→PS hop becomes one
+        # stitched edge instead of a correlation cliff, and a slow or
+        # deduped resend is attributable to the training step that
+        # issued the push
+        if isinstance(msg[-1], dict) and msg[-1].get("_meta"):
+            meta, msg = msg[-1], msg[:-1]
+            key = msg[1] if len(msg) > 1 and not isinstance(
+                msg[1], (bytes, bytearray)) else None
+            step = meta.get("step")
+            with _trace.use(_trace.from_wire(meta.get("trace"))), \
+                    _trace.span(f"kvstore.server.{msg[0]}", kind="server",
+                                key=key, step=step):
+                if step is None:
+                    return self._dispatch_inner(msg)
+                # the carried step binds server-side events (resend,
+                # errors) to the issuing step, same as the span above
+                with _tele.step_scope(step):
+                    return self._dispatch_inner(msg)
+        return self._dispatch_inner(msg)
+
+    def _dispatch_inner(self, msg):
         op = msg[0]
         if op == "init":
             _, key, arr = msg
@@ -193,13 +217,26 @@ class AsyncPSServer:
             # ("push", key, arr) legacy or ("push", key, arr, wid, version)
             key, arr = msg[1], msg[2]
             wid, ver = (msg[3], msg[4]) if len(msg) >= 5 else (None, None)
+            deduped = False
             with self._lock:
                 if wid is not None:
                     if self._applied.get((wid, key), 0) >= ver:
-                        return ("ok",)  # resend of an applied push: ack only
-                    self._applied[(wid, key)] = ver
-                self._apply(key, onp.asarray(arr))
-                self._push_count += 1
+                        deduped = True
+                    else:
+                        self._applied[(wid, key)] = ver
+                if not deduped:
+                    self._apply(key, onp.asarray(arr))
+                    self._push_count += 1
+            if deduped:
+                # resend of an applied push: ack only — and say so on
+                # the timeline (trace-correlated when the push carried
+                # context), because an exactly-once dedupe firing is
+                # the visible tail of a lost reply or a slow link.
+                # Emitted OUTSIDE self._lock: subscriber fan-out can do
+                # file I/O (the JSONL sink) and must not serialize every
+                # concurrent push/pull behind it
+                _tele.emit("kvstore.resend", key=key, worker=wid,
+                           version=ver)
             return ("ok",)
         if op == "pull":
             _, key = msg
@@ -365,6 +402,35 @@ class _Client:
         op = msg[0]
         key = msg[1] if len(msg) > 1 and not isinstance(
             msg[1], (bytes, bytearray)) else None
+        # the trace context rides the wire as a trailing meta element the
+        # server pops off — push/pull only (init/set_optimizer/stats are
+        # setup, not steady state). Ids propagate whenever a context or
+        # step is active — an UNSAMPLED trace still carries its ids (the
+        # documented contract: sampling gates recording, not
+        # propagation, so the server's resend/timeline events stay
+        # step- and trace-attributed for unsampled traffic) — while the
+        # client span that RECORDS the hop only opens when sampled
+        ctx = _trace.current()
+        sp = None
+        if op in ("push", "pull"):
+            step = _tele.current_step()
+            if ctx is not None and ctx.sampled:
+                sp = _trace.start_span(f"kvstore.{op}", kind="client",
+                                       key=key)
+            wire = _trace.to_wire(sp.ctx if sp is not None else ctx)
+            if wire is not None or step is not None:
+                msg = msg + ({"_meta": 1, "trace": wire, "step": step},)
+        try:
+            return self._call_locked(op, key, msg, sp)
+        except BaseException as e:
+            if sp is not None:
+                sp.finish(error=type(e).__name__)
+            raise
+        finally:
+            if sp is not None:
+                sp.finish()
+
+    def _call_locked(self, op, key, msg, sp):
         # the client lock deliberately serializes the SOCKET (one
         # request/reply in flight per connection, like ps-lite's van);
         # blocking I/O under it is the design
@@ -374,7 +440,7 @@ class _Client:
                 # sends: assigned any earlier, concurrent pushers could
                 # deliver versions out of order and the server's monotone
                 # dedupe would drop real updates as resends
-                msg = msg[:4] + (next(self._ver),)
+                msg = msg[:4] + (next(self._ver),) + msg[5:]
             if _inject.should("kv_drop"):   # chaos: sever before the call
                 self.close()
             _inject.maybe_delay("kv_delay")
@@ -393,6 +459,8 @@ class _Client:
                            target_op=op, key=key, attempt=n,
                            error=f"{type(exc).__name__}: {exc}")
                 self._m["retry"].inc()
+                if sp is not None:   # the span tells the resend story
+                    sp.attrs["retries"] = n
                 self.close()   # force a fresh connection before resending
                 self._connect()
                 self._m["reconnect"].inc()

@@ -520,8 +520,16 @@ class ShardedTrainer:
         new_sig = sig not in self._step_sigs
         first_sig = not self._step_sigs
         from .mesh import active_mesh
+        from ..telemetry import trace as _trace
         wd = self._watchdog
-        with _tele.step_scope(attempted):
+        # one root span per step: the kvstore-fallback push/pull hops,
+        # guard verdicts, chaos draws, and the profiler's step frame all
+        # stitch under it — the training twin of the router's
+        # per-request tree (head sampling decides per step)
+        with _tele.step_scope(attempted), \
+                _trace.span("train.step", step=attempted,
+                            path="kvstore_fallback" if fallback
+                            else "pjit"):
             with wd.watch(step=self._t, block=self._block) if wd is not None \
                     else _nullcontext():
                 _inject.maybe_delay("slow_step")

@@ -68,9 +68,12 @@ _MARKERS_DROPPED = [0]                # overflow count past the sample cap
 #: from perf_counter + a fixed anchor, so two spans from one thread compare
 #: exactly (a child's [t_start, t_start+dur] interval is contained in its
 #: parent's — the property the chrome-trace merge and step_report rely on).
+#: ``trace`` is the active distributed-trace correlation at record time —
+#: ``(trace_id, span_id)`` or None — so the chrome-trace merge and the
+#: otel export can stitch profiler wall-time spans into the request tree
 SpanRecord = namedtuple(
     "SpanRecord", ["name", "kind", "t_start", "dur_ms", "parent", "depth",
-                   "step"])
+                   "step", "trace"], defaults=[None])
 
 #: raw span ring for the chrome-trace merge (mx.telemetry.chrome_trace)
 #: and step_report — aggregates cannot be placed on a timeline
@@ -94,6 +97,19 @@ def _current_step() -> Optional[int]:
     # lazy import: telemetry.export imports profiler for the trace merge
     from .telemetry.events import current_step
     return current_step()
+
+
+def _trace():
+    # lazy import, same reason as _current_step
+    from .telemetry import trace
+    return trace
+
+
+def _trace_ids():
+    """(trace_id, span_id) of the active distributed-trace context, or
+    None — stamped onto every SpanRecord."""
+    ctx = _trace().current()
+    return (ctx.trace_id, ctx.span_id) if ctx is not None else None
 
 
 def _append(rec: SpanRecord) -> None:
@@ -128,7 +144,8 @@ def record_span(name: str, dur_ms: float, kind: str = "scope",
         step = _current_step()
     if depth is None:
         depth = 0 if parent is None else 1
-    _append(SpanRecord(name, kind, _EPOCH + t0, dur_ms, parent, depth, step))
+    _append(SpanRecord(name, kind, _EPOCH + t0, dur_ms, parent, depth,
+                       step, _trace_ids()))
 
 
 def recent_spans() -> List[SpanRecord]:
@@ -368,10 +385,25 @@ class Scope:
         self._step = step
         self._ann = jax.profiler.TraceAnnotation(name)
         self._t0: Optional[float] = None
+        self._tspan = None       # open trace.span manager, if sampled
+        self._tspan_sp = None    # the Span it returned on enter
 
     def __enter__(self):
         self._t0 = time.perf_counter()
         _stack().append(self)
+        # a sampled distributed trace adopts profiler scopes as spans:
+        # serve.pad/compute/unpad land UNDER the request's tree instead
+        # of beside it — the "one stitched tree" contract. Unsampled or
+        # untraced: two thread-local reads, nothing recorded.
+        self._tspan = None
+        self._tspan_sp = None
+        ctx = _trace().current()
+        if ctx is not None and ctx.sampled:
+            # the public scoped-span manager owns activation AND finish,
+            # so the trace module's context-stack invariants live in one
+            # place
+            self._tspan = _trace().span(self._name, kind=self._kind)
+            self._tspan_sp = self._tspan.__enter__()
         self._ann.__enter__()
         return self
 
@@ -379,6 +411,14 @@ class Scope:
         self._ann.__exit__(*exc)
         if self._t0 is None:
             return
+        trace_ids = None
+        if self._tspan is not None:
+            self._tspan.__exit__(*(exc if len(exc) == 3
+                                   else (None, None, None)))
+            trace_ids = (self._tspan_sp.ctx.trace_id,
+                         self._tspan_sp.ctx.span_id)
+        else:
+            trace_ids = _trace_ids()
         st = _stack()
         parent, depth = None, 0
         if self in st:
@@ -389,7 +429,7 @@ class Scope:
         dur_ms = (time.perf_counter() - self._t0) * 1e3
         step = self._step if self._step is not None else _current_step()
         _append(SpanRecord(self._name, self._kind, _EPOCH + self._t0,
-                           dur_ms, parent, depth, step))
+                           dur_ms, parent, depth, step, trace_ids))
         self._t0 = None
 
 

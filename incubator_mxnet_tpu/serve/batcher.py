@@ -40,6 +40,7 @@ from ..base import MXNetError
 from ..lockcheck import make_lock
 from .. import profiler
 from ..telemetry import events as _tele
+from ..telemetry import trace as _trace
 from .compiled import CompiledModel, _as_numpy
 from .metrics import ServeMetrics
 
@@ -135,13 +136,16 @@ _REQUEST_IDS = itertools.count(1)
 
 
 class _Request:
-    __slots__ = ("arrays", "future", "t_enqueue", "rid")
+    __slots__ = ("arrays", "future", "t_enqueue", "rid", "span")
 
     def __init__(self, arrays):
         self.arrays = arrays
         self.future = ServeFuture()
         self.t_enqueue = time.perf_counter()
         self.rid = f"r{next(_REQUEST_IDS)}"
+        #: open distributed-trace span covering queue→reply (set at
+        #: admit time, finished at reply/error/abandon; None = untraced)
+        self.span = None
 
 
 class DynamicBatcher:
@@ -224,6 +228,8 @@ class DynamicBatcher:
             self._queue.clear()
         for req in leftovers:
             req.future.set_exception(MXNetError("batcher stopped"))
+            if req.span is not None:
+                req.span.finish(outcome="abandoned")
         _tele.emit("serve.drain",
                    severity="warning" if leftovers else "info",
                    model=self.metrics.model, drain=bool(drain),
@@ -288,10 +294,23 @@ class DynamicBatcher:
                             "vary per request)")
                 else:
                     model._table.bucket(name, size)  # raises on overflow
+        # the request's span covers queue→reply; it parents under the
+        # submitter's context (a router attempt, a wire-hop span), and
+        # the worker thread resumes it at flush time — the cross-thread
+        # half of the one-rooted-tree contract. It must be attached
+        # BEFORE the locked append: the moment the worker can see req it
+        # may flush it, and a span assigned after the fact would never
+        # be resumed or finished.
+        if _trace.current() is not None:
+            req.span = _trace.start_span("serve.request", kind="server",
+                                         request=req.rid,
+                                         model=self.metrics.model)
         deadline = time.time() + self.block_secs
         while True:
             with self._lock:
                 if self._closed:
+                    if req.span is not None:
+                        req.span.finish(error="batcher_stopped")
                     raise MXNetError("batcher stopped; submit rejected")
                 if len(self._queue) < self.queue_limit:
                     self._queue.append(req)
@@ -302,13 +321,16 @@ class DynamicBatcher:
                 _tele.emit("serve.reject", severity="warning",
                            request_id=req.rid, model=self.metrics.model,
                            queue_limit=self.queue_limit)
+                if req.span is not None:
+                    req.span.finish(outcome="rejected")
                 raise QueueFullError(
                     f"serve queue is full ({self.queue_limit} requests); "
                     "backpressure — retry with backoff or raise "
                     "MXTPU_SERVE_QUEUE_LIMIT")
             time.sleep(0.0005)
-        _tele.emit("serve.admit", request_id=req.rid,
-                   model=self.metrics.model, depth=self.depth())
+        with _trace.use(req.span.ctx if req.span is not None else None):
+            _tele.emit("serve.admit", request_id=req.rid,
+                       model=self.metrics.model, depth=self.depth())
         self._wake.set()
         return req.future
 
@@ -349,43 +371,62 @@ class DynamicBatcher:
     def _flush(self, batch: List[_Request]) -> None:
         t0 = time.perf_counter()
         rids = [req.rid for req in batch]
-        _tele.emit("serve.batch", model=self.metrics.model,
-                   size=len(batch), request_ids=rids)
-        try:
-            # thunk inside the try: a failed registry resolve (e.g. the
-            # model was unloaded) must fail THESE futures, not kill the
-            # worker thread and hang every later submit
-            model = self._model_thunk()
-            with profiler.Scope("serve.batch"):
-                stacked = stack_examples(
-                    model, [req.arrays for req in batch])
-                outs = model.predict(*stacked)
-            self._scatter(batch, outs, model)
-        except BaseException as e:  # noqa: BLE001 — routed to futures
-            for req in batch:
-                if not req.future.done():
-                    req.future.set_exception(e)
-            # failed batches must NOT count as served traffic
-            self.metrics.record_failed_batch(len(batch))
-            _tele.emit("serve.execute", severity="error",
-                       model=self.metrics.model, size=len(batch),
-                       request_ids=rids,
-                       error=f"{type(e).__name__}: {e}")
-            return
-        dt_ms = (time.perf_counter() - t0) * 1e3
-        bucket = model._table.bucket(self._batch_axis_name, len(batch))
-        self.metrics.record_batch(len(batch), bucket, dt_ms)
-        _tele.emit("serve.execute", model=self.metrics.model,
-                   size=len(batch), bucket=bucket,
-                   wall_ms=round(dt_ms, 3),
-                   occupancy=round(len(batch) / bucket, 4) if bucket
-                   else None)
+        # the worker thread resumes the FIRST traced request's span for
+        # the shared execution: the batch/pad/compute/unpad profiler
+        # scopes become that request's subtree (its co-batched peers
+        # record the shared flush by reference in their span attrs — a
+        # span has one parent, a batch has many requests)
+        lead = next((r for r in batch if r.span is not None), None)
+        with _trace.use(lead.span.ctx if lead is not None else None):
+            _tele.emit("serve.batch", model=self.metrics.model,
+                       size=len(batch), request_ids=rids)
+            try:
+                # thunk inside the try: a failed registry resolve (e.g.
+                # the model was unloaded) must fail THESE futures, not
+                # kill the worker thread and hang every later submit
+                model = self._model_thunk()
+                with profiler.Scope("serve.batch"):
+                    stacked = stack_examples(
+                        model, [req.arrays for req in batch])
+                    outs = model.predict(*stacked)
+                self._scatter(batch, outs, model)
+            except BaseException as e:  # noqa: BLE001 — routed to futures
+                for req in batch:
+                    if not req.future.done():
+                        req.future.set_exception(e)
+                    if req.span is not None:
+                        req.span.finish(error=type(e).__name__)
+                # failed batches must NOT count as served traffic
+                self.metrics.record_failed_batch(len(batch))
+                _tele.emit("serve.execute", severity="error",
+                           model=self.metrics.model, size=len(batch),
+                           request_ids=rids,
+                           error=f"{type(e).__name__}: {e}")
+                return
+            dt_ms = (time.perf_counter() - t0) * 1e3
+            bucket = model._table.bucket(self._batch_axis_name, len(batch))
+            self.metrics.record_batch(len(batch), bucket, dt_ms)
+            _tele.emit("serve.execute", model=self.metrics.model,
+                       size=len(batch), bucket=bucket,
+                       wall_ms=round(dt_ms, 3),
+                       occupancy=round(len(batch) / bucket, 4) if bucket
+                       else None)
         for req in batch:
             lat_ms = (time.perf_counter() - req.t_enqueue) * 1e3
-            self.metrics.record_request(lat_ms)
-            _tele.emit("serve.reply", request_id=req.rid,
-                       model=self.metrics.model,
-                       latency_ms=round(lat_ms, 3))
+            with _trace.use(req.span.ctx if req.span is not None else None):
+                # latency observes under the request's context so a
+                # sampled request pins its trace id as the histogram's
+                # OpenMetrics exemplar — the p99-spike→trace link
+                self.metrics.record_request(lat_ms)
+                _tele.emit("serve.reply", request_id=req.rid,
+                           model=self.metrics.model,
+                           latency_ms=round(lat_ms, 3))
+            if req.span is not None:
+                attrs = {"latency_ms": round(lat_ms, 3),
+                         "batch_size": len(batch)}
+                if lead is not None and req is not lead:
+                    attrs["exec_span"] = lead.span.ctx.span_id
+                req.span.finish(**attrs)
 
     def _scatter(self, batch: List[_Request], outs, model: CompiledModel
                  ) -> None:

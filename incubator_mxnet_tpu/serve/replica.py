@@ -208,7 +208,11 @@ class Replica:
         anything else is the request's own fault and is not retryable.
         """
         try:
-            inject.crash("replica_kill")
+            # dump=False: kill() below writes the (richer) post-mortem
+            # bundle for this death — a second one here would both halve
+            # the MXTPU_FLIGHT_MAX budget and fsync on the router's
+            # request thread before failover can start
+            inject.crash("replica_kill", dump=False)
             if inject.should("replica_kill"):
                 raise ChaosCrash("replica_kill")
         except ChaosCrash as e:
@@ -289,8 +293,19 @@ class Replica:
             self._batchers.clear()
             self.kills += 1
         self._emit_transition(frm, "crashed", reason)
+        # fail the parked futures FIRST — the router's failover clock is
+        # ticking, and a post-mortem fsync must not sit between a dead
+        # replica and the retry that rescues its requests
         for b in batchers:
             b.stop(drain=False, timeout=0.5)
+        # the kill evidence (what was queued, which locks were held, the
+        # last health probes) lives in process rings that a real crash
+        # would erase — bundle it while the state is still warm; the
+        # rings are append-only so the stop above only ADDS the
+        # drain/abandon tail to the story the bundle tells
+        from ..telemetry import flight as _flight
+        _flight.dump("replica_kill", replica=self.name, reason=reason,
+                     prior_state=frm)
 
     def restart(self) -> "Replica":
         """Full rebuild — fresh registry, fresh batchers, loader re-run

@@ -51,6 +51,8 @@ import numpy as onp
 
 from ..base import MXNetError
 from ..lockcheck import LockOrderError, make_lock
+from ..telemetry import events as _tele_events
+from ..telemetry import trace as _trace
 from .batcher import QueueFullError, ServeFuture
 from .replica import Replica, ReplicaUnavailable
 
@@ -318,20 +320,44 @@ class Router:
                       tenant: Optional[str] = None,
                       idempotent: bool = True) -> Tuple[object, Dict]:
         """:meth:`call` plus a per-request info dict — ``{replica,
-        failovers, retries, hedged, latency_ms}`` — so benches can split
-        failover-path tail latency from the happy path."""
+        failovers, retries, hedged, latency_ms, trace_id}`` — so benches
+        can split failover-path tail latency from the happy path.
+
+        The whole call is one ``router.request`` trace span (a new trace
+        when the caller carries none — e.g. each bench client request —
+        or a child of the caller's, e.g. the TCP front end's wire span);
+        every placement attempt, failover retry, and hedged duplicate is
+        a ``router.attempt`` child, so a hedged request renders as
+        sibling spans under one parent and a failover chain shows each
+        replica tried. A router-level request id binds the admit/shed/
+        failover/hedge events on this thread to the same story.
+        """
         t0 = time.perf_counter()
         timeout_s = (self.request_timeout_s if timeout_s is None
                      else float(timeout_s))
         t_deadline = time.monotonic() + timeout_s
         info: Dict = {"replica": None, "failovers": 0, "retries": 0,
                       "hedged": False}
-        self._admit(model, tenant)
-        try:
-            val = self._call_admitted(model, arrays, t_deadline,
-                                      tenant, idempotent, info)
-        finally:
-            self._release(tenant)
+        self._counter("mxtpu_router_requests_total",
+                      "Requests arriving at the router (pre-admission) — "
+                      "the SLO burn-rate denominator").inc()
+        with _trace.span("router.request", kind="server", model=model,
+                         tenant=tenant) as sp, \
+                _tele_events.request_scope(f"rq-{sp.ctx.span_id[-8:]}"):
+            # low 8 hex of the span id: ids are base+counter per thread,
+            # so the HIGH bits are constant thread-wide and would fold
+            # every request on a thread into one correlation scope
+            info["trace_id"] = sp.ctx.trace_id
+            # head sampling: an unsampled trace propagates ids but
+            # records no spans — consumers (the bench stitching gate)
+            # must not expect a tree for it
+            info["trace_sampled"] = sp.ctx.sampled
+            self._admit(model, tenant)
+            try:
+                val = self._call_admitted(model, arrays, t_deadline,
+                                          tenant, idempotent, info)
+            finally:
+                self._release(tenant)
         info["latency_ms"] = round((time.perf_counter() - t0) * 1e3, 3)
         return val, info
 
@@ -364,20 +390,34 @@ class Router:
                 self._backoff(attempt, t_deadline)
                 exclude.clear()
                 continue
+            # one attempt = one child span; the replica's batcher span
+            # parents under it because submit runs with it active
+            att = _trace.start_span("router.attempt", kind="client",
+                                    replica=rep.name, n=attempt)
             try:
-                fut = rep.submit(model, *arrays)
+                with _trace.use(att.ctx):
+                    fut = rep.submit(model, *arrays)
             except QueueFullError as e:
+                att.finish(outcome="queue_full")
                 last_err = e
                 exclude.add(rep.name)
                 continue
             except ReplicaUnavailable as e:
+                att.finish(outcome="unavailable")
                 last_err = e
                 self._note_failover(rep, model, e)
                 info["failovers"] += 1
                 exclude.add(rep.name)
                 continue
+            except BaseException as e:  # noqa: BLE001 — span hygiene
+                # the request's own error (e.g. shape/bucket validation
+                # rejected at submit): it surfaces to the caller
+                # unchanged, but the attempt span must still close so
+                # the trace shows which replica rejected it
+                att.finish(outcome=type(e).__name__)
+                raise
             try:
-                return self._await_result(rep, fut, model, arrays,
+                return self._await_result(rep, fut, att, model, arrays,
                                           exclude, t_deadline, info,
                                           idempotent)
             except _InfraFailure as e:
@@ -390,6 +430,11 @@ class Router:
                     rep.kill(reason=f"lock-order: {e.cause}")
                 if not idempotent:
                     self._bump("failed")
+                    self._counter(
+                        "mxtpu_router_failed_total",
+                        "Requests terminally failed at the router "
+                        "(non-idempotent infra failure — no retry "
+                        "allowed)").inc()
                     raise e.cause
                 exclude.add(rep.name)
                 if attempt < self.retries:
@@ -425,61 +470,90 @@ class Router:
             return True
         return isinstance(exc, MXNetError) and "batcher stopped" in str(exc)
 
-    def _await_result(self, rep: Replica, fut: ServeFuture, model: str,
+    def _await_result(self, rep: Replica, fut: ServeFuture, att, model: str,
                       arrays, exclude: set, t_deadline: float,
                       info: Dict, idempotent: bool):
         """Wait for ``fut`` under the request deadline, optionally racing
         ONE hedged duplicate on a second replica after ``hedge_ms`` —
-        only for idempotent requests (a hedge IS a duplicate execution)."""
+        only for idempotent requests (a hedge IS a duplicate execution).
+        ``att`` is the primary attempt's trace span; the hedge opens a
+        sibling span, and every racer's span is finished with its outcome
+        (won/lost/error/deadline) — ``finish`` is idempotent, so the
+        ``finally`` sweep closes whatever an exception path left open."""
         hedge_at = (time.monotonic() + self.hedge_ms / 1e3
                     if self.hedge_ms > 0 and idempotent else None)
-        racers: List[Tuple[Replica, ServeFuture]] = [(rep, fut)]
+        racers: List[Tuple[Replica, ServeFuture, object]] = [(rep, fut, att)]
+        spans = [att]                  # every attempt span ever opened
         hedged = False
-        while True:
-            now = time.monotonic()
-            if now >= t_deadline:
-                raise self._deadline(
-                    f"replica {rep.name!r} produced no result within the "
-                    "request deadline")
-            if not hedged and hedge_at is not None and now >= hedge_at:
-                hedged = True
-                h = self.replicas.pick(exclude | {rep.name})
-                if h is not None:
+        try:
+            while True:
+                now = time.monotonic()
+                if now >= t_deadline:
+                    for sp in spans:
+                        sp.finish(outcome="deadline")
+                    raise self._deadline(
+                        f"replica {rep.name!r} produced no result within "
+                        "the request deadline")
+                if not hedged and hedge_at is not None and now >= hedge_at:
+                    hedged = True
+                    h = self.replicas.pick(exclude | {rep.name})
+                    if h is not None:
+                        # the hedge is a SIBLING attempt: current context
+                        # here is the router.request span, so both
+                        # attempts hang under one parent
+                        hatt = _trace.start_span(
+                            "router.attempt", kind="client",
+                            replica=h.name, hedge=True)
+                        # on the sweep list BEFORE submit: if submit
+                        # raises past the handler below, the finally
+                        # still closes the span
+                        spans.append(hatt)
+                        try:
+                            with _trace.use(hatt.ctx):
+                                hfut = h.submit(model, *arrays)
+                            racers.append((h, hfut, hatt))
+                            info["hedged"] = True
+                            self._bump("hedges")
+                            self._counter("mxtpu_router_hedges_total",
+                                          "Hedged duplicate attempts").inc()
+                            self._emit("router.hedge", model=model,
+                                       primary=rep.name, hedge=h.name,
+                                       after_ms=self.hedge_ms)
+                        except MXNetError:
+                            hatt.finish(outcome="hedge_submit_failed")
+                            # hedging is best-effort by definition
+                done = [(r, f, a) for r, f, a in racers if f.done()]
+                for r, f, a in done:
                     try:
-                        racers.append((h, h.submit(model, *arrays)))
-                        info["hedged"] = True
-                        self._bump("hedges")
-                        self._counter("mxtpu_router_hedges_total",
-                                      "Hedged duplicate attempts").inc()
-                        self._emit("router.hedge", model=model,
-                                   primary=rep.name, hedge=h.name,
-                                   after_ms=self.hedge_ms)
-                    except MXNetError:
-                        pass  # hedging is best-effort by definition
-            done = [(r, f) for r, f in racers if f.done()]
-            for r, f in done:
-                try:
-                    val = f.result(timeout=0)
-                except BaseException as e:  # noqa: BLE001 — classified here
-                    if not self._is_infra(e):
-                        raise  # the request's own error — not retryable
-                    racers = [(rr, ff) for rr, ff in racers if ff is not f]
-                    if not racers:
-                        raise _InfraFailure(e)
-                    continue
-                if f is not fut:
-                    self._bump("hedge_wins")
-                info["replica"] = r.name
-                self._bump("completed")
-                return val
-            # block on the oldest outstanding racer up to the next event
-            # (hedge arm time, request deadline) instead of spinning
-            horizon = t_deadline
-            if hedge_at is not None and not hedged:
-                horizon = min(horizon, hedge_at)
-            elif len(racers) > 1:
-                horizon = min(horizon, now + 0.005)
-            racers[0][1].wait(max(0.0, horizon - time.monotonic()))
+                        val = f.result(timeout=0)
+                    except BaseException as e:  # noqa: BLE001 — classified
+                        a.finish(outcome=type(e).__name__)
+                        if not self._is_infra(e):
+                            raise  # the request's own error — not retryable
+                        racers = [t for t in racers if t[1] is not f]
+                        if not racers:
+                            raise _InfraFailure(e)
+                        continue
+                    a.finish(outcome="ok", won=True)
+                    for _r, _f, other in racers:
+                        if other is not a:
+                            other.finish(outcome="lost")
+                    if f is not fut:
+                        self._bump("hedge_wins")
+                    info["replica"] = r.name
+                    self._bump("completed")
+                    return val
+                # block on the oldest outstanding racer up to the next
+                # event (hedge arm time, request deadline), not spinning
+                horizon = t_deadline
+                if hedge_at is not None and not hedged:
+                    horizon = min(horizon, hedge_at)
+                elif len(racers) > 1:
+                    horizon = min(horizon, now + 0.005)
+                racers[0][1].wait(max(0.0, horizon - time.monotonic()))
+        finally:
+            for sp in spans:
+                sp.finish(outcome="abandoned")
 
     def _note_failover(self, rep: Replica, model: str,
                        err: BaseException) -> None:

@@ -10,17 +10,34 @@ layer in front of :meth:`Server.submit`.
 Wire protocol: newline-delimited JSON over TCP, one object per request::
 
     {"model": "lenet", "inputs": [[...nested lists...], ...],
-     "dtypes": ["float32"], "version": 2}          # version optional
-    -> {"ok": true, "outputs": [...], "latency_ms": 1.8}
+     "dtypes": ["float32"], "version": 2,          # version optional
+     "trace": {"trace_id": "...", "span_id": "...", "sampled": true}}
+    -> {"ok": true, "outputs": [...], "latency_ms": 1.8,
+        "trace_id": "..."}                         # echoed when traced
 
     {"cmd": "metrics", "model": "lenet"}   -> {"ok": true, "metrics": {...}}
     {"cmd": "models"}                      -> {"ok": true, "models": {...}}
     {"cmd": "prometheus"}  -> {"ok": true, "text": "<metrics scrape>"}
     {"cmd": "telemetry"}   -> {"ok": true, "telemetry": {...snapshot...}}
 
+The optional ``trace`` field carries W3C-style distributed-trace context
+across the wire (``mx.telemetry.trace``): the server resumes the
+caller's context and opens one ``serve.wire`` span over the request, so
+a traced client renders the TCP hop, the batcher, and the compiled
+execution as one rooted tree. :func:`client_call` injects the active
+context automatically.
+
 Each model gets one :class:`DynamicBatcher` whose model thunk resolves
 through the registry at flush time, so a version swap redirects the very
 next batch without restarting the server.
+
+A :class:`Server` normally fronts one :class:`ModelRegistry`; pass
+``router=`` instead to put the TCP protocol in front of the HA tier —
+predict requests route through :meth:`Router.call_detailed` (failover,
+hedging, admission control), with shed/deadline rejections surfacing as
+structured ``retry_after`` replies. Router mode serves the active
+version only: a ``version``-pinned request is refused with a structured
+error rather than silently answered by whatever version is live.
 """
 from __future__ import annotations
 
@@ -35,6 +52,7 @@ import numpy as onp
 
 from ..base import MXNetError
 from ..lockcheck import make_lock
+from ..telemetry import trace as _trace
 from .batcher import DynamicBatcher, ServeFuture
 from .registry import ModelRegistry
 
@@ -44,12 +62,17 @@ __all__ = ["Server", "client_call"]
 class Server:
     """Serve every model in ``registry`` — in-process via :meth:`submit`,
     over TCP via :meth:`start` (``port=0`` picks a free port; read it back
-    from ``server.port``)."""
+    from ``server.port``). With ``router=`` the predict path routes
+    through the HA tier instead of a local batcher."""
 
-    def __init__(self, registry: ModelRegistry, host: str = "127.0.0.1",
+    def __init__(self, registry: Optional[ModelRegistry] = None,
+                 host: str = "127.0.0.1",
                  port: int = 0, max_delay_ms: Optional[float] = None,
-                 queue_limit: Optional[int] = None):
+                 queue_limit: Optional[int] = None, router=None):
+        if registry is None and router is None:
+            raise MXNetError("Server needs a registry or a router")
         self.registry = registry
+        self.router = router
         self.host = host
         self.port = port
         self._batcher_kw = dict(max_delay_ms=max_delay_ms,
@@ -62,6 +85,13 @@ class Server:
     # -- in-process path ------------------------------------------------
     def batcher(self, name: str) -> DynamicBatcher:
         from .batcher import make_registry_batcher
+        if self.registry is None:
+            # router-backed mode: placement lives in the HA tier; a
+            # batcher built over a None registry would fail on first
+            # flush AND stay cached under the model name
+            raise MXNetError(
+                "router-backed Server has no local batchers — submit "
+                "through the wire protocol or Router.call instead")
         with self._lock:
             b = self._batchers.get(name)
             if b is None:
@@ -79,12 +109,18 @@ class Server:
         b = self.batcher(name)
         return b.metrics.snapshot(self.registry.get(name))
 
-    def prometheus(self) -> str:
-        """The process-wide telemetry scrape (Prometheus text exposition
-        0.0.4): every ``mxtpu_*`` series — serving counters/latency by
-        model, training step counters, compile ledger, event totals."""
+    def prometheus(self, openmetrics: bool = False) -> str:
+        """The process-wide telemetry scrape: every ``mxtpu_*`` series —
+        serving counters/latency by model, training step counters,
+        compile ledger, event totals. Default is strict text exposition
+        0.0.4 (no exemplar suffixes — anything after the value breaks a
+        real Prometheus scrape at that content type);
+        ``openmetrics=True`` renders the exemplar-bearing OpenMetrics
+        exposition with its mandatory ``# EOF`` terminator."""
         from .. import telemetry
-        return telemetry.prometheus_text()
+        if openmetrics:
+            return telemetry.prometheus_text(exemplars=True) + "# EOF\n"
+        return telemetry.prometheus_text(exemplars=False)
 
     # -- TCP front end --------------------------------------------------
     def start(self) -> "Server":
@@ -108,6 +144,9 @@ class Server:
                         retry_after = getattr(e, "retry_after", None)
                         if retry_after is not None:
                             reply["retry_after"] = retry_after
+                        trace_id = getattr(e, "trace_id", None)
+                        if trace_id is not None:
+                            reply["trace_id"] = trace_id
                     self.wfile.write(
                         (json.dumps(reply) + "\n").encode("utf-8"))
                     self.wfile.flush()
@@ -139,12 +178,26 @@ class Server:
         msg = json.loads(line.decode("utf-8"))
         cmd = msg.get("cmd")
         if cmd == "models":
+            if self.registry is None:
+                return {"ok": True,
+                        "models": {"router": self.router.snapshot()}}
             return {"ok": True, "models": self.registry.models()}
         if cmd == "metrics":
+            if self.registry is None:
+                return {"ok": True,
+                        "metrics": {"router": self.router.snapshot()}}
             return {"ok": True, "metrics": self.metrics(msg["model"])}
         if cmd == "prometheus":
             # text-format scrape over the JSON-lines protocol; a real
-            # Prometheus deployment fronts this with its own HTTP shim
+            # Prometheus deployment fronts this with its own HTTP shim.
+            # Default stays strict 0.0.4; {"format": "openmetrics"}
+            # switches to the exemplar-bearing exposition (and the
+            # content type a collector needs to parse it)
+            if msg.get("format") == "openmetrics":
+                return {"ok": True,
+                        "content_type": ("application/openmetrics-text; "
+                                         "version=1.0.0; charset=utf-8"),
+                        "text": self.prometheus(openmetrics=True)}
             return {"ok": True,
                     "content_type": "text/plain; version=0.0.4",
                     "text": self.prometheus()}
@@ -153,16 +206,69 @@ class Server:
             return {"ok": True, "telemetry": telemetry.snapshot()}
         if cmd is not None:
             raise MXNetError(f"unknown cmd {cmd!r}")
+        # a predict request: resume the caller's carried trace context
+        # (if any) and span the wire hop, so the TCP boundary is one
+        # stitched edge in the request's tree instead of a correlation
+        # cliff
+        ctx = _trace.from_wire(msg.get("trace"))
+        with _trace.use(ctx), \
+                _trace.span("serve.wire", kind="server",
+                            model=msg.get("model")) as wire_sp:
+            try:
+                reply = self._predict(msg)
+            except Exception as e:
+                # the error reply the handler builds from this exception
+                # is the one an on-call most wants to correlate — pin the
+                # wire span's trace id on it so sheds/timeouts keep the
+                # "structured errors carry trace_id" contract
+                if ctx is not None or _trace.sample_rate() > 0:
+                    e.trace_id = wire_sp.ctx.trace_id
+                raise
+            if ctx is not None or _trace.sample_rate() > 0:
+                reply.setdefault("trace_id", wire_sp.ctx.trace_id)
+            return reply
+
+    def _predict(self, msg: dict) -> dict:
         name = msg["model"]
         version = msg.get("version")
-        model = self.registry.get(name, version)
+        tenant = msg.get("tenant")
         dtypes = msg.get("dtypes")
+        t0 = time.perf_counter()
+        if self.registry is None:
+            # HA mode: the router owns placement/failover/shedding;
+            # Shed/Deadline errors surface through the generic handler
+            # with their structured retry_after. Wire floats default to
+            # f32 (no model avals to consult here; f64 would silently
+            # miss every compiled bucket).
+            if version is not None:
+                # replicas always serve the synced active version —
+                # silently answering a pinned request with a different
+                # version would be worse than refusing it
+                raise MXNetError(
+                    f"version pinning (version={version!r}) is not "
+                    "supported by the router-backed tier; replicas "
+                    "serve the active version only")
+            arrays = []
+            for i, payload in enumerate(msg["inputs"]):
+                dtype = dtypes[i] if dtypes and i < len(dtypes) else None
+                a = onp.asarray(payload, dtype=dtype)
+                if dtype is None and a.dtype == onp.float64:
+                    a = a.astype(onp.float32)
+                arrays.append(a)
+            val, info = self.router.call_detailed(name, *arrays,
+                                                  tenant=tenant)
+            result = val if isinstance(val, tuple) else (val,)
+            return {"ok": True,
+                    "outputs": [onp.asarray(r).tolist() for r in result],
+                    "replica": info["replica"],
+                    "latency_ms": round((time.perf_counter() - t0) * 1e3,
+                                        3)}
+        model = self.registry.get(name, version)
         arrays = []
         for i, payload in enumerate(msg["inputs"]):
             dtype = (dtypes[i] if dtypes and i < len(dtypes)
                      else model._in_avals[i][1])
             arrays.append(onp.asarray(payload, dtype=dtype))
-        t0 = time.perf_counter()
         if version is not None:
             # pinned-version requests bypass the shared batcher (which
             # always serves the active version)
@@ -192,7 +298,14 @@ class Server:
 def client_call(host: str, port: int, payload: dict,
                 timeout: float = 30.0) -> dict:
     """Minimal blocking client for the JSON-lines protocol (used by the
-    tests and the bench; real clients keep the socket open)."""
+    tests and the bench; real clients keep the socket open). An active
+    distributed-trace context is injected as the ``trace`` field (unless
+    the payload already carries one), so the server's ``serve.wire`` span
+    parents under the caller's tree."""
+    if "cmd" not in payload and "trace" not in payload:
+        wire_ctx = _trace.to_wire()
+        if wire_ctx is not None:
+            payload = {**payload, "trace": wire_ctx}
     with socket.create_connection((host, port), timeout=timeout) as sock:
         sock.sendall((json.dumps(payload) + "\n").encode("utf-8"))
         buf = b""

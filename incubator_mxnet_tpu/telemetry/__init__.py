@@ -48,15 +48,19 @@ from typing import Dict, Optional
 from . import compile_log  # noqa: F401
 from . import events  # noqa: F401
 from . import export  # noqa: F401
+from . import flight  # noqa: F401
 from . import metrics  # noqa: F401
+from . import slo  # noqa: F401
+from . import trace  # noqa: F401
 from .events import (  # noqa: F401
     BUS, Event, EventBus, clear, counts, emit, enable, enabled,
     get_events, request_scope, step_scope, subscribe, unsubscribe,
 )
 from .export import (  # noqa: F401
     JsonlSink, chrome_trace, dumps_strict, install_from_env, install_jsonl,
-    prometheus_text, sanitize,
+    otel_spans, prometheus_text, sanitize,
 )
+from .slo import SLO, SLOMonitor  # noqa: F401
 from .metrics import (  # noqa: F401
     Counter, Gauge, Histogram, MetricsRegistry, REGISTRY, counter, gauge,
     histogram,
@@ -68,8 +72,10 @@ __all__ = ["emit", "events", "get_events", "counts", "clear",
            "Event", "EventBus", "BUS",
            "Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
            "counter", "gauge", "histogram",
-           "compile_log", "metrics", "export",
-           "prometheus_text", "chrome_trace", "install_jsonl",
+           "compile_log", "metrics", "export", "trace", "flight", "slo",
+           "SLO", "SLOMonitor",
+           "prometheus_text", "chrome_trace", "otel_spans",
+           "install_jsonl",
            "install_from_env", "sanitize", "dumps_strict",
            "JsonlSink", "snapshot", "reset"]
 
@@ -91,6 +97,8 @@ def snapshot(recent: int = 5) -> Dict:
         "metrics": metrics.to_dict(),
         "compiles": compile_log.summary(),
         "spans": profiler.span_records(),
+        # distributed-trace stitching health (span/trace/orphan counts)
+        "trace": trace.summary(),
         # host-gap attribution over the recorded step frames (trainer
         # "step", serving "serve.predict") — empty-shaped when no frames
         "step_report": {"step": profiler.step_report("step"),
@@ -107,3 +115,5 @@ def reset() -> None:
     REGISTRY.clear()
     compile_log.clear()
     export.uninstall_all()
+    trace.clear()
+    flight.reset()

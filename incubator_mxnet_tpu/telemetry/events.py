@@ -59,11 +59,13 @@ class Event:
     :func:`~incubator_mxnet_tpu.telemetry.export.sanitize`)."""
 
     __slots__ = ("seq", "kind", "severity", "ts", "mono", "step",
-                 "request_id", "fields")
+                 "request_id", "trace_id", "span_id", "fields")
 
     def __init__(self, seq: int, kind: str, severity: str, ts: float,
                  mono: float, step: Optional[int],
-                 request_id: Optional[str], fields: Dict):
+                 request_id: Optional[str], fields: Dict,
+                 trace_id: Optional[str] = None,
+                 span_id: Optional[str] = None):
         self.seq = seq
         self.kind = kind
         self.severity = severity
@@ -71,6 +73,8 @@ class Event:
         self.mono = mono        # monotonic — duration math
         self.step = step        # training-step correlation id
         self.request_id = request_id  # serving-request correlation id
+        self.trace_id = trace_id      # distributed-trace correlation
+        self.span_id = span_id        # (active span when emitted)
         self.fields = fields
 
     def to_dict(self) -> Dict:
@@ -80,6 +84,9 @@ class Event:
             d["step"] = self.step
         if self.request_id is not None:
             d["request_id"] = self.request_id
+        if self.trace_id is not None:
+            d["trace_id"] = self.trace_id
+            d["span_id"] = self.span_id
         if self.fields:
             d["fields"] = self.fields
         return d
@@ -143,6 +150,13 @@ class request_scope:
 class EventBus:
     """Bounded, thread-safe, per-kind ring buffers + subscriber fan-out."""
 
+    #: consecutive failures after which a subscriber is muted
+    MAX_SUBSCRIBER_FAILURES = 8
+    #: first mute window (seconds); doubles per further failed probe,
+    #: capped at 60s — muted, never evicted, so a sink that heals (the
+    #: JSONL sink reopening after a full disk drains) gets its stream back
+    SUBSCRIBER_MUTE_BASE_S = 1.0
+
     def __init__(self, ring: Optional[int] = None):
         from ..util import getenv
         self.ring = int(ring if ring is not None
@@ -155,6 +169,10 @@ class EventBus:
         #: subscriber exceptions swallowed (a sink must never break the
         #: emitting subsystem)
         self.subscriber_errors = 0
+        #: per-subscriber consecutive-failure streaks (id(sub) keyed)
+        self._sub_failures: Dict[int, int] = {}
+        #: id(sub) -> monotonic deadline before which the sub is skipped
+        self._sub_muted: Dict[int, float] = {}
 
     def emit(self, kind: str, severity: str = "info",
              step: Optional[int] = None, request_id: Optional[str] = None,
@@ -169,12 +187,16 @@ class EventBus:
         tname = threading.current_thread().name
         if tname != "MainThread" and "thread" not in fields:
             fields["thread"] = tname
+        from . import trace as _trace
+        tctx = _trace.current()
         ev = Event(next(self._seq), kind, severity, time.time(),
                    time.monotonic(),
                    step if step is not None else current_step(),
                    request_id if request_id is not None
                    else current_request(),
-                   fields)
+                   fields,
+                   trace_id=tctx.trace_id if tctx is not None else None,
+                   span_id=tctx.span_id if tctx is not None else None)
         with self._lock:
             ring = self._rings.get(kind)
             if ring is None:
@@ -185,12 +207,72 @@ class EventBus:
         # subscribers run OUTSIDE the lock: a slow sink must not
         # serialize emitters, and a sink that emits must not deadlock
         for sub in subs:
+            until = self._sub_muted.get(id(sub))
+            if until is not None and time.monotonic() < until:
+                continue               # muted: skip, probe again later
             try:
                 sub(ev)
             except Exception:  # noqa: BLE001 — sinks must not break emitters
-                with self._lock:  # unlocked += would lose concurrent counts
-                    self.subscriber_errors += 1
+                self._note_subscriber_error(sub)
+            else:
+                # per-sub membership first (GIL-safe read, same pattern
+                # as the mute check above) so a healthy sink's success
+                # never takes the lock even while ANOTHER sink is wedged
+                if id(sub) in self._sub_failures or id(sub) in self._sub_muted:
+                    # reset the streak under the lock, and only when no
+                    # mute window is ACTIVE: a stale success from a
+                    # thread descheduled before the sink wedged must not
+                    # cancel the mute another thread just engaged (an
+                    # expired window means this success was the healing
+                    # probe, so unmuting is correct)
+                    with self._lock:
+                        until = self._sub_muted.get(id(sub))
+                        if until is None or time.monotonic() >= until:
+                            self._sub_failures.pop(id(sub), None)
+                            self._sub_muted.pop(id(sub), None)
         return ev
+
+    def _note_subscriber_error(self, sub) -> None:
+        """Isolate one failing subscriber: count it (attribute + the
+        ``mxtpu_telemetry_subscriber_errors_total`` registry counter so
+        the scrape can alert on it), and MUTE a sink that fails many
+        times in a row — a wedged sink must not tax every future emit on
+        the trainer/serve threads, let alone break them. Muting is a
+        backoff, not an eviction: the sub is probed again after the
+        window (doubling per failed probe, capped at 60s), so a sink
+        that heals — the JSONL sink reopening once a full disk drains —
+        gets its stream back instead of staying dark for the process
+        lifetime."""
+        with self._lock:
+            self.subscriber_errors += 1
+            n = self._sub_failures.get(id(sub), 0) + 1
+            self._sub_failures[id(sub)] = n
+            muted = n >= self.MAX_SUBSCRIBER_FAILURES
+            if muted:
+                # exponent capped BEFORE pow: a sink that never heals
+                # keeps failing probes for the process lifetime, and
+                # 2.0**1024 would raise OverflowError out of the very
+                # isolation path that must not throw
+                window = min(
+                    60.0, self.SUBSCRIBER_MUTE_BASE_S
+                    * (2.0 ** min(n - self.MAX_SUBSCRIBER_FAILURES, 16)))
+                self._sub_muted[id(sub)] = time.monotonic() + window
+            first_mute = muted and n == self.MAX_SUBSCRIBER_FAILURES
+        try:
+            from . import metrics as _metrics
+            _metrics.counter(
+                "mxtpu_telemetry_subscriber_errors_total",
+                "Event-bus subscriber exceptions swallowed (the flush "
+                "path never propagates them)").inc()
+        except Exception:  # noqa: BLE001 — error accounting must not
+            pass           # itself become an error source
+        if first_mute:
+            import warnings
+            warnings.warn(
+                f"[telemetry] subscriber {sub!r} muted after "
+                f"{self.MAX_SUBSCRIBER_FAILURES} consecutive failures; "
+                "it will be probed again with backoff (events emitted "
+                "while muted are lost to it)")
 
     def events(self, kind: Optional[str] = None,
                n: Optional[int] = None) -> List[Event]:
@@ -224,6 +306,11 @@ class EventBus:
         with self._lock:
             if fn in self._subscribers:
                 self._subscribers.remove(fn)
+            # id() keys can be recycled by the allocator once fn is
+            # collected — a later subscriber at the same address must
+            # not inherit this one's failure streak or mute window
+            self._sub_failures.pop(id(fn), None)
+            self._sub_muted.pop(id(fn), None)
 
     def clear(self) -> None:
         with self._lock:

@@ -27,13 +27,13 @@ from __future__ import annotations
 
 import json
 import os
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 from ..lockcheck import make_lock
 
 __all__ = ["sanitize", "dumps_strict", "JsonlSink", "install_jsonl",
            "install_from_env", "uninstall_all", "prometheus_text",
-           "chrome_trace"]
+           "chrome_trace", "otel_spans"]
 
 
 def sanitize(obj):
@@ -61,6 +61,19 @@ def dumps_strict(obj, **kw) -> str:
     return json.dumps(sanitize(obj), allow_nan=False, **kw)
 
 
+def _reject_nonfinite(tok):
+    raise ValueError(f"non-strict JSON token {tok!r}")
+
+
+def loads_strict(s: str):
+    """The loads half of the strict-JSON contract: rejects
+    ``NaN``/``Infinity`` tokens a lenient parser would accept, so a
+    consumer cannot read back what :func:`dumps_strict` could never have
+    written. (The stdlib-only tools under ``tools/`` carry their own
+    copies by design.)"""
+    return json.loads(s, parse_constant=_reject_nonfinite)
+
+
 class JsonlSink:
     """Bus subscriber writing one strict-JSON line per event, with
     size-based rotation (``path`` -> ``path.1``, one generation — bounded
@@ -81,22 +94,36 @@ class JsonlSink:
     def __call__(self, event) -> None:
         line = dumps_strict(event.to_dict(), sort_keys=True)
         with self._lock:
-            if self._fh is None:
-                d = os.path.dirname(os.path.abspath(self.path))
-                os.makedirs(d, exist_ok=True)
-                # first open truncates: seq numbers restart per process,
-                # so appending to a previous run's file would read as
-                # corruption (duplicate seqs) to tools/telemetry_check.py;
-                # reopens within one run (after rotation/close) append
-                self._fh = open(self.path,
-                                "a" if self._started else "w",
-                                encoding="utf-8")
-                self._started = True
-            self._fh.write(line + "\n")
-            self._fh.flush()
-            self.lines += 1
-            if self.max_bytes and self._fh.tell() >= self.max_bytes:
-                self._rotate()
+            try:
+                if self._fh is None:
+                    d = os.path.dirname(os.path.abspath(self.path))
+                    os.makedirs(d, exist_ok=True)
+                    # first open truncates: seq numbers restart per
+                    # process, so appending to a previous run's file would
+                    # read as corruption (duplicate seqs) to
+                    # tools/telemetry_check.py; reopens within one run
+                    # (after rotation/close) append
+                    self._fh = open(self.path,
+                                    "a" if self._started else "w",
+                                    encoding="utf-8")
+                    self._started = True
+                self._fh.write(line + "\n")
+                self._fh.flush()
+                self.lines += 1
+                if self.max_bytes and self._fh.tell() >= self.max_bytes:
+                    self._rotate()
+            except Exception:
+                # self-heal: a failed write/rotate must not wedge the
+                # stream forever on a half-dead handle — drop the handle
+                # so the NEXT event reopens (append), and let the bus
+                # count this one (it isolates subscriber errors)
+                if self._fh is not None:
+                    try:
+                        self._fh.close()
+                    except Exception:  # noqa: BLE001 — already broken
+                        pass
+                    self._fh = None
+                raise
 
     def _rotate(self) -> None:
         self._fh.close()
@@ -151,22 +178,64 @@ def uninstall_all() -> None:
     _events._reset_env_sinks_flag()
 
 
-def prometheus_text() -> str:
+def prometheus_text(exemplars: bool = False) -> str:
     """The full scrape: metrics registry + per-kind event totals +
-    subscriber-error count."""
+    subscriber-error count. The default is a strict 0.0.4 exposition
+    (no OpenMetrics exemplar suffixes) — what a scrape endpoint
+    advertising ``text/plain; version=0.0.4`` must serve;
+    ``exemplars=True`` opts into the OpenMetrics form with trace-id
+    exemplars on the ``<name>_observations_total`` companion counters."""
     from . import events as _events
     from . import metrics as _metrics
-    out = [_metrics.prometheus_text().rstrip("\n")]
+    out = [_metrics.prometheus_text(exemplars=exemplars).rstrip("\n")]
+
+    def _family(total_name: str) -> str:
+        # OpenMetrics counter families drop the _total their samples
+        # carry; 0.0.4 conventionally types the sample name itself
+        return (_metrics.om_family(total_name, "counter") if exemplars
+                else total_name)
+
     counts = _events.counts()
     if counts:
-        out.append("# TYPE mxtpu_events_total counter")
+        out.append(f"# TYPE {_family('mxtpu_events_total')} counter")
         for kind in sorted(counts):
             out.append(f'mxtpu_events_total{{kind="{kind}"}} '
                        f"{counts[kind]}")
-    out.append("# TYPE mxtpu_telemetry_subscriber_errors_total counter")
-    out.append("mxtpu_telemetry_subscriber_errors_total "
-               f"{_events.BUS.subscriber_errors}")
+    # the first subscriber error registers this series in the registry
+    # (rendered above); the synthetic zero line below only fills the gap
+    # before then, so the series exists from the first scrape without
+    # ever duplicating
+    if not any(i.name == "mxtpu_telemetry_subscriber_errors_total"
+               for i in _metrics.REGISTRY.instruments()):
+        out.append(f"# TYPE "
+                   f"{_family('mxtpu_telemetry_subscriber_errors_total')} "
+                   f"counter")
+        out.append("mxtpu_telemetry_subscriber_errors_total "
+                   f"{_events.BUS.subscriber_errors}")
     return "\n".join(out) + "\n"
+
+
+def otel_spans() -> List[Dict]:
+    """The trace ring in OpenTelemetry-style span dicts (``traceId`` /
+    ``spanId`` / ``parentSpanId`` / nanosecond timestamps) — the export
+    form ``serve_bench --trace-out`` writes and ``tools/telemetry_check.py
+    --require-rooted-traces`` validates. JSON-ready after
+    :func:`sanitize`."""
+    from . import trace as _trace
+    out = []
+    for r in _trace.spans():
+        t0_ns = int(r["ts"] * 1e9)
+        rec = {"traceId": r["trace_id"], "spanId": r["span_id"],
+               "parentSpanId": r.get("parent_id") or "",
+               "name": r["name"], "kind": r["kind"],
+               "startTimeUnixNano": t0_ns,
+               "endTimeUnixNano": t0_ns + int(r["dur_ms"] * 1e6),
+               "attributes": dict(r.get("attrs", {}))}
+        for k in ("thread", "step", "request_id"):
+            if r.get(k) is not None:
+                rec["attributes"][k] = r[k]
+        out.append(rec)
+    return out
 
 
 def chrome_trace(include_events: bool = True) -> str:
@@ -185,6 +254,8 @@ def chrome_trace(include_events: bool = True) -> str:
             args["parent"] = rec.parent
         if rec.step is not None:
             args["step"] = rec.step
+        if rec.trace is not None:
+            args["trace_id"], args["span_id"] = rec.trace
         trace.append({"name": rec.name, "cat": rec.kind, "ph": "X",
                       "ts": round(rec.t_start * 1e6, 1),
                       "dur": round(rec.dur_ms * 1e3, 1),
@@ -197,6 +268,9 @@ def chrome_trace(include_events: bool = True) -> str:
                 args["step"] = ev.step
             if ev.request_id is not None:
                 args["request_id"] = ev.request_id
+            if ev.trace_id is not None:
+                args["trace_id"] = ev.trace_id
+                args["span_id"] = ev.span_id
             trace.append({"name": f"{ev.kind}", "cat": ev.severity,
                           "ph": "i", "s": "p",
                           "ts": round(ev.ts * 1e6, 1),

@@ -1,0 +1,278 @@
+"""Flight recorder — post-mortem bundles written at the moment of death.
+
+Reference counterpart: none — when the reference crashed, the evidence
+died with it (whatever stderr captured). This repo's situation before
+this module was structurally the same: the event rings, the trace ring,
+the compile ledger, the lock graph, and the profiler's step attribution
+are all **in-process memory** — precisely the state that evaporates when
+the watchdog trips, a guard halts, a replica is stall-killed, or a chaos
+crash site fires. The flight recorder inverts that: trigger sites call
+:func:`dump`, which atomically writes one strict-JSON bundle of every
+in-memory diagnostic surface to ``MXTPU_FLIGHT_DIR``; then
+``tools/postmortem.py`` renders a bundle into a human-readable timeline.
+
+Contract:
+
+- **Off by default, near-zero when off**: :func:`dump` is one env read
+  when ``MXTPU_FLIGHT_DIR`` is unset. Nothing is recorded *for* the
+  flight recorder — it snapshots rings that already exist.
+- **Atomic**: bundles are written tmp → fsync → ``os.replace``; a
+  mid-dump death (chaos site ``flight.dump``) leaves a ``.tmp-*`` file,
+  never a torn bundle under the final name. Readers may trust any
+  ``flight-*.json`` they can see.
+- **Never the second fault**: :func:`dump` swallows its own errors
+  (warning, not raise) — a broken disk must not mask the original
+  failure. The one exception is :class:`~..fault.inject.ChaosCrash`
+  from the ``flight.dump`` site itself, which propagates by design
+  (it *is* the simulated mid-dump kill).
+- **Storm-bounded**: at most ``MXTPU_FLIGHT_MAX`` bundles per process
+  (default 16) and at least ``MXTPU_FLIGHT_MIN_S`` seconds apart
+  (default 0) — a crash loop produces a few bundles, not a full disk.
+
+Bundle format (``format: 1``, strict JSON, one file per trigger)::
+
+    flight-<utc>-<reason>-p<pid>.json
+    {"format": 1, "reason": ..., "site": ..., "ts": ..., "context": {...},
+     "trace":   {"summary": ..., "spans": [...recent...]},
+     "events":  {kind: [...recent per-kind ring...], ...},
+     "compiles": {...ledger rollup...},
+     "lockcheck": {"edges": [...], "inversions": [...], "held_now": [...]},
+     "step_report": {...host-gap attribution...},
+     "metrics": {...registry table...},
+     "env": {...MXTPU_/MXNET_/DMLC_/JAX_/XLA_ vars...},
+     "config": {...python/jax/platform...}}
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..lockcheck import make_lock
+
+__all__ = ["enabled", "flight_dir", "set_dir", "bundle", "dump", "load",
+           "list_bundles", "reset"]
+
+_LOCK = make_lock("flight._LOCK")
+_DIR_OVERRIDE: Optional[str] = None
+_STATE = {"count": 0, "last_ts": 0.0}
+
+#: environment prefixes worth preserving in a post-mortem (config that
+#: changes behavior; never the whole environ — tokens/paths leak)
+_ENV_PREFIXES = ("MXTPU_", "MXNET_", "DMLC_", "JAX_", "XLA_")
+
+
+def flight_dir() -> Optional[str]:
+    """The bundle directory (``MXTPU_FLIGHT_DIR``; :func:`set_dir`
+    overrides), or None = recorder off."""
+    if _DIR_OVERRIDE is not None:
+        return _DIR_OVERRIDE or None
+    return os.environ.get("MXTPU_FLIGHT_DIR") or None
+
+
+def set_dir(path: Optional[str]) -> None:
+    """Programmatic override (tests, the chaos drill). ``None`` re-reads
+    the env; ``""`` forces off."""
+    global _DIR_OVERRIDE
+    _DIR_OVERRIDE = path
+
+
+def enabled() -> bool:
+    return flight_dir() is not None
+
+
+def _limits():
+    from ..util import getenv
+    try:
+        mx = int(getenv("MXTPU_FLIGHT_MAX"))
+    except (TypeError, ValueError):
+        mx = 16
+    try:
+        min_s = float(getenv("MXTPU_FLIGHT_MIN_S"))
+    except (TypeError, ValueError):
+        min_s = 0.0
+    return mx, min_s
+
+
+def _span_cap() -> int:
+    from ..util import getenv
+    try:
+        return int(getenv("MXTPU_FLIGHT_SPANS"))
+    except (TypeError, ValueError):
+        return 2048
+
+
+def bundle(reason: str, /, site: Optional[str] = None, **context) -> Dict:
+    """Assemble the post-mortem dict from every in-memory diagnostic
+    surface. Pure read — no I/O, no rate limit — so tests and
+    ``telemetry.snapshot()``-style callers can inspect without writing.
+    Each surface is snapshotted independently: one broken subsystem
+    degrades its own section to an ``{"error": ...}`` stub instead of
+    costing the whole bundle."""
+    from .. import profiler
+    from ..lockcheck import edges, held_now, inversions
+    from . import compile_log, events, metrics, trace
+    from .export import sanitize
+
+    doc: Dict = {"format": 1, "reason": reason, "site": site,
+                 "ts": time.time(),
+                 "pid": os.getpid(),
+                 "thread": threading.current_thread().name,
+                 "context": dict(context)}
+
+    def section(name, fn):
+        try:
+            doc[name] = fn()
+        except Exception as e:  # noqa: BLE001 — degrade, don't lose all
+            doc[name] = {"error": f"{type(e).__name__}: {e}"}
+
+    section("trace", lambda: {"summary": trace.summary(),
+                              "spans": trace.spans()[-_span_cap():]})
+    section("events", lambda: {
+        kind: [e.to_dict() for e in events.events(kind)]
+        for kind in sorted(events.counts())})
+    section("compiles", compile_log.summary)
+    section("lockcheck", lambda: {"edges": sorted(edges()),
+                                  "inversions": sorted(inversions()),
+                                  "held_now": held_now()})
+    section("step_report", lambda: {
+        "step": profiler.step_report("step"),
+        "serve.predict": profiler.step_report("serve.predict")})
+    section("metrics", metrics.to_dict)
+    section("env", lambda: {k: v for k, v in sorted(os.environ.items())
+                            if k.startswith(_ENV_PREFIXES)})
+    section("config", lambda: _config())
+    return sanitize(doc)
+
+
+def _config() -> Dict:
+    import platform
+    cfg = {"python": sys.version.split()[0],
+           "platform": platform.platform()}
+    try:
+        import jax
+        cfg["jax"] = jax.__version__
+        cfg["backend"] = jax.default_backend()
+    except Exception:  # noqa: BLE001 — config is best-effort
+        pass
+    return cfg
+
+
+def dump(reason: str, /, site: Optional[str] = None, **context
+         ) -> Optional[str]:
+    # ``reason`` is positional-only so trigger context may itself carry a
+    # "reason" field (a guard's trip info, a kill reason) without clashing
+    """Write one bundle; returns its path, or None (recorder off, rate
+    limit hit, or the write failed — a warning, never a raise: the dump
+    must not become the second fault that masks the first).
+
+    The write is atomic (tmp + fsync + ``os.replace``) with a chaos
+    crash point ``flight.dump`` between the write and the rename — the
+    harness's simulated mid-dump kill, which must leave no torn bundle
+    under the final name."""
+    d = flight_dir()
+    if d is None:
+        return None
+    max_n, min_s = _limits()
+    now = time.monotonic()
+    with _LOCK:
+        if _STATE["count"] >= max_n:
+            return None
+        if min_s > 0 and _STATE["last_ts"] and \
+                now - _STATE["last_ts"] < min_s:
+            return None
+        _STATE["count"] += 1
+        prev_ts = _STATE["last_ts"]
+        _STATE["last_ts"] = now
+        seq = _STATE["count"]
+    from ..fault import inject as _inject
+    from ..fault.inject import ChaosCrash
+    try:
+        doc = bundle(reason, site=site, **context)
+        from .export import dumps_strict
+        blob = dumps_strict(doc, sort_keys=True)
+        os.makedirs(d, exist_ok=True)
+        stamp = time.strftime("%Y%m%dT%H%M%S", time.gmtime(doc["ts"]))
+        safe = "".join(c if c.isalnum() or c in "-_" else "-"
+                       for c in reason)
+        path = os.path.join(
+            d, f"flight-{stamp}-{safe}-p{os.getpid()}-{seq}.json")
+        tmp = f"{path}.tmp-{os.getpid()}-{threading.get_ident()}"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                f.write(blob + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+            # the simulated mid-dump kill: tmp is on disk, the final
+            # name is not — atomicity means readers never see a torn
+            # bundle however exactly this process dies
+            _inject.crash("flight.dump")
+            os.replace(tmp, path)
+        except ChaosCrash:
+            # the simulated SIGKILL: a real one cannot run cleanup, so
+            # neither does the simulation — the ``.tmp-*`` file stays
+            # behind exactly as the docstring tells operators to expect
+            raise
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+    except ChaosCrash:
+        raise          # the simulated death itself — see the docstring
+    except Exception as e:  # noqa: BLE001 — never mask the first fault
+        # refund the MXTPU_FLIGHT_MAX budget AND the MIN_S window: a
+        # transiently unwritable dir during a crash loop must not eat
+        # the cap — or start a storm-damping window that silences the
+        # very next trigger — when zero bundles exist (the state was
+        # taken before the write so concurrent triggers rate-limit
+        # correctly)
+        with _LOCK:
+            _STATE["count"] -= 1
+            if _STATE["last_ts"] == now:
+                _STATE["last_ts"] = prev_ts
+        import warnings
+        warnings.warn(f"[telemetry.flight] bundle write failed "
+                      f"({reason!r}): {type(e).__name__}: {e}")
+        return None
+    # announce AFTER the bundle exists: the event stream names a path
+    # that is guaranteed readable
+    from . import events as _events
+    from . import metrics as _metrics
+    _events.emit("flight.dump", severity="warning", reason=reason,
+                 site=site, path=path)
+    _metrics.counter("mxtpu_flight_bundles_total",
+                     "Post-mortem bundles written", reason=reason).inc()
+    return path
+
+
+def load(path: str) -> Dict:
+    """Read one bundle back (strict JSON; raises on a torn/invalid file —
+    which, by the atomicity contract, means a bug, not a crash)."""
+    from .export import loads_strict
+    with open(path, encoding="utf-8") as f:
+        doc = loads_strict(f.read())
+    if doc.get("format") != 1:
+        raise ValueError(f"{path}: unknown flight-bundle format "
+                         f"{doc.get('format')!r}")
+    return doc
+
+
+def list_bundles(d: Optional[str] = None) -> List[str]:
+    """Completed bundle paths in ``d`` (default: the active dir), oldest
+    first by name (names embed the UTC stamp)."""
+    d = d or flight_dir()
+    if d is None or not os.path.isdir(d):
+        return []
+    return sorted(os.path.join(d, f) for f in os.listdir(d)
+                  if f.startswith("flight-") and f.endswith(".json"))
+
+
+def reset() -> None:
+    """Reset the per-process storm limiter (tests)."""
+    with _LOCK:
+        _STATE["count"] = 0
+        _STATE["last_ts"] = 0.0

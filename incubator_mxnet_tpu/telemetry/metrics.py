@@ -12,9 +12,14 @@ same series is shared wherever it is requested (Prometheus identity
 semantics), and renders the whole table as
 
 - ``to_dict()``  — JSON-ready nested dict (``telemetry.snapshot()``), and
-- ``prometheus_text()`` — Prometheus text exposition format 0.0.4
-  (the scrape the serve :class:`~incubator_mxnet_tpu.serve.server.Server`
-  answers with ``{"cmd": "prometheus"}``).
+- ``prometheus_text()`` — Prometheus text exposition: strict 0.0.4 by
+  default (the scrape the serve
+  :class:`~incubator_mxnet_tpu.serve.server.Server` answers with
+  ``{"cmd": "prometheus"}``); ``exemplars=True`` opts into the
+  OpenMetrics exposition, where each traced histogram gains a companion
+  ``<name>_observations_total`` counter sample carrying the trace-id
+  exemplar (the only sample type OpenMetrics lets an exemplar ride —
+  the Server's ``{"format": "openmetrics"}`` wire command opts in).
 
 Counters are monotonic for Prometheus sanity; per-window views belong to
 the owning subsystem's snapshot (e.g. ``ServeMetrics.reset`` resets its
@@ -22,12 +27,14 @@ window, not the registry series).
 """
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as onp
 
 from ..lockcheck import make_lock
 from ..util import nearest_rank_percentile
+from . import trace as _trace
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
            "counter", "gauge", "histogram", "prometheus_text", "to_dict"]
@@ -44,6 +51,30 @@ def _escape_label_value(v: str) -> str:
     must not make the whole scrape unparseable."""
     return (v.replace("\\", "\\\\").replace('"', '\\"')
             .replace("\n", "\\n"))
+
+
+#: seconds after which the windowed "max" exemplar is considered stale
+#: and replaced by the next traced observation — an all-time max would
+#: point every scrape at a trace long gone from the span ring
+EXEMPLAR_MAX_AGE_S = 60.0
+
+
+def _exemplar_str(value: float, trace_id: str, ts: float) -> str:
+    """OpenMetrics exemplar suffix: `` # {trace_id="..."} value ts`` —
+    the link from a scraped series point to an actual recorded trace."""
+    return (f' # {{trace_id="{_escape_label_value(trace_id)}"}} '
+            f"{repr(value)} {round(ts, 3)}")
+
+
+def om_family(name: str, kind: str) -> str:
+    """The OpenMetrics metric-FAMILY name for a series: counter families
+    are declared without the ``_total`` suffix their samples carry
+    (``# TYPE x counter`` + sample ``x_total``); every other kind keeps
+    its name. Shared by every exemplar-mode renderer so the convention
+    cannot drift between them."""
+    if kind == "counter" and name.endswith("_total"):
+        return name[:-len("_total")]
+    return name
 
 
 def _labels_str(labels: Tuple) -> str:
@@ -145,9 +176,16 @@ class Histogram:
             self._min = float("inf")
             self._max = float("-inf")
             self._rng = onp.random.RandomState(self._seed)
+            #: Prometheus exemplars: {"last"|"max": (value, trace_id, ts)}
+            #: — recorded when a SAMPLED distributed-trace context is
+            #: active at observe() time, so a p99 spike on the scrape
+            #: links to an actual trace (OpenMetrics exemplar syntax in
+            #: prometheus_text)
+            self._exemplars: Dict[str, Tuple[float, str, float]] = {}
 
     def observe(self, value: float) -> None:
         v = float(value)
+        ctx = _trace.current()   # outside the lock: two TLS reads
         with self._lock:
             self._seen += 1
             self._total += v
@@ -159,6 +197,31 @@ class Histogram:
                 j = int(self._rng.randint(0, self._seen))
                 if j < self.reservoir:
                     self._samples[j] = v
+            if ctx is not None and ctx.sampled:
+                ts = time.time()
+                self._exemplars["last"] = (v, ctx.trace_id, ts)
+                mx = self._exemplars.get("max")
+                # the max exemplar is WINDOWED: an all-time max would
+                # pin a cold-start outlier's trace id on every future
+                # scrape long after that trace aged out of the ring —
+                # a stale window restarts from the current observation
+                if (mx is None or v >= mx[0]
+                        or ts - mx[2] > EXEMPLAR_MAX_AGE_S):
+                    self._exemplars["max"] = (v, ctx.trace_id, ts)
+
+    def exemplars(self) -> Dict[str, Tuple[float, str, float]]:
+        """The recorded trace exemplars (``{"last"|"max": (value,
+        trace_id, ts)}``; empty when no traced observation happened)."""
+        with self._lock:
+            return dict(self._exemplars)
+
+    def reservoir_snapshot(self) -> Tuple[int, List[float]]:
+        """Consistent ``(seen, samples)`` read of the reservoir: the
+        total observation count and a copy of the current sample set,
+        taken under one lock so cross-module consumers (SLO latency
+        evaluation) never see a torn count/samples pair."""
+        with self._lock:
+            return self._seen, list(self._samples)
 
     # -- summaries ------------------------------------------------------
     @property
@@ -250,10 +313,26 @@ class MetricsRegistry:
                         else inst.value)
         return out
 
-    def prometheus_text(self) -> str:
-        """Prometheus text exposition (format 0.0.4). Histograms render as
-        summaries (quantile series + _count/_sum) — the host-side reservoir
-        has true quantiles, which beat lossy fixed buckets."""
+    def prometheus_text(self, exemplars: bool = False) -> str:
+        """Prometheus text exposition. Histograms render as summaries
+        (quantile series + _count/_sum) — the host-side reservoir has
+        true quantiles, which beat lossy fixed buckets.
+
+        The default is strict 0.0.4: the classic text format rejects
+        ANYTHING after the value except a numeric timestamp, so the
+        zero-argument call always yields what a scrape endpoint
+        advertising ``text/plain; version=0.0.4`` must serve.
+
+        ``exemplars=True`` opts into the OpenMetrics exposition: each
+        traced histogram gains a companion ``<name>_observations``
+        counter whose ``_total`` sample carries the exemplar suffix
+        (`` # {trace_id="..."} v ts``) for the WORST traced observation
+        — "this p99 spike IS trace <id>". The exemplar rides a counter
+        sample because OpenMetrics permits exemplars only on counter and
+        histogram-bucket samples, never on the summary quantile/_count
+        lines the histogram itself renders as (the Server's
+        ``{"format": "openmetrics"}`` wire command opts in; its default
+        scrape stays 0.0.4)."""
         by_name: Dict[str, List] = {}
         for inst in self.instruments():
             by_name.setdefault(inst.name, []).append(inst)
@@ -262,9 +341,14 @@ class MetricsRegistry:
             insts = by_name[name]
             kind = ("summary" if isinstance(insts[0], Histogram)
                     else insts[0].kind)
+            # OpenMetrics names the counter FAMILY without the _total
+            # suffix its samples carry; 0.0.4 conventionally types the
+            # sample name itself
+            family = om_family(name, kind) if exemplars else name
             if insts[0].help:
-                lines.append(f"# HELP {name} {insts[0].help}")
-            lines.append(f"# TYPE {name} {kind}")
+                lines.append(f"# HELP {family} {insts[0].help}")
+            lines.append(f"# TYPE {family} {kind}")
+            exemplar_lines: List[str] = []
             for inst in insts:
                 if isinstance(inst, Histogram):
                     base = dict(inst.labels)
@@ -278,9 +362,22 @@ class MetricsRegistry:
                     ls = _labels_str(inst.labels)
                     lines.append(f"{name}_count{ls} {s['count']}")
                     lines.append(f"{name}_sum{ls} {repr(s['total'])}")
+                    # OpenMetrics forbids exemplars on summary samples;
+                    # a companion counter's _total sample is the legal
+                    # carrier for the worst traced observation — "this
+                    # p99 spike IS trace <id>"
+                    ex = inst.exemplars() if exemplars else {}
+                    pick = ex.get("max") or ex.get("last")
+                    if pick is not None:
+                        exemplar_lines.append(
+                            f"{name}_observations_total{ls} {s['count']}"
+                            + _exemplar_str(*pick))
                 else:
                     ls = _labels_str(inst.labels)
                     lines.append(f"{name}{ls} {repr(inst.value)}")
+            if exemplar_lines:
+                lines.append(f"# TYPE {name}_observations counter")
+                lines.extend(exemplar_lines)
         return "\n".join(lines) + "\n"
 
 
@@ -302,8 +399,8 @@ def histogram(name: str, help: str = "", q=(50, 95, 99),
                               **labels)
 
 
-def prometheus_text() -> str:
-    return REGISTRY.prometheus_text()
+def prometheus_text(exemplars: bool = False) -> str:
+    return REGISTRY.prometheus_text(exemplars=exemplars)
 
 
 def to_dict() -> Dict:

@@ -1,0 +1,353 @@
+"""SLO specs + multi-window burn-rate monitoring over the metrics registry.
+
+Reference counterpart: none — the reference had no metrics plane, let
+alone objectives on top of one. This repo's registry (PR 4) already
+carries everything an on-call needs — serve latency histograms, shed and
+failover counters, train step timing — but raw series answer "what is
+the p99 *now*", not "are we eating the month's error budget *fast
+enough to page*". This module closes that gap the SRE-workbook way:
+
+- an :class:`SLO` is a **declarative spec** naming registry series — a
+  good/bad counter ratio (shed rate, failover rate) or a latency
+  histogram + threshold (serve p99, train step budget) — plus an
+  objective (e.g. 0.99 = at most 1% bad);
+- an :class:`SLOMonitor` samples the cumulative series on every
+  :meth:`~SLOMonitor.evaluate` call, keeps a time-stamped history ring,
+  and computes the **burn rate** over multiple windows: burn 1.0 means
+  "exactly spending the budget", 14.4 over an hour means "the 30-day
+  budget is gone in 2 days" (the classic page threshold);
+- a breach — every window over its threshold at once, the multi-window
+  AND that suppresses both stale and blip alerts — emits an
+  ``slo.burn`` event (severity ``error``) and flips the
+  ``mxtpu_slo_breach`` gauge; every evaluation refreshes the
+  ``mxtpu_slo_burn_rate`` gauges, so the scrape shows burn trajectory
+  continuously, not only at alert time.
+
+``serve_bench`` and the chaos drill consult :meth:`SLOMonitor.gate` as
+a pass/fail gate: an HA drill that "recovers" while silently shedding
+10% of traffic fails its availability SLO even though every individual
+assertion passed.
+
+Latency SLOs are reservoir-estimated: the histogram keeps a uniform
+sample of the full stream, so "bad" (above-threshold) counts are
+``seen x above-threshold reservoir fraction`` — exact for the ratio the
+alert needs, without per-request threshold counters on the hot path.
+
+Short histories degrade gracefully: a window longer than the recorded
+history evaluates over what exists (a bench's 10-second run can still
+gate on its 60-second window spec), and one-sample histories burn 0.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..lockcheck import make_lock
+
+__all__ = ["SLO", "SLOMonitor", "default_slos", "default_windows"]
+
+
+def default_windows() -> Tuple[Tuple[float, float], ...]:
+    """``MXTPU_SLO_WINDOWS`` (``"sec:burn,sec:burn"``; default
+    ``60:14.4,300:6`` — scaled-down analogues of the workbook's
+    1h/6h pair for jobs that live minutes, not months)."""
+    from ..util import getenv
+    spec = getenv("MXTPU_SLO_WINDOWS") or "60:14.4,300:6"
+    out = []
+    try:
+        for part in spec.split(","):
+            w, b = part.strip().split(":")
+            out.append((float(w), float(b)))
+    except ValueError as e:
+        raise ValueError(f"bad MXTPU_SLO_WINDOWS {spec!r}: {e}") from e
+    return tuple(out)
+
+
+class SLO:
+    """One objective over registry series.
+
+    ``kind="ratio"``: ``bad``/``total`` name cumulative counters (str or
+    sequence of str; values are summed across every labelset of each
+    name). Bad fraction over a window = Δbad / Δtotal.
+
+    ``kind="latency"``: ``series`` names a histogram; a sample above
+    ``threshold_ms`` is bad. ``total`` defaults to the histogram's own
+    count.
+
+    ``objective`` is the good-fraction target (0.99 = 1% error budget).
+    ``windows`` overrides :func:`default_windows` per SLO.
+    """
+
+    def __init__(self, name: str, objective: float, kind: str = "ratio",
+                 bad: Sequence[str] = (), total: Sequence[str] = (),
+                 series: Optional[str] = None,
+                 threshold_ms: Optional[float] = None,
+                 windows: Optional[Sequence[Tuple[float, float]]] = None,
+                 description: str = ""):
+        if kind not in ("ratio", "latency"):
+            raise ValueError(f"unknown SLO kind {kind!r}")
+        if not 0.0 < objective < 1.0:
+            raise ValueError(f"objective must be in (0, 1), got {objective}")
+        if kind == "latency" and (series is None or threshold_ms is None):
+            raise ValueError("latency SLO needs series= and threshold_ms=")
+        if kind == "ratio" and (not bad or not total):
+            raise ValueError("ratio SLO needs bad= and total= series")
+        self.name = name
+        self.objective = float(objective)
+        self.kind = kind
+        self.bad = (bad,) if isinstance(bad, str) else tuple(bad)
+        self.total = (total,) if isinstance(total, str) else tuple(total)
+        self.series = series
+        self.threshold_ms = threshold_ms
+        self.windows = tuple(windows) if windows is not None else None
+        self.description = description
+
+    @property
+    def budget(self) -> float:
+        """The error budget: the bad fraction the objective tolerates."""
+        return 1.0 - self.objective
+
+    # -- cumulative (bad, total) sampling --------------------------------
+    def sample(self, registry) -> Tuple[float, float]:
+        """Current cumulative ``(bad, total)`` from the registry. Pure
+        read; missing series read as 0 (a job that never registered the
+        serve tier simply has no serve traffic)."""
+        if self.kind == "ratio":
+            return (self._sum(registry, self.bad),
+                    self._sum(registry, self.total))
+        bad = total = 0.0
+        for inst in registry.instruments():
+            if inst.name != self.series or inst.kind != "histogram":
+                continue
+            seen, samples = inst.reservoir_snapshot()
+            if not samples:
+                continue
+            frac = (sum(1 for s in samples if s > self.threshold_ms)
+                    / len(samples))
+            bad += seen * frac
+            total += seen
+        return bad, total
+
+    @staticmethod
+    def _sum(registry, names: Tuple[str, ...]) -> float:
+        tot = 0.0
+        for inst in registry.instruments():
+            if inst.name in names and inst.kind in ("counter", "gauge"):
+                tot += inst.value
+        return tot
+
+    def __repr__(self):
+        tgt = (f"p under {self.threshold_ms}ms" if self.kind == "latency"
+               else f"{'+'.join(self.bad)}/{'+'.join(self.total)}")
+        return f"SLO({self.name!r}, {self.objective:g} of {tgt})"
+
+
+class SLOMonitor:
+    """Evaluates a set of :class:`SLO`\\ s against the live registry.
+
+    Call :meth:`evaluate` on a cadence (the serve bench calls it per
+    progress tick; a trainer can hang it off the step loop or
+    :meth:`start` a background thread). Every call appends one
+    cumulative sample per SLO to a bounded history ring and recomputes
+    windowed burn rates from the deltas — the registry itself stays
+    cumulative and monotonic.
+    """
+
+    def __init__(self, slos: Optional[Sequence[SLO]] = None,
+                 registry=None):
+        from . import metrics as _metrics
+        self.registry = registry if registry is not None \
+            else _metrics.REGISTRY
+        self.slos: List[SLO] = list(default_slos() if slos is None
+                                    else slos)
+        self._lock = make_lock("SLOMonitor._lock")
+        #: per-SLO history of (mono_ts, bad, total), oldest first
+        self._hist: Dict[str, List[Tuple[float, float, float]]] = {}
+        self._last: Dict[str, Dict] = {}
+        self._default_windows = default_windows()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- evaluation ------------------------------------------------------
+    def evaluate(self, now: Optional[float] = None) -> List[Dict]:
+        """Sample every SLO, compute per-window burn rates, emit alerts.
+
+        Returns one report dict per SLO: ``{slo, objective, kind,
+        bad, total, bad_fraction, budget, burn (per window), breach,
+        budget_remaining}``. Breaches emit ``slo.burn`` (severity
+        ``error``); recoveries emit it once at ``info``."""
+        from . import events as _events
+        from . import metrics as _metrics
+        now = time.monotonic() if now is None else now
+        reports = []
+        for slo in self.slos:
+            bad, total = slo.sample(self.registry)
+            windows = slo.windows or self._default_windows
+            with self._lock:
+                hist = self._hist.setdefault(slo.name, [])
+                hist.append((now, bad, total))
+                # keep just past the longest window (plus slack for
+                # uneven cadences)
+                horizon = now - max(w for w, _ in windows) * 2
+                while len(hist) > 2 and hist[1][0] <= horizon:
+                    hist.pop(0)
+                snap = list(hist)
+                prev_breach = self._last.get(slo.name, {}).get("breach",
+                                                              False)
+            burns = {}
+            breach = bool(windows)
+            for win_s, threshold in windows:
+                burn = self._burn(snap, now - win_s, slo.budget)
+                burns[f"{win_s:g}s"] = {"burn": round(burn, 4),
+                                        "threshold": threshold,
+                                        "over": burn > threshold}
+                if not burn > threshold:
+                    breach = False
+            frac = bad / total if total else 0.0
+            rep = {"slo": slo.name, "kind": slo.kind,
+                   "objective": slo.objective,
+                   "description": slo.description,
+                   "bad": round(bad, 3), "total": round(total, 3),
+                   "bad_fraction": round(frac, 6),
+                   "budget": round(slo.budget, 6),
+                   "budget_remaining": round(1.0 - frac / slo.budget, 4)
+                   if slo.budget else None,
+                   "burn": burns, "breach": breach}
+            if slo.kind == "latency":
+                rep["threshold_ms"] = slo.threshold_ms
+            reports.append(rep)
+            with self._lock:
+                self._last[slo.name] = rep
+            # gauges refresh every evaluation — burn trajectory is a
+            # scrapeable series, not only an alert-time artifact
+            for wname, b in burns.items():
+                self.registry.gauge(
+                    "mxtpu_slo_burn_rate",
+                    "SLO error-budget burn rate per window",
+                    slo=slo.name, window=wname).set(b["burn"])
+            self.registry.gauge(
+                "mxtpu_slo_breach",
+                "1 while the SLO's multi-window burn alert "
+                "is firing", slo=slo.name).set(float(breach))
+            self.registry.gauge(
+                "mxtpu_slo_bad_fraction",
+                "Cumulative bad-event fraction",
+                slo=slo.name).set(frac)
+            if breach:
+                _events.emit("slo.burn", severity="error", slo=slo.name,
+                             objective=slo.objective,
+                             bad_fraction=round(frac, 6), burn=burns)
+            elif prev_breach:
+                _events.emit("slo.burn", severity="info", slo=slo.name,
+                             recovered=True, burn=burns)
+        return reports
+
+    @staticmethod
+    def _burn(hist: List[Tuple[float, float, float]], t_from: float,
+              budget: float) -> float:
+        """Burn rate over [t_from, newest]: windowed bad fraction divided
+        by the budget. The window anchor is the newest sample at or
+        before ``t_from`` (falling back to the oldest recorded — a short
+        history evaluates over what exists)."""
+        if len(hist) < 2 or budget <= 0:
+            return 0.0
+        anchor = hist[0]
+        for ent in hist:
+            if ent[0] <= t_from:
+                anchor = ent
+            else:
+                break
+        t1, bad1, total1 = hist[-1]
+        _, bad0, total0 = anchor
+        dt_total = total1 - total0
+        if dt_total <= 0:
+            return 0.0
+        frac = max(0.0, bad1 - bad0) / dt_total
+        return frac / budget
+
+    def report(self) -> Dict:
+        """Latest evaluation per SLO (empty before the first
+        :meth:`evaluate`)."""
+        with self._lock:
+            return {name: dict(rep) for name, rep in self._last.items()}
+
+    def gate(self) -> Tuple[bool, Dict]:
+        """(ok, report) — the serve_bench / chaos-drill pass/fail hook.
+        Runs one fresh evaluation; ok is False when any SLO breaches."""
+        reports = self.evaluate()
+        return (not any(r["breach"] for r in reports),
+                {r["slo"]: r for r in reports})
+
+    # -- optional background cadence -------------------------------------
+    def start(self, period_s: float = 10.0) -> "SLOMonitor":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(period_s):
+                try:
+                    self.evaluate()
+                except Exception:  # noqa: BLE001 — the monitor must not
+                    pass           # take down what it watches
+
+        self._thread = threading.Thread(target=loop, name="mx-slo-monitor",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._thread.join(timeout=2)
+        self._thread = None
+
+
+def default_slos() -> List[SLO]:
+    """The built-in objectives for the train+serve tier. Thresholds come
+    from ``MXTPU_SLO_SERVE_P99_MS`` / ``MXTPU_SLO_STEP_MS`` /
+    ``MXTPU_SLO_OBJECTIVE`` so a deployment tunes numbers, not code."""
+    from ..util import getenv
+
+    def _f(name, fallback):
+        try:
+            return float(getenv(name))
+        except (TypeError, ValueError):
+            return fallback
+
+    obj = min(0.99999, max(0.5, _f("MXTPU_SLO_OBJECTIVE", 0.99)))
+    return [
+        SLO("serve-latency", objective=obj, kind="latency",
+            series="mxtpu_serve_latency_ms",
+            threshold_ms=_f("MXTPU_SLO_SERVE_P99_MS", 250.0),
+            description="serve requests complete under the latency "
+                        "threshold"),
+        # USER-VISIBLE outcomes only: the router's terminal counters.
+        # Replica-level rejects/failed batches (mxtpu_serve_*) are NOT
+        # bad here — a queue-full bounce or chaos-killed batch that the
+        # router's failover completes was never visible to the caller,
+        # and counting it would breach this SLO on a run with zero lost
+        # requests. Single-process (router-less) tiers surface those
+        # same events AS the caller-visible error, but emit no router
+        # series — the availability SLO is a statement about the tier
+        # that owns admission.
+        SLO("serve-availability", objective=obj, kind="ratio",
+            bad=("mxtpu_router_sheds_total",
+                 "mxtpu_router_deadline_exceeded_total",
+                 "mxtpu_router_failed_total"),
+            # requests_total counts every arrival pre-admission, so it
+            # already contains the bad outcomes — a true fraction
+            total=("mxtpu_router_requests_total",),
+            description="requests neither shed, failed, nor timed out"),
+        SLO("serve-failover-rate", objective=obj, kind="ratio",
+            bad=("mxtpu_router_failovers_total",),
+            total=("mxtpu_router_requests_total",),
+            description="requests served without a failover retry"),
+        SLO("train-step-time", objective=obj, kind="latency",
+            series="mxtpu_train_step_ms",
+            threshold_ms=_f("MXTPU_SLO_STEP_MS", 60000.0),
+            description="training steps complete inside the step-time "
+                        "budget"),
+    ]

@@ -1,0 +1,415 @@
+"""Distributed tracing — W3C-style trace/span context for the whole tier.
+
+Reference counterpart: none — the reference correlated nothing across its
+process boundaries (ps-lite hops, the MMS frontend) and debugging a slow
+request meant grepping per-process logs. PRs 4/6 gave this repo a
+correlated event bus and a span-recording profiler, but correlation still
+dies at every boundary: the router's failover/hedge attempts, the TCP
+front end, and the kvstore client→PS-server hop each emit events that
+cannot be stitched into one causal story. This module closes that gap the
+way OpenTelemetry does: a ``(trace_id, span_id, sampled)`` context rides
+a thread-local stack, child spans record their parent, and the context
+crosses the wire as a small JSON object — so one sampled request renders
+as ONE rooted span tree: request → router attempt → replica batcher →
+CompiledModel pad/compute/unpad, hedges as sibling attempts under one
+parent (the PyGraph position — attribute overhead AT the boundary —
+applied to cross-process boundaries instead of graph launches).
+
+Mechanics:
+
+- :func:`span` opens a child of the current context (or a NEW trace when
+  none is active); :func:`use` activates a carried context (a wire hop, a
+  batcher worker resuming a request's context) without recording a span.
+- **Head sampling**: the root draw (``MXTPU_TRACE_SAMPLE``, default 0.1)
+  decides once per trace; unsampled traces still propagate ids (cheap —
+  no ring writes anywhere downstream), so always-on tracing costs two
+  thread-local reads per span on the unsampled path — the serve_bench
+  tracing-overhead gate holds the p50 tax at the default rate under 3%.
+  CI's trace-smoke job sets 1.0 so the stitching gate sees every
+  request.
+- Completed spans land in one bounded process ring
+  (``MXTPU_TRACE_RING``); :func:`export.otel_spans` renders it, and
+  :func:`tree`/:func:`orphans` stitch it — the ``trace-smoke`` CI gate is
+  "every sampled request yields a single rooted tree, zero orphans".
+- The event bus stamps every event with the active context, and
+  ``profiler.Scope``/``Frame`` open trace spans when a sampled context is
+  active, so the profiler's wall-time story and the trace tree are one
+  structure (``SpanRecord.trace`` carries the ids into chrome_trace).
+"""
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..lockcheck import make_lock
+
+__all__ = ["SpanContext", "Span", "current", "start_span", "span", "use",
+           "to_wire", "from_wire", "spans", "clear", "trace_ids", "tree",
+           "orphans", "sample_rate", "set_sample_rate", "summary"]
+
+#: wall-clock anchor shared with the profiler's idea of "one clock":
+#: every span timestamp is _EPOCH + perf_counter(), so trace spans and
+#: profiler spans compare exactly on a merged timeline
+_EPOCH = time.time() - time.perf_counter()
+
+_TLS = threading.local()        # .stack: [SpanContext, ...]; .ids: counter
+
+_LOCK = make_lock("trace._LOCK")
+_RING: Optional[list] = None    # built lazily (deque) — see _ring()
+_SAMPLE_OVERRIDE: Optional[float] = None
+_RATE_CACHE: Optional[float] = None
+_current_request = _current_step = None  # lazy events accessors (cycle)
+
+
+class SpanContext(tuple):
+    """Immutable ``(trace_id, span_id, sampled)`` — the propagated unit.
+    A tuple subclass so contexts are hashable, comparable, and free to
+    copy across threads."""
+
+    __slots__ = ()
+
+    def __new__(cls, trace_id: str, span_id: str, sampled: bool):
+        return tuple.__new__(cls, (trace_id, span_id, bool(sampled)))
+
+    @property
+    def trace_id(self) -> str:
+        return self[0]
+
+    @property
+    def span_id(self) -> str:
+        return self[1]
+
+    @property
+    def sampled(self) -> bool:
+        return self[2]
+
+    def __repr__(self):
+        bit = "sampled" if self.sampled else "unsampled"
+        return f"SpanContext({self.trace_id}/{self.span_id}, {bit})"
+
+
+# -- id generation (hot path: no os.urandom per span) ------------------------
+def _next_span_id() -> str:
+    """64-bit hex span id: a per-thread random base + counter, so ids are
+    unique across threads without a syscall or lock per span."""
+    base = getattr(_TLS, "id_base", None)
+    if base is None:
+        base = _TLS.id_base = int.from_bytes(os.urandom(8), "big") or 1
+        _TLS.id_n = 0
+    _TLS.id_n += 1
+    return format((base + _TLS.id_n) & 0xFFFFFFFFFFFFFFFF, "016x")
+
+
+def _new_trace_id() -> str:
+    return os.urandom(16).hex()
+
+
+# -- sampling ----------------------------------------------------------------
+def sample_rate() -> float:
+    """Head-sampling probability for NEW traces (``MXTPU_TRACE_SAMPLE``,
+    cached; :func:`set_sample_rate` overrides)."""
+    global _RATE_CACHE
+    if _SAMPLE_OVERRIDE is not None:
+        return _SAMPLE_OVERRIDE
+    if _RATE_CACHE is None:
+        from ..util import getenv
+        try:
+            _RATE_CACHE = min(1.0, max(0.0,
+                                       float(getenv("MXTPU_TRACE_SAMPLE"))))
+        except (TypeError, ValueError):
+            _RATE_CACHE = 0.1
+    return _RATE_CACHE
+
+
+def set_sample_rate(rate: Optional[float]) -> None:
+    """Programmatic override (``None`` re-reads the env) — tests and the
+    serve_bench tracing-overhead A/B use this."""
+    global _SAMPLE_OVERRIDE, _RATE_CACHE
+    _SAMPLE_OVERRIDE = None if rate is None else min(1.0, max(0.0,
+                                                              float(rate)))
+    _RATE_CACHE = None
+
+
+def _draw_sampled() -> bool:
+    rate = sample_rate()
+    if rate >= 1.0:
+        return True
+    if rate <= 0.0:
+        return False
+    return random.random() < rate
+
+
+# -- the ring ----------------------------------------------------------------
+def _ring():
+    global _RING
+    if _RING is None:
+        with _LOCK:
+            if _RING is None:
+                from collections import deque
+                from ..util import getenv
+                try:
+                    cap = int(getenv("MXTPU_TRACE_RING"))
+                except (TypeError, ValueError):
+                    cap = 65536
+                _RING = deque(maxlen=max(cap, 16))
+    return _RING
+
+
+# -- context stack -----------------------------------------------------------
+def _stack() -> list:
+    st = getattr(_TLS, "stack", None)
+    if st is None:
+        st = _TLS.stack = []
+    return st
+
+
+def current() -> Optional[SpanContext]:
+    """The active span context on this thread (None = no trace)."""
+    st = getattr(_TLS, "stack", None)
+    return st[-1] if st else None
+
+
+def _push(ctx: SpanContext) -> None:
+    _stack().append(ctx)
+
+
+def _pop(ctx: SpanContext) -> None:
+    st = _stack()
+    if st and st[-1] is ctx:
+        st.pop()
+    elif ctx in st:          # exotic unwind order: remove the right entry
+        st.remove(ctx)
+
+
+class Span:
+    """One in-flight span. Create via :func:`start_span` (manual finish —
+    the batcher holds a request's span across threads) or :func:`span`
+    (scoped). ``finish`` is idempotent; unsampled spans skip the ring."""
+
+    __slots__ = ("ctx", "parent_id", "name", "kind", "attrs", "_t0",
+                 "_done")
+
+    def __init__(self, ctx: SpanContext, parent_id: Optional[str],
+                 name: str, kind: str, attrs: Dict):
+        self.ctx = ctx
+        self.parent_id = parent_id
+        self.name = name
+        self.kind = kind
+        self.attrs = attrs
+        self._t0 = time.perf_counter()
+        self._done = False
+
+    def finish(self, **attrs) -> None:
+        if self._done:
+            return
+        self._done = True
+        if not self.ctx.sampled:
+            return
+        if attrs:
+            self.attrs.update(attrs)
+        rec = {"trace_id": self.ctx.trace_id,
+               "span_id": self.ctx.span_id,
+               "parent_id": self.parent_id,
+               "name": self.name, "kind": self.kind,
+               "ts": _EPOCH + self._t0,
+               "dur_ms": round((time.perf_counter() - self._t0) * 1e3, 4),
+               "thread": threading.current_thread().name}
+        # step/request correlation rides on the span like on every event
+        # (module-global accessors: finish() is the sampled hot path)
+        global _current_request, _current_step
+        if _current_step is None:
+            from .events import current_request, current_step
+            _current_request, _current_step = current_request, current_step
+        current_request, current_step = _current_request, _current_step
+        step = self.attrs.pop("step", None)
+        if step is None:
+            step = current_step()
+        if step is not None:
+            rec["step"] = step
+        rid = current_request()
+        if rid is not None:
+            rec["request_id"] = rid
+        if self.attrs:
+            rec["attrs"] = dict(self.attrs)
+        ring = _ring()   # resolved OUTSIDE the lock (_ring takes it too)
+        with _LOCK:
+            ring.append(rec)
+
+    def __repr__(self):
+        state = "open" if not self._done else "finished"
+        return f"Span({self.name!r}, {self.ctx.span_id}, {state})"
+
+
+def start_span(name: str, kind: str = "internal",
+               parent: Optional[SpanContext] = None, **attrs) -> Span:
+    """Open one span WITHOUT activating it (caller owns ``finish()``).
+    ``parent`` defaults to the thread's current context; with neither, a
+    new trace starts and the head-sampling draw happens here."""
+    if parent is None:
+        parent = current()
+    if parent is None:
+        ctx = SpanContext(_new_trace_id(), _next_span_id(), _draw_sampled())
+        parent_id = None
+    else:
+        ctx = SpanContext(parent.trace_id, _next_span_id(), parent.sampled)
+        parent_id = parent.span_id
+    return Span(ctx, parent_id, name, kind, attrs)
+
+
+class span:
+    """Scoped span: opens a child of the current context, activates it
+    for the block, records it on exit (an exception lands in ``attrs``)::
+
+        with trace.span("router.request", model=name) as sp:
+            ...  # events + nested profiler scopes join sp's trace
+    """
+
+    def __init__(self, name: str, kind: str = "internal",
+                 parent: Optional[SpanContext] = None, **attrs):
+        self._sp = start_span(name, kind=kind, parent=parent, **attrs)
+
+    def __enter__(self) -> Span:
+        _push(self._sp.ctx)
+        return self._sp
+
+    def __exit__(self, exc_type, exc, tb):
+        _pop(self._sp.ctx)
+        if exc_type is not None:
+            self._sp.finish(error=exc_type.__name__)
+        else:
+            self._sp.finish()
+
+
+class use:
+    """Activate a carried context (wire hop, cross-thread resume) for the
+    block — no span is recorded, children parent under it. A ``None``
+    context is a no-op, so call sites need no conditional."""
+
+    def __init__(self, ctx: Optional[SpanContext]):
+        self._ctx = ctx
+
+    def __enter__(self):
+        if self._ctx is not None:
+            _push(self._ctx)
+        return self._ctx
+
+    def __exit__(self, *exc):
+        if self._ctx is not None:
+            _pop(self._ctx)
+
+
+# -- wire form ---------------------------------------------------------------
+def to_wire(ctx: Optional[SpanContext] = None) -> Optional[Dict]:
+    """The JSON-safe carried form (``None`` when no context is active) —
+    the TCP front end's optional ``trace`` field and the kvstore message
+    meta both carry exactly this."""
+    if ctx is None:
+        ctx = current()
+    if ctx is None:
+        return None
+    return {"trace_id": ctx.trace_id, "span_id": ctx.span_id,
+            "sampled": ctx.sampled}
+
+
+def from_wire(obj) -> Optional[SpanContext]:
+    """Parse a carried context; malformed input yields None (a bad peer
+    must degrade to an untraced request, never an error)."""
+    if not isinstance(obj, dict):
+        return None
+    tid, sid = obj.get("trace_id"), obj.get("span_id")
+    if not (isinstance(tid, str) and isinstance(sid, str) and tid and sid):
+        return None
+    return SpanContext(tid, sid, bool(obj.get("sampled", True)))
+
+
+# -- inspection / stitching --------------------------------------------------
+def spans(trace_id: Optional[str] = None) -> List[Dict]:
+    """Completed span records, oldest first (bounded ring)."""
+    ring = _ring()
+    with _LOCK:
+        out = list(ring)
+    if trace_id is not None:
+        out = [r for r in out if r["trace_id"] == trace_id]
+    return out
+
+
+def clear() -> None:
+    ring = _RING
+    if ring is not None:
+        with _LOCK:
+            ring.clear()
+
+
+def trace_ids() -> List[str]:
+    """Distinct trace ids in the ring, in first-seen order."""
+    seen, out = set(), []
+    for r in spans():
+        t = r["trace_id"]
+        if t not in seen:
+            seen.add(t)
+            out.append(t)
+    return out
+
+
+def tree(trace_id: str) -> Optional[Dict]:
+    """Stitch one trace into a nested dict ``{span, children: [...]}``.
+    Returns None for an unknown trace; raises nothing on malformed data —
+    orphans (parent missing from the ring) are surfaced by
+    :func:`orphans`, not silently grafted."""
+    recs = spans(trace_id)
+    if not recs:
+        return None
+    by_id = {r["span_id"]: {"span": r, "children": []} for r in recs}
+    roots = []
+    for r in recs:
+        node = by_id[r["span_id"]]
+        pid = r.get("parent_id")
+        if pid and pid in by_id:
+            by_id[pid]["children"].append(node)
+        else:
+            roots.append(node)
+    if len(roots) == 1:
+        return roots[0]
+    return {"span": {"trace_id": trace_id, "name": "<forest>",
+                     "roots": len(roots)},
+            "children": roots}
+
+
+def orphans(records: Optional[List[Dict]] = None) -> List[Dict]:
+    """Spans whose ``parent_id`` is set but absent from their trace — the
+    stitching failures the rooted-trace CI gate counts. A span parented
+    on a still-open (never-finished) span is an orphan too: an
+    un-finished parent is exactly the evidence loss the gate exists to
+    catch."""
+    if records is None:
+        records = spans()
+    by_trace: Dict[str, set] = {}
+    for r in records:
+        by_trace.setdefault(r["trace_id"], set()).add(r["span_id"])
+    return [r for r in records
+            if r.get("parent_id")
+            and r["parent_id"] not in by_trace[r["trace_id"]]]
+
+
+def summary() -> Dict:
+    """One-line stitching health: span/trace/root/orphan counts — inlined
+    into ``telemetry.snapshot()`` and the flight-recorder bundle. One
+    ring copy + one id-set pass (snapshot is polled over the wire, and
+    the ring can hold 64Ki spans — :func:`orphans` would rebuild the
+    same per-trace sets a second time)."""
+    recs = spans()
+    roots = 0
+    by_trace: Dict[str, set] = {}
+    for r in recs:
+        by_trace.setdefault(r["trace_id"], set()).add(r["span_id"])
+        if not r.get("parent_id"):
+            roots += 1
+    orphan_n = sum(1 for r in recs
+                   if r.get("parent_id")
+                   and r["parent_id"] not in by_trace[r["trace_id"]])
+    return {"spans": len(recs), "traces": len(by_trace),
+            "roots": roots, "orphans": orphan_n,
+            "sample_rate": sample_rate()}

@@ -132,6 +132,43 @@ ENV_VARS: Dict[str, tuple] = {
                                   "inversion; expiry raises "
                                   "LockOrderError instead of "
                                   "deadlocking the process."),
+    "MXTPU_TRACE_SAMPLE": ("0.1", "Head-sampling probability for NEW "
+                           "distributed traces (0..1). Unsampled traces "
+                           "still propagate ids across threads and the "
+                           "wire but record nothing — the serve_bench "
+                           "tracing-overhead gate holds the p50 tax at "
+                           "this default under 3%. CI's trace-smoke "
+                           "job sets 1.0 so every request must stitch "
+                           "into one rooted span tree."),
+    "MXTPU_TRACE_RING": ("65536", "Completed-span ring capacity "
+                         "(process-wide; oldest spans drop first)."),
+    "MXTPU_FLIGHT_DIR": ("", "When set, the flight recorder writes one "
+                         "atomic strict-JSON post-mortem bundle here on "
+                         "watchdog trip, guard halt, replica "
+                         "crash/stall-kill, and chaos crash sites; "
+                         "unset = recorder off (the off path is one "
+                         "env read). Render bundles with "
+                         "tools/postmortem.py."),
+    "MXTPU_FLIGHT_MAX": ("16", "Per-process cap on flight bundles — a "
+                         "crash loop produces a few bundles, not a "
+                         "full disk."),
+    "MXTPU_FLIGHT_MIN_S": ("0", "Minimum seconds between two flight "
+                           "bundles (storm damping; 0 = no spacing)."),
+    "MXTPU_FLIGHT_SPANS": ("2048", "Most-recent trace spans included in "
+                           "a flight bundle."),
+    "MXTPU_SLO_WINDOWS": ("60:14.4,300:6", "Burn-rate alert windows as "
+                          "'seconds:threshold,...' — every window must "
+                          "burn over its threshold at once to page "
+                          "(multi-window AND; scaled-down analogue of "
+                          "the SRE-workbook 1h/6h pair)."),
+    "MXTPU_SLO_OBJECTIVE": ("0.99", "Good-fraction objective shared by "
+                            "the built-in SLOs (0.99 = 1% error "
+                            "budget)."),
+    "MXTPU_SLO_SERVE_P99_MS": ("250", "Serve-latency SLO threshold: a "
+                               "request slower than this is an "
+                               "error-budget spend."),
+    "MXTPU_SLO_STEP_MS": ("60000", "Train step-time SLO threshold (ms) "
+                          "for the train-step-time objective."),
 }
 
 

@@ -1,0 +1,29 @@
+"""Seeded MX602 violation: a request-path function emits a bus event
+with no correlation whatsoever — no ``request_id=``/``step=`` kwarg and
+no enclosing ``request_scope``/``step_scope``/``trace.span`` block. The
+event lands on the timeline as a free-floating fact that can never be
+stitched into any request's story."""
+from incubator_mxnet_tpu.telemetry import events as _tele
+from incubator_mxnet_tpu.telemetry import trace as _trace
+
+
+class ToyReplicaPool:
+    def submit(self, model, arrays):
+        _tele.emit("serve.admit", model=model,   # MX602: uncorrelated
+                   depth=len(arrays))
+        return self._enqueue(model, arrays)
+
+    def call_detailed(self, model, *arrays):
+        # clean control: the whole call is wrapped in a trace span, so
+        # everything emitted inside is correlated
+        with _trace.span("router.request", model=model):
+            _tele.emit("router.attempt", model=model)
+            return self.submit(model, arrays)
+
+    def _enqueue(self, model, arrays):
+        raise NotImplementedError
+
+    def health_sweep(self):
+        # clean control: lifecycle telemetry outside the request path is
+        # legitimately uncorrelated — out of MX602's vocabulary
+        _tele.emit("router.health", replicas=0)

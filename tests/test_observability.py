@@ -1,0 +1,819 @@
+"""ISSUE 10 observability layer — distributed tracing (mx.telemetry.trace),
+flight-recorder post-mortems (telemetry.flight + tools/postmortem.py),
+SLO burn-rate monitoring (telemetry.slo), Prometheus exemplars,
+subscriber isolation, the hardened JSONL export path, and the MX602
+uncorrelated-telemetry lint.
+"""
+import json
+import os
+import threading
+
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import fault, gluon, parallel, telemetry
+from incubator_mxnet_tpu.telemetry import export as texport
+from incubator_mxnet_tpu.telemetry import flight, slo as tslo, trace
+from incubator_mxnet_tpu.telemetry.metrics import MetricsRegistry
+
+from tools.telemetry_check import check_spans
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "lint_fixtures")
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    """Empty bus/registry/ledger/trace-ring, deterministic sampling."""
+    telemetry.reset()
+    telemetry.enable(True)
+    trace.set_sample_rate(1.0)
+    yield
+    trace.set_sample_rate(None)
+    flight.set_dir(None)
+    telemetry.reset()
+    telemetry.enable(True)
+
+
+# ---------------------------------------------------------------------------
+# trace — context, sampling, wire form, stitching
+# ---------------------------------------------------------------------------
+class TestTraceContext:
+    def test_scoped_spans_nest_into_one_rooted_tree(self):
+        with trace.span("router.request", model="m") as root:
+            assert trace.current() is root.ctx
+            with trace.span("router.attempt", replica="r0") as att:
+                assert att.ctx.trace_id == root.ctx.trace_id
+                assert att.parent_id == root.ctx.span_id
+        assert trace.current() is None
+        recs = trace.spans(root.ctx.trace_id)
+        assert [r["name"] for r in recs] == ["router.attempt",
+                                             "router.request"]
+        t = trace.tree(root.ctx.trace_id)
+        assert t["span"]["name"] == "router.request"
+        assert [c["span"]["name"] for c in t["children"]] \
+            == ["router.attempt"]
+        assert trace.orphans() == []
+
+    def test_hedged_attempts_are_siblings_under_one_parent(self):
+        with trace.span("router.request") as root:
+            a1 = trace.start_span("router.attempt", replica="r0")
+            a2 = trace.start_span("router.attempt", replica="r1")
+            a2.finish(won=True)
+            a1.finish(won=False)
+        t = trace.tree(root.ctx.trace_id)
+        kids = t["children"]
+        assert len(kids) == 2
+        assert {k["span"]["attrs"]["replica"] for k in kids} == {"r0", "r1"}
+        assert all(k["span"]["parent_id"] == root.ctx.span_id
+                   for k in kids)
+
+    def test_unsampled_trace_propagates_ids_but_records_nothing(self):
+        trace.set_sample_rate(0.0)
+        with trace.span("a") as sp:
+            assert trace.current() is sp.ctx
+            assert not sp.ctx.sampled
+            with trace.span("b") as child:
+                assert child.ctx.trace_id == sp.ctx.trace_id
+        assert trace.spans() == []
+
+    def test_sample_rate_clamped_and_override_restores(self):
+        trace.set_sample_rate(7.0)
+        assert trace.sample_rate() == 1.0
+        trace.set_sample_rate(-1.0)
+        assert trace.sample_rate() == 0.0
+        trace.set_sample_rate(None)  # back to env/default
+        assert 0.0 <= trace.sample_rate() <= 1.0
+
+    def test_wire_roundtrip_and_malformed_degrade(self):
+        with trace.span("root") as sp:
+            wire = trace.to_wire()
+        assert wire == {"trace_id": sp.ctx.trace_id,
+                        "span_id": sp.ctx.span_id, "sampled": True}
+        ctx = trace.from_wire(json.loads(json.dumps(wire)))
+        assert ctx == sp.ctx
+        # a bad peer degrades to an untraced request, never an error
+        for bad in (None, 17, "x", {}, {"trace_id": "t"},
+                    {"trace_id": 3, "span_id": "s"},
+                    {"trace_id": "", "span_id": "s"}):
+            assert trace.from_wire(bad) is None
+        assert trace.to_wire() is None  # nothing active here
+
+    def test_cross_thread_resume_parents_correctly(self):
+        root = trace.start_span("serve.request")
+
+        def worker():
+            with trace.use(root.ctx):
+                with trace.span("serve.execute"):
+                    pass
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+        root.finish()
+        tr = trace.tree(root.ctx.trace_id)
+        assert tr["span"]["name"] == "serve.request"
+        assert [c["span"]["name"] for c in tr["children"]] \
+            == ["serve.execute"]
+        assert trace.orphans() == []
+
+    def test_use_none_is_a_noop(self):
+        with trace.use(None):
+            assert trace.current() is None
+
+    def test_unfinished_parent_surfaces_as_orphan(self):
+        root = trace.start_span("request")          # never finished
+        child = trace.start_span("attempt", parent=root.ctx)
+        child.finish()
+        assert len(trace.orphans()) == 1
+        assert trace.orphans()[0]["name"] == "attempt"
+        t = trace.tree(child.ctx.trace_id)
+        assert t["span"]["name"] != "<forest>"      # single record, rooted
+        root.finish()
+        assert trace.orphans() == []
+
+    def test_events_stamp_active_trace_context(self):
+        with trace.span("request") as sp:
+            ev = telemetry.emit("serve.admit", depth=1)
+        assert ev.trace_id == sp.ctx.trace_id
+        assert ev.span_id == sp.ctx.span_id
+        d = ev.to_dict()
+        assert d["trace_id"] == sp.ctx.trace_id
+        out = telemetry.emit("lifecycle")
+        assert out.trace_id is None and "trace_id" not in out.to_dict()
+
+    def test_finish_is_idempotent_and_exceptions_land_in_attrs(self):
+        with pytest.raises(RuntimeError):
+            with trace.span("boom"):
+                raise RuntimeError("x")
+        (rec,) = trace.spans()
+        assert rec["attrs"]["error"] == "RuntimeError"
+        sp = trace.start_span("once")
+        sp.finish()
+        sp.finish()
+        assert len(trace.spans()) == 2
+
+    def test_summary_counts(self):
+        with trace.span("a"):
+            with trace.span("b"):
+                pass
+        s = trace.summary()
+        assert s["spans"] == 2 and s["traces"] == 1
+        assert s["roots"] == 1 and s["orphans"] == 0
+        assert s["sample_rate"] == 1.0
+
+    def test_profiler_scopes_join_a_sampled_trace(self):
+        from incubator_mxnet_tpu import profiler
+        profiler.reset_spans()
+        with trace.span("request") as sp:
+            with profiler.scope("serve.pad"):
+                pass
+        names = {r["name"]: r for r in trace.spans(sp.ctx.trace_id)}
+        assert "serve.pad" in names
+        assert names["serve.pad"]["parent_id"] == sp.ctx.span_id
+        rec = [r for r in profiler.recent_spans()
+               if r.name == "serve.pad"][-1]
+        assert rec.trace == (sp.ctx.trace_id,
+                             names["serve.pad"]["span_id"])
+
+
+class TestTraceWireHop:
+    def test_kvstore_push_pull_stitches_across_the_wire(self):
+        from incubator_mxnet_tpu.kvstore.async_ps import AsyncKVStore
+        kv = AsyncKVStore()
+        try:
+            a = mx.nd.array(onp.ones((4,), "float32"))
+            kv.init(0, a)
+            trace.clear()
+            with trace.span("train.step", step=3) as root:
+                kv.push(0, a)
+                kv.pull(0, out=a)
+        finally:
+            kv.close()
+        recs = trace.spans(root.ctx.trace_id)
+        names = [r["name"] for r in recs]
+        assert "kvstore.push" in names and "kvstore.pull" in names
+        # the PS-server side carries the SAME trace across the socket
+        assert "kvstore.server.push" in names
+        assert "kvstore.server.pull" in names
+        t = trace.tree(root.ctx.trace_id)
+        assert t["span"]["name"] == "train.step"
+        assert trace.orphans(recs) == []
+
+    def test_trainer_step_opens_root_span(self):
+        net = gluon.nn.HybridSequential(prefix="obs_tr_")
+        with net.name_scope():
+            net.add(gluon.nn.Dense(4, in_units=8))
+        net.initialize()
+        tr = parallel.ShardedTrainer(
+            net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+            {"learning_rate": 0.01})
+        x = onp.random.randn(8, 8).astype("float32")
+        y = onp.zeros((8,), "int32")
+        tr.step(x, y)
+        tr.step(x, y)
+        steps = [r for r in trace.spans() if r["name"] == "train.step"]
+        assert len(steps) == 2
+        assert [r["step"] for r in steps] == [1, 2]
+        # each step is its own rooted trace
+        assert all(r["parent_id"] is None for r in steps)
+        assert len({r["trace_id"] for r in steps}) == 2
+
+
+class TestRouterModeServerWire:
+    def test_metrics_cmd_and_local_batcher_in_router_mode(self):
+        """A router-backed Server (registry=None) must answer the
+        ``metrics`` wire command from the router snapshot and refuse the
+        in-process batcher path outright — never cache a batcher built
+        over the None registry."""
+        from incubator_mxnet_tpu import serve
+        from incubator_mxnet_tpu.base import MXNetError
+
+        class _FakeRouter:
+            def snapshot(self):
+                return {"stats": {"accepted": 3}}
+
+        srv = serve.Server(router=_FakeRouter())
+        reply = srv._handle_line(b'{"cmd": "metrics", "model": "m"}')
+        assert reply["ok"]
+        assert reply["metrics"]["router"]["stats"]["accepted"] == 3
+        with pytest.raises(MXNetError, match="router-backed"):
+            srv.submit("m", onp.zeros((2,), "float32"))
+        assert srv._batchers == {}
+
+
+# ---------------------------------------------------------------------------
+# otel export + the --require-rooted-traces gate
+# ---------------------------------------------------------------------------
+class TestOtelSpansAndRootedGate:
+    def test_otel_form_and_check_spans_accepts(self):
+        with trace.span("request", model="m"):
+            with trace.span("attempt"):
+                pass
+        spans = texport.otel_spans()
+        assert len(spans) == 2
+        by_name = {s["name"]: s for s in spans}
+        root, child = by_name["request"], by_name["attempt"]
+        assert root["parentSpanId"] == ""
+        assert child["parentSpanId"] == root["spanId"]
+        assert child["traceId"] == root["traceId"]
+        assert root["endTimeUnixNano"] >= root["startTimeUnixNano"]
+        assert root["attributes"]["model"] == "m"
+        lines = [texport.dumps_strict(s) for s in spans]
+        assert check_spans(lines) == []
+
+    def test_check_spans_flags_orphan_forest_dup_and_empty(self):
+        mk = json.dumps
+        orphan = [mk({"traceId": "t", "spanId": "a", "parentSpanId": "",
+                      "name": "root"}),
+                  mk({"traceId": "t", "spanId": "b", "parentSpanId": "zz",
+                      "name": "lost"})]
+        (p,) = check_spans(orphan)
+        assert "ORPHAN SPAN" in p
+        forest = [mk({"traceId": "t", "spanId": "a", "name": "r1"}),
+                  mk({"traceId": "t", "spanId": "b", "name": "r2"})]
+        (p,) = check_spans(forest)
+        assert "2 root span(s)" in p
+        dup = [mk({"traceId": "t", "spanId": "a", "name": "r"}),
+               mk({"traceId": "t", "spanId": "a", "parentSpanId": "a",
+                   "name": "child"})]
+        assert any("duplicate span id" in p for p in check_spans(dup))
+        assert any("empty" in p for p in check_spans([]))
+        assert any("malformed" in p
+                   for p in check_spans(['{"traceId": NaN}']))
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+class TestFlightRecorder:
+    def test_off_by_default_dump_returns_none(self, monkeypatch):
+        monkeypatch.delenv("MXTPU_FLIGHT_DIR", raising=False)
+        assert not flight.enabled()
+        assert flight.dump("unit") is None
+
+    def test_dump_load_roundtrip_with_all_sections(self, tmp_path):
+        flight.set_dir(str(tmp_path))
+        with trace.span("request"):
+            telemetry.emit("serve.admit", depth=1)
+        telemetry.histogram("mxtpu_obs_ms", "h").observe(4.0)
+        path = flight.dump("unit_test", site="tests", detail="abc")
+        assert path and os.path.basename(path).startswith(
+            "flight-") and path.endswith(".json")
+        # strict JSON on disk (what telemetry_check would accept)
+        with open(path, encoding="utf-8") as f:
+            json.loads(f.read())
+        doc = flight.load(path)
+        assert doc["format"] == 1 and doc["reason"] == "unit_test"
+        assert doc["context"] == {"detail": "abc"}
+        for section in ("trace", "events", "compiles", "lockcheck",
+                        "step_report", "metrics", "env", "config"):
+            assert section in doc, section
+        assert doc["trace"]["summary"]["spans"] == 1
+        assert "serve.admit" in doc["events"]
+        assert flight.list_bundles(str(tmp_path)) == [path]
+        # the announcement happens AFTER the bundle exists
+        (ev,) = telemetry.get_events("flight.dump")
+        assert ev.fields["path"] == path
+
+    def test_storm_cap_and_reset(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("MXTPU_FLIGHT_MAX", "2")
+        flight.set_dir(str(tmp_path))
+        assert flight.dump("one") and flight.dump("two")
+        assert flight.dump("three") is None
+        assert len(flight.list_bundles(str(tmp_path))) == 2
+        flight.reset()
+        assert flight.dump("four") is not None
+
+    def test_failed_write_refunds_the_storm_cap(self, tmp_path,
+                                                monkeypatch):
+        """A transiently unwritable dir must not eat MXTPU_FLIGHT_MAX:
+        the dump that fires after the disk recovers is the one the
+        recorder exists for."""
+        monkeypatch.setenv("MXTPU_FLIGHT_MAX", "1")
+        blocker = tmp_path / "blocker"
+        blocker.write_text("not a dir")
+        flight.set_dir(str(blocker / "sub"))   # makedirs fails
+        with pytest.warns(UserWarning, match="bundle write failed"):
+            assert flight.dump("doomed") is None
+        flight.set_dir(str(tmp_path / "ok"))
+        assert flight.dump("survivor") is not None
+
+    def test_guard_halt_writes_a_bundle(self, tmp_path):
+        flight.set_dir(str(tmp_path))
+        guard = fault.StepGuard(policy="halt")
+        with pytest.raises(fault.NonFiniteError):
+            guard.decide(5, "non-finite loss/grad")
+        (bundle,) = flight.list_bundles(str(tmp_path))
+        doc = flight.load(bundle)
+        assert doc["reason"] == "guard_halt"
+
+    def test_watchdog_trip_writes_a_bundle(self, tmp_path):
+        flight.set_dir(str(tmp_path))
+        wd = fault.Watchdog(deadline=0.05)
+        with pytest.warns(UserWarning, match="watchdog"):
+            with wd.watch(step=9):
+                import time
+                time.sleep(0.15)
+        (bundle,) = flight.list_bundles(str(tmp_path))
+        doc = flight.load(bundle)
+        assert doc["reason"] == "watchdog"
+        assert doc["context"]["step"] == 9
+
+
+@pytest.mark.chaos
+class TestFlightChaos:
+    def test_mid_dump_kill_leaves_no_torn_bundle(self, tmp_path):
+        """The atomicity contract: a death between write and rename must
+        leave nothing under the final name — readers may trust any
+        flight-*.json they can see."""
+        flight.set_dir(str(tmp_path))
+        with fault.inject.chaos(seed=5, crash_sites=["flight.dump"]):
+            with pytest.raises(fault.inject.ChaosCrash):
+                flight.dump("mid_dump_kill")
+        assert flight.list_bundles(str(tmp_path)) == []
+        assert [f for f in os.listdir(str(tmp_path))
+                if f.endswith(".json")] == []
+        # ...and, like a real SIGKILL between write and rename, the
+        # simulated one leaves the fsynced ``.tmp-*`` evidence behind —
+        # the exact debris the docstring tells operators to look for
+        assert [f for f in os.listdir(str(tmp_path))
+                if ".json.tmp-" in f]
+        # the recorder recovers once the fault clears
+        path = flight.dump("after")
+        assert path is not None and flight.load(path)["reason"] == "after"
+
+    def test_chaos_crash_site_bundles_before_raising(self, tmp_path):
+        flight.set_dir(str(tmp_path))
+        with fault.inject.chaos(seed=5, crash_sites=["nd.save"]):
+            with pytest.raises(fault.inject.ChaosCrash):
+                fault.inject.crash("nd.save")
+        (bundle,) = flight.list_bundles(str(tmp_path))
+        doc = flight.load(bundle)
+        assert doc["reason"] == "chaos_crash"
+        assert doc["site"] == "nd.save"
+
+    def test_replica_kill_writes_a_bundle(self, tmp_path):
+        from incubator_mxnet_tpu import serve
+        flight.set_dir(str(tmp_path))
+        net = gluon.nn.HybridSequential(prefix="obs_rk_")
+        with net.name_scope():
+            net.add(gluon.nn.Dense(4, in_units=8))
+        net.initialize()
+        net.hybridize()
+        net(mx.nd.array(onp.zeros((1, 8), "float32")))
+        table = serve.BucketTable({"batch": (1, 2)})
+
+        def loader(rep):
+            rep.load("m", table=table, input_axes=[{0: "batch"}],
+                     factory=lambda: net, output_axes=[{0: "batch"}],
+                     analyze=False)
+
+        rep = serve.Replica("r0", loader)
+        rep.start()
+        try:
+            rep.kill(reason="unit stall-kill")
+        finally:
+            rep.stop()
+        bundles = [flight.load(b)
+                   for b in flight.list_bundles(str(tmp_path))]
+        kills = [d for d in bundles if d["reason"] == "replica_kill"]
+        assert kills and kills[0]["context"]["replica"] == "r0"
+
+
+class TestPostmortemTool:
+    def test_render_and_cli_roundtrip(self, tmp_path, capsys):
+        from tools import postmortem
+        flight.set_dir(str(tmp_path))
+        with trace.span("request"):
+            telemetry.emit("serve.admit", depth=1)
+        path = flight.dump("unit_test", site="tests")
+        text = postmortem.render(flight.load(path))
+        assert "FLIGHT BUNDLE" in text and "unit_test" in text
+        assert "event timeline" in text
+        assert postmortem.main([path]) == 0
+        assert "FLIGHT BUNDLE" in capsys.readouterr().out
+        assert postmortem.main(["--dir", str(tmp_path)]) == 0
+        capsys.readouterr()
+        assert postmortem.main(["--json", path]) == 0
+        assert json.loads(capsys.readouterr().out)["reason"] == "unit_test"
+
+    def test_unreadable_bundle_exits_2(self, tmp_path):
+        from tools import postmortem
+        bad = tmp_path / "flight-torn.json"
+        bad.write_text('{"format": 1, "reason": ')
+        assert postmortem.main([str(bad)]) == 2
+        assert postmortem.main(["--dir", str(tmp_path / "nowhere")]) == 2
+
+
+# ---------------------------------------------------------------------------
+# SLO burn-rate monitoring
+# ---------------------------------------------------------------------------
+class TestSLO:
+    WINDOWS = ((60.0, 14.4), (300.0, 6.0))
+
+    def _ratio_monitor(self):
+        reg = MetricsRegistry()
+        bad = reg.counter("unit_bad_total")
+        total = reg.counter("unit_requests_total")
+        s = tslo.SLO("unit-availability", objective=0.99,
+                     bad="unit_bad_total", total="unit_requests_total",
+                     windows=self.WINDOWS)
+        return tslo.SLOMonitor([s], registry=reg), bad, total
+
+    def test_burn_rate_math_and_multiwindow_breach(self):
+        mon, bad, total = self._ratio_monitor()
+        (r0,) = mon.evaluate(now=1000.0)
+        assert r0["burn"]["60s"]["burn"] == 0.0 and not r0["breach"]
+        total.inc(100)
+        bad.inc(20)                      # 20% bad vs a 1% budget
+        (r1,) = mon.evaluate(now=1030.0)
+        assert r1["bad_fraction"] == pytest.approx(0.2)
+        assert r1["burn"]["60s"]["burn"] == pytest.approx(20.0)
+        assert r1["burn"]["300s"]["burn"] == pytest.approx(20.0)
+        assert r1["breach"] is True
+        (ev,) = [e for e in telemetry.get_events("slo.burn")
+                 if e.severity == "error"]
+        assert ev.fields["slo"] == "unit-availability"
+        # burn gauges refresh on every evaluation, published to the SAME
+        # registry the monitor samples from — a monitor over a private
+        # registry must not leak gauges into the process-global scrape
+        from incubator_mxnet_tpu.telemetry import metrics as tmetrics
+        names = {i.name: i for i in mon.registry.instruments()}
+        assert names["mxtpu_slo_breach"].value == 1.0
+        assert names["mxtpu_slo_bad_fraction"].value == pytest.approx(0.2)
+        global_names = {i.name for i in tmetrics.REGISTRY.instruments()}
+        assert "mxtpu_slo_breach" not in global_names
+
+    def test_one_window_over_is_not_a_page(self):
+        """Multi-window AND: the 60s window burning alone (a blip) must
+        not breach while the 300s window stays calm."""
+        mon, bad, total = self._ratio_monitor()
+        mon.evaluate(now=1000.0)
+        total.inc(1000)                  # long calm stretch
+        mon.evaluate(now=1250.0)
+        total.inc(10)
+        bad.inc(2)                       # short blip: 20% of 10 requests
+        (rep,) = mon.evaluate(now=1310.0)
+        assert rep["burn"]["60s"]["over"] is True
+        assert rep["burn"]["300s"]["over"] is False
+        assert rep["breach"] is False
+        assert telemetry.get_events("slo.burn") == []
+
+    def test_recovery_emits_info_once(self):
+        mon, bad, total = self._ratio_monitor()
+        mon.evaluate(now=1000.0)
+        total.inc(100)
+        bad.inc(20)
+        mon.evaluate(now=1030.0)         # breach
+        total.inc(5000)                  # good traffic flushes the window
+        (rep,) = mon.evaluate(now=1400.0)
+        assert rep["breach"] is False
+        kinds = [(e.severity, e.fields.get("recovered"))
+                 for e in telemetry.get_events("slo.burn")]
+        assert kinds == [("error", None), ("info", True)]
+        mon.evaluate(now=1401.0)         # still calm: no second recovery
+        assert len(telemetry.get_events("slo.burn")) == 2
+
+    def test_latency_slo_reservoir_estimate(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("unit_latency_ms", "x")
+        for _ in range(90):
+            h.observe(10.0)
+        for _ in range(10):
+            h.observe(500.0)
+        s = tslo.SLO("unit-latency", objective=0.99, kind="latency",
+                     series="unit_latency_ms", threshold_ms=250.0,
+                     windows=self.WINDOWS)
+        bad, total = s.sample(reg)
+        assert total == 100 and bad == pytest.approx(10.0)
+
+    def test_gate_runs_a_fresh_evaluation(self):
+        mon, bad, total = self._ratio_monitor()
+        mon.evaluate(now=None)
+        ok, report = mon.gate()
+        assert ok is True and set(report) == {"unit-availability"}
+
+    def test_spec_validation(self):
+        with pytest.raises(ValueError, match="objective"):
+            tslo.SLO("x", objective=1.5, bad="b", total="t")
+        with pytest.raises(ValueError, match="kind"):
+            tslo.SLO("x", objective=0.9, kind="nope")
+        with pytest.raises(ValueError, match="threshold_ms"):
+            tslo.SLO("x", objective=0.9, kind="latency")
+        with pytest.raises(ValueError, match="bad"):
+            tslo.SLO("x", objective=0.9)
+
+    def test_default_windows_env(self, monkeypatch):
+        monkeypatch.setenv("MXTPU_SLO_WINDOWS", "30:10,120:4")
+        assert tslo.default_windows() == ((30.0, 10.0), (120.0, 4.0))
+        monkeypatch.setenv("MXTPU_SLO_WINDOWS", "garbage")
+        with pytest.raises(ValueError, match="MXTPU_SLO_WINDOWS"):
+            tslo.default_windows()
+
+    def test_default_slos_cover_the_tier(self):
+        names = {s.name for s in tslo.default_slos()}
+        assert names == {"serve-latency", "serve-availability",
+                         "serve-failover-rate", "train-step-time"}
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exemplars
+# ---------------------------------------------------------------------------
+class TestExemplars:
+    def test_traced_observation_lands_as_openmetrics_exemplar(self):
+        h = telemetry.histogram("mxtpu_obs_latency_ms", "x")
+        with trace.span("request") as sp:
+            h.observe(41.0)
+        ex = h.exemplars()
+        assert ex["last"][0] == 41.0
+        assert ex["last"][1] == sp.ctx.trace_id
+        assert ex["max"][0] == 41.0
+        text = telemetry.prometheus_text(exemplars=True)
+        # OpenMetrics allows exemplars on counter/bucket samples only —
+        # the trace link rides a companion counter, and every summary
+        # sample (quantiles, _count, _sum) stays clean
+        line = [ln for ln in text.splitlines()
+                if ln.startswith("mxtpu_obs_latency_ms_observations_total")
+                ][0]
+        assert f'# {{trace_id="{sp.ctx.trace_id}"}}' in line
+        assert "# TYPE mxtpu_obs_latency_ms_observations counter" in text
+        for ln in text.splitlines():
+            if (ln.startswith("mxtpu_obs_latency_ms")
+                    and not ln.startswith(
+                        "mxtpu_obs_latency_ms_observations")):
+                assert "trace_id" not in ln, ln
+
+    def test_max_exemplar_tracks_the_worst_traced_sample(self):
+        h = telemetry.histogram("mxtpu_obs_worst_ms", "x")
+        with trace.span("slow") as slow:
+            h.observe(900.0)
+        with trace.span("fast"):
+            h.observe(1.0)
+        assert h.exemplars()["max"][1] == slow.ctx.trace_id
+        assert h.exemplars()["last"][0] == 1.0
+
+    def test_strict_004_scrape_has_no_exemplar_suffixes(self):
+        """The classic 0.0.4 exposition rejects anything after the value
+        except a timestamp — the Server's default scrape must stay
+        parseable by a real Prometheus once a traced observation
+        lands."""
+        from incubator_mxnet_tpu import serve
+        h = telemetry.histogram("mxtpu_obs_strict_ms", "x")
+        with trace.span("request"):
+            h.observe(7.0)
+        assert "trace_id" in telemetry.prometheus_text(exemplars=True)
+        # strict 0.0.4 is the DEFAULT: a zero-argument scrape shim built
+        # on the public API keeps parsing after this PR
+        assert "trace_id" not in telemetry.prometheus_text()
+        srv = serve.Server(serve.ModelRegistry())
+        assert "trace_id" not in srv.prometheus()
+        om = srv.prometheus(openmetrics=True)
+        assert "trace_id" in om and om.endswith("# EOF\n")
+        reply = srv._handle_line(b'{"cmd": "prometheus"}')
+        assert reply["content_type"] == "text/plain; version=0.0.4"
+        assert "trace_id" not in reply["text"]
+        reply = srv._handle_line(
+            b'{"cmd": "prometheus", "format": "openmetrics"}')
+        assert reply["content_type"].startswith(
+            "application/openmetrics-text")
+        assert "trace_id" in reply["text"]
+
+    def test_untraced_and_unsampled_observations_record_none(self):
+        h = telemetry.histogram("mxtpu_obs_plain_ms", "x")
+        h.observe(5.0)
+        trace.set_sample_rate(0.0)
+        with trace.span("unsampled"):
+            h.observe(6.0)
+        assert h.exemplars() == {}
+        assert "trace_id" not in telemetry.prometheus_text(
+            exemplars=True)
+
+
+# ---------------------------------------------------------------------------
+# EventBus subscriber isolation (satellite 1)
+# ---------------------------------------------------------------------------
+class TestSubscriberIsolation:
+    """A private bus per test: the global BUS's error/streak counters
+    are process-lifetime (production evidence, not ring state)."""
+
+    def test_failing_subscriber_never_breaks_the_emitter(self):
+        bus = telemetry.EventBus(ring=16)
+        got = []
+
+        def bad(ev):
+            raise RuntimeError("sink died")
+
+        bus.subscribe(bad)
+        bus.subscribe(got.append)
+        ev = bus.emit("unit.kind")                 # must not raise
+        assert ev is not None and got == [ev]
+        assert bus.subscriber_errors == 1
+        from incubator_mxnet_tpu.telemetry import metrics as tmetrics
+        names = {i.name: i for i in tmetrics.REGISTRY.instruments()}
+        assert names["mxtpu_telemetry_subscriber_errors_total"].value == 1
+
+    def test_persistently_failing_subscriber_is_muted_then_probed(self):
+        bus = telemetry.EventBus(ring=16)
+        bus.SUBSCRIBER_MUTE_BASE_S = 0.05          # fast probe for the test
+        calls = {"n": 0, "fail": True}
+
+        def wedged(ev):
+            calls["n"] += 1
+            if calls["fail"]:
+                raise RuntimeError("wedged")
+
+        bus.subscribe(wedged)
+        limit = telemetry.EventBus.MAX_SUBSCRIBER_FAILURES
+        with pytest.warns(UserWarning, match="muted after"):
+            for i in range(limit + 5):
+                bus.emit("unit.kind", i=i)
+        assert calls["n"] == limit                 # muted, not retried
+        assert bus.subscriber_errors == limit
+        # a mute is a backoff, not an eviction: once the window passes
+        # the sub is probed, and a healed sink gets its stream back —
+        # the JSONL-sink-reopens-after-disk-full scenario
+        calls["fail"] = False
+        import time as _time
+        _time.sleep(0.06)
+        bus.emit("unit.kind")
+        bus.emit("unit.kind")
+        assert calls["n"] == limit + 2             # recovered for good
+
+    def test_a_success_resets_the_failure_streak(self):
+        bus = telemetry.EventBus(ring=16)
+        flaky = {"fail": True, "n": 0}
+
+        def sub(ev):
+            flaky["n"] += 1
+            if flaky["fail"]:
+                raise RuntimeError("flaky")
+
+        bus.subscribe(sub)
+        limit = telemetry.EventBus.MAX_SUBSCRIBER_FAILURES
+        for _ in range(limit - 1):
+            bus.emit("unit.kind")
+        flaky["fail"] = False
+        bus.emit("unit.kind")                      # streak resets
+        flaky["fail"] = True
+        for _ in range(limit - 1):
+            bus.emit("unit.kind")                  # under the limit again
+        flaky["fail"] = False
+        bus.emit("unit.kind")                      # resets once more
+        assert flaky["n"] == 2 * limit             # never dropped
+        assert bus.subscriber_errors == 2 * (limit - 1)
+
+
+# ---------------------------------------------------------------------------
+# JSONL rotation under concurrent writers (satellite 4)
+# ---------------------------------------------------------------------------
+class TestJsonlRotationConcurrent:
+    def test_no_torn_lines_across_size_triggered_rotation(self, tmp_path):
+        """Size-triggered rotation racing concurrent emitters: every
+        surviving line (current file + the rotated generation) must be
+        one complete strict-JSON event — no interleaved or torn lines —
+        and no event may appear twice."""
+        path = str(tmp_path / "events.jsonl")
+        sink = telemetry.JsonlSink(path, max_mb=0.002)   # ~2 KiB: rotates
+        telemetry.subscribe(sink)
+
+        def worker(wid):
+            for i in range(120):
+                telemetry.emit("rot.kind", wid=wid, i=i,
+                               pad="x" * 40)
+
+        threads = [threading.Thread(target=worker, args=(w,))
+                   for w in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        telemetry.unsubscribe(sink)
+        assert os.path.exists(path + ".1"), \
+            "config did not exercise rotation"
+        seqs = []
+        for p in (path + ".1", path):
+            if not os.path.exists(p):
+                continue
+            with open(p, encoding="utf-8") as f:
+                for line in f:
+                    assert line.endswith("\n"), "torn final line"
+                    ev = json.loads(
+                        line, parse_constant=lambda t: 1 / 0)
+                    assert ev["kind"] == "rot.kind"
+                    assert ev["fields"]["pad"] == "x" * 40
+                    seqs.append(ev["seq"])
+        assert len(seqs) == len(set(seqs)), "event duplicated by rotation"
+        assert seqs, "nothing written"
+
+    def test_write_failure_self_heals_on_next_event(self, tmp_path):
+        path = str(tmp_path / "events.jsonl")
+        sink = telemetry.JsonlSink(path, max_mb=64)
+        telemetry.subscribe(sink)
+        base_errors = telemetry.BUS.subscriber_errors
+        telemetry.emit("heal.kind", i=0)
+        sink._fh.close()                 # wedge the handle under the sink
+        telemetry.emit("heal.kind", i=1)     # swallowed by bus isolation
+        assert telemetry.BUS.subscriber_errors == base_errors + 1
+        telemetry.emit("heal.kind", i=2)     # reopened handle, appended
+        telemetry.unsubscribe(sink)
+        with open(path, encoding="utf-8") as f:
+            ids = [json.loads(ln)["fields"]["i"] for ln in f]
+        assert ids == [0, 2]
+
+
+# ---------------------------------------------------------------------------
+# MX602 — uncorrelated telemetry lint (satellite 3)
+# ---------------------------------------------------------------------------
+@pytest.mark.lint
+class TestUncorrelatedTelemetryLint:
+    def test_seeded_fixture_exactly_one_mx602(self):
+        from incubator_mxnet_tpu.analysis import lint_file
+        rep = lint_file(os.path.join(FIXTURES,
+                                     "uncorrelated_telemetry.py"))
+        assert rep.codes() == ["MX602"]
+        (d,) = rep.diagnostics
+        assert d.op == "submit" and d.severity == "warning"
+        assert "correlation" in d.message
+
+    def test_explicit_request_id_kwarg_is_correlated(self):
+        from incubator_mxnet_tpu.analysis import telemetry_lint
+        src = ("from incubator_mxnet_tpu import telemetry\n"
+               "def submit(rid, x):\n"
+               "    telemetry.emit('serve.admit', request_id=rid)\n"
+               "    telemetry.emit('serve.queue', step=3)\n")
+        assert telemetry_lint.lint_source(src).codes() == []
+
+    def test_correlation_with_block_is_correlated(self):
+        from incubator_mxnet_tpu.analysis import telemetry_lint
+        src = ("from incubator_mxnet_tpu import telemetry\n"
+               "from incubator_mxnet_tpu.telemetry import trace\n"
+               "def handle_request(rid, x):\n"
+               "    with telemetry.request_scope(rid):\n"
+               "        telemetry.emit('serve.admit')\n"
+               "def call(x):\n"
+               "    with trace.span('router.request'):\n"
+               "        telemetry.emit('router.attempt')\n")
+        assert telemetry_lint.lint_source(src).codes() == []
+
+    def test_uncorrelated_on_request_path_flagged(self):
+        from incubator_mxnet_tpu.analysis import telemetry_lint
+        src = ("from incubator_mxnet_tpu import telemetry\n"
+               "def handle_request(x):\n"
+               "    telemetry.emit('serve.admit', depth=1)\n")
+        rep = telemetry_lint.lint_source(src)
+        assert rep.codes() == ["MX602"]
+        assert "'serve.admit'" in rep.diagnostics[0].message
+
+    def test_lifecycle_emit_outside_request_path_is_fine(self):
+        from incubator_mxnet_tpu.analysis import telemetry_lint
+        src = ("from incubator_mxnet_tpu import telemetry\n"
+               "def health_sweep(self):\n"
+               "    telemetry.emit('router.health', replicas=2)\n")
+        assert telemetry_lint.lint_source(src).codes() == []
+
+    def test_package_is_mx602_clean(self):
+        from incubator_mxnet_tpu.analysis import telemetry_lint
+        rep = telemetry_lint.lint_paths(["incubator_mxnet_tpu"])
+        assert "MX602" not in rep.codes(), [
+            d.node for d in rep.diagnostics if d.code == "MX602"]

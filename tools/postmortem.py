@@ -1,0 +1,246 @@
+#!/usr/bin/env python
+"""Render a flight-recorder bundle into a human-readable post-mortem.
+
+The :mod:`incubator_mxnet_tpu.telemetry.flight` recorder writes one
+strict-JSON bundle per trigger (watchdog trip, guard halt, replica
+stall-kill, chaos crash site) to ``MXTPU_FLIGHT_DIR``; this tool turns a
+bundle — or the newest one in a directory — back into the story an
+on-call needs: what fired, what the process was doing (merged event
+timeline), which request/step trees were in flight (stitched trace
+forest), where the step's wall time went, and whether the lock graph or
+compile ledger held a smoking gun.
+
+    python tools/postmortem.py FLIGHT_BUNDLE.json
+    python tools/postmortem.py --dir /var/flight      # newest bundle
+    python tools/postmortem.py --json bundle.json     # machine-readable
+
+Exit: 0 rendered, 1 bundle shows a fatal trigger but ``--strict`` asked
+for a clean run, 2 unreadable/unparseable bundle or bad invocation.
+(The chaos CI job runs this over the drill's bundle as the "a bundle is
+produced and parses" gate.)
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List
+
+#: pure stdlib on purpose: a post-mortem must render on a box where the
+#: package (or jax) cannot even import — that may be WHY it crashed
+
+
+def _fmt_ts(ts) -> str:
+    import datetime
+    try:
+        return datetime.datetime.fromtimestamp(
+            float(ts), datetime.timezone.utc).strftime("%H:%M:%S.%f")[:-3]
+    except (TypeError, ValueError, OSError, OverflowError):
+        return str(ts)
+
+
+def _section(title: str) -> List[str]:
+    return ["", f"== {title} " + "=" * max(0, 60 - len(title))]
+
+
+def render(doc: Dict, events_n: int = 40) -> str:
+    """The whole bundle as one readable report string."""
+    out: List[str] = []
+    out.append(f"FLIGHT BUNDLE — reason: {doc.get('reason')!r}"
+               + (f" at site {doc['site']!r}" if doc.get("site") else ""))
+    out.append(f"  written {_fmt_ts(doc.get('ts'))}Z by pid "
+               f"{doc.get('pid')} thread {doc.get('thread')!r}")
+    for k, v in sorted((doc.get("context") or {}).items()):
+        out.append(f"  {k}: {v}")
+
+    cfg = doc.get("config") or {}
+    env = doc.get("env") or {}
+    out += _section("environment")
+    out.append("  " + ", ".join(f"{k}={v}" for k, v in sorted(cfg.items())))
+    for k, v in sorted(env.items()):
+        out.append(f"  {k}={v}")
+
+    # -- event timeline (merged across kinds, oldest first) --------------
+    evs: List[Dict] = []
+    for kind, ring in (doc.get("events") or {}).items():
+        if isinstance(ring, list):
+            evs.extend(e for e in ring if isinstance(e, dict))
+    evs.sort(key=lambda e: e.get("ts") or 0)
+    out += _section(f"event timeline (last {min(events_n, len(evs))} "
+                    f"of {len(evs)})")
+    for e in evs[-events_n:]:
+        sev = e.get("severity", "info")
+        mark = {"error": "!!", "warning": " !"}.get(sev, "  ")
+        corr = []
+        if e.get("step") is not None:
+            corr.append(f"step={e['step']}")
+        if e.get("request_id"):
+            corr.append(f"req={e['request_id']}")
+        if e.get("trace_id"):
+            corr.append(f"trace={e['trace_id'][:8]}")
+        fields = e.get("fields") or {}
+        body = ", ".join(f"{k}={v}" for k, v in sorted(fields.items()))
+        out.append(f"{mark} {_fmt_ts(e.get('ts'))} {e.get('kind'):<24}"
+                   f"{' [' + ' '.join(corr) + ']' if corr else ''} {body}")
+
+    # -- trace forest -----------------------------------------------------
+    tr = doc.get("trace") or {}
+    spans = [s for s in (tr.get("spans") or []) if isinstance(s, dict)]
+    out += _section(f"traces ({tr.get('summary', {})})")
+    out.extend(_render_traces(spans))
+
+    # -- step attribution -------------------------------------------------
+    out += _section("step attribution")
+    for frame, rep in sorted((doc.get("step_report") or {}).items()):
+        if not isinstance(rep, dict) or not rep.get("frames"):
+            continue
+        out.append(f"  {frame}: {rep.get('frames')} frame(s), wall "
+                   f"{rep.get('wall_ms')}ms, host gap "
+                   f"{rep.get('host_gap_ms')}ms")
+        for seg in rep.get("segments") or []:
+            if isinstance(seg, dict):
+                out.append(f"    {seg.get('name'):<22} "
+                           f"{seg.get('wall_ms')}ms")
+
+    # -- compile ledger ----------------------------------------------------
+    comp = doc.get("compiles") or {}
+    out += _section("compile ledger")
+    out.append(f"  total={comp.get('total')} "
+               f"post_warmup={comp.get('post_warmup')}")
+    for site in (comp.get("sites") or {}) if isinstance(
+            comp.get("sites"), dict) else {}:
+        out.append(f"    {site}: {comp['sites'][site]}")
+
+    # -- lock graph --------------------------------------------------------
+    lc = doc.get("lockcheck") or {}
+    invs = lc.get("inversions") or []
+    out += _section("lock graph")
+    out.append(f"  edges={len(lc.get('edges') or [])} "
+               f"inversions={len(invs)} held_now={lc.get('held_now')}")
+    for inv in invs:
+        out.append(f"  !! inversion: {inv}")
+
+    # -- SLO / metrics headline -------------------------------------------
+    mets = doc.get("metrics") or {}
+    out += _section("metrics headline")
+    for name in sorted(mets):
+        if name.startswith(("mxtpu_slo_", "mxtpu_flight_",
+                            "mxtpu_guard_", "mxtpu_watchdog_",
+                            "mxtpu_chaos_", "mxtpu_lockcheck_",
+                            "mxtpu_router_", "mxtpu_serve_replica")):
+            for labels, val in sorted(mets[name].items()):
+                v = (val.get("count") if isinstance(val, dict) else val)
+                out.append(f"  {name}{'' if labels == '_' else labels} "
+                           f"= {v}")
+    return "\n".join(out) + "\n"
+
+
+def _render_traces(spans: List[Dict], max_traces: int = 8) -> List[str]:
+    """ASCII forest per trace id, newest traces last."""
+    by_trace: Dict[str, List[Dict]] = {}
+    order: List[str] = []
+    for s in spans:
+        tid = s.get("trace_id")
+        if tid not in by_trace:
+            by_trace[tid] = []
+            order.append(tid)
+        by_trace[tid].append(s)
+    out: List[str] = []
+    shown = order[-max_traces:]
+    if len(order) > len(shown):
+        out.append(f"  ({len(order) - len(shown)} older trace(s) omitted)")
+    for tid in shown:
+        recs = by_trace[tid]
+        out.append(f"  trace {str(tid)[:16]} ({len(recs)} span(s)):")
+        by_id = {r.get("span_id"): r for r in recs}
+        children: Dict[str, List[Dict]] = {}
+        roots = []
+        for r in recs:
+            pid = r.get("parent_id")
+            if pid and pid in by_id:
+                children.setdefault(pid, []).append(r)
+            else:
+                roots.append(r)
+
+        def walk(rec, depth):
+            attrs = rec.get("attrs") or {}
+            extra = ", ".join(f"{k}={v}" for k, v in sorted(attrs.items()))
+            star = " (ORPHAN)" if rec.get("parent_id") and \
+                rec.get("parent_id") not in by_id else ""
+            out.append(f"    {'  ' * depth}{rec.get('name')} "
+                       f"[{rec.get('dur_ms')}ms]"
+                       + (f" {{{extra}}}" if extra else "") + star)
+            for c in sorted(children.get(rec.get("span_id"), []),
+                            key=lambda r: r.get("ts") or 0):
+                walk(c, depth + 1)
+
+        for r in sorted(roots, key=lambda r: r.get("ts") or 0):
+            walk(r, 0)
+    if not spans:
+        out.append("  (no spans recorded)")
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("path", nargs="?", help="flight bundle JSON file")
+    ap.add_argument("--dir", help="render the NEWEST flight-*.json here")
+    ap.add_argument("--json", action="store_true",
+                    help="re-emit the parsed bundle as compact JSON "
+                         "(machine-readable path of the CI gate)")
+    ap.add_argument("--events", type=int, default=40,
+                    help="timeline length (default 40)")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 when the bundle records a fatal trigger "
+                         "(anything but a manual/snapshot dump) — for "
+                         "jobs asserting a run died cleanly")
+    args = ap.parse_args(argv)
+
+    path = args.path
+    if path is None and args.dir:
+        import os
+        try:
+            names = os.listdir(args.dir)
+        except OSError as e:
+            print(f"postmortem: cannot read {args.dir}: {e}",
+                  file=sys.stderr)
+            return 2
+        cands = sorted(f for f in names
+                       if f.startswith("flight-") and f.endswith(".json"))
+        if not cands:
+            print(f"postmortem: no flight-*.json in {args.dir}",
+                  file=sys.stderr)
+            return 2
+        path = os.path.join(args.dir, cands[-1])
+    if path is None:
+        ap.print_usage(sys.stderr)
+        return 2
+
+    def _reject(tok):
+        raise ValueError(f"non-strict JSON token {tok!r}")
+
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f, parse_constant=_reject)
+    except (OSError, ValueError) as e:
+        print(f"postmortem: cannot parse {path}: {e}", file=sys.stderr)
+        return 2
+    if not isinstance(doc, dict) or doc.get("format") != 1:
+        print(f"postmortem: {path}: not a flight bundle (format "
+              f"{doc.get('format') if isinstance(doc, dict) else '?'!r})",
+              file=sys.stderr)
+        return 2
+    if args.json:
+        json.dump(doc, sys.stdout, separators=(",", ":"))
+        sys.stdout.write("\n")
+    else:
+        sys.stdout.write(render(doc, events_n=args.events))
+    if args.strict and doc.get("reason") not in ("manual", "snapshot"):
+        print(f"postmortem: fatal trigger {doc.get('reason')!r} recorded "
+              "(--strict)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,9 +18,18 @@ checker, which fails (exit 1) when:
   CI job forbids ``concurrency.inversion`` on its lockcheck-enabled
   chaos smoke — one observed lock-order inversion fails the build).
 
+With ``--require-rooted-traces`` the inputs are OTel-style **span**
+JSON-lines instead (``serve_bench --trace-out``'s format:
+``traceId``/``spanId``/``parentSpanId`` per line) and the gate flips to
+the trace-smoke contract: every trace must stitch into exactly ONE
+rooted tree — one root span per trace, zero orphans (a ``parentSpanId``
+absent from its trace), zero duplicate span ids — so a hedged or
+failover request that fails to parent its attempts fails the build.
+
     python tools/telemetry_check.py events.jsonl [more.jsonl ...]
     python tools/telemetry_check.py --allow-post-warmup events.jsonl
     python tools/telemetry_check.py --forbid concurrency.inversion ev.jsonl
+    python tools/telemetry_check.py --require-rooted-traces spans.jsonl
 
 Exit: 0 clean, 1 violations, 2 bad invocation / unreadable file.
 """
@@ -92,6 +101,79 @@ def check_stream(lines, name: str = "<stream>",
     return problems
 
 
+SPAN_KEYS = ("traceId", "spanId", "name")
+
+
+def ingest_spans(lines, name: str, traces: dict,
+                 problems: List[str]) -> int:
+    """Fold one OTel-style span JSONL stream into ``traces``
+    (traceId -> {"ids", "roots", "parents"}). Returns the non-blank
+    line count. Separated from validation so a trace split across
+    several files (a rotated export) is stitched, not orphaned."""
+    n = 0
+    for i, raw in enumerate(lines, 1):
+        raw = raw.strip()
+        if not raw:
+            continue
+        n += 1
+        try:
+            rec = json.loads(raw, parse_constant=_reject_nonfinite)
+        except ValueError as e:
+            problems.append(f"{name}:{i}: malformed JSON line: {e}")
+            continue
+        if not isinstance(rec, dict):
+            problems.append(f"{name}:{i}: not a JSON object")
+            continue
+        missing = [k for k in SPAN_KEYS if not rec.get(k)]
+        if missing:
+            problems.append(f"{name}:{i}: span missing keys {missing}")
+            continue
+        t = traces.setdefault(rec["traceId"],
+                              {"ids": set(), "roots": [], "parents": []})
+        sid = rec["spanId"]
+        if sid in t["ids"]:
+            problems.append(f"{name}:{i}: duplicate span id {sid} in "
+                            f"trace {rec['traceId']}")
+        t["ids"].add(sid)
+        pid = rec.get("parentSpanId") or ""
+        if pid:
+            t["parents"].append((name, i, sid, pid, rec["name"]))
+        else:
+            t["roots"].append((name, i, sid, rec["name"]))
+    return n
+
+
+def validate_traces(traces: dict, problems: List[str]) -> None:
+    """The rooted-tree contract over an accumulated trace map: exactly
+    one root per trace, every parent id present."""
+    for tid, t in sorted(traces.items()):
+        if len(t["roots"]) != 1:
+            roots = [f"{nm}@{src}:{ln}" for src, ln, _, nm in t["roots"]]
+            problems.append(
+                f"trace {tid}: {len(t['roots'])} root span(s) {roots} — "
+                "the rooted-trace contract requires exactly one")
+        for src, ln, sid, pid, nm in t["parents"]:
+            if pid not in t["ids"]:
+                problems.append(
+                    f"{src}:{ln}: ORPHAN SPAN {nm!r} ({sid}) — parent "
+                    f"{pid} is absent from trace {tid} (a hop dropped "
+                    "its context or a parent span never finished)")
+
+
+def check_spans(lines, name: str = "<stream>") -> List[str]:
+    """The ``--require-rooted-traces`` gate over ONE OTel-style span
+    JSON-lines stream. Returns violation strings (empty = clean)."""
+    problems: List[str] = []
+    traces: dict = {}
+    n = ingest_spans(lines, name, traces, problems)
+    validate_traces(traces, problems)
+    if n == 0:
+        problems.append(f"{name}: span stream is empty (was the bench "
+                        "run with MXTPU_TRACE_SAMPLE=1.0 and "
+                        "--trace-out?)")
+    return problems
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("paths", nargs="+", help="JSON-lines files to check")
@@ -103,10 +185,17 @@ def main(argv=None) -> int:
                     help="fail on ANY event of this kind (repeatable); "
                          "the concurrency CI smoke forbids "
                          "concurrency.inversion")
+    ap.add_argument("--require-rooted-traces", action="store_true",
+                    help="inputs are OTel-style span JSONL "
+                         "(serve_bench --trace-out): every trace must "
+                         "be one rooted tree with zero orphan spans — "
+                         "the trace-smoke CI gate")
     args = ap.parse_args(argv)
 
     problems: List[str] = []
     total_lines = 0
+    span_traces: dict = {}
+    span_lines = 0
     for path in args.paths:
         try:
             with open(path, encoding="utf-8") as f:
@@ -116,9 +205,21 @@ def main(argv=None) -> int:
                   file=sys.stderr)
             return 2
         total_lines += len(lines)
-        problems.extend(check_stream(
-            lines, name=path, allow_post_warmup=args.allow_post_warmup,
-            forbid=args.forbid))
+        if args.require_rooted_traces:
+            # one accumulated trace map across ALL inputs: a trace whose
+            # root and children land in different files of a split/
+            # rotated export must stitch, not read as orphaned
+            span_lines += ingest_spans(lines, path, span_traces, problems)
+        else:
+            problems.extend(check_stream(
+                lines, name=path, allow_post_warmup=args.allow_post_warmup,
+                forbid=args.forbid))
+    if args.require_rooted_traces:
+        validate_traces(span_traces, problems)
+        if span_lines == 0:
+            problems.append("span stream is empty (was the bench run "
+                            "with MXTPU_TRACE_SAMPLE=1.0 and "
+                            "--trace-out?)")
     for p in problems:
         print(p, file=sys.stderr)
     print(f"telemetry_check: {total_lines} line(s) across "
