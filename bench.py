@@ -367,12 +367,17 @@ def run_frcnn(watchdog) -> dict:
 
 #: banked-baseline metrics the --check gate compares (deterministic
 #: functions of the traced graph only — wall-time metrics like
-#: host_gap_ms vary per machine and are reported, never gated)
+#: host_gap_ms vary per machine and are reported, never gated).
+#: graphs_per_step: jitted-executable invocations one steady-state
+#: training step makes — the fused whole-step capture's contract is 1
+#: (guard + optimizer + LR inside the one donated pjit step)
 _PROXY_GATE_KEYS = ("flops_per_step", "bytes_per_step",
-                    "comm_bytes_per_step")
+                    "comm_bytes_per_step", "graphs_per_step")
 #: measured fields excluded from the banked file so re-banking on a
 #: different machine never churns the committed baseline
-_PROXY_VOLATILE_KEYS = ("host_gap_ms", "instrumented_pct")
+_PROXY_VOLATILE_KEYS = ("host_gap_ms", "instrumented_pct",
+                        "host_gap_ms_fused", "host_gap_ms_unfused",
+                        "host_gap_delta_ms")
 
 
 def _proxy_sync(out) -> None:
@@ -464,6 +469,79 @@ def _proxy_compare(current: dict, banked: dict, tol: float):
                     f"({(ratio - 1) * 100:.1f}%) — improvement; re-bank "
                     "the baseline (bench.py --proxy --out PERF_PROXY.json)")
     return failures, warnings
+
+
+def _fused_step_record(steps: int = 6) -> dict:
+    """Device-blind probe of whole-step capture: the SAME tiny guarded +
+    LR-scheduled trainer stepped with the fused step (guard verdict +
+    schedule position inside the one donated pjit graph — the default)
+    and with ``MXTPU_FUSED_STEP=0`` (the before-capture shape: separate
+    jitted finite check, per-step host LR eval + transfer). Banked
+    metrics are deterministic — ``graphs_per_step`` (jitted-executable
+    invocations per steady step: 1 fused vs 2 unfused) and the fused
+    train graph's cost-table numbers; the measured host-gap delta is
+    reported, never gated."""
+    import jax
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import fault, gluon, lr_scheduler, parallel, \
+        profiler, telemetry
+    from incubator_mxnet_tpu.analysis import hlo
+
+    rng = onp.random.RandomState(0)
+    x = rng.randn(16, 64).astype("float32")
+    y = rng.randint(0, 8, (16,)).astype("float32")
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def probe(fused):
+        prev = os.environ.get("MXTPU_FUSED_STEP")
+        os.environ["MXTPU_FUSED_STEP"] = "1" if fused else "0"
+        try:
+            mx.random.seed(7)
+            net = gluon.nn.HybridSequential()
+            net.add(gluon.nn.Dense(128, activation="relu", in_units=64),
+                    gluon.nn.Dense(8, in_units=128))
+            net.initialize(mx.init.Xavier())
+            tr = parallel.ShardedTrainer(
+                net, loss_fn, "adamw",
+                {"learning_rate": 1e-3,
+                 "lr_scheduler": lr_scheduler.CosineScheduler(
+                     max_update=1000, base_lr=1e-3)},
+                mesh=parallel.make_mesh(devices=jax.devices()[:1]),
+                guard=fault.StepGuard(policy="warn"))
+            tr.step(x, y).asnumpy()        # init + compile
+            batch = tr.place(x, y)         # steady state: resident inputs
+            tr.step(*batch).asnumpy()      # warm
+            profiler.reset_spans()
+            for _ in range(steps):
+                tr.step(*batch)
+            sr = profiler.step_report(frame="step")
+            return tr, sr
+        finally:
+            if prev is None:
+                os.environ.pop("MXTPU_FUSED_STEP", None)
+            else:
+                os.environ["MXTPU_FUSED_STEP"] = prev
+
+    tr_fused, sr_fused = probe(True)
+    graphs_fused = tr_fused.last_step_graphs
+    tr_unfused, sr_unfused = probe(False)
+    graphs_unfused = tr_unfused.last_step_graphs
+    rep = hlo.cost(tr_fused, sample_args=(x, y))
+    gap_f = sr_fused["host_gap_ms_mean"]
+    gap_u = sr_unfused["host_gap_ms_mean"]
+    record = {
+        "graphs": len(rep.rows),
+        "graphs_per_step": graphs_fused,
+        "graphs_per_step_unfused": graphs_unfused,
+        "flops_per_step": rep.model_flops_per_step(),
+        "bytes_per_step": rep.bytes_per_step(),
+        "comm_bytes_per_step": rep.comm_bytes_per_step(),
+        "host_gap_ms_fused": gap_f,
+        "host_gap_ms_unfused": gap_u,
+        "host_gap_delta_ms": round(gap_u - gap_f, 4),
+    }
+    telemetry.emit("perf.proxy", family="fused_step", **record)
+    return record
 
 
 def _mesh_step_record(steps: int = 6) -> dict:
@@ -600,6 +678,11 @@ def run_proxy(argv) -> int:
     except RuntimeError as e:
         print(f"bench.py {e}", file=sys.stderr)
         return 2
+    # the train-side record: whole-step capture metrics (fused vs
+    # unfused graph counts + the fused step graph's deterministic cost),
+    # banked under its own "train" section so the serve-family set stays
+    # exactly models.SERVE_SPECS
+    train = {"fused_step": _fused_step_record()}
     mesh_step = None
     if args.mesh_step:
         try:
@@ -630,6 +713,10 @@ def run_proxy(argv) -> int:
                   file=sys.stderr)
         failures, warns = _proxy_compare(
             fams, baseline.get("families", {}), args.tolerance)
+        t_fail, t_warn = _proxy_compare(
+            train, baseline.get("train", {}), args.tolerance)
+        failures += t_fail
+        warns += t_warn
         gate = {"baseline": args.check, "tolerance": args.tolerance,
                 "failures": failures, "warnings": warns}
         for w in warns:
@@ -644,7 +731,11 @@ def run_proxy(argv) -> int:
                   "families": {
                       f: {k: v for k, v in rec.items()
                           if k not in _PROXY_VOLATILE_KEYS}
-                      for f, rec in sorted(fams.items())}}
+                      for f, rec in sorted(fams.items())},
+                  "train": {
+                      f: {k: v for k, v in rec.items()
+                          if k not in _PROXY_VOLATILE_KEYS}
+                      for f, rec in sorted(train.items())}}
         tmp = f"{args.out}.tmp-{os.getpid()}"
         with open(tmp, "w") as f:
             json.dump(banked, f, indent=1, sort_keys=True)
@@ -657,7 +748,7 @@ def run_proxy(argv) -> int:
         "value": total_flops,
         "unit": "flops/step (sum over families)",
         "vs_baseline": None,
-        "extra": {"families": fams, "gate": gate,
+        "extra": {"families": fams, "train": train, "gate": gate,
                   "backend": jax.default_backend()},
     }
     if mesh_step is not None:
@@ -703,7 +794,11 @@ def main(argv=None) -> None:
     trainer = parallel.ShardedTrainer(
         net, models.bert_pretrain_loss, "adamw",
         {"learning_rate": 1e-4, "multi_precision": True}, mesh=mesh,
-        rules=models.bert_sharding_rules(), n_labels=3)
+        rules=models.bert_sharding_rules(), n_labels=3,
+        # banked autotune winners (MXTPU_AUTOTUNE_DIR) apply at build —
+        # a tuned config is reproducible per key, not a one-off env
+        # recipe pasted into a shell
+        autotune_key="bert")
 
     rng = onp.random.RandomState(0)
     ids = rng.randint(0, vocab, (B, L)).astype("int32")

@@ -1,13 +1,20 @@
 #!/usr/bin/env python
 """Config sweep over the headline BERT bench (bench.py) on real hardware.
 
-Each variant runs ``python bench.py`` in its own subprocess (its own device
-client and compile cache) with a different env, so a wedged/crashed config
-can't poison the rest of the sweep. Results append to
+DEPRECATION NOTE: the hand-listed variant set has moved — this script's
+VARIANTS now derive from ``benchmark/autotune.py``'s declared search
+space (:func:`autotune.bench_variants`), the one source of truth for the
+tunable dimensions. For device-blind search over the FULL space (scored
+by the HLO cost model, winners banked into the autotune cache that
+trainer and serve consult), use ``python -m benchmark.autotune``; keep
+this script for validating banked winners on real hardware — each
+variant still runs ``python bench.py`` in its own subprocess (its own
+device client and compile cache) so a wedged/crashed config can't poison
+the rest of the sweep. Results append to
 ``benchmark/sweep_results.jsonl`` and print as a table.
 
-    python benchmark/bert_sweep.py             # the round-3 prepared sweep
-    python benchmark/bert_sweep.py --quick     # default config only
+    python benchmark/bert_sweep.py             # the derived hardware sweep
+    python benchmark/bert_sweep.py --quick     # default config only (smoke)
     python benchmark/bert_sweep.py --trace DIR # + profiler trace of default
 
 Reference counterpart: ``benchmark/opperf`` does per-op timing; this is the
@@ -23,23 +30,16 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-# The prepared follow-up sweep from BASELINE.md round-3 notes: batch/remat
-# rescan under the new adaptive flash tiles, the BK=256 variant, and the
-# one-hot embedding-gradient path.
-VARIANTS = [
-    ("default-B8", {}),
-    ("embed-onehot-grad", {"MXTPU_EMBED_ONEHOT_GRAD": "1"}),
-    ("flash-BK256", {"MXTPU_FLASH_BK": "256"}),
-    ("B16", {"MXTPU_BENCH_BATCH": "16"}),
-    ("B16-remat", {"MXTPU_BENCH_BATCH": "16", "MXTPU_BENCH_REMAT": "1"}),
-    ("B32-remat", {"MXTPU_BENCH_BATCH": "32", "MXTPU_BENCH_REMAT": "1"}),
-    ("B8-onehot+BK256", {"MXTPU_EMBED_ONEHOT_GRAD": "1",
-                         "MXTPU_FLASH_BK": "256"}),
-    # same tokens/step as the headline config, doubled sequence: probes
-    # whether the (512,512) flash tiles hold their efficiency as the
-    # attention share of credited FLOPs grows (L divides the tiles)
-    ("B4-L1024", {"MXTPU_BENCH_BATCH": "4", "MXTPU_BENCH_SEQ": "1024"}),
-]
+try:                              # package import (python -m benchmark...)
+    from . import autotune as _autotune
+except ImportError:               # direct script run
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import autotune as _autotune
+
+# Derived from the autotuner's search-space declaration (the BASELINE.md
+# round-3 prepared sweep: batch/remat rescan under the adaptive flash
+# tiles, the BK=256 variant, the one-hot embedding-gradient path).
+VARIANTS = _autotune.bench_variants()
 
 
 def run_variant(name, env_delta, timeout=1200, trace=None):

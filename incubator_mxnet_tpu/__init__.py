@@ -34,6 +34,7 @@ import importlib as _importlib
 
 _LAZY = {
     "analysis": ".analysis",
+    "autotune": ".autotune",
     "fault": ".fault",
     "gluon": ".gluon",
     "optimizer": ".optimizer",

@@ -1,0 +1,280 @@
+"""On-disk autotune cache — persisted per-config tuning winners.
+
+Reference counterpart: TVM's tuning-log reuse (arXiv 1802.04799) — search
+once, persist the best schedule per (workload, target), and every later
+build consults the log instead of re-searching or hand-picking env knobs.
+Here the "schedule" is a small dict of runtime knobs (flash-attention
+block sizes, embedding-gradient path, remat policy, batch/bucket
+geometry) found by the device-blind search driver
+``benchmark/autotune.py`` and scored by ``analysis.hlo.cost`` plus the
+compile ledger.
+
+Winners persist per ``(model, mesh_shape, chip)`` key with the same
+integrity discipline as :class:`~incubator_mxnet_tpu.serve.artifact_cache
+.ArtifactCache`: canonical-JSON payload + CRC32, written to a temp file
+finalized by one atomic ``os.replace``; a corrupt entry is evicted and
+reported as a miss, never applied.
+
+Both build sites consult the cache when ``MXTPU_AUTOTUNE_DIR`` is set:
+
+- :class:`~incubator_mxnet_tpu.parallel.trainer.ShardedTrainer` before
+  tracing its compiled step (site ``trainer.step`` — the same name its
+  compiles carry on the telemetry compile ledger);
+- :class:`~incubator_mxnet_tpu.serve.compiled.CompiledModel` around each
+  bucket's AOT compile (site ``serve.compiled``).
+
+Every consult publishes an ``autotune.consult`` event carrying the
+ledger site name, so a tuned build is attributable end to end: the
+consult event and the compile record share the site string. Explicitly
+user-set environment variables always win over a cached winner —
+:func:`applied` only fills knobs the environment leaves unset.
+"""
+from __future__ import annotations
+
+import json
+import os
+import zlib
+from contextlib import contextmanager
+from typing import Any, Dict, Optional
+
+from .base import MXNetError
+from .lockcheck import make_lock
+
+__all__ = ["AutotuneCache", "AutotuneCorruptError", "default_cache",
+           "consult", "applied", "mesh_desc", "chip_kind", "TUNABLE_ENV"]
+
+#: env knobs a cached winner may carry — the applied() allowlist, so a
+#: corrupted/hostile cache entry can never set arbitrary variables
+TUNABLE_ENV = (
+    "MXTPU_FLASH_BK", "MXTPU_FLASH_BQ", "MXTPU_EMBED_ONEHOT_GRAD",
+)
+
+_FORMAT = 1
+
+
+class AutotuneCorruptError(MXNetError):
+    """A cache entry exists but fails CRC/format verification."""
+
+
+def mesh_desc(mesh=None) -> str:
+    """Canonical mesh-shape key component: ``"dp2tp4"`` for a configured
+    mesh, ``"single"`` for no mesh / one device. Lookups additionally
+    fall back to ``"any"`` — the key the device-blind search driver
+    banks under (its cost-model score is mesh-portable)."""
+    if mesh is None:
+        return "single"
+    shape = dict(getattr(mesh, "shape", {}) or {})
+    if not shape or all(v == 1 for v in shape.values()):
+        return "single"
+    return "".join(f"{k}{v}" for k, v in sorted(shape.items()))
+
+
+def chip_kind() -> str:
+    """Normalized accelerator kind of the default backend's first device
+    (``"cpu"``, ``"tpu-v5e"``...) — the hardware half of the cache key."""
+    import jax
+    kind = jax.devices()[0].device_kind
+    return str(kind).strip().lower().replace(" ", "-")
+
+
+class AutotuneCache:
+    """Directory of verified tuning winners, one JSON file per key.
+
+    Layout::
+
+        <root>/<model>/<mesh_shape>-<chip>.json
+
+    Each file holds ``{"format", "model", "mesh", "chip", "jax",
+    "config": {"env": {...}, "geometry": {...}}, "score", "meta",
+    "crc"}`` where ``crc`` is the CRC32 of the canonical (sorted-key)
+    JSON of everything else — the same torn-write/bit-rot discipline as
+    the serve artifact cache, sized for a dict instead of StableHLO.
+    """
+
+    def __init__(self, root: str):
+        self.root = root
+        self._lock = make_lock("AutotuneCache._lock")
+        self.stats = {"hits": 0, "misses": 0, "corrupt": 0, "puts": 0}
+
+    # -- key / paths -----------------------------------------------------
+    @staticmethod
+    def _safe(part: str) -> str:
+        keep = [c if (c.isalnum() or c in "._-") else "_" for c in str(part)]
+        return "".join(keep) or "_"
+
+    def entry_path(self, model: str, mesh_shape: str, chip: str) -> str:
+        return os.path.join(self.root, self._safe(model),
+                            f"{self._safe(mesh_shape)}-{self._safe(chip)}"
+                            ".json")
+
+    def _note(self, outcome: str, model: str, mesh_shape: str, chip: str,
+              **fields) -> None:
+        key = {"hit": "hits", "miss": "misses", "corrupt": "corrupt",
+               "put": "puts"}[outcome]
+        with self._lock:
+            self.stats[key] += 1
+        from .telemetry import events as _tele
+        from .telemetry import metrics as _tmetrics
+        _tele.emit("autotune.cache",
+                   severity="warning" if outcome == "corrupt" else "info",
+                   model=model, mesh=mesh_shape, chip=chip,
+                   outcome=outcome, **fields)
+        _tmetrics.counter("mxtpu_autotune_cache_total",
+                          "Autotune-cache lookups/writes by outcome",
+                          outcome=outcome).inc()
+
+    # -- write path ------------------------------------------------------
+    @staticmethod
+    def _payload_crc(doc: Dict[str, Any]) -> int:
+        body = {k: v for k, v in doc.items() if k != "crc"}
+        return zlib.crc32(
+            json.dumps(body, sort_keys=True).encode("utf-8")) & 0xFFFFFFFF
+
+    def put(self, model: str, mesh_shape: str, chip: str,
+            config: Dict[str, Any], score: float,
+            meta: Optional[Dict[str, Any]] = None) -> str:
+        """Persist one winner atomically; returns the entry path.
+        ``config`` splits into ``env`` (the applied knobs, filtered to
+        :data:`TUNABLE_ENV` on read) and free-form ``geometry``."""
+        import jax
+        path = self.entry_path(model, mesh_shape, chip)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        doc = {
+            "format": _FORMAT, "model": str(model),
+            "mesh": str(mesh_shape), "chip": str(chip),
+            "jax": jax.__version__,
+            "config": config, "score": float(score),
+            "meta": dict(meta or {}),
+        }
+        doc["crc"] = self._payload_crc(doc)
+        tmp = f"{path}.tmp-{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(doc, f, indent=1, sort_keys=True)
+                f.write("\n")
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        self._note("put", model, mesh_shape, chip, score=float(score))
+        return path
+
+    # -- read path -------------------------------------------------------
+    def get(self, model: str, mesh_shape: str = "single",
+            chip: Optional[str] = None) -> Optional[Dict[str, Any]]:
+        """Verified lookup → the entry dict on a hit, ``None`` on a
+        miss. Falls back from the exact mesh key to the driver's
+        ``"any"`` key. A corrupt entry (CRC/format mismatch) is evicted
+        and reported as a miss so the caller builds untuned."""
+        chip = chip if chip is not None else chip_kind()
+        for mesh_key in dict.fromkeys((mesh_shape, "any")):
+            path = self.entry_path(model, mesh_key, chip)
+            if not os.path.isfile(path):
+                continue
+            try:
+                entry = self._verify(path)
+            except (AutotuneCorruptError, OSError) as e:
+                self._note("corrupt", model, mesh_key, chip,
+                           error=str(e)[:200])
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                continue
+            self._note("hit", model, mesh_key, chip,
+                       score=entry.get("score"))
+            return entry
+        self._note("miss", model, mesh_shape, chip)
+        return None
+
+    def _verify(self, path: str) -> Dict[str, Any]:
+        try:
+            with open(path) as f:
+                entry = json.load(f)
+        except (OSError, ValueError) as e:
+            raise AutotuneCorruptError(
+                f"{path}: unreadable entry: {e}") from e
+        if not isinstance(entry, dict) or entry.get("format") != _FORMAT:
+            raise AutotuneCorruptError(
+                f"{path}: unknown format {entry.get('format')!r}"
+                if isinstance(entry, dict) else f"{path}: not an object")
+        if self._payload_crc(entry) != entry.get("crc"):
+            raise AutotuneCorruptError(
+                f"{path}: checksum mismatch (entry {entry.get('crc')}, "
+                f"payload {self._payload_crc(entry)})")
+        return entry
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self.stats)
+
+
+# -- build-time consult ------------------------------------------------------
+
+def enabled() -> bool:
+    """True when builds should consult the cache: ``MXTPU_AUTOTUNE_DIR``
+    names a directory and ``MXTPU_AUTOTUNE`` is not ``0``. Both reads
+    are plain env lookups — the off path costs nothing on the hot
+    build."""
+    return bool(os.environ.get("MXTPU_AUTOTUNE_DIR")) \
+        and os.environ.get("MXTPU_AUTOTUNE", "1") == "1"
+
+
+def default_cache() -> Optional[AutotuneCache]:
+    """The process cache at ``MXTPU_AUTOTUNE_DIR`` (None when consulting
+    is disabled). Constructed per call — the object is a thin path
+    wrapper; entries live on disk."""
+    if not enabled():
+        return None
+    return AutotuneCache(os.environ["MXTPU_AUTOTUNE_DIR"])
+
+
+def consult(site: str, model: str, mesh=None) -> Optional[Dict[str, Any]]:
+    """Build-time lookup for ``site`` (the compile-ledger site name the
+    caller's compiles are recorded under — ``trainer.step`` /
+    ``serve.compiled``). Returns the winning entry or ``None``; emits
+    one ``autotune.consult`` event either way so a tuned build is
+    attributable to its cache entry on the same timeline as its compile
+    record."""
+    cache = default_cache()
+    if cache is None:
+        return None
+    entry = cache.get(model, mesh_desc(mesh))
+    from .telemetry import events as _tele
+    _tele.emit("autotune.consult", site=site, model=model,
+               mesh=mesh_desc(mesh), chip=chip_kind(),
+               outcome="hit" if entry is not None else "miss",
+               config=(entry or {}).get("config"),
+               score=(entry or {}).get("score"))
+    return entry
+
+
+@contextmanager
+def applied(entry: Optional[Dict[str, Any]], force: bool = False):
+    """Overlay a winner's env knobs for the duration of a trace/compile.
+
+    Only keys in :data:`TUNABLE_ENV` apply, and (unless ``force``) only
+    keys the user did NOT set explicitly — an operator's hand-pinned
+    ``MXTPU_FLASH_BK`` beats the cache. Values restore on exit, so the
+    overlay is scoped to the build, not leaked into the process."""
+    env = {}
+    if entry:
+        cfg = entry.get("config", entry)
+        env = {k: str(v) for k, v in (cfg.get("env") or {}).items()
+               if k in TUNABLE_ENV and v is not None
+               and (force or k not in os.environ)}
+    saved = {k: os.environ.get(k) for k in env}
+    try:
+        os.environ.update(env)
+        yield env
+    finally:
+        for k, prev in saved.items():
+            if prev is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = prev

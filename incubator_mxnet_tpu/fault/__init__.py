@@ -32,7 +32,7 @@ Typical wiring::
     guard = mx.fault.StepGuard(policy="skip_and_rollback")
     trainer = mx.parallel.ShardedTrainer(net, loss_fn, "adamw", ...,
                                          guard=guard,
-                                         watchdog=mx.fault.Watchdog(30.0))
+                                         watchdog=mx.fault.Watchdog())
     for step, (x, y) in enumerate(batches):
         trainer.step(x, y)
         if step % 100 == 0:

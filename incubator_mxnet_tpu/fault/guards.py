@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 
 from ..base import MXNetError
+from ..lockcheck import make_lock
 
 __all__ = ["StepGuard", "NonFiniteError", "all_finite", "POLICIES"]
 
@@ -46,11 +47,32 @@ def _tree_finite(tree) -> jax.Array:
         ok = jnp.logical_and(ok, jnp.isfinite(l).all())
     return ok
 
+# a NEW (shape, dtype)-structure through the jitted finite check is an
+# extra XLA compile — noted on the process-wide ledger so "how many
+# jitted graphs does one training step run" is answerable from the
+# ledger alone (ShardedTrainer's fused whole-step capture folds this
+# check into the step graph; only the unfused path lands entries here)
+_SIG_LOCK = make_lock("guards._SIG_LOCK")
+_SEEN_SIGS: set = set()
+
 
 def all_finite(*trees) -> bool:
     """One fused device reduction over every inexact leaf of the given
     pytrees → a host bool (a single scalar transfer, however many arrays).
-    Non-float leaves (int labels, step counters) are ignored."""
+    Non-float leaves (int labels, step counters) are ignored. This is a
+    SEPARATE jitted call — a training loop that wants the check for free
+    uses the fused step's in-graph verdict instead."""
+    leaves, treedef = jax.tree_util.tree_flatten(trees)
+    sig = (str(treedef), tuple(
+        (tuple(getattr(l, "shape", ()) or ()), str(getattr(l, "dtype", "?")))
+        for l in leaves))
+    with _SIG_LOCK:
+        new = sig not in _SEEN_SIGS
+        if new:
+            _SEEN_SIGS.add(sig)
+    if new:
+        from ..telemetry import compile_log as _clog
+        _clog.note("fault.guards.finite", sig)
     return bool(_tree_finite(trees))
 
 

@@ -5,7 +5,7 @@ Reference counterpart: the reference engine's only hang story was
 classic silent stall is a *recompile storm* (every step re-traces because a
 static arg churns — seconds per step, no error anywhere), or a collective
 waiting on a dead peer. The watchdog is a daemon timer armed around each
-step: past ``deadline`` it fires ONCE for that step and dumps a diagnostic
+step: past the deadline it fires ONCE for that step and dumps a diagnostic
 — elapsed time, the block's live jit-compile count and most recent
 signatures (from :mod:`..analysis.recompile`'s accounting), i.e. the "last
 op" provenance a hung run needs — via ``warnings.warn`` and the
@@ -13,10 +13,22 @@ op" provenance a hung run needs — via ``warnings.warn`` and the
 interrupted mid-flight; the watchdog's job is attribution, the recovery
 decision stays with the caller (checkpoint + restart).
 
-Usage (``ShardedTrainer(watchdog=Watchdog(deadline=30))`` does this for
-you)::
+**Picking the deadline.** A fixed number calibrated for the ~40ms
+dispatch-tax era reads as noise today: the compiled whole-step path runs
+~0.7ms/step, so a deadline loose enough for the old dispatch overhead is
+4-5 orders of magnitude above steady state and only ever catches total
+wedges. Default (``deadline=None``) is therefore *adaptive*: each step's
+deadline is ``ADAPTIVE_MULT`` (50×) the EMA of recent step wall time,
+floored at ``ADAPTIVE_FLOOR_S`` so sub-millisecond steps don't arm a
+hair-trigger, and the first steps (compile included) get
+``WARMUP_DEADLINE_S`` of headroom. A 0.7ms step tripping means the step
+really stalled (a recompile, a dead collective peer), not that the
+constant drifted out of date. Pass an explicit ``deadline=`` seconds to
+pin the old fixed behavior.
 
-    wd = fault.Watchdog(deadline=30.0)
+Usage (``ShardedTrainer(watchdog=Watchdog())`` does this for you)::
+
+    wd = fault.Watchdog()                # adaptive deadline
     with wd.watch(step=trainer.num_update, block=net):
         trainer.step(x, y)
     if wd.flags: ...
@@ -30,7 +42,21 @@ from typing import Any, Callable, List, Optional
 
 from ..lockcheck import make_lock
 
-__all__ = ["Watchdog", "WatchdogFlag"]
+__all__ = ["Watchdog", "WatchdogFlag", "WARMUP_DEADLINE_S",
+           "ADAPTIVE_MULT", "ADAPTIVE_FLOOR_S"]
+
+#: adaptive-mode deadline while no steady-state sample exists yet — the
+#: first step carries the XLA compile (seconds to minutes for a big step
+#: graph), which must not read as a stall
+WARMUP_DEADLINE_S = 300.0
+#: adaptive-mode multiplier over the step-time EMA: 50× the 0.7ms fused
+#: step is 35ms — still instant against a real stall, far above jitter
+ADAPTIVE_MULT = 50.0
+#: adaptive-mode floor: sub-millisecond steps keep a 2s deadline so GC
+#: pauses / data hiccups don't page anyone
+ADAPTIVE_FLOOR_S = 2.0
+#: EMA smoothing for observed step wall times
+_EMA_ALPHA = 0.2
 
 
 class WatchdogFlag:
@@ -57,18 +83,44 @@ class WatchdogFlag:
 class Watchdog:
     """Arms a timer per step; fires at most once per step.
 
-    ``deadline``  seconds a step may take before flagging
+    ``deadline``  seconds a step may take before flagging; ``None``
+                  (default) = adaptive — ``ADAPTIVE_MULT`` × the EMA of
+                  observed step time, floored at ``ADAPTIVE_FLOOR_S``,
+                  with ``WARMUP_DEADLINE_S`` until the first completed
+                  step seeds the EMA (compile headroom)
     ``on_flag``   optional callback ``(WatchdogFlag)`` — alerting seam;
                   the default also ``warnings.warn``\\ s every flag
     """
 
-    def __init__(self, deadline: float,
+    def __init__(self, deadline: Optional[float] = None,
                  on_flag: Optional[Callable[[WatchdogFlag], None]] = None):
-        self.deadline = float(deadline)
+        self.deadline = None if deadline is None else float(deadline)
         self.on_flag = on_flag
         self.flags: List[WatchdogFlag] = []
         self._timer: Optional[threading.Timer] = None
+        self._ema_s: Optional[float] = None
+        self._warmup_seen = False    # adaptive: first watched step = compile
         self._lock = make_lock("Watchdog._lock")
+
+    # -- adaptive deadline ----------------------------------------------
+    def observe(self, wall_s: float) -> None:
+        """Feed one completed step's wall time into the adaptive EMA
+        (``watch`` does this automatically for unflagged steps)."""
+        with self._lock:
+            self._ema_s = (float(wall_s) if self._ema_s is None else
+                           (1 - _EMA_ALPHA) * self._ema_s
+                           + _EMA_ALPHA * float(wall_s))
+
+    def deadline_for_step(self) -> float:
+        """The deadline the next armed step runs under: the fixed value
+        when one was given, else the recalibrated adaptive bound."""
+        if self.deadline is not None:
+            return self.deadline
+        with self._lock:
+            ema = self._ema_s
+        if ema is None:
+            return WARMUP_DEADLINE_S
+        return max(ADAPTIVE_FLOOR_S, ADAPTIVE_MULT * ema)
 
     # -- accounting ------------------------------------------------------
     @staticmethod
@@ -85,9 +137,10 @@ class Watchdog:
         for child in getattr(block, "_children", {}).values():
             yield from Watchdog._blocks(child)
 
-    def _fire(self, step: int, t0: float, block: Any) -> None:
+    def _fire(self, step: int, t0: float, block: Any,
+              deadline: float) -> None:
         compiles, recent = self._compile_state(block)
-        flag = WatchdogFlag(step, self.deadline, time.monotonic() - t0,
+        flag = WatchdogFlag(step, deadline, time.monotonic() - t0,
                             compiles, recent)
         with self._lock:
             self.flags.append(flag)
@@ -98,7 +151,7 @@ class Watchdog:
         from ..telemetry import events as _tele
         from ..telemetry import metrics as _tmetrics
         _tele.emit("watchdog", severity="warning", step=step,
-                   deadline_s=self.deadline,
+                   deadline_s=deadline,
                    elapsed_s=round(flag.elapsed, 3),
                    compiles=compiles, recent_signatures=recent)
         _tmetrics.counter("mxtpu_watchdog_flags_total",
@@ -107,7 +160,7 @@ class Watchdog:
         # step is wedged and the operator's next move may be kill -9 —
         # capture the rings NOW, while they still exist
         from ..telemetry import flight as _flight
-        _flight.dump("watchdog", step=step, deadline_s=self.deadline,
+        _flight.dump("watchdog", step=step, deadline_s=deadline,
                      elapsed_s=round(flag.elapsed, 3),
                      compiles=compiles, recent_signatures=recent)
         warnings.warn(f"[fault.watchdog] {flag}")
@@ -118,12 +171,16 @@ class Watchdog:
     class _Watch:
         def __init__(self, wd: "Watchdog", step: int, block: Any):
             self._wd, self._step, self._block = wd, step, block
+            self._t0 = 0.0
+            self._deadline = 0.0
 
         def __enter__(self):
             wd = self._wd
-            t0 = time.monotonic()
+            self._t0 = t0 = time.monotonic()
+            self._deadline = wd.deadline_for_step()
             wd._timer = threading.Timer(
-                wd.deadline, wd._fire, args=(self._step, t0, self._block))
+                self._deadline, wd._fire,
+                args=(self._step, t0, self._block, self._deadline))
             # Timer's ctor takes neither name nor daemon: set both as
             # attributes before start() so hang dumps and the lockcheck
             # timeline can attribute the firing thread
@@ -133,15 +190,29 @@ class Watchdog:
             return wd
 
         def __exit__(self, *exc):
-            t = self._wd._timer
-            self._wd._timer = None
+            wd = self._wd
+            t = wd._timer
+            wd._timer = None
             if t is not None:
                 t.cancel()
+            elapsed = time.monotonic() - self._t0
+            if wd.deadline is None and not wd._warmup_seen:
+                # adaptive mode discards its FIRST watched step: that one
+                # carries the XLA compile, and seeding the EMA with it
+                # would leave deadlines at 50x compile time for dozens of
+                # steps — exactly the stall-blindness being recalibrated
+                # away
+                wd._warmup_seen = True
+            elif elapsed < self._deadline:
+                # only clean steps recalibrate the adaptive bound — a
+                # flagged stall must not stretch the next deadline
+                wd.observe(elapsed)
 
     def watch(self, step: int, block: Any = None) -> "Watchdog._Watch":
         """Context manager arming the deadline around one step."""
         return Watchdog._Watch(self, step, block)
 
     def __repr__(self):
-        return (f"Watchdog(deadline={self.deadline}, "
-                f"flags={len(self.flags)})")
+        dl = ("adaptive" if self.deadline is None
+              else f"{self.deadline}")
+        return f"Watchdog(deadline={dl}, flags={len(self.flags)})"

@@ -29,7 +29,7 @@ from .. import recordio as rec_mod
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
            "LibSVMIter", "MNISTIter", "ImageRecordIter", "PrefetchingIter",
-           "ResizeIter"]
+           "PrefetchIter", "ResizeIter"]
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape", "dtype", "layout"])):
@@ -460,6 +460,176 @@ class PrefetchingIter(DataIter):
 
     def iter_next(self) -> bool:
         raise MXNetError("PrefetchingIter supports iteration via next() only")
+
+    @property
+    def provide_data(self):
+        return self._it.provide_data
+
+    @property
+    def provide_label(self):
+        return self._it.provide_label
+
+
+class PrefetchIter(DataIter):
+    """Async double-buffered DEVICE prefetch over any :class:`DataIter`.
+
+    Where :class:`PrefetchingIter` overlaps host-side decode with
+    compute, this wrapper additionally runs a *placement* function on the
+    worker thread — typically ``ShardedTrainer.place`` — so the
+    host→device hop of batch N+1 (and N+2, with the default ``depth=2``
+    double buffer) proceeds while the compiled step is executing batch N.
+    Input placement never serializes with the step: the training loop's
+    per-step host work drops to one queue pop::
+
+        it = mx.io.PrefetchIter(
+            base_iter, place=lambda b: trainer.place(*b.data, *b.label))
+        for placed in it:
+            trainer.step(*placed)
+
+    ``place`` takes the wrapped iterator's :class:`DataBatch` and may
+    return anything (default: the batch unchanged — pure async
+    prefetch). Batches arrive strictly in the wrapped iterator's order.
+    A ``place``/iterator exception is captured on the worker and
+    re-raised from :meth:`next` — never swallowed. The worker is one
+    named daemon thread (``mx-io-device-prefetch``, lockcheck/MX804
+    conventions); :meth:`close` (or ``with`` exit) shuts it down and
+    joins it, :meth:`reset` restarts the stream from the wrapped
+    iterator's top.
+    """
+
+    _DONE = object()
+
+    def __init__(self, data_iter, place=None, depth: int = 2):
+        if depth < 1:
+            raise MXNetError("PrefetchIter depth must be >= 1")
+        super().__init__(getattr(data_iter, "batch_size", 0))
+        self._it = data_iter
+        self._place = place
+        self._depth = depth
+        self._queue: _queue.Queue = _queue.Queue(maxsize=depth)
+        self._worker: Optional[threading.Thread] = None
+        self._exc: Optional[BaseException] = None
+        self._done = False           # stream ended (worker queues _DONE once)
+        self._gen = 0
+        self._closed = False
+        self._start()
+
+    def _start(self):
+        gen = self._gen
+        q = self._queue
+
+        def run():
+            # A stale generation (reset()/close() bumped self._gen) stops
+            # touching the shared underlying iterator and exits without
+            # queueing its sentinel.
+            tail = None
+            try:
+                while gen == self._gen:
+                    try:
+                        b = self._it.next()
+                    except StopIteration:
+                        tail = PrefetchIter._DONE
+                        break
+                    except BaseException as e:  # surfaced to the consumer
+                        self._exc = e
+                        tail = PrefetchIter._DONE
+                        break
+                    if self._place is not None:
+                        try:
+                            # the device hop happens HERE, on the worker —
+                            # overlapped with the step consuming the
+                            # previous batch
+                            b = self._place(b)
+                        except BaseException as e:
+                            self._exc = e
+                            tail = PrefetchIter._DONE
+                            break
+                    while gen == self._gen:
+                        try:
+                            q.put(b, timeout=0.05)
+                            break
+                        except _queue.Full:
+                            continue
+            finally:
+                while tail is not None and gen == self._gen:
+                    try:
+                        q.put(tail, timeout=0.05)
+                        break
+                    except _queue.Full:
+                        continue
+
+        self._worker = threading.Thread(target=run,
+                                        name="mx-io-device-prefetch",
+                                        daemon=True)
+        self._worker.start()
+
+    def _stop_worker(self) -> bool:
+        """Signal + join the worker; True when it actually exited."""
+        self._gen += 1  # signal the worker to exit
+        try:
+            while True:
+                self._queue.get_nowait()
+        except _queue.Empty:
+            pass
+        if self._worker is not None:
+            self._worker.join(timeout=5)
+            if self._worker.is_alive():
+                return False
+            self._worker = None
+        return True
+
+    def reset(self):
+        if self._closed:
+            raise MXNetError("PrefetchIter is closed")
+        if not self._stop_worker():
+            # the old worker is still blocked inside the wrapped
+            # iterator/place call — starting a second one would drive the
+            # same (non-thread-safe) iterator from two threads; fail loud
+            raise MXNetError(
+                "PrefetchIter worker did not stop within 5s (the wrapped "
+                "iterator or place() is blocked); cannot reset safely")
+        self._exc = None
+        self._done = False
+        self._it.reset()
+        self._queue = _queue.Queue(maxsize=self._depth)
+        self._start()
+
+    def close(self):
+        """Stop and join the worker thread (idempotent). The wrapped
+        iterator is left as-is — mid-stream batches it already produced
+        into the dropped queue are consumed, matching any prefetcher's
+        read-ahead semantics."""
+        if self._closed:
+            return
+        self._closed = True
+        self._stop_worker()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def next(self):
+        if self._closed:
+            raise MXNetError("PrefetchIter is closed")
+        if self._done:
+            # the worker queued its sentinel exactly once and exited; any
+            # further next() must keep raising (matching plain iterators)
+            # instead of blocking forever on an empty, producer-less queue
+            if self._exc is not None:
+                raise self._exc
+            raise StopIteration
+        b = self._queue.get()
+        if b is PrefetchIter._DONE:
+            self._done = True
+            if self._exc is not None:
+                raise self._exc
+            raise StopIteration
+        return b
+
+    def iter_next(self) -> bool:
+        raise MXNetError("PrefetchIter supports iteration via next() only")
 
     @property
     def provide_data(self):

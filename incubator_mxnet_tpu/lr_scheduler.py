@@ -28,6 +28,39 @@ class LRScheduler:
     def __call__(self, num_update: int) -> float:
         raise NotImplementedError
 
+    # -- traced twin (whole-step capture) ------------------------------
+    def _jax_warmup_lr(self, t):
+        """Traced ``get_warmup_lr``: ``t`` is a device int32 scalar."""
+        import jax.numpy as jnp
+        tf = t.astype(jnp.float32)
+        span = jnp.float32(self.warmup_final_lr - self.warmup_begin_lr)
+        if self.warmup_mode == "linear":
+            return jnp.float32(self.warmup_begin_lr) \
+                + span * tf / jnp.float32(self.warmup_steps)
+        return jnp.float32(self.warmup_begin_lr) + span * (
+            1.0 - jnp.exp(-tf / max(self.warmup_steps / 5.0, 1e-8)))
+
+    def _jax_main_lr(self, t):
+        """Post-warmup schedule as a traced function of the device step
+        counter; subclasses implement this half of :meth:`jax_lr`."""
+        raise NotImplementedError
+
+    def jax_lr(self, t):
+        """The schedule as a traced jax expression of the device-resident
+        update counter — the LR-schedule *position* folded into the one
+        compiled training step (ShardedTrainer's whole-step capture), so
+        a scheduled run pays no per-step host LR evaluation + transfer.
+        Warmup is a ``where`` select, not Python control flow: one graph
+        covers the whole run. Matches :meth:`__call__` up to float32
+        device arithmetic (the host twin computes in float64)."""
+        import jax.numpy as jnp
+        t = jnp.maximum(t, 0)
+        main = self._jax_main_lr(t)
+        if not self.warmup_steps:
+            return main.astype(jnp.float32)
+        return jnp.where(t < self.warmup_steps, self._jax_warmup_lr(t),
+                         main).astype(jnp.float32)
+
 
 class FactorScheduler(LRScheduler):
     def __init__(self, step: int, factor=1.0, stop_factor_lr=1e-8, base_lr=0.01, **kw):
@@ -43,6 +76,12 @@ class FactorScheduler(LRScheduler):
         lr = self.base_lr * (self.factor ** (num_update // self.step))
         return max(lr, self.stop_factor_lr)
 
+    def _jax_main_lr(self, t):
+        import jax.numpy as jnp
+        n = (t // self.step).astype(jnp.float32)
+        lr = jnp.float32(self.base_lr) * jnp.float32(self.factor) ** n
+        return jnp.maximum(lr, jnp.float32(self.stop_factor_lr))
+
 
 class MultiFactorScheduler(LRScheduler):
     def __init__(self, step: List[int], factor=1.0, base_lr=0.01, **kw):
@@ -55,6 +94,11 @@ class MultiFactorScheduler(LRScheduler):
             return self.get_warmup_lr(num_update)
         n = sum(1 for s in self.step if s <= num_update)
         return self.base_lr * (self.factor ** n)
+
+    def _jax_main_lr(self, t):
+        import jax.numpy as jnp
+        n = sum((t >= s).astype(jnp.float32) for s in self.step)
+        return jnp.float32(self.base_lr) * jnp.float32(self.factor) ** n
 
 
 class PolyScheduler(LRScheduler):
@@ -71,6 +115,16 @@ class PolyScheduler(LRScheduler):
         frac = 1.0 - t / max(self.max_update - self.warmup_steps, 1)
         return self.final_lr + (self.base_lr - self.final_lr) * (frac ** self.power)
 
+    def _jax_main_lr(self, t):
+        import jax.numpy as jnp
+        span = max(self.max_update - self.warmup_steps, 1)
+        tt = jnp.minimum((t - self.warmup_steps).astype(jnp.float32),
+                         jnp.float32(span))
+        frac = 1.0 - tt / jnp.float32(span)
+        return jnp.float32(self.final_lr) \
+            + jnp.float32(self.base_lr - self.final_lr) \
+            * frac ** jnp.float32(self.power)
+
 
 class CosineScheduler(LRScheduler):
     def __init__(self, max_update: int, base_lr=0.01, final_lr=0.0, **kw):
@@ -85,6 +139,16 @@ class CosineScheduler(LRScheduler):
         frac = t / max(self.max_update - self.warmup_steps, 1)
         return self.final_lr + (self.base_lr - self.final_lr) * 0.5 * (1 + math.cos(math.pi * frac))
 
+    def _jax_main_lr(self, t):
+        import jax.numpy as jnp
+        span = max(self.max_update - self.warmup_steps, 1)
+        tt = jnp.minimum((t - self.warmup_steps).astype(jnp.float32),
+                         jnp.float32(span))
+        frac = tt / jnp.float32(span)
+        return jnp.float32(self.final_lr) \
+            + jnp.float32(self.base_lr - self.final_lr) * 0.5 \
+            * (1.0 + jnp.cos(jnp.float32(math.pi) * frac))
+
 
 class LinearWarmUp(LRScheduler):
     """Wrap another scheduler with linear warmup (GluonNLP-style)."""
@@ -97,3 +161,8 @@ class LinearWarmUp(LRScheduler):
         if num_update < self.warmup_steps:
             return self.get_warmup_lr(num_update)
         return self.schedule(num_update)
+
+    def _jax_main_lr(self, t):
+        # the wrapped schedule applies its own warmup select (usually a
+        # no-op: warmup_steps=0 on the inner schedule)
+        return self.schedule.jax_lr(t)

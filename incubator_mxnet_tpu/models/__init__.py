@@ -105,55 +105,72 @@ class _NMTEncodeEntry(HybridBlock):
                             mask.reshape((B, 1, 1, L)))
 
 
-def hlo_smoke(family: str) -> dict:
+def hlo_smoke(family: str, batch: int = None, seq: int = None) -> dict:
     """Small live instance of one serving family for compiled-graph
     analysis (``mxlint --hlo`` / CI ``hlo-lint``): returns ``{"block",
     "example_args", "table", "spec", "compiled"}`` sized so every bucket
     traces in milliseconds on CPU. ``compiled`` is THE un-warmed
     ``serve.CompiledModel`` every gate analyzes (building it never
     XLA-compiles — only :meth:`~...serve.CompiledModel.warmup` does), so
-    the CLI target and the tests provably check the same object shape."""
+    the CLI target and the tests provably check the same object shape.
+
+    ``batch``/``seq`` override the bucket geometry with a SINGLE bucket
+    of that size (example args sized to fill it) — the knob
+    ``benchmark.autotune`` turns to price batch/bucket-geometry
+    candidates through the exact entry the gates analyze. Defaults keep
+    the historical two-bucket ladders, so every existing caller traces
+    byte-identical graphs."""
     import numpy as onp
 
     from .. import nd, serve
 
     spec = serve_spec(family)
+    B = int(batch) if batch else 2
+    batch_lad = (int(batch),) if batch else (1, 4)
+    L = int(seq) if seq else 16
+    seq_lad = (int(seq),) if seq else (8, 16)
     if family in ("bert", "bert_encoder"):
-        vocab, L, P = 1000, 16, 4
+        vocab, P = 1000, 4
+        if L > 32:
+            raise ValueError(f"hlo_smoke({family!r}) probe caps seq at 32 "
+                             f"(position table), got {L}")
         net = get_bert("bert_2_128_2", vocab_size=vocab, max_length=32,
                        dropout=0.1, use_decoder=(family == "bert"),
                        use_classifier=(family == "bert"))
         net.initialize()
         net.hybridize()
-        ids = nd.array(onp.ones((2, L), "int32"))
-        tt = nd.array(onp.zeros((2, L), "int32"))
-        vl = nd.array(onp.full((2,), L, "float32"))
+        ids = nd.array(onp.ones((B, L), "int32"))
+        tt = nd.array(onp.zeros((B, L), "int32"))
+        vl = nd.array(onp.full((B,), L, "float32"))
         if family == "bert":
-            pos = nd.array(onp.zeros((2, P), "int32"))
+            pos = nd.array(onp.zeros((B, P), "int32"))
             args = (ids, tt, vl, pos)
         else:
             args = (ids, tt, vl)
-        table = serve.BucketTable({"batch": (1, 4), "seq": (8, 16)})
+        table = serve.BucketTable({"batch": batch_lad, "seq": seq_lad})
     elif family == "lenet":
         net = LeNet()
         net.initialize()
         net.hybridize()
-        args = (nd.array(onp.zeros((2, 1, 28, 28), "float32")),)
-        table = serve.BucketTable({"batch": (1, 4)})
+        args = (nd.array(onp.zeros((B, 1, 28, 28), "float32")),)
+        table = serve.BucketTable({"batch": batch_lad})
     elif family == "transformer_encoder":
         net = StackedTransformerEncoder(num_layers=2, units=32,
                                         hidden_size=64, num_heads=2)
         net.initialize()
         net.hybridize()
-        args = (nd.array(onp.zeros((2, 16, 32), "float32")),)
-        table = serve.BucketTable({"batch": (1, 4), "seq": (8, 16)})
+        args = (nd.array(onp.zeros((B, L, 32), "float32")),)
+        table = serve.BucketTable({"batch": batch_lad, "seq": seq_lad})
     elif family == "nmt_encoder":
+        if L > 32:
+            raise ValueError(f"hlo_smoke({family!r}) probe caps seq at 32 "
+                             f"(position table), got {L}")
         net = _NMTEncodeEntry()
         net.initialize()
         net.hybridize()
-        args = (nd.array(onp.ones((2, 16), "int32")),
-                nd.array(onp.full((2,), 16, "float32")))
-        table = serve.BucketTable({"batch": (1, 4), "seq": (8, 16)})
+        args = (nd.array(onp.ones((B, L), "int32")),
+                nd.array(onp.full((B,), L, "float32")))
+        table = serve.BucketTable({"batch": batch_lad, "seq": seq_lad})
     else:
         raise KeyError(f"no hlo smoke model for {family!r}; known: "
                        f"{sorted(SERVE_SPECS)}")
@@ -161,6 +178,7 @@ def hlo_smoke(family: str) -> dict:
     compiled = serve.CompiledModel(net, table, spec["input_axes"],
                                    example_args=args,
                                    output_axes=spec["output_axes"],
-                                   pad_values=spec["pad_values"])
+                                   pad_values=spec["pad_values"],
+                                   autotune_key=family)
     return {"block": net, "example_args": args, "table": table,
             "spec": spec, "compiled": compiled}

@@ -23,6 +23,16 @@ host-side per-parameter exchange over a kvstore backend (``dist_async``
 keeps its reconnect/exactly-once-resend semantics untouched) — every other
 configuration runs ONE compiled call with zero per-parameter host work.
 
+Whole-step capture (default, ``MXTPU_FUSED_STEP=0`` opts out) finishes
+the job: the guard's finite verdict and the LR-schedule position are
+computed INSIDE that one donated graph (loss/grad-norm/ok come back as
+pinned replicated outputs; the rollback decision stays on host), so a
+guarded, LR-scheduled step is still exactly one jitted graph + one host
+sync per step. Builds consult the on-disk autotune cache
+(``MXTPU_AUTOTUNE_DIR`` — winners banked by ``benchmark/autotune.py``
+per (model, mesh_shape, chip)) and overlay the winning env knobs for
+exactly the first-trace scope.
+
 Usage::
 
     mesh = parallel.make_mesh(dp=2, tp=4)
@@ -76,7 +86,9 @@ class ShardedTrainer:
                  rules: Optional[ShardingRules] = None,
                  n_labels: int = 1, seq_axis: Optional[int] = None,
                  donate: bool = True, zero1: Optional[bool] = None,
-                 kvstore=None, guard=None, watchdog=None):
+                 kvstore=None, guard=None, watchdog=None,
+                 fused: Optional[bool] = None,
+                 autotune_key: Optional[str] = None):
         self._block = block
         self._loss_fn = loss_fn
         self._optimizer = opt_mod.create(
@@ -105,6 +117,26 @@ class ShardedTrainer:
         self._grad_fn = None         # compiled fwd+bwd (fallback path)
         self._step_ndims = None      # batch ranks the built step was pinned to
         self.last_path: Optional[str] = None
+        #: whole-step capture (default on, MXTPU_FUSED_STEP=0 opts out):
+        #: the guard's finite verdict and the LR-schedule position are
+        #: computed INSIDE the one donated pjit step — loss/grad-norm/ok
+        #: come back as pinned replicated outputs, so a guarded,
+        #: scheduled step runs exactly ONE jitted graph with one host
+        #: sync; the unfused path keeps the PR-2-era shape (separate
+        #: jitted finite check, per-step host LR eval + transfer)
+        self._fused = (os.environ.get("MXTPU_FUSED_STEP", "1") == "1"
+                       if fused is None else bool(fused))
+        self._lr_fold = False        # schedule folded into the step graph
+        #: jitted-executable invocations the last step() made (fused: 1;
+        #: unfused + guard: 2 — the bench.py --proxy fused_step record)
+        self.last_step_graphs = 0
+        #: autotune-cache key (benchmark/autotune.py winners); default =
+        #: the block's class name lowercased — drivers pass the family
+        #: name ("bert") so the banked winner and the build agree
+        self._autotune_key = (autotune_key
+                              or type(block).__name__.lower())
+        self._tuned = None           # consult result, resolved at build
+        self.autotune_entry: Optional[Dict[str, Any]] = None
         self._params = None          # sorted List[Parameter]
         self._param_vals = None      # tuple of sharded jax arrays
         self._opt_states = None      # tuple of per-param state tuples
@@ -248,12 +280,14 @@ class ShardedTrainer:
         """The explicit pjit resource contract of the compiled step:
         ``(in_shardings, out_shardings)`` NamedSharding pytrees matching
         ``step(param_vals, opt_states, key, lr, t, *batch)`` →
-        ``(loss, gnorm, new_vals, new_states, effects, t+1)``. Scalars and
-        the RNG key replicate; parameters/optimizer shards carry their
-        rule (+ zero1 ``dp``) layouts in AND out, so the optimizer update
-        is compiled cross-replica sharded and the next call sees identical
-        placements (no silent re-trace); batch arguments take the
-        batch-over-``dp`` / seq-over-``sp`` data sharding."""
+        ``(loss, gnorm, new_vals, new_states, effects, t+1[, ok])``
+        (``ok`` — the in-graph guard verdict — only on the fused path).
+        Scalars and the RNG key replicate; parameters/optimizer shards
+        carry their rule (+ zero1 ``dp``) layouts in AND out, so the
+        optimizer update is compiled cross-replica sharded and the next
+        call sees identical placements (no silent re-trace); batch
+        arguments take the batch-over-``dp`` / seq-over-``sp`` data
+        sharding."""
         repl = NamedSharding(self._mesh, P())
         batch_sh = tuple(
             data_sharding(self._mesh, batch_axis=0, seq_axis=self._seq_axis,
@@ -264,6 +298,10 @@ class ShardedTrainer:
         # effects (aux state: batchnorm running stats) replicate — a repl
         # prefix broadcasts over that subtree whatever its arity
         out_shardings = (repl, repl, params_sh, states_sh, repl, repl)
+        if self._fused:
+            # the guard verdict: a pinned replicated scalar, read back in
+            # the SAME host sync as loss/grad-norm
+            out_shardings = out_shardings + (repl,)
         return in_shardings, out_shardings
 
     def _make_loss_grads(self, n_data: int) -> Callable:
@@ -316,8 +354,29 @@ class ShardedTrainer:
         state_shardings = self._state_shardings
         lr_mults, wds, mp = self._per_param_hparams()
         loss_grads = self._make_loss_grads(n_data)
+        fused = self._fused
+        # LR-schedule position folded into the graph (whole-step capture):
+        # with a traceable scheduler the per-step LR is a function of the
+        # device-resident update counter — no host schedule eval, no
+        # per-step host→device LR transfer. ``lr`` stays an input scalar
+        # carrying the base LR (so an explicit set_learning_rate still
+        # rescales without a re-trace); the schedule position is t-1,
+        # this step's optimizer.num_update.
+        sched = getattr(opt, "lr_scheduler", None)
+        fold_lr = bool(fused and sched is not None
+                       and hasattr(sched, "jax_lr"))
+        self._lr_fold = fold_lr
+        base_lr = (float(getattr(sched, "base_lr", 0.0) or 0.0)
+                   if fold_lr else None)
 
         def step(param_vals, opt_states, key, lr, t, *batch_vals):
+            if fold_lr:
+                # scale by lr/baked_base: the lr input tracks the
+                # scheduler's live base_lr (_refresh_scalars), so a
+                # mid-run base override rescales the folded schedule
+                # without a re-trace; at the baked base the factor is 1
+                scale = (lr / jnp.float32(base_lr)) if base_lr else 1.0
+                lr = sched.jax_lr(t - 1) * scale
             loss, gnorm, grads, effects = loss_grads(
                 param_vals, key, t, *batch_vals)
             constrain = jax.lax.with_sharding_constraint
@@ -343,6 +402,14 @@ class ShardedTrainer:
                             for a, sh in zip(nst, state_shardings[i]))
                 new_vals.append(nv)
                 new_states.append(nst)
+            if fused:
+                # the guard's finite check, captured in-graph: one fused
+                # reduction instead of a separate jitted call — the
+                # rollback DECISION stays on host (_apply_guard)
+                ok = jnp.logical_and(jnp.isfinite(loss).all(),
+                                     jnp.isfinite(gnorm))
+                return (loss, gnorm, tuple(new_vals), tuple(new_states),
+                        effects, t + 1, ok)
             return (loss, gnorm, tuple(new_vals), tuple(new_states),
                     effects, t + 1)
 
@@ -396,9 +463,11 @@ class ShardedTrainer:
         lr_mults, wds, mp = self._per_param_hparams()
         opt = self._optimizer
         # the whole update runs host-side: every operand comes off the
-        # mesh (the per-parameter device→host sync IS this path's cost)
+        # mesh (the per-parameter device→host sync IS this path's cost).
+        # The LR comes straight off the host schedule — the device mirror
+        # may hold only the base LR when a pjit build folded the schedule
         t = jnp.asarray(jax.device_get(self._t_dev))
-        lr = jnp.asarray(jax.device_get(self._lr_dev))
+        lr = jnp.asarray(float(self._optimizer.learning_rate), jnp.float32)
         new_vals, new_states = [], []
         for i, (wm, g, sm) in enumerate(zip(self._param_vals, grads,
                                             self._opt_states)):
@@ -428,15 +497,77 @@ class ShardedTrainer:
         return loss, gnorm, effects
 
     # ------------------------------------------------------------------
+    def _ensure_built(self, n_data: int, ndims: Tuple[int, ...]) -> None:
+        """(Re)build the pjit step for these batch ranks, consulting the
+        autotune cache ONCE per trainer first (``MXTPU_AUTOTUNE_DIR``):
+        a banked winner's env knobs overlay the first trace, so the
+        tuned configuration is applied per build, not per shell."""
+        if self._step_fn is not None and ndims == self._step_ndims:
+            return
+        if self._tuned is None:
+            from .. import autotune as _autotune
+            self._tuned = _autotune.consult(
+                "trainer.step", self._autotune_key, mesh=self._mesh) or {}
+            self.autotune_entry = self._tuned or None
+        self._step_fn = self._build_step(n_data, ndims)
+        self._step_ndims = ndims
+
+    def _refresh_scalars(self, next_t: int) -> None:
+        """Materialize the device-resident step scalars. With the LR
+        schedule folded into the graph the LR input is the base LR set
+        ONCE — no per-step host schedule eval or transfer; otherwise the
+        host mirror refreshes whenever the schedule moved."""
+        if self._lr_fold:
+            # the lr input carries the scheduler's CURRENT base LR; the
+            # step graph computes jax_lr(t) * (lr / baked_base), so a
+            # live `sched.base_lr = x` rescales the folded schedule on
+            # the next step without a re-trace (at the baked base the
+            # factor is exactly 1)
+            sched = self._optimizer.lr_scheduler
+            base = float(getattr(sched, "base_lr", 0.0) or 0.0)
+            if self._lr_dev is None or self._lr_val != base:
+                self._lr_val = base
+                self._lr_dev = jnp.asarray(base, jnp.float32)
+        elif self._lr_dev is None \
+                or self._lr_val != self._optimizer.learning_rate:
+            self._lr_val = self._optimizer.learning_rate
+            self._lr_dev = jnp.asarray(self._lr_val, jnp.float32)
+        if self._t_dev is None:
+            self._t_dev = jnp.asarray(next_t, jnp.int32)
+        if self._base_key is None:
+            self._base_key = random_mod.next_key(self._ctx)
+
+    def prepare(self, *batch) -> None:
+        """Build everything :meth:`step` needs WITHOUT dispatching (no
+        XLA compile): eager parameter init, sharding resolution, the
+        autotune consult, the pjit step function, and the device-resident
+        scalars. After ``prepare()`` the full fwd+bwd+optimizer graph is
+        traceable offline (``analysis.hlo`` / ``benchmark.autotune``
+        price it through ``jax.make_jaxpr``) before any step has run —
+        the autotuner's trace-only scoring path."""
+        n_data = len(batch) - self._n_labels
+        if n_data < 1:
+            raise MXNetError("prepare() needs at least one data argument")
+        if self._params is None:
+            warm_ctx = current_context()
+            warm = [a if isinstance(a, NDArray) else NDArray(a, ctx=warm_ctx)
+                    for a in batch[:n_data]]
+            self._init_state(warm, warm_ctx)
+        vals = self.place(*batch)
+        self._ensure_built(n_data, tuple(v.ndim for v in vals))
+        self._refresh_scalars(self._t + 1)
+
+    # ------------------------------------------------------------------
     def step_trace_args(self, *batch):
         """Live argument tuple matching the jitted step's signature, for
         offline inspection (``mx.analysis.hlo`` traces the full
-        fwd+bwd+optimizer graph without executing it). Requires at least
-        one completed :meth:`step` so the parameter/optimizer state and
-        the step function exist."""
+        fwd+bwd+optimizer graph without executing it). Requires a built
+        step function — one completed :meth:`step`, or a compile-free
+        :meth:`prepare`."""
         if self._step_fn is None or self._base_key is None:
             raise MXNetError("step_trace_args() needs a built step "
-                             "function: run one step() first")
+                             "function: run one step() (or prepare()) "
+                             "first")
         vals = self.place(*batch)
         return (self._param_vals, self._opt_states, self._base_key,
                 self._lr_dev, self._t_dev) + tuple(vals)
@@ -499,21 +630,12 @@ class ShardedTrainer:
             # of NEW ranks rebuilds the entry (a fresh compile, noted in
             # the ledger via its new signature — the same cost the
             # re-trace paid before shardings were explicit)
-            ndims = tuple(v.ndim for v in vals)
-            if self._step_fn is None or ndims != self._step_ndims:
-                self._step_fn = self._build_step(n_data, ndims)
-                self._step_ndims = ndims
+            self._ensure_built(n_data, tuple(v.ndim for v in vals))
         if self._guard is not None:
             self._maybe_snapshot()
         self._t += 1
         attempted = self._t          # event id even if a rollback resets _t
-        if self._lr_dev is None or self._lr_val != self._optimizer.learning_rate:
-            self._lr_val = self._optimizer.learning_rate
-            self._lr_dev = jnp.asarray(self._lr_val, jnp.float32)
-        if self._t_dev is None:
-            self._t_dev = jnp.asarray(self._t, jnp.int32)
-        if self._base_key is None:
-            self._base_key = random_mod.next_key(self._ctx)
+        self._refresh_scalars(self._t)
         # a new batch (shape, dtype) signature re-traces inside the jit
         # entry — the classic silent recompile; the ledger makes it visible
         sig = tuple((tuple(v.shape), str(v.dtype)) for v in vals)
@@ -534,12 +656,28 @@ class ShardedTrainer:
                     else _nullcontext():
                 _inject.maybe_delay("slow_step")
                 t_disp0 = time.perf_counter()
-                with active_mesh(self._mesh):
+                self.last_step_graphs = 1       # the step executable
+                ok = None
+                # a NEW signature is about to trace: overlay the autotune
+                # winner's env knobs for exactly that trace (user-set env
+                # always wins; see autotune.applied)
+                if new_sig and not fallback and self._tuned:
+                    from .. import autotune as _autotune
+                    tune_ctx = _autotune.applied(self._tuned)
+                else:
+                    tune_ctx = _nullcontext()
+                with active_mesh(self._mesh), tune_ctx:
                     # bound during (first-call) tracing so mesh-aware ops
                     # lower to mesh collectives — e.g. attention → ring
                     # over sp
                     if fallback:
                         loss, gnorm, effects = self._kv_step(vals, n_data)
+                    elif self._fused:
+                        (loss, gnorm, self._param_vals, self._opt_states,
+                         effects, self._t_dev, ok) = \
+                            self._step_fn(self._param_vals, self._opt_states,
+                                          self._base_key, self._lr_dev,
+                                          self._t_dev, *vals)
                     else:
                         (loss, gnorm, self._param_vals, self._opt_states,
                          effects, self._t_dev) = \
@@ -554,13 +692,15 @@ class ShardedTrainer:
                                warmup=first_sig)
                 t_sync0 = time.perf_counter()
                 rolled_back = (self._guard is not None
-                               and self._apply_guard(loss, gnorm))
+                               and self._apply_guard(loss, gnorm, ok))
                 sync_ms = (time.perf_counter() - t_sync0) * 1e3
             wall_ms = (time.perf_counter() - t_step0) * 1e3
             fields = {"wall_ms": round(wall_ms, 3),
                       "place_ms": round(place_ms, 3),
                       "dispatch_ms": round(dispatch_ms, 3),
-                      "path": self.last_path}
+                      "path": self.last_path,
+                      "graphs": self.last_step_graphs,
+                      "fused": self._fused and not fallback}
             if self._guard is not None:
                 # guard runs synced loss/grad-norm to host — free to report
                 fields.update(loss=self.last_loss,
@@ -634,14 +774,25 @@ class ShardedTrainer:
     def _copy_state(tree):
         return jax.tree.map(lambda a: a.copy(), tree)
 
-    def _apply_guard(self, loss, gnorm) -> bool:
-        """Returns True when the step was rolled back."""
-        lf = float(jax.device_get(loss))
-        gn = float(jax.device_get(gnorm))
+    def _apply_guard(self, loss, gnorm, ok=None) -> bool:
+        """Returns True when the step was rolled back. ``ok`` is the
+        fused step's in-graph finite verdict — everything comes back in
+        ONE host sync. Without it (unfused/fallback path) the finite
+        check is the PR-2-era SEPARATE jitted reduction, one more graph
+        on this step's dispatch count."""
+        g = self._guard
+        if ok is None:
+            from ..fault.guards import all_finite
+            self.last_step_graphs += 1
+            finite = all_finite(loss, gnorm)
+            lf = float(jax.device_get(loss))
+            gn = float(jax.device_get(gnorm))
+        else:
+            lf, gn, okv = jax.device_get((loss, gnorm, ok))
+            lf, gn, finite = float(lf), float(gn), bool(okv)
         self.last_grad_norm = gn
         self.last_loss = lf
-        g = self._guard
-        reason = g.is_bad(bool(onp.isfinite(lf) and onp.isfinite(gn)), gn)
+        reason = g.is_bad(finite, gn)
         if reason is None:
             g.good_step()
             return False

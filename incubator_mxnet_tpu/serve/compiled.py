@@ -71,7 +71,8 @@ class CompiledModel:
                  input_axes: Sequence[Dict[int, str]],
                  example_args: Optional[Sequence] = None,
                  output_axes: Optional[Sequence[Dict[int, str]]] = None,
-                 pad_values: Any = 0, donate: Any = "auto", ctx=None):
+                 pad_values: Any = 0, donate: Any = "auto", ctx=None,
+                 autotune_key: Optional[str] = None):
         from ..gluon.block import HybridBlock, SymbolBlock
         self._table = table
         self._input_axes = [dict(a) for a in input_axes]
@@ -89,6 +90,13 @@ class CompiledModel:
         # backend-resolved argnums so mx.analysis.hlo can reason about the
         # accelerator deployment even when staging runs on CPU
         self._donate_requested = donate
+        # build-time autotune consult (MXTPU_AUTOTUNE_DIR): a banked
+        # winner's env knobs overlay every bucket's trace+compile in
+        # _compile — same contract as ShardedTrainer, under the serving
+        # ledger site "serve.compiled"
+        from .. import autotune as _autotune
+        self.autotune_entry = _autotune.consult(
+            "serve.compiled", autotune_key or type(block).__name__.lower())
 
         if isinstance(block, SymbolBlock):
             arch = block._arch
@@ -201,22 +209,27 @@ class CompiledModel:
 
     # -- compilation ----------------------------------------------------
     def _compile(self, key: tuple, sig) -> Callable:
+        from .. import autotune as _autotune
         t0 = time.perf_counter()
         avals = [jax.ShapeDtypeStruct(self._key_data.shape,
                                       self._key_data.dtype)]
         avals += [jax.ShapeDtypeStruct(s, jnp.dtype(d)) for s, d in sig]
         avals += [jax.ShapeDtypeStruct(p.shape, p.dtype)
                   for p in self._pvals]
-        if self._mode == "artifact":
-            ins = [jax.ShapeDtypeStruct(s, jnp.dtype(d)) for s, d in sig]
-            ent = self._block._sig_for(ins)
-            fn = jax.jit(ent["exported"].call)
-            exe = fn.lower(*avals).compile()
-            info = {"out_fmt": ent["out_fmt"], "multi": ent["multi"]}
-        else:
-            exe = self._jit.lower(*avals).compile()
-            info = {"out_fmt": self._meta["out_fmt"],
-                    "multi": self._meta["multi"]}
+        with _autotune.applied(self.autotune_entry):
+            # the trace reads tunable env knobs (flash block sizes,
+            # embed-grad path) — the cached winner overlays exactly this
+            # scope; an explicitly user-set variable still wins
+            if self._mode == "artifact":
+                ins = [jax.ShapeDtypeStruct(s, jnp.dtype(d)) for s, d in sig]
+                ent = self._block._sig_for(ins)
+                fn = jax.jit(ent["exported"].call)
+                exe = fn.lower(*avals).compile()
+                info = {"out_fmt": ent["out_fmt"], "multi": ent["multi"]}
+            else:
+                exe = self._jit.lower(*avals).compile()
+                info = {"out_fmt": self._meta["out_fmt"],
+                        "multi": self._meta["multi"]}
         self._exe[key] = (exe, info)
         self.stats["compiles"] += 1
         if self._warmed:

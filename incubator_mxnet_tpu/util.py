@@ -102,9 +102,42 @@ ENV_VARS: Dict[str, tuple] = {
                           "directory (jax.profiler trace)."),
     "MXTPU_PEAK_TFLOPS": ("", "Override per-chip peak for MFU accounting."),
     "MXTPU_FLASH_ATTENTION": ("1", "Enable the Pallas flash-attention path."),
+    "MXTPU_FLASH_BK": ("", "Flash-attention key/value block size override "
+                       "(ops/pallas/flash_attention.py); unset = "
+                       "auto-sized per sequence length. An autotune "
+                       "dimension: benchmark/autotune.py sweeps it and "
+                       "banked winners apply it at build time."),
+    "MXTPU_FLASH_BQ": ("", "Flash-attention query block size override; "
+                       "unset = auto-sized. Autotune dimension like "
+                       "MXTPU_FLASH_BK."),
     "MXTPU_EMBED_ONEHOT_GRAD": ("0", "Embedding weight gradient as a one-hot "
                                 "MXU matmul instead of scatter-add (sweep "
                                 "candidate; numerically identical)."),
+    "MXTPU_FUSED_STEP": ("1", "Whole-step capture (ShardedTrainer): the "
+                         "guard finite verdict and the LR-schedule "
+                         "position are computed INSIDE the one donated "
+                         "pjit step — a guarded, scheduled step runs "
+                         "exactly one jitted graph with one host sync. "
+                         "0 restores the unfused shape (separate jitted "
+                         "finite check, per-step host LR eval + "
+                         "transfer) for A/B probes and bit-parity "
+                         "tests."),
+    "MXTPU_AUTOTUNE_DIR": ("", "On-disk autotune cache root. When set, "
+                           "ShardedTrainer and serve.CompiledModel "
+                           "consult it at build time and overlay the "
+                           "banked winner's env knobs (flash block "
+                           "sizes, embed-grad path) for exactly the "
+                           "trace/compile scope; explicitly user-set "
+                           "variables always win. Unset = no consult "
+                           "(one env read on the build path)."),
+    "MXTPU_AUTOTUNE": ("1", "0 disables autotune-cache consults even "
+                       "when MXTPU_AUTOTUNE_DIR is set (kill switch "
+                       "for debugging a suspect banked winner)."),
+    "MXTPU_AUTOTUNE_BUDGET": ("16", "Default candidate budget per family "
+                              "for benchmark/autotune.py when --budget "
+                              "is not given (candidates enumerate in "
+                              "deterministic space order and truncate "
+                              "here)."),
     "MXTPU_TELEMETRY": ("1", "Master switch for the mx.telemetry event "
                         "bus; 0 turns every emit() into a no-op."),
     "MXTPU_TELEMETRY_RING": ("1024", "Per-kind event ring-buffer capacity; "
