@@ -371,8 +371,13 @@ def run_frcnn(watchdog) -> dict:
 #: graphs_per_step: jitted-executable invocations one steady-state
 #: training step makes — the fused whole-step capture's contract is 1
 #: (guard + optimizer + LR inside the one donated pjit step)
+#: peak_live_bytes: the liveness-scan residency high-water mark — a
+#: config that silently grows what must fit in HBM fails here even
+#: though its traffic metrics look unchanged (the ZeRO-1 class of
+#: regression)
 _PROXY_GATE_KEYS = ("flops_per_step", "bytes_per_step",
-                    "comm_bytes_per_step", "graphs_per_step")
+                    "comm_bytes_per_step", "graphs_per_step",
+                    "peak_live_bytes")
 #: measured fields excluded from the banked file so re-banking on a
 #: different machine never churns the committed baseline
 _PROXY_VOLATILE_KEYS = ("host_gap_ms", "instrumented_pct",
@@ -415,6 +420,8 @@ def _proxy_record(family: str, iters: int = 4) -> dict:
         "graphs": len(rep.rows),
         "flops_per_step": rep.model_flops_per_step(),
         "bytes_per_step": rep.bytes_per_step(),
+        "peak_live_bytes": rep.peak_live_bytes(),
+        "ladder_peak_bytes": rep.ladder_peak_bytes(),
         "comm_bytes_per_step": rep.comm_bytes_per_step(),
         "collective_ops": rep.collective_ops_per_step(),
         "param_bytes": head.param_bytes,
@@ -535,6 +542,7 @@ def _fused_step_record(steps: int = 6) -> dict:
         "graphs_per_step_unfused": graphs_unfused,
         "flops_per_step": rep.model_flops_per_step(),
         "bytes_per_step": rep.bytes_per_step(),
+        "peak_live_bytes": rep.peak_live_bytes(),
         "comm_bytes_per_step": rep.comm_bytes_per_step(),
         "host_gap_ms_fused": gap_f,
         "host_gap_ms_unfused": gap_u,

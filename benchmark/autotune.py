@@ -31,6 +31,13 @@ flash block sizes on CPU, where Pallas falls back to XLA attention) tie,
 and the deterministic enumeration order breaks the tie — still the same
 winner twice.
 
+Memory feasibility: when ``MXTPU_HBM_BUDGET`` is set, every candidate's
+whole-ladder residency (``analysis.hlo`` liveness scan,
+``ladder_peak_bytes``) is checked against it and infeasible candidates
+are scored-but-never-elected (reported, no silent caps) — the search
+can expand batch/bucket geometry without proposing configs that OOM
+the chip.
+
     python -m benchmark.autotune --families bert --budget 16 \
         --cache-dir autotune_cache
     python -m benchmark.autotune --families lenet --budget 6 \
@@ -299,6 +306,11 @@ def evaluate(family: str, cfg: Dict[str, Any]) -> Dict[str, Any]:
         "fusion_candidates": head.fusion_candidates,
         "graphs": len(rep.rows),
         "tokens_per_step": tokens,
+        # residency (liveness scan): the worst graph's peak and the
+        # whole-ladder footprint — what the memory-feasibility
+        # constraint checks against MXTPU_HBM_BUDGET
+        "peak_live_bytes": rep.peak_live_bytes(),
+        "ladder_peak_bytes": rep.ladder_peak_bytes(),
     }
 
 
@@ -323,18 +335,37 @@ def search(family: str, budget: Optional[int] = None, cache=None,
     space = FAMILY_SPACES[family]
     full = candidates(family)
     cand = candidates(family, budget)
+    # memory-feasibility constraint: a candidate whose whole-ladder
+    # residency (liveness scan, deterministic) exceeds MXTPU_HBM_BUDGET
+    # is scored but NEVER elected — the search can expand geometry
+    # without proposing configs that OOM the chip. Unset budget =
+    # unconstrained (the pre-memory-gate behavior, bit for bit).
+    from incubator_mxnet_tpu.telemetry import memory as _memory
+    hbm_budget = _memory.hbm_budget()
     rows = []
     for cfg in cand:
         metrics = evaluate(family, cfg)
+        feasible = (hbm_budget is None
+                    or metrics["ladder_peak_bytes"] <= hbm_budget)
         rows.append({"config": dict(cfg), "metrics": metrics,
-                     "score": score(metrics)})
-    best_i = max(range(len(rows)), key=lambda i: (rows[i]["score"], -i))
+                     "score": score(metrics), "feasible": feasible})
+    feasible_i = [i for i, r in enumerate(rows) if r["feasible"]]
+    if not feasible_i:
+        raise RuntimeError(
+            f"autotune: every candidate of {family!r} exceeds the "
+            f"{hbm_budget / 2**20:.1f} MiB MXTPU_HBM_BUDGET (smallest "
+            f"ladder peak "
+            f"{min(r['metrics']['ladder_peak_bytes'] for r in rows) / 2**20:.1f}"
+            " MiB) — shrink the declared geometry dims or raise the budget")
+    best_i = max(feasible_i, key=lambda i: (rows[i]["score"], -i))
     best = rows[best_i]
     result = {
         "family": family, "kind": space["kind"],
         "dims": list(space["dims"]),
         "evaluated": len(rows), "space_size": len(full),
         "truncated": len(full) - len(cand),   # no silent caps
+        "infeasible": len(rows) - len(feasible_i),
+        "hbm_budget": hbm_budget,
         "winner": best["config"], "winner_score": best["score"],
         "winner_metrics": best["metrics"],
         "rows": rows,
@@ -348,6 +379,7 @@ def search(family: str, budget: Optional[int] = None, cache=None,
                   "space_size": len(full), "driver": "benchmark.autotune"})
     telemetry.emit("autotune.search", family=family,
                    evaluated=len(rows), space_size=len(full),
+                   infeasible=result["infeasible"], hbm_budget=hbm_budget,
                    winner=best["config"], score=best["score"],
                    banked=result.get("cache_path"))
     return result
@@ -488,6 +520,11 @@ def main(argv=None) -> int:
             print(f"autotune: {fam}: budget {budget} evaluated "
                   f"{res['evaluated']}/{res['space_size']} candidates "
                   f"(deterministic prefix)", file=sys.stderr)
+        if res["infeasible"]:
+            print(f"autotune: {fam}: {res['infeasible']}/{res['evaluated']}"
+                  " candidate(s) excluded by the MXTPU_HBM_BUDGET "
+                  "memory-feasibility constraint "
+                  f"({res['hbm_budget']} bytes)", file=sys.stderr)
         results[fam] = res
         if args.gate:
             if not args.cache_dir:

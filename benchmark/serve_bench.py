@@ -469,7 +469,13 @@ def main(argv=None) -> int:
         args.replicas = 3
 
     from incubator_mxnet_tpu import profiler, serve
+    from incubator_mxnet_tpu.telemetry import memory as _memory
 
+    # device-memory ledger: MXTPU_MEMORY_SAMPLE_S > 0 runs the
+    # background sampler over the whole bench (the CI memory-smoke
+    # config — a steady-state growth trips memory.leak, which
+    # telemetry_check --forbid memory.leak turns into a failed job)
+    _memory.start_from_env()
     if args.smoke:
         args.iters = min(args.iters, 5)
     deadline = args.deadline_ms if args.deadline_ms is not None else \
@@ -539,6 +545,8 @@ def main(argv=None) -> int:
         "graphs": len(cost_rep.rows),
         "flops_per_step": cost_rep.model_flops_per_step(),
         "bytes_per_step": cost_rep.bytes_per_step(),
+        "peak_live_bytes": cost_rep.peak_live_bytes(),
+        "ladder_peak_bytes": cost_rep.ladder_peak_bytes(),
         "fusion_candidates": (cost_rep.head.fusion_candidates
                               if cost_rep.head else 0),
         "transcendentals": (cost_rep.head.transcendentals
@@ -579,9 +587,13 @@ def main(argv=None) -> int:
             "analysis": analysis_rep.summary_dict(),
             "tracing_overhead": overhead,
             "slo": {"ok": slo_ok, "slos": slo_rep},
+            # the device-memory ledger's closing view: residency, site
+            # attribution, leak-watchdog state over the run
+            "memory": _memory.snapshot(),
             "wall_total_s": round(time.perf_counter() - t0, 1),
         },
     }
+    _memory.stop()
     doc = json.dumps(result)
     print(doc)
     if args.out:

@@ -15,7 +15,7 @@ __version__ = "0.1.0"
 from .base import MXNetError  # noqa: F401
 from .context import (  # noqa: F401
     Context, cpu, gpu, tpu, cpu_pinned, cpu_shared, current_context,
-    num_gpus, num_tpus, gpu_memory_info, tpu_memory_info,
+    num_gpus, num_tpus, gpu_memory_info, tpu_memory_info, memory_stats,
 )
 from . import base  # noqa: F401
 from . import engine  # noqa: F401

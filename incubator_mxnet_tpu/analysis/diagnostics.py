@@ -94,6 +94,10 @@ CODES = {
              "contract: a per-parameter host round-trip (callback / live "
              "device_put) or a non-donated >=64KiB parameter/optimizer "
              "buffer survives in the step graph",
+    "MX709": "peak live device memory over budget: the graph's (or the "
+             "bucket ladder's summed) liveness-scan peak_live_bytes "
+             "exceeds MXTPU_HBM_BUDGET — the geometry cannot fit on "
+             "the chip",
     "MX801": "shared attribute mutated without the lock that guards it "
              "elsewhere, in a class that runs threads (attribute→lock "
              "binding inferred from `with self._lock:` dominance)",
@@ -130,7 +134,7 @@ DEFAULT_SEVERITY: Dict[str, str] = {
     "MX601": "warning", "MX602": "warning",
     "MX701": "error", "MX702": "warning", "MX703": "warning",
     "MX704": "warning", "MX705": "error", "MX706": "warning",
-    "MX707": "info", "MX708": "error",
+    "MX707": "info", "MX708": "error", "MX709": "error",
     "MX801": "warning", "MX802": "error", "MX803": "warning",
     "MX804": "warning", "MX805": "warning",
 }

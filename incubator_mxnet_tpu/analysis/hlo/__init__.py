@@ -44,20 +44,23 @@ from .passes import (  # noqa: F401
 from .trace import (  # noqa: F401
     TracedGraph, TraceResult, trace_entry, walk_eqns,
 )
-from .cost import (  # noqa: F401  (importing registers the hlo_cost pass)
-    CostReport, GraphCost, cost, cost_table, graph_cost,
+from .cost import (  # noqa: F401  (importing registers hlo_cost/hlo_memory)
+    CostReport, GraphCost, cost, cost_table, graph_cost, hbm_budget_bytes,
+    ladder_peak_bytes, peak_live_bytes,
 )
 
 __all__ = ["verify", "verify_trace", "trace_entry", "TracedGraph",
            "TraceResult", "HLO_PASSES", "register_hlo_pass",
            "list_hlo_passes", "run_hlo_passes", "walk_eqns",
-           "cost", "cost_table", "graph_cost", "CostReport", "GraphCost"]
+           "cost", "cost_table", "graph_cost", "CostReport", "GraphCost",
+           "peak_live_bytes", "ladder_peak_bytes", "hbm_budget_bytes"]
 
 
 def verify_trace(result: TraceResult, *,
                  passes: Optional[Sequence[str]] = None,
                  const_limit_bytes: int = 1 << 20,
                  donation_min_bytes: int = 1 << 16,
+                 hbm_budget_bytes: Optional[int] = None,
                  cost: bool = False) -> Report:
     """Run the MX7xx passes over an already-traced entry and fold in the
     tracer's own diagnostics/coverage notes — the shared second half of
@@ -67,6 +70,7 @@ def verify_trace(result: TraceResult, *,
     report = run_hlo_passes(result.graphs, names=passes,
                             const_limit_bytes=const_limit_bytes,
                             donation_min_bytes=donation_min_bytes,
+                            hbm_budget_bytes=hbm_budget_bytes,
                             cost=cost)
     for d in result.diags:
         report.add(d)
@@ -79,6 +83,7 @@ def verify(model, sample_args=None, *,
            max_graphs: int = 8,
            const_limit_bytes: int = 1 << 20,
            donation_min_bytes: int = 1 << 16,
+           hbm_budget_bytes: Optional[int] = None,
            cost: bool = False) -> Report:
     """Trace ``model`` (every bucket/signature/call site, capped at
     ``max_graphs``) and run the registered MX7xx passes; returns the
@@ -97,8 +102,13 @@ def verify(model, sample_args=None, *,
     ``cost=True`` additionally runs the informational ``hlo_cost`` pass,
     appending one MX707 info row per graph (the
     :func:`~.cost.graph_cost` table in diagnostic form).
+
+    ``hbm_budget_bytes`` overrides the ``MXTPU_HBM_BUDGET`` env read of
+    the MX709 memory pass (``None`` = read the env; unset env = the
+    pass is silent).
     """
     return verify_trace(trace_entry(model, sample_args,
                                     max_graphs=max_graphs),
                         passes=passes, const_limit_bytes=const_limit_bytes,
-                        donation_min_bytes=donation_min_bytes, cost=cost)
+                        donation_min_bytes=donation_min_bytes,
+                        hbm_budget_bytes=hbm_budget_bytes, cost=cost)

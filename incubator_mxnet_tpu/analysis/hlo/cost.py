@@ -35,6 +35,15 @@ Accounting rules (documented limits, all deterministic):
   jaxpr, never multiplied by trip counts.
 - unknown primitives price one FLOP per output element and are tallied
   in ``unknown_eqns`` so a drifting jax version is visible, not silent.
+
+Memory: :func:`peak_live_bytes` is a donation-aware last-use liveness
+scan over the same jaxpr — args + consts + the maximal simultaneously-
+live eqn outputs — the deterministic static twin of the runtime
+``telemetry.memory`` ledger. It feeds the ``peak`` column of ``mxlint
+--cost``, the banked ``peak_live_bytes`` perf-proxy gate, the autotune
+memory-feasibility constraint, and the MX709 ``hlo_memory`` pass that
+errors when a graph (or a whole bucket ladder,
+:func:`ladder_peak_bytes`) exceeds ``MXTPU_HBM_BUDGET``.
 """
 from __future__ import annotations
 
@@ -46,7 +55,8 @@ import numpy as onp
 from ..diagnostics import Diagnostic
 from .trace import TracedGraph, trace_entry
 
-__all__ = ["GraphCost", "CostReport", "graph_cost", "cost_table", "cost"]
+__all__ = ["GraphCost", "CostReport", "graph_cost", "cost_table", "cost",
+           "peak_live_bytes", "ladder_peak_bytes", "hbm_budget_bytes"]
 
 
 # -- primitive taxonomy ------------------------------------------------------
@@ -160,7 +170,17 @@ class GraphCost:
     param_bytes: int = 0
     input_bytes: int = 0
     output_bytes: int = 0
-    activation_bytes: int = 0        # every eqn output, the traffic proxy
+    #: every eqn output's bytes (trip-multiplied) — a memory-TRAFFIC
+    #: proxy, NOT residency: values that die immediately still count.
+    #: Residency is :attr:`peak_live_bytes` (the liveness scan).
+    activation_bytes: int = 0
+    #: deterministic peak live device bytes over one executed call:
+    #: non-donated args + consts resident for the whole call, plus the
+    #: maximal simultaneously-live set of eqn outputs under a
+    #: last-use liveness scan (donated inputs die at their last use —
+    #: the donation credit). An upper-bound residency model: XLA's
+    #: buffer-assignment reuse can only come in under it.
+    peak_live_bytes: int = 0
     eqns: int = 0
     fusible_eqns: int = 0
     fusion_groups: int = 0           # def-use components of fusible eqns
@@ -182,7 +202,13 @@ class GraphCost:
 
     @property
     def bytes_per_step(self) -> int:
-        """Memory traffic floor per call: params + inputs + outputs."""
+        """Memory-TRAFFIC floor per call: params + inputs + outputs —
+        bytes the call must at minimum move through HBM, not bytes it
+        must simultaneously hold. Residency (what OOMs a chip) is
+        :attr:`peak_live_bytes`; ``activation_bytes`` is likewise a
+        traffic proxy (every eqn output, even values that die
+        immediately), kept byte-identical to the banked PERF_PROXY
+        families."""
         return self.param_bytes + self.input_bytes + self.output_bytes
 
     def to_dict(self) -> dict:
@@ -195,6 +221,7 @@ class GraphCost:
             "input_bytes": int(self.input_bytes),
             "output_bytes": int(self.output_bytes),
             "activation_bytes": int(self.activation_bytes),
+            "peak_live_bytes": int(self.peak_live_bytes),
             "bytes_per_step": int(self.bytes_per_step),
             "eqns": int(self.eqns),
             "fusible_eqns": int(self.fusible_eqns),
@@ -429,6 +456,134 @@ def _implied_spmd_comm(g: TracedGraph, acc: dict) -> None:
                " (all-reduce)"))
 
 
+# -- liveness: peak resident device bytes ------------------------------------
+
+def _donated_mask(g: TracedGraph) -> tuple:
+    n = len(g.closed.jaxpr.invars)
+    d = g.donated or ()
+    return tuple(bool(d[i]) if i < len(d) else False for i in range(n))
+
+
+def _inner_extra(eqn) -> int:
+    """Transient scratch an eqn's sub-jaxprs (pjit/remat/scan/cond
+    bodies) need beyond the eqn's own operands: the sub-graph's peak
+    minus its invar bytes (those alias buffers already live in the
+    enclosing frame). Counted once — residency is a max, never a sum
+    over trips — so a scan body's scratch is NOT trip-multiplied."""
+    extra = 0
+    for sub in _sub_jaxprs(eqn):
+        in_b = sum(_nbytes(v.aval) for v in sub.invars
+                   if hasattr(v, "aval"))
+        extra = max(extra, max(0, _open_jaxpr_peak(sub, ()) - in_b))
+    return extra
+
+
+def _open_jaxpr_peak(jaxpr, donated: tuple) -> int:
+    """Last-use liveness scan over one (open) jaxpr, in bytes.
+
+    Residency model, a deterministic pure function of the jaxpr:
+
+    - non-donated invars are resident for the WHOLE call (the caller
+      retains those buffers) — so are constvars (trace-time constants
+      XLA materializes on device);
+    - donated invars die after their last use (the donation credit —
+      XLA may alias the buffer into an output);
+    - each eqn's outputs are allocated while its inputs are still live
+      (an executing kernel holds both), then freed after their own last
+      use; jaxpr outvars live to the end of the call;
+    - an eqn with sub-jaxprs additionally holds the sub-graph's
+      transient scratch (:func:`_inner_extra`) while it runs.
+    """
+    n_eqns = len(jaxpr.eqns)
+    last_use: Dict = {}
+    for i, eqn in enumerate(jaxpr.eqns):
+        for v in eqn.invars:
+            if not _is_literal(v):
+                last_use[v] = i
+    for v in jaxpr.outvars:
+        if not _is_literal(v):
+            last_use[v] = n_eqns          # outputs survive the call
+    fixed = sum(_nbytes(v.aval) for v in getattr(jaxpr, "constvars", ())
+                if hasattr(v, "aval"))
+    live: Dict = {}                       # var -> bytes, dies at last use
+    for i, v in enumerate(jaxpr.invars):
+        b = _nbytes(v.aval) if hasattr(v, "aval") else 0
+        if i < len(donated) and donated[i]:
+            live[v] = b
+        else:
+            fixed += b
+    live_b = sum(live.values())
+    peak = fixed + live_b
+    for i, eqn in enumerate(jaxpr.eqns):
+        out_b = sum(_nbytes(o.aval) for o in eqn.outvars
+                    if hasattr(o, "aval"))
+        peak = max(peak, fixed + live_b + out_b + _inner_extra(eqn))
+        for o in eqn.outvars:
+            if last_use.get(o, -1) > i:   # value someone later reads
+                b = _nbytes(o.aval) if hasattr(o, "aval") else 0
+                live[o] = b
+                live_b += b
+        for v in eqn.invars:
+            if not _is_literal(v) and last_use.get(v) == i and v in live:
+                live_b -= live.pop(v)
+    return int(peak)
+
+
+def peak_live_bytes(g: TracedGraph) -> int:
+    """Deterministic peak live device bytes of one traced graph —
+    args + consts + the maximal simultaneously-live eqn outputs under a
+    donation-aware last-use liveness scan. Zero XLA compiles; same
+    graph → same number, the property the MX709 budget gate and the
+    banked PERF_PROXY ``peak_live_bytes`` rely on."""
+    return _open_jaxpr_peak(g.closed.jaxpr, _donated_mask(g))
+
+
+def _graph_param_bytes(g: TracedGraph) -> int:
+    return sum(_nbytes(v.aval)
+               for v, role in zip(g.closed.jaxpr.invars, g.roles)
+               if role in ("param", "state") and hasattr(v, "aval"))
+
+
+def _ladder_from_pairs(pairs) -> int:
+    """THE ladder accounting, over ``(param_bytes, peak_bytes)`` pairs:
+    parameters counted once (max — weights are shared across bucket
+    executables), every graph's non-parameter residency summed. Shared
+    by :func:`ladder_peak_bytes` (TracedGraphs) and
+    :meth:`CostReport.ladder_peak_bytes` (priced rows) so the staging
+    preflight and the banked proxy can never disagree."""
+    pairs = list(pairs)
+    if not pairs:
+        return 0
+    params = max(pb for pb, _ in pairs)
+    rest = sum(max(0, peak - pb) for pb, peak in pairs)
+    return int(params + rest)
+
+
+def ladder_peak_bytes(graphs: List[TracedGraph]) -> int:
+    """Conservative resident footprint of a whole bucket LADDER (one
+    entry's graphs held on device at once): the parameter/state set
+    counted ONCE (weights are shared across bucket executables) plus
+    every bucket's non-parameter residency summed — each warmed bucket
+    retains its own donated request buffers, outputs, and executable
+    scratch. This is the number the serve staging preflight checks
+    against ``MXTPU_HBM_BUDGET``: buckets execute one at a time, but
+    they stay RESIDENT together."""
+    return _ladder_from_pairs((_graph_param_bytes(g), peak_live_bytes(g))
+                              for g in graphs)
+
+
+def hbm_budget_bytes() -> Optional[int]:
+    """``MXTPU_HBM_BUDGET`` in bytes, or ``None`` when unset — a
+    re-export of :func:`~...util.hbm_budget_bytes` (the ONE budget read
+    every gate shares) at the analysis surface."""
+    from ...util import hbm_budget_bytes as _budget
+    return _budget()
+
+
+def _fmt_mib(n: int) -> str:
+    return f"{n / 2**20:.1f} MiB"
+
+
 def graph_cost(g: TracedGraph) -> GraphCost:
     """Price one :class:`~.trace.TracedGraph` — THE cost function every
     surface (``analysis.hlo.cost``, the MX707 pass, ``mxlint --cost``,
@@ -452,6 +607,7 @@ def graph_cost(g: TracedGraph) -> GraphCost:
         param_bytes=param_bytes, input_bytes=input_bytes,
         output_bytes=output_bytes,
         activation_bytes=int(acc["activation_bytes"]),
+        peak_live_bytes=peak_live_bytes(g),
         eqns=acc["eqns"], fusible_eqns=acc["fusible_eqns"],
         fusion_groups=acc["fusion_groups"],
         fusion_candidates=acc["fusion_candidates"],
@@ -488,6 +644,20 @@ class CostReport:
     def bytes_per_step(self) -> int:
         return int(self.head.bytes_per_step) if self.rows else 0
 
+    def peak_live_bytes(self) -> int:
+        """Deterministic peak live device bytes: the WORST graph's peak
+        (one executed step/call runs one executable, so the largest
+        bucket / the step graph sets the high-water mark)."""
+        return max((int(r.peak_live_bytes) for r in self.rows), default=0)
+
+    def ladder_peak_bytes(self) -> int:
+        """Conservative whole-ladder resident footprint — the SAME
+        :func:`_ladder_from_pairs` accounting as the module-level
+        :func:`ladder_peak_bytes`, derived from the priced rows so
+        callers holding only a CostReport need not re-trace."""
+        return _ladder_from_pairs((r.param_bytes, r.peak_live_bytes)
+                                  for r in self.rows)
+
     def comm_bytes_per_step(self) -> int:
         """Per-device collective communication bytes of the costliest
         graph (explicit collective prims + implied SPMD gradient
@@ -502,6 +672,8 @@ class CostReport:
         return {"rows": [r.to_dict() for r in self.rows],
                 "model_flops_per_step": self.model_flops_per_step(),
                 "bytes_per_step": self.bytes_per_step(),
+                "peak_live_bytes": self.peak_live_bytes(),
+                "ladder_peak_bytes": self.ladder_peak_bytes(),
                 "comm_bytes_per_step": self.comm_bytes_per_step(),
                 "collective_ops_per_step": self.collective_ops_per_step(),
                 "skipped": list(self.skipped)}
@@ -510,6 +682,7 @@ class CostReport:
         """Aligned human table (``mxlint --hlo <t> --cost``)."""
         hdr = (f"{'graph':<40} {'kind':<6} {'MFLOP':>10} {'mm%':>5} "
                f"{'trans':>8} {'par KiB':>9} {'act KiB':>9} "
+               f"{'peak KiB':>9} "
                f"{'io KiB':>9} {'comm KiB':>9} {'coll':>4} {'eqns':>5} "
                f"{'fus':>4} {'grp':>4} {'cand':>4}")
         lines = [hdr, "-" * len(hdr)]
@@ -520,6 +693,7 @@ class CostReport:
                 f"{r.label:<40} {r.kind:<6} {r.flops / 1e6:>10.3f} "
                 f"{mm:>5.1f} {r.transcendentals:>8} "
                 f"{r.param_bytes >> 10:>9} {r.activation_bytes >> 10:>9} "
+                f"{r.peak_live_bytes >> 10:>9} "
                 f"{io_kib:>9} {int(r.comm_bytes) >> 10:>9} "
                 f"{sum(r.collective_ops.values()):>4} "
                 f"{r.eqns:>5} {r.fusible_eqns:>4} "
@@ -528,6 +702,8 @@ class CostReport:
             lines.append(
                 f"model_flops_per_step={self.model_flops_per_step():.6g} "
                 f"bytes_per_step={self.bytes_per_step()} "
+                f"peak_live_bytes={self.peak_live_bytes()} "
+                f"ladder_peak_bytes={self.ladder_peak_bytes()} "
                 f"comm_bytes_per_step={self.comm_bytes_per_step()}")
         for s in self.skipped:
             lines.append(f"note: skipped {s}")
@@ -572,10 +748,57 @@ def _register():
                 f"{c.transcendentals} transcendental elems, "
                 f"{c.param_bytes >> 10} KiB params, "
                 f"{c.activation_bytes >> 10} KiB activations, "
+                f"{c.peak_live_bytes >> 10} KiB peak live, "
                 f"{c.input_bytes + c.output_bytes >> 10} KiB in+out, "
                 f"{c.eqns} eqns, {c.fusible_eqns} fusible in "
                 f"{c.fusion_groups} group(s) "
                 f"({c.fusion_candidates} multi-op){coll}", g, severity="info")
+
+    @register_hlo_pass("hlo_memory",
+                       describe="peak live device memory exceeds "
+                                "MXTPU_HBM_BUDGET (donation-aware jaxpr "
+                                "liveness scan; whole bucket ladders "
+                                "checked too), MX709")
+    def hlo_memory(ctx) -> None:
+        """The memory budget gate (MX709): each graph's deterministic
+        ``peak_live_bytes`` — and each entry's summed bucket-ladder
+        residency — must fit ``MXTPU_HBM_BUDGET`` (or the explicit
+        ``hbm_budget_bytes`` pass option). Silent when no budget is
+        configured, so un-budgeted runs and the clean fixtures see zero
+        findings; with a budget set it is error severity and aborts
+        serve staging exactly like MX701/MX705."""
+        budget = ctx.opt("hbm_budget_bytes", None)
+        if budget is None:
+            budget = hbm_budget_bytes()
+        if not budget:
+            return
+        by_entry: Dict[str, list] = {}
+        for g in ctx.graphs:
+            peak = peak_live_bytes(g)
+            by_entry.setdefault(g.entry, []).append((g, peak))
+            if peak > budget:
+                ctx.diag(
+                    "MX709",
+                    f"peak live device memory {_fmt_mib(peak)} exceeds "
+                    f"the HBM budget {_fmt_mib(int(budget))} "
+                    f"(MXTPU_HBM_BUDGET): this graph cannot fit on the "
+                    "chip — shrink the batch/bucket geometry, enable "
+                    "remat, or raise the budget", g, severity="error")
+        for entry, rows in by_entry.items():
+            if len(rows) < 2 or any(p > budget for _, p in rows):
+                continue          # per-graph findings already tell the story
+            ladder = _ladder_from_pairs(          # peaks already scanned
+                (_graph_param_bytes(g), p) for g, p in rows)
+            if ladder > budget:
+                ctx.diag(
+                    "MX709",
+                    f"bucket ladder holds {_fmt_mib(ladder)} resident "
+                    f"across {len(rows)} warmed bucket(s) — over the "
+                    f"HBM budget {_fmt_mib(int(budget))} even though "
+                    "every bucket fits alone (weights counted once, "
+                    "per-bucket buffers summed): trim the bucket table "
+                    "or raise the budget",
+                    node=f"{entry}[ladder]", severity="error")
 
 
 _register()

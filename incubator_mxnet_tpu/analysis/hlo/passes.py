@@ -18,6 +18,8 @@ the compiled-graph sibling of the Symbol pass registry in
 ``MX705``   large constant baked into the graph (>1 MiB literal)
 ``MX706``   trace-signature divergence across call sites — the static
             twin of the telemetry compile ledger
+``MX709``   peak live device memory (liveness scan, ``cost.py``) over
+            ``MXTPU_HBM_BUDGET`` — per graph and per bucket ladder
 ==========  =============================================================
 """
 from __future__ import annotations

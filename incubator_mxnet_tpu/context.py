@@ -32,6 +32,7 @@ __all__ = [
     "num_tpus",
     "gpu_memory_info",
     "tpu_memory_info",
+    "memory_stats",
 ]
 
 
@@ -149,6 +150,28 @@ class Context:
 
         gc.collect()
 
+    def memory_stats(self) -> dict:
+        """Memory stats for this context's device: PjRt
+        ``device.memory_stats()`` where the backend exposes them
+        (``source="pjrt"``), else the ``telemetry.memory`` ledger's view
+        — live-array residency on the device, ``MXTPU_HBM_BUDGET`` as
+        the limit (``source="ledger"``) — so reference scripts read
+        real numbers on every backend instead of hitting the PjRt
+        stub."""
+        dev = self.jax_device
+        try:
+            stats = dev.memory_stats()
+        except Exception:
+            stats = None
+        if stats:
+            return dict(stats, source="pjrt")
+        from .telemetry import memory as _memory
+        used = _memory.device_bytes(dev)
+        budget = _memory.hbm_budget()
+        return {"bytes_in_use": used,
+                "bytes_limit": budget if budget else used,
+                "source": "ledger"}
+
 
 def cpu(device_id: int = 0) -> Context:
     return Context("cpu", device_id)
@@ -200,9 +223,17 @@ def gpu_memory_info(device_id: int = 0):
     ``python/mxnet/context.py (gpu_memory_info)`` / C API
     ``MXGetGPUMemoryInformation64``. On TPU the numbers come from PjRt's
     ``memory_stats`` (HBM); alias name kept so reference scripts run
-    unchanged. Raises MXNetError when the device exposes no stats
-    (e.g. pure-CPU test runs)."""
+    unchanged. Backends exposing no PjRt stats (pure-CPU test runs) fall
+    back to the ``telemetry.memory`` ledger — live-array residency as
+    "used", ``MXTPU_HBM_BUDGET`` as "total" — so the call reports real
+    numbers everywhere instead of raising on the PjRt stub."""
     return tpu_memory_info(device_id)
+
+
+def memory_stats(device_id: int = 0) -> dict:
+    """Module-level alias of :meth:`Context.memory_stats` for the
+    accelerator context (reference scripts call it off ``mx.context``)."""
+    return tpu(device_id).memory_stats()
 
 
 def tpu_memory_info(device_id: int = 0):
@@ -215,12 +246,19 @@ def tpu_memory_info(device_id: int = 0):
         stats = devs[device_id].memory_stats()
     except Exception:
         stats = None
-    if not stats:
-        raise MXNetError(
-            f"device {devs[device_id]} exposes no memory stats")
-    total = stats.get("bytes_limit", 0)
-    used = stats.get("bytes_in_use", 0)
-    return (total - used, total)
+    if stats:
+        total = stats.get("bytes_limit", 0)
+        used = stats.get("bytes_in_use", 0)
+        return (total - used, total)
+    # no PjRt stats on this backend: the telemetry.memory ledger is the
+    # source of truth — residency measured off jax.live_arrays(), the
+    # configured HBM budget as capacity (used = total when unbudgeted,
+    # i.e. free reads 0 rather than a made-up number)
+    from .telemetry import memory as _memory
+    used = _memory.device_bytes(devs[device_id])
+    budget = _memory.hbm_budget()
+    total = budget if budget else used
+    return (max(total - used, 0), total)
 
 
 def num_tpus() -> int:

@@ -55,8 +55,8 @@ from ..base import MXNetError
 from ..lockcheck import make_lock
 
 __all__ = ["ChaosMonkey", "ChaosCrash", "chaos", "enable", "disable",
-           "active", "enable_from_env", "should", "maybe_delay", "crash",
-           "armed", "poison"]
+           "active", "enable_from_env", "should", "maybe_delay",
+           "maybe_leak", "crash", "armed", "poison"]
 
 
 class ChaosCrash(MXNetError):
@@ -80,6 +80,10 @@ class ChaosMonkey:
     ``delay_s`` in a replica's request path
     ``corrupt_artifact`` — ``should('corrupt_artifact')``: the artifact
     cache bit-flips a cached file before CRC verification
+    ``leak`` — ``maybe_leak(site)``: allocate and RETAIN ``leak_bytes``
+    of device memory at the site (the trainer's ``trainer.step`` hook) —
+    a simulated slow leak the ``telemetry.memory`` watchdog must flag
+    as a ``memory.leak`` event
     ``crash_sites`` — iterable of site names where :meth:`crash` raises
     (and :meth:`armed` consumes without raising); each site fires at most
     ``crash_count`` times (default 1) then disarms, so a retried save can
@@ -91,6 +95,7 @@ class ChaosMonkey:
                  kv_delay: float = 0.0, delay_s: float = 0.0,
                  replica_kill: float = 0.0, slow_replica: float = 0.0,
                  corrupt_artifact: float = 0.0,
+                 leak: float = 0.0, leak_bytes: float = 1 << 20,
                  crash_sites: Iterable[str] = (), crash_count: int = 1):
         self.seed = int(seed)
         self.probs: Dict[str, float] = {
@@ -99,7 +104,12 @@ class ChaosMonkey:
             "replica_kill": float(replica_kill),
             "slow_replica": float(slow_replica),
             "corrupt_artifact": float(corrupt_artifact),
+            "leak": float(leak),
         }
+        self.leak_bytes = int(leak_bytes)
+        #: retained leak allocations — the whole point is that nothing
+        #: ever frees them while the monkey is installed
+        self._leaked: list = []
         self.delay_s = float(delay_s)
         self._armed: Dict[str, int] = {s: int(crash_count)
                                        for s in crash_sites}
@@ -141,6 +151,23 @@ class ChaosMonkey:
             time.sleep(self.delay_s)
             return self.delay_s
         return 0.0
+
+    def maybe_leak(self, site: str) -> int:
+        """When the ``leak`` draw fires at ``site``, allocate
+        ``leak_bytes`` of device memory and retain it forever (visible
+        to ``jax.live_arrays()``, hence to the ``telemetry.memory``
+        ledger). Returns the bytes leaked this call (0 = no fire)."""
+        if not self.should("leak"):
+            return 0
+        n = max(1, self.leak_bytes // 4)
+        try:
+            import jax.numpy as jnp
+            buf = jnp.zeros((n,), "float32")
+        except Exception:  # noqa: BLE001 — no jax: leak host memory
+            buf = onp.zeros((n,), "float32")
+        with self._lock:
+            self._leaked.append((site, buf))
+        return int(n * 4)
 
     def crash(self, site: str, dump: bool = True) -> None:
         """Raise :class:`ChaosCrash` if ``site`` is armed (then disarm).
@@ -270,6 +297,11 @@ def should(site: str) -> bool:
 def maybe_delay(site: str) -> float:
     m = active()
     return m.maybe_delay(site) if m is not None else 0.0
+
+
+def maybe_leak(site: str) -> int:
+    m = active()
+    return m.maybe_leak(site) if m is not None else 0
 
 
 def crash(site: str, dump: bool = True) -> None:

@@ -138,7 +138,19 @@ class AsyncPSServer:
         self._thread = threading.Thread(target=self._serve,
                                         name="mx-kvstore-ps-accept",
                                         daemon=True)
+        # attribute the server's parameter table on the device-memory
+        # ledger (host-side numpy here, but it is the same weights a
+        # device store pins — the "kvstore" site of telemetry.memory)
+        from ..telemetry import memory as _tele_memory
+        self._mem_unregister = _tele_memory.register_site(
+            "kvstore", self._resident_bytes)
         self._thread.start()
+
+    def _resident_bytes(self) -> int:
+        with self._lock:
+            return sum(int(getattr(v, "nbytes", 0) or 0)
+                       for table in (self._store, self._merged)
+                       for v in table.values())
 
     # -- message handling ---------------------------------------------------
     def _serve(self) -> None:

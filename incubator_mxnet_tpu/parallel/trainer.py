@@ -224,6 +224,21 @@ class ShardedTrainer:
             self._state_shardings.append(tuple(st_shs))
         self._param_vals = tuple(vals)
         self._opt_states = tuple(states)
+        # attribute this trainer's resident state on the device-memory
+        # ledger (weak provider: a collected trainer drops off silently)
+        from ..telemetry import memory as _memory
+        self._mem_unregister = _memory.register_site(
+            "trainer.step", self._resident_bytes)
+
+    def _resident_bytes(self) -> int:
+        """Device bytes this trainer pins between steps (parameters +
+        optimizer states) — the ``trainer.step`` site of the
+        ``telemetry.memory`` ledger."""
+        total = 0
+        for leaf in jax.tree_util.tree_leaves(
+                (self._param_vals or (), self._opt_states or ())):
+            total += int(getattr(leaf, "nbytes", 0) or 0)
+        return total
 
     def _state_sharding(self, name, wshape, sshape) -> NamedSharding:
         """ONE policy for optimizer-state placement (used by init and
@@ -655,6 +670,9 @@ class ShardedTrainer:
             with wd.watch(step=self._t, block=self._block) if wd is not None \
                     else _nullcontext():
                 _inject.maybe_delay("slow_step")
+                # chaos leak site: retains device arrays so the memory
+                # ledger's leak watchdog is deterministically testable
+                _inject.maybe_leak("trainer.step")
                 t_disp0 = time.perf_counter()
                 self.last_step_graphs = 1       # the step executable
                 ok = None
@@ -666,7 +684,12 @@ class ShardedTrainer:
                     tune_ctx = _autotune.applied(self._tuned)
                 else:
                     tune_ctx = _nullcontext()
-                with active_mesh(self._mesh), tune_ctx:
+                # a RESOURCE_EXHAUSTED out of dispatch (or the guard's
+                # device sync below) writes ONE OOM flight bundle with
+                # the memory ledger + static peaks, then re-raises
+                from ..telemetry import memory as _memory
+                with _memory.oom_guard("trainer.step", step=attempted), \
+                        active_mesh(self._mesh), tune_ctx:
                     # bound during (first-call) tracing so mesh-aware ops
                     # lower to mesh collectives — e.g. attention → ring
                     # over sp
@@ -691,8 +714,9 @@ class ShardedTrainer:
                     _clog.note("trainer.step", sig, wall_ms=dispatch_ms,
                                warmup=first_sig)
                 t_sync0 = time.perf_counter()
-                rolled_back = (self._guard is not None
-                               and self._apply_guard(loss, gnorm, ok))
+                with _memory.oom_guard("trainer.step", step=attempted):
+                    rolled_back = (self._guard is not None
+                                   and self._apply_guard(loss, gnorm, ok))
                 sync_ms = (time.perf_counter() - t_sync0) * 1e3
             wall_ms = (time.perf_counter() - t_step0) * 1e3
             fields = {"wall_ms": round(wall_ms, 3),

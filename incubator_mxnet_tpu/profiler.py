@@ -223,7 +223,10 @@ def step_report(frame: str = "step", emit: bool = False) -> Dict:
 
     Returns a strict-JSON-safe dict: ``{frame, steps, wall_ms_total,
     wall_ms_mean, segments: {name: {total_ms, mean_ms, count,
-    share_pct}}, instrumented_pct, host_gap_ms_total, host_gap_ms_mean}``.
+    share_pct}}, instrumented_pct, host_gap_ms_total, host_gap_ms_mean,
+    memory: {live_bytes, live_arrays, sites}}`` — the ``memory``
+    segment is the ``telemetry.memory`` ledger's current residency view
+    beside the time attribution.
     ``instrumented_pct`` is the share of frame wall time covered by
     *measured* child spans (the ``python`` remainder excluded) — the
     honest instrumentation-coverage signal; the remainder itself is
@@ -273,6 +276,12 @@ def step_report(frame: str = "step", emit: bool = False) -> Dict:
         "host_gap_ms_total": round(host_gap, 4),
         "host_gap_ms_mean": round(host_gap / max(n, 1), 4),
     }
+    # current device-memory residency beside the time attribution: the
+    # telemetry.memory ledger's light view (live bytes + per-site
+    # attribution) — "where did the step's time AND memory go" in one
+    # report
+    from .telemetry import memory as _memory
+    report["memory"] = _memory.segment()
     if emit:
         from .telemetry import events as _tele
         _tele.emit("perf.step_report", **{

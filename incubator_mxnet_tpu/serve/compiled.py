@@ -166,6 +166,20 @@ class CompiledModel:
                               else sorted(table.axes)[0])
         self._pvals = None
         self.refresh_params()
+        # attribute this model's resident weight buffers on the
+        # device-memory ledger (weak provider: an unloaded version
+        # drops off the ledger when the registry lets go of it)
+        from ..telemetry import memory as _memory
+        self._mem_unregister = _memory.register_site(
+            "serve.compiled", self._resident_bytes)
+
+    def _resident_bytes(self) -> int:
+        """Device bytes this compiled model pins between requests (the
+        weight buffers shared by every warmed bucket) — the
+        ``serve.compiled`` site of the ``telemetry.memory`` ledger."""
+        with self._lock:
+            pvals = self._pvals or ()
+            return sum(int(getattr(p, "nbytes", 0) or 0) for p in pvals)
 
     # -- parameters ----------------------------------------------------
     def refresh_params(self) -> None:
@@ -329,7 +343,11 @@ class CompiledModel:
                     with profiler.Scope("serve.compile"):
                         exe, info = self._compile(key, sig)
                 pvals = self._pvals
-            with profiler.Scope("serve.compute"):
+            # a RESOURCE_EXHAUSTED out of the compiled call writes ONE
+            # OOM flight bundle (live ledger + static peaks), re-raised
+            from ..telemetry import memory as _memory
+            with profiler.Scope("serve.compute"), \
+                    _memory.oom_guard("serve.compiled"):
                 outs = exe(self._key_data, *padded, *pvals)
             with profiler.Scope("serve.unpad"):
                 result = self._unpad(list(outs), info, sizes)

@@ -146,6 +146,12 @@ class ModelRegistry:
         compile: error-severity findings (host callbacks in the graph,
         baked >1 MiB constants, unbucketed signatures) abort the load;
         warnings are published as a ``serve.analysis`` telemetry event.
+        The same traced graphs feed the memory preflight: the summed
+        bucket-ladder residency (``analysis.hlo.ladder_peak_bytes`` —
+        weights once, per-bucket buffers summed) is emitted as a
+        ``serve.memory`` event and, when ``MXTPU_HBM_BUDGET`` is set, an
+        over-budget ladder is rejected at staging while the active
+        version keeps serving.
 
         ``deadline_s`` bounds the whole staging build under a
         ``fault.watchdog`` deadline: a *hung* loader (not just a raising
@@ -199,6 +205,12 @@ class ModelRegistry:
             pinned = self._active.get(name)
             if pinned is None or version > pinned:
                 self._active[name] = version
+        # the ACCEPTED version's predicted residency rides into OOM
+        # bundles — noted only now, past every staging rejection path
+        if source.get("ladder_peak_bytes") is not None:
+            from ..telemetry import memory as _memory
+            _memory.note_static_peak(f"serve:{name}",
+                                     source["ladder_peak_bytes"])
         # emitted AFTER install so a concurrent auto-version bump cannot
         # put a version number on the stream the registry never held
         _tele.emit("serve.load", model=name, version=entry.version,
@@ -262,8 +274,30 @@ class ModelRegistry:
             # copy; max_graphs covers the FULL bucket table so the gate
             # never silently under-analyzes large tables
             from ..analysis import hlo as _hlo
-            rep = _hlo.verify(compiled,
-                              max_graphs=max(8, table.num_buckets()))
+            traced = _hlo.trace_entry(compiled,
+                                      max_graphs=max(8,
+                                                     table.num_buckets()))
+            # memory preflight over the SAME traced graphs: the summed
+            # bucket-ladder residency (weights once + every bucket's
+            # buffers) vs MXTPU_HBM_BUDGET — the event is emitted before
+            # any rejection so an over-budget ladder is visible on the
+            # stream. The static peak is stashed on the source record
+            # and noted for OOM forensics only AFTER the version is
+            # installed (load()), so a REJECTED candidate never
+            # overwrites the serving version's prediction.
+            from ..analysis.hlo.cost import (_graph_param_bytes,
+                                             _ladder_from_pairs)
+            from ..telemetry import memory as _memory
+            budget = _memory.hbm_budget()
+            peaks = {g.site: _hlo.peak_live_bytes(g) for g in traced.graphs}
+            ladder = _ladder_from_pairs(          # one scan, shared
+                (_graph_param_bytes(g), peaks[g.site])
+                for g in traced.graphs)
+            _tele.emit("serve.memory", model=name, version=version,
+                       ladder_peak_bytes=ladder, hbm_budget=budget,
+                       buckets=peaks)
+            source["ladder_peak_bytes"] = ladder
+            rep = _hlo.verify_trace(traced)
             if rep.diagnostics or rep.skipped:
                 _tele.emit("serve.analysis", model=name, version=version,
                            **rep.summary_dict())
@@ -272,6 +306,16 @@ class ModelRegistry:
                     f"analysis.hlo rejected {name!r} v{version} at "
                     "staging (the active version keeps serving):\n" +
                     "\n".join(f"  {d}" for d in rep.errors))
+            if budget and ladder > budget:
+                # the MX709 ladder rule usually catches this above; the
+                # explicit check keeps the preflight airtight even when
+                # a caller restricts the pass list
+                raise MXNetError(
+                    f"bucket ladder of {name!r} v{version} needs "
+                    f"{ladder / 2**20:.1f} MiB resident, over the "
+                    f"{budget / 2**20:.1f} MiB MXTPU_HBM_BUDGET — load "
+                    "rejected at staging (the active version keeps "
+                    "serving); trim the bucket table or raise the budget")
         if warmup:
             compiled.warmup()
         return compiled, source

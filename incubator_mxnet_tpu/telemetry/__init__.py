@@ -49,6 +49,7 @@ from . import compile_log  # noqa: F401
 from . import events  # noqa: F401
 from . import export  # noqa: F401
 from . import flight  # noqa: F401
+from . import memory  # noqa: F401
 from . import metrics  # noqa: F401
 from . import slo  # noqa: F401
 from . import trace  # noqa: F401
@@ -73,6 +74,7 @@ __all__ = ["emit", "events", "get_events", "counts", "clear",
            "Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
            "counter", "gauge", "histogram",
            "compile_log", "metrics", "export", "trace", "flight", "slo",
+           "memory",
            "SLO", "SLOMonitor",
            "prometheus_text", "chrome_trace", "otel_spans",
            "install_jsonl",
@@ -104,6 +106,9 @@ def snapshot(recent: int = 5) -> Dict:
         "step_report": {"step": profiler.step_report("step"),
                         "serve.predict":
                             profiler.step_report("serve.predict")},
+        # the device-memory ledger: residency, per-site attribution,
+        # leak-watchdog state, noted static peaks
+        "memory": memory.snapshot(),
     }
     return sanitize(doc)
 

@@ -5,8 +5,9 @@ died with it (whatever stderr captured). This repo's situation before
 this module was structurally the same: the event rings, the trace ring,
 the compile ledger, the lock graph, and the profiler's step attribution
 are all **in-process memory** — precisely the state that evaporates when
-the watchdog trips, a guard halts, a replica is stall-killed, or a chaos
-crash site fires. The flight recorder inverts that: trigger sites call
+the watchdog trips, a guard halts, a replica is stall-killed, a device
+allocation dies with ``RESOURCE_EXHAUSTED`` (``telemetry.memory``'s OOM
+guard), or a chaos crash site fires. The flight recorder inverts that: trigger sites call
 :func:`dump`, which atomically writes one strict-JSON bundle of every
 in-memory diagnostic surface to ``MXTPU_FLIGHT_DIR``; then
 ``tools/postmortem.py`` renders a bundle into a human-readable timeline.
@@ -37,6 +38,8 @@ Bundle format (``format: 1``, strict JSON, one file per trigger)::
      "events":  {kind: [...recent per-kind ring...], ...},
      "compiles": {...ledger rollup...},
      "lockcheck": {"edges": [...], "inversions": [...], "held_now": [...]},
+     "memory":  {...device-memory ledger: live/site bytes, history,
+                 static peaks, leak-watchdog state...},
      "step_report": {...host-gap attribution...},
      "metrics": {...registry table...},
      "env": {...MXTPU_/MXNET_/DMLC_/JAX_/XLA_ vars...},
@@ -113,7 +116,7 @@ def bundle(reason: str, /, site: Optional[str] = None, **context) -> Dict:
     costing the whole bundle."""
     from .. import profiler
     from ..lockcheck import edges, held_now, inversions
-    from . import compile_log, events, metrics, trace
+    from . import compile_log, events, memory, metrics, trace
     from .export import sanitize
 
     doc: Dict = {"format": 1, "reason": reason, "site": site,
@@ -141,6 +144,11 @@ def bundle(reason: str, /, site: Optional[str] = None, **context) -> Dict:
         "step": profiler.step_report("step"),
         "serve.predict": profiler.step_report("serve.predict")})
     section("metrics", metrics.to_dict)
+    # the device-memory ledger: a fresh sample at the moment of death,
+    # the recent history ring, and the statically-predicted peaks — an
+    # OOM bundle (reason "resource_exhausted") reads prediction vs
+    # measurement on one page
+    section("memory", memory.snapshot)
     section("env", lambda: {k: v for k, v in sorted(os.environ.items())
                             if k.startswith(_ENV_PREFIXES)})
     section("config", lambda: _config())

@@ -1,0 +1,456 @@
+"""Device-memory ledger — runtime residency observability.
+
+Reference counterpart: ``MXGetGPUMemoryInformation64`` and the GPU
+memory-pool env knobs — numbers you could only read, never correlate.
+Here the ledger is the runtime twin of the static liveness scan in
+``analysis/hlo/cost.py`` (``peak_live_bytes``): the scan predicts what a
+graph *must* hold; this module measures what the process *does* hold —
+``jax.live_arrays()`` residency, PjRt ``device.memory_stats()`` where
+the backend exposes them, and per-site attribution from registered
+providers (``trainer.step`` parameter/optimizer state,
+``serve.compiled`` weights, the kvstore's parameter table) — published
+as ``mxtpu_memory_*`` gauges on every :func:`sample`.
+
+Three jobs:
+
+- **Ledger**: :func:`sample` (manual or via the :func:`start` background
+  sampler, interval ``MXTPU_MEMORY_SAMPLE_S``) reads live-array bytes +
+  device stats + site providers, sets the gauges, and appends to a
+  bounded history ring; :func:`snapshot` renders the whole state for
+  ``telemetry.snapshot()`` and flight bundles.
+- **Leak watchdog**: a steady state whose live bytes grow monotonically
+  across a full sample window (default 8 samples, >=1 MiB growth) emits
+  one damped ``memory.leak`` warning event — the signal
+  ``telemetry_check --forbid memory.leak`` gates on in CI. Chaos twin:
+  ``fault.inject``'s ``leak`` knob retains device arrays at the
+  ``trainer.step`` site so the watchdog is testable deterministically.
+- **OOM forensics**: :func:`oom_guard` / :func:`record_oom` turn a
+  ``RESOURCE_EXHAUSTED`` crash into exactly ONE flight-recorder bundle
+  (reason ``resource_exhausted``) whose memory section holds the live
+  ledger beside the static peaks staging noted via
+  :func:`note_static_peak` — rendered by ``tools/postmortem.py``.
+
+Budget: ``MXTPU_HBM_BUDGET`` (bytes; K/M/G suffixes) is the one chip
+capacity every consumer shares — the MX709 static pass, the serve
+staging preflight, the autotune feasibility constraint, and this
+ledger's gauges/"free" arithmetic (``context.tpu_memory_info`` falls
+back to it when PjRt exposes no stats).
+"""
+from __future__ import annotations
+
+import contextlib
+import itertools
+import threading
+import time
+import weakref
+from collections import deque
+from typing import Any, Callable, Dict, Optional
+
+from ..lockcheck import make_lock
+
+__all__ = ["hbm_budget", "live_bytes", "device_bytes", "device_stats",
+           "register_site", "note_static_peak", "static_peaks",
+           "sample", "segment", "snapshot", "start", "stop",
+           "start_from_env", "is_oom", "record_oom", "oom_guard", "reset"]
+
+_LOCK = make_lock("telemetry.memory._LOCK")
+#: (name, seq) -> zero-arg provider returning resident bytes for a site.
+#: Providers registered off bound methods are held via WeakMethod so the
+#: ledger never keeps a dead trainer/model alive; dead refs drop on the
+#: next sample.
+_SITES: Dict[tuple, Callable[[], Optional[int]]] = {}
+_SEQ = itertools.count()
+_STATIC_PEAKS: Dict[str, int] = {}
+_HISTORY: deque = deque(maxlen=256)
+_STATE: Dict[str, Any] = {"thread": None, "stop": None,
+                          "leak_level": None, "oom_bundles": 0}
+
+#: leak-watchdog window: this many consecutive samples of monotonic
+#: non-decreasing live bytes with at least _LEAK_MIN_BYTES total growth
+#: flag a steady-state leak (damped: re-flags only after ANOTHER
+#: _LEAK_MIN_BYTES past the flagged level)
+_LEAK_WINDOW = 8
+_LEAK_MIN_BYTES = 1 << 20
+
+
+def hbm_budget() -> Optional[int]:
+    """``MXTPU_HBM_BUDGET`` in bytes, or ``None`` when unset — a
+    re-export of :func:`~..util.hbm_budget_bytes` (the ONE budget read
+    every gate shares) at the ledger surface."""
+    from ..util import hbm_budget_bytes
+    return hbm_budget_bytes()
+
+
+def _sample_interval() -> float:
+    from ..util import getenv
+    try:
+        return float(getenv("MXTPU_MEMORY_SAMPLE_S") or 0.0)
+    except (TypeError, ValueError):
+        return 0.0
+
+
+# -- raw reads ---------------------------------------------------------------
+
+def live_bytes() -> tuple:
+    """``(bytes, count)`` over ``jax.live_arrays()`` — every device
+    buffer the process holds a reference to. Per-array failures (a
+    buffer deleted mid-walk) are skipped, not raised."""
+    try:
+        import jax
+        arrays = jax.live_arrays()
+    except Exception:  # noqa: BLE001 — ledger must never be the fault
+        return 0, 0
+    total = n = 0
+    for a in arrays:
+        try:
+            total += int(a.nbytes)
+            n += 1
+        except Exception:  # noqa: BLE001 — deleted/donated buffer
+            continue
+    return total, n
+
+
+def device_bytes(device) -> int:
+    """Live-array bytes resident on ONE concrete jax device (the
+    ``context.tpu_memory_info`` fallback when PjRt has no stats)."""
+    try:
+        import jax
+        arrays = jax.live_arrays()
+    except Exception:  # noqa: BLE001
+        return 0
+    total = 0
+    for a in arrays:
+        try:
+            devs = a.devices() if callable(getattr(a, "devices", None)) \
+                else {getattr(a, "device", None)}
+            if device in devs:
+                total += int(a.nbytes)
+        except Exception:  # noqa: BLE001
+            continue
+    return total
+
+
+def device_stats() -> Dict[str, Dict]:
+    """PjRt ``memory_stats()`` per local device, where exposed (TPU/GPU
+    backends; the CPU backend usually returns nothing)."""
+    out: Dict[str, Dict] = {}
+    try:
+        import jax
+        devs = jax.local_devices()
+    except Exception:  # noqa: BLE001
+        return out
+    for d in devs:
+        try:
+            stats = d.memory_stats()
+        except Exception:  # noqa: BLE001
+            stats = None
+        if stats:
+            out[str(d)] = {k: stats[k] for k in sorted(stats)}
+    return out
+
+
+# -- per-site attribution ----------------------------------------------------
+
+def register_site(name: str, fn: Callable[[], Optional[int]]):
+    """Register a zero-arg provider reporting ``name``'s resident bytes
+    (``trainer.step`` registers its parameter+optimizer leaves,
+    ``serve.compiled`` its weight buffers, ``kvstore`` its parameter
+    table). Bound methods are held weakly — a collected owner silently
+    drops off the ledger. Returns a zero-arg unregister callable."""
+    if hasattr(fn, "__self__"):
+        ref: Callable = weakref.WeakMethod(fn)
+    else:
+        def ref(f=fn):
+            return f
+    key = (str(name), next(_SEQ))
+    with _LOCK:
+        _SITES[key] = ref
+
+    def unregister():
+        with _LOCK:
+            _SITES.pop(key, None)
+    return unregister
+
+
+def _site_bytes() -> Dict[str, int]:
+    with _LOCK:
+        items = list(_SITES.items())
+    out: Dict[str, int] = {}
+    dead = []
+    for key, ref in items:
+        fn = ref()
+        if fn is None:
+            dead.append(key)
+            continue
+        try:
+            b = fn()
+        except Exception:  # noqa: BLE001 — a broken provider is not a fault
+            continue
+        if b:
+            out[key[0]] = out.get(key[0], 0) + int(b)
+    if dead:
+        with _LOCK:
+            for key in dead:
+                _SITES.pop(key, None)
+    return out
+
+
+def note_static_peak(site: str, peak_bytes: int) -> None:
+    """Record a statically-predicted peak (the liveness scan's number)
+    so OOM bundles show the prediction beside the measured ledger —
+    staging notes the serve ladder here, the trainer its step graph."""
+    with _LOCK:
+        _STATIC_PEAKS[str(site)] = int(peak_bytes)
+
+
+def static_peaks() -> Dict[str, int]:
+    with _LOCK:
+        return dict(_STATIC_PEAKS)
+
+
+# -- the ledger --------------------------------------------------------------
+
+def _read() -> Dict:
+    """One pure residency reading (no gauges, no history, no watchdog)
+    — the side-effect-free half :func:`sample` and :func:`snapshot`
+    share."""
+    total, count = live_bytes()
+    rec: Dict[str, Any] = {"ts": time.time(),
+                           "live_bytes": int(total),
+                           "live_arrays": int(count),
+                           "sites": _site_bytes()}
+    budget = hbm_budget()
+    if budget:
+        rec["budget"] = int(budget)
+    dstats = device_stats()
+    if dstats:
+        rec["device_bytes_in_use"] = int(sum(
+            s.get("bytes_in_use", 0) for s in dstats.values()))
+        rec["device_bytes_limit"] = int(sum(
+            s.get("bytes_limit", 0) for s in dstats.values()))
+    return rec
+
+
+def sample() -> Dict:
+    """Take one ledger sample: read residency, publish the
+    ``mxtpu_memory_*`` gauges, append to the history ring, and run the
+    leak watchdog. Returns the sample dict (strict-JSON safe). This is
+    the ONE entry that feeds the watchdog window — read-only surfaces
+    (:func:`snapshot`, flight bundles) never pollute its cadence."""
+    from . import metrics as _metrics
+    rec = _read()
+    sites = rec["sites"]
+    _metrics.gauge("mxtpu_memory_live_bytes",
+                   "Total live jax-array bytes held by this process"
+                   ).set(float(rec["live_bytes"]))
+    _metrics.gauge("mxtpu_memory_live_arrays",
+                   "Live jax arrays held by this process"
+                   ).set(float(rec["live_arrays"]))
+    for site, b in sorted(sites.items()):
+        _metrics.gauge("mxtpu_memory_site_bytes",
+                       "Resident bytes attributed to one runtime site",
+                       site=site).set(float(b))
+    with _LOCK:
+        # a site that vanished (collected provider, freed buffers) must
+        # read 0, not its last non-zero value, on every later scrape
+        gone = _STATE.setdefault("published_sites", set()) - set(sites)
+        _STATE["published_sites"].update(sites)
+    for site in sorted(gone):
+        _metrics.gauge("mxtpu_memory_site_bytes",
+                       "Resident bytes attributed to one runtime site",
+                       site=site).set(0.0)
+    if rec.get("budget"):
+        _metrics.gauge("mxtpu_memory_budget_bytes",
+                       "Configured HBM budget (MXTPU_HBM_BUDGET)"
+                       ).set(float(rec["budget"]))
+    if rec.get("device_bytes_in_use") is not None:
+        _metrics.gauge("mxtpu_memory_device_bytes_in_use",
+                       "PjRt bytes_in_use summed over local devices"
+                       ).set(float(rec["device_bytes_in_use"]))
+        _metrics.gauge("mxtpu_memory_device_bytes_limit",
+                       "PjRt bytes_limit summed over local devices"
+                       ).set(float(rec["device_bytes_limit"]))
+    with _LOCK:
+        _HISTORY.append(rec)
+        window = list(_HISTORY)[-_LEAK_WINDOW:]
+        leak = _leak_verdict(window)
+        if leak is not None:
+            _STATE["leak_level"] = leak["live_bytes"]
+    if leak is not None:
+        from . import events as _events
+        _events.emit("memory.leak", severity="warning", **leak)
+        _metrics.counter("mxtpu_memory_leak_events_total",
+                         "Steady-state memory-growth warnings").inc()
+    return rec
+
+
+def _leak_verdict(window) -> Optional[Dict]:
+    """Leak decision over the newest sample window (caller holds the
+    lock): monotonic non-decreasing live bytes across a FULL window with
+    >= ``_LEAK_MIN_BYTES`` total growth. Damped — after flagging, the
+    level must grow another ``_LEAK_MIN_BYTES`` to re-flag; a drop
+    below the flagged level re-arms."""
+    if len(window) < _LEAK_WINDOW:
+        return None
+    vals = [w["live_bytes"] for w in window]
+    level = _STATE["leak_level"]
+    if any(b < a for a, b in zip(vals, vals[1:])):
+        if level is not None and vals[-1] < level:
+            _STATE["leak_level"] = None      # re-arm after a real drop
+        return None
+    growth = vals[-1] - vals[0]
+    if growth < _LEAK_MIN_BYTES:
+        return None
+    if level is not None and vals[-1] < level + _LEAK_MIN_BYTES:
+        return None                          # already flagged hereabouts
+    return {"live_bytes": vals[-1], "growth_bytes": int(growth),
+            "window_samples": len(vals),
+            "window_s": round(window[-1]["ts"] - window[0]["ts"], 3)}
+
+
+def segment() -> Dict:
+    """The lightweight per-step-report view: current residency + site
+    attribution (no device walk of stats, no history) — embedded as the
+    ``memory`` segment of ``profiler.step_report``."""
+    total, count = live_bytes()
+    return {"live_bytes": int(total), "live_arrays": int(count),
+            "sites": _site_bytes()}
+
+
+def snapshot() -> Dict:
+    """Everything the ledger knows — the ``memory`` section of flight
+    bundles and ``telemetry.snapshot()``. A READ: the fresh residency
+    reading here bypasses the gauges, the history ring, and the leak
+    watchdog, so snapshot-driven pollers (monitoring loops, repeated
+    flight dumps) can never shrink the watchdog's sample window or
+    emit events as a side effect."""
+    rec = _read()
+    with _LOCK:
+        hist = list(_HISTORY)[-32:]
+        doc = {"current": rec,
+               "budget": rec.get("budget"),
+               "static_peaks": dict(_STATIC_PEAKS),
+               "history": hist,
+               "leak": {"flagged_level": _STATE["leak_level"],
+                        "window_samples": _LEAK_WINDOW,
+                        "min_growth_bytes": _LEAK_MIN_BYTES},
+               "sampler_running": (_STATE["thread"] is not None
+                                   and _STATE["thread"].is_alive()),
+               "oom_bundles": _STATE["oom_bundles"]}
+    doc["device"] = device_stats()
+    return doc
+
+
+# -- background sampler ------------------------------------------------------
+
+def _run(interval_s: float, stop_ev: threading.Event) -> None:
+    while not stop_ev.wait(interval_s):
+        try:
+            sample()
+        except Exception:  # noqa: BLE001 — the sampler must not die loudly
+            continue
+
+
+def start(interval_s: Optional[float] = None) -> Optional[threading.Thread]:
+    """Start the background sampler (named daemon thread
+    ``mx-memory-ledger``). ``interval_s=None`` reads
+    ``MXTPU_MEMORY_SAMPLE_S``; a non-positive interval means "ledger
+    off" and returns None. Idempotent while a sampler is alive."""
+    if interval_s is None:
+        interval_s = _sample_interval()
+    if not interval_s or interval_s <= 0:
+        return None
+    with _LOCK:
+        t = _STATE["thread"]
+        if t is not None and t.is_alive():
+            return t
+        stop_ev = threading.Event()
+        t = threading.Thread(target=_run, args=(float(interval_s), stop_ev),
+                             name="mx-memory-ledger", daemon=True)
+        _STATE["thread"], _STATE["stop"] = t, stop_ev
+    t.start()
+    return t
+
+
+def start_from_env() -> Optional[threading.Thread]:
+    """Start the sampler iff ``MXTPU_MEMORY_SAMPLE_S`` > 0 (the
+    serve_bench / CI memory-smoke entry)."""
+    return start(None)
+
+
+def stop() -> None:
+    with _LOCK:
+        t, ev = _STATE["thread"], _STATE["stop"]
+        _STATE["thread"] = _STATE["stop"] = None
+    if ev is not None:
+        ev.set()
+    if t is not None and t.is_alive():
+        t.join(timeout=5.0)
+
+
+# -- OOM forensics -----------------------------------------------------------
+
+#: substrings marking a device allocator failure across jax/XLA versions
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory",
+                "OOM when allocating")
+
+
+def is_oom(exc: BaseException) -> bool:
+    """Whether an exception is a device out-of-memory (XLA surfaces
+    these as ``RESOURCE_EXHAUSTED`` RuntimeErrors)."""
+    text = f"{type(exc).__name__}: {exc}"
+    return any(m in text for m in _OOM_MARKERS)
+
+
+def record_oom(exc: BaseException, site: Optional[str] = None,
+               **context) -> Optional[str]:
+    """One OOM → one flight bundle (reason ``resource_exhausted``): the
+    bundle's memory section carries the live ledger beside the noted
+    static peaks, so the post-mortem shows prediction and measurement
+    on one page. Deduped on the exception object — an OOM re-raised
+    through nested :func:`oom_guard` layers writes exactly one bundle.
+    Returns the bundle path (None when the recorder is off)."""
+    if getattr(exc, "_mxtpu_oom_recorded", False):
+        return None
+    try:
+        exc._mxtpu_oom_recorded = True
+    except Exception:  # noqa: BLE001 — slotted exceptions: dedupe best-effort
+        pass
+    from . import events as _events
+    from . import flight as _flight
+    from . import metrics as _metrics
+    err = str(exc)
+    _events.emit("memory.oom", severity="error", site=site,
+                 error=err[:400], **context)
+    _metrics.counter("mxtpu_memory_oom_total",
+                     "Device RESOURCE_EXHAUSTED crashes recorded",
+                     site=site or "unknown").inc()
+    path = _flight.dump("resource_exhausted", site=site,
+                        error=err[:400], **context)
+    with _LOCK:
+        _STATE["oom_bundles"] += 1
+    return path
+
+
+@contextlib.contextmanager
+def oom_guard(site: str, **context):
+    """Wrap a dispatch site (``trainer.step``, ``serve.compiled``): a
+    ``RESOURCE_EXHAUSTED`` escaping the block is recorded
+    (:func:`record_oom`) and re-raised unchanged. Non-OOM exceptions
+    pass through untouched; the happy path costs one try/except."""
+    try:
+        yield
+    except BaseException as e:  # noqa: BLE001 — classify, record, re-raise
+        if is_oom(e):
+            record_oom(e, site=site, **context)
+        raise
+
+
+def reset() -> None:
+    """Reset history, leak state, OOM count and static peaks (tests).
+    Registered site providers survive — they belong to live objects."""
+    with _LOCK:
+        _HISTORY.clear()
+        _STATIC_PEAKS.clear()
+        _STATE["leak_level"] = None
+        _STATE["oom_bundles"] = 0

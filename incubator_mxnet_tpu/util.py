@@ -12,7 +12,43 @@ from typing import Any, Dict, Optional
 
 __all__ = ["getenv", "setenv", "env_var_doc", "makedirs", "use_np_shape",
            "is_np_shape", "is_np_array", "set_np", "reset_np", "np_shape",
-           "nearest_rank_percentile"]
+           "nearest_rank_percentile", "parse_size", "hbm_budget_bytes"]
+
+
+def parse_size(s: str) -> int:
+    """Byte-size string → int bytes: plain/float forms (``"123"``,
+    ``"16e9"``) and binary suffixes (``"512M"``, ``"16G"``, ``"1.5T"``,
+    optional trailing ``B``/``iB``). THE parse ``MXTPU_HBM_BUDGET``
+    consumers share (the MX709 pass, the serve staging preflight, the
+    autotune feasibility constraint, the memory ledger)."""
+    mult = 1
+    low = str(s).strip().lower()
+    # strip an optional iB/B after a unit letter, then the unit letter
+    if low.endswith("ib"):
+        low = low[:-2]
+    elif low.endswith("b"):
+        low = low[:-1]
+    if low and low[-1] in "kmgt":
+        mult = {"k": 1 << 10, "m": 1 << 20,
+                "g": 1 << 30, "t": 1 << 40}[low[-1]]
+        low = low[:-1]
+    try:
+        if not low:               # suffix-only input ("B", "iB", "G", " ")
+            raise ValueError(low)
+        return int(float(low) * mult)
+    except ValueError:
+        raise ValueError(f"cannot parse byte size {s!r} (want e.g. "
+                         "'2000000000', '16e9', '512M', '16G')") from None
+
+
+def hbm_budget_bytes() -> Optional[int]:
+    """``MXTPU_HBM_BUDGET`` parsed to bytes via :func:`parse_size`, or
+    ``None`` when unset — THE single budget read shared by the MX709
+    static pass (``analysis.hlo.cost``), the serve staging preflight,
+    the autotune feasibility constraint, and the ``telemetry.memory``
+    ledger, so the gates can never read different capacities."""
+    raw = getenv("MXTPU_HBM_BUDGET")
+    return parse_size(raw) if raw else None
 
 
 def nearest_rank_percentile(sorted_vals, q: float) -> float:
@@ -138,6 +174,31 @@ ENV_VARS: Dict[str, tuple] = {
                               "is not given (candidates enumerate in "
                               "deterministic space order and truncate "
                               "here)."),
+    "MXTPU_HBM_BUDGET": ("", "Per-chip device-memory budget in bytes "
+                         "(K/M/G suffixes and float forms accepted). "
+                         "When set: the MX709 hlo_memory pass errors on "
+                         "any graph (or summed serve bucket ladder) "
+                         "whose liveness-scan peak_live_bytes exceeds "
+                         "it, serve.ModelRegistry.load rejects "
+                         "over-budget ladders at staging while the "
+                         "active version keeps serving, "
+                         "benchmark/autotune.py excludes infeasible "
+                         "candidates from winner election, and the "
+                         "telemetry.memory ledger publishes it as "
+                         "mxtpu_memory_budget_bytes / uses it as the "
+                         "capacity in context.tpu_memory_info's "
+                         "ledger fallback. Unset = no memory gating."),
+    "MXTPU_MEMORY_SAMPLE_S": ("0", "Interval (seconds) of the "
+                              "telemetry.memory background sampler "
+                              "(named daemon thread mx-memory-ledger): "
+                              "each tick reads jax.live_arrays() + "
+                              "device memory_stats + registered site "
+                              "providers into mxtpu_memory_* gauges and "
+                              "runs the leak watchdog (monotonic growth "
+                              "across a full 8-sample window >= 1 MiB "
+                              "emits a memory.leak warning event). "
+                              "0 = sampler off (manual sample() calls "
+                              "still work)."),
     "MXTPU_TELEMETRY": ("1", "Master switch for the mx.telemetry event "
                         "bus; 0 turns every emit() into a no-op."),
     "MXTPU_TELEMETRY_RING": ("1024", "Per-kind event ring-buffer capacity; "

@@ -1,0 +1,24 @@
+"""Seeded MX709: a wide MLP whose liveness-scan peak (~2.2 MiB of
+parameters + activations resident at once) exceeds the 256 KiB
+``MXTPU_HBM_BUDGET`` the test sets — the geometry cannot fit the chip.
+The harness sets the env var from :data:`BUDGET` for exactly the verify
+call (monkeypatch), so the budget never leaks into other tests."""
+import numpy as onp
+
+from incubator_mxnet_tpu import gluon, nd
+
+EXPECT = "MX709"
+#: the budget the test exports as MXTPU_HBM_BUDGET — far below the
+#: model's deterministic peak_live_bytes, far above the clean fixture's
+BUDGET = str(256 * 1024)
+
+
+def model():
+    net = gluon.nn.HybridSequential(prefix="hlomem_")
+    with net.name_scope():
+        net.add(gluon.nn.Dense(512, activation="relu", in_units=512))
+        net.add(gluon.nn.Dense(512, in_units=512))
+    net.initialize()
+    net.hybridize()
+    net(nd.array(onp.zeros((8, 512), "float32")))
+    return net, None

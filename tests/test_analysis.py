@@ -576,6 +576,26 @@ class TestHloPasses:
         rep = hlo.verify(entry, sample)
         assert len(rep) == 0, str(rep)
 
+    def test_mx709_fixture_flagged(self, monkeypatch):
+        # the seeded over-budget model, with MXTPU_HBM_BUDGET exported
+        # for exactly this verify (same contract as the MX701-706
+        # harness: the seeded violation is the only family present)
+        from incubator_mxnet_tpu.analysis import hlo
+        from incubator_mxnet_tpu.analysis.diagnostics import \
+            DEFAULT_SEVERITY
+        mod = _hlo_fixture("mx709_over_budget.py")
+        monkeypatch.setenv("MXTPU_HBM_BUDGET", mod.BUDGET)
+        entry, sample = mod.model()
+        rep = hlo.verify(entry, sample)
+        assert mod.EXPECT in rep.codes(), rep.codes()
+        assert {d.code for d in rep} == {mod.EXPECT}
+        assert DEFAULT_SEVERITY[mod.EXPECT] in \
+            {d.severity for d in rep if d.code == mod.EXPECT}
+        # budget gone -> the same model is silent (the pass is opt-in
+        # via the env, so un-budgeted runs and the zoo see nothing)
+        monkeypatch.delenv("MXTPU_HBM_BUDGET")
+        assert hlo.verify(entry, sample).codes() == []
+
     def test_error_severities(self):
         # MX701 (callback) and MX705 gate CI (error); the perf-shaped
         # findings ride as warnings
@@ -592,7 +612,7 @@ class TestHloPasses:
         names = hlo.list_hlo_passes()
         assert names == ["hlo_transfer", "hlo_promotion", "hlo_dead_code",
                          "hlo_donation", "hlo_constants", "hlo_signature",
-                         "hlo_mesh_step", "hlo_cost"]
+                         "hlo_mesh_step", "hlo_cost", "hlo_memory"]
         with pytest.raises(MXNetError, match="unknown hlo pass"):
             hlo.run_hlo_passes([], names=["nope"])
 

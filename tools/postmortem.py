@@ -102,6 +102,42 @@ def render(doc: Dict, events_n: int = 40) -> str:
                 out.append(f"    {seg.get('name'):<22} "
                            f"{seg.get('wall_ms')}ms")
 
+    # -- device memory -----------------------------------------------------
+    mem = doc.get("memory") or {}
+    if isinstance(mem, dict) and "error" not in mem:
+        out += _section("device memory")
+        cur = mem.get("current") or {}
+
+        def mib(n):
+            try:
+                return f"{float(n) / 2**20:.1f} MiB"
+            except (TypeError, ValueError):
+                return str(n)
+
+        line = (f"  live {mib(cur.get('live_bytes', 0))} across "
+                f"{cur.get('live_arrays', 0)} array(s)")
+        if cur.get("budget"):
+            line += f", budget {mib(cur['budget'])}"
+        if cur.get("device_bytes_in_use") is not None:
+            line += (f", device in_use {mib(cur['device_bytes_in_use'])}"
+                     f"/{mib(cur.get('device_bytes_limit', 0))}")
+        out.append(line)
+        for site, b in sorted((cur.get("sites") or {}).items()):
+            out.append(f"    site {site:<20} {mib(b)}")
+        peaks = mem.get("static_peaks") or {}
+        for site, b in sorted(peaks.items()):
+            out.append(f"    static peak {site:<13} {mib(b)} (predicted)")
+        leak = mem.get("leak") or {}
+        if leak.get("flagged_level"):
+            out.append(f"  !! leak watchdog flagged at "
+                       f"{mib(leak['flagged_level'])}")
+        hist = [h for h in (mem.get("history") or [])
+                if isinstance(h, dict)][-8:]
+        if len(hist) >= 2:
+            out.append("  recent samples: "
+                       + " -> ".join(mib(h.get("live_bytes", 0))
+                                     for h in hist))
+
     # -- compile ledger ----------------------------------------------------
     comp = doc.get("compiles") or {}
     out += _section("compile ledger")
@@ -127,6 +163,7 @@ def render(doc: Dict, events_n: int = 40) -> str:
         if name.startswith(("mxtpu_slo_", "mxtpu_flight_",
                             "mxtpu_guard_", "mxtpu_watchdog_",
                             "mxtpu_chaos_", "mxtpu_lockcheck_",
+                            "mxtpu_memory_",
                             "mxtpu_router_", "mxtpu_serve_replica")):
             for labels, val in sorted(mets[name].items()):
                 v = (val.get("count") if isinstance(val, dict) else val)
