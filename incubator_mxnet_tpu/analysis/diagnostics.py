@@ -74,6 +74,11 @@ CODES = {
     "MX602": "request-path code emits bus events outside any request/step "
              "correlation scope (uncorrelated telemetry — the event can "
              "never be stitched into a request or step story)",
+    "MX603": "tensor statistics routed through a host callback inside a "
+             "jitted function (jax.debug.callback/print, pure_callback, "
+             "io_callback over a reduction) — breaks whole-step capture; "
+             "return the stats as extra pinned outputs instead "
+             "(telemetry.numerics)",
     "MX701": "host<->device transfer inside a jitted region (callback / "
              "device_put round-trip per executed step)",
     "MX702": "unintended f64/widening float promotion in the compiled "
@@ -131,7 +136,7 @@ DEFAULT_SEVERITY: Dict[str, str] = {
     "MX301": "error", "MX302": "error", "MX303": "error",
     "MX401": "warning",
     "MX501": "warning", "MX502": "warning",
-    "MX601": "warning", "MX602": "warning",
+    "MX601": "warning", "MX602": "warning", "MX603": "warning",
     "MX701": "error", "MX702": "warning", "MX703": "warning",
     "MX704": "warning", "MX705": "error", "MX706": "warning",
     "MX707": "info", "MX708": "error", "MX709": "error",

@@ -26,6 +26,18 @@ severity (hygiene, not correctness; ``mxlint --strict`` gates):
   a free-floating fact that can never be stitched into any request or
   step story — the uncorrelated telemetry this PR's tracing layer
   exists to eliminate.
+- **MX603** — tensor statistics routed through a **host callback inside
+  a jitted function**: a ``jax.debug.callback`` / ``jax.debug.print`` /
+  ``jax.pure_callback`` / ``io_callback`` call whose arguments carry a
+  reduction (``.mean()``, ``jnp.min``, ``linalg.norm``, ...) lexically
+  inside a function that is jit-compiled (decorated with
+  ``jit``/``jax.jit``/``pjit``, or passed by name to ``jax.jit(...)``
+  in the same file). This is the anti-pattern the in-graph numerics
+  design forbids: a per-step host callback breaks whole-step capture
+  (MX701/MX708 catch it at the HLO level; this is the AST-level twin
+  that fires before anything is traced). Return the stats as extra
+  pinned outputs and decimate host-side — ``telemetry.numerics`` is
+  exactly that machinery.
 
 Heuristics are tuned for zero noise elsewhere: for MX601, any use of
 ``telemetry``, ``profiler`` scopes, ``emit``, a metrics instrument, or
@@ -40,7 +52,7 @@ out of its vocabulary by construction.
 from __future__ import annotations
 
 import ast
-from typing import List, Set
+from typing import List, Optional, Set
 
 from .diagnostics import Diagnostic, Report, walk_lint
 
@@ -208,6 +220,107 @@ def _lint_uncorrelated(tree: ast.Module, filename: str,
                 severity="warning"))
 
 
+# -- MX603: stats through host callbacks in a jitted region ------------------
+
+#: callback entry points that round-trip to host from inside a jit
+_CALLBACK_LEAVES = {"pure_callback", "io_callback", "callback",
+                    "debug_callback", "host_callback"}
+#: jax.debug.<leaf> forms (print included: it IS a host callback)
+_DEBUG_LEAVES = {"callback", "print"}
+#: reduction callables whose presence in a callback's arguments marks
+#: it as "stats leaving the graph through the side door"
+_REDUCTION_LEAVES = {"min", "max", "mean", "sum", "std", "var", "norm",
+                     "rms", "amin", "amax", "nanmin", "nanmax",
+                     "nanmean", "histogram", "bincount", "quantile",
+                     "percentile", "isfinite", "isnan", "any", "all"}
+#: decorator names marking a function as jit-compiled
+_JIT_NAMES = {"jit", "pjit"}
+
+
+def _leaf_name(f: ast.AST) -> Optional[str]:
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def _is_host_callback_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    leaf = _leaf_name(f)
+    if leaf in _CALLBACK_LEAVES:
+        return True
+    # jax.debug.callback / jax.debug.print
+    if leaf in _DEBUG_LEAVES and isinstance(f, ast.Attribute) \
+            and isinstance(f.value, ast.Attribute) \
+            and f.value.attr == "debug":
+        return True
+    return False
+
+
+def _carries_reduction(call: ast.Call) -> bool:
+    for arg in list(call.args) + [kw.value for kw in call.keywords]:
+        for node in ast.walk(arg):
+            if isinstance(node, ast.Call) \
+                    and _leaf_name(node.func) in _REDUCTION_LEAVES:
+                return True
+    return False
+
+
+def _is_jit_decorator(dec: ast.AST) -> bool:
+    # @jit / @jax.jit / @pjit / @partial(jax.jit, ...) / @jax.jit(...)
+    if isinstance(dec, ast.Call):
+        if _leaf_name(dec.func) in ("partial",):
+            return any(_leaf_name(getattr(a, "func", a)) in _JIT_NAMES
+                       or _leaf_name(a) in _JIT_NAMES for a in dec.args)
+        dec = dec.func
+    return _leaf_name(dec) in _JIT_NAMES
+
+
+def _jitted_functions(tree: ast.Module) -> List[ast.AST]:
+    """Functions provably jit-compiled in this file: jit-decorated, or
+    passed by name as the first argument of a ``jit(...)`` call."""
+    jitted_names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _leaf_name(node.func) in _JIT_NAMES:
+            if node.args and isinstance(node.args[0], ast.Name):
+                jitted_names.add(node.args[0].id)
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if node.name in jitted_names \
+                or any(_is_jit_decorator(d) for d in node.decorator_list):
+            out.append(node)
+    return out
+
+
+def _lint_callback_stats(tree: ast.Module, filename: str,
+                         report: Report) -> None:
+    """MX603 over every provably-jitted function in the module."""
+    for func in _jitted_functions(tree):
+        for node in ast.walk(func):
+            if not _is_host_callback_call(node):
+                continue
+            if not _carries_reduction(node):
+                continue   # custom-op style callbacks over raw tensors
+                # are MX701's HLO-level business, not a stats smell
+            report.add(Diagnostic(
+                "MX603",
+                f"tensor statistics leave the jitted function "
+                f"{func.name}() through a host callback "
+                f"({_leaf_name(node.func)}) — this breaks whole-step "
+                "capture (one callback round-trip per executed step); "
+                "compute the reduction in-graph and return it as an "
+                "extra pinned output (telemetry.numerics.graph_stats/"
+                "tap), decimating host-side",
+                node=f"{filename}:{getattr(node, 'lineno', 0)}",
+                op=func.name, pass_name="telemetry_lint",
+                severity="warning"))
+
+
 def lint_source(src: str, filename: str = "<string>") -> Report:
     """Lint one Python source blob for MX6xx findings."""
     report = Report()
@@ -218,6 +331,9 @@ def lint_source(src: str, filename: str = "<string>") -> Report:
     # MX602 runs unconditionally: emit() is its subject, so file-level
     # telemetry evidence cannot excuse it
     _lint_uncorrelated(tree, filename, report)
+    # MX603 likewise: a host callback carrying reductions out of a jit
+    # is the subject itself, never excused by other telemetry in the file
+    _lint_callback_stats(tree, filename, report)
     if _has_telemetry_evidence(tree):
         return report
     seen_clocks: Set[int] = set()  # one finding per scope; a clock call

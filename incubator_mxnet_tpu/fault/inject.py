@@ -56,7 +56,7 @@ from ..lockcheck import make_lock
 
 __all__ = ["ChaosMonkey", "ChaosCrash", "chaos", "enable", "disable",
            "active", "enable_from_env", "should", "maybe_delay",
-           "maybe_leak", "crash", "armed", "poison"]
+           "maybe_leak", "scale_ramp", "crash", "armed", "poison"]
 
 
 class ChaosCrash(MXNetError):
@@ -84,6 +84,15 @@ class ChaosMonkey:
     of device memory at the site (the trainer's ``trainer.step`` hook) —
     a simulated slow leak the ``telemetry.memory`` watchdog must flag
     as a ``memory.leak`` event
+    ``grad_blowup`` / ``activation_drift`` — ``scale_ramp(site)``: a
+    seeded per-site MULTIPLICATIVE ramp consumed by ``trainer.step``'s
+    chaos batch hook — each fired draw multiplies the site's running
+    scale by ``blowup_factor`` (resp. the gentler ``drift_factor``), so
+    activations and gradients grow monotonically step over step: the
+    slow-divergence signature the ``telemetry.numerics`` drift watchdog
+    must flag BEFORE the run goes non-finite (the ramp eventually
+    overflows f32 and the classic StepGuard verdict trips too — one
+    knob drives the full drift → non-finite escalation timeline)
     ``crash_sites`` — iterable of site names where :meth:`crash` raises
     (and :meth:`armed` consumes without raising); each site fires at most
     ``crash_count`` times (default 1) then disarms, so a retried save can
@@ -96,6 +105,8 @@ class ChaosMonkey:
                  replica_kill: float = 0.0, slow_replica: float = 0.0,
                  corrupt_artifact: float = 0.0,
                  leak: float = 0.0, leak_bytes: float = 1 << 20,
+                 grad_blowup: float = 0.0, activation_drift: float = 0.0,
+                 blowup_factor: float = 16.0, drift_factor: float = 1.5,
                  crash_sites: Iterable[str] = (), crash_count: int = 1):
         self.seed = int(seed)
         self.probs: Dict[str, float] = {
@@ -105,8 +116,16 @@ class ChaosMonkey:
             "slow_replica": float(slow_replica),
             "corrupt_artifact": float(corrupt_artifact),
             "leak": float(leak),
+            "grad_blowup": float(grad_blowup),
+            "activation_drift": float(activation_drift),
         }
         self.leak_bytes = int(leak_bytes)
+        #: per-fired-draw ramp factors of the numerics-drift chaos knobs
+        self._ramp_factor: Dict[str, float] = {
+            "grad_blowup": float(blowup_factor),
+            "activation_drift": float(drift_factor)}
+        #: fired-draw counts per ramp site (scale = factor ** count)
+        self._ramp: Dict[str, int] = {}
         #: retained leak allocations — the whole point is that nothing
         #: ever frees them while the monkey is installed
         self._leaked: list = []
@@ -168,6 +187,23 @@ class ChaosMonkey:
         with self._lock:
             self._leaked.append((site, buf))
         return int(n * 4)
+
+    def scale_ramp(self, site: str) -> float:
+        """Advance and return ``site``'s multiplicative chaos ramp
+        (``grad_blowup`` / ``activation_drift``): each fired seeded draw
+        multiplies the running scale by the site's factor, and the
+        CURRENT scale applies from then on — a monotonic, deterministic
+        divergence trajectory. Returns 1.0 while the site never fired
+        (or its probability is 0)."""
+        p = self.probs.get(site, 0.0)
+        if p <= 0.0:
+            return 1.0
+        if self.should(site):
+            with self._lock:
+                self._ramp[site] = self._ramp.get(site, 0) + 1
+        with self._lock:
+            k = self._ramp.get(site, 0)
+        return float(self._ramp_factor.get(site, 2.0) ** k) if k else 1.0
 
     def crash(self, site: str, dump: bool = True) -> None:
         """Raise :class:`ChaosCrash` if ``site`` is armed (then disarm).
@@ -302,6 +338,11 @@ def maybe_delay(site: str) -> float:
 def maybe_leak(site: str) -> int:
     m = active()
     return m.maybe_leak(site) if m is not None else 0
+
+
+def scale_ramp(site: str) -> float:
+    m = active()
+    return m.scale_ramp(site) if m is not None else 1.0
 
 
 def crash(site: str, dump: bool = True) -> None:

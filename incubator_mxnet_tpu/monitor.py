@@ -2,21 +2,39 @@
 
 Reference counterpart: ``python/mxnet/monitor.py (Monitor)`` — installed on
 executors (``mod.fit(..., monitor=mon)``), it records a statistic of every
-op output whose name matches ``pattern`` each ``interval`` batches. The
-reference hooks the engine's per-op callbacks; here the Executor compiles a
-second "capture" program returning every node's primary output (one extra
-jit executable, built lazily on the first monitored batch — the normal
-training step stays a single fused program).
+op output whose name matches ``pattern`` each ``interval`` batches.
 
-Usage::
+.. deprecated:: this class is now a COMPATIBILITY BRIDGE onto
+   ``mx.telemetry.numerics``. The reference design (and this module's
+   previous life) re-executed a second "capture" program per monitored
+   batch and pulled every intermediate to host — on the jit runtime that
+   breaks whole-step capture (two executables per step) and is exactly
+   the per-step host-readback anti-pattern MX603/MX701 forbid. The
+   bridge instead *taps* each matching child block
+   (``numerics.tap(name, out)`` via a forward hook, collected at trace
+   time), so the statistics are computed **inside** the one compiled
+   step and decimated host-side — MXNet-parity users get the new
+   telemetry (events, gauges, drift watchdog, flight bundles) for free.
+   New code should use ``telemetry.numerics`` directly.
 
-    mon = mx.monitor.Monitor(interval=10, pattern='.*fullyconnected.*')
-    mod.fit(train_iter, monitor=mon, ...)
+Usage (bridge)::
+
+    mon = mx.monitor.Monitor(interval=10, pattern='.*dense.*')
+    mon.install(net)                    # BEFORE the first trainer.step
+    trainer = parallel.ShardedTrainer(net, ...)
+    for x, y in batches:
+        mon.tic()
+        trainer.step(x, y)
+        mon.toc_print()                 # rms rows from the numerics ring
+
+The legacy executor path (``install(exe)`` with an object exposing
+``capture_internals``) keeps its eager behavior for Module users.
 """
 from __future__ import annotations
 
 import logging
 import re
+import warnings
 from typing import Callable, List, Optional, Tuple
 
 import numpy as onp
@@ -25,7 +43,9 @@ __all__ = ["Monitor"]
 
 
 def _default_stat(arr: onp.ndarray) -> float:
-    """Reference default: ||x|| / sqrt(x.size)."""
+    """Reference default: ||x|| / sqrt(x.size) — exactly the ``rms``
+    field of the numerics stat vector, which is why the bridge can
+    serve it without ever materializing the tensor on host."""
     a = onp.asarray(arr, dtype=onp.float64)
     return float(onp.linalg.norm(a) / max(onp.sqrt(a.size), 1.0))
 
@@ -43,13 +63,84 @@ class Monitor:
         self.queue: List[Tuple[int, str, float]] = []
         self.logger = logging.getLogger(__name__)
         self._execs: List = []
+        self._tap_sites: List[str] = []
+        self._hook_handles: List = []
+        self._reported: dict = {}      # site -> last ring step reported
+        self._set_override = False     # we armed numerics.configure()
 
-    # -- executor side -----------------------------------------------------
-    def install(self, exe) -> None:
-        """Attach to an Executor (called by Module.bind/fit)."""
-        if exe not in self._execs:
-            self._execs.append(exe)
+    # -- install: numerics bridge (Block) or legacy executor ---------------
+    def install(self, target) -> None:
+        """Attach to an Executor (legacy eager path) or to a gluon Block
+        tree (the numerics bridge: every matching child is tapped via a
+        forward hook, so its output statistics are computed in-graph by
+        the instrumented trainer/serving build)."""
+        if hasattr(target, "capture_internals"):
+            if target not in self._execs:
+                self._execs.append(target)
+            return
+        from .gluon.block import Block
+        if not isinstance(target, Block):
+            raise TypeError(f"Monitor.install expects an Executor or a "
+                            f"gluon Block, got {type(target)}")
+        warnings.warn(
+            "mx.monitor.Monitor now bridges onto mx.telemetry.numerics "
+            "(in-graph stats, decimated host-side); use the numerics "
+            "API directly in new code", DeprecationWarning, stacklevel=2)
+        from .telemetry import numerics as _numerics
+        # the bridge needs numerics ON: if the env left it off, arm a
+        # summary config whose decimation matches this monitor's
+        # interval — installed via the programmatic override so the
+        # NEXT trainer/serve build picks it up
+        cfg = _numerics.config()
+        if not cfg.enabled:
+            _numerics.configure(_numerics.NumericsConfig(
+                mode="summary", every=self.interval))
+            self._set_override = True
+        self._install_block(target)
 
+    def _install_block(self, block) -> None:
+        from .telemetry import numerics as _numerics
+        seen = set()
+
+        def _walk(b):
+            yield b
+            for c in b._children.values():
+                yield from _walk(c)
+
+        for child in _walk(block):
+            name = getattr(child, "name", None)
+            if not name or name in seen or child is block:
+                continue
+            seen.add(name)
+            if not self.pattern.match(name):
+                continue
+
+            def hook(blk, _args, out, _name=name):
+                o = out[0] if isinstance(out, (list, tuple)) else out
+                _numerics.tap(_name, o)
+
+            self._hook_handles.append(child.register_forward_hook(hook))
+            self._tap_sites.append(f"act:{name}")
+        if not self._tap_sites:
+            self.logger.warning(
+                "Monitor.install: no child block matched pattern %r",
+                self.pattern.pattern)
+
+    def detach(self) -> None:
+        """Remove every bridge hook (the taps disappear from the NEXT
+        trace; already-compiled graphs keep their baked stats) and
+        restore the numerics config override if :meth:`install` armed
+        it — a detached monitor must not leave every LATER-built
+        trainer/CompiledModel silently instrumented."""
+        for h in self._hook_handles:
+            h.detach()
+        self._hook_handles = []
+        if self._set_override:
+            from .telemetry import numerics as _numerics
+            _numerics.configure(None)
+            self._set_override = False
+
+    # -- batch cadence ------------------------------------------------------
     def tic(self) -> None:
         """Start of batch: decide whether this batch is monitored."""
         self.activated = (self.step % self.interval) == 0
@@ -62,10 +153,26 @@ class Monitor:
                     continue
                 self.queue.append(
                     (self.step - 1, name, self.stat_func(onp.asarray(val))))
+        if self._tap_sites:
+            from .telemetry import numerics as _numerics
+            if self.stat_func is not _default_stat:
+                warnings.warn(
+                    "Monitor bridge: custom stat_func is not supported "
+                    "over in-graph stats; reporting rms (the reference "
+                    "default norm/sqrt(size))", stacklevel=2)
+                self.stat_func = _default_stat
+            for site in self._tap_sites:
+                for rec in _numerics.ring(site):
+                    if rec["step"] is None \
+                            or rec["step"] <= self._reported.get(site, 0):
+                        continue
+                    self._reported[site] = rec["step"]
+                    self.queue.append((rec["step"], site, rec["rms"]))
 
     def toc(self) -> List[Tuple[int, str, float]]:
-        """End of batch: collect stats from installed executors (if this
-        batch was monitored) and return them."""
+        """End of batch: collect stats (legacy executors eagerly; bridge
+        sites from the numerics ring — new entries since last toc) and
+        return them."""
         if not self.activated:
             return []
         self._collect()

@@ -88,7 +88,8 @@ class ShardedTrainer:
                  donate: bool = True, zero1: Optional[bool] = None,
                  kvstore=None, guard=None, watchdog=None,
                  fused: Optional[bool] = None,
-                 autotune_key: Optional[str] = None):
+                 autotune_key: Optional[str] = None,
+                 numerics=None):
         self._block = block
         self._loss_fn = loss_fn
         self._optimizer = opt_mod.create(
@@ -137,6 +138,15 @@ class ShardedTrainer:
                               or type(block).__name__.lower())
         self._tuned = None           # consult result, resolved at build
         self.autotune_entry: Optional[Dict[str, Any]] = None
+        #: in-graph numerics telemetry (telemetry.numerics): an explicit
+        #: NumericsConfig, or None = resolve MXTPU_NUMERICS at build
+        #: time. When enabled the step graph returns per-site stat
+        #: vectors (param:/grad:/act: sites) as extra pinned replicated
+        #: outputs of the SAME jitted graph — still exactly one
+        #: executable per step — which the host syncs (folded into the
+        #: guard's existing device read) every cfg.every steps.
+        self._numerics_req = numerics
+        self._numerics_cfg = None    # resolved at build (env or explicit)
         self._params = None          # sorted List[Parameter]
         self._param_vals = None      # tuple of sharded jax arrays
         self._opt_states = None      # tuple of per-param state tuples
@@ -295,14 +305,15 @@ class ShardedTrainer:
         """The explicit pjit resource contract of the compiled step:
         ``(in_shardings, out_shardings)`` NamedSharding pytrees matching
         ``step(param_vals, opt_states, key, lr, t, *batch)`` →
-        ``(loss, gnorm, new_vals, new_states, effects, t+1[, ok])``
-        (``ok`` — the in-graph guard verdict — only on the fused path).
-        Scalars and the RNG key replicate; parameters/optimizer shards
-        carry their rule (+ zero1 ``dp``) layouts in AND out, so the
-        optimizer update is compiled cross-replica sharded and the next
-        call sees identical placements (no silent re-trace); batch
-        arguments take the batch-over-``dp`` / seq-over-``sp`` data
-        sharding."""
+        ``(loss, gnorm, new_vals, new_states, effects, t+1[, ok][, stats])``
+        (``ok`` — the in-graph guard verdict — only on the fused path;
+        ``stats`` — the per-site numerics pytree — only when numerics
+        telemetry is enabled for this build). Scalars and the RNG key
+        replicate; parameters/optimizer shards carry their rule (+ zero1
+        ``dp``) layouts in AND out, so the optimizer update is compiled
+        cross-replica sharded and the next call sees identical
+        placements (no silent re-trace); batch arguments take the
+        batch-over-``dp`` / seq-over-``sp`` data sharding."""
         repl = NamedSharding(self._mesh, P())
         batch_sh = tuple(
             data_sharding(self._mesh, batch_axis=0, seq_axis=self._seq_axis,
@@ -317,15 +328,26 @@ class ShardedTrainer:
             # the guard verdict: a pinned replicated scalar, read back in
             # the SAME host sync as loss/grad-norm
             out_shardings = out_shardings + (repl,)
+        if self._numerics_cfg is not None and self._numerics_cfg.enabled:
+            # numerics stats: a dict subtree of small replicated vectors
+            # — one repl prefix broadcasts over it whatever its arity
+            out_shardings = out_shardings + (repl,)
         return in_shardings, out_shardings
 
     def _make_loss_grads(self, n_data: int) -> Callable:
-        """``(param_vals, key, t, *batch) -> (loss, gnorm, grads, effects)``
-        — the fwd+bwd half of the step, shared verbatim by the compiled
-        pjit step and the kvstore-fallback path so their gradients are the
-        same function of the same inputs."""
+        """``(param_vals, key, t, *batch) -> (loss, gnorm, grads, effects,
+        taps)`` — the fwd+bwd half of the step, shared verbatim by the
+        compiled pjit step and the kvstore-fallback path so their
+        gradients are the same function of the same inputs. ``taps`` is
+        the tuple of in-graph activation stats collected from
+        ``numerics.tap()`` sites during the forward trace (site names
+        recorded in ``info['tap_sites']``); empty when numerics is off
+        — tap stat tracers belong to the inner differentiated trace, so
+        like the aux effects they MUST ride out through ``has_aux``."""
         blk, params = self._block, self._params
         loss_fn, ctx, info = self._loss_fn, self._ctx, self._info
+        num_cfg = self._numerics_cfg
+        num_on = num_cfg is not None and num_cfg.enabled
 
         def loss_grads(param_vals, key, t, *batch_vals):
             # Per-step randomness is derived ON DEVICE from one resident base
@@ -335,13 +357,17 @@ class ShardedTrainer:
             key = jax.random.fold_in(key, t)
 
             def loss_of(pvals):
+                from ..telemetry import numerics as _numerics
                 proxies = {id(p): NDArray(v, ctx=ctx)
                            for p, v in zip(params, pvals)}
                 ins = [NDArray(v, ctx=ctx) for v in batch_vals]
+                col_ctx = (_numerics.collecting(num_cfg) if num_on
+                           else _nullcontext())
                 _TRACING.flag = True
                 try:
                     with autograd.pause(train_mode=True), \
                             random_mod.trace_rng(key), \
+                            col_ctx as col, \
                             _trace.TraceScope(proxies) as scope:
                         out = blk.forward(*ins[:n_data])
                         loss = loss_fn(out, *ins[n_data:])
@@ -349,9 +375,11 @@ class ShardedTrainer:
                     _TRACING.flag = False
                 lv = loss._data if isinstance(loss, NDArray) else loss
                 info["effects"] = list(scope.effect_keys)
-                return jnp.mean(lv), tuple(scope.effect_values)
+                info["tap_sites"] = list(col.names) if num_on else []
+                taps = tuple(col.values) if num_on else ()
+                return jnp.mean(lv), (tuple(scope.effect_values), taps)
 
-            (loss, effects), grads = jax.value_and_grad(
+            (loss, (effects, taps)), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(param_vals)
             # Global grad norm, fused into the step (fp32 accumulation so a
             # bf16 overflow can't hide): one scalar out, consumed by the
@@ -359,15 +387,35 @@ class ShardedTrainer:
             # trainer.last_grad_norm.
             gnorm = jnp.sqrt(sum(
                 jnp.sum(jnp.square(g.astype(jnp.float32))) for g in grads))
-            return loss, gnorm, grads, effects
+            return loss, gnorm, grads, effects, taps
 
         return loss_grads
+
+    def _resolve_numerics(self):
+        """Resolve the numerics config ONCE per trainer (explicit ctor
+        config wins; else the env) — build-time, like the autotune
+        consult, so flipping MXTPU_NUMERICS mid-run cannot silently
+        re-trace a compiled step."""
+        if self._numerics_cfg is None:
+            from ..telemetry import numerics as _numerics
+            self._numerics_cfg = (self._numerics_req
+                                  if self._numerics_req is not None
+                                  else _numerics.config())
+        return self._numerics_cfg
 
     def _build_step(self, n_data: int, batch_ndims: Sequence[int]) -> Callable:
         opt = self._optimizer
         param_shardings = self._param_shardings
         state_shardings = self._state_shardings
         lr_mults, wds, mp = self._per_param_hparams()
+        num_cfg = self._resolve_numerics()
+        num_on = num_cfg.enabled
+        param_names = [name for name, _ in
+                       sorted(self._block.collect_params().items())]
+        # local alias, NOT self: the jitted step closure must never
+        # capture the trainer — that cycle would keep dead trainers
+        # (and their weak memory-ledger providers) alive past refcount
+        step_info = self._info
         loss_grads = self._make_loss_grads(n_data)
         fused = self._fused
         # LR-schedule position folded into the graph (whole-step capture):
@@ -392,8 +440,26 @@ class ShardedTrainer:
                 # without a re-trace; at the baked base the factor is 1
                 scale = (lr / jnp.float32(base_lr)) if base_lr else 1.0
                 lr = sched.jax_lr(t - 1) * scale
-            loss, gnorm, grads, effects = loss_grads(
+            loss, gnorm, grads, effects, taps = loss_grads(
                 param_vals, key, t, *batch_vals)
+            stats = None
+            if num_on:
+                # per-site tensor stats, computed IN-GRAPH (a handful of
+                # fused reductions) and returned as extra pinned
+                # replicated outputs of this same executable — never a
+                # host callback (the MX603/MX701 anti-pattern)
+                from ..telemetry import numerics as _numerics
+                stats = {}
+                for name, w, g in zip(param_names, param_vals, grads):
+                    s = f"param:{name}"
+                    if num_cfg.wants(s):
+                        stats[s] = _numerics.graph_stats(w, num_cfg)
+                    s = f"grad:{name}"
+                    if num_cfg.wants(s):
+                        stats[s] = _numerics.graph_stats(g, num_cfg)
+                for site, val in zip(step_info.get("tap_sites", ()),
+                                     taps):
+                    stats[site] = val
             constrain = jax.lax.with_sharding_constraint
             new_vals, new_states = [], []
             for i, (w, g, s) in enumerate(zip(param_vals, grads, opt_states)):
@@ -423,10 +489,14 @@ class ShardedTrainer:
                 # rollback DECISION stays on host (_apply_guard)
                 ok = jnp.logical_and(jnp.isfinite(loss).all(),
                                      jnp.isfinite(gnorm))
-                return (loss, gnorm, tuple(new_vals), tuple(new_states),
-                        effects, t + 1, ok)
-            return (loss, gnorm, tuple(new_vals), tuple(new_states),
-                    effects, t + 1)
+                out = (loss, gnorm, tuple(new_vals), tuple(new_states),
+                       effects, t + 1, ok)
+            else:
+                out = (loss, gnorm, tuple(new_vals), tuple(new_states),
+                       effects, t + 1)
+            if num_on:
+                out = out + (stats,)
+            return out
 
         # The explicit pjit contract: named in/out resources + donation.
         # With out_shardings pinned, XLA's SPMD partitioner OWNS the
@@ -471,9 +541,13 @@ class ShardedTrainer:
         untouched: a ``dist_async`` store keeps its reconnect, bounded
         retry and versioned exactly-once resend behavior per key."""
         if self._grad_fn is None:
+            self._resolve_numerics()
             self._grad_fn = jax.jit(self._make_loss_grads(n_data))
         kv = self._resolve_kvstore()
-        loss, gnorm, grads, effects = self._grad_fn(
+        # taps are discarded on this path: numerics decimation/recording
+        # belongs to the compiled pjit step (the fallback is the legacy
+        # per-parameter host loop — it was never capture-clean)
+        loss, gnorm, grads, effects, _taps = self._grad_fn(
             self._param_vals, self._base_key, self._t_dev, *vals)
         lr_mults, wds, mp = self._per_param_hparams()
         opt = self._optimizer
@@ -693,30 +767,55 @@ class ShardedTrainer:
                     # bound during (first-call) tracing so mesh-aware ops
                     # lower to mesh collectives — e.g. attention → ring
                     # over sp
+                    stats_dev = None
+                    num_cfg = self._numerics_cfg
+                    num_on = (not fallback and num_cfg is not None
+                              and num_cfg.enabled)
                     if fallback:
                         loss, gnorm, effects = self._kv_step(vals, n_data)
-                    elif self._fused:
-                        (loss, gnorm, self._param_vals, self._opt_states,
-                         effects, self._t_dev, ok) = \
-                            self._step_fn(self._param_vals, self._opt_states,
-                                          self._base_key, self._lr_dev,
-                                          self._t_dev, *vals)
                     else:
-                        (loss, gnorm, self._param_vals, self._opt_states,
-                         effects, self._t_dev) = \
-                            self._step_fn(self._param_vals, self._opt_states,
-                                          self._base_key, self._lr_dev,
-                                          self._t_dev, *vals)
+                        out = self._step_fn(self._param_vals,
+                                            self._opt_states,
+                                            self._base_key, self._lr_dev,
+                                            self._t_dev, *vals)
+                        if num_on:
+                            stats_dev = out[-1]
+                            out = out[:-1]
+                        if self._fused:
+                            (loss, gnorm, self._param_vals,
+                             self._opt_states, effects, self._t_dev,
+                             ok) = out
+                        else:
+                            (loss, gnorm, self._param_vals,
+                             self._opt_states, effects,
+                             self._t_dev) = out
                 self.last_path = "kvstore_fallback" if fallback else "pjit"
                 dispatch_ms = (time.perf_counter() - t_disp0) * 1e3
                 if new_sig:
                     self._step_sigs.add(sig)
                     _clog.note("trainer.step", sig, wall_ms=dispatch_ms,
                                warmup=first_sig)
+                # numerics decimation: the host SYNCS the stat outputs
+                # only every cfg.every steps (first step included), and
+                # the read rides the guard's existing single device
+                # sync — stats never add a host round trip of their own
+                read_stats = (num_on and stats_dev is not None
+                              and (attempted - 1) % num_cfg.every == 0)
                 t_sync0 = time.perf_counter()
                 with _memory.oom_guard("trainer.step", step=attempted):
                     rolled_back = (self._guard is not None
-                                   and self._apply_guard(loss, gnorm, ok))
+                                   and self._apply_guard(
+                                       loss, gnorm, ok,
+                                       stats_dev=(stats_dev if read_stats
+                                                  else None),
+                                       step=attempted))
+                    if read_stats and self._guard is None:
+                        # unguarded loop: the decimated read is the only
+                        # sync this step performs
+                        from ..telemetry import numerics as _numerics
+                        _numerics.record("trainer.step", attempted,
+                                         jax.device_get(stats_dev),
+                                         num_cfg)
                 sync_ms = (time.perf_counter() - t_sync0) * 1e3
             wall_ms = (time.perf_counter() - t_step0) * 1e3
             fields = {"wall_ms": round(wall_ms, 3),
@@ -771,17 +870,32 @@ class ShardedTrainer:
         """Chaos hook: when the active monkey draws ``nan_batch``, the first
         float data argument is replaced with NaNs — the realistic NaN-step
         signature (propagates to loss and every grad through the unmodified
-        compiled graph)."""
+        compiled graph). The ``grad_blowup`` / ``activation_drift`` knobs
+        apply the monkey's seeded per-site scale ramp to the float data
+        arguments instead: activations and gradients grow monotonically
+        step over step — the slow divergence trajectory the numerics
+        drift watchdog must flag BEFORE anything goes non-finite (the
+        ramp eventually overflows f32 and the classic guard trips, so
+        one chaos run exercises the whole warn → drift → non-finite
+        escalation ladder)."""
         from ..fault import inject as _inject
-        if not _inject.should("nan_batch"):
+        scale = (_inject.scale_ramp("grad_blowup")
+                 * _inject.scale_ramp("activation_drift"))
+        nan = _inject.should("nan_batch")
+        if not nan and scale == 1.0:
             return batch
         out = list(batch)
+        poisoned = False
         for i in range(n_data):
             a = out[i]
             v = a.asnumpy() if isinstance(a, NDArray) else onp.asarray(a)
-            if v.dtype.kind == "f":
+            if v.dtype.kind != "f":
+                continue
+            if nan and not poisoned:
                 out[i] = _inject.poison(v)
-                break
+                poisoned = True
+            elif scale != 1.0:
+                out[i] = (v * scale).astype(v.dtype, copy=False)
         return tuple(out)
 
     def _maybe_snapshot(self) -> None:
@@ -798,25 +912,56 @@ class ShardedTrainer:
     def _copy_state(tree):
         return jax.tree.map(lambda a: a.copy(), tree)
 
-    def _apply_guard(self, loss, gnorm, ok=None) -> bool:
+    def _apply_guard(self, loss, gnorm, ok=None, stats_dev=None,
+                     step=None) -> bool:
         """Returns True when the step was rolled back. ``ok`` is the
         fused step's in-graph finite verdict — everything comes back in
-        ONE host sync. Without it (unfused/fallback path) the finite
-        check is the PR-2-era SEPARATE jitted reduction, one more graph
-        on this step's dispatch count."""
+        ONE host sync (``stats_dev``, the decimated numerics outputs,
+        joins that same sync when due). Without ``ok`` (unfused/fallback
+        path) the finite check is the PR-2-era SEPARATE jitted
+        reduction, one more graph on this step's dispatch count.
+
+        Escalation ordering: a real non-finite/limit verdict always
+        wins; otherwise a sustained ``numerics.drift`` verdict (under
+        ``MXTPU_NUMERICS_DRIFT=rollback``) feeds the SAME guard policy
+        — so the drift watchdog can skip-and-rollback a diverging run
+        steps before it ever goes non-finite, and ``halt``/
+        ``max_consecutive`` precedence is unchanged."""
         g = self._guard
+        stats_host = None
         if ok is None:
             from ..fault.guards import all_finite
             self.last_step_graphs += 1
             finite = all_finite(loss, gnorm)
-            lf = float(jax.device_get(loss))
-            gn = float(jax.device_get(gnorm))
+            if stats_dev is not None:
+                lf, gn, stats_host = jax.device_get((loss, gnorm,
+                                                     stats_dev))
+                lf, gn = float(lf), float(gn)
+            else:
+                lf = float(jax.device_get(loss))
+                gn = float(jax.device_get(gnorm))
+        elif stats_dev is not None:
+            lf, gn, okv, stats_host = jax.device_get(
+                (loss, gnorm, ok, stats_dev))
+            lf, gn, finite = float(lf), float(gn), bool(okv)
         else:
             lf, gn, okv = jax.device_get((loss, gnorm, ok))
             lf, gn, finite = float(lf), float(gn), bool(okv)
         self.last_grad_norm = gn
         self.last_loss = lf
+        drift = []
+        if stats_host is not None:
+            from ..telemetry import numerics as _numerics
+            drift = _numerics.record("trainer.step", step, stats_host,
+                                     self._numerics_cfg)
         reason = g.is_bad(finite, gn)
+        if reason is None and drift \
+                and self._numerics_cfg.drift_action == "rollback":
+            # the drift watchdog armed the guard: escalate BEFORE any
+            # non-finite exists, through the guard's own policy ladder
+            v = drift[0]
+            reason = (f"numerics drift at {v['site']} "
+                      f"({v['reason']})")
         if reason is None:
             g.good_step()
             return False

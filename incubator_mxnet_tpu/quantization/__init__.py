@@ -35,7 +35,7 @@ from ..ndarray import NDArray
 from .. import ndarray as nd
 
 __all__ = ["quantize_net", "QuantizedDense", "QuantizedConv2D",
-           "LayerRangeCollector", "optimal_threshold"]
+           "LayerRangeCollector", "Observer", "optimal_threshold"]
 
 
 # ---------------------------------------------------------------------------
@@ -158,6 +158,108 @@ class LayerRangeCollector:
             th = optimal_threshold(hist, edges)
             out[name] = (-th, th)
         return out
+
+
+class Observer:
+    """Calibration observer over ``telemetry.numerics`` hist-mode tables —
+    the bridge from live-traffic numerics telemetry to the int8
+    calibrate→quantize pipeline (ROADMAP item 4).
+
+    A ``MXTPU_NUMERICS=hist`` run accumulates one log2-magnitude
+    histogram per tagged site *inside* the compiled graphs (bucket ``i``
+    counts ``|x|`` in ``[2^(lo_exp+i), 2^(lo_exp+i+1))``);
+    ``numerics.calibration_table()`` exports them, and this class turns
+    that table into per-site symmetric quantization ranges by
+    percentile-clipping the magnitude distribution (the TensorRT-style
+    outlier cut on a coarser, merge-friendly support than
+    :class:`LayerRangeCollector`'s linear histogram — magnitude buckets
+    add across steps, models, and processes).
+
+    Round-trip contract (tested): ``Observer(table).to_table() ==
+    table`` — the observer is a faithful container, so calibration data
+    survives export → file → import unchanged. ::
+
+        obs = quantization.Observer(numerics.calibration_table())
+        obs.ranges()                # {"act:encoder_out": (-2.9, 2.9)}
+        obs.to_table()              # strict-JSON, banked beside ckpts
+    """
+
+    def __init__(self, table: Optional[Dict[str, dict]] = None):
+        self._sites: Dict[str, dict] = {}
+        for site, rec in (table or {}).items():
+            self.update(site, rec["counts"], lo_exp=rec["lo_exp"],
+                        amin=rec.get("min", 0.0), amax=rec.get("max", 0.0),
+                        samples=rec.get("samples", 1))
+
+    def update(self, site: str, counts, lo_exp: int,
+               amin: float = 0.0, amax: float = 0.0,
+               samples: int = 1) -> None:
+        """Merge one magnitude histogram into ``site`` (fixed edges:
+        histograms from different steps/processes add per-bucket)."""
+        counts = [float(c) for c in counts]
+        c = self._sites.get(site)
+        if c is None:
+            self._sites[site] = {"counts": counts, "lo_exp": int(lo_exp),
+                                 "min": float(amin), "max": float(amax),
+                                 "samples": int(samples)}
+            return
+        if int(lo_exp) != c["lo_exp"] or len(counts) != len(c["counts"]):
+            raise MXNetError(
+                f"observer site {site!r}: incompatible histogram support "
+                f"(lo_exp {lo_exp} vs {c['lo_exp']}, bins {len(counts)} "
+                f"vs {len(c['counts'])})")
+        c["counts"] = [a + b for a, b in zip(c["counts"], counts)]
+        c["min"] = min(c["min"], float(amin))
+        c["max"] = max(c["max"], float(amax))
+        c["samples"] += int(samples)
+
+    def sites(self) -> List[str]:
+        return sorted(self._sites)
+
+    def threshold(self, site: str, percentile: float = 99.99) -> float:
+        """The |x| clipping threshold covering ``percentile`` % of the
+        observed magnitude mass: walk the histogram from the top until
+        the excluded tail would exceed the allowance, return that
+        bucket's upper edge (clamped into the observed [~, max|x|])."""
+        c = self._sites[site]
+        counts, lo = c["counts"], c["lo_exp"]
+        total = sum(counts)
+        absmax = max(abs(c["min"]), abs(c["max"]))
+        if total <= 0:
+            return absmax or 1.0
+        # (100 - p)/100, NOT 1 - p/100: the subtraction in percent
+        # space is exact for the round percentiles callers pass, so a
+        # bucket holding exactly the tail allowance is dropped
+        allow = total * (100.0 - percentile) / 100.0
+        dropped = 0.0
+        cut = len(counts)                 # index of first EXCLUDED bucket
+        for i in range(len(counts) - 1, -1, -1):
+            if dropped + counts[i] > allow:
+                break
+            dropped += counts[i]
+            cut = i
+        th = float(2.0 ** (lo + cut))     # upper edge of the last kept
+        if absmax > 0:
+            th = min(th, absmax)
+        return th
+
+    def ranges(self, percentile: float = 99.99
+               ) -> Dict[str, Tuple[float, float]]:
+        """Symmetric per-site quantization ranges ``(-t, t)`` — the
+        ``in_range`` shape :func:`quantize_net`'s swapped layers take."""
+        return {site: (-self.threshold(site, percentile),
+                       self.threshold(site, percentile))
+                for site in self._sites}
+
+    def to_table(self) -> Dict[str, dict]:
+        """Render back to the ``numerics.calibration_table()`` shape
+        (strict-JSON; byte round-trips a table fed to the ctor)."""
+        return {site: {"counts": list(c["counts"]),
+                       "lo_exp": int(c["lo_exp"]),
+                       "bins": len(c["counts"]),
+                       "min": float(c["min"]), "max": float(c["max"]),
+                       "samples": int(c["samples"])}
+                for site, c in sorted(self._sites.items())}
 
 
 # ---------------------------------------------------------------------------

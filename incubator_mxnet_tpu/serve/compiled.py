@@ -97,6 +97,15 @@ class CompiledModel:
         from .. import autotune as _autotune
         self.autotune_entry = _autotune.consult(
             "serve.compiled", autotune_key or type(block).__name__.lower())
+        # in-graph numerics telemetry (MXTPU_NUMERICS, resolved ONCE at
+        # build like the autotune consult): when enabled every bucket's
+        # executable additionally returns per-site stat vectors —
+        # numerics.tap()-tagged activations plus each output tensor
+        # (serve.out:<i>) — computed in-graph over the padded bucket
+        # tensors; predict() syncs them every cfg.every requests
+        from ..telemetry import numerics as _numerics
+        self._numerics_cfg = _numerics.config()
+        self._num_seen = 0           # predict-call decimation counter
 
         if isinstance(block, SymbolBlock):
             arch = block._arch
@@ -132,6 +141,8 @@ class CompiledModel:
                 jax.random.key(0, impl=self._key_impl)))
             self._pure, self._meta = block._make_pure_infer(
                 skeleton, n_in, self._ctx)
+            if self._numerics_cfg.enabled:
+                self._pure = self._wrap_pure_stats(self._pure)
             if donate == "auto":
                 donate = jax.default_backend() != "cpu"
             self._jit = jax.jit(
@@ -221,6 +232,44 @@ class CompiledModel:
                 sizes[name] = max(sizes.get(name, 0), a.shape[axis])
         return sizes
 
+    # -- numerics -------------------------------------------------------
+    def _wrap_pure_stats(self, base: Callable) -> Callable:
+        """Wrap the pure inference function so the SAME compiled
+        executable also returns the per-site numerics stats —
+        ``numerics.tap()``-tagged activations collected during the
+        trace plus one ``serve.out:<i>`` site per output — as a second
+        (replicated, scalar-sized) result. One executable per bucket
+        still; stats are in-graph reductions, never host callbacks."""
+        cfg = self._numerics_cfg
+
+        def pure_stats(key_data, *vals):
+            from ..telemetry import numerics as _numerics
+            with _numerics.collecting(cfg) as col:
+                outs = tuple(base(key_data, *vals))
+            stats = dict(zip(col.names, col.values))
+            for i, o in enumerate(outs):
+                site = f"serve.out:{i}"
+                if cfg.wants(site):
+                    stats[site] = _numerics.graph_stats(o, cfg)
+            return outs, stats
+
+        return pure_stats
+
+    def _maybe_record_numerics(self, stats_dev) -> None:
+        """Host half of serve numerics: decimated by request count
+        (``cfg.every``), the stat arrays sync and fold into the rings/
+        gauges/events exactly like the trainer's."""
+        cfg = self._numerics_cfg
+        with self._lock:
+            self._num_seen += 1
+            due = (self._num_seen - 1) % cfg.every == 0
+            seen = self._num_seen
+        if not due:
+            return
+        from ..telemetry import numerics as _numerics
+        _numerics.record("serve.compiled", seen,
+                         jax.device_get(stats_dev), cfg)
+
     # -- compilation ----------------------------------------------------
     def _compile(self, key: tuple, sig) -> Callable:
         from .. import autotune as _autotune
@@ -237,7 +286,12 @@ class CompiledModel:
             if self._mode == "artifact":
                 ins = [jax.ShapeDtypeStruct(s, jnp.dtype(d)) for s, d in sig]
                 ent = self._block._sig_for(ins)
-                fn = jax.jit(ent["exported"].call)
+                call = ent["exported"].call
+                if self._numerics_cfg.enabled:
+                    # baked StableHLO has no taps left; output-site
+                    # stats still compute in-graph around the call
+                    call = self._wrap_pure_stats(call)
+                fn = jax.jit(call)
                 exe = fn.lower(*avals).compile()
                 info = {"out_fmt": ent["out_fmt"], "multi": ent["multi"]}
             else:
@@ -349,6 +403,9 @@ class CompiledModel:
             with profiler.Scope("serve.compute"), \
                     _memory.oom_guard("serve.compiled"):
                 outs = exe(self._key_data, *padded, *pvals)
+            if self._numerics_cfg.enabled:
+                outs, stats_dev = outs
+                self._maybe_record_numerics(stats_dev)
             with profiler.Scope("serve.unpad"):
                 result = self._unpad(list(outs), info, sizes)
             return result

@@ -51,6 +51,7 @@ from . import export  # noqa: F401
 from . import flight  # noqa: F401
 from . import memory  # noqa: F401
 from . import metrics  # noqa: F401
+from . import numerics  # noqa: F401
 from . import slo  # noqa: F401
 from . import trace  # noqa: F401
 from .events import (  # noqa: F401
@@ -74,7 +75,7 @@ __all__ = ["emit", "events", "get_events", "counts", "clear",
            "Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
            "counter", "gauge", "histogram",
            "compile_log", "metrics", "export", "trace", "flight", "slo",
-           "memory",
+           "memory", "numerics",
            "SLO", "SLOMonitor",
            "prometheus_text", "chrome_trace", "otel_spans",
            "install_jsonl",
@@ -109,6 +110,9 @@ def snapshot(recent: int = 5) -> Dict:
         # the device-memory ledger: residency, per-site attribution,
         # leak-watchdog state, noted static peaks
         "memory": memory.snapshot(),
+        # in-graph tensor-stats telemetry: per-site rings, drift
+        # watchdog state, calibration rollup
+        "numerics": numerics.snapshot(),
     }
     return sanitize(doc)
 
@@ -122,3 +126,4 @@ def reset() -> None:
     export.uninstall_all()
     trace.clear()
     flight.reset()
+    numerics.reset()

@@ -40,6 +40,8 @@ Bundle format (``format: 1``, strict JSON, one file per trigger)::
      "lockcheck": {"edges": [...], "inversions": [...], "held_now": [...]},
      "memory":  {...device-memory ledger: live/site bytes, history,
                  static peaks, leak-watchdog state...},
+     "numerics": {...per-site tensor-stats rings (the drift trajectory),
+                 drift-watchdog state, calibration rollup...},
      "step_report": {...host-gap attribution...},
      "metrics": {...registry table...},
      "env": {...MXTPU_/MXNET_/DMLC_/JAX_/XLA_ vars...},
@@ -116,7 +118,7 @@ def bundle(reason: str, /, site: Optional[str] = None, **context) -> Dict:
     costing the whole bundle."""
     from .. import profiler
     from ..lockcheck import edges, held_now, inversions
-    from . import compile_log, events, memory, metrics, trace
+    from . import compile_log, events, memory, metrics, numerics, trace
     from .export import sanitize
 
     doc: Dict = {"format": 1, "reason": reason, "site": site,
@@ -149,6 +151,10 @@ def bundle(reason: str, /, site: Optional[str] = None, **context) -> Dict:
     # OOM bundle (reason "resource_exhausted") reads prediction vs
     # measurement on one page
     section("memory", memory.snapshot)
+    # numerics rings: a guard-halt bundle carries the per-site drift
+    # trajectory — the hundreds of steps of rms growth BEFORE the
+    # non-finite verdict, not just the corpse
+    section("numerics", numerics.snapshot)
     section("env", lambda: {k: v for k, v in sorted(os.environ.items())
                             if k.startswith(_ENV_PREFIXES)})
     section("config", lambda: _config())

@@ -199,6 +199,46 @@ ENV_VARS: Dict[str, tuple] = {
                               "emits a memory.leak warning event). "
                               "0 = sampler off (manual sample() calls "
                               "still work)."),
+    "MXTPU_NUMERICS": ("", "In-graph numerics telemetry "
+                       "(telemetry.numerics): 'summary' makes the "
+                       "trainer's pjit step and serve.CompiledModel "
+                       "return per-site min/max/mean/rms/zero-fraction/"
+                       "finite-fraction vectors (param:/grad:/act:/"
+                       "serve.out: sites) as extra pinned outputs of "
+                       "the SAME jitted graph; 'hist' additionally "
+                       "accumulates log2-magnitude histograms per site "
+                       "(quantization.Observer calibration tables). "
+                       "Unset/other = off: the traced graphs are "
+                       "byte-identical to an uninstrumented build "
+                       "(the perf-proxy gate proves it). Resolved at "
+                       "build time like the autotune consult."),
+    "MXTPU_NUMERICS_EVERY": ("16", "Host-side decimation of numerics "
+                             "stats: the stat outputs are synced (and "
+                             "folded into numerics.step events, "
+                             "mxtpu_numerics_* gauges, the per-site "
+                             "ring) every N steps/requests, riding the "
+                             "guard's existing device read — never an "
+                             "extra per-step round trip."),
+    "MXTPU_NUMERICS_SITES": ("", "Comma-separated fnmatch allowlist "
+                             "over numerics site names (e.g. "
+                             "'grad:*,act:*attn*'); empty = every "
+                             "site. Filtering happens at trace time, "
+                             "so excluded sites cost zero graph ops."),
+    "MXTPU_NUMERICS_BINS": ("40", "Log2-magnitude histogram buckets "
+                            "per site in hist mode (bucket i counts "
+                            "|x| in [2^(-24+i), 2^(-24+i+1)))."),
+    "MXTPU_NUMERICS_RING": ("128", "Per-site numerics history-ring "
+                            "capacity (the drift watchdog's window and "
+                            "the postmortem's trajectory live here)."),
+    "MXTPU_NUMERICS_DRIFT": ("warn", "Drift-watchdog action: 'warn' "
+                             "emits damped numerics.drift warning "
+                             "events only; 'rollback' additionally "
+                             "escalates a sustained drift (monotonic "
+                             "rms growth / finite-fraction decay over "
+                             "the recorded window) to the trainer's "
+                             "StepGuard — its policy then decides "
+                             "warn/skip_and_rollback/halt BEFORE the "
+                             "run ever goes non-finite."),
     "MXTPU_TELEMETRY": ("1", "Master switch for the mx.telemetry event "
                         "bus; 0 turns every emit() into a no-op."),
     "MXTPU_TELEMETRY_RING": ("1024", "Per-kind event ring-buffer capacity; "

@@ -1,0 +1,44 @@
+"""Seeded MX603 fixture: tensor statistics smuggled out of a jitted
+function through host callbacks — the anti-pattern the in-graph
+numerics design forbids (stats must ride out as pinned outputs,
+decimated host-side; see telemetry/numerics.py).
+
+Expected findings: MX603 x3 (debug.callback in `step`, debug.print in
+`step`, pure_callback in `fwd`); the plain-tensor pure_callback in
+`custom_op` must NOT fire (raw custom-op round-trips are MX701's
+HLO-level business, not a stats smell).
+"""
+import jax
+import jax.numpy as jnp
+
+
+def _log_stats(mn, mx, mean):
+    print("stats", mn, mx, mean)
+
+
+@jax.jit
+def step(params, grads):
+    # VIOLATION: per-step host callback carrying in-graph reductions
+    jax.debug.callback(_log_stats, jnp.min(grads), jnp.max(grads),
+                       grads.mean())
+    # VIOLATION: debug.print IS a host callback too
+    jax.debug.print("gnorm={g}", g=jnp.linalg.norm(grads))
+    return params - 0.1 * grads
+
+
+def fwd(x):
+    # VIOLATION: pure_callback whose payload is a reduction
+    jax.pure_callback(_log_stats, jax.ShapeDtypeStruct((), jnp.float32),
+                      x.mean(), x.sum(), jnp.std(x))
+    return x * 2
+
+
+fwd_jit = jax.jit(fwd)
+
+
+@jax.jit
+def custom_op(x):
+    # clean: a raw-tensor callback (custom-op style) carries no
+    # reduction — not this rule's subject
+    return jax.pure_callback(
+        lambda v: v, jax.ShapeDtypeStruct(x.shape, x.dtype), x)

@@ -138,7 +138,53 @@ def render(doc: Dict, events_n: int = 40) -> str:
                        + " -> ".join(mib(h.get("live_bytes", 0))
                                      for h in hist))
 
-    # -- compile ledger ----------------------------------------------------
+    # -- numerics drift timeline -------------------------------------------
+    num = doc.get("numerics") or {}
+    sites = num.get("sites") or {}
+    if isinstance(num, dict) and sites:
+        cfg = num.get("config") or {}
+        out += _section(f"numerics (mode={cfg.get('mode')}, "
+                        f"every={cfg.get('every')})")
+        drift = num.get("drift") or {}
+
+        def g(v):
+            if v is None:
+                return "?"
+            try:
+                return f"{float(v):.3g}"
+            except (TypeError, ValueError):
+                return str(v)
+
+        # rank sites by how far their rms moved across the recorded ring
+        # — the diverging tensors float to the top of the page
+        def growth(recs):
+            rms = [r.get("rms") for r in recs
+                   if isinstance(r, dict) and r.get("rms") is not None]
+            if len(rms) < 2 or not rms[0]:
+                return 0.0
+            try:
+                return abs(rms[-1]) / max(abs(rms[0]), 1e-30)
+            except (TypeError, ZeroDivisionError):
+                return 0.0
+
+        ranked = sorted(sites.items(), key=lambda kv: -growth(kv[1]))
+        shown = ranked[:12]
+        if len(ranked) > len(shown):
+            out.append(f"  ({len(ranked) - len(shown)} quieter site(s) "
+                       "omitted)")
+        for site, recs in shown:
+            recs = [r for r in recs if isinstance(r, dict)]
+            if not recs:
+                continue
+            last = recs[-1]
+            flag = drift.get(site) or {}
+            flagged = flag.get("rms_level") is not None \
+                or flag.get("ff_level") is not None
+            trail = " -> ".join(g(r.get("rms")) for r in recs[-6:])
+            line = (f"  {'!!' if flagged else '  '} {site:<28} rms {trail}"
+                    f"  (finite {g(last.get('finite_fraction'))}, "
+                    f"step {last.get('step')})")
+            out.append(line)
     comp = doc.get("compiles") or {}
     out += _section("compile ledger")
     out.append(f"  total={comp.get('total')} "
@@ -163,7 +209,7 @@ def render(doc: Dict, events_n: int = 40) -> str:
         if name.startswith(("mxtpu_slo_", "mxtpu_flight_",
                             "mxtpu_guard_", "mxtpu_watchdog_",
                             "mxtpu_chaos_", "mxtpu_lockcheck_",
-                            "mxtpu_memory_",
+                            "mxtpu_memory_", "mxtpu_numerics_drift",
                             "mxtpu_router_", "mxtpu_serve_replica")):
             for labels, val in sorted(mets[name].items()):
                 v = (val.get("count") if isinstance(val, dict) else val)
