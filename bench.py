@@ -43,20 +43,12 @@ import numpy as onp
 
 
 def _peak_tflops() -> float:
-    """Per-chip bf16 peak for MFU accounting, by device kind (public specs);
-    override with MXTPU_PEAK_TFLOPS."""
-    env = os.environ.get("MXTPU_PEAK_TFLOPS")
-    if env:
-        return float(env)
-    import jax
-    kind = jax.devices()[0].device_kind.lower()
-    table = {"v5 lite": 197.0, "v5e": 197.0, "v5p": 459.0, "v5": 459.0,
-             "v4": 275.0, "v3": 123.0, "v6e": 918.0, "v6 lite": 918.0,
-             "trillium": 918.0}
-    for key, val in table.items():
-        if key in kind:
-            return val
-    return 459.0
+    """Per-chip bf16 peak for MFU accounting — the shared
+    ``util.peak_tflops`` table (by device kind, public specs;
+    MXTPU_PEAK_TFLOPS overrides), the same source the autotuner's
+    roofline score and the goodput ledger's MFU headline read."""
+    from incubator_mxnet_tpu.util import peak_tflops
+    return peak_tflops()
 
 
 _DEFAULT_MODEL = {"resnet": "resnet50_v1", "bert": "bert_12_768_12"}
@@ -82,11 +74,17 @@ def _watchdog_record(budget: int) -> dict:
     line: harnesses that parse one-JSON-line-per-run see a machine-readable
     ``{"error": "device_init_timeout"}`` instead of ``parsed: null``, so a
     wedged TPU tunnel (rc=75, see BENCH_r05.json) is distinguishable from
-    "produced no data"."""
+    "produced no data". ``goodput: null`` rides along so the record is
+    self-describing (no goodput data was measured this round);
+    ``tools/perf_history.py`` classifies the round BLIND off the null
+    ``value`` and renders the ``error`` as its reason instead of
+    silently skipping it — a run of rc=75 wedges reads as "no device
+    data since rN", never as "no regressions"."""
     workload = _bench_workload()
     model = _bench_model(workload)
     return {
         "error": "device_init_timeout",
+        "goodput": None,
         "metric": None,
         "value": None,
         "unit": None,
@@ -727,6 +725,19 @@ def run_proxy(argv) -> int:
         warns += t_warn
         gate = {"baseline": args.check, "tolerance": args.tolerance,
                 "failures": failures, "warnings": warns}
+        # the whole-trajectory view rides along with the per-graph gate:
+        # best banked config, blind-round count, and any measured-round
+        # regression flag from the merged BENCH/BASELINE/PERF_PROXY
+        # artifacts (tools/perf_history.py — flags surface as warnings
+        # here; the goodput-smoke CI job gates on them via --check)
+        try:
+            from tools import perf_history as _ph
+            hist_root = os.path.dirname(os.path.abspath(args.check)) or "."
+            gate["perf_history"] = _ph.summary(hist_root, args.tolerance)
+            for flag in gate["perf_history"]["regressions"]:
+                warns.append(f"perf_history: {flag}")
+        except Exception as e:  # noqa: BLE001 — the trajectory is
+            gate["perf_history"] = {"error": str(e)}  # context, not a gate
         for w in warns:
             print(f"bench.py --proxy: WARN {w}", file=sys.stderr)
         for fl in failures:

@@ -157,31 +157,17 @@ def candidates(family: str,
 # scoring — deterministic roofline proxy over the cost table
 # ---------------------------------------------------------------------------
 
-#: nominal per-chip bf16 peak TFLOPs (bench.py's table); the unknown/CPU
-#: default keeps the proxy deterministic — rankings, not absolute MFU
-_PEAK_TFLOPS_BY_KIND = {"v5 lite": 197.0, "v5e": 197.0, "v5p": 459.0,
-                        "v5": 459.0, "v4": 275.0, "v3": 123.0,
-                        "v6e": 918.0, "v6 lite": 918.0, "trillium": 918.0}
-_DEFAULT_PEAK_TFLOPS = 459.0
-_DEFAULT_PEAK_GBPS = 1200.0      # nominal HBM bandwidth
-_DEFAULT_ICI_GBPS = 90.0         # nominal inter-chip bandwidth
 _LAUNCH_S = 2e-6                 # per fused-kernel dispatch overhead proxy
 _COMPILE_S = 30.0                # per-graph warmup compile proxy (ledger)
 _AMORTIZE_STEPS = 10000.0        # steps a banked config is expected to run
 
 
 def _peaks() -> Tuple[float, float, float]:
-    import jax
-    env = os.environ.get("MXTPU_PEAK_TFLOPS")
-    if env:
-        tf = float(env)
-    else:
-        kind = jax.devices()[0].device_kind.lower()
-        tf = next((v for k, v in _PEAK_TFLOPS_BY_KIND.items() if k in kind),
-                  _DEFAULT_PEAK_TFLOPS)
-    bw = float(os.environ.get("MXTPU_PEAK_GBPS", _DEFAULT_PEAK_GBPS))
-    ici = float(os.environ.get("MXTPU_ICI_GBPS", _DEFAULT_ICI_GBPS))
-    return tf * 1e12, bw * 1e9, ici * 1e9
+    # THE shared peak table (util.roofline_peaks): bench.py's MFU
+    # accounting, this score, and telemetry.goodput's predicted_mfu all
+    # read one source, so a chip-kind correction can never diverge them
+    from incubator_mxnet_tpu.util import roofline_peaks
+    return roofline_peaks()
 
 
 def score(metrics: Dict[str, Any]) -> float:

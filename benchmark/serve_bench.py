@@ -469,6 +469,7 @@ def main(argv=None) -> int:
         args.replicas = 3
 
     from incubator_mxnet_tpu import profiler, serve
+    from incubator_mxnet_tpu.telemetry import goodput as _goodput
     from incubator_mxnet_tpu.telemetry import memory as _memory
 
     # device-memory ledger: MXTPU_MEMORY_SAMPLE_S > 0 runs the
@@ -476,6 +477,10 @@ def main(argv=None) -> int:
     # config — a steady-state growth trips memory.leak, which
     # telemetry_check --forbid memory.leak turns into a failed job)
     _memory.start_from_env()
+    # goodput ledger: MXTPU_GOODPUT=1 anchors the run clock here, so
+    # the bench's checkpoint/input notes (weight-sync saves, prefetch
+    # waits) attribute against the whole bench wall
+    _goodput.begin_from_env()
     if args.smoke:
         args.iters = min(args.iters, 5)
     deadline = args.deadline_ms if args.deadline_ms is not None else \
@@ -590,6 +595,9 @@ def main(argv=None) -> int:
             # the device-memory ledger's closing view: residency, site
             # attribution, leak-watchdog state over the run
             "memory": _memory.snapshot(),
+            # the goodput ledger's closing view (enabled-off shape when
+            # MXTPU_GOODPUT is unset — one env read)
+            "goodput": _goodput.snapshot(),
             "wall_total_s": round(time.perf_counter() - t0, 1),
         },
     }

@@ -79,6 +79,12 @@ CODES = {
              "io_callback over a reduction) — breaks whole-step capture; "
              "return the stats as extra pinned outputs instead "
              "(telemetry.numerics)",
+    "MX604": "stray device sync inside a step loop "
+             "(block_until_ready()/.item()/float() on a step result "
+             "every iteration) — a second host round trip per step "
+             "outside the guard's single-sync cadence; read "
+             "trainer.last_loss/last_grad_norm (synced once by the "
+             "guard) or decimate the read (if step % N)",
     "MX701": "host<->device transfer inside a jitted region (callback / "
              "device_put round-trip per executed step)",
     "MX702": "unintended f64/widening float promotion in the compiled "
@@ -137,6 +143,7 @@ DEFAULT_SEVERITY: Dict[str, str] = {
     "MX401": "warning",
     "MX501": "warning", "MX502": "warning",
     "MX601": "warning", "MX602": "warning", "MX603": "warning",
+    "MX604": "warning",
     "MX701": "error", "MX702": "warning", "MX703": "warning",
     "MX704": "warning", "MX705": "error", "MX706": "warning",
     "MX707": "info", "MX708": "error", "MX709": "error",

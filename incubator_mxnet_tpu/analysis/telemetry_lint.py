@@ -26,6 +26,16 @@ severity (hygiene, not correctness; ``mxlint --strict`` gates):
   a free-floating fact that can never be stitched into any request or
   step story — the uncorrelated telemetry this PR's tracing layer
   exists to eliminate.
+- **MX604** — a **stray device sync inside a step loop**: a
+  ``.block_until_ready()`` / ``.item()`` call or ``float(...)``
+  coercion on a name bound to a ``.step(...)`` result, executed every
+  iteration. The guarded trainer already syncs loss/grad-norm in ONE
+  device read per step (the fused step's single-sync cadence); a
+  per-iteration extra sync re-serializes the host with the device —
+  over a tunneled chip each costs ~1-2 ms of pure dispatch latency
+  (BASELINE.md). Reads decimated behind an ``if step % N`` cadence (or
+  performed once after the loop) pass; ``.asnumpy()`` is exempt as the
+  documented honest sync.
 - **MX603** — tensor statistics routed through a **host callback inside
   a jitted function**: a ``jax.debug.callback`` / ``jax.debug.print`` /
   ``jax.pure_callback`` / ``io_callback`` call whose arguments carry a
@@ -220,6 +230,88 @@ def _lint_uncorrelated(tree: ast.Module, filename: str,
                 severity="warning"))
 
 
+# -- MX604: stray device syncs inside step loops -----------------------------
+
+#: method leaves that force a host<->device sync when called on a device
+#: array. ``.asnumpy()`` is deliberately NOT here: it is the documented
+#: honest sync (BASELINE.md: over a tunneled backend block_until_ready
+#: does not even wait for execution), and the sanctioned loop shape
+#: syncs it once after the loop or on a decimated cadence.
+_SYNC_METHOD_LEAVES = {"block_until_ready", "item"}
+
+
+def _step_result_names(loop: ast.AST) -> Set[str]:
+    """Names bound (anywhere in the loop body) to a ``.step(...)`` call
+    result — the device arrays whose every-iteration sync is the smell."""
+    out: Set[str] = set()
+    for node in ast.walk(loop):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call) \
+                and isinstance(node.value.func, ast.Attribute) \
+                and node.value.func.attr == "step":
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out.add(tgt.id)
+    return out
+
+
+def _decimated_ifs(loop: ast.AST) -> List[ast.AST]:
+    """``if``-blocks whose test contains a modulo — the decimated-cadence
+    idiom (``if step % N == 0:``) that keeps a sync OFF the every-step
+    path; syncs inside one respect the single-sync cadence and pass."""
+    out: List[ast.AST] = []
+    for node in ast.walk(loop):
+        if isinstance(node, ast.If):
+            for t in ast.walk(node.test):
+                if isinstance(t, ast.BinOp) and isinstance(t.op, ast.Mod):
+                    out.append(node)
+                    break
+    return out
+
+
+def _lint_stray_syncs(tree: ast.Module, filename: str,
+                      report: Report) -> None:
+    """MX604 over every step loop: a ``.block_until_ready()``/``.item()``
+    call — or a ``float(...)`` coercion — on a name bound to a
+    ``.step(...)`` result, executed every iteration, is a second device
+    round trip per step outside the guard's single-sync cadence."""
+    seen: Set[int] = set()
+    for loop in _step_loops(tree):
+        names = _step_result_names(loop)
+        if not names:
+            continue
+        decimated = _decimated_ifs(loop)
+        for node in ast.walk(loop):
+            if not isinstance(node, ast.Call) or id(node) in seen:
+                continue
+            f = node.func
+            hit = None
+            if isinstance(f, ast.Attribute) \
+                    and f.attr in _SYNC_METHOD_LEAVES \
+                    and isinstance(f.value, ast.Name) \
+                    and f.value.id in names:
+                hit = f"{f.value.id}.{f.attr}()"
+            elif isinstance(f, ast.Name) and f.id == "float" and node.args \
+                    and isinstance(node.args[0], ast.Name) \
+                    and node.args[0].id in names:
+                hit = f"float({node.args[0].id})"
+            if hit is None:
+                continue
+            if _inside(node, decimated):
+                continue   # decimated (if step % N) — cadence respected
+            seen.add(id(node))
+            report.add(Diagnostic(
+                "MX604",
+                f"stray device sync {hit} inside a step loop — every "
+                "iteration pays a second host round trip on top of the "
+                "guard's single sync (~1-2 ms each over a tunneled "
+                "chip); read trainer.last_loss/last_grad_norm (already "
+                "synced by the guard), sync once after the loop, or "
+                "decimate the read (if step % N == 0)",
+                node=f"{filename}:{getattr(node, 'lineno', 0)}",
+                op=hit, pass_name="telemetry_lint",
+                severity="warning"))
+
+
 # -- MX603: stats through host callbacks in a jitted region ------------------
 
 #: callback entry points that round-trip to host from inside a jit
@@ -334,6 +426,9 @@ def lint_source(src: str, filename: str = "<string>") -> Report:
     # MX603 likewise: a host callback carrying reductions out of a jit
     # is the subject itself, never excused by other telemetry in the file
     _lint_callback_stats(tree, filename, report)
+    # MX604 likewise: the stray sync IS the subject — a file full of
+    # telemetry spine usage can still pay a hidden round trip per step
+    _lint_stray_syncs(tree, filename, report)
     if _has_telemetry_evidence(tree):
         return report
     seen_clocks: Set[int] = set()  # one finding per scope; a clock call

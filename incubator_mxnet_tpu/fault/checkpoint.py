@@ -143,7 +143,15 @@ def save_checkpoint(root: str, arrays: Dict[str, onp.ndarray],
     values first); ``meta`` must be JSON-serializable. ``keep`` prunes to
     the newest K completed checkpoints after a successful save (None keeps
     everything). Re-saving an existing step atomically replaces it.
+
+    Every successful save records one ``checkpoint.save`` profiler span,
+    a ``checkpoint.save`` telemetry event, and (when the goodput ledger
+    is on) a ``checkpoint`` attribution note — checkpointing is wall
+    time the training loop pays, so it must show up in the run's
+    goodput vector, not vanish into ``unattributed``.
     """
+    import time as _time
+    t_save0 = _time.perf_counter()
     meta = dict(meta or {})
     os.makedirs(root, exist_ok=True)
     final = os.path.join(root, _step_dirname(step))
@@ -191,6 +199,15 @@ def save_checkpoint(root: str, arrays: Dict[str, onp.ndarray],
         raise
     if keep is not None:
         _prune(root, keep)
+    save_ms = (_time.perf_counter() - t_save0) * 1e3
+    from .. import profiler as _prof
+    from ..telemetry import events as _tele
+    from ..telemetry import goodput as _goodput
+    _prof.record_span("checkpoint.save", save_ms, t0=t_save0)
+    _tele.emit("checkpoint.save", step=step, wall_ms=round(save_ms, 3),
+               path=final, arrays=len(arrays))
+    if _goodput.enabled():
+        _goodput.note("checkpoint", save_ms)
     return final
 
 

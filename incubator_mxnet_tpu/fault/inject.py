@@ -74,6 +74,9 @@ class ChaosMonkey:
     ``kv_drop``   — ``should('kv_drop')``: drop the PS connection pre-call
     ``slow_prob`` — ``maybe_delay('slow_step')`` sleeps ``delay_s``
     ``kv_delay``  — ``maybe_delay('kv_delay')`` sleeps ``delay_s``
+    ``slow_input`` — ``maybe_delay('slow_input')`` sleeps ``delay_s`` in
+    the ``io.PrefetchIter`` producer — seeded input starvation, so the
+    goodput ledger's ``input_wait`` attribution is testable end to end
     ``replica_kill``     — ``should('replica_kill')``: a serve replica
     dies on its next request (the router's failover path)
     ``slow_replica``     — ``maybe_delay('slow_replica')`` sleeps
@@ -102,6 +105,7 @@ class ChaosMonkey:
     def __init__(self, seed: int = 0, nan_prob: float = 0.0,
                  kv_drop: float = 0.0, slow_prob: float = 0.0,
                  kv_delay: float = 0.0, delay_s: float = 0.0,
+                 slow_input: float = 0.0,
                  replica_kill: float = 0.0, slow_replica: float = 0.0,
                  corrupt_artifact: float = 0.0,
                  leak: float = 0.0, leak_bytes: float = 1 << 20,
@@ -112,6 +116,7 @@ class ChaosMonkey:
         self.probs: Dict[str, float] = {
             "nan_batch": float(nan_prob), "kv_drop": float(kv_drop),
             "slow_step": float(slow_prob), "kv_delay": float(kv_delay),
+            "slow_input": float(slow_input),
             "replica_kill": float(replica_kill),
             "slow_replica": float(slow_replica),
             "corrupt_artifact": float(corrupt_artifact),

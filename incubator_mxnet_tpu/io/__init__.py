@@ -17,6 +17,7 @@ from __future__ import annotations
 import os
 import struct
 import threading
+import time
 import queue as _queue
 from collections import namedtuple
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
@@ -489,6 +490,13 @@ class PrefetchIter(DataIter):
     ``place`` takes the wrapped iterator's :class:`DataBatch` and may
     return anything (default: the batch unchanged — pure async
     prefetch). Batches arrive strictly in the wrapped iterator's order.
+    Every consumer-side queue pop is timed: the blocked portion is
+    recorded as an ``io.wait`` profiler span, the ``mxtpu_io_wait_ms``
+    histogram + ``mxtpu_io_queue_depth`` gauge, and (when the goodput
+    ledger is on) the ``input_wait`` attribution bucket — so "the step
+    is starving on input" is a measured, gated fact, testable end to
+    end via the seeded ``slow_input`` chaos knob (``fault.inject``
+    delays the producer).
     A ``place``/iterator exception is captured on the worker and
     re-raised from :meth:`next` — never swallowed. The worker is one
     named daemon thread (``mx-io-device-prefetch``, lockcheck/MX804
@@ -512,11 +520,26 @@ class PrefetchIter(DataIter):
         self._done = False           # stream ended (worker queues _DONE once)
         self._gen = 0
         self._closed = False
+        # input-wait instrumentation: every consumer-side queue pop is
+        # timed — the blocked portion IS input starvation, the number
+        # the goodput ledger's input_wait bucket and the "is the step
+        # waiting on data" triage question both need. Registry handles
+        # resolve ONCE (the per-call registry lookup takes a lock; this
+        # sits on the per-batch hot path).
+        from ..telemetry import metrics as _tmetrics
+        self._m_wait = _tmetrics.histogram(
+            "mxtpu_io_wait_ms",
+            "Consumer wait on the PrefetchIter queue per batch (ms)")
+        self._m_depth = _tmetrics.gauge(
+            "mxtpu_io_queue_depth",
+            "Prefetched batches ready at the last queue pop")
         self._start()
 
     def _start(self):
         gen = self._gen
         q = self._queue
+
+        from ..fault import inject as _inject
 
         def run():
             # A stale generation (reset()/close() bumped self._gen) stops
@@ -526,6 +549,11 @@ class PrefetchIter(DataIter):
             try:
                 while gen == self._gen:
                     try:
+                        # chaos: the seeded slow_input knob starves the
+                        # consumer HERE, on the producer — the realistic
+                        # slow-storage/slow-decode signature the goodput
+                        # ledger must attribute as input_wait
+                        _inject.maybe_delay("slow_input")
                         b = self._it.next()
                     except StopIteration:
                         tail = PrefetchIter._DONE
@@ -620,7 +648,19 @@ class PrefetchIter(DataIter):
             if self._exc is not None:
                 raise self._exc
             raise StopIteration
+        t0 = time.perf_counter()
         b = self._queue.get()
+        wait_ms = (time.perf_counter() - t0) * 1e3
+        # the blocked pop is the step's input starvation: an io.wait span
+        # on the profiler timeline, the mxtpu_io_* metrics, and the
+        # goodput ledger's input_wait bucket — all from the ONE timing
+        from .. import profiler as _prof
+        _prof.record_span("io.wait", wait_ms)
+        self._m_wait.observe(wait_ms)
+        self._m_depth.set(self._queue.qsize())
+        from ..telemetry import goodput as _goodput
+        if _goodput.enabled():
+            _goodput.note("input_wait", wait_ms)
         if b is PrefetchIter._DONE:
             self._done = True
             if self._exc is not None:

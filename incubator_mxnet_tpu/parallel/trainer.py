@@ -831,6 +831,19 @@ class ShardedTrainer:
                               rolled_back=rolled_back,
                               device_wait_ms=round(sync_ms, 3))
             _tele.emit("train.step", step=attempted, **fields)
+            # the goodput ledger folds the SAME timings into the run's
+            # wall-clock attribution vector (compute/collective via the
+            # guard's sync, one-off compile, host remainder; a rollback
+            # reclassifies the discarded since-snapshot steps as waste)
+            from ..telemetry import goodput as _goodput
+            if _goodput.enabled():
+                _goodput.note_step(
+                    step=attempted, wall_ms=wall_ms,
+                    device_wait_ms=(sync_ms if self._guard is not None
+                                    else 0.0),
+                    compile_ms=(dispatch_ms if new_sig else 0.0),
+                    rolled_back=rolled_back,
+                    rollback_to=(self._t if rolled_back else None))
             # one "step" frame + its segments on the profiler timeline —
             # the raw material of profiler.step_report()'s host-gap
             # attribution (all from the timings measured above, so the

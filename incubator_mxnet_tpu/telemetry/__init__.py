@@ -49,6 +49,7 @@ from . import compile_log  # noqa: F401
 from . import events  # noqa: F401
 from . import export  # noqa: F401
 from . import flight  # noqa: F401
+from . import goodput  # noqa: F401
 from . import memory  # noqa: F401
 from . import metrics  # noqa: F401
 from . import numerics  # noqa: F401
@@ -75,7 +76,7 @@ __all__ = ["emit", "events", "get_events", "counts", "clear",
            "Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
            "counter", "gauge", "histogram",
            "compile_log", "metrics", "export", "trace", "flight", "slo",
-           "memory", "numerics",
+           "memory", "numerics", "goodput",
            "SLO", "SLOMonitor",
            "prometheus_text", "chrome_trace", "otel_spans",
            "install_jsonl",
@@ -113,6 +114,9 @@ def snapshot(recent: int = 5) -> Dict:
         # in-graph tensor-stats telemetry: per-site rings, drift
         # watchdog state, calibration rollup
         "numerics": numerics.snapshot(),
+        # the goodput ledger: run-level wall-clock attribution vector +
+        # measured-vs-roofline MFU (empty-shaped when the ledger is off)
+        "goodput": goodput.snapshot(),
     }
     return sanitize(doc)
 
@@ -127,3 +131,4 @@ def reset() -> None:
     trace.clear()
     flight.reset()
     numerics.reset()
+    goodput.reset()

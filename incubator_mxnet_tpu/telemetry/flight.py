@@ -42,6 +42,8 @@ Bundle format (``format: 1``, strict JSON, one file per trigger)::
                  static peaks, leak-watchdog state...},
      "numerics": {...per-site tensor-stats rings (the drift trajectory),
                  drift-watchdog state, calibration rollup...},
+     "goodput": {...run-level wall-clock attribution vector +
+                 measured-vs-roofline MFU (telemetry.goodput)...},
      "step_report": {...host-gap attribution...},
      "metrics": {...registry table...},
      "env": {...MXTPU_/MXNET_/DMLC_/JAX_/XLA_ vars...},
@@ -118,7 +120,8 @@ def bundle(reason: str, /, site: Optional[str] = None, **context) -> Dict:
     costing the whole bundle."""
     from .. import profiler
     from ..lockcheck import edges, held_now, inversions
-    from . import compile_log, events, memory, metrics, numerics, trace
+    from . import (compile_log, events, goodput, memory, metrics, numerics,
+                   trace)
     from .export import sanitize
 
     doc: Dict = {"format": 1, "reason": reason, "site": site,
@@ -155,6 +158,10 @@ def bundle(reason: str, /, site: Optional[str] = None, **context) -> Dict:
     # trajectory — the hundreds of steps of rms growth BEFORE the
     # non-finite verdict, not just the corpse
     section("numerics", numerics.snapshot)
+    # the goodput ledger: where the dead run's wall-seconds had been
+    # going (attribution vector + measured-vs-roofline MFU) — the
+    # "was it even training efficiently" page of the post-mortem
+    section("goodput", goodput.snapshot)
     section("env", lambda: {k: v for k, v in sorted(os.environ.items())
                             if k.startswith(_ENV_PREFIXES)})
     section("config", lambda: _config())

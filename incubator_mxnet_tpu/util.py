@@ -12,7 +12,8 @@ from typing import Any, Dict, Optional
 
 __all__ = ["getenv", "setenv", "env_var_doc", "makedirs", "use_np_shape",
            "is_np_shape", "is_np_array", "set_np", "reset_np", "np_shape",
-           "nearest_rank_percentile", "parse_size", "hbm_budget_bytes"]
+           "nearest_rank_percentile", "parse_size", "hbm_budget_bytes",
+           "peak_tflops", "roofline_peaks", "PEAK_TFLOPS_BY_KIND"]
 
 
 def parse_size(s: str) -> int:
@@ -49,6 +50,44 @@ def hbm_budget_bytes() -> Optional[int]:
     ledger, so the gates can never read different capacities."""
     raw = getenv("MXTPU_HBM_BUDGET")
     return parse_size(raw) if raw else None
+
+
+#: nominal per-chip bf16 peaks for MFU/roofline accounting (public
+#: specs) — THE single table ``bench.py``, ``benchmark/autotune.py``,
+#: and ``telemetry.goodput`` all read, so a chip-kind correction lands
+#: in every consumer at once. The unknown/CPU default keeps
+#: device-blind runs deterministic (rankings, not absolute MFU).
+PEAK_TFLOPS_BY_KIND = {"v5 lite": 197.0, "v5e": 197.0, "v5p": 459.0,
+                       "v5": 459.0, "v4": 275.0, "v3": 123.0,
+                       "v6e": 918.0, "v6 lite": 918.0, "trillium": 918.0}
+DEFAULT_PEAK_TFLOPS = 459.0
+DEFAULT_PEAK_GBPS = 1200.0       # nominal HBM bandwidth
+DEFAULT_ICI_GBPS = 90.0          # nominal inter-chip bandwidth
+
+
+def peak_tflops() -> float:
+    """Per-chip bf16 peak TFLOPs (``MXTPU_PEAK_TFLOPS`` overrides, else
+    by device kind; the deterministic default on unknown/CPU/no
+    backend)."""
+    env = os.environ.get("MXTPU_PEAK_TFLOPS")
+    if env:
+        return float(env)
+    try:
+        import jax
+        kind = jax.devices()[0].device_kind.lower()
+        return next((v for k, v in PEAK_TFLOPS_BY_KIND.items()
+                     if k in kind), DEFAULT_PEAK_TFLOPS)
+    except Exception:  # noqa: BLE001 — no backend: stay deterministic
+        return DEFAULT_PEAK_TFLOPS
+
+
+def roofline_peaks() -> tuple:
+    """``(peak_flops_per_s, hbm_bytes_per_s, ici_bytes_per_s)`` — the
+    roofline denominators (``MXTPU_PEAK_TFLOPS`` / ``MXTPU_PEAK_GBPS``
+    / ``MXTPU_ICI_GBPS`` override the per-kind defaults)."""
+    bw = float(os.environ.get("MXTPU_PEAK_GBPS", DEFAULT_PEAK_GBPS))
+    ici = float(os.environ.get("MXTPU_ICI_GBPS", DEFAULT_ICI_GBPS))
+    return peak_tflops() * 1e12, bw * 1e9, ici * 1e9
 
 
 def nearest_rank_percentile(sorted_vals, q: float) -> float:
@@ -239,6 +278,25 @@ ENV_VARS: Dict[str, tuple] = {
                              "StepGuard — its policy then decides "
                              "warn/skip_and_rollback/halt BEFORE the "
                              "run ever goes non-finite."),
+    "MXTPU_GOODPUT": ("0", "1 enables the run-level goodput ledger "
+                      "(telemetry.goodput): every wall-second between "
+                      "begin() and report() is attributed to compute / "
+                      "collective / input_wait / host / compile / "
+                      "checkpoint / rollback_waste (unattributed is the "
+                      "honesty remainder, gated <10% by the "
+                      "goodput-smoke CI job), with a measured-vs-"
+                      "roofline MFU headline. Host-side bookkeeping "
+                      "only — the compiled graphs are untouched either "
+                      "way (the perf-proxy gate proves banked "
+                      "PERF_PROXY.json stays byte-identical). Default "
+                      "off: the trainer/io/checkpoint hooks are one "
+                      "env read."),
+    "MXTPU_GOODPUT_WINDOW": ("32", "Steps per goodput attribution "
+                             "window: each window closes with one "
+                             "goodput.window event and refreshed "
+                             "mxtpu_goodput_* gauges (share per "
+                             "category, measured/predicted MFU, "
+                             "divergence, unattributed share)."),
     "MXTPU_TELEMETRY": ("1", "Master switch for the mx.telemetry event "
                         "bus; 0 turns every emit() into a no-op."),
     "MXTPU_TELEMETRY_RING": ("1024", "Per-kind event ring-buffer capacity; "

@@ -1,0 +1,31 @@
+"""Seeded MX604 violations: stray device syncs inside a step loop.
+
+Three hot-loop syncs on the step result (``float()``, ``.item()``,
+``.block_until_ready()``) must each produce exactly one MX604 finding;
+the decimated read, the honest post-loop sync, and the ``.asnumpy()``
+idiom are controls that must stay clean.
+"""
+
+
+def train(trainer, batches):
+    last = None
+    for step, batch in enumerate(batches):
+        loss = trainer.step(*batch)
+        last = float(loss)              # MX604: sync every iteration
+        loss.item()                     # MX604: same smell, .item() form
+        loss.block_until_ready()        # MX604: dispatch-fence form
+        if step % 50 == 0:
+            # control: decimated cadence — NOT flagged
+            print(step, float(loss))
+        logged = float(loss.asnumpy())  # control: the honest sync idiom
+        del logged
+    return last
+
+
+def train_clean(trainer, batches):
+    # control: the sanctioned shape — no per-iteration sync at all, one
+    # honest sync after the loop
+    loss = None
+    for batch in batches:
+        loss = trainer.step(*batch)
+    return float(loss.asnumpy())

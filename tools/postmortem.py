@@ -185,6 +185,38 @@ def render(doc: Dict, events_n: int = 40) -> str:
                     f"  (finite {g(last.get('finite_fraction'))}, "
                     f"step {last.get('step')})")
             out.append(line)
+    # -- goodput: where the run's wall-seconds went ------------------------
+    gp = doc.get("goodput") or {}
+    if isinstance(gp, dict) and gp.get("steps"):
+        out += _section(f"goodput ({gp.get('steps')} step(s), "
+                        f"{gp.get('good_steps')} good, "
+                        f"{gp.get('rolled_back_steps')} rolled back)")
+        cats = gp.get("categories") or {}
+
+        def cat_ms(kv):
+            v = kv[1]
+            return -(v.get("ms") or 0.0) if isinstance(v, dict) else 0.0
+
+        # ranked by cost — the step budget's biggest consumer leads the
+        # page, which IS the triage answer
+        for name, v in sorted(cats.items(), key=cat_ms):
+            if not isinstance(v, dict):
+                continue
+            bad = name in ("rollback_waste", "unattributed") \
+                and (v.get("share_pct") or 0) >= 10.0
+            out.append(f"  {'!!' if bad else '  '} {name:<16} "
+                       f"{v.get('ms', 0):>12.1f} ms  "
+                       f"{v.get('share_pct', 0):>6.2f}%")
+        mfu = gp.get("mfu") or {}
+        if mfu.get("measured_mfu") is not None:
+            line = f"  measured MFU {mfu['measured_mfu']}"
+            if mfu.get("predicted_mfu") is not None:
+                line += (f" vs roofline {mfu['predicted_mfu']} "
+                         f"({mfu.get('divergence_pct')}% divergence)")
+            out.append(line)
+        if gp.get("classification"):
+            out.append(f"  classification: {gp['classification']}")
+
     comp = doc.get("compiles") or {}
     out += _section("compile ledger")
     out.append(f"  total={comp.get('total')} "
@@ -210,6 +242,7 @@ def render(doc: Dict, events_n: int = 40) -> str:
                             "mxtpu_guard_", "mxtpu_watchdog_",
                             "mxtpu_chaos_", "mxtpu_lockcheck_",
                             "mxtpu_memory_", "mxtpu_numerics_drift",
+                            "mxtpu_goodput_", "mxtpu_io_",
                             "mxtpu_router_", "mxtpu_serve_replica")):
             for labels, val in sorted(mets[name].items()):
                 v = (val.get("count") if isinstance(val, dict) else val)
