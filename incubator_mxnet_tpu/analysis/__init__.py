@@ -20,6 +20,8 @@ telemetry lint            observability hygiene (AST), MX6xx
 ``hlo`` passes            compiled-graph (jaxpr/StableHLO), MX7xx
 ``concurrency`` passes    race/deadlock/lock-order (AST, whole-package
                           lock graph + runtime sanitizer twin), MX8xx
+``distributed`` passes    SPMD divergence hazards (AST + HLO, runtime
+                          collective-ledger twin), MX9xx
 ========================  ===========================================
 
 Source lints honor inline suppressions (``# mxlint: disable=MX204`` on
@@ -61,6 +63,7 @@ from .recompile import (  # noqa: F401
 )
 from . import hlo  # noqa: F401  (registers the MX7xx compiled-graph passes)
 from . import concurrency  # noqa: F401  (MX8xx + the lockcheck twin)
+from . import distributed  # noqa: F401  (MX9xx + the collective-ledger twin)
 
 
 def lint_source(src, filename: str = "<string>") -> Report:
@@ -92,7 +95,7 @@ __all__ = ["verify", "Report", "Diagnostic", "CODES", "DEFAULT_SEVERITY",
            "list_passes", "run_passes", "PassContext", "tensor_arity",
            "check_sharding", "lint_source", "lint_file", "lint_paths",
            "cache_report", "RecompileWarning", "RECOMPILE_WARN_THRESHOLD",
-           "hlo", "concurrency", "parse_suppressions",
+           "hlo", "concurrency", "distributed", "parse_suppressions",
            "apply_suppressions"]
 
 

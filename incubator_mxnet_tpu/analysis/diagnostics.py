@@ -124,6 +124,32 @@ CODES = {
     "MX805": "jit/bucket compile cache accessed without the owning "
              "class's lock (the caches telemetry.compile_log tracks "
              "must be synchronized wherever threads can reach them)",
+    "MX901": "collective-sequence divergence: host-conditional control "
+             "flow (a branch on process_index()/process_count()/rank env "
+             "vars) encloses a collective issue, jitted-graph "
+             "build/dispatch, or kvstore traffic — in the multi-"
+             "controller SPMD model the processes that skip the branch "
+             "never reach the collective and the pod hangs, not crashes",
+    "MX902": "unelected side effect: a multi-host-aware module writes a "
+             "persistent file (checkpoint, telemetry export, flight "
+             "bundle, artifact cache) with no host-0 election guard — "
+             "the inverse rule of MX901: collectives must not diverge "
+             "across hosts, filesystem effects must",
+    "MX903": "non-elastic world assumption: a mesh shape / world size "
+             "frozen from jax.devices()/device_count()/process_count() "
+             "or a rank env var at import time (module scope or a "
+             "default argument) — the value is baked in before "
+             "dist.initialize() can rendezvous, so an elastic restart "
+             "with a different topology silently reuses the stale count",
+    "MX904": "cross-host RNG divergence: unseeded or time-seeded "
+             "randomness in a multi-host-aware module without a "
+             "process_index-folded or broadcast seed — each host draws "
+             "a different stream, so 'identical' SPMD programs feed "
+             "different batches/graphs and the run diverges silently",
+    "MX905": "collective-schedule divergence across buckets of one "
+             "entry: the traced graphs issue different collective "
+             "verb/axis sequences — the static twin of the telemetry "
+             "collective ledger's cross-process fingerprint crosscheck",
 }
 
 #: Default severity per code — THE single source of truth the passes,
@@ -149,6 +175,8 @@ DEFAULT_SEVERITY: Dict[str, str] = {
     "MX707": "info", "MX708": "error", "MX709": "error",
     "MX801": "warning", "MX802": "error", "MX803": "warning",
     "MX804": "warning", "MX805": "warning",
+    "MX901": "error", "MX902": "warning", "MX903": "warning",
+    "MX904": "warning", "MX905": "error",
 }
 
 

@@ -1,0 +1,89 @@
+"""``mx.analysis.distributed`` — SPMD divergence passes for the
+multi-host tier (the MX9xx family).
+
+Fourth lint registry beside the graph (MX0xx), compiled-graph (MX7xx),
+and concurrency (MX8xx) families, aimed at the invariant the
+multi-controller JAX model rests on: *every process runs the same
+program*. Nothing crashes when the invariant breaks — one host takes a
+divergent branch and the rest of the pod blocks in a collective forever
+— so the checks must run before the pod does.
+
+==========================  ==============================================
+``dist_collective_flow``     MX901 host-conditional control flow enclosing
+                             collective issues / jit builds / kv traffic
+``dist_elected_effects``     MX902 persistent writes with no host-0
+                             election in multi-host-aware modules
+``dist_elastic_world``       MX903 world sizes frozen at import time
+``dist_rng_divergence``      MX904 unseeded/time-seeded randomness without
+                             a process-folded or broadcast seed
+``hlo_collective_schedule``  MX905 collective verb/axis sequence diverges
+                             across buckets of one entry (HLO layer)
+==========================  ==============================================
+
+MX901 and MX902 are each other's inverse: collectives must NOT diverge
+across hosts, filesystem effects MUST (one elected writer). MX905 runs
+in the ``analysis.hlo`` pass registry over traced graphs; the rest are
+source lints. Run them via ``python -m tools.mxlint --distributed``
+(defaults to the installed package) or programmatically::
+
+    report = mx.analysis.distributed.lint_paths(["incubator_mxnet_tpu"])
+
+The **runtime twin** is :mod:`incubator_mxnet_tpu.telemetry.
+collective_ledger` (re-exported here as ``distributed.ledger``): under
+``MXTPU_COLLECTIVE_LEDGER=1`` every pjit step/bucket build banks a
+fingerprint of its collective schedule (the same
+:func:`~.schedule.schedule_of` extractor MX905 uses, plus comm bytes
+from the cost model), and :func:`crosscheck` exchanges the fingerprints
+across processes at ``dist.initialize()`` and on any post-warmup
+recompile — a mismatch writes one flight bundle and raises loudly
+instead of wedging the pod. The exact analogue of MX802↔``lockcheck``
+one layer up: static pass finds the hazard in CI, runtime twin catches
+the escape in production.
+
+Inline suppressions work as everywhere else: annotate intentional
+divergence (``# mxlint: disable=MX902`` on a per-host forensics write)
+so the package self-lints clean under ``--strict``.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..diagnostics import Report, apply_suppressions, walk_lint
+from .checks import DIST_PASSES, check_source
+from . import schedule  # noqa: F401  (registers hlo_collective_schedule)
+from .schedule import schedule_of, schedule_str  # noqa: F401
+from ...telemetry import collective_ledger as ledger  # noqa: F401
+
+__all__ = ["lint_source", "lint_file", "lint_paths", "crosscheck",
+           "DIST_PASSES", "list_distributed_passes", "schedule_of",
+           "schedule_str", "ledger"]
+
+
+def list_distributed_passes() -> List[str]:
+    return list(DIST_PASSES)
+
+
+def lint_source(src: str, filename: str = "<string>") -> Report:
+    """The MX901–MX904 source passes over one blob, inline suppressions
+    applied (MX905 needs traced graphs — it runs in the hlo registry)."""
+    return apply_suppressions(check_source(src, filename), src)
+
+
+def lint_file(path: str) -> Report:
+    with open(path) as f:
+        return lint_source(f.read(), filename=path)
+
+
+def lint_paths(paths) -> Report:
+    """The MX9xx source passes over files/directories (the
+    ``mxlint --distributed`` entry point)."""
+    return walk_lint(paths, lint_file)
+
+
+def crosscheck(tag: str = "manual", peers=None,
+               timeout_s: Optional[float] = None):
+    """Exchange this process's banked collective-schedule fingerprints
+    with every peer and raise on mismatch — a re-export of
+    :func:`telemetry.collective_ledger.crosscheck` at the analysis
+    surface (the ``concurrency.crosscheck`` analogue)."""
+    return ledger.crosscheck(tag, peers=peers, timeout_s=timeout_s)

@@ -1,0 +1,493 @@
+"""AST implementations of the MX901–MX904 distributed-correctness passes.
+
+The multi-controller SPMD contract has two halves, and the two central
+passes here are each other's inverse:
+
+- **MX901**: code every process must run identically (collective issues,
+  jitted-graph builds/dispatches, kvstore traffic) must NOT sit under
+  host-conditional control flow — the processes that skip the branch
+  never reach the collective and the pod hangs.
+- **MX902**: code that touches the shared filesystem (checkpoints,
+  telemetry exports, artifact caches) MUST diverge — exactly one elected
+  host writes, the rest no-op — or N hosts race the same rename.
+
+MX903 (non-elastic world assumptions frozen at import time) and MX904
+(cross-host RNG divergence) round out the family; MX905, the HLO-layer
+collective-schedule pass, lives in :mod:`.schedule` because it runs over
+traced graphs rather than source.
+
+Awareness scoping: MX902/MX904 only fire in *multi-host-aware* files —
+files that already reference the process topology (``process_index``/
+``process_count``/``is_primary``, ``jax.distributed``, the
+``parallel.dist`` shim, or dmlc rank env vars). A single-host utility
+writing a local file is not an SPMD hazard; the moment the file learns
+about the topology, its effects must be elected. MX901 and MX903 run
+everywhere (a topology-conditional collective or an import-time world
+size is hazardous wherever it appears).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from ..diagnostics import Diagnostic, Report
+
+__all__ = ["DIST_PASSES", "check_source"]
+
+#: pass name -> diagnostic code (the registry audit and the generated
+#: docs read this table)
+DIST_PASSES: Dict[str, str] = {
+    "dist_collective_flow": "MX901",
+    "dist_elected_effects": "MX902",
+    "dist_elastic_world": "MX903",
+    "dist_rng_divergence": "MX904",
+    "hlo_collective_schedule": "MX905",
+}
+
+#: calls whose result identifies THIS process within the pod
+_TOPOLOGY_CALLS = frozenset({"process_index", "process_count"})
+#: rank/world env vars (dmlc lineage + the common launcher conventions)
+_RANK_ENV_VARS = frozenset({
+    "DMLC_WORKER_ID", "DMLC_NUM_WORKER", "DMLC_ROLE",
+    "RANK", "WORLD_SIZE", "LOCAL_RANK", "NODE_RANK",
+    "OMPI_COMM_WORLD_RANK", "SLURM_PROCID",
+    "JAX_PROCESS_ID", "JAX_NUM_PROCESSES",
+})
+#: collective issues — every process on the mesh must reach these
+_COLLECTIVE_CALLS = frozenset({
+    "psum", "pmax", "pmin", "pmean", "all_gather", "all_reduce",
+    "psum_scatter", "reduce_scatter", "all_to_all", "ppermute",
+    "collective_permute", "barrier",
+})
+#: jitted-graph builds/dispatches — a compile (and the executable it
+#: produces) must exist on every process or the first dispatch hangs
+_BUILD_CALLS = frozenset({
+    "jit", "pjit", "lower", "compile", "make_jaxpr", "hybridize",
+    "shard_map", "pmap", "step",
+})
+#: kvstore traffic — the ps-lite lineage's collective surface
+_KVSTORE_CALLS = frozenset({"push", "pull", "pushpull", "broadcast"})
+_MX901_HAZARDS = _COLLECTIVE_CALLS | _BUILD_CALLS | _KVSTORE_CALLS
+
+#: names whose mention in an ``if`` test marks it as a host-0 election
+#: guard (MX902's accepted idiom) — and as host-conditional flow (MX901)
+_ELECTION_NAMES = frozenset({
+    "is_primary", "process_index", "process_count", "primary", "host0",
+    "elected",
+})
+
+#: import-time world-size reads (MX903)
+_WORLD_CALLS = frozenset({"device_count", "local_device_count",
+                          "process_count"})
+
+#: global-stream draws from the process-local default RNG (MX904)
+_GLOBAL_DRAWS = frozenset({
+    "rand", "randn", "randint", "uniform", "normal", "random", "choice",
+    "permutation", "shuffle", "standard_normal", "sample",
+})
+#: non-deterministic seed sources (MX904)
+_TIME_SEEDS = frozenset({"time", "time_ns", "monotonic", "urandom",
+                         "getrandbits", "perf_counter"})
+#: seed plumbing that makes per-host streams intentional and reproducible
+_SEED_FIXES = frozenset({"process_index", "fold_in", "random_fold_in",
+                         "broadcast", "broadcast_one_to_all"})
+
+
+# ---------------------------------------------------------------------------
+# small AST helpers
+# ---------------------------------------------------------------------------
+
+def _tail(node) -> Optional[str]:
+    """The last dotted component of a Name/Attribute chain."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _call_tails(node) -> Set[str]:
+    """Tails of every call inside ``node`` (the expression subtree)."""
+    return {t for n in ast.walk(node) if isinstance(n, ast.Call)
+            for t in [_tail(n.func)] if t}
+
+
+def _name_tails(node) -> Set[str]:
+    out: Set[str] = set()
+    for n in ast.walk(node):
+        t = _tail(n) if isinstance(n, (ast.Name, ast.Attribute)) else None
+        if t:
+            out.add(t)
+    return out
+
+
+def _env_keys(node) -> Set[str]:
+    """String keys read from ``os.environ[...]`` / ``environ.get(...)`` /
+    ``os.getenv(...)`` anywhere inside ``node``."""
+    keys: Set[str] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Subscript) and _tail(n.value) == "environ":
+            s = n.slice
+            if isinstance(s, ast.Constant) and isinstance(s.value, str):
+                keys.add(s.value)
+        elif isinstance(n, ast.Call):
+            t = _tail(n.func)
+            is_env_get = (t == "get"
+                          and _tail(getattr(n.func, "value", None))
+                          == "environ")
+            if (t == "getenv" or is_env_get) and n.args:
+                a = n.args[0]
+                if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                    keys.add(a.value)
+    return keys
+
+
+def _mentions_topology(test) -> bool:
+    """Does this ``if``/``while`` test read the process topology?"""
+    if _call_tails(test) & _TOPOLOGY_CALLS:
+        return True
+    if _env_keys(test) & _RANK_ENV_VARS:
+        return True
+    return False
+
+
+def _mentions_election(test) -> bool:
+    return bool(_name_tails(test) & _ELECTION_NAMES) \
+        or bool(_env_keys(test) & _RANK_ENV_VARS)
+
+
+def _attach_parents(tree) -> None:
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child._mx_parent = node  # type: ignore[attr-defined]
+
+
+def _ancestors(node):
+    p = getattr(node, "_mx_parent", None)
+    while p is not None:
+        yield p
+        p = getattr(p, "_mx_parent", None)
+
+
+def _context_of(node) -> str:
+    """``Class.method`` / function / ``<module>`` provenance label."""
+    names: List[str] = []
+    for a in _ancestors(node):
+        if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef)):
+            names.append(a.name)
+    return ".".join(reversed(names)) or "<module>"
+
+
+def _enclosing_function(node):
+    for a in _ancestors(node):
+        if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return a
+    return None
+
+
+def _is_aware(tree) -> bool:
+    """Multi-host-aware file: it references the process topology, the
+    ``parallel.dist`` shim, or ``jax.distributed``."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            t = _tail(node.func)
+            if t in _TOPOLOGY_CALLS or t == "is_primary":
+                return True
+        if isinstance(node, ast.Attribute) and node.attr == "distributed" \
+                and _tail(node.value) == "jax":
+            return True
+        if isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod.endswith("dist") or mod.endswith("distributed"):
+                return True
+            if any(a.name in ("dist", "is_primary") for a in node.names):
+                return True
+    for node in ast.walk(tree):
+        if _env_keys(node) & _RANK_ENV_VARS:
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# MX901 — host-conditional control flow over collectives/builds/kv traffic
+# ---------------------------------------------------------------------------
+
+def _check_collective_flow(tree, filename: str, report: Report) -> None:
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.If, ast.While)):
+            continue
+        if not _mentions_topology(node.test):
+            continue
+        # scan BOTH branches: either side reaching a collective while the
+        # other does not is the asymmetry that hangs
+        hazards: List[ast.Call] = []
+        for stmt in list(node.body) + list(node.orelse):
+            for n in ast.walk(stmt):
+                if isinstance(n, ast.Call):
+                    t = _tail(n.func)
+                    if t in _MX901_HAZARDS:
+                        hazards.append(n)
+        if not hazards:
+            continue
+        first = hazards[0]
+        tails = sorted({_tail(h.func) for h in hazards})
+        kind = ("while loop" if isinstance(node, ast.While)
+                else "branch")
+        report.add(Diagnostic(
+            "MX901",
+            f"host-conditional {kind} on the process topology encloses "
+            f"{len(hazards)} collective/jit/kvstore call(s) "
+            f"({', '.join(tails[:4])} at line {first.lineno}): in the "
+            "multi-controller SPMD model every process must issue the "
+            "same collective sequence — a host that skips this branch "
+            "leaves the others blocked in the collective forever (a "
+            "hang, not a crash). Elect effects, never collectives: keep "
+            "graph builds and collective dispatches unconditional and "
+            "put only filesystem/telemetry side effects behind "
+            "process_index() guards",
+            node=f"{filename}:{node.lineno}",
+            op=_context_of(node), pass_name="dist_collective_flow"))
+
+
+# ---------------------------------------------------------------------------
+# MX902 — unelected persistent writes in multi-host-aware files
+# ---------------------------------------------------------------------------
+
+def _write_mode(call: ast.Call) -> Optional[str]:
+    """The write-ish mode string of an ``open(...)`` call, or None.
+    Handles conditional modes like ``"a" if started else "w"``."""
+    mode = None
+    if len(call.args) >= 2:
+        mode = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if mode is None:
+        return None
+    literals = [n.value for n in ast.walk(mode)
+                if isinstance(n, ast.Constant) and isinstance(n.value, str)]
+    for lit in literals:
+        if any(c in lit for c in "wax"):
+            return lit
+    return None
+
+
+def _is_write_site(node: ast.Call) -> Optional[str]:
+    t = _tail(node.func)
+    if t in ("replace", "rename") and _tail(
+            getattr(node.func, "value", None)) == "os":
+        return f"os.{t}"
+    if t == "open" and isinstance(node.func, ast.Name):
+        m = _write_mode(node)
+        if m is not None:
+            return f"open(mode={m!r})"
+    return None
+
+
+def _guarded(node: ast.Call) -> bool:
+    """Is this write dominated by a host-election test?  Accepted forms:
+    an enclosing ``if`` whose test mentions election names, an earlier
+    early-exit election guard in the same function, or an enclosing
+    function that IS the election helper."""
+    fn = _enclosing_function(node)
+    if fn is not None and any(s in fn.name.lower()
+                              for s in ("primary", "elect")):
+        return True
+    for a in _ancestors(node):
+        if isinstance(a, ast.If) and _mentions_election(a.test):
+            return True
+    if fn is None:
+        return False
+    # early-exit guard: `if not is_primary(): return ...` before the write
+    for stmt in fn.body:
+        if stmt.lineno >= node.lineno:
+            break
+        if isinstance(stmt, ast.If) and _mentions_election(stmt.test) \
+                and any(isinstance(s, (ast.Return, ast.Raise))
+                        for s in stmt.body):
+            return True
+    return False
+
+
+def _check_elected_effects(tree, filename: str, report: Report,
+                           aware: bool) -> None:
+    if not aware:
+        return
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        what = _is_write_site(node)
+        if what is None or _guarded(node):
+            continue
+        report.add(Diagnostic(
+            "MX902",
+            f"unelected persistent write ({what}) in a multi-host-aware "
+            "module: under SPMD every process executes this line, so N "
+            "hosts race the same file/rename on a shared filesystem — "
+            "elect exactly one writer (guard with parallel.dist."
+            "is_primary(), a no-op at process_count()==1) or, where "
+            "per-host divergence is intentional (per-host forensics "
+            "with pid-unique names), document it with an inline "
+            "`# mxlint: disable=MX902`",
+            node=f"{filename}:{node.lineno}",
+            op=_context_of(node), pass_name="dist_elected_effects"))
+
+
+# ---------------------------------------------------------------------------
+# MX903 — world sizes frozen at import time
+# ---------------------------------------------------------------------------
+
+def _module_scope_stmts(tree):
+    """Nodes that execute at import time: module-level simple statements,
+    class bodies, and the import-time *headers* of module-level compound
+    statements (an ``if`` test, ``with`` context expressions) — their
+    bodies are queued individually rather than scanned wholesale, so a
+    method inside a class (call-time) never leaks into the import-time
+    set. Function bodies re-evaluate per call and are exempt."""
+    stack = list(tree.body)
+    while stack:
+        stmt = stack.pop(0)
+        if isinstance(stmt, ast.ClassDef):
+            stack = list(stmt.body) + stack
+        elif isinstance(stmt, ast.If):
+            yield stmt.test
+            stack = list(stmt.body) + list(stmt.orelse) + stack
+        elif isinstance(stmt, ast.Try):
+            body = list(stmt.body) + list(stmt.orelse) + list(stmt.finalbody)
+            for h in stmt.handlers:
+                body += list(h.body)
+            stack = body + stack
+        elif isinstance(stmt, ast.With):
+            for item in stmt.items:
+                yield item.context_expr
+            stack = list(stmt.body) + stack
+        else:
+            yield stmt
+
+
+def _world_reads(node) -> List[str]:
+    """World-size reads inside ``node``: jax.devices()/device_count()/
+    process_count() calls and rank/world env var reads."""
+    out = []
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            t = _tail(n.func)
+            if t in _WORLD_CALLS:
+                out.append(f"{t}()")
+            elif t == "devices" and _tail(
+                    getattr(n.func, "value", None)) == "jax":
+                out.append("jax.devices()")
+    env = _env_keys(node) & _RANK_ENV_VARS
+    out.extend(sorted(env))
+    return out
+
+
+def _check_elastic_world(tree, filename: str, report: Report) -> None:
+    def flag(node, reads: List[str], where: str) -> None:
+        report.add(Diagnostic(
+            "MX903",
+            f"world size frozen at import time ({', '.join(reads[:3])} "
+            f"in {where}): the value is evaluated when the module loads "
+            "— before dist.initialize() has rendezvoused the pod — and "
+            "an elastic restart with a different process/device count "
+            "silently reuses the stale number; read the topology inside "
+            "the function that builds the mesh/step instead",
+            node=f"{filename}:{node.lineno}",
+            op=_context_of(node), pass_name="dist_elastic_world"))
+
+    for stmt in _module_scope_stmts(tree):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue  # the body is call-time; defaults handled below
+        reads = _world_reads(stmt)
+        if reads:
+            flag(stmt, reads, "module scope")
+    # default-argument expressions evaluate at def time == import time
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for default in list(node.args.defaults) + [
+                d for d in node.args.kw_defaults if d is not None]:
+            reads = _world_reads(default)
+            if reads:
+                flag(node, reads, f"a default argument of {node.name}()")
+
+
+# ---------------------------------------------------------------------------
+# MX904 — cross-host RNG divergence
+# ---------------------------------------------------------------------------
+
+def _seed_fixed(call: ast.Call) -> bool:
+    """Seed expression folds the process identity or is broadcast —
+    per-host streams are then intentional and reproducible."""
+    return bool(_call_tails(call) & _SEED_FIXES) \
+        or bool(_name_tails(call) & _SEED_FIXES)
+
+
+def _rng_hazard(call: ast.Call) -> Optional[str]:
+    t = _tail(call.func)
+    owner = _tail(getattr(call.func, "value", None))
+    args = list(call.args) + [kw.value for kw in call.keywords]
+    time_seeded = any(_call_tails(a) & _TIME_SEEDS for a in args)
+    none_seeded = any(isinstance(a, ast.Constant) and a.value is None
+                      for a in args)
+    if t in ("PRNGKey", "key") and owner in ("random", "jax", None) \
+            and args and time_seeded:
+        return f"{t}() seeded from wall-clock time"
+    if t in ("seed",) and owner in ("random", None):
+        if not args or time_seeded or none_seeded:
+            return "seed() with no/time-based seed (fresh OS entropy " \
+                   "per host)"
+    if t in ("RandomState", "default_rng", "Generator"):
+        if not args or time_seeded or none_seeded:
+            return f"{t}() with no/time-based seed"
+    if t in _GLOBAL_DRAWS and owner == "random":
+        return f"{owner}.{t}() draw from the unseeded process-local " \
+               "default stream"
+    return None
+
+
+def _check_rng_divergence(tree, filename: str, report: Report,
+                          aware: bool) -> None:
+    if not aware:
+        return
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        what = _rng_hazard(node)
+        if what is None or _seed_fixed(node):
+            continue
+        report.add(Diagnostic(
+            "MX904",
+            f"cross-host RNG divergence: {what} in a multi-host-aware "
+            "module — every process draws a different stream, so "
+            "'identical' SPMD programs feed different batches or trace "
+            "different graphs and the run diverges without any error; "
+            "derive the seed deterministically and fold the process "
+            "identity in where per-host streams are wanted "
+            "(fold_in(key, process_index())) or broadcast one seed "
+            "from host 0",
+            node=f"{filename}:{node.lineno}",
+            op=_context_of(node), pass_name="dist_rng_divergence"))
+
+
+# ---------------------------------------------------------------------------
+# entry point
+# ---------------------------------------------------------------------------
+
+def check_source(src: str, filename: str = "<string>") -> Report:
+    """Run MX901–MX904 over one source blob. A file that does not parse
+    yields an empty report (``tracer_lint`` owns the MX200 diagnostic)."""
+    report = Report()
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return report
+    _attach_parents(tree)
+    aware = _is_aware(tree)
+    _check_collective_flow(tree, filename, report)
+    _check_elected_effects(tree, filename, report, aware)
+    _check_elastic_world(tree, filename, report)
+    _check_rng_divergence(tree, filename, report, aware)
+    report.diagnostics.sort(key=lambda d: (d.node or "", d.code))
+    return report

@@ -1,0 +1,87 @@
+"""Collective-schedule extraction + the MX905 HLO-layer pass.
+
+:func:`schedule_of` walks a traced graph's jaxpr in deterministic
+(program) order and returns the ordered ``verb@axes`` sequence of its
+explicit collective primitives — THE extractor both the static MX905
+pass and the runtime :mod:`~incubator_mxnet_tpu.telemetry.
+collective_ledger` fingerprint share, so the two surfaces can never
+disagree about what "the collective schedule" of a graph is.
+
+MX905 is the cross-bucket projection of the same invariant the ledger
+checks cross-process: every executable of one entry point must issue the
+same collective verb/axis sequence. Two buckets of one served model (or
+a step graph re-traced under a new signature) that lower to *different*
+schedules mean the program's collective structure depends on data
+geometry — exactly the divergence that, spread across hosts instead of
+buckets, wedges the pod.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+from ..hlo.cost import _COLLECTIVE_VERBS, _collective_axes
+from ..hlo.trace import TracedGraph, walk_eqns
+
+__all__ = ["schedule_of", "schedule_str"]
+
+
+def schedule_of(closed) -> List[str]:
+    """Ordered ``verb@axis[,axis...]`` entries for every explicit
+    collective primitive in a (closed) jaxpr, sub-jaxprs included, in
+    deterministic program order. Loop bodies contribute their schedule
+    once — ORDER is the invariant here, not executed counts (the cost
+    model owns trip-multiplied accounting)."""
+    out: List[str] = []
+    jaxpr = closed.jaxpr if hasattr(closed, "jaxpr") else closed
+    for eqn in walk_eqns(jaxpr):
+        name = eqn.primitive.name
+        if name in _COLLECTIVE_VERBS:
+            axes = ",".join(str(a) for a in _collective_axes(eqn)) or "?"
+            out.append(f"{_COLLECTIVE_VERBS[name]}@{axes}")
+    return out
+
+
+def schedule_str(schedule: List[str]) -> str:
+    return " -> ".join(schedule) if schedule else "(no collectives)"
+
+
+def _register() -> None:
+    from ..hlo.passes import register_hlo_pass
+
+    @register_hlo_pass("hlo_collective_schedule",
+                       describe="collective verb/axis sequence diverges "
+                                "across buckets of one entry (static twin "
+                                "of the telemetry collective ledger's "
+                                "cross-process crosscheck), MX905")
+    def hlo_collective_schedule(ctx) -> None:
+        by_entry: Dict[tuple, List[TracedGraph]] = {}
+        for g in ctx.graphs:
+            by_entry.setdefault((g.entry, g.kind), []).append(g)
+        for (entry, _kind), graphs in by_entry.items():
+            if len(graphs) < 2:
+                continue
+            schedules: Dict[tuple, List[str]] = {}
+            for g in graphs:
+                schedules.setdefault(tuple(schedule_of(g.closed)),
+                                     []).append(g.site)
+            if len(schedules) < 2:
+                continue
+            sites = "; ".join(
+                f"{'+'.join(v)}→[{schedule_str(list(k))}]"
+                for k, v in sorted(schedules.items(),
+                                   key=lambda kv: kv[1]))
+            ctx.diag(
+                "MX905",
+                f"{len(schedules)} distinct collective schedules across "
+                f"{len(graphs)} graphs of one entry [{sites}]: every "
+                "executable of an entry must issue the same collective "
+                "verb/axis sequence — a geometry-dependent collective "
+                "structure is the same divergence that, spread across "
+                "hosts, leaves part of the pod blocked in a collective "
+                "the rest never issues (the runtime twin is the "
+                "telemetry collective ledger's fingerprint crosscheck)",
+                node=f"{entry}[{len(schedules)} schedules]",
+                severity="error")
+
+
+_register()

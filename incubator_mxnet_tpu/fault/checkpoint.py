@@ -71,7 +71,9 @@ def _recover(root: str) -> None:
         try:
             if not os.path.isdir(final) \
                     and os.path.isfile(os.path.join(old, MANIFEST_FILE)):
-                os.replace(old, final)
+                # reader-side self-heal: idempotent (rename either already
+                # happened or is a no-op retry), so every host may run it
+                os.replace(old, final)  # mxlint: disable=MX902
             else:
                 shutil.rmtree(old, ignore_errors=True)
         except OSError:
@@ -153,6 +155,14 @@ def save_checkpoint(root: str, arrays: Dict[str, onp.ndarray],
     import time as _time
     t_save0 = _time.perf_counter()
     meta = dict(meta or {})
+    # SPMD election (the MX902 invariant): every host runs this same save
+    # call — the program must not diverge — but only the elected host may
+    # touch the shared checkpoint tree. Non-primary processes return the
+    # path the primary is writing; single-process runs are always primary,
+    # so this is a no-op outside multi-host jobs.
+    from ..parallel.dist import is_primary
+    if not is_primary():
+        return os.path.join(root, _step_dirname(step))
     os.makedirs(root, exist_ok=True)
     final = os.path.join(root, _step_dirname(step))
     tmp = os.path.join(root, f"{_TMP_PREFIX}{_step_dirname(step)}-{os.getpid()}")

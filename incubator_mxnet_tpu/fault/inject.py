@@ -96,6 +96,12 @@ class ChaosMonkey:
     must flag BEFORE the run goes non-finite (the ramp eventually
     overflows f32 and the classic StepGuard verdict trips too — one
     knob drives the full drift → non-finite escalation timeline)
+    ``collective_divergence`` — ``should('collective_divergence')``: the
+    collective-schedule ledger perturbs THIS process's fingerprint table
+    (salted with its process index) just before a crosscheck exchange —
+    the seeded SPMD-divergence drill; any >=2-process crosscheck with the
+    draw fired must trip and write a flight bundle
+    (``tools/collective_smoke.py`` and the CI crosscheck smoke)
     ``crash_sites`` — iterable of site names where :meth:`crash` raises
     (and :meth:`armed` consumes without raising); each site fires at most
     ``crash_count`` times (default 1) then disarms, so a retried save can
@@ -109,6 +115,7 @@ class ChaosMonkey:
                  replica_kill: float = 0.0, slow_replica: float = 0.0,
                  corrupt_artifact: float = 0.0,
                  leak: float = 0.0, leak_bytes: float = 1 << 20,
+                 collective_divergence: float = 0.0,
                  grad_blowup: float = 0.0, activation_drift: float = 0.0,
                  blowup_factor: float = 16.0, drift_factor: float = 1.5,
                  crash_sites: Iterable[str] = (), crash_count: int = 1):
@@ -121,6 +128,7 @@ class ChaosMonkey:
             "slow_replica": float(slow_replica),
             "corrupt_artifact": float(corrupt_artifact),
             "leak": float(leak),
+            "collective_divergence": float(collective_divergence),
             "grad_blowup": float(grad_blowup),
             "activation_drift": float(activation_drift),
         }

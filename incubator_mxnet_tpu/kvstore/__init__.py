@@ -456,6 +456,13 @@ class KVStore(GradientCompressionMixin, KVStoreBase):
 
     # -- persistence (reference: MXKVStoreSaveOptimizerStates) -------------
     def save_optimizer_states(self, fname: str, dump_optimizer: bool = False):
+        # host-0 election (MX902): comm='mesh' replicates the optimizer
+        # states across processes, so every host holds the same blob and
+        # exactly one may write it — single-process stores are always
+        # primary, so the local path is unchanged
+        from ..parallel.dist import is_primary
+        if not is_primary():
+            return
         blob = {"states": {k: tuple(onp.asarray(s._data if isinstance(s, NDArray)
                                                 else s) for s in st)
                            for k, st in self._opt_states.items()}}

@@ -38,7 +38,9 @@ from . import collectives  # noqa: F401
 from .collectives import (  # noqa: F401
     all_reduce, all_gather, reduce_scatter, broadcast, ppermute, all_to_all,
 )
-from .dist import initialize, finalize, process_count, process_index  # noqa: F401
+from .dist import (  # noqa: F401
+    finalize, initialize, is_primary, process_count, process_index,
+)
 from .trainer import ShardedTrainer  # noqa: F401
 from .ring import ring_attention, ring_attention_sharded  # noqa: F401
 from .pipeline import pipeline_apply, pipeline_sharded  # noqa: F401

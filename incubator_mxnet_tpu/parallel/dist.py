@@ -52,6 +52,13 @@ def initialize(coordinator_address: Optional[str] = None,
         process_id=process_id,
         local_device_ids=local_device_ids)
     _INITIALIZED[0] = True
+    # first collective-ledger crosscheck the moment the coordination
+    # service exists: validates every process reached the same rendezvous
+    # (and, on restarts, that restored fingerprint tables agree) before
+    # the first real collective can wedge the pod. One env read when the
+    # ledger is off.
+    from ..telemetry import collective_ledger
+    collective_ledger.crosscheck("dist.initialize")
 
 
 def finalize() -> None:
@@ -69,3 +76,23 @@ def process_count() -> int:
 
 def process_index() -> int:
     return jax.process_index()
+
+
+def is_primary() -> bool:
+    """True on the elected writer host (process 0) — THE election every
+    persistent side effect (checkpoint saves, telemetry sinks, artifact
+    uploads) must consult in a multi-host run (the MX902 invariant:
+    collectives must not diverge across hosts, filesystem effects must).
+
+    Reads the coordination-service state directly so it never initializes
+    a backend from a telemetry code path; falls back to the dmlc-style
+    ``DMLC_WORKER_ID`` before rendezvous so launch scripts see a
+    consistent answer at import time. Single-process runs are always
+    primary."""
+    try:
+        from jax._src.distributed import global_state
+        if getattr(global_state, "client", None) is not None:
+            return int(global_state.process_id or 0) == 0
+    except Exception:  # noqa: BLE001 — jax version drift → env fallback
+        pass
+    return os.environ.get("DMLC_WORKER_ID", "0") in ("", "0")

@@ -791,10 +791,21 @@ class ShardedTrainer:
                              self._t_dev) = out
                 self.last_path = "kvstore_fallback" if fallback else "pjit"
                 dispatch_ms = (time.perf_counter() - t_disp0) * 1e3
+                from ..telemetry import collective_ledger as _cledger
                 if new_sig:
                     self._step_sigs.add(sig)
                     _clog.note("trainer.step", sig, wall_ms=dispatch_ms,
                                warmup=first_sig)
+                    # bank this build's collective-schedule fingerprint
+                    # (one re-trace, no XLA compile; ledger off = one env
+                    # read) — a post-warmup rebank in a multi-process run
+                    # crosschecks immediately: the one-host-recompiled
+                    # divergence onset
+                    if _cledger.enabled() and not fallback:
+                        _cledger.bank_trainer(self, vals)
+                # the dispatch ring: what this pod member actually ran,
+                # in order — the flight bundle's cross-host diff surface
+                _cledger.note_dispatch("trainer.step", sig)
                 # numerics decimation: the host SYNCS the stat outputs
                 # only every cfg.every steps (first step included), and
                 # the read rides the guard's existing single device

@@ -310,6 +310,18 @@ class CompiledModel:
         compile_log.note("serve.compiled", sig,
                          wall_ms=(time.perf_counter() - t0) * 1e3,
                          warmup=not self._warmed)
+        # bank the bucket's collective-schedule fingerprint (one extra
+        # trace, no compile; off = one env read) — replicated serving
+        # fleets crosscheck these the same way trainer pods do
+        from ..telemetry import collective_ledger as _cledger
+        if _cledger.enabled():
+            try:
+                fn = (jax.jit(call) if self._mode == "artifact"
+                      else self._jit)
+                _cledger.bank_closed("serve.compiled",
+                                     jax.make_jaxpr(fn)(*avals), sig)
+            except Exception:  # noqa: BLE001 — never break a compile
+                pass
         return self._exe[key]
 
     def warmup(self, verbose: bool = False) -> Dict[str, Any]:

@@ -45,6 +45,7 @@ from __future__ import annotations
 import time
 from typing import Dict, Optional
 
+from . import collective_ledger  # noqa: F401
 from . import compile_log  # noqa: F401
 from . import events  # noqa: F401
 from . import export  # noqa: F401
@@ -75,7 +76,8 @@ __all__ = ["emit", "events", "get_events", "counts", "clear",
            "Event", "EventBus", "BUS",
            "Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
            "counter", "gauge", "histogram",
-           "compile_log", "metrics", "export", "trace", "flight", "slo",
+           "compile_log", "collective_ledger", "metrics", "export",
+           "trace", "flight", "slo",
            "memory", "numerics", "goodput",
            "SLO", "SLOMonitor",
            "prometheus_text", "chrome_trace", "otel_spans",
@@ -117,6 +119,10 @@ def snapshot(recent: int = 5) -> Dict:
         # the goodput ledger: run-level wall-clock attribution vector +
         # measured-vs-roofline MFU (empty-shaped when the ledger is off)
         "goodput": goodput.snapshot(),
+        # the collective-schedule ledger: banked per-site fingerprints,
+        # the dispatch ring, and crosscheck state (the SPMD divergence
+        # detector; empty-shaped when the ledger is off)
+        "collective_schedule": collective_ledger.snapshot(),
     }
     return sanitize(doc)
 
@@ -132,3 +138,4 @@ def reset() -> None:
     flight.reset()
     numerics.reset()
     goodput.reset()
+    collective_ledger.reset()

@@ -89,9 +89,26 @@ class JsonlSink:
         self._lock = make_lock("JsonlSink._lock")
         self._fh = None
         self._started = False
+        self._primary: Optional[bool] = None
         self.lines = 0
 
+    def elected(self) -> bool:
+        """Host-0 election (the MX902 invariant): under SPMD every
+        process emits the same events, but only the elected host may own
+        a shared JSONL path — the rest no-op. Always True single-process
+        (``parallel.dist.is_primary`` is a no-op election there), cached
+        at the first event so the per-event cost is one attribute read."""
+        if self._primary is None:
+            try:
+                from ..parallel.dist import is_primary
+                self._primary = bool(is_primary())
+            except Exception:  # noqa: BLE001 — no dist runtime ⇒ one host
+                self._primary = True
+        return self._primary
+
     def __call__(self, event) -> None:
+        if not self.elected():
+            return
         line = dumps_strict(event.to_dict(), sort_keys=True)
         with self._lock:
             try:
@@ -128,7 +145,9 @@ class JsonlSink:
     def _rotate(self) -> None:
         self._fh.close()
         self._fh = None
-        os.replace(self.path, self.path + ".1")
+        # reached only from the elected writer's __call__ (the election
+        # is per-sink, not per-method — statically unprovable from here)
+        os.replace(self.path, self.path + ".1")  # mxlint: disable=MX902
 
     def close(self) -> None:
         with self._lock:

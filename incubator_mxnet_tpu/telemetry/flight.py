@@ -34,6 +34,11 @@ Bundle format (``format: 1``, strict JSON, one file per trigger)::
 
     flight-<utc>-<reason>-p<pid>.json
     {"format": 1, "reason": ..., "site": ..., "ts": ..., "context": {...},
+     "process": {"index": ..., "count": ...},   # which pod member wrote it
+     "collective_schedule": {...banked fingerprints + dispatch ring:
+                 the SPMD-divergence ledger (telemetry.collective_ledger);
+                 a crosscheck-mismatch bundle from each host shows which
+                 site/signature they compiled differently...},
      "trace":   {"summary": ..., "spans": [...recent...]},
      "events":  {kind: [...recent per-kind ring...], ...},
      "compiles": {...ledger rollup...},
@@ -120,8 +125,8 @@ def bundle(reason: str, /, site: Optional[str] = None, **context) -> Dict:
     costing the whole bundle."""
     from .. import profiler
     from ..lockcheck import edges, held_now, inversions
-    from . import (compile_log, events, goodput, memory, metrics, numerics,
-                   trace)
+    from . import (collective_ledger, compile_log, events, goodput, memory,
+                   metrics, numerics, trace)
     from .export import sanitize
 
     doc: Dict = {"format": 1, "reason": reason, "site": site,
@@ -129,6 +134,12 @@ def bundle(reason: str, /, site: Optional[str] = None, **context) -> Dict:
                  "pid": os.getpid(),
                  "thread": threading.current_thread().name,
                  "context": dict(context)}
+    # which pod member wrote this bundle: a collective-schedule mismatch
+    # produces one bundle PER process, and the cross-host diff starts by
+    # lining them up by index (reads coordination state only — never
+    # initializes a backend from a crash path)
+    _, _pidx, _pcount = collective_ledger._coord()
+    doc["process"] = {"index": _pidx, "count": _pcount}
 
     def section(name, fn):
         try:
@@ -162,6 +173,10 @@ def bundle(reason: str, /, site: Optional[str] = None, **context) -> Dict:
     # going (attribution vector + measured-vs-roofline MFU) — the
     # "was it even training efficiently" page of the post-mortem
     section("goodput", goodput.snapshot)
+    # the collective-schedule ledger: banked fingerprints + the dispatch
+    # ring — a crosscheck-mismatch bundle shows WHICH site/signature this
+    # process compiled differently from its peers
+    section("collective_schedule", collective_ledger.snapshot)
     section("env", lambda: {k: v for k, v in sorted(os.environ.items())
                             if k.startswith(_ENV_PREFIXES)})
     section("config", lambda: _config())

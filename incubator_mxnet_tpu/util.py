@@ -348,6 +348,24 @@ ENV_VARS: Dict[str, tuple] = {
                            "bundles (storm damping; 0 = no spacing)."),
     "MXTPU_FLIGHT_SPANS": ("2048", "Most-recent trace spans included in "
                            "a flight bundle."),
+    "MXTPU_COLLECTIVE_LEDGER": ("0", "Master switch for the collective-"
+                                "schedule ledger (the MX9xx runtime "
+                                "twin): 1/true/on/yes banks a "
+                                "verb/axis-sequence fingerprint per "
+                                "compiled step and crosschecks it "
+                                "across the pod at dist.initialize() "
+                                "and on post-warmup recompiles. Off "
+                                "(default) costs one env read."),
+    "MXTPU_COLLECTIVE_LEDGER_RING": ("512", "Capacity of the per-process "
+                                     "dispatch ring (most-recent "
+                                     "collective dispatches kept for "
+                                     "flight bundles; oldest drop "
+                                     "first)."),
+    "MXTPU_COLLECTIVE_LEDGER_TIMEOUT_S": ("20", "Seconds each process "
+                                          "waits for peer fingerprint "
+                                          "blobs during a crosscheck "
+                                          "before declaring the "
+                                          "exchange failed."),
     "MXTPU_SLO_WINDOWS": ("60:14.4,300:6", "Burn-rate alert windows as "
                           "'seconds:threshold,...' — every window must "
                           "burn over its threshold at once to page "

@@ -1,0 +1,34 @@
+"""Control fixture: a disciplined multi-host step — unconditional
+collectives, host-0-elected writes, call-time world reads, and a
+process-folded RNG stream. Must produce ZERO MX9xx findings."""
+import json
+import os
+
+import jax
+
+EXPECT = None
+
+
+def all_reduce_metrics(metrics):
+    # every process issues the same collective, unconditionally
+    return jax.lax.psum(metrics, "data")
+
+
+def world_size():
+    # topology read at call time — survives an elastic restart
+    return jax.process_count()
+
+
+def host_key(base_key):
+    # per-host streams are intentional AND reproducible: the process
+    # identity is folded into one broadcast seed
+    return jax.random.fold_in(base_key, jax.process_index())
+
+
+def export_metrics(metrics, path):
+    if jax.process_index() != 0:
+        return  # host-0 election: exactly one writer
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(dict(metrics), f)
+    os.replace(tmp, path)

@@ -1,0 +1,14 @@
+"""Seeded MX901: a collective issued under host-conditional control flow
+— process 0 reaches the psum, every other process never does, and the
+pod blocks inside the collective forever (a hang, not a crash)."""
+import jax
+
+EXPECT = "MX901"
+
+
+def all_reduce_metrics(metrics):
+    if jax.process_index() == 0:
+        # MX901: only host 0 issues the collective; hosts 1..N-1 wait in
+        # their NEXT collective for a psum that never comes
+        return jax.lax.psum(metrics, "data")
+    return metrics

@@ -1,0 +1,17 @@
+"""Seeded MX902: a multi-host-aware module (it reads the process
+topology) persists a file with no host-0 election — N hosts race the
+same rename on the shared filesystem."""
+import json
+import os
+
+import jax
+
+EXPECT = "MX902"
+
+
+def export_metrics(metrics, path):
+    doc = {"process": jax.process_index(), "metrics": dict(metrics)}
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:          # MX902: every host writes `path`
+        json.dump(doc, f)
+    os.replace(tmp, path)              # MX902: every host races the rename

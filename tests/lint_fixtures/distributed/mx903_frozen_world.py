@@ -1,0 +1,13 @@
+"""Seeded MX903: the world size is read at import time — before
+``dist.initialize()`` has rendezvoused the pod — and an elastic restart
+with a different device count silently reuses the stale number."""
+import jax
+
+EXPECT = "MX903"
+
+# MX903: evaluated when the module loads, frozen for the process lifetime
+WORLD_SIZE = len(jax.devices())
+
+
+def shards_per_host(n_shards, world=None):
+    return n_shards // (world if world is not None else WORLD_SIZE)

@@ -1,0 +1,15 @@
+"""Seeded MX904: a multi-host-aware module seeds its RNG from wall-clock
+time — every process draws a different stream, so 'identical' SPMD
+programs feed different batches and the run diverges with no error."""
+import time
+
+import jax
+
+EXPECT = "MX904"
+
+
+def shuffle_seed():
+    if jax.process_count() > 1:
+        pass  # topology-aware module: per-host streams here are a hazard
+    # MX904: a fresh wall-clock seed per host
+    return jax.random.PRNGKey(int(time.time()))

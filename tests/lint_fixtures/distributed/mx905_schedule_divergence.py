@@ -1,0 +1,41 @@
+"""Seeded MX905: two buckets of ONE entry lower to different collective
+verb/axis sequences — the collective structure depends on data geometry,
+which is the same divergence that, spread across hosts instead of
+buckets, wedges the pod.
+
+Unlike the AST fixtures this one is a *factory*: :func:`graphs` builds
+the hand-made :class:`TracedGraph` pair the test feeds straight to
+``run_hlo_passes(names=["hlo_collective_schedule"])`` (the pass runs
+over traced graphs, not source)."""
+
+EXPECT = "MX905"
+
+
+def graphs():
+    import jax
+    import jax.numpy as jnp
+
+    from incubator_mxnet_tpu.analysis.hlo.trace import TracedGraph
+
+    x = jnp.ones((1, 4))
+
+    def bucket_small(v):
+        s = jax.lax.psum(v, "i")
+        g = jax.lax.all_gather(v, "i")
+        return s.sum() + g.sum()
+
+    def bucket_large(v):
+        # same entry, inverted collective order — the divergence
+        g = jax.lax.all_gather(v, "i")
+        s = jax.lax.psum(v, "i")
+        return s.sum() + g.sum()
+
+    out = []
+    for site, fn in (("bucket:4", bucket_small), ("bucket:8", bucket_large)):
+        closed = jax.make_jaxpr(jax.pmap(fn, axis_name="i"))(x)
+        out.append(TracedGraph(
+            entry="predict", site=site, closed=closed,
+            arg_names=["data"], roles=["input"],
+            kind="infer", signature=((tuple(x.shape), str(x.dtype)),),
+            expected=True))
+    return out

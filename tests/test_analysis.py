@@ -612,7 +612,8 @@ class TestHloPasses:
         names = hlo.list_hlo_passes()
         assert names == ["hlo_transfer", "hlo_promotion", "hlo_dead_code",
                          "hlo_donation", "hlo_constants", "hlo_signature",
-                         "hlo_mesh_step", "hlo_cost", "hlo_memory"]
+                         "hlo_mesh_step", "hlo_cost", "hlo_memory",
+                         "hlo_collective_schedule"]
         with pytest.raises(MXNetError, match="unknown hlo pass"):
             hlo.run_hlo_passes([], names=["nope"])
 

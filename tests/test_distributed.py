@@ -1,0 +1,400 @@
+"""mx.analysis.distributed (MX9xx) + the collective-schedule ledger.
+
+Static half: each seeded fixture under ``tests/lint_fixtures/distributed``
+produces exactly its designated diagnostic family; the clean control
+produces zero; the MX905 fixture's *traced graphs* trip the HLO-layer
+pass while its source lints clean; the installed package self-lints
+clean under ``--strict`` (intentional per-host writes carry inline
+``# mxlint: disable=MX902`` markers).
+
+Dynamic half: under ``MXTPU_COLLECTIVE_LEDGER=1`` the ledger banks
+deterministic collective-schedule fingerprints, rings dispatches,
+crosschecks digest tables against injected peers (match and mismatch),
+trips loudly under the seeded ``collective_divergence`` chaos knob, and
+surfaces through ``telemetry.snapshot()`` / flight bundles /
+``tools/postmortem.py`` — the MX802↔lockcheck analogue one layer up.
+The real 2-process exchange is exercised by ``tools/collective_smoke.py``
+(CI's crosscheck-smoke job); here it runs only under ``-m slow``.
+"""
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+from incubator_mxnet_tpu import fault, telemetry, util
+from incubator_mxnet_tpu.analysis import distributed
+from incubator_mxnet_tpu.analysis.diagnostics import (CODES,
+                                                      DEFAULT_SEVERITY)
+from incubator_mxnet_tpu.telemetry import collective_ledger as ledger
+from incubator_mxnet_tpu.telemetry.export import dumps_strict
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "lint_fixtures",
+                        "distributed")
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO_ROOT, "incubator_mxnet_tpu")
+
+pytestmark = pytest.mark.lint
+
+
+def _expect(name):
+    src = open(os.path.join(FIXTURES, name)).read()
+    for line in src.splitlines():
+        if line.startswith("EXPECT"):
+            val = line.split("=", 1)[1].strip()
+            return None if val == "None" else val.strip('"')
+    raise AssertionError(f"{name} has no EXPECT")
+
+
+class TestRegistryAudit:
+    """MX9xx folds into the diagnostics single-source-of-truth."""
+
+    def test_distributed_family_registered(self):
+        assert {f"MX90{i}" for i in range(1, 6)} <= set(CODES)
+        for i in range(1, 6):
+            assert f"MX90{i}" in DEFAULT_SEVERITY
+
+    def test_divergence_codes_are_error_severity(self):
+        # a proven schedule divergence WILL hang the pod: gate the build
+        assert DEFAULT_SEVERITY["MX901"] == "error"
+        assert DEFAULT_SEVERITY["MX905"] == "error"
+
+    def test_pass_table_matches_docs_registry(self):
+        assert list(distributed.DIST_PASSES) == [
+            "dist_collective_flow", "dist_elected_effects",
+            "dist_elastic_world", "dist_rng_divergence",
+            "hlo_collective_schedule"]
+        assert distributed.list_distributed_passes() == \
+            list(distributed.DIST_PASSES)
+
+    def test_hlo_layer_pass_registered(self):
+        from incubator_mxnet_tpu.analysis.hlo.passes import list_hlo_passes
+        assert "hlo_collective_schedule" in list_hlo_passes()
+
+
+class TestSeededFixtures:
+    """Tentpole acceptance: one fixture per code, exactly that family."""
+
+    @pytest.mark.parametrize("fixture", [
+        "mx901_conditional_collective.py",
+        "mx902_unelected_write.py",
+        "mx903_frozen_world.py",
+        "mx904_rng_divergence.py",
+    ])
+    def test_fixture_yields_exactly_its_code(self, fixture):
+        expect = _expect(fixture)
+        rep = distributed.lint_file(os.path.join(FIXTURES, fixture))
+        assert {d.code for d in rep} == {expect}, \
+            f"{fixture}: expected only {expect}, got {rep.codes()}"
+        assert len(rep) >= 1, str(rep)
+        sev = {d.severity for d in rep}
+        assert DEFAULT_SEVERITY[expect] in sev
+
+    def test_clean_fixture_zero_findings(self):
+        rep = distributed.lint_file(os.path.join(FIXTURES, "clean.py"))
+        assert len(rep) == 0, str(rep)
+
+    def test_mx905_fixture_source_lints_clean(self):
+        # the schedule divergence lives in the traced graphs, not the
+        # source — the AST passes must NOT fire on it
+        rep = distributed.lint_file(
+            os.path.join(FIXTURES, "mx905_schedule_divergence.py"))
+        assert len(rep) == 0, str(rep)
+
+    def test_mx905_fires_on_traced_graphs(self):
+        from incubator_mxnet_tpu.analysis.hlo.passes import run_hlo_passes
+        path = os.path.join(FIXTURES, "mx905_schedule_divergence.py")
+        spec = importlib.util.spec_from_file_location("mx905_fixture", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        assert _expect("mx905_schedule_divergence.py") == "MX905"
+        rep = run_hlo_passes(mod.graphs(),
+                             names=["hlo_collective_schedule"])
+        assert {d.code for d in rep} == {"MX905"}, str(rep)
+        assert DEFAULT_SEVERITY["MX905"] in {d.severity for d in rep}
+
+    def test_suppression_silences_fixture(self):
+        path = os.path.join(FIXTURES, "mx901_conditional_collective.py")
+        src = open(path).read()
+        rep = distributed.lint_source(src, path)
+        assert rep.codes(), "fixture must fire before suppression"
+        lines = src.splitlines()
+        for d in rep:
+            ln = int(d.node.rsplit(":", 1)[1])
+            lines[ln - 1] += "  # mxlint: disable=MX901"
+        assert distributed.lint_source("\n".join(lines),
+                                       path).codes() == []
+
+    def test_package_self_lints_clean_strict(self):
+        # the acceptance-criteria gate, in-process: zero errors AND zero
+        # warnings over the installed package (documented suppressions
+        # annotate the intentional single-writer designs)
+        rep = distributed.lint_paths([PKG])
+        assert rep.codes() == [], str(rep)
+
+
+class TestMxlintDistributedCLI:
+    def _main(self, argv):
+        from tools.mxlint import main
+        return main(argv)
+
+    def test_fixture_dir_exits_nonzero(self, capsys):
+        rc = self._main(["--distributed", FIXTURES, "--format=json"])
+        out = capsys.readouterr().out
+        assert rc == 1  # MX901 in the merged model is an error
+        codes = {json.loads(line)["code"]
+                 for line in out.splitlines() if line.startswith("{")}
+        assert codes == {"MX901", "MX902", "MX903", "MX904"}
+
+    def test_package_default_target_strict_clean(self, capsys):
+        rc = self._main(["--distributed", "--strict", "-q"])
+        assert rc == 0, capsys.readouterr().out
+
+    def test_json_findings_carry_pass_names(self, capsys):
+        self._main(["--distributed", FIXTURES, "--format=json"])
+        passes = {json.loads(line)["pass"]
+                  for line in capsys.readouterr().out.splitlines()
+                  if line.startswith("{")}
+        assert passes <= set(distributed.DIST_PASSES)
+
+
+class TestEnvCatalog:
+    def test_ledger_knobs_catalogued(self):
+        assert util.ENV_VARS["MXTPU_COLLECTIVE_LEDGER"][0] == "0"
+        assert util.ENV_VARS["MXTPU_COLLECTIVE_LEDGER_RING"][0] == "512"
+        assert util.ENV_VARS[
+            "MXTPU_COLLECTIVE_LEDGER_TIMEOUT_S"][0] == "20"
+
+    def test_off_by_default(self, monkeypatch):
+        monkeypatch.delenv("MXTPU_COLLECTIVE_LEDGER", raising=False)
+        assert not ledger.enabled()
+        assert ledger.crosscheck("off") == {"checked": False,
+                                            "reason": "disabled"}
+
+
+@pytest.fixture
+def live_ledger(monkeypatch):
+    monkeypatch.setenv("MXTPU_COLLECTIVE_LEDGER", "1")
+    monkeypatch.delenv("MXTPU_FLIGHT_DIR", raising=False)
+    ledger.reset()
+    yield ledger
+    ledger.reset()
+
+
+def _pmap_closed(inverted=False):
+    import jax
+    import jax.numpy as jnp
+
+    def step(v):
+        if inverted:
+            g = jax.lax.all_gather(v, "i")
+            s = jax.lax.psum(v, "i")
+        else:
+            s = jax.lax.psum(v, "i")
+            g = jax.lax.all_gather(v, "i")
+        return s.sum() + g.sum()
+
+    return jax.make_jaxpr(jax.pmap(step, axis_name="i"))(jnp.ones((1, 4)))
+
+
+class TestLedgerFingerprints:
+    def test_fingerprint_deterministic(self, live_ledger):
+        a = ledger.fingerprint(["all_reduce@i"], {"all_reduce": 1},
+                               1024, ((4,),), ("i",))
+        b = ledger.fingerprint(["all_reduce@i"], {"all_reduce": 1},
+                               1024, ((4,),), ("i",))
+        assert a["digest"] == b["digest"]
+        assert a == b
+
+    def test_fingerprint_sensitive_to_schedule_order(self, live_ledger):
+        a = ledger.fingerprint(["all_reduce@i", "all_gather@i"],
+                               {}, 0, "sig")
+        b = ledger.fingerprint(["all_gather@i", "all_reduce@i"],
+                               {}, 0, "sig")
+        assert a["digest"] != b["digest"]
+
+    def test_fingerprint_mesh_axes_forms(self, live_ledger):
+        # TracedGraph.mesh_axes may be a dict, a tuple, or None
+        d = ledger.fingerprint([], {}, 0, "s", {"data": 2, "model": 4})
+        t = ledger.fingerprint([], {}, 0, "s", ("data", "model"))
+        n = ledger.fingerprint([], {}, 0, "s", None)
+        assert d["mesh_axes"] == ["data=2", "model=4"]
+        assert t["mesh_axes"] == ["data", "model"]
+        assert n["mesh_axes"] == []
+
+    def test_bank_closed_extracts_schedule(self, live_ledger):
+        fp = ledger.bank_closed("t.step", _pmap_closed(),
+                                (((1, 4), "float32"),))
+        assert fp is not None
+        assert fp["schedule"] and all("@i" in s for s in fp["schedule"])
+        assert sum(fp["collective_ops"].values()) == len(fp["schedule"])
+        table = ledger.digest_table()
+        assert [r[0] for r in table] == ["t.step"]
+        assert table[0][2] == fp["digest"]
+
+    def test_bank_closed_divergent_builds_differ(self, live_ledger):
+        a = ledger.bank_closed("a", _pmap_closed(False), "sig")
+        b = ledger.bank_closed("b", _pmap_closed(True), "sig")
+        assert a["digest"] != b["digest"]
+        assert a["schedule"] == list(reversed(b["schedule"]))
+
+    def test_banked_and_digest_table_sorted(self, live_ledger):
+        ledger.bank("z.site", "s1", ledger.fingerprint([], {}, 0, "s1"))
+        ledger.bank("a.site", "s1", ledger.fingerprint([], {}, 0, "s1"))
+        table = ledger.digest_table()
+        assert [r[0] for r in table] == ["a.site", "z.site"]
+        assert set(ledger.banked()) == {"a.site", "z.site"}
+
+    def test_disabled_banking_is_noop(self, monkeypatch):
+        monkeypatch.delenv("MXTPU_COLLECTIVE_LEDGER", raising=False)
+        ledger.reset()
+        assert ledger.bank_closed("t", _pmap_closed(), "sig") is None
+        ledger.note_dispatch("t", "sig")
+        assert ledger.digest_table() == []
+        assert ledger.schedule_ring() == []
+
+
+class TestDispatchRing:
+    def test_ring_records_and_bounds(self, live_ledger, monkeypatch):
+        monkeypatch.setenv("MXTPU_COLLECTIVE_LEDGER_RING", "16")
+        ledger.reset()  # re-read the ring size
+        for i in range(20):
+            ledger.note_dispatch("t.step", (("b", i % 2),))
+        ring = ledger.schedule_ring()
+        assert len(ring) == 16  # bounded: oldest 4 dropped
+        assert ring[-1]["site"] == "t.step"
+        snap = ledger.snapshot()
+        assert snap["dispatches"]["t.step"] == 20
+
+
+class TestCrosscheck:
+    def test_single_process_degenerates(self, live_ledger):
+        out = ledger.crosscheck("solo")
+        assert out == {"checked": False, "reason": "single_process"}
+
+    def test_peers_match(self, live_ledger):
+        ledger.bank_closed("t.step", _pmap_closed(), "sig")
+        blob = dumps_strict(ledger.digest_table(), sort_keys=True)
+        out = ledger.crosscheck("unit", peers=[blob])
+        assert out == {"checked": True, "processes": 2, "entries": 1}
+        assert ledger.snapshot()["crosschecks"]["mismatches"] == 0
+
+    def test_peers_mismatch_raises_loudly(self, live_ledger):
+        ledger.bank_closed("t.step", _pmap_closed(), "sig")
+        peer = dumps_strict([], sort_keys=True)  # peer banked nothing
+        with pytest.raises(ledger.CollectiveMismatchError,
+                           match="different collective"):
+            ledger.crosscheck("unit", peers=[peer])
+        stats = ledger.snapshot()["crosschecks"]
+        assert stats["mismatches"] == 1
+        assert stats["last"]["ok"] is False
+
+    def test_mismatch_is_an_mxnet_error(self):
+        from incubator_mxnet_tpu.base import MXNetError
+        assert issubclass(ledger.CollectiveMismatchError, MXNetError)
+
+    def test_chaos_divergence_knob_trips(self, live_ledger):
+        # the smoke drill in-process: the seeded knob folds this
+        # process's identity into the payload, so an exchange against
+        # its own UNPERTURBED blob must trip
+        ledger.bank_closed("t.step", _pmap_closed(), "sig")
+        clean_blob = dumps_strict(ledger.digest_table(), sort_keys=True)
+        with fault.inject.chaos(seed=7, collective_divergence=1.0):
+            assert fault.inject.should("collective_divergence")
+            with pytest.raises(ledger.CollectiveMismatchError):
+                ledger.crosscheck("chaos", peers=[clean_blob])
+
+    def test_chaos_knob_off_by_default(self, live_ledger):
+        ledger.bank_closed("t.step", _pmap_closed(), "sig")
+        blob = dumps_strict(ledger.digest_table(), sort_keys=True)
+        with fault.inject.chaos(seed=7):  # knob not set -> no perturbation
+            out = ledger.crosscheck("quiet", peers=[blob])
+        assert out["checked"] is True
+
+
+class TestTelemetrySurface:
+    def test_snapshot_section(self, live_ledger):
+        ledger.bank_closed("t.step", _pmap_closed(), "sig")
+        ledger.note_dispatch("t.step", "sig")
+        sec = telemetry.snapshot()["collective_schedule"]
+        assert sec["enabled"] is True
+        assert any(k.startswith("t.step|") for k in sec["banked"])
+        assert sec["dispatches"] == {"t.step": 1}
+
+    def test_flight_bundle_carries_ledger_and_process(self, live_ledger):
+        from incubator_mxnet_tpu.telemetry import flight
+        ledger.bank_closed("t.step", _pmap_closed(), "sig")
+        doc = flight.bundle("manual")
+        assert doc["process"] == {"index": 0, "count": 1}
+        cs = doc["collective_schedule"]
+        assert cs["enabled"] is True and cs["banked"]
+
+    def test_postmortem_renders_collective_section(self, live_ledger):
+        from incubator_mxnet_tpu.telemetry import flight
+        ledger.bank_closed("t.step", _pmap_closed(), "sig")
+        ledger.note_dispatch("t.step", "sig")
+        doc = flight.bundle("manual")
+        if REPO_ROOT not in sys.path:
+            sys.path.insert(0, REPO_ROOT)
+        from tools import postmortem
+        rendered = postmortem.render(doc)
+        assert "collective schedule" in rendered
+        assert "t.step" in rendered
+
+    def test_reset_clears_everything(self, live_ledger):
+        ledger.bank_closed("t.step", _pmap_closed(), "sig")
+        ledger.note_dispatch("t.step", "sig")
+        ledger.reset()
+        snap = ledger.snapshot()
+        assert snap["banked"] == {} and snap["ring"] == []
+        assert snap["crosschecks"]["crosschecks"] == 0
+
+
+class TestElection:
+    def test_is_primary_defaults_true(self, monkeypatch):
+        from incubator_mxnet_tpu.parallel import is_primary
+        monkeypatch.delenv("DMLC_WORKER_ID", raising=False)
+        assert is_primary() is True
+
+    def test_is_primary_false_on_nonzero_rank(self, monkeypatch):
+        from incubator_mxnet_tpu.parallel import is_primary
+        monkeypatch.setenv("DMLC_WORKER_ID", "3")
+        assert is_primary() is False
+
+    def test_jsonl_sink_elects(self, monkeypatch, tmp_path):
+        # a non-primary process's sink must write NOTHING (MX902's fix)
+        from incubator_mxnet_tpu.telemetry import events as tele
+        from incubator_mxnet_tpu.telemetry.export import JsonlSink
+        monkeypatch.setenv("DMLC_WORKER_ID", "1")
+        path = str(tmp_path / "events.jsonl")
+        sink = JsonlSink(path)
+        sink(tele.emit("test.election"))
+        assert sink.lines == 0 and not os.path.exists(path)
+
+    def test_checkpoint_save_elects(self, monkeypatch, tmp_path):
+        import numpy as onp
+
+        from incubator_mxnet_tpu.fault import checkpoint as ckpt
+        monkeypatch.setenv("DMLC_WORKER_ID", "2")
+        out = ckpt.save_checkpoint(str(tmp_path),
+                                   {"w": onp.zeros(2)}, step=7)
+        assert not os.path.exists(out)  # elected writer only
+
+
+@pytest.mark.slow
+class TestTwoProcessSmoke:
+    """The real coordination-service exchange — CI's crosscheck-smoke
+    job in-process. Slow: two fresh jax processes per mode."""
+
+    def _run(self, argv):
+        if REPO_ROOT not in sys.path:
+            sys.path.insert(0, REPO_ROOT)
+        from tools.collective_smoke import main
+        return main(argv)
+
+    def test_clean_pod_agrees(self):
+        assert self._run([]) == 0
+
+    def test_seeded_divergence_trips(self):
+        assert self._run(["--chaos"]) == 0

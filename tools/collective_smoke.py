@@ -1,0 +1,174 @@
+#!/usr/bin/env python
+"""collective_smoke — 2-process end-to-end drill for the collective-
+schedule ledger (the MX9xx family's runtime twin).
+
+Spawns two CPU processes that rendezvous through
+``parallel.dist.initialize`` (dmlc-style env vars → the jax coordination
+service), bank identical collective-schedule fingerprints, and
+crosscheck them twice (once inside ``dist.initialize``, once explicitly).
+
+Two modes, mirroring the CI ``collective-smoke`` job:
+
+- **clean** (default): both processes must exit 0 — the exchange agrees.
+- **--chaos**: runs under ``MXTPU_CHAOS="seed=7,collective_divergence=1.0"``,
+  so each process perturbs its digest table with its own process index
+  before the exchange. The drill passes only if at least one worker dies
+  with a non-zero exit AND at least one parseable flight bundle with a
+  ``collective_schedule`` section lands in the flight dir — divergence
+  must be loud and leave evidence, never hang.
+
+Exit status: 0 = the mode's expectation held, 1 = it did not,
+2 = bad invocation / infrastructure failure (port, spawn, timeout).
+
+Usage::
+
+    python -m tools.collective_smoke            # clean pass
+    python -m tools.collective_smoke --chaos    # seeded divergence trips
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+NUM_WORKERS = 2
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _worker(rank: int) -> int:
+    """One pod member: rendezvous, bank, crosscheck, exit 0. A schedule
+    mismatch raises CollectiveMismatchError out of crosscheck — the
+    traceback (plus the flight bundle the ledger wrote) IS the finding."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from incubator_mxnet_tpu.parallel import dist
+    from incubator_mxnet_tpu.telemetry import collective_ledger as ledger
+
+    # crosscheck #1 runs inside initialize (tag "dist.initialize") with
+    # empty tables — it proves every process reached the same rendezvous
+    dist.initialize()
+
+    # every process banks the SAME step fingerprint (the clean invariant)
+    import jax.numpy as jnp
+
+    def step(v):
+        s = jax.lax.psum(v, "i")
+        return s.sum()
+
+    closed = jax.make_jaxpr(jax.pmap(step, axis_name="i"))(jnp.ones((1, 4)))
+    fp = ledger.bank_closed("smoke.step", closed,
+                            (((1, 4), "float32"),))
+    assert fp is not None, "ledger must be enabled for the smoke"
+    ledger.note_dispatch("smoke.step", (((1, 4), "float32"),))
+
+    # crosscheck #2: the banked digests must agree across the pod
+    out = ledger.crosscheck("smoke")
+    print(f"[worker {rank}] crosscheck ok: {out}", flush=True)
+    dist.finalize()
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="collective_smoke",
+                                 description=__doc__)
+    ap.add_argument("--chaos", action="store_true",
+                    help="run under the seeded collective_divergence "
+                         "chaos knob; expect a loud trip + flight bundle")
+    ap.add_argument("--timeout", type=float, default=180.0,
+                    help="per-run wall clock limit in seconds")
+    ap.add_argument("--worker", type=int, default=None,
+                    help=argparse.SUPPRESS)  # internal: worker rank
+    args = ap.parse_args(argv)
+
+    if args.worker is not None:
+        return _worker(args.worker)
+
+    port = _free_port()
+    flight_dir = tempfile.mkdtemp(prefix="collective-smoke-flight-")
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "DMLC_PS_ROOT_URI": "127.0.0.1",
+        "DMLC_PS_ROOT_PORT": str(port),
+        "DMLC_NUM_WORKER": str(NUM_WORKERS),
+        "MXTPU_COLLECTIVE_LEDGER": "1",
+        "MXTPU_COLLECTIVE_LEDGER_TIMEOUT_S": "30",
+        "MXTPU_FLIGHT_DIR": flight_dir,
+    })
+    if args.chaos:
+        env["MXTPU_CHAOS"] = "seed=7,collective_divergence=1.0"
+
+    procs = []
+    for rank in range(NUM_WORKERS):
+        wenv = dict(env, DMLC_WORKER_ID=str(rank))
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--worker", str(rank)],
+            env=wenv, cwd=REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+    rcs, outs = [], []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=args.timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            print("collective_smoke: worker timed out — the divergence "
+                  "path must raise, never hang", file=sys.stderr)
+            return 2
+        rcs.append(p.returncode)
+        outs.append(out.decode(errors="replace"))
+    for rank, out in enumerate(outs):
+        for line in out.splitlines():
+            print(f"  [w{rank}] {line}")
+
+    bundles = [os.path.join(flight_dir, f)
+               for f in sorted(os.listdir(flight_dir))
+               if f.startswith("flight-") and f.endswith(".json")]
+    parsed = []
+    for b in bundles:
+        try:
+            with open(b, encoding="utf-8") as f:
+                doc = json.load(f)
+            if doc.get("format") == 1 and "collective_schedule" in doc:
+                parsed.append(b)
+        except ValueError:
+            print(f"collective_smoke: TORN bundle {b} — the atomic-write "
+                  "contract broke", file=sys.stderr)
+            return 1
+
+    if not args.chaos:
+        if rcs == [0] * NUM_WORKERS:
+            print(f"collective_smoke: clean pass ({NUM_WORKERS} workers "
+                  "agreed)")
+            return 0
+        print(f"collective_smoke: clean mode FAILED, rcs={rcs}",
+              file=sys.stderr)
+        return 1
+
+    tripped = any(rc != 0 for rc in rcs)
+    if tripped and parsed:
+        print(f"collective_smoke: chaos divergence tripped loudly "
+              f"(rcs={rcs}, {len(parsed)} flight bundle(s))")
+        return 0
+    print(f"collective_smoke: chaos mode FAILED — rcs={rcs}, "
+          f"parseable bundles={len(parsed)} (need a non-zero exit AND "
+          "at least one bundle)", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,6 +18,8 @@ findings::
     python -m tools.mxlint --hlo bert --cost     # + per-graph cost table
     python -m tools.mxlint --concurrency         # MX8xx over the package
     python -m tools.mxlint --concurrency dir/    # ... or given targets
+    python -m tools.mxlint --distributed         # MX9xx over the package
+    python -m tools.mxlint --distributed dir/    # ... or given targets
     python -m tools.mxlint --format=json ...     # one JSON finding per line
 
 Python targets get the pure-AST JAX-pitfall lint (no import of the linted
@@ -40,6 +42,13 @@ the installed ``incubator_mxnet_tpu`` package — as ONE merged model, so
 the MX802 lock-acquisition graph spans every module. It replaces the
 per-file AST families for those targets (the two lint modes answer
 different questions; run both commands to get both).
+
+``--distributed`` runs the MX9xx SPMD-divergence passes
+(``mx.analysis.distributed``) over the given Python targets — default:
+the installed ``incubator_mxnet_tpu`` package. MX901–MX904 are source
+passes (host-conditional collectives, unelected writes, import-frozen
+world sizes, cross-host RNG); MX905 (cross-bucket collective-schedule
+divergence) runs with the compiled-graph passes under ``--hlo``.
 
 ``--format=json`` emits one finding per line
 (``{"file", "line", "node", "code", "severity", "message", "pass",
@@ -214,6 +223,12 @@ def main(argv=None) -> int:
                          "(mx.analysis.concurrency) over the Python "
                          "targets as one whole-package lock graph "
                          "(default target: the installed package)")
+    ap.add_argument("--distributed", action="store_true",
+                    help="run the MX9xx SPMD-divergence passes "
+                         "(mx.analysis.distributed) over the Python "
+                         "targets (default target: the installed "
+                         "package); combine with --hlo for the MX905 "
+                         "cross-bucket collective-schedule pass")
     ap.add_argument("--cost", action="store_true",
                     help="with --hlo: also print the per-graph cost table "
                          "(analysis.hlo.cost — FLOPs, bytes, "
@@ -240,7 +255,7 @@ def main(argv=None) -> int:
     import incubator_mxnet_tpu.analysis as analysis
 
     targets = args.targets
-    if args.concurrency and not targets:
+    if (args.concurrency or args.distributed) and not targets:
         targets = [os.path.join(REPO, "incubator_mxnet_tpu")]
     elif not targets and not args.hlo:
         targets = [os.path.join(REPO, t) for t in DEFAULT_TARGETS]
@@ -270,7 +285,9 @@ def main(argv=None) -> int:
             # MX8xx wants ONE merged model over every target (the lock
             # graph is whole-package), not a per-file walk
             report.extend(analysis.concurrency.lint_paths(py_targets))
-        else:
+        if args.distributed:
+            report.extend(analysis.distributed.lint_paths(py_targets))
+        if not args.concurrency and not args.distributed:
             report.extend(analysis.lint_paths(py_targets))
     for jt in json_targets:
         report.extend(_lint_json(jt, analysis))

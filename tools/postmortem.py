@@ -217,6 +217,34 @@ def render(doc: Dict, events_n: int = 40) -> str:
         if gp.get("classification"):
             out.append(f"  classification: {gp['classification']}")
 
+    # -- collective schedule: the SPMD-divergence ledger -------------------
+    cs = doc.get("collective_schedule") or {}
+    banked = cs.get("banked") or {}
+    if isinstance(cs, dict) and (banked or cs.get("dispatches")):
+        proc = doc.get("process") or {}
+        out += _section(
+            f"collective schedule (process {proc.get('index', '?')}/"
+            f"{proc.get('count', '?')}, enabled={cs.get('enabled')})")
+        for key in sorted(banked):
+            fp = banked[key] or {}
+            sched = fp.get("schedule") or []
+            out.append(f"  {key}")
+            out.append(f"    digest {str(fp.get('digest'))[:16]}  "
+                       f"schedule {' -> '.join(sched) or '(no collectives)'}")
+        disp = cs.get("dispatches") or {}
+        for site in sorted(disp):
+            out.append(f"  dispatched {site}: {disp[site]} step(s)")
+        stats = cs.get("crosschecks") or {}
+        if stats:
+            out.append(f"  crosschecks={stats.get('crosschecks')} "
+                       f"mismatches={stats.get('mismatches')} "
+                       f"last={stats.get('last')}")
+            if stats.get("mismatches"):
+                out.append("  !! collective-schedule mismatch: this "
+                           "process banked a different schedule than a "
+                           "peer — diff the per-process bundles' "
+                           "'banked' digests to find the site")
+
     comp = doc.get("compiles") or {}
     out += _section("compile ledger")
     out.append(f"  total={comp.get('total')} "
@@ -243,6 +271,7 @@ def render(doc: Dict, events_n: int = 40) -> str:
                             "mxtpu_chaos_", "mxtpu_lockcheck_",
                             "mxtpu_memory_", "mxtpu_numerics_drift",
                             "mxtpu_goodput_", "mxtpu_io_",
+                            "mxtpu_collective_",
                             "mxtpu_router_", "mxtpu_serve_replica")):
             for labels, val in sorted(mets[name].items()):
                 v = (val.get("count") if isinstance(val, dict) else val)
