@@ -69,7 +69,7 @@ def _bench_model(workload: str):
     return os.environ.get("MXTPU_BENCH_MODEL", _DEFAULT_MODEL[workload])
 
 
-def _watchdog_record(budget: int) -> dict:
+def _watchdog_record(budget: int, attempts: int = 1) -> dict:
     """The structured abort record the watchdog prints as its last stdout
     line: harnesses that parse one-JSON-line-per-run see a machine-readable
     ``{"error": "device_init_timeout"}`` instead of ``parsed: null``, so a
@@ -79,11 +79,15 @@ def _watchdog_record(budget: int) -> dict:
     ``tools/perf_history.py`` classifies the round BLIND off the null
     ``value`` and renders the ``error`` as its reason instead of
     silently skipping it — a run of rc=75 wedges reads as "no device
-    data since rN", never as "no regressions"."""
+    data since rN", never as "no regressions". ``attempts`` is the number
+    of full watchdog windows waited (1 = no retry configured): a round
+    that wedged through a retry is distinguishable from one that was
+    never given a second window."""
     workload = _bench_workload()
     model = _bench_model(workload)
     return {
         "error": "device_init_timeout",
+        "attempts": int(attempts),
         "goodput": None,
         "metric": None,
         "value": None,
@@ -94,40 +98,100 @@ def _watchdog_record(budget: int) -> dict:
     }
 
 
+class _BenchWatchdog:
+    """The device-init watchdog with one bounded retry: a fired window
+    re-arms up to ``MXTPU_BENCH_RETRIES`` times (default 1), each retry
+    window stretched by ``MXTPU_BENCH_RETRY_BACKOFF_S`` (default 60) —
+    a pool grant that lands late is a recovered round, not a blind one.
+    Only after the LAST window expires does the abort record print
+    (with the ``attempts`` count) and the process ``os._exit(75)``.
+
+    The timer thread cannot un-wedge the blocked device-init call — the
+    retry IS the extra bounded window; what it buys is distinguishing
+    "wedged forever" from "slow grant", without a human re-launching.
+    """
+
+    def __init__(self, budget: int, retries: int, backoff_s: float):
+        import threading
+        self._threading = threading
+        self._budget = budget
+        self._retries = max(0, retries)
+        self._backoff = max(0.0, backoff_s)
+        self._lock = threading.Lock()
+        self._attempt = 1
+        self._cancelled = False
+        self._timer = None
+        self._arm(budget)
+
+    def _arm(self, window: float) -> None:
+        t = self._threading.Timer(window, self._fire)
+        t.daemon = True
+        self._timer = t
+        t.start()
+
+    def cancel(self) -> None:
+        with self._lock:
+            self._cancelled = True
+            if self._timer is not None:
+                self._timer.cancel()
+                self._timer = None
+
+    @property
+    def attempts(self) -> int:
+        return self._attempt
+
+    def _fire(self) -> None:
+        import sys
+        with self._lock:
+            if self._cancelled:
+                return
+            attempt = self._attempt
+            if attempt <= self._retries:
+                # the bounded retry: one more window, stretched by the
+                # backoff, and the round records that it needed it
+                self._attempt = attempt + 1
+                window = self._budget + self._backoff
+                sys.stderr.write(
+                    f"bench.py watchdog: no result within {self._budget}s "
+                    f"(attempt {attempt}) — re-arming once with backoff: "
+                    f"{window:g}s more before aborting.\n")
+                sys.stderr.flush()
+                self._arm(window)
+                return
+            attempts = self._attempt
+        sys.stderr.write(
+            f"bench.py watchdog: no result after {attempts} attempt(s) "
+            f"({self._budget}s budget) — the TPU tunnel/device init is "
+            "likely wedged; aborting.\n")
+        sys.stderr.flush()
+        # the one JSON line the bench harness parses: a structured abort
+        # record, not silence
+        sys.stdout.write(json.dumps(
+            _watchdog_record(self._budget, attempts=attempts)) + "\n")
+        sys.stdout.flush()
+        os._exit(75)  # EX_TEMPFAIL
+
+
 def _arm_watchdog():
-    """Arm and return the watchdog timer (None when disabled) — callers
-    cancel it once the device proves alive (see ``_measure``).
+    """Arm and return the watchdog (None when disabled) — callers cancel
+    it once the device proves alive (see ``_measure``).
 
     Fail loudly instead of hanging forever if the TPU tunnel is wedged
     (device init blocks indefinitely when the pool grant is stuck).
-    MXTPU_BENCH_TIMEOUT seconds, default 1500; 0 disables.
+    MXTPU_BENCH_TIMEOUT seconds, default 1500; 0 disables. One bounded
+    retry with backoff before aborting (MXTPU_BENCH_RETRIES /
+    MXTPU_BENCH_RETRY_BACKOFF_S; see :class:`_BenchWatchdog`).
 
     Uses a daemon timer + os._exit: a Python signal handler could never run
     while the main thread is blocked inside the C++ device-init call (the
     exact hang being guarded against).
     """
-    import threading
-
     budget = int(os.environ.get("MXTPU_BENCH_TIMEOUT", "1500"))
     if budget <= 0:
         return
-
-    def _fire():
-        import sys
-        sys.stderr.write(
-            f"bench.py watchdog: no result within {budget}s — the TPU "
-            "tunnel/device init is likely wedged; aborting.\n")
-        sys.stderr.flush()
-        # the one JSON line the bench harness parses: a structured abort
-        # record, not silence
-        sys.stdout.write(json.dumps(_watchdog_record(budget)) + "\n")
-        sys.stdout.flush()
-        os._exit(75)  # EX_TEMPFAIL
-
-    t = threading.Timer(budget, _fire)
-    t.daemon = True
-    t.start()
-    return t
+    retries = int(os.environ.get("MXTPU_BENCH_RETRIES", "1"))
+    backoff = float(os.environ.get("MXTPU_BENCH_RETRY_BACKOFF_S", "60"))
+    return _BenchWatchdog(budget, retries, backoff)
 
 
 # fwd GMACs per image at 224x224 (the canonical He-et-al. multiply-add

@@ -56,7 +56,8 @@ from ..lockcheck import make_lock
 
 __all__ = ["ChaosMonkey", "ChaosCrash", "chaos", "enable", "disable",
            "active", "enable_from_env", "should", "maybe_delay",
-           "maybe_leak", "scale_ramp", "crash", "armed", "poison"]
+           "maybe_leak", "scale_ramp", "crash", "armed", "poison",
+           "note_step", "heartbeat_stalled"]
 
 
 class ChaosCrash(MXNetError):
@@ -102,6 +103,17 @@ class ChaosMonkey:
     the seeded SPMD-divergence drill; any >=2-process crosscheck with the
     draw fired must trip and write a flight bundle
     (``tools/collective_smoke.py`` and the CI crosscheck smoke)
+    ``host_kill`` / ``host_stall`` — STEP NUMBERS, not probabilities
+    (``-1`` = off, like every other knob's default). At the named
+    training step, ``note_step(step)`` (the trainer's chaos hook)
+    either SIGKILLs this process (``host_kill`` — the clean corpse: no
+    cleanup, no flush, exactly what a preempted TPU host looks like to
+    its peers) or stops the elastic heartbeat while the process keeps
+    running (``host_stall`` — the nastier failure: the host still
+    answers nothing is wrong, only its lease goes stale). Both exist to
+    drill ``parallel.elastic``'s lease watchdog: survivors must detect
+    the loss by lease expiry and write a flight bundle stamped with the
+    dead process index, never hang in a collective.
     ``crash_sites`` — iterable of site names where :meth:`crash` raises
     (and :meth:`armed` consumes without raising); each site fires at most
     ``crash_count`` times (default 1) then disarms, so a retried save can
@@ -118,6 +130,7 @@ class ChaosMonkey:
                  collective_divergence: float = 0.0,
                  grad_blowup: float = 0.0, activation_drift: float = 0.0,
                  blowup_factor: float = 16.0, drift_factor: float = 1.5,
+                 host_kill: int = -1, host_stall: int = -1,
                  crash_sites: Iterable[str] = (), crash_count: int = 1):
         self.seed = int(seed)
         self.probs: Dict[str, float] = {
@@ -143,6 +156,10 @@ class ChaosMonkey:
         #: ever frees them while the monkey is installed
         self._leaked: list = []
         self.delay_s = float(delay_s)
+        #: elastic-drill knobs: step numbers (-1 = off)
+        self.host_kill_step = int(host_kill)
+        self.host_stall_step = int(host_stall)
+        self._last_step: Optional[int] = None
         self._armed: Dict[str, int] = {s: int(crash_count)
                                        for s in crash_sites}
         self._streams: Dict[str, onp.random.RandomState] = {}
@@ -252,6 +269,38 @@ class ChaosMonkey:
                           "Chaos faults fired", site=site).inc()
         return True
 
+    def note_step(self, step: int) -> None:
+        """The trainer's per-step chaos hook for the elastic-drill
+        knobs: record the current step (``host_stall`` keys off it) and,
+        at the ``host_kill`` step, SIGKILL this process — no Python
+        cleanup, no flushed buffers, the exact corpse a preempted host
+        leaves. The kill is announced on stderr first (the drill driver
+        reads it; a SIGKILLed process can say nothing after)."""
+        with self._lock:
+            self._last_step = int(step)
+        if self.host_kill_step >= 0 and int(step) == self.host_kill_step:
+            import signal
+            import sys
+            print(f"[chaos] host_kill firing at step {step}: "
+                  f"SIGKILL pid {os.getpid()}", file=sys.stderr,
+                  flush=True)
+            from ..telemetry import events as _tele
+            _tele.emit("chaos", severity="error", site="host_kill",
+                       step=int(step), seed=self.seed)
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    def heartbeat_stalled(self) -> bool:
+        """Is the ``host_stall`` knob holding heartbeats back? True once
+        the trainer has noted a step >= the stall step — the process
+        keeps running (and keeps issuing collectives) but its lease goes
+        stale, which is exactly the failure the lease watchdog exists
+        to catch."""
+        if self.host_stall_step < 0:
+            return False
+        with self._lock:
+            last = self._last_step
+        return last is not None and last >= self.host_stall_step
+
     def poison(self, arr):
         """Return a NaN-filled array matching ``arr`` (float dtypes only —
         integer batches poison the first float downstream instead)."""
@@ -296,10 +345,8 @@ def enable_from_env() -> Optional[ChaosMonkey]:
         k = k.strip()
         if k == "crash":
             sites.append(v.strip())
-        elif k == "seed":
-            kw["seed"] = int(v)
-        elif k == "crash_count":
-            kw["crash_count"] = int(v)
+        elif k in ("seed", "crash_count", "host_kill", "host_stall"):
+            kw[k] = int(v)
         else:
             kw[k] = float(v)
     if sites:
@@ -372,3 +419,14 @@ def armed(site: str) -> bool:
 def poison(arr):
     m = active()
     return m.poison(arr) if m is not None else arr
+
+
+def note_step(step: int) -> None:
+    m = active()
+    if m is not None:
+        m.note_step(step)
+
+
+def heartbeat_stalled() -> bool:
+    m = active()
+    return m.heartbeat_stalled() if m is not None else False

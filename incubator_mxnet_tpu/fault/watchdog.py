@@ -160,9 +160,19 @@ class Watchdog:
         # step is wedged and the operator's next move may be kill -9 —
         # capture the rings NOW, while they still exist
         from ..telemetry import flight as _flight
+        # membership rides in the trigger context: "is this hang a dead
+        # peer?" is the FIRST multi-host triage question, and the lease
+        # table answers it without waiting for the lease watchdog's own
+        # bundle
+        try:
+            from ..parallel import elastic as _elastic
+            membership = _elastic.snapshot()
+        except Exception:  # noqa: BLE001 — a broken control plane must
+            membership = None          # not mask the hang diagnosis
         _flight.dump("watchdog", step=step, deadline_s=deadline,
                      elapsed_s=round(flag.elapsed, 3),
-                     compiles=compiles, recent_signatures=recent)
+                     compiles=compiles, recent_signatures=recent,
+                     membership=membership)
         warnings.warn(f"[fault.watchdog] {flag}")
         if self.on_flag is not None:
             self.on_flag(flag)

@@ -503,6 +503,20 @@ class PrefetchIter(DataIter):
     conventions); :meth:`close` (or ``with`` exit) shuts it down and
     joins it, :meth:`reset` restarts the stream from the wrapped
     iterator's top.
+
+    **Host sharding** (the elastic data plane): :meth:`shard` gives this
+    process a disjoint round-robin view of the wrapped stream — global
+    batch ``g`` belongs to host ``g % process_count == process_index``;
+    the worker *consumes* every batch from the wrapped iterator but
+    delivers (and places) only this host's share, so N hosts driving N
+    identical iterators partition the epoch with zero overlap and zero
+    cross-host coordination. The shard boundary is checkpointable:
+    :meth:`shard_state` returns the pod-wide consumed-through cursor
+    (every host computes the same value at the same step — SPMD
+    lockstep), trainers bank it in checkpoint meta, and
+    :meth:`restore_shard` fast-forwards past it under a **new**
+    ``(index, count)`` membership — so a 2-host run restored on 1 host
+    resumes the stream with no sample replayed and no sample dropped.
     """
 
     _DONE = object()
@@ -520,6 +534,14 @@ class PrefetchIter(DataIter):
         self._done = False           # stream ended (worker queues _DONE once)
         self._gen = 0
         self._closed = False
+        # host-shard view (identity by default). All five fields are
+        # written only while the worker is stopped (shard/restore/reset),
+        # so the worker thread reads them race-free.
+        self._shard_index = 0
+        self._shard_count = 1
+        self._shard_base = 0      # global index round-robin starts from
+        self._skip_to = 0         # globals below this are already consumed
+        self._boundary = 0        # pod-wide consumed-through cursor
         # input-wait instrumentation: every consumer-side queue pop is
         # timed — the blocked portion IS input starvation, the number
         # the goodput ledger's input_wait bucket and the "is the step
@@ -541,11 +563,18 @@ class PrefetchIter(DataIter):
 
         from ..fault import inject as _inject
 
+        # the shard view, snapshotted at worker start (only mutated while
+        # the worker is stopped); g counts batches pulled from the wrapped
+        # iterator since its last reset — the GLOBAL batch index
+        sh_index, sh_count = self._shard_index, self._shard_count
+        sh_base, sh_skip = self._shard_base, self._skip_to
+
         def run():
             # A stale generation (reset()/close() bumped self._gen) stops
             # touching the shared underlying iterator and exits without
             # queueing its sentinel.
             tail = None
+            g = 0
             try:
                 while gen == self._gen:
                     try:
@@ -562,6 +591,12 @@ class PrefetchIter(DataIter):
                         self._exc = e
                         tail = PrefetchIter._DONE
                         break
+                    g_cur, g = g, g + 1
+                    if g_cur < sh_skip:
+                        continue   # restored boundary: already trained on
+                    if sh_count > 1 and \
+                            (g_cur - sh_base) % sh_count != sh_index:
+                        continue   # another host's batch: consume, not ours
                     if self._place is not None:
                         try:
                             # the device hop happens HERE, on the worker —
@@ -574,7 +609,7 @@ class PrefetchIter(DataIter):
                             break
                     while gen == self._gen:
                         try:
-                            q.put(b, timeout=0.05)
+                            q.put((g_cur, b), timeout=0.05)
                             break
                         except _queue.Full:
                             continue
@@ -618,9 +653,93 @@ class PrefetchIter(DataIter):
                 "iterator or place() is blocked); cannot reset safely")
         self._exc = None
         self._done = False
+        # a new epoch re-shards from global 0: the shard membership
+        # (index/count) survives reset, any restored fast-forward does not
+        self._shard_base = 0
+        self._skip_to = 0
+        self._boundary = 0
         self._it.reset()
         self._queue = _queue.Queue(maxsize=self._depth)
         self._start()
+
+    def shard(self, process_index: int, process_count: int) -> "PrefetchIter":
+        """Restrict this iterator to host ``process_index``'s round-robin
+        share of the stream (global batch ``g`` is ours iff
+        ``g % process_count == process_index``). Restarts the stream from
+        the wrapped iterator's top so every host's view starts from the
+        same global 0 — call it once, right after construction, with
+        ``parallel.dist.world()``. Returns ``self`` for chaining. A
+        ``(0, 1)`` shard is the identity view."""
+        process_index, process_count = int(process_index), int(process_count)
+        if process_count < 1 or not 0 <= process_index < process_count:
+            raise MXNetError(
+                f"invalid shard view ({process_index}, {process_count}): "
+                "need 0 <= process_index < process_count")
+        if self._closed:
+            raise MXNetError("PrefetchIter is closed")
+        if not self._stop_worker():
+            raise MXNetError(
+                "PrefetchIter worker did not stop within 5s; cannot "
+                "reshard safely")
+        self._shard_index = process_index
+        self._shard_count = process_count
+        self._shard_base = 0
+        self._skip_to = 0
+        self._boundary = 0
+        self._exc = None
+        self._done = False
+        self._it.reset()
+        self._queue = _queue.Queue(maxsize=self._depth)
+        self._start()
+        return self
+
+    def shard_state(self) -> Dict[str, int]:
+        """The checkpointable shard boundary. ``next_global`` is the
+        pod-wide consumed-through cursor: with every host in SPMD
+        lockstep (same step count at the save barrier), batches
+        ``[0, next_global)`` have each been consumed by exactly one
+        host, so a restore under ANY new membership starts there with
+        no overlap and no gap. Trainers bank this dict in checkpoint
+        meta (``meta["data_state"]``)."""
+        return {"next_global": self._boundary,
+                "index": self._shard_index,
+                "count": self._shard_count,
+                "batch_size": int(self.batch_size)}
+
+    def restore_shard(self, state: Dict[str, int],
+                      index: Optional[int] = None,
+                      count: Optional[int] = None) -> "PrefetchIter":
+        """Resume the stream from a banked :meth:`shard_state` under a
+        (possibly different) membership — THE elastic-recovery data
+        path: the wrapped iterator restarts from its top, the worker
+        fast-forwards past the ``next_global`` already-consumed batches,
+        and round-robin assignment restarts from that boundary with the
+        NEW ``(index, count)`` (defaults: the saved membership). No
+        consumed sample is replayed, no unconsumed sample is skipped."""
+        state = dict(state or {})
+        idx = int(state.get("index", 0)) if index is None else int(index)
+        n = int(state.get("count", 1)) if count is None else int(count)
+        if n < 1 or not 0 <= idx < n:
+            raise MXNetError(
+                f"invalid shard view ({idx}, {n}): need 0 <= index < count")
+        boundary = max(0, int(state.get("next_global", 0)))
+        if self._closed:
+            raise MXNetError("PrefetchIter is closed")
+        if not self._stop_worker():
+            raise MXNetError(
+                "PrefetchIter worker did not stop within 5s; cannot "
+                "restore shard safely")
+        self._shard_index = idx
+        self._shard_count = n
+        self._shard_base = boundary
+        self._skip_to = boundary
+        self._boundary = boundary
+        self._exc = None
+        self._done = False
+        self._it.reset()
+        self._queue = _queue.Queue(maxsize=self._depth)
+        self._start()
+        return self
 
     def close(self):
         """Stop and join the worker thread (idempotent). The wrapped
@@ -666,7 +785,13 @@ class PrefetchIter(DataIter):
             if self._exc is not None:
                 raise self._exc
             raise StopIteration
-        return b
+        g, batch = b
+        # consuming our batch of round r means the pod (SPMD lockstep)
+        # consumed every global through the end of that round — THE
+        # value shard_state() banks
+        r = (g - self._shard_base) // self._shard_count
+        self._boundary = self._shard_base + (r + 1) * self._shard_count
+        return batch
 
     def iter_next(self) -> bool:
         raise MXNetError("PrefetchIter supports iteration via next() only")

@@ -40,7 +40,10 @@ from .collectives import (  # noqa: F401
 )
 from .dist import (  # noqa: F401
     finalize, initialize, is_primary, process_count, process_index,
+    process_namespace, world,
 )
+from . import elastic  # noqa: F401
+from .elastic import HostLossError  # noqa: F401
 from .trainer import ShardedTrainer  # noqa: F401
 from .ring import ring_attention, ring_attention_sharded  # noqa: F401
 from .pipeline import pipeline_apply, pipeline_sharded  # noqa: F401

@@ -1,0 +1,532 @@
+"""Elastic multi-host control plane — explicit membership over leases.
+
+Reference counterpart: the dmlc tracker + ps-lite heartbeats
+(``3rdparty/ps-lite``'s ``Van::Heartbeat`` / scheduler timeout), which
+this repo's collective rebuild of the distributed stack (SURVEY §2.5)
+deliberately dropped — and with it the one thing the parameter server
+did better than a bare SPMD pod: *noticing* that a worker died. In the
+multi-controller JAX model a lost host does not produce an error; the
+survivors block inside the next collective forever. This module puts
+the membership signal back, on the transport the runtime already trusts
+for control-plane exchange (the jax coordination-service key-value
+store that :func:`telemetry.collective_ledger.crosscheck` uses):
+
+- **Leases** — every process banks a heartbeat lease under
+  ``mxtpu/elastic/<generation>/lease/<index>`` every
+  ``MXTPU_ELASTIC_HEARTBEAT_S`` seconds (default: a third of the lease).
+  The write is an overwrite of the process's own key — never a
+  collective, never blocking on a peer.
+- **Detection** — the lease watchdog (a daemon thread started by
+  ``dist.initialize`` when ``MXTPU_ELASTIC=1``) scans the lease table
+  each beat. A peer whose newest lease is older than
+  ``MXTPU_ELASTIC_LEASE_S`` is a *detected host loss*: the watchdog
+  trips, every surviving host writes one flight bundle stamped with the
+  dead process index, and :func:`poll` (hooked into
+  ``ShardedTrainer.step``) raises :class:`HostLossError` at the next
+  step boundary — a loud, attributable failure instead of a hung
+  collective.
+- **Recovery** — :func:`recover` is the survivor-side restart:
+  ``fault.checkpoint.load_latest`` through
+  ``ShardedTrainer.restore_checkpoint`` (which re-places host arrays
+  onto the *live* mesh shardings, so the ZeRO-1 opt-state partition and
+  the RNG base key reshard to the new host count — PR 9's
+  cross-mesh-shape resume generalized from a test into the recovery
+  path), plus the checkpointed ``io.PrefetchIter`` shard boundary so
+  per-host data sharding survives the membership change without sample
+  overlap. Each recovery bumps the **restore generation** counter
+  (``MXTPU_ELASTIC_GENERATION`` seeds it across process restarts), which
+  namespaces the lease keys so a restarted pod never reads a dead
+  generation's leases.
+
+Everything is off by default (``MXTPU_ELASTIC`` unset): the trainer
+hook is one :func:`enabled` read, and without a coordination client the
+control plane degrades to a single-member pod. The transport is
+pluggable (:class:`LocalTransport`) so the detection state machine is
+an ordinary unit test — the same philosophy as ``fault.inject``.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..base import MXNetError
+from ..lockcheck import make_lock
+
+__all__ = ["HostLossError", "LocalTransport", "enabled", "configure",
+           "lease_s", "heartbeat_s", "generation", "membership",
+           "start", "stop", "active", "beat", "check", "poll",
+           "snapshot", "recover", "reset"]
+
+_LEASE_PREFIX = "mxtpu/elastic"
+
+_LOCK = make_lock("elastic._LOCK")
+_ON_OVERRIDE: Optional[bool] = None
+_LEASE_OVERRIDE: Optional[float] = None
+_BEAT_OVERRIDE: Optional[float] = None
+_TRANSPORT_OVERRIDE: Optional[Any] = None
+
+
+def _new_state() -> Dict[str, Any]:
+    return {
+        "thread": None,          # the heartbeat/watchdog daemon
+        "stop": None,            # its threading.Event
+        "started_at": None,      # perf-independent wall anchor for grace
+        "beats": 0,              # leases banked by THIS process
+        "stalled_beats": 0,      # beats skipped by the host_stall chaos
+        "lost": set(),           # detected-dead process indices
+        "pending": [],           # losses poll() has not raised yet
+        "bundled": set(),        # indices already stamped into a bundle
+        "leases": {},            # last scanned lease table (idx -> doc)
+        "last_scan": None,       # wall clock of the last check()
+        "recoveries": 0,         # recover() calls in THIS process
+    }
+
+
+_S = _new_state()
+
+
+class HostLossError(MXNetError):
+    """A pod member's lease expired — detected host loss. Carries the
+    dead process indices and the membership generation, so the handler
+    (or the launcher reading the message) can restart the survivors
+    from the last checkpoint instead of hanging in a collective."""
+
+    def __init__(self, lost: List[int], generation: int, lease: float):
+        self.lost = sorted(int(p) for p in lost)
+        self.generation = int(generation)
+        super().__init__(
+            f"elastic: host loss detected — process(es) "
+            f"{self.lost} missed the {lease:g}s heartbeat lease "
+            f"(membership generation {self.generation}). Surviving hosts "
+            "wrote flight bundles stamped with the dead index; restart "
+            "the run on the survivors and restore with "
+            "fault.checkpoint.load_latest (elastic.recover).")
+
+
+# ---------------------------------------------------------------------------
+# knobs
+# ---------------------------------------------------------------------------
+
+def enabled() -> bool:
+    """Elastic control plane on? One env read (``MXTPU_ELASTIC=1``;
+    :func:`configure` overrides)."""
+    if _ON_OVERRIDE is not None:
+        return _ON_OVERRIDE
+    return os.environ.get("MXTPU_ELASTIC", "0") == "1"
+
+
+def lease_s() -> float:
+    """Lease validity window (``MXTPU_ELASTIC_LEASE_S``, default 10):
+    a peer whose newest lease is older than this is a detected loss."""
+    if _LEASE_OVERRIDE is not None:
+        return _LEASE_OVERRIDE
+    try:
+        return max(0.1, float(os.environ.get("MXTPU_ELASTIC_LEASE_S",
+                                             "10")))
+    except ValueError:
+        return 10.0
+
+
+def heartbeat_s() -> float:
+    """Beat interval (``MXTPU_ELASTIC_HEARTBEAT_S``; default a third of
+    the lease, floor 0.05s) — three missed beats expire a lease."""
+    if _BEAT_OVERRIDE is not None:
+        return _BEAT_OVERRIDE
+    raw = os.environ.get("MXTPU_ELASTIC_HEARTBEAT_S", "")
+    if raw:
+        try:
+            return max(0.05, float(raw))
+        except ValueError:
+            pass
+    return max(0.05, lease_s() / 3.0)
+
+
+def generation() -> int:
+    """The restore-generation counter: ``MXTPU_ELASTIC_GENERATION``
+    (stamped by the launcher on each elastic restart) plus the in-process
+    :func:`recover` count. Namespaces the lease keys, rides checkpoint
+    meta, and is the postmortem's "how many times has this run come back
+    from the dead" number."""
+    try:
+        base = int(os.environ.get("MXTPU_ELASTIC_GENERATION", "0"))
+    except ValueError:
+        base = 0
+    with _LOCK:
+        return base + _S["recoveries"]
+
+
+def configure(on: Optional[bool] = None,
+              lease: Optional[float] = None,
+              heartbeat: Optional[float] = None,
+              transport: Optional[Any] = None) -> None:
+    """Programmatic override of the env knobs and (for tests/drills) the
+    lease transport. Calling with no arguments clears every override."""
+    global _ON_OVERRIDE, _LEASE_OVERRIDE, _BEAT_OVERRIDE, \
+        _TRANSPORT_OVERRIDE
+    if on is None and lease is None and heartbeat is None \
+            and transport is None:
+        _ON_OVERRIDE = _LEASE_OVERRIDE = _BEAT_OVERRIDE = None
+        _TRANSPORT_OVERRIDE = None
+        return
+    if on is not None:
+        _ON_OVERRIDE = bool(on)
+    if lease is not None:
+        _LEASE_OVERRIDE = max(0.1, float(lease))
+    if heartbeat is not None:
+        _BEAT_OVERRIDE = max(0.01, float(heartbeat))
+    if transport is not None:
+        _TRANSPORT_OVERRIDE = transport
+
+
+# ---------------------------------------------------------------------------
+# transports
+# ---------------------------------------------------------------------------
+
+class _KVTransport:
+    """The production transport: the jax coordination-service KV store
+    (the same client ``collective_ledger.crosscheck`` exchanges digest
+    tables over). Lease refreshes overwrite the process's own key;
+    scans are non-blocking directory reads — absence is data, never a
+    hang."""
+
+    def __init__(self, client, index: int, count: int):
+        self._client = client
+        self.index = int(index)
+        self.count = int(count)
+
+    def put(self, key: str, value: str) -> None:
+        try:
+            self._client.key_value_set(key, value, allow_overwrite=True)
+        except TypeError:
+            # older coordination clients lack allow_overwrite: emulate
+            # the refresh as delete-then-set (only this process ever
+            # writes its own lease key, so the window is benign)
+            try:
+                self._client.key_value_delete(key)
+            except Exception:  # noqa: BLE001 — first write has no key
+                pass
+            self._client.key_value_set(key, value)
+
+    def scan(self, prefix: str) -> Dict[str, str]:
+        try:
+            return dict(self._client.key_value_dir_get(prefix))
+        except Exception:  # noqa: BLE001 — empty dir raises on some builds
+            return {}
+
+
+class LocalTransport:
+    """Dict-backed transport simulating an N-process pod inside one
+    process (unit tests, the detection-state-machine drills). Share one
+    ``store`` dict across N instances, one per simulated process."""
+
+    def __init__(self, store: Optional[Dict[str, str]] = None,
+                 index: int = 0, count: int = 1):
+        self.store = store if store is not None else {}
+        self.index = int(index)
+        self.count = int(count)
+
+    def put(self, key: str, value: str) -> None:
+        self.store[key] = value
+
+    def scan(self, prefix: str) -> Dict[str, str]:
+        return {k: v for k, v in self.store.items()
+                if k.startswith(prefix)}
+
+
+def _transport() -> Optional[Any]:
+    """The active transport: a configured override, else the live
+    coordination client, else None (single-member pod)."""
+    if _TRANSPORT_OVERRIDE is not None:
+        return _TRANSPORT_OVERRIDE
+    from ..telemetry.collective_ledger import _coord
+    client, idx, n = _coord()
+    if client is None:
+        return None
+    return _KVTransport(client, idx, n)
+
+
+def membership() -> Tuple[int, int]:
+    """``(process_index, process_count)`` as the control plane sees it."""
+    t = _transport()
+    if t is None:
+        from ..telemetry.collective_ledger import _coord
+        _, idx, n = _coord()
+        return idx, n
+    return t.index, t.count
+
+
+def _lease_key_prefix(gen: int) -> str:
+    return f"{_LEASE_PREFIX}/{gen}/lease/"
+
+
+# ---------------------------------------------------------------------------
+# heartbeats + detection
+# ---------------------------------------------------------------------------
+
+def beat(step: Optional[int] = None) -> bool:
+    """Bank one heartbeat lease for this process (overwrite of its own
+    key). Returns False when there is nothing to bank (no transport, or
+    the seeded ``host_stall`` chaos knob is holding the beat back while
+    the process keeps running — the nastier failure mode the lease
+    watchdog must catch). The payload carries the per-host goodput
+    collective share, so the lease table doubles as the straggler gauge:
+    a slow host is visible in its peers' membership snapshot *before*
+    it becomes a failure."""
+    t = _transport()
+    if t is None:
+        return False
+    from ..fault import inject as _inject
+    if _inject.heartbeat_stalled():
+        with _LOCK:
+            _S["stalled_beats"] += 1
+        return False
+    from ..telemetry import goodput as _goodput
+    from ..telemetry.export import dumps_strict
+    with _LOCK:
+        _S["beats"] += 1
+        n_beats = _S["beats"]
+    doc = {"t": time.time(), "step": step, "beats": n_beats,
+           "pid": os.getpid(), "generation": generation(),
+           "collective_ms": round(_goodput.collective_ms(), 3)}
+    try:
+        t.put(_lease_key_prefix(generation()) + str(t.index),
+              dumps_strict(doc, sort_keys=True))
+    except Exception as e:  # noqa: BLE001 — a dying KV store must not
+        import warnings     # kill the beater before detection can run
+        warnings.warn(f"[elastic] lease write failed: {e}")
+        return False
+    return True
+
+
+def check(raise_on_loss: bool = True,
+          now: Optional[float] = None) -> Dict[str, Any]:
+    """Scan the lease table and classify every pod member. Returns the
+    membership snapshot; on newly expired peers the watchdog trips —
+    one ``elastic.host_loss`` event + one flight bundle per dead index
+    per surviving process — and raises :class:`HostLossError` unless
+    ``raise_on_loss=False`` (the daemon thread's mode: it records the
+    loss for :func:`poll` to surface at the next step boundary)."""
+    t = _transport()
+    if t is None or t.count <= 1:
+        return snapshot()
+    from ..telemetry.export import loads_strict
+    now = time.time() if now is None else now
+    lease = lease_s()
+    raw = t.scan(_lease_key_prefix(generation()))
+    table: Dict[int, Dict[str, Any]] = {}
+    for key, blob in raw.items():
+        try:
+            idx = int(key.rsplit("/", 1)[-1])
+            doc = loads_strict(blob)
+        except (ValueError, TypeError):
+            continue
+        doc["age_s"] = round(max(now - float(doc.get("t") or 0.0), 0.0), 3)
+        table[idx] = doc
+    with _LOCK:
+        started = _S["started_at"]
+        _S["leases"] = table
+        _S["last_scan"] = now
+        fresh: List[int] = []
+        for p in range(t.count):
+            if p == t.index or p in _S["lost"]:
+                continue
+            ent = table.get(p)
+            if ent is None:
+                # a peer that never banked: grace-period it from the
+                # watchdog's own start, so a slow rendezvous is not a
+                # false positive
+                if started is not None and now - started > lease:
+                    fresh.append(p)
+                continue
+            if ent["age_s"] > lease:
+                fresh.append(p)
+        _S["lost"].update(fresh)
+        _S["pending"].extend(fresh)
+    if fresh:
+        _trip(fresh)
+    if raise_on_loss:
+        poll()
+    return snapshot()
+
+
+def _trip(lost: List[int]) -> None:
+    """The detection path: event + counter + one flight bundle per dead
+    process index (stamped with it), exactly once per index per
+    surviving process — a crash loop re-detecting the same corpse must
+    not storm the recorder."""
+    from ..telemetry import events as _events
+    from ..telemetry import flight as _flight
+    from ..telemetry import metrics as _metrics
+    snap = snapshot()
+    for p in sorted(lost):
+        _events.emit("elastic.host_loss", severity="error",
+                     lost_process=p, generation=snap["generation"],
+                     lease_s=snap["lease_s"])
+        _metrics.counter("mxtpu_elastic_host_loss_total",
+                         "Detected host losses (expired leases)").inc()
+        with _LOCK:
+            first = p not in _S["bundled"]
+            _S["bundled"].add(p)
+        if first:
+            _flight.dump("host_loss", site="elastic.check",
+                         lost_process=p, membership=snap)
+
+
+def poll() -> None:
+    """The trainer-hot-path hook: raise :class:`HostLossError` iff the
+    lease watchdog detected a loss since the last poll. One lock-free
+    list read when nothing happened; never any I/O."""
+    if not _S["pending"]:
+        return
+    with _LOCK:
+        pending = list(_S["pending"])
+        _S["pending"].clear()
+    if pending:
+        raise HostLossError(pending, generation(), lease_s())
+
+
+# ---------------------------------------------------------------------------
+# the heartbeat daemon
+# ---------------------------------------------------------------------------
+
+def start() -> bool:
+    """Start the heartbeat/lease-watchdog daemon (idempotent). Banks the
+    first lease synchronously so a peer scanning right after its own
+    start sees us. No-op (False) when elastic is off or the pod has a
+    single member."""
+    if not enabled():
+        return False
+    t = _transport()
+    if t is None or t.count <= 1:
+        return False
+    with _LOCK:
+        th = _S["thread"]
+        if th is not None and th.is_alive():
+            return True
+        _S["started_at"] = time.time()
+        stop_ev = _S["stop"] = threading.Event()
+    beat()
+
+    def _run() -> None:
+        while not stop_ev.wait(heartbeat_s()):
+            try:
+                beat()
+                check(raise_on_loss=False)
+            except Exception as e:  # noqa: BLE001 — the watchdog must
+                import warnings     # outlive transient transport faults
+                warnings.warn(f"[elastic] heartbeat tick failed: {e}")
+
+    th = threading.Thread(target=_run, name="mx-elastic-heartbeat",
+                          daemon=True)
+    with _LOCK:
+        _S["thread"] = th
+    th.start()
+    from ..telemetry import events as _events
+    _events.emit("elastic.start", generation=generation(),
+                 process_index=t.index, process_count=t.count,
+                 lease_s=lease_s(), heartbeat_s=heartbeat_s())
+    return True
+
+
+def stop() -> None:
+    """Stop the daemon (idempotent; ``dist.finalize`` calls this)."""
+    with _LOCK:
+        th, ev = _S["thread"], _S["stop"]
+        _S["thread"] = _S["stop"] = None
+    if ev is not None:
+        ev.set()
+    if th is not None and th.is_alive():
+        th.join(timeout=2.0)
+
+
+def active() -> bool:
+    th = _S["thread"]
+    return th is not None and th.is_alive()
+
+
+# ---------------------------------------------------------------------------
+# recovery
+# ---------------------------------------------------------------------------
+
+def recover(trainer, root: str, data_iter=None,
+            step: Optional[int] = None) -> int:
+    """The survivor-side restart: restore ``trainer`` from the newest
+    complete checkpoint under ``root`` (``fault.checkpoint.load_latest``
+    → :meth:`ShardedTrainer.restore_checkpoint`, which re-places every
+    host array onto the live mesh shardings — the ZeRO-1 opt-state
+    partition and the RNG base key reshard to the surviving host count),
+    then restore the data iterator's host-shard boundary from the
+    checkpoint meta under the NEW membership so the resumed stream
+    overlaps no consumed sample. Bumps the restore generation and emits
+    one ``elastic.restore`` event. Returns the restored step."""
+    restored = trainer.restore_checkpoint(root, step=step)
+    meta = getattr(trainer, "last_restore_meta", None) or {}
+    if data_iter is not None and meta.get("data_state"):
+        idx, count = membership()
+        data_iter.restore_shard(meta["data_state"], index=idx,
+                                count=count)
+    with _LOCK:
+        _S["recoveries"] += 1
+        # a recovered pod is a new membership: dead indices from the old
+        # generation must not poison the new lease table
+        _S["lost"].clear()
+        _S["pending"].clear()
+        _S["bundled"].clear()
+        _S["leases"] = {}
+    gen = generation()
+    from ..telemetry import events as _events
+    from ..telemetry import metrics as _metrics
+    idx, count = membership()
+    _events.emit("elastic.restore", step=restored, generation=gen,
+                 process_index=idx, process_count=count)
+    _metrics.gauge("mxtpu_elastic_generation",
+                   "Elastic restore generation").set(gen)
+    return restored
+
+
+# ---------------------------------------------------------------------------
+# snapshot / reset
+# ---------------------------------------------------------------------------
+
+def snapshot() -> Dict[str, Any]:
+    """The membership section of ``telemetry.snapshot()``, flight
+    bundles, and ``tools/postmortem.py``: the lease table with last
+    heartbeat ages, the elected primary, detected losses, and the
+    restore generation counter."""
+    idx, count = membership()
+    # knobs resolve BEFORE the lock: generation() takes _LOCK itself
+    on, act = enabled(), active()
+    gen, lease, hb = generation(), lease_s(), heartbeat_s()
+    with _LOCK:
+        leases = {str(p): dict(doc) for p, doc in
+                  sorted(_S["leases"].items())}
+        lost = sorted(_S["lost"])
+        doc = {
+            "enabled": on,
+            "active": act,
+            "process": {"index": idx, "count": count},
+            "generation": gen,
+            "lease_s": lease,
+            "heartbeat_s": hb,
+            "beats": _S["beats"],
+            "stalled_beats": _S["stalled_beats"],
+            "leases": leases,
+            "lost": lost,
+            "last_scan": _S["last_scan"],
+            # the elected primary under membership change: the lowest
+            # surviving index (process 0 unless it is the corpse)
+            "elected": next((p for p in range(count) if p not in lost),
+                            0),
+        }
+    return doc
+
+
+def reset() -> None:
+    """Stop the daemon and drop all state including overrides (tests)."""
+    global _S
+    stop()
+    with _LOCK:
+        _S = _new_state()
+    configure()

@@ -694,6 +694,13 @@ class ShardedTrainer:
         from ..telemetry import compile_log as _clog
         from ..telemetry import events as _tele
         t_step0 = time.perf_counter()
+        # elastic step-boundary hooks: poll() surfaces any host loss the
+        # lease watchdog detected since the last step (one lock-free list
+        # read when the pod is healthy — never I/O on the hot path), and
+        # note_step drives the seeded host_kill/host_stall chaos knobs
+        from . import elastic as _elastic
+        _elastic.poll()
+        _inject.note_step(self._t + 1)
         if _inject.active() is not None:
             # the poisoned batch belongs to the step about to run — bind
             # its id so the chaos event and the guard verdict correlate
@@ -1110,16 +1117,21 @@ class ShardedTrainer:
     # ------------------------------------------------------------------
     _CKPT_FORMAT = 1
 
-    def save_checkpoint(self, root: str, keep: Optional[int] = 3) -> str:
+    def save_checkpoint(self, root: str, keep: Optional[int] = 3,
+                        data_state: Optional[dict] = None) -> str:
         """Write one atomic, versioned checkpoint directory under ``root``
         covering EVERYTHING a bit-identical resume needs: parameters,
         optimizer state (incl. ZeRO-1 shards — gathered to host, resharded
         on load), the step counter, the LR-schedule position, and the RNG
         base key. Returns the checkpoint directory; retention keeps the
-        newest ``keep`` steps. Call it from the training loop::
+        newest ``keep`` steps. ``data_state`` (an
+        ``io.PrefetchIter.shard_state()`` dict) rides in the meta so an
+        elastic restore can resume the data stream under a new host count
+        with no sample overlap. Call it from the training loop::
 
             if trainer.num_update % 500 == 0:
-                trainer.save_checkpoint("ckpts/")
+                trainer.save_checkpoint("ckpts/",
+                                        data_state=it.shard_state())
         """
         if self._params is None:
             raise MXNetError("nothing to checkpoint: run step() at least "
@@ -1145,6 +1157,12 @@ class ShardedTrainer:
             "param_names": [name for name, _ in items],
             "opt_state_sizes": [len(s) for s in self._opt_states],
         }
+        if data_state is not None:
+            meta["data_state"] = dict(data_state)
+        from . import elastic as _elastic
+        idx, count = _elastic.membership()
+        meta["elastic"] = {"generation": _elastic.generation(),
+                           "process_count": count}
         return ckpt.save_checkpoint(root, arrays, meta, step=self._t,
                                     keep=keep)
 
@@ -1213,6 +1231,9 @@ class ShardedTrainer:
                 jnp.asarray(arrays["rng:base_key"]),
                 impl=meta.get("rng_impl") or random_mod._impl())
         self._snapshot = None        # stale rollback state from before
+        # banked for elastic.recover: the data-shard boundary + the saving
+        # membership live in the meta, not in any trainer array
+        self.last_restore_meta = dict(meta)
         return step
 
     def _load_states_orbax(self, path: str) -> None:

@@ -123,8 +123,20 @@ def snapshot(recent: int = 5) -> Dict:
         # the dispatch ring, and crosscheck state (the SPMD divergence
         # detector; empty-shaped when the ledger is off)
         "collective_schedule": collective_ledger.snapshot(),
+        "membership": _membership_snapshot(),
     }
     return sanitize(doc)
+
+
+def _membership_snapshot() -> Dict:
+    """The elastic control plane's lease table / election / generation
+    (``parallel.elastic.snapshot``) — imported lazily so the telemetry
+    package never pulls the parallel stack at import time."""
+    try:
+        from ..parallel import elastic as _elastic
+        return _elastic.snapshot()
+    except Exception as e:  # noqa: BLE001 — degrade like flight sections
+        return {"error": repr(e)}
 
 
 def reset() -> None:
@@ -139,3 +151,5 @@ def reset() -> None:
     numerics.reset()
     goodput.reset()
     collective_ledger.reset()
+    from ..parallel import elastic as _elastic
+    _elastic.reset()

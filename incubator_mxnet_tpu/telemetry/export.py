@@ -78,7 +78,15 @@ class JsonlSink:
     """Bus subscriber writing one strict-JSON line per event, with
     size-based rotation (``path`` -> ``path.1``, one generation — bounded
     disk like the rings bound memory). Thread-safe; install with
-    ``telemetry.subscribe(sink)`` or :func:`install_jsonl`."""
+    ``telemetry.subscribe(sink)`` or :func:`install_jsonl`.
+
+    Multi-host: the configured ``path`` belongs to the elected primary
+    (the MX902 invariant — one owner per shared file); every other host
+    writes the SAME stream to its own namespaced file
+    (``path.p<index>``, ``dist.process_namespace``). N hosts → N
+    disjoint, individually valid streams: per-host forensics with zero
+    shared-file races, and a host-loss postmortem still has the dead
+    host's events up to its last flush."""
 
     def __init__(self, path: str, max_mb: Optional[float] = None):
         from ..util import getenv
@@ -90,12 +98,14 @@ class JsonlSink:
         self._fh = None
         self._started = False
         self._primary: Optional[bool] = None
+        self._out_path: Optional[str] = None
         self.lines = 0
 
     def elected(self) -> bool:
         """Host-0 election (the MX902 invariant): under SPMD every
         process emits the same events, but only the elected host may own
-        a shared JSONL path — the rest no-op. Always True single-process
+        the *configured* path — the rest own their namespaced one (see
+        :meth:`stream_path`). Always True single-process
         (``parallel.dist.is_primary`` is a no-op election there), cached
         at the first event so the per-event cost is one attribute read."""
         if self._primary is None:
@@ -106,21 +116,39 @@ class JsonlSink:
                 self._primary = True
         return self._primary
 
+    def stream_path(self) -> str:
+        """This process's actual output file: the configured ``path`` on
+        the elected primary (and always single-process), ``path.p<idx>``
+        on every other host. Cached with the election."""
+        if self._out_path is None:
+            out = self.path
+            if not self.elected():
+                try:
+                    from ..parallel.dist import process_namespace
+                    ns = process_namespace()
+                except Exception:  # noqa: BLE001 — no dist runtime
+                    ns = ""
+                if ns:
+                    out = f"{self.path}.{ns}"
+            self._out_path = out
+        return self._out_path
+
     def __call__(self, event) -> None:
-        if not self.elected():
-            return
+        path = self.stream_path()
         line = dumps_strict(event.to_dict(), sort_keys=True)
         with self._lock:
             try:
                 if self._fh is None:
-                    d = os.path.dirname(os.path.abspath(self.path))
+                    d = os.path.dirname(os.path.abspath(path))
                     os.makedirs(d, exist_ok=True)
                     # first open truncates: seq numbers restart per
                     # process, so appending to a previous run's file would
                     # read as corruption (duplicate seqs) to
                     # tools/telemetry_check.py; reopens within one run
-                    # (after rotation/close) append
-                    self._fh = open(self.path,
+                    # (after rotation/close) append. The path is
+                    # per-process by construction (stream_path), so the
+                    # write needs no further election.
+                    self._fh = open(path,  # mxlint: disable=MX902
                                     "a" if self._started else "w",
                                     encoding="utf-8")
                     self._started = True
@@ -145,9 +173,10 @@ class JsonlSink:
     def _rotate(self) -> None:
         self._fh.close()
         self._fh = None
-        # reached only from the elected writer's __call__ (the election
-        # is per-sink, not per-method — statically unprovable from here)
-        os.replace(self.path, self.path + ".1")  # mxlint: disable=MX902
+        path = self.stream_path()
+        # the rotated name is per-process too (stream_path) — one owner
+        # per file, statically unprovable from here
+        os.replace(path, path + ".1")  # mxlint: disable=MX902
 
     def close(self) -> None:
         with self._lock:

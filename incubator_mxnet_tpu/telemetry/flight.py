@@ -78,10 +78,19 @@ _ENV_PREFIXES = ("MXTPU_", "MXNET_", "DMLC_", "JAX_", "XLA_")
 
 def flight_dir() -> Optional[str]:
     """The bundle directory (``MXTPU_FLIGHT_DIR``; :func:`set_dir`
-    overrides), or None = recorder off."""
-    if _DIR_OVERRIDE is not None:
-        return _DIR_OVERRIDE or None
-    return os.environ.get("MXTPU_FLIGHT_DIR") or None
+    overrides), or None = recorder off. In a multi-host run the
+    configured directory grows a per-process subdirectory
+    (``<dir>/p<index>`` — ``dist.process_namespace``): every host keeps
+    its own forensics with zero shared-file races, and the host-loss
+    drill can assert "exactly one bundle per *surviving* host" by
+    namespace."""
+    base = _DIR_OVERRIDE if _DIR_OVERRIDE is not None \
+        else os.environ.get("MXTPU_FLIGHT_DIR")
+    if not base:
+        return None
+    from ..parallel.dist import process_namespace
+    ns = process_namespace()
+    return os.path.join(base, ns) if ns else base
 
 
 def set_dir(path: Optional[str]) -> None:
@@ -177,6 +186,8 @@ def bundle(reason: str, /, site: Optional[str] = None, **context) -> Dict:
     # ring — a crosscheck-mismatch bundle shows WHICH site/signature this
     # process compiled differently from its peers
     section("collective_schedule", collective_ledger.snapshot)
+    from ..parallel import elastic as _elastic
+    section("membership", _elastic.snapshot)
     section("env", lambda: {k: v for k, v in sorted(os.environ.items())
                             if k.startswith(_ENV_PREFIXES)})
     section("config", lambda: _config())
@@ -237,7 +248,10 @@ def dump(reason: str, /, site: Optional[str] = None, **context
             d, f"flight-{stamp}-{safe}-p{os.getpid()}-{seq}.json")
         tmp = f"{path}.tmp-{os.getpid()}-{threading.get_ident()}"
         try:
-            with open(tmp, "w", encoding="utf-8") as f:
+            # per-host divergence is the design: flight_dir() is
+            # namespaced per process and the name carries the pid
+            with open(tmp, "w",             # mxlint: disable=MX902
+                      encoding="utf-8") as f:
                 f.write(blob + "\n")
                 f.flush()
                 os.fsync(f.fileno())
@@ -245,7 +259,7 @@ def dump(reason: str, /, site: Optional[str] = None, **context
             # name is not — atomicity means readers never see a torn
             # bundle however exactly this process dies
             _inject.crash("flight.dump")
-            os.replace(tmp, path)
+            os.replace(tmp, path)       # mxlint: disable=MX902
         except ChaosCrash:
             # the simulated SIGKILL: a real one cannot run cleanup, so
             # neither does the simulation — the ``.tmp-*`` file stays

@@ -82,7 +82,7 @@ from ..lockcheck import make_lock
 
 __all__ = ["CATEGORIES", "enabled", "configure", "begin", "begin_from_env",
            "note", "note_step", "set_cost_profile", "cost_profile", "price",
-           "report", "snapshot", "reset", "window_steps"]
+           "collective_ms", "report", "snapshot", "reset", "window_steps"]
 
 #: the attribution vector, in triage order (docs/observability.md §6):
 #: an operator works the list top-down — input starvation first, host
@@ -218,6 +218,16 @@ def note(category: str, dur_ms: float) -> None:
         _S["gap_notes_ms"] += dur_ms
         if category == "checkpoint":
             _S["checkpoints"] += 1
+
+
+def collective_ms() -> float:
+    """Cumulative wall attributed to the ``collective`` bucket — the
+    per-host straggler signal the elastic heartbeat banks with each
+    lease, so a host whose collectives are slow is a *gauge* on its
+    peers' lease tables before it is a detected failure. 0.0 when the
+    ledger is off."""
+    with _LOCK:
+        return float(_S["ms"].get("collective", 0.0))
 
 
 def _collective_fraction() -> float:
@@ -528,6 +538,12 @@ def report() -> Dict[str, Any]:
         doc["classification"] = _classify(_S["ms"])
         doc["mfu"] = _mfu(wall_ms, _S["good_steps"])
         doc["cost_profile"] = dict(_S["cost"]) if _S["cost"] else None
+    # per-host attribution stamp: N hosts emit N ledgers (namespaced
+    # JSONL), and the process pair is what lets a straggler host be
+    # singled out when the reports are laid side by side
+    from ..parallel.dist import world
+    idx, count = world()
+    doc["process"] = {"index": idx, "count": count}
     return doc
 
 

@@ -175,6 +175,17 @@ ENV_VARS: Dict[str, tuple] = {
     "MXTPU_BENCH_MODEL": ("bert_12_768_12", "bench.py model config."),
     "MXTPU_BENCH_TRACE": ("", "bench.py: capture one profiled step into this "
                           "directory (jax.profiler trace)."),
+    "MXTPU_BENCH_RETRIES": ("1", "bench.py device-init watchdog: extra "
+                            "bounded windows granted after the first "
+                            "MXTPU_BENCH_TIMEOUT expiry before aborting "
+                            "with rc=75 (0 = abort on the first expiry). "
+                            "The abort record's 'attempts' field counts "
+                            "the windows waited."),
+    "MXTPU_BENCH_RETRY_BACKOFF_S": ("60", "Seconds ADDED to the watchdog "
+                                    "budget for each retry window — a "
+                                    "pool grant that lands late becomes "
+                                    "a recovered round, not a blind "
+                                    "one."),
     "MXTPU_PEAK_TFLOPS": ("", "Override per-chip peak for MFU accounting."),
     "MXTPU_FLASH_ATTENTION": ("1", "Enable the Pallas flash-attention path."),
     "MXTPU_FLASH_BK": ("", "Flash-attention key/value block size override "
@@ -366,6 +377,32 @@ ENV_VARS: Dict[str, tuple] = {
                                           "blobs during a crosscheck "
                                           "before declaring the "
                                           "exchange failed."),
+    "MXTPU_ELASTIC": ("0", "Master switch for the elastic multi-host "
+                      "control plane (parallel.elastic): 1 starts the "
+                      "heartbeat-lease daemon at dist.initialize(), so "
+                      "a host that dies mid-run is a detected loss "
+                      "(flight bundle + HostLossError at the next step "
+                      "boundary) instead of a pod hung inside a "
+                      "collective. Off costs one env read."),
+    "MXTPU_ELASTIC_LEASE_S": ("10", "Heartbeat-lease validity window: a "
+                              "pod member whose newest lease is older "
+                              "than this is a detected host loss."),
+    "MXTPU_ELASTIC_HEARTBEAT_S": ("", "Beat interval of the lease "
+                                  "daemon; unset = a third of the lease "
+                                  "(three missed beats expire it)."),
+    "MXTPU_ELASTIC_GENERATION": ("0", "Restore-generation counter, "
+                                 "stamped by the launcher on each "
+                                 "elastic restart: namespaces the lease "
+                                 "keys so a restarted pod never reads a "
+                                 "dead generation's leases, and rides "
+                                 "checkpoint meta."),
+    "MXTPU_ELASTIC_COMMIT_TIMEOUT_S": ("60", "Bound on the primary's "
+                                       "wait for every peer's commit "
+                                       "marker during a multi-host "
+                                       "checkpoint save; expiry raises "
+                                       "CheckpointError naming the "
+                                       "missing process indices instead "
+                                       "of hanging the save."),
     "MXTPU_SLO_WINDOWS": ("60:14.4,300:6", "Burn-rate alert windows as "
                           "'seconds:threshold,...' — every window must "
                           "burn over its threshold at once to page "

@@ -112,28 +112,76 @@ def test_watchdog_abort_record_is_structured(monkeypatch):
     json.loads(json.dumps(rec))  # strictly serializable
 
 
-def test_watchdog_fire_emits_json_line_before_exit(monkeypatch, capsys):
-    """The timer path itself: _fire must print the record as the last
-    stdout line before os._exit(75)."""
+def _load_bench(name):
     import importlib.util
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     spec = importlib.util.spec_from_file_location(
-        "bench_wd2", os.path.join(repo, "bench.py"))
+        name, os.path.join(repo, "bench.py"))
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
+    return mod
+
+
+def test_watchdog_fire_emits_json_line_before_exit(monkeypatch, capsys):
+    """The timer path itself: with retries exhausted (0 configured),
+    _fire must print the record as the last stdout line before
+    os._exit(75)."""
+    mod = _load_bench("bench_wd2")
     monkeypatch.setenv("MXTPU_BENCH_TIMEOUT", "1200")
+    monkeypatch.setenv("MXTPU_BENCH_RETRIES", "0")
     exits = []
     monkeypatch.setattr(mod.os, "_exit", lambda rc: exits.append(rc))
-    timer = mod._arm_watchdog()
-    assert timer is not None
+    wd = mod._arm_watchdog()
+    assert wd is not None
     try:
-        timer.cancel()            # don't let the real 1200s timer linger
-        timer.function()          # fire the callback synchronously
+        wd._timer.cancel()        # don't let the real 1200s timer linger
+        wd._fire()                # fire the callback synchronously
     finally:
-        timer.cancel()
+        wd.cancel()
     assert exits == [75]
     out = capsys.readouterr()
     rec = json.loads(out.out.strip().splitlines()[-1])
     assert rec["error"] == "device_init_timeout"
     assert rec["extra"]["timeout_s"] == 1200
+    assert rec["attempts"] == 1   # no retry window was configured
     assert "watchdog" in out.err
+
+
+def test_watchdog_retry_rearms_once_then_aborts(monkeypatch, capsys):
+    """Satellite (ISSUE 17): the first expired window re-arms ONE bounded
+    retry (budget + backoff) instead of aborting — a pool grant that
+    lands late is a recovered round — and only the second expiry prints
+    the abort record, with the attempts count."""
+    mod = _load_bench("bench_wd3")
+    monkeypatch.setenv("MXTPU_BENCH_TIMEOUT", "1200")
+    monkeypatch.setenv("MXTPU_BENCH_RETRIES", "1")
+    monkeypatch.setenv("MXTPU_BENCH_RETRY_BACKOFF_S", "30")
+    exits = []
+    monkeypatch.setattr(mod.os, "_exit", lambda rc: exits.append(rc))
+    wd = mod._arm_watchdog()
+    try:
+        wd._timer.cancel()
+        wd._fire()                # window 1 expires → re-arm, no abort
+        assert exits == [] and wd.attempts == 2
+        err = capsys.readouterr().err
+        assert "re-arming" in err and "1230" in err  # budget + backoff
+        wd._timer.cancel()        # the re-armed retry timer
+        wd._fire()                # window 2 expires → abort
+    finally:
+        wd.cancel()
+    assert exits == [75]
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["attempts"] == 2
+
+
+def test_watchdog_cancel_wins_over_late_fire(monkeypatch, capsys):
+    """A result that lands while the timer is in flight must win: a
+    cancelled watchdog's _fire is a no-op, never an exit."""
+    mod = _load_bench("bench_wd4")
+    monkeypatch.setenv("MXTPU_BENCH_TIMEOUT", "1200")
+    exits = []
+    monkeypatch.setattr(mod.os, "_exit", lambda rc: exits.append(rc))
+    wd = mod._arm_watchdog()
+    wd.cancel()
+    wd._fire()
+    assert exits == [] and capsys.readouterr().out == ""

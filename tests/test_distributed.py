@@ -363,14 +363,47 @@ class TestElection:
         assert is_primary() is False
 
     def test_jsonl_sink_elects(self, monkeypatch, tmp_path):
-        # a non-primary process's sink must write NOTHING (MX902's fix)
+        # the MX902 fix, elastic edition: only the primary owns the
+        # CONFIGURED path; a non-primary host writes the same stream to
+        # its own namespaced file (per-host forensics, zero shared-file
+        # races) instead of dropping its events on the floor
         from incubator_mxnet_tpu.telemetry import events as tele
         from incubator_mxnet_tpu.telemetry.export import JsonlSink
         monkeypatch.setenv("DMLC_WORKER_ID", "1")
+        monkeypatch.setenv("DMLC_NUM_WORKER", "2")
         path = str(tmp_path / "events.jsonl")
         sink = JsonlSink(path)
+        assert sink.elected() is False
+        assert sink.stream_path() == path + ".p1"
         sink(tele.emit("test.election"))
-        assert sink.lines == 0 and not os.path.exists(path)
+        assert sink.lines == 1
+        assert not os.path.exists(path)          # configured path untouched
+        assert os.path.exists(path + ".p1")      # namespaced stream written
+
+    def test_jsonl_sink_primary_owns_configured_path(self, monkeypatch,
+                                                     tmp_path):
+        from incubator_mxnet_tpu.telemetry import events as tele
+        from incubator_mxnet_tpu.telemetry.export import JsonlSink
+        monkeypatch.setenv("DMLC_WORKER_ID", "0")
+        monkeypatch.setenv("DMLC_NUM_WORKER", "2")
+        path = str(tmp_path / "events.jsonl")
+        sink = JsonlSink(path)
+        assert sink.elected() is True and sink.stream_path() == path
+        sink(tele.emit("test.election"))
+        assert sink.lines == 1 and os.path.exists(path)
+
+    def test_flight_dir_namespaces_per_process(self, monkeypatch, tmp_path):
+        from incubator_mxnet_tpu.telemetry import flight
+        monkeypatch.setenv("MXTPU_FLIGHT_DIR", str(tmp_path / "fl"))
+        monkeypatch.setenv("DMLC_WORKER_ID", "1")
+        monkeypatch.setenv("DMLC_NUM_WORKER", "2")
+        assert flight.flight_dir() == str(tmp_path / "fl" / "p1")
+        monkeypatch.setenv("DMLC_WORKER_ID", "0")
+        assert flight.flight_dir() == str(tmp_path / "fl" / "p0")
+        # single-process: the configured dir, no namespace subdir
+        monkeypatch.delenv("DMLC_WORKER_ID", raising=False)
+        monkeypatch.delenv("DMLC_NUM_WORKER", raising=False)
+        assert flight.flight_dir() == str(tmp_path / "fl")
 
     def test_checkpoint_save_elects(self, monkeypatch, tmp_path):
         import numpy as onp

@@ -1,0 +1,199 @@
+"""parallel.elastic — the elastic multi-host control plane (ISSUE 17).
+
+The detection state machine on the dict-backed :class:`LocalTransport`
+(an N-process pod simulated in one process — same philosophy as
+``fault.inject``): lease banking, loss detection with the rendezvous
+grace period, exactly-once flight bundles, the ``host_stall`` chaos
+knob, generation namespacing, and the snapshot/election surface. The
+REAL 2-process exchange is CI's elastic-drill job
+(``tools/multichip_smoke.py --dist`` + ``tools/elastic_smoke.py``).
+"""
+import json
+import os
+import time
+
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu.fault import inject
+from incubator_mxnet_tpu.parallel import elastic
+from incubator_mxnet_tpu.parallel.elastic import (HostLossError,
+                                                  LocalTransport)
+from incubator_mxnet_tpu.telemetry import flight
+
+
+@pytest.fixture(autouse=True)
+def _clean_elastic():
+    """Control-plane state must never leak across tests."""
+    elastic.reset()
+    inject.disable()
+    flight.set_dir("")
+    yield
+    elastic.reset()
+    inject.disable()
+    flight.set_dir(None)
+
+
+def _pod(index=0, count=2, lease=0.5):
+    """One simulated pod member wired into the module singleton."""
+    store = {}
+    t = LocalTransport(store, index=index, count=count)
+    elastic.configure(on=True, lease=lease, heartbeat=0.1, transport=t)
+    return t, store
+
+
+def _peer_lease(store, index, t=None, gen=0):
+    """Bank a lease on a simulated PEER's behalf."""
+    store[f"mxtpu/elastic/{gen}/lease/{index}"] = json.dumps(
+        {"t": time.time() if t is None else t, "step": None, "beats": 1,
+         "pid": 0, "generation": gen, "collective_ms": 0.0})
+
+
+def test_disabled_by_default(monkeypatch):
+    monkeypatch.delenv("MXTPU_ELASTIC", raising=False)
+    assert elastic.enabled() is False
+    assert elastic.start() is False
+    assert elastic.active() is False
+    elastic.poll()   # no-op, never raises
+
+
+def test_beat_banks_own_lease():
+    t, store = _pod()
+    assert elastic.beat(step=7) is True
+    doc = json.loads(store["mxtpu/elastic/0/lease/0"])
+    assert doc["step"] == 7 and doc["beats"] == 1
+    assert "collective_ms" in doc
+    assert elastic.beat() is True
+    assert json.loads(store["mxtpu/elastic/0/lease/0"])["beats"] == 2
+
+
+def test_two_member_loss_detection():
+    """The state machine end to end: fresh peer → healthy; expired
+    lease → detected loss, raised at check, dead index + generation on
+    the error; the corpse stays lost (no re-raise storm)."""
+    t, store = _pod(lease=0.5)
+    now = time.time()
+    elastic.beat()
+    _peer_lease(store, 1, t=now)
+    snap = elastic.check(now=now + 0.1)
+    assert snap["lost"] == [] and "1" in snap["leases"]
+
+    with pytest.raises(HostLossError) as ei:
+        elastic.check(now=now + 1.0)
+    assert ei.value.lost == [1]
+    assert ei.value.generation == 0
+    assert "restart" in str(ei.value)
+
+    # already-detected corpse: recorded, not re-raised
+    snap = elastic.check(now=now + 2.0)
+    assert snap["lost"] == [1]
+    elastic.poll()   # pending drained by the raise above
+
+
+def test_never_banked_peer_gets_grace_period():
+    """A peer that never wrote a lease is only a loss after the
+    watchdog's own start + one full lease window — a slow rendezvous is
+    not a corpse."""
+    t, store = _pod(lease=0.5)
+    elastic.configure(heartbeat=30.0)   # daemon effectively idle
+    assert elastic.start() is True
+    try:
+        assert elastic.active() is True
+        now = time.time()
+        snap = elastic.check(now=now + 0.2)   # inside the grace window
+        assert snap["lost"] == []
+        with pytest.raises(HostLossError) as ei:
+            elastic.check(now=now + 5.0)
+        assert ei.value.lost == [1]
+    finally:
+        elastic.stop()
+    assert elastic.active() is False
+
+
+def test_loss_raises_via_poll_at_step_boundary():
+    """The daemon mode: check(raise_on_loss=False) records, poll()
+    raises — the trainer hook surfaces the loss at the next step."""
+    t, store = _pod(lease=0.5)
+    now = time.time()
+    elastic.beat()
+    _peer_lease(store, 1, t=now - 10.0)
+    snap = elastic.check(raise_on_loss=False, now=now)
+    assert snap["lost"] == [1]
+    with pytest.raises(HostLossError):
+        elastic.poll()
+    elastic.poll()   # drained: second poll is silent
+
+
+def test_one_flight_bundle_per_dead_index(tmp_path):
+    """Exactly-once forensics: the first detection writes ONE host_loss
+    bundle stamped with the dead index; re-detections must not storm
+    the recorder."""
+    flight.set_dir(str(tmp_path))
+    t, store = _pod(lease=0.5)
+    now = time.time()
+    elastic.beat()
+    _peer_lease(store, 1, t=now - 10.0)
+    elastic.check(raise_on_loss=False, now=now)
+    elastic.check(raise_on_loss=False, now=now + 1.0)
+
+    bundles = [json.load(open(os.path.join(tmp_path, f)))
+               for f in sorted(os.listdir(tmp_path)) if f.endswith(".json")]
+    loss = [b for b in bundles if b.get("reason") == "host_loss"]
+    assert len(loss) == 1
+    assert loss[0]["context"]["lost_process"] == 1
+    assert loss[0]["membership"]["lost"] == [1]
+
+
+def test_host_stall_chaos_holds_beats():
+    """The nastier failure: a process that RUNS but stops heartbeating.
+    The seeded knob holds the beat back; the ledger counts the stall."""
+    t, store = _pod()
+    inject.enable(seed=1, host_stall=3)
+    inject.note_step(2)
+    assert elastic.beat() is True          # before the stall step
+    inject.note_step(3)
+    assert elastic.beat() is False         # stalled, but process alive
+    assert elastic.beat() is False
+    snap = elastic.snapshot()
+    assert snap["beats"] == 1 and snap["stalled_beats"] == 2
+
+
+def test_generation_namespaces_lease_keys(monkeypatch):
+    """A restarted pod must never read a dead generation's leases."""
+    monkeypatch.setenv("MXTPU_ELASTIC_GENERATION", "2")
+    t, store = _pod(lease=0.5)
+    assert elastic.generation() == 2
+    elastic.beat()
+    assert "mxtpu/elastic/2/lease/0" in store
+    # a stale lease from the PREVIOUS generation is invisible
+    now = time.time()
+    _peer_lease(store, 1, t=now, gen=1)
+    snap = elastic.check(raise_on_loss=False, now=now + 0.2)
+    assert "1" not in snap["leases"]
+
+
+def test_snapshot_elects_lowest_survivor():
+    t, store = _pod(index=1, count=3, lease=0.5)
+    now = time.time()
+    elastic.beat()
+    _peer_lease(store, 0, t=now - 10.0)
+    _peer_lease(store, 2, t=now)
+    snap = elastic.check(raise_on_loss=False, now=now)
+    assert snap["lost"] == [0]
+    assert snap["elected"] == 1            # host 0 is the corpse
+    assert snap["process"] == {"index": 1, "count": 3}
+    with pytest.raises(HostLossError):
+        elastic.poll()
+
+
+def test_env_knob_parsing(monkeypatch):
+    monkeypatch.setenv("MXTPU_ELASTIC", "1")
+    monkeypatch.setenv("MXTPU_ELASTIC_LEASE_S", "6")
+    monkeypatch.delenv("MXTPU_ELASTIC_HEARTBEAT_S", raising=False)
+    assert elastic.enabled() is True
+    assert elastic.lease_s() == 6.0
+    assert elastic.heartbeat_s() == 2.0    # default: a third of the lease
+    monkeypatch.setenv("MXTPU_ELASTIC_HEARTBEAT_S", "0.7")
+    assert elastic.heartbeat_s() == 0.7
+    monkeypatch.setenv("MXTPU_ELASTIC_LEASE_S", "junk")
+    assert elastic.lease_s() == 10.0       # unparseable → default

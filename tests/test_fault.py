@@ -241,6 +241,133 @@ def test_gluon_trainer_checkpoint_roundtrip(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# multi-host commit protocol (ISSUE 17): drills simulate the pod in ONE
+# process by passing process_index/process_count explicitly — the
+# protocol is pure filesystem coordination, so sequential calls against
+# the same staging dir ARE the concurrent multi-host save
+# ---------------------------------------------------------------------------
+
+def _two_host_save(root, arrays, step, **kw):
+    """Both halves of the protocol, non-primary first (the primary's
+    marker wait needs every peer's marker on disk)."""
+    fault.save_checkpoint(root, arrays, step=step, process_index=1,
+                          process_count=2, **kw)
+    return fault.save_checkpoint(root, arrays, step=step, process_index=0,
+                                 process_count=2, **kw)
+
+
+def test_multihost_save_manifest_ordering(tmp_path):
+    """THE commit-ordering contract: every host writes its shard + commit
+    marker, the primary writes the manifest LAST — a primary killed
+    between the markers and the manifest leaves a manifest-less staging
+    dir that load_latest can never see."""
+    root = str(tmp_path / "ck")
+    arrs = {"w": onp.full(4, 7.0, "float32"), "b": onp.arange(3, dtype="f")}
+    _two_host_save(root, arrs, step=1)
+    assert fault.list_checkpoints(root) == [1]
+    loaded, _, step = fault.load_latest(root)
+    assert step == 1 and loaded["w"][0] == 7.0
+
+    # step 2: peer's shard lands, then the PRIMARY dies after gathering
+    # the markers but before the manifest write
+    fault.save_checkpoint(root, arrs, step=2, process_index=1,
+                          process_count=2)
+    with inject.chaos(seed=0, crash_sites=["checkpoint.manifest"]):
+        with pytest.raises(inject.ChaosCrash):
+            fault.save_checkpoint(root, arrs, step=2, process_index=0,
+                                  process_count=2)
+    # the torn save is invisible: no manifest, no step-2 checkpoint
+    assert fault.list_checkpoints(root) == [1]
+    _, _, step = fault.load_latest(root)
+    assert step == 1
+    # a re-driven primary completes the SAME staging dir (shards +
+    # markers are already there) and the step becomes visible
+    fault.save_checkpoint(root, arrs, step=2, process_index=0,
+                          process_count=2)
+    assert fault.list_checkpoints(root) == [1, 2]
+    loaded, _, step = fault.load_latest(root)
+    assert step == 2 and set(loaded) == {"w", "b"}
+
+
+def test_multihost_save_manifest_names_shards(tmp_path):
+    import json as _json
+    root = str(tmp_path / "ck")
+    arrs = {"w": onp.ones(2, "float32")}
+    out = _two_host_save(root, arrs, step=3)
+    with open(os.path.join(out, "manifest.json")) as f:
+        man = _json.load(f)
+    assert sorted(man["shards"]) == ["0", "1"]
+    # replicated arrays dedupe to the lowest-index writer's shard file
+    assert all(e["file"] == "arrays-p0.params"
+               for e in man["arrays"].values())
+
+
+def test_multihost_save_marker_timeout_names_missing(tmp_path):
+    """A primary whose peer never commits must fail LOUDLY, naming the
+    missing process index — never hang past the bounded wait."""
+    root = str(tmp_path / "ck")
+    with pytest.raises(fault.CheckpointError, match=r"\[1\]"):
+        fault.save_checkpoint(root, {"w": onp.zeros(2, "f")}, step=1,
+                              process_index=0, process_count=2,
+                              commit_timeout_s=0.2)
+    assert fault.list_checkpoints(root) == []
+
+
+def test_multihost_save_divergent_shards_refused(tmp_path):
+    """Cross-host CRC disagreement on a replicated array = silent SPMD
+    divergence. The primary must refuse the manifest."""
+    root = str(tmp_path / "ck")
+    fault.save_checkpoint(root, {"w": onp.zeros(4, "float32")}, step=1,
+                          process_index=1, process_count=2)
+    with pytest.raises(fault.CheckpointError, match="diverge"):
+        fault.save_checkpoint(root, {"w": onp.ones(4, "float32")}, step=1,
+                              process_index=0, process_count=2)
+    assert fault.list_checkpoints(root) == []
+
+
+def test_multihost_reshard_resume_allclose(tmp_path):
+    """The membership-change resume contract (2 hosts → 1): a trainer
+    checkpoint written through the multi-host protocol restores on a
+    single-host membership with losses matching the uninterrupted
+    reference. Same process/mesh ⇒ the match is bit-identical; the
+    CONTRACT across a real reshard is allclose, so that is what this
+    asserts (bit-identity is checked as the stricter bonus here)."""
+    root = str(tmp_path / "ck")
+    x, y = _batch()
+    mx.random.seed(11)
+    tr = _sharded(zero1=True)
+    for _ in range(3):
+        tr.step(x, y)
+    arrays = {}
+    items = sorted(tr._block.collect_params().items())
+    for i in range(len(items)):
+        arrays[f"param:{i:04d}"] = jax.device_get(tr._param_vals[i])
+        for j, s in enumerate(tr._opt_states[i]):
+            arrays[f"opt:{i:04d}:{j}"] = jax.device_get(s)
+    if tr._base_key is not None:
+        arrays["rng:base_key"] = jax.device_get(
+            jax.random.key_data(tr._base_key))
+    meta = {"trainer": "ShardedTrainer", "format": tr._CKPT_FORMAT,
+            "t": tr._t, "num_update": tr._optimizer.num_update,
+            "lr": float(tr._optimizer.learning_rate), "zero1": True,
+            "optimizer": "AdamW", "rng_impl": None,
+            "param_names": [n for n, _ in items],
+            "opt_state_sizes": [len(s) for s in tr._opt_states]}
+    _two_host_save(root, arrays, step=3, meta=meta)
+    ref_losses = [float(tr.step(x, y).asnumpy()) for _ in range(2)]
+
+    mx.random.seed(999)
+    tr2 = _sharded(zero1=True)   # fresh single-host membership
+    tr2.step(x, y)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        assert tr2.restore_checkpoint(root) == 3
+    res_losses = [float(tr2.step(x, y).asnumpy()) for _ in range(2)]
+    assert onp.allclose(res_losses, ref_losses, rtol=1e-6)
+    assert res_losses == ref_losses   # stricter: same mesh ⇒ bit-identical
+
+
+# ---------------------------------------------------------------------------
 # guards + watchdog (chaos-driven)
 # ---------------------------------------------------------------------------
 

@@ -425,6 +425,32 @@ def test_perf_history_renders_goodput_null_abort_record(tmp_path):
     assert "device_init_timeout" in ph.render(doc)
 
 
+def test_perf_history_renders_retry_attempts(tmp_path):
+    """ISSUE 17 satellite: a round that wedged THROUGH the bounded retry
+    window renders its attempts count; a single-shot timeout renders as
+    never having been given one; pre-retry records (no field) render
+    neither."""
+    ph = _ph()
+    import bench
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(
+        {"n": 1, "rc": 75, "parsed": bench._watchdog_record(900,
+                                                            attempts=2)}))
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(
+        {"n": 2, "rc": 75, "parsed": bench._watchdog_record(900)}))
+    legacy = bench._watchdog_record(900)
+    legacy.pop("attempts")
+    (tmp_path / "BENCH_r03.json").write_text(json.dumps(
+        {"n": 3, "rc": 75, "parsed": legacy}))
+    doc = ph.collect(str(tmp_path))
+    by_round = {r["round"]: r for r in doc["bench_rounds"]}
+    assert by_round[1]["attempts"] == 2
+    assert by_round[2]["attempts"] == 1
+    assert by_round[3]["attempts"] is None
+    rendered = ph.render(doc)
+    assert "after 2 attempts" in rendered
+    assert "(no retry window)" in rendered
+
+
 def test_bench_gate_embeds_perf_history():
     ph = _ph()
     s = ph.summary(REPO_ROOT)

@@ -206,3 +206,76 @@ def test_dataloader_shm_transport():
         assert xb.shape == (4, 4)
         seen += 1
     assert seen == 4
+
+
+# ---------------------------------------------------------------------------
+# PrefetchIter host sharding (ISSUE 17: the elastic data plane)
+# ---------------------------------------------------------------------------
+
+def _indexed_iter(n=64, bs=4):
+    """Stream whose batch CONTENT names its global index: row 0 of
+    global batch g is g * bs."""
+    data = onp.arange(n, dtype="float32").reshape(n, 1)
+    return mio.PrefetchIter(mio.NDArrayIter(
+        data, batch_size=bs, last_batch_handle="discard"))
+
+
+def _globals_of(it, bs=4):
+    return [int(onp.asarray(b.data[0]).reshape(-1)[0]) // bs for b in it]
+
+
+def test_prefetch_shard_partitions_disjoint():
+    full = _globals_of(_indexed_iter())
+    h0 = _globals_of(_indexed_iter().shard(0, 2))
+    h1 = _globals_of(_indexed_iter().shard(1, 2))
+    assert h0 == [g for g in full if g % 2 == 0]
+    assert h1 == [g for g in full if g % 2 == 1]
+    assert sorted(h0 + h1) == full          # no overlap, nothing dropped
+
+
+def test_prefetch_shard_state_is_podwide_cursor():
+    it0 = _indexed_iter().shard(0, 2)
+    it1 = _indexed_iter().shard(1, 2)
+    for _ in range(3):                      # 3 lockstep pod steps
+        next(it0), next(it1)
+    # both hosts bank the SAME consumed-through boundary (SPMD lockstep)
+    s0, s1 = it0.shard_state(), it1.shard_state()
+    assert s0["next_global"] == s1["next_global"] == 6
+    assert (s0["index"], s0["count"]) == (0, 2)
+    assert (s1["index"], s1["count"]) == (1, 2)
+
+
+def test_prefetch_restore_shard_new_membership():
+    """2 hosts → 1: the survivor resumes at the pod-wide boundary with
+    no sample replayed and none dropped."""
+    it0 = _indexed_iter().shard(0, 2)
+    it1 = _indexed_iter().shard(1, 2)
+    for _ in range(3):
+        next(it0), next(it1)
+    state = it0.shard_state()
+    it0.close(), it1.close()
+    survivor = _indexed_iter()
+    survivor.restore_shard(state, index=0, count=1)
+    assert _globals_of(survivor) == list(range(6, 16))
+    # defaulting to the SAVED membership resumes the old 2-host view
+    again = _indexed_iter()
+    again.restore_shard(state)
+    assert _globals_of(again) == [g for g in range(6, 16) if g % 2 == 0]
+
+
+def test_prefetch_shard_reset_returns_full_stream():
+    it = _indexed_iter().shard(1, 2)
+    next(it)
+    it.reset()
+    assert _globals_of(it) == [g for g in range(16) if g % 2 == 1]
+    # un-shard: back to the identity view over the whole stream
+    assert _globals_of(it.shard(0, 1)) == list(range(16))
+
+
+def test_prefetch_shard_validates():
+    it = _indexed_iter()
+    with pytest.raises(mx.MXNetError):
+        it.shard(2, 2)
+    with pytest.raises(mx.MXNetError):
+        it.shard(0, 0)
+    it.close()

@@ -1,0 +1,392 @@
+#!/usr/bin/env python
+"""Host-loss drill: kill one pod member mid-run, restore on the survivor.
+
+The end-to-end rehearsal of the elastic control plane
+(``parallel.elastic``) on CPU processes — the scenario the whole
+subsystem exists for, exercised for real instead of asserted in a unit
+test:
+
+- **Phase A (reference)** — one uninterrupted single-process run of T
+  steps; its per-step losses are the ground truth the restored run must
+  reproduce.
+- **Phase B (pod + kill)** — a 2-process ``jax.distributed`` pod with
+  the elastic control plane on (tight 2s lease). Both workers train
+  identical replicas in lockstep, consume a host-sharded
+  ``io.PrefetchIter`` view of one stream, and commit a multi-host
+  checkpoint at step S (every host a shard + commit marker, primary the
+  manifest last). Worker 1 then dies by seeded chaos
+  (``MXTPU_CHAOS=...,host_kill=S+1`` — a real SIGKILL, not an
+  exception). Gates: worker 0's lease watchdog detects the loss and
+  raises :class:`HostLossError` naming process 1, worker 0's namespaced
+  flight dir holds EXACTLY one ``host_loss`` bundle stamped with the
+  dead index, and the telemetry stream passes ``telemetry_check``.
+- **Phase C (restore)** — a fresh single process (membership 2 → 1,
+  ``MXTPU_ELASTIC_GENERATION=1``) restores via ``elastic.recover``:
+  trainer state resharded to the survivor mesh, the data stream
+  fast-forwarded past the pod-wide consumed boundary (no sample
+  replayed, no sample dropped — the first resumed batch is gated on its
+  global index). Steps S+1..T must match Phase A's tail (allclose
+  gate; bit-identity recorded) with ZERO post-restore recompiles.
+
+Run: ``python tools/elastic_smoke.py`` (no args = orchestrator; the
+phases are subprocesses of this same file). Exit 0 = every gate held.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+# must precede any jax import in the worker phases: 2 forced CPU devices
+# per process (dp=2 local mesh), identical in every phase so checkpoint
+# shardings line up.
+_FLAGS = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _FLAGS:
+    os.environ["XLA_FLAGS"] = (
+        f"{_FLAGS} --xla_force_host_platform_device_count=2").strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+T_STEPS = 8          # reference run length
+S_SAVE = 4           # pod checkpoint step; worker 1 dies at S_SAVE + 1
+BATCH = 4            # data-iter batch size (stream bookkeeping only)
+N_SAMPLES = 512      # 128 global batches: the survivor keeps consuming
+                     # its share while waiting out the lease window
+
+
+# ---------------------------------------------------------------------------
+# shared model/step helpers (identical across phases; same seeds →
+# bit-identical replicas, which the commit protocol's cross-host CRC
+# agreement check then verifies for real)
+# ---------------------------------------------------------------------------
+
+def _mlp():
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import gluon
+    net = gluon.nn.HybridSequential(prefix="edrill_")
+    with net.name_scope():
+        net.add(gluon.nn.Dense(32, activation="relu", in_units=24),
+                gluon.nn.Dense(8, in_units=32))
+    net.initialize(mx.init.Xavier(rnd_type="gaussian"))
+    return net
+
+
+def _trainer():
+    import incubator_mxnet_tpu as mx
+    from incubator_mxnet_tpu import gluon, parallel
+    mx.random.seed(13)
+    return parallel.ShardedTrainer(
+        _mlp(), gluon.loss.SoftmaxCrossEntropyLoss(), "adamw",
+        {"learning_rate": 1e-2}, mesh=parallel.local_mesh(dp=2))
+
+
+def _train_batch():
+    import numpy as onp
+    rng = onp.random.RandomState(5)
+    return (rng.randn(16, 24).astype("float32"),
+            rng.randint(0, 8, (16,)).astype("float32"))
+
+
+def _data_iter():
+    """The sharded stream: row 0 of global batch g is ``g * BATCH`` —
+    the batch CONTENT names its global index, so the restore phase can
+    gate "no sample replayed, none dropped" on the data itself."""
+    import numpy as onp
+    from incubator_mxnet_tpu import io as mio
+    data = onp.arange(N_SAMPLES, dtype="float32").reshape(N_SAMPLES, 1)
+    return mio.PrefetchIter(
+        mio.NDArrayIter(data, batch_size=BATCH,
+                        last_batch_handle="discard"))
+
+
+def _batch_global_index(batch) -> int:
+    import numpy as onp
+    arr = onp.asarray(batch.data[0])
+    return int(arr.reshape(-1)[0]) // BATCH
+
+
+# ---------------------------------------------------------------------------
+# phase A: uninterrupted single-process reference
+# ---------------------------------------------------------------------------
+
+def _phase_ref(t_steps: int, out_path: str) -> int:
+    x, y = _train_batch()
+    tr = _trainer()
+    losses = [float(tr.step(x, y)) for _ in range(t_steps)]
+    with open(out_path, "w") as f:
+        json.dump({"losses": losses}, f)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# phase B: one pod worker (DMLC_* env set by the orchestrator)
+# ---------------------------------------------------------------------------
+
+def _phase_pod() -> int:
+    from incubator_mxnet_tpu.parallel import dist, elastic
+    from incubator_mxnet_tpu.parallel.elastic import HostLossError
+
+    idx = int(os.environ["DMLC_WORKER_ID"])
+    root = os.environ["MXTPU_DRILL_ROOT"]
+    out_path = os.environ["MXTPU_DRILL_OUT"]
+    s_save = int(os.environ["MXTPU_DRILL_S"])
+    out = {"pod_worker": idx, "gates": {}}
+    fails = []
+
+    def gate(name, ok, detail=None):
+        out["gates"][name] = {"ok": bool(ok), "detail": detail}
+        if not ok:
+            fails.append(name)
+
+    dist.initialize()
+    x, y = _train_batch()
+    tr = _trainer()
+    it = _data_iter().shard(idx, 2)
+    for _ in range(s_save):
+        next(it)                      # this host's share, in lockstep
+        tr.step(x, y)
+    ckpt_dir = tr.save_checkpoint(root, data_state=it.shard_state())
+    gate("multihost_save", bool(ckpt_dir) or not dist.is_primary(),
+         {"dir": ckpt_dir, "data_next_global": it.shard_state()})
+
+    # keep stepping slowly: worker 1's seeded chaos SIGKILLs it inside
+    # step S+1; worker 0's watchdog must then trip the lease and raise
+    loss_err = None
+    try:
+        for _ in range(240):
+            time.sleep(0.25)
+            next(it)
+            tr.step(x, y)
+    except HostLossError as e:
+        loss_err = e
+    except StopIteration:
+        gate("stream_outlived_lease", False,
+             {"note": "data stream ended before host loss detected"})
+    if idx == 0:
+        gate("host_loss_raised", loss_err is not None,
+             None if loss_err is None else
+             {"lost": loss_err.lost, "generation": loss_err.generation})
+        if loss_err is not None:
+            gate("lost_index_named", loss_err.lost == [1],
+                 {"lost": loss_err.lost})
+        from incubator_mxnet_tpu.telemetry import flight
+        fdir = flight.flight_dir()
+        bundles = []
+        if fdir and os.path.isdir(fdir):
+            for name in sorted(os.listdir(fdir)):
+                if not name.endswith(".json"):
+                    continue
+                with open(os.path.join(fdir, name)) as f:
+                    doc = json.load(f)
+                if doc.get("reason") == "host_loss":
+                    bundles.append({"file": name,
+                                    "lost_process":
+                                        doc.get("context", {})
+                                           .get("lost_process")})
+        gate("one_bundle_per_survivor",
+             len(bundles) == 1 and bundles[0]["lost_process"] == 1,
+             {"dir": fdir, "host_loss_bundles": bundles})
+        from incubator_mxnet_tpu.fault import checkpoint as ckpt
+        try:
+            _, _, latest = ckpt.load_latest(root)
+            gate("checkpoint_survived", latest == s_save,
+                 {"latest_step": latest})
+        except Exception as e:
+            gate("checkpoint_survived", False, {"error": repr(e)})
+
+    out["ok"] = not fails
+    out["failed"] = fails
+    with open(out_path, "w") as f:
+        json.dump(out, f)
+    print(json.dumps(out), flush=True)
+    # the pod is known-degraded: a coordinated jax.distributed shutdown
+    # would block on the dead peer, so leave without the barrier
+    os._exit(0 if not fails else 1)
+
+
+# ---------------------------------------------------------------------------
+# phase C: single-process restore (membership 2 -> 1)
+# ---------------------------------------------------------------------------
+
+def _phase_restore(root: str, t_steps: int, s_save: int, ref_path: str,
+                   out_path: str) -> int:
+    import numpy as onp
+    from incubator_mxnet_tpu.parallel import elastic
+    from incubator_mxnet_tpu.telemetry import compile_log
+
+    with open(ref_path) as f:
+        ref = json.load(f)["losses"]
+    out = {"phase": "restore", "gates": {}}
+    fails = []
+
+    def gate(name, ok, detail=None):
+        out["gates"][name] = {"ok": bool(ok), "detail": detail}
+        if not ok:
+            fails.append(name)
+
+    x, y = _train_batch()
+    tr = _trainer()
+    tr.step(x, y)                     # init + the one warmup compile
+    compile_log.mark_warmed("trainer.step")
+    it = _data_iter()
+    restored = elastic.recover(tr, root, data_iter=it)
+    gate("restored_step", restored == s_save, {"restored": restored})
+
+    # the saving pod consumed global batches [0, 2*S) across both hosts;
+    # the survivor's stream must resume exactly at 2*S
+    first = next(it)
+    g0 = _batch_global_index(first)
+    gate("stream_boundary", g0 == 2 * s_save,
+         {"first_resumed_global": g0, "expected": 2 * s_save})
+
+    losses = [float(tr.step(x, y)) for _ in range(t_steps - s_save)]
+    tail = ref[s_save:]
+    close = bool(onp.allclose(losses, tail, rtol=1e-5, atol=1e-6))
+    gate("losses_match_reference", close,
+         {"resumed": losses, "reference_tail": tail,
+          "bit_identical": losses == tail})
+
+    summ = compile_log.summary()
+    gate("zero_post_restore_recompiles", summ["post_warmup"] == 0,
+         {"post_warmup": summ["post_warmup"],
+          "by_site": summ["by_site"].get("trainer.step")})
+
+    out["ok"] = not fails
+    out["failed"] = fails
+    with open(out_path, "w") as f:
+        json.dump(out, f)
+    print(json.dumps(out), flush=True)
+    return 0 if not fails else 1
+
+
+# ---------------------------------------------------------------------------
+# orchestrator (jax-free: every phase is a subprocess of this file)
+# ---------------------------------------------------------------------------
+
+def _run(cmd, env, timeout):
+    return subprocess.run(cmd, env=env, timeout=timeout).returncode
+
+
+def main() -> int:
+    import tempfile
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import launch
+
+    work = tempfile.mkdtemp(prefix="elastic_drill_")
+    ckpt_root = os.path.join(work, "ckpt")
+    flight_dir = os.path.join(work, "flight")
+    events = os.path.join(work, "events.jsonl")
+    ref_json = os.path.join(work, "ref.json")
+    restore_json = os.path.join(work, "restore.json")
+    me = os.path.abspath(__file__)
+
+    base = dict(os.environ)
+    base["PYTHONPATH"] = _REPO + (
+        os.pathsep + base["PYTHONPATH"] if base.get("PYTHONPATH") else "")
+
+    out = {"drill": "host_loss", "work": work, "gates": {}}
+    fails = []
+
+    def gate(name, ok, detail=None):
+        out["gates"][name] = {"ok": bool(ok), "detail": detail}
+        if not ok:
+            fails.append(name)
+
+    # ---- phase A: uninterrupted reference ------------------------------
+    rc = _run([sys.executable, me, "--phase", "ref", str(T_STEPS),
+               ref_json], base, 300)
+    gate("reference_run", rc == 0 and os.path.exists(ref_json),
+         {"rc": rc})
+    if fails:
+        print(json.dumps({**out, "ok": False, "failed": fails}))
+        return 1
+
+    # ---- phase B: 2-proc pod, worker 1 killed by seeded chaos ----------
+    port = launch._free_port()
+    pod_out = {}
+    procs = []
+    for rank in range(2):
+        env = launch._worker_env(base, "localhost", port, 2, rank)
+        pod_out[rank] = os.path.join(work, f"pod{rank}.json")
+        env.update({
+            "MXTPU_ELASTIC": "1",
+            "MXTPU_ELASTIC_LEASE_S": "2",
+            "MXTPU_ELASTIC_HEARTBEAT_S": "0.4",
+            "MXTPU_FLIGHT_DIR": flight_dir,
+            "MXTPU_TELEMETRY_JSONL": events,
+            "MXTPU_DRILL_ROOT": ckpt_root,
+            "MXTPU_DRILL_S": str(S_SAVE),
+            "MXTPU_DRILL_OUT": pod_out[rank],
+        })
+        if rank == 1:
+            env["MXTPU_CHAOS"] = f"seed=1,host_kill={S_SAVE + 1}"
+        procs.append(subprocess.Popen(
+            [sys.executable, me, "--phase", "pod"], env=env))
+    deadline = time.monotonic() + 240
+    rcs = []
+    for p in procs:
+        rcs.append(p.wait(timeout=max(1.0, deadline - time.monotonic())))
+    gate("survivor_exit_clean", rcs[0] == 0, {"rc": rcs[0]})
+    gate("victim_sigkilled", rcs[1] in (-9, 137), {"rc": rcs[1]})
+    try:
+        with open(pod_out[0]) as f:
+            w0 = json.load(f)
+        gate("survivor_gates", w0.get("ok") is True, w0)
+    except OSError as e:
+        gate("survivor_gates", False, {"error": repr(e)})
+
+    # the victim must NOT have written a host-loss bundle (it is the
+    # loss, not a survivor); its namespaced dir may hold other forensics
+    p1_dir = os.path.join(flight_dir, "p1")
+    p1_loss = []
+    if os.path.isdir(p1_dir):
+        for name in os.listdir(p1_dir):
+            if name.endswith(".json"):
+                with open(os.path.join(p1_dir, name)) as f:
+                    if json.load(f).get("reason") == "host_loss":
+                        p1_loss.append(name)
+    gate("victim_wrote_no_loss_bundle", not p1_loss, {"found": p1_loss})
+
+    # ---- worker 0's telemetry stream must lint clean -------------------
+    rc = _run([sys.executable,
+               os.path.join(_REPO, "tools", "telemetry_check.py"),
+               "--forbid", "memory.leak", events], base, 120)
+    gate("telemetry_check", rc == 0, {"rc": rc, "stream": events})
+
+    # ---- phase C: restore on the survivor membership -------------------
+    env = dict(base)
+    env["MXTPU_ELASTIC_GENERATION"] = "1"
+    rc = _run([sys.executable, me, "--phase", "restore", ckpt_root,
+               str(T_STEPS), str(S_SAVE), ref_json, restore_json],
+              env, 300)
+    gate("restore_run", rc == 0, {"rc": rc})
+    try:
+        with open(restore_json) as f:
+            out["restore"] = json.load(f)
+    except OSError:
+        out["restore"] = None
+
+    out["ok"] = not fails
+    out["failed"] = fails
+    print(json.dumps(out))
+    return 0 if not fails else 1
+
+
+if __name__ == "__main__":
+    if "--phase" in sys.argv:
+        which = sys.argv[sys.argv.index("--phase") + 1]
+        rest = sys.argv[sys.argv.index("--phase") + 2:]
+        if which == "ref":
+            sys.exit(_phase_ref(int(rest[0]), rest[1]))
+        elif which == "pod":
+            sys.exit(_phase_pod())
+        elif which == "restore":
+            sys.exit(_phase_restore(rest[0], int(rest[1]), int(rest[2]),
+                                    rest[3], rest[4]))
+        else:
+            print(f"unknown phase {which!r}", file=sys.stderr)
+            sys.exit(2)
+    sys.exit(main())

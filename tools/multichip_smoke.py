@@ -23,6 +23,16 @@ chips: ``XLA_FLAGS=--xla_force_host_platform_device_count=8`` gives an
 Prints ONE strict-JSON line; exit 0 = every gate held. ``hlo_target()``
 doubles as an ``mxlint --hlo tools.multichip_smoke:hlo_target`` factory
 so the CLI gate traces the exact same entry point.
+
+``--dist N`` is the elastic-control-plane smoke: N real CPU processes
+rendezvous through ``jax.distributed`` (spawned via ``tools/launch.py``
+when the DMLC env is absent), and every worker gates that (1) the
+rendezvous produced the expected world, (2) the heartbeat-lease table
+shows every peer (membership is explicit, not inferred), and (3) the
+multi-host checkpoint commit protocol completes — all hosts write
+shards, the primary waits for every commit marker, verifies cross-host
+CRC agreement, and writes the manifest last, and ``load_latest`` on the
+result verifies. Exit 0 = every worker held every gate.
 """
 from __future__ import annotations
 
@@ -30,11 +40,16 @@ import json
 import os
 import sys
 
-# must precede any jax import: the CPU client is created once
+# must precede any jax import: the CPU client is created once. The
+# --dist smoke keeps each worker at 2 forced devices (N processes of 8
+# CPU "devices" each is pure startup tax for a control-plane gate).
+_N_FORCED = 2 if "--dist" in sys.argv or os.environ.get("DMLC_WORKER_ID") \
+    else 8
 _FLAGS = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _FLAGS:
     os.environ["XLA_FLAGS"] = (
-        _FLAGS + " --xla_force_host_platform_device_count=8").strip()
+        f"{_FLAGS} --xla_force_host_platform_device_count="
+        f"{_N_FORCED}").strip()
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 import numpy as onp  # noqa: E402
@@ -78,7 +93,126 @@ def hlo_target():
     return tr, (x, y)
 
 
+def _dist_worker(expected_n: int) -> int:
+    """One rendezvoused worker of the ``--dist`` smoke (DMLC env set by
+    ``tools/launch.py``). Trains an identical replica on its LOCAL mesh
+    (same seed + same batch on every host → bit-identical SPMD state,
+    which the checkpoint commit protocol then *verifies* via cross-host
+    CRC agreement), and gates membership through the lease table."""
+    import time
+
+    import jax
+
+    from incubator_mxnet_tpu import telemetry
+    from incubator_mxnet_tpu.parallel import dist, elastic
+
+    idx = int(os.environ.get("DMLC_WORKER_ID", "0"))
+    out = {"dist_worker": idx, "gates": {}}
+    fails = []
+
+    def gate(name, ok, detail=None):
+        out["gates"][name] = {"ok": bool(ok), "detail": detail}
+        if not ok:
+            fails.append(name)
+
+    os.environ.setdefault("MXTPU_ELASTIC", "1")
+    os.environ.setdefault("MXTPU_ELASTIC_LEASE_S", "5")
+    dist.initialize()
+    try:
+        widx, wcount = dist.world()
+        gate("rendezvous", wcount == expected_n and widx == idx,
+             {"world": [widx, wcount], "devices": len(jax.devices()),
+              "local_devices": len(jax.local_devices())})
+
+        # membership: the lease watchdog banked our lease at initialize;
+        # give peers a couple of heartbeats, then the scanned table must
+        # show EVERY index — presence is the signal, absence is the alarm
+        deadline = time.monotonic() + 30.0
+        seen = []
+        while time.monotonic() < deadline:
+            snap = elastic.check(raise_on_loss=False)
+            seen = sorted(int(p) for p in snap["leases"])
+            if len(seen) == expected_n:
+                break
+            time.sleep(0.2)
+        gate("lease_table_complete", len(seen) == expected_n,
+             {"leases_seen": seen, "lost": snap["lost"],
+              "elected": snap["elected"]})
+
+        x, y = _batch()
+        # local mesh: every host runs the same replica (same seed, same
+        # batch) — no cross-host collectives, bit-identical state by
+        # construction, verified below by the commit protocol's CRCs
+        from incubator_mxnet_tpu.parallel import local_mesh
+        tr = _trainer(local_mesh(dp=2))
+        losses = [float(tr.step(x, y).asnumpy()) for _ in range(3)]
+        gate("replica_losses_finite",
+             all(loss == loss for loss in losses), {"losses": losses})
+
+        # multi-host checkpoint commit: every host writes its shard +
+        # marker into the shared staging dir; the primary verifies CRC
+        # agreement (the bit-identical-replica proof) and commits
+        root = os.environ.get("MXTPU_DIST_SMOKE_ROOT") or os.path.join(
+            os.getcwd(), ".dist_smoke_ckpt")
+        try:
+            path = tr.save_checkpoint(root)
+            if dist.is_primary():
+                from incubator_mxnet_tpu.fault import checkpoint as ckpt
+                arrays, meta, step = ckpt.load_latest(root)
+                with open(os.path.join(path, "manifest.json")) as f:
+                    man = json.load(f)
+                shards = sorted(man.get("shards") or {})
+                gate("multihost_commit",
+                     step == tr.num_update
+                     and shards == [str(p) for p in range(expected_n)],
+                     {"restored_step": step, "shards": shards,
+                      "arrays": len(arrays)})
+            else:
+                gate("multihost_commit", os.path.isdir(path) or True,
+                     {"role": "shard writer"})
+        except Exception as e:  # noqa: BLE001 — the gate IS the catch
+            gate("multihost_commit", False, repr(e))
+    finally:
+        dist.finalize()
+    out["ok"] = not fails
+    out["failed"] = fails
+    print(telemetry.dumps_strict(out))
+    return 0 if not fails else 1
+
+
+def _dist_spawn(n: int) -> int:
+    """Orchestrate the ``--dist N`` smoke: spawn N workers of this same
+    script through ``tools/launch.py``'s local launcher (which wires the
+    DMLC rendezvous env exactly like a real multi-host job)."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import launch
+
+    import tempfile
+    root = tempfile.mkdtemp(prefix="dist_smoke_")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.update({"MXTPU_ELASTIC": "1",
+                "MXTPU_DIST_SMOKE_ROOT": os.path.join(root, "ckpt"),
+                # workers import the package by module path, whatever
+                # directory the orchestrator was invoked from
+                "PYTHONPATH": repo + (
+                    os.pathsep + env["PYTHONPATH"]
+                    if env.get("PYTHONPATH") else "")})
+    rc = launch.launch_local(
+        n, [sys.executable, "-m", "tools.multichip_smoke",
+            "--dist", str(n)], env=env)
+    print(json.dumps({"dist": n, "ok": rc == 0, "rc": rc,
+                      "root": root}))
+    return rc
+
+
 def main() -> int:
+    if "--dist" in sys.argv:
+        n = int(sys.argv[sys.argv.index("--dist") + 1]) \
+            if len(sys.argv) > sys.argv.index("--dist") + 1 else 2
+        if os.environ.get("DMLC_WORKER_ID") is None:
+            return _dist_spawn(n)
+        return _dist_worker(n)
     import jax
 
     import incubator_mxnet_tpu as mx  # noqa: F401

@@ -82,6 +82,12 @@ def collect_bench(root: str) -> List[Dict[str, Any]]:
             row["reason"] = (parsed.get("error")
                             if isinstance(parsed, dict) else None) \
                 or f"no parsed output (rc={rc})"
+            # retry history (bench watchdog, post-elastic): attempts > 1
+            # means the round was given a bounded retry window and STILL
+            # wedged — a different operational story than a single-shot
+            # timeout (pre-retry records carry no attempts field: None)
+            row["attempts"] = (parsed.get("attempts")
+                               if isinstance(parsed, dict) else None)
         else:
             extra = parsed.get("extra") or {}
             row.update(blind=False, metric=parsed.get("metric"),
@@ -240,8 +246,12 @@ def render(doc: Dict[str, Any]) -> str:
         out.append("  (no BENCH_r*.json artifacts)")
     for r in doc["bench_rounds"]:
         if r.get("blind"):
+            att = r.get("attempts")
+            retry = (f"  after {att} attempts" if isinstance(att, int)
+                     and att > 1 else
+                     ("  (no retry window)" if att == 1 else ""))
             out.append(f"  r{r['round']:02d}  BLIND  rc={r['rc']}  "
-                       f"— {r['reason']}")
+                       f"— {r['reason']}{retry}")
         else:
             mfu = f"{r['mfu']:.4f}" if r.get("mfu") is not None else "?"
             out.append(f"  r{r['round']:02d}  mfu {mfu}  "

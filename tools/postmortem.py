@@ -245,6 +245,38 @@ def render(doc: Dict, events_n: int = 40) -> str:
                            "peer — diff the per-process bundles' "
                            "'banked' digests to find the site")
 
+    # -- elastic membership -----------------------------------------------
+    mem = doc.get("membership") or {}
+    if isinstance(mem, dict) and mem and "error" not in mem:
+        proc = mem.get("process") or {}
+        out += _section(
+            f"membership (process {proc.get('index', '?')}/"
+            f"{proc.get('count', '?')}, enabled={mem.get('enabled')}, "
+            f"generation={mem.get('generation')})")
+        out.append(f"  lease={mem.get('lease_s')}s "
+                   f"heartbeat={mem.get('heartbeat_s')}s "
+                   f"beats={mem.get('beats')} "
+                   f"stalled_beats={mem.get('stalled_beats')} "
+                   f"elected_primary=p{mem.get('elected')}")
+        leases = mem.get("leases") or {}
+        lost = set(mem.get("lost") or [])
+        for p in sorted(leases, key=lambda k: int(k)):
+            doc_p = leases[p] or {}
+            mark = "  !! LOST" if int(p) in lost else ""
+            out.append(
+                f"  p{p}: last heartbeat {doc_p.get('age_s', '?')}s ago "
+                f"(beat #{doc_p.get('beats', '?')}, step "
+                f"{doc_p.get('step', '?')}, collective "
+                f"{doc_p.get('collective_ms', '?')}ms){mark}")
+        for p in sorted(lost):
+            if str(p) not in leases:
+                out.append(f"  p{p}: never banked a lease  !! LOST")
+        if lost:
+            out.append("  !! host loss detected: survivors wrote one "
+                       "flight bundle each naming the dead index; "
+                       "restart on the surviving mesh and restore with "
+                       "elastic.recover (docs/observability.md runbook)")
+
     comp = doc.get("compiles") or {}
     out += _section("compile ledger")
     out.append(f"  total={comp.get('total')} "
