@@ -25,7 +25,16 @@ Usage::
     python -m benchmark.serve_bench --model bert --requests 5000
     python -m benchmark.serve_bench --replicas 3     # HA tier in front
     python -m benchmark.serve_bench --smoke --chaos-replicas  # restart drill
+    python -m benchmark.serve_bench --smoke --decode  # autoregressive serving
     python -m benchmark.serve_bench --out serve_bench.json
+
+``--decode`` swaps in the autoregressive serving section (``serve.decode``):
+ragged prompts stream through the paged-KV-cache continuous-batching stack
+and the record reports tokens/sec, ITL p50/p99, TTFT, step occupancy, the
+statically priced capacity, and the goodput serve twin — gated device-blind
+on zero post-warmup recompiles across ragged generation lengths, MX706/MX709
+clean over the decode graphs, and static capacity == the runtime block
+pool's admission limit.
 
 ``--replicas N`` runs the dynamic section through the HA serve tier —
 N :class:`Replica` workers prewarmed from a shared on-disk artifact
@@ -423,6 +432,166 @@ def dynamic_run(model, spec, make_request, n_requests: int,
     }
 
 
+def decode_run(n_requests: int, smoke: bool, out_path=None) -> int:
+    """The ``--decode`` section: autoregressive serving through the paged
+    KV-cache + continuous batching stack (``serve.decode``), gated
+    device-blind on the ISSUE's acceptance criteria:
+
+    1. **zero post-warmup recompiles** across ragged generation lengths —
+       the process-wide compile ledger's warm contract
+       (``compile_log.assert_zero_post_warmup``), not a per-model counter;
+    2. **MX706/MX709 clean** over every decode-engine graph (the bucketed
+       prefill ladder AND the AOT single-token step) via the
+       ``analysis.hlo`` staging lint;
+    3. the **static capacity** number the liveness model priced equals
+       the runtime block pool's actual admission limit, and re-pricing is
+       deterministic (same inputs → the same number).
+
+    Measured alongside: tokens/sec, ITL p50/p99, TTFT, step occupancy,
+    and the goodput serve twin (prefill-bound vs decode-bound wall split,
+    measured tokens/sec vs the per-token roofline ceiling).
+    """
+    from incubator_mxnet_tpu import nd, serve
+    from incubator_mxnet_tpu.analysis import hlo as _hlo
+    from incubator_mxnet_tpu.models.nmt import NMTModel
+    from incubator_mxnet_tpu.telemetry import compile_log
+    from incubator_mxnet_tpu.telemetry import goodput as _goodput
+
+    rng = onp.random.RandomState(0)
+    if smoke:
+        dims = dict(units=32, hidden_size=64, num_layers=2, num_heads=2)
+        vocab, max_src, max_tgt, max_batch = 31, 16, 24, 4
+    else:
+        dims = dict(units=128, hidden_size=256, num_layers=4, num_heads=4)
+        vocab, max_src, max_tgt, max_batch = 512, 64, 64, 8
+    model = NMTModel(src_vocab=vocab, tgt_vocab=vocab, dropout=0.0,
+                     max_length=max(max_src, max_tgt), prefix="decbench_",
+                     **dims)
+    model.initialize()
+    src = nd.array(rng.randint(3, vocab, (2, 6)).astype("int32"))
+    tgt = nd.array(rng.randint(3, vocab, (2, 5)).astype("int32"))
+    model(src, tgt)  # materialise params
+
+    table = serve.BucketTable({"batch": (1, 1), "src": (4, max_src)})
+    engine = serve.DecodeEngine(model, table, max_batch=max_batch,
+                                block_size=4, max_target_len=max_tgt,
+                                hbm_budget=1 << 26)
+
+    # gate 2 — staging lint over the decode entry (prefill ladder + AOT
+    # step), trace-only, before the first compile
+    analysis_rep = _hlo.verify(engine,
+                               max_graphs=max(8, table.num_buckets() + 1))
+    if analysis_rep.errors:
+        print("serve_bench --decode: analysis.hlo found "
+              f"{len(analysis_rep.errors)} error-severity finding(s): "
+              f"{[d.code for d in analysis_rep.errors]}", file=sys.stderr)
+        return 1
+
+    # gate 3 — capacity: static number == runtime admission limit, and
+    # re-pricing from the same inputs reproduces it exactly
+    capacity = dict(engine.capacity)
+    repriced = engine.capacity_report()
+    if repriced != engine.capacity:
+        print(f"serve_bench --decode: CAPACITY NOT DETERMINISTIC: "
+              f"{engine.capacity} re-priced as {repriced}", file=sys.stderr)
+        return 1
+    if capacity["max_sequences"] != engine.pool.admission_limit():
+        print("serve_bench --decode: STATIC CAPACITY MISMATCH: priced "
+              f"{capacity['max_sequences']} sequences but the pool admits "
+              f"{engine.pool.admission_limit()}", file=sys.stderr)
+        return 1
+
+    # goodput serve twin: per-token roofline ceiling from the same
+    # device-blind cost model, decode-step FLOPs per generated token
+    _goodput.configure(on=True)
+    _goodput.begin(reset_totals=True)
+    cost_rep = _hlo.cost(engine, max_graphs=max(8, table.num_buckets() + 1))
+    step_rows = [r for r in cost_rep.rows
+                 if "step" in (r.entry or "").lower()]
+    step_flops = (step_rows[-1].flops if step_rows
+                  else cost_rep.model_flops_per_step())
+    _goodput.set_serve_cost_profile(
+        flops_per_token=step_flops / max_batch,
+        source="analysis.hlo.cost(DecodeEngine.step)")
+
+    t_warm = time.perf_counter()
+    engine.warmup()
+    warm_ms = round((time.perf_counter() - t_warm) * 1e3, 1)
+    warm_compiles = len(compile_log.records())
+
+    batcher = serve.DecodeBatcher(engine).start()
+    streams, errors = [], []
+    try:
+        # ragged on BOTH axes — prompt lengths span the prefill buckets,
+        # generation lengths exercise block-boundary growth and
+        # token-boundary join/leave — so the warm contract is asserted
+        # across the shapes continuous batching actually sees
+        for i in range(n_requests):
+            ls = int(rng.randint(2, max_src))
+            prompt = rng.randint(3, vocab, (ls,)).astype("int32")
+            streams.append(batcher.submit(
+                prompt, max_new_tokens=int(rng.randint(1, max_tgt - 1)),
+                tenant=f"tenant{i % 2}"))
+        t0 = time.perf_counter()
+        for s in streams:
+            try:
+                s.result(timeout=120)
+            except Exception as e:  # noqa: BLE001 — gate evidence
+                errors.append(f"{type(e).__name__}: {e}")
+        wall = time.perf_counter() - t0
+    finally:
+        batcher.stop()
+    if errors:
+        print(f"serve_bench --decode: {len(errors)} stream error(s): "
+              f"{errors[:5]}", file=sys.stderr)
+        return 1
+
+    # gate 1 — the warm contract on the process-wide ledger: every
+    # compile so far was warmup-phase, none after
+    try:
+        compile_log.assert_zero_post_warmup()
+    except Exception as e:  # noqa: BLE001 — the gate's evidence
+        print("serve_bench --decode: ZERO-RECOMPILE CONTRACT VIOLATED "
+              f"across ragged generation lengths: {e}", file=sys.stderr)
+        return 1
+
+    snap = batcher.metrics.snapshot()
+    serve_goodput = _goodput.serve_report()
+    _goodput.configure()  # drop the programmatic override
+    tokens = snap["tokens"]
+    result = {
+        "metric": "serve_decode_tokens_per_sec",
+        "value": round(tokens / wall, 1) if wall else 0.0,
+        "unit": "tokens/sec",
+        "vs_baseline": None,
+        "extra": {
+            "backend": jax.default_backend(),
+            "requests": n_requests,
+            "tokens": tokens,
+            "wall_s": round(wall, 3),
+            "itl_ms_p50": snap["itl"].get("itl_ms_p50"),
+            "itl_ms_p99": snap["itl"].get("itl_ms_p99"),
+            "ttft_ms_p50": snap["ttft"].get("ttft_ms_p50"),
+            "step_occupancy": snap["step_occupancy"],
+            "capacity": capacity,
+            "admission_limit": engine.pool.admission_limit(),
+            "pool": engine.pool.snapshot(),
+            "warmup_ms": warm_ms,
+            "warmup_compiles": warm_compiles,
+            "post_warmup_compiles": compile_log.post_warmup_compiles(),
+            "analysis": analysis_rep.summary_dict(),
+            "goodput_serve": serve_goodput,
+            "decode_metrics": snap,
+        },
+    }
+    doc = json.dumps(result)
+    print(doc)
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(doc + "\n")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--model", default=os.environ.get(
@@ -444,6 +613,14 @@ def main(argv=None) -> int:
                     "+ corrupt_artifact mid-run, gated on zero silent "
                     "drops, full recovery, and zero post-warmup compiles "
                     "(implies --replicas 3)")
+    ap.add_argument("--decode", action="store_true",
+                    help="run the autoregressive decode section instead: "
+                    "paged KV-cache + continuous batching through "
+                    "serve.decode, gated device-blind on zero post-warmup "
+                    "recompiles across ragged generation lengths, "
+                    "MX706/MX709 clean over the decode graphs, and the "
+                    "statically priced capacity matching the runtime "
+                    "block pool's admission limit")
     ap.add_argument("--cache-dir", default=None,
                     help="artifact-cache root for --replicas (default: "
                     "a fresh temp dir)")
@@ -465,6 +642,10 @@ def main(argv=None) -> int:
                     "deliberately un-warmed), so combining them with "
                     "this flag is an error, not a vacuous pass")
     args = ap.parse_args(argv)
+    if args.decode:
+        n = args.requests if args.requests != 1000 else (
+            12 if args.smoke else 64)
+        return decode_run(n, args.smoke, out_path=args.out)
     if args.chaos_replicas and args.replicas <= 0:
         args.replicas = 3
 

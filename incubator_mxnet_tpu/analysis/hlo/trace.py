@@ -466,10 +466,15 @@ def trace_entry(model, sample_args=None, max_graphs: int = 8) -> TraceResult:
     plain callable (+ ``sample_args``)."""
     from ...gluon.block import HybridBlock, SymbolBlock
     from ...serve.compiled import CompiledModel
+    from ...serve.decode.engine import DecodeEngine
     try:
         from ...parallel.trainer import ShardedTrainer
     except Exception:                                    # pragma: no cover
         ShardedTrainer = ()
+    if isinstance(model, DecodeEngine):
+        # both graph families: every prefill bucket + the capacity-sized
+        # decode step (the engine owns the assembly)
+        return model.trace(max_graphs=max_graphs)
     if isinstance(model, CompiledModel):
         return _trace_compiled(model, sample_args, max_graphs)
     if ShardedTrainer and isinstance(model, ShardedTrainer):

@@ -84,6 +84,14 @@ class ChaosMonkey:
     ``delay_s`` in a replica's request path
     ``corrupt_artifact`` — ``should('corrupt_artifact')``: the artifact
     cache bit-flips a cached file before CRC verification
+    ``decode_block_exhaustion`` — ``should('decode_block_exhaustion')``:
+    the decode block pool raises ``CacheExhausted`` on an allocation —
+    the ``DecodeBatcher`` must requeue (bounded) or shed the stream
+    loudly, never truncate it silently
+    ``decode_replica_death`` — ``should('decode_replica_death')``: the
+    decode worker dies mid-generation at a token boundary — every
+    in-flight stream must fail with ``ReplicaUnavailable`` after ONE
+    flight bundle, never hang
     ``leak`` — ``maybe_leak(site)``: allocate and RETAIN ``leak_bytes``
     of device memory at the site (the trainer's ``trainer.step`` hook) —
     a simulated slow leak the ``telemetry.memory`` watchdog must flag
@@ -126,6 +134,8 @@ class ChaosMonkey:
                  slow_input: float = 0.0,
                  replica_kill: float = 0.0, slow_replica: float = 0.0,
                  corrupt_artifact: float = 0.0,
+                 decode_block_exhaustion: float = 0.0,
+                 decode_replica_death: float = 0.0,
                  leak: float = 0.0, leak_bytes: float = 1 << 20,
                  collective_divergence: float = 0.0,
                  grad_blowup: float = 0.0, activation_drift: float = 0.0,
@@ -140,6 +150,8 @@ class ChaosMonkey:
             "replica_kill": float(replica_kill),
             "slow_replica": float(slow_replica),
             "corrupt_artifact": float(corrupt_artifact),
+            "decode_block_exhaustion": float(decode_block_exhaustion),
+            "decode_replica_death": float(decode_replica_death),
             "leak": float(leak),
             "collective_divergence": float(collective_divergence),
             "grad_blowup": float(grad_blowup),

@@ -12,7 +12,7 @@ from . import bert  # noqa: F401
 from . import lenet  # noqa: F401
 from .lenet import LeNet  # noqa: F401
 from . import nmt  # noqa: F401
-from .nmt import NMTModel, beam_search  # noqa: F401
+from .nmt import NMTModel, beam_search, beam_search_reference  # noqa: F401
 from . import ssd  # noqa: F401
 from .ssd import SSD, SSDTargetLoss  # noqa: F401
 from . import rcnn  # noqa: F401

@@ -29,6 +29,13 @@ TVM's ahead-of-time compiled deployment, arXiv:1802.04799, meet here):
 :class:`ArtifactCache`    CRC-verified on-disk AOT artifacts so a
                           restarted replica prewarms with zero
                           post-restore compiles
+:class:`DecodeEngine`     autoregressive generation: prefill/decode
+                          split over a paged KV-cache whose capacity is
+                          priced from ``MXTPU_HBM_BUDGET`` by the
+                          liveness model (``serve.decode``)
+:class:`DecodeBatcher`    continuous batching — requests join/leave the
+                          running decode batch at token boundaries,
+                          streaming tokens through :class:`TokenStream`
 ========================  =============================================
 
 Minimal end-to-end::
@@ -55,19 +62,25 @@ from .batcher import DynamicBatcher, QueueFullError, ServeFuture  # noqa: F401
 from .metrics import ServeMetrics  # noqa: F401
 from .registry import (ModelRegistry, ModelVersion,  # noqa: F401
                        apply_weights, map_checkpoint_arrays)
-from .server import Server, client_call  # noqa: F401
+from .server import Server, client_call, client_generate  # noqa: F401
 from .artifact_cache import (ArtifactCache,  # noqa: F401
                              ArtifactCorruptError, signature_key)
 from .replica import Replica, ReplicaCrashed, ReplicaUnavailable  # noqa: F401
 from .router import (DeadlineExceeded, ReplicaSet,  # noqa: F401
-                     Router, ShedError)
+                     Router, ShedError, TokenRateBudget)
+from . import decode  # noqa: F401
+from .decode import (BlockPool, CacheExhausted, DecodeBatcher,  # noqa: F401
+                     DecodeEngine, DecodeMetrics, TokenStream)
 
 __all__ = ["BucketTable", "BucketOverflow", "round_up_pow2",
            "CompiledModel", "export_for_serving",
            "DynamicBatcher", "QueueFullError", "ServeFuture",
            "ServeMetrics", "ModelRegistry", "ModelVersion",
            "apply_weights", "map_checkpoint_arrays",
-           "Server", "client_call",
+           "Server", "client_call", "client_generate",
            "ArtifactCache", "ArtifactCorruptError", "signature_key",
            "Replica", "ReplicaUnavailable", "ReplicaCrashed",
-           "Router", "ReplicaSet", "ShedError", "DeadlineExceeded"]
+           "Router", "ReplicaSet", "ShedError", "DeadlineExceeded",
+           "TokenRateBudget",
+           "DecodeEngine", "DecodeBatcher", "TokenStream", "BlockPool",
+           "CacheExhausted", "DecodeMetrics"]

@@ -1,0 +1,41 @@
+"""mx.serve.decode — autoregressive decode serving.
+
+The serving stack above this package is one-shot: a request is one
+``predict`` and one reply. Generation breaks both of that stack's core
+assumptions — a request's cost is unknown at admission (ragged output
+lengths) and its working set grows every token (the KV cache). This
+package is the decode-shaped counterpart, three layers deep:
+
+======================  ====================================================
+:mod:`.blocks`          paged KV-cache allocator: uniform cache pages,
+                        per-sequence block tables, seat-based admission
+                        whose capacity is PRICED (not tuned) from
+                        ``MXTPU_HBM_BUDGET`` via the liveness model
+:mod:`.engine`          the prefill/decode split: bucketed prefill
+                        ``CompiledModel`` + ONE AOT fixed-shape decode
+                        step (donated in-place cache updates) — zero
+                        post-warmup recompiles across ragged lengths, by
+                        construction
+:mod:`.batcher`         continuous batching: requests join/leave the
+                        running batch at token boundaries, streaming
+                        tokens through :class:`TokenStream`
+======================  ====================================================
+
+``DecodeMetrics`` adds the token-level telemetry (ITL/TTFT histograms
+feeding the ``decode-itl`` SLO built-ins); ``analysis.hlo.verify``
+dispatches on :class:`DecodeEngine` so the MX706/MX709 lint gates cover
+both graph families device-blind.
+"""
+from .blocks import (BlockPool, CacheExhausted, block_bytes,
+                     blocks_per_sequence, price_capacity)
+from .engine import DECODE_SITE, DecodeEngine, PrefillEntry
+from .batcher import DecodeBatcher, TokenStream
+from .metrics import DecodeMetrics
+
+__all__ = [
+    "BlockPool", "CacheExhausted", "blocks_per_sequence", "block_bytes",
+    "price_capacity",
+    "DecodeEngine", "PrefillEntry", "DECODE_SITE",
+    "DecodeBatcher", "TokenStream",
+    "DecodeMetrics",
+]

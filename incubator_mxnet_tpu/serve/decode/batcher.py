@@ -1,0 +1,534 @@
+"""DecodeBatcher — continuous batching at token boundaries.
+
+:class:`~..batcher.DynamicBatcher` coalesces one-shot requests and drains
+a whole batch before admitting the next; generation would make that
+catastrophic — a 4-token reply would wait for the 64-token straggler it
+was co-batched with. The decode batcher instead keeps ONE fixed-shape
+decode batch running and lets requests **join and leave between steps**:
+
+- ``submit(prompt)`` enqueues and returns a :class:`TokenStream`
+  (a streaming :class:`~..batcher.ServeFuture` sibling — tokens arrive
+  as they are generated, ``result()`` waits for the full sequence);
+- the worker ("mx-decode-batcher") runs one engine step per token
+  boundary; before each step it admits queued requests into free batch
+  rows while the block pool has seats (seat-based admission — the priced
+  capacity), running their bucketed prefill;
+- a sequence leaves the instant it emits EOS or hits its token budget:
+  its pages free, its row opens, the next queued request takes it on the
+  very next boundary — no drain barrier, which is what keeps step
+  occupancy (and therefore tokens/sec) high under ragged lengths.
+
+Chaos contract (``fault.inject``): seeded ``decode_block_exhaustion``
+sheds/requeues loudly (``decode.shed``/``decode.requeue`` events, bounded
+requeues, then a :class:`~.blocks.CacheExhausted` on the stream);
+``decode_replica_death`` fails every in-flight stream with
+``ReplicaUnavailable`` after ONE flight-recorder bundle — a stream never
+hangs and is never silently truncated.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from typing import List, Optional
+
+import numpy as onp
+
+from ...base import MXNetError
+from ...lockcheck import make_lock
+from ... import profiler
+from ...telemetry import events as _tele
+from ...telemetry import trace as _trace
+from ...telemetry import goodput as _goodput
+from ..batcher import QueueFullError
+from .blocks import CacheExhausted
+from .engine import DecodeEngine
+from .metrics import DecodeMetrics
+
+__all__ = ["DecodeBatcher", "TokenStream"]
+
+_STREAM_IDS = itertools.count(1)
+
+
+class TokenStream:
+    """Streaming result handle: tokens land one by one; the full sequence
+    lands at :meth:`result`. API-compatible with
+    :class:`~..batcher.ServeFuture` (``done``/``wait``/``result``/
+    ``set_exception``) so router/client plumbing treats both alike."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._tokens: List[int] = []
+        self._read = 0
+        self._finished = False
+        self._reason: Optional[str] = None
+        self._exc: Optional[BaseException] = None
+
+    # -- producer side (batcher worker) ---------------------------------
+    def put_token(self, tok: int) -> None:
+        with self._cond:
+            self._tokens.append(int(tok))
+            self._cond.notify_all()
+
+    def finish(self, reason: str = "eos") -> None:
+        with self._cond:
+            self._finished = True
+            self._reason = reason
+            self._cond.notify_all()
+
+    def set_exception(self, exc: BaseException) -> None:
+        with self._cond:
+            self._exc = exc
+            self._finished = True
+            self._cond.notify_all()
+
+    def set_result(self, tokens) -> None:
+        with self._cond:
+            self._tokens = [int(t) for t in tokens]
+            self._finished = True
+            self._reason = "set_result"
+            self._cond.notify_all()
+
+    # -- consumer side ---------------------------------------------------
+    def next_token(self, timeout: Optional[float] = None) -> Optional[int]:
+        """Block for the next unread token; ``None`` = stream finished.
+        Raises the stream's exception (a failed stream never hangs)."""
+        with self._cond:
+            while True:
+                if self._exc is not None:
+                    raise self._exc
+                if self._read < len(self._tokens):
+                    self._read += 1
+                    return self._tokens[self._read - 1]
+                if self._finished:
+                    return None
+                if not self._cond.wait(timeout):
+                    raise TimeoutError("no token within timeout; stream "
+                                       "still generating")
+
+    def tokens(self) -> List[int]:
+        with self._cond:
+            return list(self._tokens)
+
+    def done(self) -> bool:
+        with self._cond:
+            return self._finished
+
+    def finish_reason(self) -> Optional[str]:
+        with self._cond:
+            return self._reason
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        with self._cond:
+            self._cond.wait_for(lambda: self._finished, timeout)
+            return self._finished
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        """The full generated sequence (excluding BOS, including EOS when
+        emitted)."""
+        with self._cond:
+            if not self._cond.wait_for(lambda: self._finished, timeout):
+                raise TimeoutError("generation still in flight")
+            if self._exc is not None:
+                raise self._exc
+            return list(self._tokens)
+
+
+class _DecodeRequest:
+    __slots__ = ("src", "valid", "max_new", "tenant", "stream", "t_enqueue",
+                 "rid", "span", "requeues")
+
+    def __init__(self, src, valid, max_new, tenant):
+        self.src = src
+        self.valid = valid
+        self.max_new = max_new
+        self.tenant = tenant
+        self.stream = TokenStream()
+        self.t_enqueue = time.perf_counter()
+        self.rid = f"d{next(_STREAM_IDS)}"
+        self.span = None
+        self.requeues = 0
+
+
+class _Active:
+    __slots__ = ("req", "row", "last_token", "produced", "t_admit", "t_last")
+
+    def __init__(self, req: _DecodeRequest, row: int, bos: int):
+        self.req = req
+        self.row = row
+        self.last_token = bos
+        self.produced = 0
+        self.t_admit = time.perf_counter()
+        self.t_last = self.t_admit
+
+
+class DecodeBatcher:
+    """Continuous batching over one :class:`~.engine.DecodeEngine`.
+
+    ``submit(prompt_tokens)`` → :class:`TokenStream`. Env knobs:
+    ``MXTPU_DECODE_QUEUE_LIMIT``, ``MXTPU_DECODE_MAX_REQUEUES`` (see
+    docs/env_vars.md).
+    """
+
+    def __init__(self, engine: DecodeEngine,
+                 queue_limit: Optional[int] = None,
+                 max_requeues: Optional[int] = None,
+                 block_secs: float = 0.0,
+                 metrics: Optional[DecodeMetrics] = None,
+                 qos=None):
+        from ...util import getenv
+        self.engine = engine
+        #: optional router.TokenRateBudget: per-tenant tokens/sec QoS,
+        #: consulted BEFORE a request queues (shed-before-breach)
+        self.qos = qos
+        self.queue_limit = int(getenv("MXTPU_DECODE_QUEUE_LIMIT")
+                               if queue_limit is None else queue_limit)
+        self.max_requeues = int(getenv("MXTPU_DECODE_MAX_REQUEUES")
+                                if max_requeues is None else max_requeues)
+        self.block_secs = float(block_secs)
+        self.metrics = metrics or DecodeMetrics()
+        self._queue: deque = deque()
+        self._lock = make_lock("DecodeBatcher._lock")
+        self._wake = threading.Event()
+        self._active: List[Optional[_Active]] = [None] * engine.max_batch
+        #: requests popped from the queue but not yet landed in a batch
+        #: row — stop(drain=True) must not mistake this window for idle
+        self._inflight_admits = 0
+        self._stop = False
+        self._closed = False
+        self._worker: Optional[threading.Thread] = None
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> "DecodeBatcher":
+        if self._worker is None or not self._worker.is_alive():
+            self._stop = False
+            self._closed = False
+            self._worker = threading.Thread(target=self._run,
+                                            name="mx-decode-batcher",
+                                            daemon=True)
+            self._worker.start()
+        return self
+
+    def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """``drain=True`` finishes in-flight generation and the queue
+        first (bounded by ``timeout`` on the monotonic clock); leftovers
+        fail loudly with "batcher stopped". One ``decode.drain`` event
+        records the drained/abandoned split."""
+        t0 = time.monotonic()
+        served_before = self.metrics.requests
+        self._closed = True
+        if self._worker is not None:
+            if drain:
+                while ((self.depth() or self.active_sequences()
+                        or self._admits_in_flight())
+                       and time.monotonic() - t0 < timeout):
+                    time.sleep(0.005)
+            self._stop = True
+            self._wake.set()
+            self._worker.join(timeout)
+        abandoned = 0
+        with self._lock:
+            leftovers = list(self._queue)
+            self._queue.clear()
+        for req in leftovers:
+            req.stream.set_exception(MXNetError("batcher stopped"))
+            if req.span is not None:
+                req.span.finish(outcome="abandoned")
+            abandoned += 1
+        for act in list(self._active):
+            if act is None:
+                continue
+            self._retire(act, reason="stopped",
+                         exc=MXNetError("batcher stopped"))
+            abandoned += 1
+        _tele.emit("decode.drain",
+                   severity="warning" if abandoned else "info",
+                   model=self.metrics.model, drain=bool(drain),
+                   drained=self.metrics.requests - served_before,
+                   abandoned=abandoned,
+                   wall_ms=round((time.monotonic() - t0) * 1e3, 3))
+
+    def worker_alive(self) -> bool:
+        w = self._worker
+        return w is not None and w.is_alive()
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    def active_sequences(self) -> int:
+        with self._lock:
+            return sum(1 for a in self._active if a is not None)
+
+    def _admits_in_flight(self) -> int:
+        with self._lock:
+            return self._inflight_admits
+
+    def retry_after_s(self) -> float:
+        """Backoff hint: roughly one sequence's residence time per queued
+        batch-slot wave."""
+        waves = max(1, (self.depth() + self.engine.max_batch - 1)
+                    // self.engine.max_batch)
+        return round(max(0.05, waves * 0.1), 3)
+
+    def stats(self) -> dict:
+        return {"metrics": self.metrics.snapshot(),
+                "engine": self.engine.stats(),
+                "queue_depth": self.depth(),
+                "active_sequences": self.active_sequences()}
+
+    # -- client side ----------------------------------------------------
+    def submit(self, src_tokens, valid_len: Optional[int] = None,
+               max_new_tokens: Optional[int] = None,
+               tenant: Optional[str] = None) -> TokenStream:
+        """Enqueue one prompt; returns its token stream. Oversized
+        prompts are rejected here (bucket-table overflow), a full queue
+        raises :class:`~..batcher.QueueFullError` (after blocking up to
+        ``block_secs`` when configured)."""
+        src = onp.asarray(src_tokens, "int32").reshape(-1)
+        self.engine._table.bucket("src", src.shape[0])  # raises on overflow
+        max_new = min(int(max_new_tokens or self.engine.max_target_len - 1),
+                      self.engine.max_target_len - 1)
+        req = _DecodeRequest(src, valid_len, max_new, tenant)
+        if self.qos is not None and not self.qos.try_take(
+                tenant or "default", max_new):
+            self.metrics.record_shed()
+            _tele.emit("decode.shed", severity="warning",
+                       request_id=req.rid, model=self.metrics.model,
+                       tenant=tenant, reason="tenant_tokens",
+                       est_tokens=max_new)
+            from ..router import ShedError
+            raise ShedError(
+                f"tenant {tenant or 'default'!r} is over its tokens/sec "
+                f"budget ({self.qos.rate}/s, est {max_new} tokens)",
+                retry_after=self.retry_after_s(), reason="tenant_tokens")
+        if _trace.current() is not None:
+            req.span = _trace.start_span("decode.request", kind="server",
+                                         request=req.rid,
+                                         model=self.metrics.model)
+        deadline = time.time() + self.block_secs
+        while True:
+            with self._lock:
+                if self._closed:
+                    if req.span is not None:
+                        req.span.finish(error="batcher_stopped")
+                    raise MXNetError("batcher stopped; submit rejected")
+                if len(self._queue) < self.queue_limit:
+                    self._queue.append(req)
+                    break
+            if time.time() >= deadline:
+                self.metrics.record_shed()
+                _tele.emit("decode.shed", severity="warning",
+                           request_id=req.rid, model=self.metrics.model,
+                           reason="queue_full",
+                           queue_limit=self.queue_limit)
+                if req.span is not None:
+                    req.span.finish(outcome="rejected")
+                raise QueueFullError(
+                    f"decode queue is full ({self.queue_limit} requests); "
+                    "backpressure — retry with backoff or raise "
+                    "MXTPU_DECODE_QUEUE_LIMIT")
+            time.sleep(0.0005)
+        with _trace.use(req.span.ctx if req.span is not None else None):
+            _tele.emit("decode.admit", request_id=req.rid,
+                       model=self.metrics.model, depth=self.depth())
+        self._wake.set()
+        return req.stream
+
+    # -- worker side ----------------------------------------------------
+    def _run(self) -> None:
+        while not self._stop:
+            admitted = self._admit_pending()
+            if any(a is not None for a in self._active):
+                self._step_once()
+                continue
+            if not admitted:
+                self._wake.wait(timeout=0.05 if self.depth() else None)
+                self._wake.clear()
+
+    def _free_row(self) -> Optional[int]:
+        for i, a in enumerate(self._active):
+            if a is None:
+                return i
+        return None
+
+    def _admit_pending(self) -> bool:
+        """Token-boundary join: move queued requests into free batch rows
+        while the block pool has seats. Prefill runs here (bucketed, a
+        warm compile-cache hit)."""
+        admitted = False
+        while True:
+            row = self._free_row()
+            if row is None or not self.engine.pool.can_admit():
+                return admitted
+            with self._lock:
+                if not self._queue:
+                    return admitted
+                req = self._queue.popleft()
+                self._inflight_admits += 1
+            try:
+                try:
+                    table = self.engine.pool.alloc_sequence(req.rid)
+                except CacheExhausted as e:
+                    self._bounce(req, e)
+                    continue
+                try:
+                    t0 = time.perf_counter()
+                    with profiler.Scope("decode.prefill"):
+                        cross_row, lp = self.engine.prefill_request(
+                            req.src, req.valid)
+                    if _goodput.enabled():
+                        _goodput.note_serve(
+                            "prefill", tokens=lp,
+                            wall_ms=(time.perf_counter() - t0) * 1e3)
+                except BaseException as e:  # noqa: BLE001 — to the stream
+                    self.engine.pool.free_sequence(req.rid)
+                    req.stream.set_exception(e)
+                    self.metrics.record_failed()
+                    if req.span is not None:
+                        req.span.finish(error=type(e).__name__)
+                    _tele.emit("decode.execute", severity="error",
+                               request_id=req.rid, model=self.metrics.model,
+                               stage="prefill",
+                               error=f"{type(e).__name__}: {e}")
+                    continue
+                self.engine.bind_row(row, cross_row, lp)
+                self.engine.set_row_table(row, table)
+                self._active[row] = _Active(req, row, self.engine.bos_id)
+                admitted = True
+                with _trace.use(req.span.ctx
+                                if req.span is not None else None):
+                    _tele.emit("decode.join", request_id=req.rid,
+                               model=self.metrics.model, row=row,
+                               prompt_len=lp,
+                               active=self.active_sequences())
+            finally:
+                with self._lock:
+                    self._inflight_admits -= 1
+        return admitted
+
+    def _bounce(self, req: _DecodeRequest, exc: CacheExhausted) -> None:
+        """Cache-pressure admission failure: requeue (bounded), then shed
+        loudly — never silently drop."""
+        req.requeues += 1
+        if req.requeues <= self.max_requeues:
+            self.metrics.record_requeue()
+            _tele.emit("decode.requeue", severity="warning",
+                       request_id=req.rid, model=self.metrics.model,
+                       attempt=req.requeues, error=str(exc))
+            with self._lock:
+                self._queue.append(req)
+        else:
+            self.metrics.record_shed()
+            _tele.emit("decode.shed", severity="warning",
+                       request_id=req.rid, model=self.metrics.model,
+                       reason="cache_exhausted", attempts=req.requeues)
+            if req.span is not None:
+                req.span.finish(outcome="shed")
+            req.stream.set_exception(exc)
+
+    def _step_once(self) -> None:
+        """One token boundary: advance every active sequence one token
+        through the fixed-shape decode executable."""
+        from ...fault import inject
+        mk = inject.active()
+        if mk is not None and mk.should("decode_replica_death"):
+            self._replica_death()
+            return
+        B = self.engine.max_batch
+        positions = onp.zeros((B,), "int32")
+        tokens = onp.zeros((B,), "int32")
+        stepping: List[_Active] = []
+        for act in self._active:
+            if act is None:
+                continue
+            try:
+                _page, _slot, table = self.engine.pool.append_token(
+                    act.req.rid)
+            except CacheExhausted as e:
+                # only reachable via chaos (seat-based admission): fail
+                # the stream loudly rather than truncate it silently
+                self._retire(act, reason="cache_exhausted", exc=e)
+                continue
+            self.engine.set_row_table(act.row, table)
+            positions[act.row] = (
+                self.engine.pool.sequence_length(act.req.rid) - 1)
+            tokens[act.row] = act.last_token
+            stepping.append(act)
+        if not stepping:
+            return
+        t0 = time.perf_counter()
+        with profiler.Scope("decode.step"):
+            logits = self.engine.run_step(positions, tokens)
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        self.metrics.record_step(len(stepping), B)
+        if _goodput.enabled():
+            _goodput.note_serve("decode", tokens=len(stepping),
+                                wall_ms=dt_ms)
+        now = time.perf_counter()
+        for act in stepping:
+            nxt = int(onp.argmax(logits[act.row]))
+            if act.produced == 0:
+                self.metrics.record_first_token(
+                    (now - act.req.t_enqueue) * 1e3)
+            self.metrics.record_token((now - act.t_last) * 1e3)
+            act.t_last = now
+            act.produced += 1
+            act.last_token = nxt
+            act.req.stream.put_token(nxt)
+            if nxt == self.engine.eos_id:
+                self._retire(act, reason="eos")
+            elif act.produced >= act.req.max_new:
+                self._retire(act, reason="length")
+
+    def _retire(self, act: _Active, reason: str,
+                exc: Optional[BaseException] = None) -> None:
+        """Token-boundary leave: free the pages and the batch row; the
+        next queued request joins on the following boundary."""
+        self.engine.pool.free_sequence(act.req.rid)
+        self.engine.clear_row(act.row)
+        self._active[act.row] = None
+        req = act.req
+        lat_ms = (time.perf_counter() - req.t_enqueue) * 1e3
+        with _trace.use(req.span.ctx if req.span is not None else None):
+            if exc is None:
+                self.metrics.record_stream_done(lat_ms)
+                req.stream.finish(reason)
+                _tele.emit("decode.reply", request_id=req.rid,
+                           model=self.metrics.model, reason=reason,
+                           tokens=act.produced,
+                           latency_ms=round(lat_ms, 3))
+            else:
+                self.metrics.record_failed()
+                req.stream.set_exception(exc)
+                _tele.emit("decode.execute", severity="error",
+                           request_id=req.rid, model=self.metrics.model,
+                           stage="decode", reason=reason,
+                           error=f"{type(exc).__name__}: {exc}")
+        if req.span is not None:
+            if exc is None:
+                req.span.finish(latency_ms=round(lat_ms, 3),
+                                tokens=act.produced, reason=reason)
+            else:
+                req.span.finish(error=type(exc).__name__)
+
+    def _replica_death(self) -> None:
+        """Chaos mid-generation replica death: ONE flight bundle, every
+        in-flight stream fails with ``ReplicaUnavailable`` (the router's
+        retry classifier requeues it) — nothing hangs, nothing truncates
+        silently."""
+        from ...telemetry import flight as _flight
+        from ..replica import ReplicaUnavailable
+        victims = [a for a in self._active if a is not None]
+        _tele.emit("decode.replica_death", severity="error",
+                   model=self.metrics.model, in_flight=len(victims),
+                   queued=self.depth())
+        _flight.dump("decode_replica_death", model=self.metrics.model,
+                     in_flight=len(victims), queued=self.depth())
+        exc = ReplicaUnavailable(
+            "decode replica died mid-generation (chaos); stream aborted — "
+            "requeue the request")
+        for act in victims:
+            self._retire(act, reason="replica_death", exc=exc)
+        self.engine.reset_cache()

@@ -1,0 +1,143 @@
+"""Token-level decode observability — ServeMetrics' streaming sibling.
+
+One-shot inference has one latency; a token stream has three that matter
+independently: **TTFT** (time to first token — prefill + queueing),
+**ITL** (inter-token latency — the per-step cadence the SLO monitor's
+p50/p99 built-ins gate), and end-to-end request latency. Plus the decode
+batcher's own health: tokens/sec, step occupancy (active rows ÷ batch
+rows), admissions, requeues (cache-pressure sheds), and pool pressure.
+
+Registry series (``mxtpu_decode_*``, labeled by model) feed the
+Prometheus scrape and the ``decode-itl`` SLO built-ins
+(``telemetry.slo.default_slos``); the instance view is the window
+``snapshot()`` the bench dumps.
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict
+
+from ...lockcheck import make_lock
+from ...telemetry import metrics as tmetrics
+from ...telemetry.metrics import Histogram
+from ..metrics import _j
+
+__all__ = ["DecodeMetrics"]
+
+
+class DecodeMetrics:
+    """Thread-safe token/stream counters for one decode batcher."""
+
+    def __init__(self, reservoir: int = 8192, model: str = "default"):
+        self._lock = make_lock("DecodeMetrics._lock")
+        self.model = model
+        self._itl = Histogram(name="itl_ms", q=(50, 99), reservoir=reservoir)
+        self._ttft = Histogram(name="ttft_ms", q=(50, 99),
+                               reservoir=reservoir)
+        self._latency = Histogram(name="latency_ms", q=(50, 95, 99),
+                                  reservoir=reservoir)
+        self._g = {
+            "requests": tmetrics.counter(
+                "mxtpu_decode_requests_total",
+                "Decode requests completed", model=model),
+            "tokens": tmetrics.counter(
+                "mxtpu_decode_tokens_total",
+                "Tokens generated", model=model),
+            "shed": tmetrics.counter(
+                "mxtpu_decode_shed_total",
+                "Decode requests shed (queue/cache pressure)", model=model),
+            "requeued": tmetrics.counter(
+                "mxtpu_decode_requeued_total",
+                "Admissions bounced back to the queue", model=model),
+            "failed": tmetrics.counter(
+                "mxtpu_decode_failed_total",
+                "Streams failed with an exception", model=model),
+            "steps": tmetrics.counter(
+                "mxtpu_decode_steps_total",
+                "Fixed-shape decode steps executed", model=model),
+            "itl": tmetrics.histogram(
+                "mxtpu_decode_itl_ms",
+                "Inter-token latency (ms)", q=(50, 99), model=model),
+            "ttft": tmetrics.histogram(
+                "mxtpu_decode_ttft_ms",
+                "Time to first token (ms)", q=(50, 99), model=model),
+        }
+        self.requests = 0
+        self.tokens = 0
+        self.shed = 0
+        self.requeued = 0
+        self.failed = 0
+        self.steps = 0
+        self.step_rows = 0
+        self.step_capacity = 0
+
+    # -- recording ------------------------------------------------------
+    def record_token(self, itl_ms: float) -> None:
+        with self._lock:
+            self.tokens += 1
+            self._itl.observe(itl_ms)
+        self._g["tokens"].inc()
+        self._g["itl"].observe(itl_ms)
+
+    def record_first_token(self, ttft_ms: float) -> None:
+        with self._lock:
+            self._ttft.observe(ttft_ms)
+        self._g["ttft"].observe(ttft_ms)
+
+    def record_stream_done(self, latency_ms: float) -> None:
+        with self._lock:
+            self.requests += 1
+            self._latency.observe(latency_ms)
+        self._g["requests"].inc()
+
+    def record_shed(self) -> None:
+        with self._lock:
+            self.shed += 1
+        self._g["shed"].inc()
+
+    def record_requeue(self) -> None:
+        with self._lock:
+            self.requeued += 1
+        self._g["requeued"].inc()
+
+    def record_failed(self) -> None:
+        with self._lock:
+            self.failed += 1
+        self._g["failed"].inc()
+
+    def record_step(self, active_rows: int, capacity_rows: int) -> None:
+        with self._lock:
+            self.steps += 1
+            self.step_rows += active_rows
+            self.step_capacity += capacity_rows
+        self._g["steps"].inc()
+
+    # -- reporting ------------------------------------------------------
+    def snapshot(self) -> Dict:
+        from ..metrics import ServeMetrics
+        with self._lock:
+            return {
+                "requests": self.requests,
+                "tokens": self.tokens,
+                "shed": self.shed,
+                "requeued": self.requeued,
+                "failed": self.failed,
+                "steps": self.steps,
+                "step_occupancy": _j(self.step_rows / self.step_capacity, 4)
+                if self.step_capacity else None,
+                "itl": ServeMetrics._pcts(self._itl),
+                "ttft": ServeMetrics._pcts(self._ttft),
+                "latency": ServeMetrics._pcts(self._latency),
+            }
+
+    def dumps(self) -> str:
+        return json.dumps(self.snapshot(), indent=1, sort_keys=True)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._itl.reset()
+            self._ttft.reset()
+            self._latency.reset()
+            self.requests = self.tokens = self.shed = 0
+            self.requeued = self.failed = self.steps = 0
+            self.step_rows = self.step_capacity = 0

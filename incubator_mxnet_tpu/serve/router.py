@@ -56,7 +56,71 @@ from ..telemetry import trace as _trace
 from .batcher import QueueFullError, ServeFuture
 from .replica import Replica, ReplicaUnavailable
 
-__all__ = ["Router", "ReplicaSet", "ShedError", "DeadlineExceeded"]
+__all__ = ["Router", "ReplicaSet", "ShedError", "DeadlineExceeded",
+           "TokenRateBudget"]
+
+
+class TokenRateBudget:
+    """Per-tenant tokens/sec QoS — the decode-era extension of the
+    request-count inflight cap.
+
+    A classic token bucket per tenant: ``rate`` tokens/sec sustained,
+    ``burst`` depth (default one second's budget). :meth:`try_take` is
+    consulted with a request's *estimated* token cost BEFORE it queues —
+    shed-before-breach: a tenant over budget is refused at admission
+    (cheap, with ``retry_after``) instead of after its generation has
+    held decode batch rows. ``rate`` 0/unset = unlimited (every take
+    succeeds). Thread-safe; refill is lazy on the monotonic clock.
+    """
+
+    def __init__(self, tokens_per_s: Optional[float] = None,
+                 burst: Optional[float] = None):
+        from ..util import getenv
+        self.rate = float(getenv("MXTPU_SERVE_TENANT_TOKENS_PER_S")
+                          if tokens_per_s is None else tokens_per_s)
+        b = float(getenv("MXTPU_SERVE_TENANT_TOKEN_BURST")
+                  if burst is None else burst)
+        self.burst = b if b > 0 else max(self.rate, 1.0)
+        self._lock = make_lock("TokenRateBudget._lock")
+        self._level: Dict[str, float] = {}
+        self._mark: Dict[str, float] = {}
+
+    def enabled(self) -> bool:
+        return self.rate > 0
+
+    def try_take(self, tenant: str, tokens: float) -> bool:
+        """Debit ``tokens`` from ``tenant``'s bucket if it fits; False =
+        over budget (shed the request, do not queue it)."""
+        if not self.enabled() or tokens <= 0:
+            return True
+        now = time.monotonic()
+        with self._lock:
+            level = self._level.get(tenant, self.burst)
+            mark = self._mark.get(tenant, now)
+            level = min(self.burst, level + (now - mark) * self.rate)
+            if tokens > level:
+                self._level[tenant] = level
+                self._mark[tenant] = now
+                return False
+            self._level[tenant] = level - tokens
+            self._mark[tenant] = now
+            return True
+
+    def headroom(self, tenant: str) -> float:
+        """Current bucket level (tokens) — monitoring only."""
+        if not self.enabled():
+            return float("inf")
+        now = time.monotonic()
+        with self._lock:
+            level = self._level.get(tenant, self.burst)
+            mark = self._mark.get(tenant, now)
+            return min(self.burst, level + (now - mark) * self.rate)
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            return {"tokens_per_s": self.rate, "burst": self.burst,
+                    "tenants": {t: round(v, 3)
+                                for t, v in self._level.items()}}
 
 
 class ShedError(MXNetError):
@@ -138,6 +202,8 @@ class Router:
                  hedge_ms: Optional[float] = None,
                  shed_depth: Optional[int] = None,
                  tenant_inflight: Optional[int] = None,
+                 tenant_tokens_per_s: Optional[float] = None,
+                 tenant_token_burst: Optional[float] = None,
                  request_timeout_s: Optional[float] = None,
                  restart_backoff_s: float = 0.5):
         from ..util import getenv
@@ -158,6 +224,8 @@ class Router:
         self.tenant_inflight = int(
             getenv("MXTPU_SERVE_TENANT_INFLIGHT")
             if tenant_inflight is None else tenant_inflight)
+        self.token_budget = TokenRateBudget(tenant_tokens_per_s,
+                                            tenant_token_burst)
         self.request_timeout_s = float(
             getenv("MXTPU_SERVE_REQUEST_TIMEOUT_S")
             if request_timeout_s is None else request_timeout_s)
@@ -267,7 +335,8 @@ class Router:
                       "Accepted requests that hit their deadline").inc()
         return DeadlineExceeded(msg, self.retry_after_s())
 
-    def _admit(self, model: str, tenant: Optional[str]) -> None:
+    def _admit(self, model: str, tenant: Optional[str],
+               est_tokens: int = 0) -> None:
         healthy = self.replicas.healthy()
         if not healthy:
             raise self._shed("no_healthy_replica",
@@ -280,6 +349,15 @@ class Router:
                 f"every healthy replica is at/over the shed depth "
                 f"({self.shed_depth})", model, tenant)
         key = tenant or "default"
+        # tokens/sec QoS before the inflight seat: an over-budget tenant
+        # is refused while the request is still cheap (nothing queued,
+        # no decode rows held) — shed-before-breach
+        if est_tokens and not self.token_budget.try_take(key, est_tokens):
+            raise self._shed(
+                "tenant_tokens",
+                f"tenant {key!r} is over its tokens/sec budget "
+                f"({self.token_budget.rate}/s, est {est_tokens} tokens)",
+                model, tenant)
         if self.tenant_inflight:
             with self._lock:
                 if self._inflight.get(key, 0) >= self.tenant_inflight:
@@ -302,7 +380,8 @@ class Router:
 
     # -- request path ---------------------------------------------------
     def call(self, model: str, *arrays, timeout_s: Optional[float] = None,
-             tenant: Optional[str] = None, idempotent: bool = True):
+             tenant: Optional[str] = None, idempotent: bool = True,
+             est_tokens: int = 0):
         """Route one single-example request; returns the model output(s).
 
         Raises :class:`ShedError` (admission/overload/placement, with
@@ -310,15 +389,19 @@ class Router:
         with ``retry_after``), or the request's own validation error.
         Every infrastructure failure in between is retried on a surviving
         replica when ``idempotent`` (the default) — an accepted request
-        is never silently dropped.
+        is never silently dropped. ``est_tokens`` (decode front ends pass
+        the request's ``max_new_tokens``) is debited against the tenant's
+        :class:`TokenRateBudget` at admission.
         """
         return self.call_detailed(model, *arrays, timeout_s=timeout_s,
-                                  tenant=tenant, idempotent=idempotent)[0]
+                                  tenant=tenant, idempotent=idempotent,
+                                  est_tokens=est_tokens)[0]
 
     def call_detailed(self, model: str, *arrays,
                       timeout_s: Optional[float] = None,
                       tenant: Optional[str] = None,
-                      idempotent: bool = True) -> Tuple[object, Dict]:
+                      idempotent: bool = True,
+                      est_tokens: int = 0) -> Tuple[object, Dict]:
         """:meth:`call` plus a per-request info dict — ``{replica,
         failovers, retries, hedged, latency_ms, trace_id}`` — so benches
         can split failover-path tail latency from the happy path.
@@ -352,7 +435,7 @@ class Router:
             # records no spans — consumers (the bench stitching gate)
             # must not expect a tree for it
             info["trace_sampled"] = sp.ctx.sampled
-            self._admit(model, tenant)
+            self._admit(model, tenant, est_tokens=est_tokens)
             try:
                 val = self._call_admitted(model, arrays, t_deadline,
                                           tenant, idempotent, info)
@@ -739,11 +822,13 @@ class Router:
             inflight = dict(self._inflight)
         return {"replicas": self.replicas.states(),
                 "stats": stats, "tenants_inflight": inflight,
+                "token_budget": self.token_budget.snapshot(),
                 "policy": {"retries": self.retries,
                            "backoff_ms": self.backoff_ms,
                            "hedge_ms": self.hedge_ms,
                            "shed_depth": self.shed_depth,
                            "tenant_inflight": self.tenant_inflight,
+                           "tenant_tokens_per_s": self.token_budget.rate,
                            "heartbeat_ms": self.heartbeat_ms,
                            "stall_s": self.stall_s,
                            "request_timeout_s": self.request_timeout_s}}

@@ -20,6 +20,19 @@ Wire protocol: newline-delimited JSON over TCP, one object per request::
     {"cmd": "prometheus"}  -> {"ok": true, "text": "<metrics scrape>"}
     {"cmd": "telemetry"}   -> {"ok": true, "telemetry": {...snapshot...}}
 
+Generation streams — one reply line per token as it is produced by an
+attached :class:`~.decode.DecodeBatcher` (:meth:`Server.attach_decoder`),
+then a terminal ``done`` line::
+
+    {"cmd": "generate", "model": "nmt", "tokens": [5, 9, 3],
+     "max_new_tokens": 16, "tenant": "t1"}
+    -> {"ok": true, "token": 7, "i": 0}
+    -> {"ok": true, "token": 2, "i": 1}
+    -> {"ok": true, "done": true, "reason": "eos", "tokens": [7, 2],
+        "latency_ms": 12.1}
+
+:func:`client_generate` is the matching streaming client (a generator).
+
 The optional ``trace`` field carries W3C-style distributed-trace context
 across the wire (``mx.telemetry.trace``): the server resumes the
 caller's context and opens one ``serve.wire`` span over the request, so
@@ -56,7 +69,7 @@ from ..telemetry import trace as _trace
 from .batcher import DynamicBatcher, ServeFuture
 from .registry import ModelRegistry
 
-__all__ = ["Server", "client_call"]
+__all__ = ["Server", "client_call", "client_generate"]
 
 
 class Server:
@@ -78,6 +91,7 @@ class Server:
         self._batcher_kw = dict(max_delay_ms=max_delay_ms,
                                 queue_limit=queue_limit)
         self._batchers: Dict[str, DynamicBatcher] = {}
+        self._decoders: Dict[str, object] = {}
         self._lock = make_lock("Server._lock")
         self._tcp: Optional[socketserver.ThreadingTCPServer] = None
         self._tcp_thread: Optional[threading.Thread] = None
@@ -104,6 +118,23 @@ class Server:
         """Enqueue one single-example request for ``name``'s active
         version; returns the future."""
         return self.batcher(name).submit(*arrays)
+
+    def attach_decoder(self, name: str, batcher) -> None:
+        """Expose a started :class:`~.decode.DecodeBatcher` under model
+        name ``name`` for the ``generate`` wire command (decoders wrap a
+        live model + engine, so they attach explicitly rather than load
+        through the registry)."""
+        with self._lock:
+            self._decoders[name] = batcher
+
+    def decoder(self, name: str):
+        with self._lock:
+            b = self._decoders.get(name)
+        if b is None:
+            raise MXNetError(
+                f"no decoder attached for model {name!r}; call "
+                "Server.attach_decoder(name, DecodeBatcher) first")
+        return b
 
     def metrics(self, name: str) -> dict:
         b = self.batcher(name)
@@ -147,9 +178,14 @@ class Server:
                         trace_id = getattr(e, "trace_id", None)
                         if trace_id is not None:
                             reply["trace_id"] = trace_id
-                    self.wfile.write(
-                        (json.dumps(reply) + "\n").encode("utf-8"))
-                    self.wfile.flush()
+                    # a generate stream returns an iterator of reply
+                    # docs — each is written (and flushed) as the token
+                    # is produced, so the client reads a live stream
+                    replies = [reply] if isinstance(reply, dict) else reply
+                    for doc in replies:
+                        self.wfile.write(
+                            (json.dumps(doc) + "\n").encode("utf-8"))
+                        self.wfile.flush()
 
         class TCP(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
@@ -170,6 +206,9 @@ class Server:
         with self._lock:
             batchers = list(self._batchers.values())
             self._batchers.clear()
+            # attached decoders are externally owned (they wrap a caller-
+            # built engine) — detach without stopping them
+            self._decoders.clear()
         for b in batchers:
             b.stop()
 
@@ -204,6 +243,8 @@ class Server:
         if cmd == "telemetry":
             from .. import telemetry
             return {"ok": True, "telemetry": telemetry.snapshot()}
+        if cmd == "generate":
+            return self._generate(msg)
         if cmd is not None:
             raise MXNetError(f"unknown cmd {cmd!r}")
         # a predict request: resume the caller's carried trace context
@@ -227,6 +268,48 @@ class Server:
             if ctx is not None or _trace.sample_rate() > 0:
                 reply.setdefault("trace_id", wire_sp.ctx.trace_id)
             return reply
+
+    def _generate(self, msg: dict):
+        """One generation stream: submit to the attached decoder, return
+        a generator of wire replies — one per token as it lands, then a
+        terminal ``done`` doc. Submit-time sheds (queue full, tenant
+        tokens/sec budget) raise here and surface as the usual structured
+        error line with ``retry_after``."""
+        from ..util import getenv
+        name = msg["model"]
+        b = self.decoder(name)
+        stream = b.submit(
+            onp.asarray(msg["tokens"], "int32"),
+            valid_len=msg.get("valid"),
+            max_new_tokens=msg.get("max_new_tokens"),
+            tenant=msg.get("tenant"))
+        timeout_s = float(getenv("MXTPU_SERVE_REQUEST_TIMEOUT_S"))
+        t0 = time.perf_counter()
+
+        def _replies():
+            i = 0
+            try:
+                while True:
+                    tok = stream.next_token(timeout=timeout_s)
+                    if tok is None:
+                        break
+                    yield {"ok": True, "token": tok, "i": i}
+                    i += 1
+            except Exception as e:  # noqa: BLE001 — wire boundary
+                doc = {"ok": False, "error": f"{type(e).__name__}: {e}",
+                       "model": name}
+                retry_after = getattr(e, "retry_after", None)
+                if retry_after is None:
+                    retry_after = b.retry_after_s()
+                doc["retry_after"] = retry_after
+                yield doc
+                return
+            yield {"ok": True, "done": True,
+                   "reason": stream.finish_reason(),
+                   "tokens": stream.tokens(),
+                   "latency_ms": round((time.perf_counter() - t0) * 1e3, 3)}
+
+        return _replies()
 
     def _predict(self, msg: dict) -> dict:
         name = msg["model"]
@@ -319,3 +402,24 @@ def client_call(host: str, port: int, payload: dict,
                     f"reply ({len(buf)} bytes received)")
             buf += chunk
     return json.loads(buf.decode("utf-8"))
+
+
+def client_generate(host: str, port: int, payload: dict,
+                    timeout: float = 60.0):
+    """Streaming client for the ``generate`` command: a generator over
+    the server's reply lines — one ``{"ok": true, "token": t, "i": n}``
+    per generated token as it arrives, ending with the terminal ``done``
+    doc (or a single structured-error doc). ``payload`` needs ``model``
+    and ``tokens``; ``cmd`` is filled in."""
+    payload = dict(payload)
+    payload.setdefault("cmd", "generate")
+    with socket.create_connection((host, port), timeout=timeout) as sock:
+        sock.sendall((json.dumps(payload) + "\n").encode("utf-8"))
+        with sock.makefile("rb") as rf:
+            for line in rf:
+                doc = json.loads(line.decode("utf-8"))
+                yield doc
+                if not doc.get("ok") or doc.get("done"):
+                    return
+    raise ConnectionError("server closed the generate stream before the "
+                          "terminal done/error line")

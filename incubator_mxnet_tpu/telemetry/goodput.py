@@ -82,7 +82,8 @@ from ..lockcheck import make_lock
 
 __all__ = ["CATEGORIES", "enabled", "configure", "begin", "begin_from_env",
            "note", "note_step", "set_cost_profile", "cost_profile", "price",
-           "collective_ms", "report", "snapshot", "reset", "window_steps"]
+           "collective_ms", "report", "snapshot", "reset", "window_steps",
+           "note_serve", "set_serve_cost_profile", "serve_report"]
 
 #: the attribution vector, in triage order (docs/observability.md §6):
 #: an operator works the list top-down — input starvation first, host
@@ -349,6 +350,116 @@ def _reclassify_discarded_locked(rollback_to: int) -> None:
 
 
 # ---------------------------------------------------------------------------
+# serve twin — token-level goodput for the decode path
+# ---------------------------------------------------------------------------
+
+def _new_serve_state() -> Dict[str, Any]:
+    return {"t0": None,
+            "ms": {"prefill": 0.0, "decode": 0.0},
+            "tokens": {"prefill": 0, "decode": 0},
+            "calls": {"prefill": 0, "decode": 0},
+            "cost": None}
+
+
+_SERVE = _new_serve_state()
+
+
+def note_serve(kind: str, tokens: int, wall_ms: float) -> None:
+    """Attribute one serve-side dispatch: ``kind`` is ``"prefill"`` (one
+    prompt, ``tokens`` = prompt length) or ``"decode"`` (one step,
+    ``tokens`` = active rows advanced). The DecodeBatcher calls this at
+    every token boundary; no-op when the ledger is off — same zero-cost
+    contract as the training hooks."""
+    if not enabled() or kind not in ("prefill", "decode"):
+        return
+    with _LOCK:
+        if _SERVE["t0"] is None:
+            _SERVE["t0"] = time.perf_counter()
+        _SERVE["ms"][kind] += float(wall_ms)
+        _SERVE["tokens"][kind] += int(tokens)
+        _SERVE["calls"][kind] += 1
+
+
+def set_serve_cost_profile(flops_per_token: float,
+                           hbm_bytes_per_token: float = 0.0,
+                           source: Optional[str] = None) -> Dict[str, Any]:
+    """Install the per-generated-token cost the decode roofline ceiling
+    is computed against (same ``util.roofline_peaks()`` constants as the
+    training profile). Decode is almost always HBM-bound — every step
+    re-reads the params and the live cache pages — so the ceiling is
+    ``1 / max(flops/PEAK, hbm/BW)`` tokens/sec. Returns the profile."""
+    from ..util import roofline_peaks
+    peak_flops, peak_bw, _ici = roofline_peaks()
+    compute_s = flops_per_token / peak_flops
+    mem_s = hbm_bytes_per_token / peak_bw
+    token_s = max(compute_s, mem_s)
+    prof = {"flops_per_token": float(flops_per_token),
+            "hbm_bytes_per_token": float(hbm_bytes_per_token),
+            "compute_s": compute_s, "mem_s": mem_s,
+            "roofline_tokens_per_s": (1.0 / token_s) if token_s > 0
+            else None,
+            "bound": "hbm" if mem_s >= compute_s else "compute",
+            "source": source}
+    with _LOCK:
+        _SERVE["cost"] = prof
+    return prof
+
+
+def serve_report() -> Dict[str, Any]:
+    """The decode-goodput twin of :func:`report`: measured tokens/sec vs
+    the per-token roofline ceiling, and the prefill-bound vs decode-bound
+    wall split (which of the two graphs the serve wall actually went to).
+    Publishes the ``mxtpu_goodput_serve_*`` gauges. Strict-JSON-safe."""
+    from . import metrics as _metrics
+    with _LOCK:
+        t0 = _SERVE["t0"]
+        wall_ms = ((time.perf_counter() - t0) * 1e3
+                   if t0 is not None else 0.0)
+        pre_ms = _SERVE["ms"]["prefill"]
+        dec_ms = _SERVE["ms"]["decode"]
+        dec_tok = _SERVE["tokens"]["decode"]
+        doc: Dict[str, Any] = {
+            "enabled": enabled(),
+            "wall_ms": round(wall_ms, 3),
+            "prefill": {"ms": round(pre_ms, 3),
+                        "tokens": _SERVE["tokens"]["prefill"],
+                        "calls": _SERVE["calls"]["prefill"]},
+            "decode": {"ms": round(dec_ms, 3),
+                       "tokens": dec_tok,
+                       "steps": _SERVE["calls"]["decode"]},
+        }
+        attributed = pre_ms + dec_ms
+        doc["attributed_ms"] = round(attributed, 3)
+        doc["unattributed_pct"] = (
+            round(100.0 * max(wall_ms - attributed, 0.0) / wall_ms, 2)
+            if wall_ms > 0 else 0.0)
+        doc["tokens_per_s"] = (round(dec_tok / (wall_ms / 1e3), 3)
+                               if wall_ms > 0 else None)
+        doc["decode_tokens_per_s"] = (round(dec_tok / (dec_ms / 1e3), 3)
+                                      if dec_ms > 0 else None)
+        doc["classification"] = (None if attributed == 0 else
+                                 ("prefill_bound" if pre_ms > dec_ms
+                                  else "decode_bound"))
+        cost = _SERVE["cost"]
+        doc["cost_profile"] = dict(cost) if cost else None
+        ceiling = cost["roofline_tokens_per_s"] if cost else None
+        doc["roofline_tokens_per_s"] = (round(ceiling, 3)
+                                        if ceiling else None)
+        doc["roofline_fraction"] = (
+            round((dec_tok / (wall_ms / 1e3)) / ceiling, 6)
+            if ceiling and wall_ms > 0 else None)
+    if doc["tokens_per_s"] is not None:
+        _metrics.gauge("mxtpu_goodput_serve_tokens_per_s",
+                       "Generated tokens/sec over the serve ledger window"
+                       ).set(doc["tokens_per_s"])
+    if doc["roofline_fraction"] is not None:
+        _metrics.gauge("mxtpu_goodput_serve_roofline_fraction",
+                       "Measured tokens/sec over the per-token roofline "
+                       "ceiling").set(doc["roofline_fraction"])
+    return doc
+
+
+# ---------------------------------------------------------------------------
 # cost profile / MFU reconciliation
 # ---------------------------------------------------------------------------
 
@@ -556,8 +667,9 @@ def snapshot() -> Dict[str, Any]:
 def reset() -> None:
     """Drop all ledger state including the cost profile and any
     :func:`configure` overrides (test isolation)."""
-    global _S, _ON_OVERRIDE, _WINDOW_OVERRIDE
+    global _S, _SERVE, _ON_OVERRIDE, _WINDOW_OVERRIDE
     with _LOCK:
         _S = _new_state()
+        _SERVE = _new_serve_state()
         _ON_OVERRIDE = None
         _WINDOW_OVERRIDE = None

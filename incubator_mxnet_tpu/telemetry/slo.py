@@ -350,4 +350,17 @@ def default_slos() -> List[SLO]:
             threshold_ms=_f("MXTPU_SLO_STEP_MS", 60000.0),
             description="training steps complete inside the step-time "
                         "budget"),
+        # decode streaming: inter-token latency is the user-perceived
+        # cadence of a generation — two latency objectives over the same
+        # histogram series, a tight median and a loose tail
+        SLO("decode-itl-p50", objective=0.5, kind="latency",
+            series="mxtpu_decode_itl_ms",
+            threshold_ms=_f("MXTPU_SLO_ITL_P50_MS", 100.0),
+            description="median inter-token latency of decode streams "
+                        "stays under the p50 threshold"),
+        SLO("decode-itl-p99", objective=obj, kind="latency",
+            series="mxtpu_decode_itl_ms",
+            threshold_ms=_f("MXTPU_SLO_ITL_P99_MS", 500.0),
+            description="tail inter-token latency of decode streams "
+                        "stays under the p99 threshold"),
     ]

@@ -172,6 +172,34 @@ ENV_VARS: Dict[str, tuple] = {
                                     "concurrent router requests a single "
                                     "tenant may hold before being shed "
                                     "with retry_after; 0 = unlimited."),
+    "MXTPU_SERVE_TENANT_TOKENS_PER_S": ("0", "Per-tenant decode QoS: "
+                                        "sustained generated-tokens/sec "
+                                        "budget (token bucket); requests "
+                                        "whose estimated tokens would "
+                                        "breach it are shed with "
+                                        "retry_after BEFORE queueing; "
+                                        "0 = unlimited."),
+    "MXTPU_SERVE_TENANT_TOKEN_BURST": ("0", "Token-bucket burst depth for "
+                                       "MXTPU_SERVE_TENANT_TOKENS_PER_S "
+                                       "(tokens); 0 = one second's "
+                                       "budget."),
+    "MXTPU_DECODE_MAX_BATCH": ("8", "Decode batch rows: concurrent "
+                               "sequences one DecodeEngine steps per "
+                               "token boundary (the fixed shape of the "
+                               "AOT decode executable)."),
+    "MXTPU_DECODE_BLOCK_SIZE": ("16", "Tokens per paged-KV-cache page; "
+                                "pages are the allocation unit of the "
+                                "decode block pool."),
+    "MXTPU_DECODE_MAX_TOKENS": ("64", "Generation cap per sequence = "
+                                "pages-per-sequence x block size; must "
+                                "fit the model's position table."),
+    "MXTPU_DECODE_QUEUE_LIMIT": ("256", "Bounded decode request-queue "
+                                 "size; past it submit() sheds with "
+                                 "QueueFullError (backpressure)."),
+    "MXTPU_DECODE_MAX_REQUEUES": ("3", "Cache-pressure admissions bounce "
+                                  "back to the queue at most this many "
+                                  "times before the stream is shed with "
+                                  "CacheExhausted."),
     "MXTPU_BENCH_MODEL": ("bert_12_768_12", "bench.py model config."),
     "MXTPU_BENCH_TRACE": ("", "bench.py: capture one profiled step into this "
                           "directory (jax.profiler trace)."),
@@ -416,6 +444,12 @@ ENV_VARS: Dict[str, tuple] = {
                                "error-budget spend."),
     "MXTPU_SLO_STEP_MS": ("60000", "Train step-time SLO threshold (ms) "
                           "for the train-step-time objective."),
+    "MXTPU_SLO_ITL_P50_MS": ("100", "Decode inter-token-latency SLO "
+                             "threshold (ms) for the decode-itl-p50 "
+                             "built-in objective."),
+    "MXTPU_SLO_ITL_P99_MS": ("500", "Decode inter-token-latency SLO "
+                             "threshold (ms) for the decode-itl-p99 "
+                             "built-in objective."),
 }
 
 

@@ -1,0 +1,489 @@
+"""serve.decode — paged KV-cache + continuous-batching decode tests.
+
+Covers the ISSUE 18 acceptance surface: block-pool alloc/free/
+fragmentation/exhaustion semantics, the statically priced capacity
+matching the runtime pool's admission limit (and re-pricing
+deterministically), token-boundary join/leave ordering under continuous
+batching, prefill bucket selection across ragged prompt lengths with the
+zero-recompile warm contract held across ragged generation lengths,
+greedy/beam parity between the incremental cache-backed decode path and
+the full-recompute reference loop, TokenStream semantics, the seeded
+decode chaos knobs (cache-block exhaustion → bounded requeue then a loud
+shed; mid-generation replica death → every active stream fails fast with
+one flight bundle), per-tenant tokens/sec QoS shedding, and the TCP
+``generate`` streaming front end.
+"""
+import os
+import time
+
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import nd, serve
+from incubator_mxnet_tpu.fault import inject
+from incubator_mxnet_tpu.models.nmt import (NMTModel, beam_search,
+                                            beam_search_reference)
+from incubator_mxnet_tpu.serve.decode import (BlockPool, CacheExhausted,
+                                              DECODE_SITE, TokenStream,
+                                              block_bytes,
+                                              blocks_per_sequence,
+                                              price_capacity)
+from incubator_mxnet_tpu.telemetry import compile_log
+
+SRC_VOCAB, TGT_VOCAB = 23, 19
+
+
+def _make_model():
+    model = NMTModel(src_vocab=SRC_VOCAB, tgt_vocab=TGT_VOCAB, units=16,
+                     hidden_size=32, num_layers=2, num_heads=2,
+                     dropout=0.0, max_length=32, prefix="decode_test_")
+    model.initialize()
+    rng = onp.random.RandomState(0)
+    src = nd.array(rng.randint(3, SRC_VOCAB, (2, 7)).astype("int32"))
+    tgt = nd.array(rng.randint(3, TGT_VOCAB, (2, 5)).astype("int32"))
+    model(src, tgt)  # materialise params
+    return model
+
+
+@pytest.fixture(scope="module")
+def warm_engine():
+    """One warmed engine shared by the behavioural tests (warmup AOT-
+    compiles the prefill ladder + the decode step once per module)."""
+    model = _make_model()
+    table = serve.BucketTable({"batch": (1, 1), "src": (4, 8)})
+    engine = serve.DecodeEngine(model, table, max_batch=2, block_size=4,
+                                max_target_len=16, hbm_budget=None)
+    engine.warmup()
+    return model, engine
+
+
+def _prompt(rng, lo=2, hi=8):
+    return rng.randint(3, SRC_VOCAB, (int(rng.randint(lo, hi)),)) \
+        .astype("int32")
+
+
+# ---------------------------------------------------------------------------
+# Block pool
+# ---------------------------------------------------------------------------
+class TestBlockPool:
+    def test_alloc_append_free_roundtrip(self):
+        pool = BlockPool(num_blocks=9, block_size=4, blocks_per_seq=4)
+        table = pool.alloc_sequence("a")
+        assert len(table) == 1 and pool.active_sequences() == 1
+        # the first block_size appends fill the admission page; the next
+        # crosses into a fresh one — pages allocate at block boundaries
+        pages = set(table)
+        for i in range(4):
+            page, slot, table = pool.append_token("a")
+            assert slot == i
+            pages.add(page)
+        assert len(pages) == 1 and len(table) == 1
+        page, slot, table = pool.append_token("a")  # boundary crossing
+        assert slot == 0 and len(table) == 2 and page != table[0]
+        assert pool.sequence_length("a") == 5
+        pool.free_sequence("a")
+        assert pool.active_sequences() == 0
+        assert pool.free_blocks() == 8  # page 0 is reserved scratch
+
+    def test_fragmentation_reuse_after_free(self):
+        pool = BlockPool(num_blocks=9, block_size=4, blocks_per_seq=4)
+        pool.alloc_sequence("a")
+        b_pages = set(pool.alloc_sequence("b"))
+        for _ in range(5):
+            _, _, t = pool.append_token("b")
+        b_pages.update(t)
+        assert len(b_pages) == 2
+        free_before = pool.free_blocks()
+        pool.free_sequence("b")
+        assert pool.free_blocks() == free_before + len(b_pages)
+        # freed pages are reusable by a new sequence even though "a"
+        # still holds a page in between — paging tolerates fragmentation
+        c_table = pool.alloc_sequence("c")
+        for _ in range(5):
+            _, _, c_table = pool.append_token("c")
+        assert set(c_table) <= b_pages
+        assert len(pool.sequence_table("a")) == 1  # untouched neighbour
+
+    def test_exhaustion_raises_and_recovers(self):
+        # 4 usable pages, 2-page sequences, 3 seats: two grown sequences
+        # drain the free list, so the third admission hits CacheExhausted
+        # even though a seat is free — and freeing makes the pool whole
+        pool = BlockPool(num_blocks=5, block_size=2, blocks_per_seq=2,
+                         max_sequences=3)
+        for sid in ("a", "b"):
+            pool.alloc_sequence(sid)
+            for _ in range(3):  # third append crosses into page 2
+                pool.append_token(sid)
+            assert len(pool.sequence_table(sid)) == 2
+        assert pool.free_blocks() == 0
+        with pytest.raises(CacheExhausted):
+            pool.alloc_sequence("c")
+        assert pool.active_sequences() == 2  # failed alloc left no seat
+        pool.free_sequence("a")
+        pool.alloc_sequence("c")
+        assert pool.active_sequences() == 2
+        # mid-generation growth past the per-sequence reservation is loud
+        for _ in range(2):
+            pool.append_token("c")
+        pool.append_token("c")  # page 2 of 2
+        pool.append_token("c")
+        with pytest.raises(CacheExhausted):
+            pool.append_token("c")  # would need page 3
+
+    def test_admission_limit_caps_seats(self):
+        pool = BlockPool(num_blocks=64, block_size=4, blocks_per_seq=4,
+                         max_sequences=2)
+        assert pool.admission_limit() == 2
+        pool.alloc_sequence("a")
+        assert pool.can_admit()
+        pool.alloc_sequence("b")
+        assert not pool.can_admit()
+        with pytest.raises(CacheExhausted):
+            pool.alloc_sequence("c")
+        pool.free_sequence("a")
+        assert pool.can_admit()
+
+    def test_unknown_sequence_raises(self):
+        pool = BlockPool(num_blocks=5, block_size=4, blocks_per_seq=1)
+        with pytest.raises(mx.MXNetError):
+            pool.append_token("ghost")
+
+
+# ---------------------------------------------------------------------------
+# Capacity pricing
+# ---------------------------------------------------------------------------
+class TestCapacity:
+    def test_price_capacity_arithmetic(self):
+        cap = price_capacity(hbm_budget=1 << 20, fixed_bytes=1 << 18,
+                             per_block_bytes=1 << 12, max_target_len=64,
+                             block_size=16, max_batch=64)
+        bps = blocks_per_sequence(64, 16)
+        assert cap["blocks_per_seq"] == bps == 4
+        per_seq = bps * (1 << 12)
+        assert cap["max_sequences"] == ((1 << 20) - (1 << 18)) // per_seq
+        assert cap["num_blocks"] == cap["max_sequences"] * bps + 1
+
+    def test_price_capacity_no_budget_uses_max_batch(self):
+        cap = price_capacity(hbm_budget=None, fixed_bytes=0,
+                             per_block_bytes=1024, max_target_len=32,
+                             block_size=8, max_batch=6)
+        assert cap["max_sequences"] == 6
+
+    def test_budget_too_small_prices_zero(self):
+        # pricing itself stays total — zero sequences fit; the ENGINE
+        # turns that into a loud MXNetError at construction
+        cap = price_capacity(hbm_budget=1 << 10, fixed_bytes=1 << 18,
+                             per_block_bytes=1 << 12, max_target_len=64,
+                             block_size=16, max_batch=64)
+        assert cap["max_sequences"] == 0 and cap["num_blocks"] == 1
+
+    def test_block_bytes_analytic(self):
+        # K and V planes: 2 * layers * block * units * dtype_bytes
+        assert block_bytes(2, 16, 4) == 2 * 2 * 4 * 16 * 4
+
+    def test_static_capacity_matches_pool_and_repricing(self):
+        """The ISSUE acceptance gate: the number priced by the liveness
+        model before the pool exists equals the runtime pool's actual
+        admission limit, and pricing the same inputs again reproduces
+        the same report exactly."""
+        model = _make_model()
+        table = serve.BucketTable({"batch": (1, 1), "src": (4, 8)})
+        engine = serve.DecodeEngine(model, table, max_batch=4,
+                                    block_size=4, max_target_len=16,
+                                    hbm_budget=1 << 26)
+        cap = engine.capacity
+        assert cap["max_sequences"] == engine.pool.admission_limit()
+        assert 1 <= cap["max_sequences"] <= 4
+        assert engine.capacity_report() == cap  # deterministic re-price
+        # MX709-family check over the budget-priced graphs stays clean
+        engine.check_budget()
+
+
+# ---------------------------------------------------------------------------
+# TokenStream
+# ---------------------------------------------------------------------------
+class TestTokenStream:
+    def test_stream_then_result(self):
+        s = TokenStream()
+        for t in (5, 7, 9):
+            s.put_token(t)
+        s.finish("eos")
+        assert [s.next_token(timeout=1) for _ in range(3)] == [5, 7, 9]
+        assert s.next_token(timeout=1) is None
+        assert s.result(timeout=1) == [5, 7, 9]
+        assert s.done() and s.finish_reason() == "eos"
+
+    def test_next_token_timeout(self):
+        s = TokenStream()
+        with pytest.raises(TimeoutError):
+            s.next_token(timeout=0.05)
+
+    def test_exception_propagates_to_both_reads(self):
+        s = TokenStream()
+        s.put_token(1)
+        s.set_exception(serve.CacheExhausted("no pages"))
+        with pytest.raises(serve.CacheExhausted):
+            s.next_token(timeout=1)  # a failed stream never hangs
+        with pytest.raises(serve.CacheExhausted):
+            s.result(timeout=1)
+        assert s.done()
+
+
+# ---------------------------------------------------------------------------
+# Engine: prefill buckets + warm contract
+# ---------------------------------------------------------------------------
+class TestEngineWarmContract:
+    def test_prefill_bucket_selection(self, warm_engine):
+        _, engine = warm_engine
+        # ragged prompt lengths land in the smallest covering src bucket
+        assert [engine._table.bucket("src", n) for n in (2, 4, 5, 8)] \
+            == [4, 4, 8, 8]
+        with pytest.raises(serve.BucketOverflow):
+            engine._table.bucket("src", 9)
+
+    def test_zero_recompiles_across_ragged_lengths(self, warm_engine):
+        """The warm contract BY CONSTRUCTION: ragged prompt lengths ride
+        the prefill bucket ladder, ragged generation lengths never reach
+        XLA (raggedness lives in host-side block tables), so after
+        warmup the decode sites record zero compiles."""
+        _, engine = warm_engine
+        rng = onp.random.RandomState(3)
+        batcher = serve.DecodeBatcher(engine).start()
+        try:
+            streams = [batcher.submit(_prompt(rng),
+                                      max_new_tokens=int(rng.randint(1, 14)))
+                       for _ in range(7)]
+            lens = sorted({len(s.result(timeout=60)) for s in streams})
+        finally:
+            batcher.stop()
+        assert len(lens) >= 2  # genuinely ragged generation lengths
+        assert compile_log.post_warmup_compiles(DECODE_SITE) == 0
+        assert compile_log.post_warmup_compiles("serve.compiled") == 0
+        compile_log.assert_zero_post_warmup(DECODE_SITE)
+
+    def test_stats_surface(self, warm_engine):
+        _, engine = warm_engine
+        st = engine.stats()
+        assert st["warmed"] and st["decode_steps"] > 0
+        assert st["capacity"]["max_sequences"] == 2
+        assert st["pool"]["admission_limit"] == 2
+
+
+# ---------------------------------------------------------------------------
+# Continuous batching: join/leave at token boundaries
+# ---------------------------------------------------------------------------
+class TestContinuousBatching:
+    def test_join_leave_ordering_and_occupancy(self, warm_engine):
+        """max_batch=2, 4 requests with staggered lengths: later requests
+        join as earlier ones retire, all complete, and the pool never
+        holds more than the seat count mid-flight."""
+        _, engine = warm_engine
+        engine.pool.snapshot()  # baseline
+        rng = onp.random.RandomState(1)
+        batcher = serve.DecodeBatcher(engine).start()
+        try:
+            streams = [batcher.submit(_prompt(rng), max_new_tokens=n)
+                       for n in (3, 9, 5, 7)]
+            results = [s.result(timeout=60) for s in streams]
+        finally:
+            batcher.stop()
+        for want, (got, s) in zip((3, 9, 5, 7), zip(results, streams)):
+            assert 1 <= len(got) <= want
+            assert s.finish_reason() in ("eos", "length")
+        snap = engine.pool.snapshot()
+        assert snap["active_sequences"] == 0  # every leave freed its seat
+        m = batcher.metrics.snapshot()
+        assert m["requests"] == 4 and m["failed"] == 0 and m["shed"] == 0
+        # 4 sequences over 2 seats forces at least one token-boundary join
+        assert m["steps"] >= max(len(r) for r in results)
+
+    def test_membership_churn_does_not_change_tokens(self, warm_engine):
+        """Greedy decode of a prompt must be identical whether it ran
+        alone or joined a running batch mid-flight — the in-place paged
+        cache isolates rows."""
+        _, engine = warm_engine
+        rng = onp.random.RandomState(2)
+        probe = _prompt(rng, lo=5, hi=8)
+        batcher = serve.DecodeBatcher(engine).start()
+        try:
+            alone = batcher.submit(probe, max_new_tokens=10).result(
+                timeout=60)
+            # now the same prompt with churn around it
+            noise1 = batcher.submit(_prompt(rng), max_new_tokens=12)
+            churned = batcher.submit(probe, max_new_tokens=10)
+            noise2 = batcher.submit(_prompt(rng), max_new_tokens=4)
+            assert churned.result(timeout=60) == alone
+            noise1.result(timeout=60), noise2.result(timeout=60)
+        finally:
+            batcher.stop()
+
+    def test_queue_backpressure_sheds_loudly(self, warm_engine):
+        _, engine = warm_engine
+        batcher = serve.DecodeBatcher(engine, queue_limit=1)
+        # worker NOT started: the queue can only fill
+        rng = onp.random.RandomState(4)
+        batcher.submit(_prompt(rng), max_new_tokens=2)
+        with pytest.raises(serve.QueueFullError):
+            batcher.submit(_prompt(rng), max_new_tokens=2)
+        batcher.stop(drain=False)
+
+    def test_stop_drains_and_fails_leftovers(self, warm_engine):
+        _, engine = warm_engine
+        rng = onp.random.RandomState(5)
+        batcher = serve.DecodeBatcher(engine).start()
+        streams = [batcher.submit(_prompt(rng), max_new_tokens=3)
+                   for _ in range(3)]
+        batcher.stop(drain=True)
+        for s in streams:
+            # generous bound: a loaded CI box can stall the worker thread
+            # for seconds; the contract under test is drained-not-abandoned,
+            # not latency
+            s.result(timeout=60)
+        assert not batcher.worker_alive()
+
+
+# ---------------------------------------------------------------------------
+# Greedy/beam parity with the reference loop
+# ---------------------------------------------------------------------------
+class TestBeamParity:
+    def test_incremental_beam_matches_reference(self, warm_engine):
+        """The cache-backed ``beam_search`` must reproduce the reference
+        full-recompute loop exactly on a seeded example (greedy K=1 and
+        K=3), sequences AND scores."""
+        model, _ = warm_engine
+        rng = onp.random.RandomState(0)
+        src = nd.array(rng.randint(3, SRC_VOCAB, (2, 7)).astype("int32"))
+        vl = nd.array(onp.array([7.0, 5.0], "float32"))
+        for beam in (1, 3):
+            seqs, scores = beam_search(model, src, vl, beam_size=beam,
+                                       max_length=12)
+            ref_seqs, ref_scores = beam_search_reference(
+                model, src, vl, beam_size=beam, max_length=12)
+            onp.testing.assert_array_equal(onp.asarray(seqs),
+                                           onp.asarray(ref_seqs))
+            onp.testing.assert_allclose(onp.asarray(scores),
+                                        onp.asarray(ref_scores), rtol=1e-5)
+
+    def test_batcher_greedy_matches_beam_k1(self, warm_engine):
+        model, engine = warm_engine
+        rng = onp.random.RandomState(6)
+        prompt = _prompt(rng, lo=5, hi=8)
+        batcher = serve.DecodeBatcher(engine).start()
+        try:
+            got = batcher.submit(prompt, max_new_tokens=10).result(
+                timeout=60)
+        finally:
+            batcher.stop()
+        seqs, _ = beam_search(
+            model, nd.array(prompt.reshape(1, -1), dtype="int32"),
+            nd.array([float(len(prompt))]), beam_size=1, max_length=11)
+        ref = [int(t) for t in onp.asarray(seqs)[0, 0]]
+        n = min(len(got), len(ref))
+        assert n and got[:n] == ref[:n]
+
+
+# ---------------------------------------------------------------------------
+# Decode chaos + QoS
+# ---------------------------------------------------------------------------
+@pytest.mark.chaos
+class TestDecodeChaos:
+    def test_block_exhaustion_requeues_then_sheds(self, warm_engine):
+        """Seeded cache-pressure: the admission bounces back to the queue
+        a bounded number of times, then the stream fails LOUDLY with
+        CacheExhausted — never a hang, never a silent truncation."""
+        _, engine = warm_engine
+        inject.enable(seed=7, decode_block_exhaustion=1.0)
+        batcher = serve.DecodeBatcher(engine, max_requeues=2).start()
+        try:
+            s = batcher.submit(onp.arange(3, 8).astype("int32"),
+                               max_new_tokens=4)
+            with pytest.raises(CacheExhausted):
+                s.result(timeout=30)
+            m = batcher.metrics.snapshot()
+            assert m["requeued"] == 2 and m["shed"] == 1
+        finally:
+            batcher.stop()
+            inject.disable()
+
+    def test_replica_death_fails_streams_with_flight_bundle(
+            self, warm_engine, tmp_path, monkeypatch):
+        """Mid-generation replica death: every active stream fails fast
+        with ReplicaUnavailable and exactly one flight bundle lands."""
+        from incubator_mxnet_tpu.telemetry import flight
+        monkeypatch.setenv("MXTPU_FLIGHT_DIR", str(tmp_path))
+        _, engine = warm_engine
+        engine.reset_cache()
+        inject.enable(seed=7, decode_replica_death=0.5)
+        batcher = serve.DecodeBatcher(engine).start()
+        try:
+            streams = [batcher.submit(onp.arange(3, 8).astype("int32"),
+                                      max_new_tokens=10)
+                       for _ in range(2)]
+            died = 0
+            for s in streams:
+                try:
+                    s.result(timeout=30)
+                except serve.ReplicaUnavailable:
+                    died += 1
+            assert died == 2  # the whole batch fails together, loudly
+        finally:
+            batcher.stop()
+            inject.disable()
+        bundles = [d for d in os.listdir(str(tmp_path))
+                   if "decode_replica_death" in d]
+        assert len(bundles) == 1  # ONE bundle for the event, not per row
+
+    def test_qos_tokens_per_sec_sheds_before_breach(self, warm_engine):
+        _, engine = warm_engine
+        qos = serve.TokenRateBudget(tokens_per_s=10, burst=10)
+        batcher = serve.DecodeBatcher(engine, qos=qos).start()
+        try:
+            ok = batcher.submit(onp.arange(3, 8).astype("int32"),
+                                max_new_tokens=8, tenant="t1")
+            with pytest.raises(serve.ShedError) as exc:
+                batcher.submit(onp.arange(3, 8).astype("int32"),
+                               max_new_tokens=8, tenant="t1")
+            assert exc.value.reason == "tenant_tokens"
+            assert exc.value.retry_after > 0
+            # an unrelated tenant is untouched by t1's debt
+            other = batcher.submit(onp.arange(3, 8).astype("int32"),
+                                   max_new_tokens=4, tenant="t2")
+            ok.result(timeout=30), other.result(timeout=30)
+        finally:
+            batcher.stop()
+
+
+# ---------------------------------------------------------------------------
+# TCP generate front end
+# ---------------------------------------------------------------------------
+class TestGenerateWire:
+    def test_streaming_generate_over_tcp(self, warm_engine):
+        _, engine = warm_engine
+        batcher = serve.DecodeBatcher(engine).start()
+        srv = serve.Server(serve.ModelRegistry()).start()
+        try:
+            srv.attach_decoder("nmt", batcher)
+            docs = list(serve.client_generate(
+                "127.0.0.1", srv.port,
+                {"model": "nmt", "tokens": [5, 9, 3, 11, 4],
+                 "max_new_tokens": 6}))
+            tokens = [d["token"] for d in docs if "token" in d]
+            done = docs[-1]
+            assert done.get("done") and done["tokens"] == tokens
+            assert done["reason"] in ("eos", "length")
+            assert 1 <= len(tokens) <= 6
+        finally:
+            srv.stop()
+            batcher.stop()
+
+    def test_generate_without_decoder_is_structured_error(self,
+                                                          warm_engine):
+        srv = serve.Server(serve.ModelRegistry()).start()
+        try:
+            docs = list(serve.client_generate(
+                "127.0.0.1", srv.port, {"model": "nope", "tokens": [5]}))
+            assert docs[0]["ok"] is False
+            assert "no decoder" in docs[0]["error"]
+        finally:
+            srv.stop()

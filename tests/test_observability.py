@@ -554,7 +554,8 @@ class TestSLO:
     def test_default_slos_cover_the_tier(self):
         names = {s.name for s in tslo.default_slos()}
         assert names == {"serve-latency", "serve-availability",
-                         "serve-failover-rate", "train-step-time"}
+                         "serve-failover-rate", "train-step-time",
+                         "decode-itl-p50", "decode-itl-p99"}
 
 
 # ---------------------------------------------------------------------------

@@ -114,6 +114,77 @@ def collect_multichip(root: str) -> List[Dict[str, Any]]:
     return rows
 
 
+def collect_decode(root: str) -> List[Dict[str, Any]]:
+    """``DECODE_r*.json`` → one row per decode-serving round, ascending.
+    Each artifact is a ``serve_bench --decode`` record (or the driver's
+    ``{"parsed": record, "rc": N}`` wrapper): tokens/sec, ITL p50/p99,
+    the statically priced capacity vs the pool's admission limit, and
+    the post-warmup compile count — the decode twin of the BENCH rows."""
+    rows = []
+    for path in sorted(glob.glob(os.path.join(root, "DECODE_r*.json")),
+                       key=_round_no):
+        doc = _load_json(path)
+        if not isinstance(doc, dict):
+            continue
+        rec = doc.get("parsed") if isinstance(doc.get("parsed"), dict) \
+            else doc
+        row: Dict[str, Any] = {"round": _round_no(path),
+                               "file": os.path.basename(path)}
+        if rec.get("value") is None:
+            row["blind"] = True
+            row["reason"] = rec.get("error") or \
+                f"no parsed output (rc={doc.get('rc')})"
+        else:
+            extra = rec.get("extra") or {}
+            cap = extra.get("capacity") or {}
+            row.update(
+                blind=False, tokens_per_sec=rec.get("value"),
+                itl_ms_p50=extra.get("itl_ms_p50"),
+                itl_ms_p99=extra.get("itl_ms_p99"),
+                capacity=cap.get("max_sequences"),
+                admission_limit=extra.get("admission_limit"),
+                post_warmup_compiles=extra.get("post_warmup_compiles"),
+                backend=extra.get("backend"))
+        rows.append(row)
+    return rows
+
+
+def decode_regressions(rows: List[Dict],
+                       tolerance: float = 0.05) -> List[str]:
+    """The decode sweep: tokens/sec per round against the best preceding
+    measured round (same blind-round semantics as :func:`regressions`),
+    plus hard flags — a post-warmup compile or a capacity/admission
+    mismatch is a broken contract at any throughput."""
+    flags: List[str] = []
+    best: Optional[float] = None
+    best_round = None
+    for row in rows:
+        if row.get("blind"):
+            continue
+        if row.get("post_warmup_compiles"):
+            flags.append(f"DECODE r{row['round']}: "
+                         f"{row['post_warmup_compiles']} post-warmup "
+                         "compile(s) — the warm contract is broken")
+        if row.get("capacity") is not None \
+                and row.get("admission_limit") is not None \
+                and row["capacity"] != row["admission_limit"]:
+            flags.append(f"DECODE r{row['round']}: priced capacity "
+                         f"{row['capacity']} != pool admission limit "
+                         f"{row['admission_limit']}")
+        tps = row.get("tokens_per_sec")
+        if not tps:
+            continue
+        if best is not None and tps < (1.0 - tolerance) * best:
+            flags.append(
+                f"DECODE r{row['round']}: {tps:.4g} tokens/sec is "
+                f"{100.0 * (tps / best - 1):.1f}% vs best {best:.4g} "
+                f"(r{best_round}) — beyond the ±{tolerance * 100:.0f}% "
+                "tolerance")
+        if best is None or tps > best:
+            best, best_round = tps, row["round"]
+    return flags
+
+
 def collect_proxy(root: str) -> Optional[Dict[str, Any]]:
     """The banked device-blind baseline (``PERF_PROXY.json``): per-family
     deterministic cost metrics — the perf ground truth while the device
@@ -209,6 +280,7 @@ def collect(root: str, tolerance: float = 0.05) -> Dict[str, Any]:
     """The whole merged trajectory as one JSON-ready dict."""
     bench = collect_bench(root)
     sweeps = collect_baseline_sweeps(root)
+    decode = collect_decode(root)
     doc = {
         "root": os.path.abspath(root),
         "tolerance": tolerance,
@@ -218,8 +290,10 @@ def collect(root: str, tolerance: float = 0.05) -> Dict[str, Any]:
         "multichip_rounds": collect_multichip(root),
         "proxy": collect_proxy(root),
         "baseline_sweeps": sweeps,
+        "decode_rounds": decode,
         "best_banked": best_banked(bench, sweeps),
-        "regressions": regressions(bench, tolerance),
+        "regressions": (regressions(bench, tolerance)
+                        + decode_regressions(decode, tolerance)),
     }
     return doc
 
@@ -267,6 +341,24 @@ def render(doc: Dict[str, Any]) -> str:
                    f"MFU {r['mfu']:.4f}{star}")
     if not doc["baseline_sweeps"]:
         out.append("  (no parseable sweep rows)")
+
+    section("decode serving rounds")
+    for r in doc.get("decode_rounds") or []:
+        if r.get("blind"):
+            out.append(f"  r{r['round']:02d}  BLIND  — {r['reason']}")
+        else:
+            itl50 = r.get("itl_ms_p50")
+            itl99 = r.get("itl_ms_p99")
+            itl = (f"ITL p50 {itl50}/p99 {itl99} ms"
+                   if itl50 is not None else "ITL ?")
+            out.append(
+                f"  r{r['round']:02d}  {r.get('tokens_per_sec')} "
+                f"tokens/sec  {itl}  capacity {r.get('capacity')} "
+                f"(admits {r.get('admission_limit')})  "
+                f"recompiles {r.get('post_warmup_compiles')}  "
+                f"({r.get('backend')})")
+    if not doc.get("decode_rounds"):
+        out.append("  (no DECODE_r*.json artifacts)")
 
     section("multichip rounds")
     for r in doc["multichip_rounds"]:
@@ -344,9 +436,11 @@ def main(argv=None) -> int:
         return 2
     doc = collect(args.dir, args.tolerance)
     if not doc["bench_rounds"] and not doc["multichip_rounds"] \
-            and doc["proxy"] is None and not doc["baseline_sweeps"]:
-        print(f"perf_history: no BENCH_r*/MULTICHIP_r*/PERF_PROXY.json/"
-              f"BASELINE.md artifacts under {args.dir}", file=sys.stderr)
+            and doc["proxy"] is None and not doc["baseline_sweeps"] \
+            and not doc["decode_rounds"]:
+        print(f"perf_history: no BENCH_r*/MULTICHIP_r*/DECODE_r*/"
+              f"PERF_PROXY.json/BASELINE.md artifacts under {args.dir}",
+              file=sys.stderr)
         return 2
     if args.json:
         json.dump(doc, sys.stdout, separators=(",", ":"))
