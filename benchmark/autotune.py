@@ -170,16 +170,36 @@ def _peaks() -> Tuple[float, float, float]:
     return roofline_peaks()
 
 
-def score(metrics: Dict[str, Any]) -> float:
+def score(metrics: Dict[str, Any],
+          measured: Optional[Dict[str, float]] = None) -> float:
     """tokens/sec under the roofline proxy — higher is better. A pure
     function of the cost-table metrics and the (fixed) peak constants,
-    so candidate ranking is deterministic by construction."""
+    so candidate ranking is deterministic by construction.
+
+    ``measured`` folds a goodput window's attribution into the score
+    (the flight director's rescoring hook, TVM's learned-cost-model
+    argument in miniature): the window's ``collective`` / ``input_wait``
+    / ``host`` wall fractions, priced relative to its ``compute``
+    fraction, re-weight the analytic terms — measured communication can
+    only *raise* the analytic comm estimate (the model stays a lower
+    bound), and input/host time the analytic model assumes away is added
+    outright. ``None`` (the default, and every pre-existing caller) is
+    the original expression bit for bit."""
     peak_flops, peak_bw, ici_bw = _peaks()
     compute_s = metrics["flops_per_step"] / peak_flops
     mem_s = metrics["hbm_bytes_per_step"] / peak_bw
     comm_s = metrics["comm_bytes_per_step"] / ici_bw
     launch_s = _LAUNCH_S * metrics["fusion_groups"]
-    steady_s = max(compute_s, mem_s) + comm_s + launch_s
+    device_s = max(compute_s, mem_s)
+    steady_s = device_s + comm_s + launch_s
+    if measured:
+        f_comp = max(float(measured.get("compute", 0.0)), 1e-6)
+        per_compute = device_s / f_comp   # 1.0 measured fraction in secs
+        comm_meas = per_compute * float(measured.get("collective", 0.0))
+        input_s = per_compute * float(measured.get("input_wait", 0.0))
+        host_s = per_compute * float(measured.get("host", 0.0))
+        steady_s = (device_s + max(comm_s, comm_meas) + input_s + host_s
+                    + launch_s)
     warmup_s = _COMPILE_S * metrics["graphs"]
     return metrics["tokens_per_step"] / (steady_s
                                          + warmup_s / _AMORTIZE_STEPS)
@@ -312,9 +332,13 @@ def winner_config(family: str, cfg: Dict[str, Any]) -> Dict[str, Any]:
 
 
 def search(family: str, budget: Optional[int] = None, cache=None,
-           mesh_key: str = "any") -> Dict[str, Any]:
+           mesh_key: str = "any",
+           measured: Optional[Dict[str, float]] = None) -> Dict[str, Any]:
     """Evaluate the family's candidate list and (optionally) bank the
-    winner. Deterministic: same space + budget → same winner, twice."""
+    winner. Deterministic: same space + budget → same winner, twice
+    (``measured`` is part of that determinism key — a fixed attribution
+    dict re-ranks the same rows the same way; ``None`` leaves every
+    result byte-identical to the pre-rescoring search)."""
     from incubator_mxnet_tpu import autotune as _cache_mod
     from incubator_mxnet_tpu import telemetry
 
@@ -334,7 +358,8 @@ def search(family: str, budget: Optional[int] = None, cache=None,
         feasible = (hbm_budget is None
                     or metrics["ladder_peak_bytes"] <= hbm_budget)
         rows.append({"config": dict(cfg), "metrics": metrics,
-                     "score": score(metrics), "feasible": feasible})
+                     "score": score(metrics, measured=measured),
+                     "feasible": feasible})
     feasible_i = [i for i, r in enumerate(rows) if r["feasible"]]
     if not feasible_i:
         raise RuntimeError(
@@ -357,12 +382,17 @@ def search(family: str, budget: Optional[int] = None, cache=None,
         "rows": rows,
         "chip": _cache_mod.chip_kind(), "mesh": mesh_key,
     }
+    if measured is not None:
+        result["measured"] = dict(measured)
     if cache is not None:
+        meta = {"dims": list(space["dims"]), "evaluated": len(rows),
+                "space_size": len(full), "driver": "benchmark.autotune"}
+        if measured is not None:
+            meta["measured"] = dict(measured)
         result["cache_path"] = cache.put(
             family, mesh_key, _cache_mod.chip_kind(),
             winner_config(family, best["config"]), best["score"],
-            meta={"dims": list(space["dims"]), "evaluated": len(rows),
-                  "space_size": len(full), "driver": "benchmark.autotune"})
+            meta=meta)
     telemetry.emit("autotune.search", family=family,
                    evaluated=len(rows), space_size=len(full),
                    infeasible=result["infeasible"], hbm_budget=hbm_budget,

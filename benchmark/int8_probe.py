@@ -31,8 +31,30 @@ def main() -> None:
     # The axon plugin forces jax_platforms='axon,cpu' at interpreter boot,
     # so the JAX_PLATFORMS env var alone cannot pin this probe to CPU for
     # smoke runs — honor it in-process (unset → default device, the TPU).
+    # CAVEAT: jax.config.update('jax_platforms', ...) is a silent no-op
+    # once the backends are initialized — the probe would then run (and
+    # report timings) on whatever platform the first device lookup chose.
+    # Check the bridge state and refuse to pretend the pin worked.
     if os.environ.get("JAX_PLATFORMS"):
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+        requested = os.environ["JAX_PLATFORMS"]
+        from jax._src import xla_bridge as _bridge
+        initialized = getattr(_bridge, "backends_are_initialized",
+                              lambda: bool(getattr(_bridge, "_backends",
+                                                   None)))()
+        if initialized:
+            actual = jax.default_backend()
+            if actual not in requested.split(","):
+                import sys
+                print(f"[int8_probe] JAX_PLATFORMS={requested!r} requested "
+                      f"but the XLA backends are already initialized "
+                      f"(active: {actual!r}) — jax.config.update("
+                      f"'jax_platforms') is a no-op at this point and the "
+                      f"probe would silently time the wrong platform. Run "
+                      f"this probe in a fresh interpreter with the env var "
+                      f"set at launch.", file=sys.stderr)
+                raise SystemExit(2)
+        else:
+            jax.config.update("jax_platforms", requested)
     import jax.numpy as jnp
 
     B, IN, OUT = (int(os.environ.get(k, d)) for k, d in

@@ -662,6 +662,37 @@ class PrefetchIter(DataIter):
         self._queue = _queue.Queue(maxsize=self._depth)
         self._start()
 
+    @property
+    def depth(self) -> int:
+        """Prefetch queue capacity currently in force."""
+        return self._depth
+
+    def set_depth(self, depth: int) -> int:
+        """Resize the prefetch bound **live** — no worker restart, no
+        batch dropped or replayed. The stdlib queue re-reads ``maxsize``
+        under its own mutex on every put, so mutating it there (and
+        waking blocked producers) makes a grow take effect within one
+        producer put; a shrink drains naturally as the consumer pops —
+        queued batches are never discarded. This is the flight
+        director's ``input_bound`` remediation, and it is allowlisted
+        precisely because nothing else moves: stream order, the worker's
+        global-batch cursor, and the shard/restore accounting are all
+        untouched (a restart would rewind the worker's cursor to 0 and
+        drop in-flight batches). ``reset``/``shard``/``restore_shard``
+        rebuild their queues at the new depth. Returns the previous
+        depth."""
+        depth = int(depth)
+        if depth < 1:
+            raise MXNetError("PrefetchIter depth must be >= 1")
+        if self._closed:
+            raise MXNetError("PrefetchIter is closed")
+        prev, q = self._depth, self._queue
+        with q.mutex:
+            q.maxsize = depth
+            q.not_full.notify_all()
+        self._depth = depth
+        return prev
+
     def shard(self, process_index: int, process_count: int) -> "PrefetchIter":
         """Restrict this iterator to host ``process_index``'s round-robin
         share of the stream (global batch ``g`` is ours iff

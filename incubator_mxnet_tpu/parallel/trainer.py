@@ -117,6 +117,12 @@ class ShardedTrainer:
         self._kv = None              # resolved lazily on first fallback step
         self._grad_fn = None         # compiled fwd+bwd (fallback path)
         self._step_ndims = None      # batch ranks the built step was pinned to
+        self._step_n_data = None     # data-arg count of the built step
+        #: staged-recompile cutover flag (:meth:`retune`): the ledger
+        #: site the NEXT dispatch's compile is banked under — never
+        #: ``trainer.step``, so that site's zero-post-warmup contract
+        #: survives a director-driven rebuild
+        self._retune_site: Optional[str] = None
         self.last_path: Optional[str] = None
         #: whole-step capture (default on, MXTPU_FUSED_STEP=0 opts out):
         #: the guard's finite verdict and the LR-schedule position are
@@ -600,6 +606,7 @@ class ShardedTrainer:
             self.autotune_entry = self._tuned or None
         self._step_fn = self._build_step(n_data, ndims)
         self._step_ndims = ndims
+        self._step_n_data = n_data
 
     def _refresh_scalars(self, next_t: int) -> None:
         """Materialize the device-resident step scalars. With the LR
@@ -645,6 +652,39 @@ class ShardedTrainer:
         vals = self.place(*batch)
         self._ensure_built(n_data, tuple(v.ndim for v in vals))
         self._refresh_scalars(self._t + 1)
+
+    def retune(self, entry: Optional[Dict[str, Any]] = None,
+               site: str = "director.recompile") -> None:
+        """Stage a recompile cutover (the flight director's
+        ``compute_bound`` remediation): swap the tuned config and rebuild
+        the pjit step entry NOW — no dispatch, no XLA compile yet (pjit
+        traces lazily), so the running step is never interrupted. The
+        NEXT :meth:`step` traces the fresh entry under the new config's
+        env overlay and pays exactly one compile, which is banked on the
+        compile ledger under ``site`` — never ``trainer.step``, so that
+        site's ``assert_zero_post_warmup`` contract still holds across
+        the cutover. Safe mid-run: parameters, optimizer state, the step
+        counter, and the seen-signature set are all untouched.
+
+        ``entry`` is an autotune-cache entry (``{"config": {"env": ...},
+        ...}``); ``{}`` clears the tuned overlay, ``None`` keeps the
+        current one (rebuild only — still a guaranteed fresh compile)."""
+        if self._step_fn is None or self._step_ndims is None:
+            raise MXNetError("retune() before the first build — run "
+                             "prepare() or step() first")
+        if self.kv_fallback_active():
+            raise MXNetError("retune() stages a pjit rebuild; the "
+                             "kvstore-fallback path has no pjit step")
+        if entry is not None:
+            self._tuned = dict(entry)
+            self.autotune_entry = self._tuned or None
+        from .. import autotune as _autotune
+        tune_ctx = (_autotune.applied(self._tuned) if self._tuned
+                    else _nullcontext())
+        with tune_ctx:
+            self._step_fn = self._build_step(self._step_n_data,
+                                             self._step_ndims)
+        self._retune_site = site
 
     # ------------------------------------------------------------------
     def step_trace_args(self, *batch):
@@ -759,8 +799,11 @@ class ShardedTrainer:
                 ok = None
                 # a NEW signature is about to trace: overlay the autotune
                 # winner's env knobs for exactly that trace (user-set env
-                # always wins; see autotune.applied)
-                if new_sig and not fallback and self._tuned:
+                # always wins; see autotune.applied). A staged retune()
+                # cutover re-traces a FRESH pjit entry at a seen
+                # signature — same overlay rule applies
+                retuned_now = self._retune_site is not None and not fallback
+                if (new_sig or retuned_now) and not fallback and self._tuned:
                     from .. import autotune as _autotune
                     tune_ctx = _autotune.applied(self._tuned)
                 else:
@@ -810,6 +853,14 @@ class ShardedTrainer:
                     # divergence onset
                     if _cledger.enabled() and not fallback:
                         _cledger.bank_trainer(self, vals)
+                if retuned_now:
+                    # the staged cutover's one compile: seen signature,
+                    # fresh pjit entry — banked under the staging site
+                    # (director.recompile), never trainer.step, so the
+                    # step site's zero-post-warmup contract survives
+                    _clog.note(self._retune_site, sig,
+                               wall_ms=dispatch_ms, warmup=None)
+                    self._retune_site = None
                 # the dispatch ring: what this pod member actually ran,
                 # in order — the flight bundle's cross-host diff surface
                 _cledger.note_dispatch("trainer.step", sig)
@@ -859,7 +910,8 @@ class ShardedTrainer:
                     step=attempted, wall_ms=wall_ms,
                     device_wait_ms=(sync_ms if self._guard is not None
                                     else 0.0),
-                    compile_ms=(dispatch_ms if new_sig else 0.0),
+                    compile_ms=(dispatch_ms if (new_sig or retuned_now)
+                                else 0.0),
                     rolled_back=rolled_back,
                     rollback_to=(self._t if rolled_back else None))
             # one "step" frame + its segments on the profiler timeline —

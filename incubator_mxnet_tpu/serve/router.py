@@ -335,6 +335,27 @@ class Router:
                       "Accepted requests that hit their deadline").inc()
         return DeadlineExceeded(msg, self.retry_after_s())
 
+    def set_overload_policy(self, hedge_ms: Optional[float] = None,
+                            shed_depth: Optional[int] = None) -> Dict:
+        """Hot-swap the overload knobs on a live router — both are read
+        per request (``_admit`` / ``_await_result``), so the change
+        applies to the next admission with no restart and no inflight
+        disruption. The flight director's serve-side remediation; the
+        ``router.policy`` event makes every swap auditable even without
+        the director's decision ring. Returns the previous values (the
+        revert handle)."""
+        prev = {"hedge_ms": self.hedge_ms, "shed_depth": self.shed_depth}
+        if hedge_ms is not None:
+            self.hedge_ms = float(hedge_ms)
+        if shed_depth is not None:
+            self.shed_depth = int(shed_depth)
+        _tele_events.emit("router.policy", severity="info",
+                          hedge_ms=self.hedge_ms,
+                          shed_depth=self.shed_depth,
+                          prev_hedge_ms=prev["hedge_ms"],
+                          prev_shed_depth=prev["shed_depth"])
+        return prev
+
     def _admit(self, model: str, tenant: Optional[str],
                est_tokens: int = 0) -> None:
         healthy = self.replicas.healthy()

@@ -47,6 +47,7 @@ from typing import Dict, Optional
 
 from . import collective_ledger  # noqa: F401
 from . import compile_log  # noqa: F401
+from . import director  # noqa: F401
 from . import events  # noqa: F401
 from . import export  # noqa: F401
 from . import flight  # noqa: F401
@@ -78,7 +79,7 @@ __all__ = ["emit", "events", "get_events", "counts", "clear",
            "counter", "gauge", "histogram",
            "compile_log", "collective_ledger", "metrics", "export",
            "trace", "flight", "slo",
-           "memory", "numerics", "goodput",
+           "memory", "numerics", "goodput", "director",
            "SLO", "SLOMonitor",
            "prometheus_text", "chrome_trace", "otel_spans",
            "install_jsonl",
@@ -119,6 +120,9 @@ def snapshot(recent: int = 5) -> Dict:
         # the goodput ledger: run-level wall-clock attribution vector +
         # measured-vs-roofline MFU (empty-shaped when the ledger is off)
         "goodput": goodput.snapshot(),
+        # the flight director's audit surface: loop config, hysteresis
+        # state, and the bounded decision ring (one-line shape when off)
+        "director": director.snapshot(),
         # the collective-schedule ledger: banked per-site fingerprints,
         # the dispatch ring, and crosscheck state (the SPMD divergence
         # detector; empty-shaped when the ledger is off)
@@ -150,6 +154,7 @@ def reset() -> None:
     flight.reset()
     numerics.reset()
     goodput.reset()
+    director.reset()
     collective_ledger.reset()
     from ..parallel import elastic as _elastic
     _elastic.reset()

@@ -182,6 +182,11 @@ def bundle(reason: str, /, site: Optional[str] = None, **context) -> Dict:
     # going (attribution vector + measured-vs-roofline MFU) — the
     # "was it even training efficiently" page of the post-mortem
     section("goodput", goodput.snapshot)
+    # the flight director's decision ring: which remediations the closed
+    # loop applied (or reverted) before the run died — the "did the
+    # autopilot touch anything" page of the post-mortem
+    from . import director as _director
+    section("director", _director.snapshot)
     # the collective-schedule ledger: banked fingerprints + the dispatch
     # ring — a crosscheck-mismatch bundle shows WHICH site/signature this
     # process compiled differently from its peers

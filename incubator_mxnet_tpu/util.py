@@ -336,6 +336,57 @@ ENV_VARS: Dict[str, tuple] = {
                              "mxtpu_goodput_* gauges (share per "
                              "category, measured/predicted MFU, "
                              "divergence, unattributed share)."),
+    "MXTPU_DIRECTOR": ("0", "1 enables the flight director "
+                       "(telemetry.director): a closed adaptive loop "
+                       "that watches goodput.window events and "
+                       "hot-applies ONE allowlisted remediation per "
+                       "breach — prefetch depth for input_bound, a "
+                       "staged recompile (ledger site "
+                       "director.recompile) for compute_bound, Router "
+                       "shed/hedge for a serve SLO burn — with a "
+                       "damped hysteresis (cooldown + revert-if-worse, "
+                       "exactly one revert) and every decision on an "
+                       "audited ring. Host-side only; default off is "
+                       "one env read at install()."),
+    "MXTPU_DIRECTOR_DIVERGENCE_PCT": ("25", "Flight-director trigger "
+                                      "threshold: a goodput window "
+                                      "whose measured-vs-roofline MFU "
+                                      "divergence is at or below "
+                                      "-THRESHOLD percent counts as "
+                                      "breached."),
+    "MXTPU_DIRECTOR_WINDOWS": ("2", "Consecutive breached (or "
+                               "bucket-drifted) goodput windows "
+                               "required before the director acts — "
+                               "the debounce half of the hysteresis."),
+    "MXTPU_DIRECTOR_COOLDOWN": ("2", "Goodput windows the director "
+                                "holds after every decision before it "
+                                "may act again; the first window after "
+                                "the cooldown is the revert-if-worse "
+                                "evaluation sample."),
+    "MXTPU_DIRECTOR_REVERT_MARGIN_PCT": ("5", "Revert-if-worse margin: "
+                                         "the post-cooldown window's "
+                                         "divergence must be at least "
+                                         "this many points below the "
+                                         "pre-action baseline to "
+                                         "trigger the (single) "
+                                         "revert."),
+    "MXTPU_DIRECTOR_RING": ("64", "Flight-director decision-ring "
+                            "capacity (the audit trail embedded in "
+                            "telemetry.snapshot(), flight bundles and "
+                            "tools/postmortem.py)."),
+    "MXTPU_DIRECTOR_MAX_DEPTH": ("8", "Cap on the PrefetchIter depth "
+                                 "the director's input_bound "
+                                 "remediation may grow to (doubling "
+                                 "per action up to the cap)."),
+    "MXTPU_DIRECTOR_BUDGET": ("4", "Candidate budget for the "
+                              "director's rescored trace-only autotune "
+                              "search (benchmark.autotune.search with "
+                              "the measured attribution folded into "
+                              "the roofline score)."),
+    "MXTPU_DIRECTOR_HEDGE_MS": ("50", "Hedge deadline the director's "
+                                "serve-side remediation enables on a "
+                                "Router whose hedging was off when the "
+                                "SLO burn fired."),
     "MXTPU_TELEMETRY": ("1", "Master switch for the mx.telemetry event "
                         "bus; 0 turns every emit() into a no-op."),
     "MXTPU_TELEMETRY_RING": ("1024", "Per-kind event ring-buffer capacity; "

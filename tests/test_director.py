@@ -1,0 +1,521 @@
+"""Flight director (ISSUE 19): the closed adaptive loop over goodput ×
+autotune — breach/drift triggering, the allowlisted policy table, damped
+hysteresis (cooldown / revert-if-worse-exactly-once / hold), the
+rescored autotune hook, the staged-recompile ledger contract, the
+prefetch live resize, and the audit-ring observability surfaces."""
+import os
+import types
+
+import jax
+import numpy as onp
+import pytest
+
+import incubator_mxnet_tpu as mx
+from incubator_mxnet_tpu import gluon, parallel, telemetry
+from incubator_mxnet_tpu import io as mio
+from incubator_mxnet_tpu.telemetry import compile_log, director, goodput
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    telemetry.clear()
+    goodput.reset()
+    director.reset()
+    yield
+    director.reset()
+    goodput.reset()
+
+
+# ---------------------------------------------------------------------------
+# fakes — the loop logic is a pure function of window dicts + targets
+# ---------------------------------------------------------------------------
+
+class _FakeIter:
+    def __init__(self, depth=1):
+        self._depth = depth
+        self.calls = []
+
+    @property
+    def depth(self):
+        return self._depth
+
+    def set_depth(self, depth):
+        prev, self._depth = self._depth, int(depth)
+        self.calls.append(int(depth))
+        return prev
+
+
+class _FakeTrainer:
+    _autotune_key = "not_a_family"
+
+    def __init__(self, entry=None):
+        self.autotune_entry = entry
+        self.retunes = []
+
+    def retune(self, entry=None, site="director.recompile"):
+        self.retunes.append((entry, site))
+        if entry is not None:
+            self.autotune_entry = dict(entry) or None
+
+
+class _FakeRouter:
+    def __init__(self):
+        self.shed_depth = 0
+        self.hedge_ms = 0.0
+        self.calls = []
+
+    def set_overload_policy(self, hedge_ms=None, shed_depth=None):
+        prev = {"hedge_ms": self.hedge_ms, "shed_depth": self.shed_depth}
+        if hedge_ms is not None:
+            self.hedge_ms = float(hedge_ms)
+        if shed_depth is not None:
+            self.shed_depth = int(shed_depth)
+        self.calls.append((hedge_ms, shed_depth))
+        return prev
+
+
+def _director(**kw):
+    kw.setdefault("divergence_pct", 25.0)
+    kw.setdefault("windows", 2)
+    kw.setdefault("cooldown", 2)
+    kw.setdefault("revert_margin_pct", 5.0)
+    return director.FlightDirector(**kw)
+
+
+def _win(window, div=-60.0, cls="input_bound", rolled=0, wall=100.0,
+         cats=None):
+    return {"window": window, "wall_ms": wall, "steps": 4,
+            "good_steps": 4 - rolled, "rolled_back_steps": rolled,
+            "classification": cls,
+            "mfu": None if div is None else {"divergence_pct": div},
+            "categories": cats or {"input_wait": 60.0, "host": 20.0,
+                                   "compute": 15.0, "collective": 5.0}}
+
+
+def _kinds(d):
+    return [dec["action"].get("kind") for dec in d.snapshot()["decisions"]]
+
+
+# ---------------------------------------------------------------------------
+# off-by-default + wiring
+# ---------------------------------------------------------------------------
+
+def test_off_by_default(monkeypatch):
+    monkeypatch.delenv("MXTPU_DIRECTOR", raising=False)
+    assert not director.enabled()
+    assert director.install(prefetch=_FakeIter()) is None
+    assert director.get() is None
+    snap = director.snapshot()
+    assert snap == {"enabled": False, "installed": False, "decisions": []}
+    # telemetry.snapshot() embeds the same uninstalled stub
+    assert telemetry.snapshot()["director"]["installed"] is False
+
+
+def test_configure_override_and_reset(monkeypatch):
+    monkeypatch.delenv("MXTPU_DIRECTOR", raising=False)
+    director.configure(on=True)
+    assert director.enabled()
+    d = director.install(prefetch=_FakeIter())
+    assert d is not None and director.get() is d
+    director.reset()                     # drops singleton AND override
+    assert director.get() is None and not director.enabled()
+
+
+def test_policy_table_pinned():
+    assert director.POLICY == {
+        "input_bound": "io.prefetch_depth",
+        "compute_bound": "trainer.retune",
+        "rollback_storm": "trainer.retune",
+        "serve_breach": "router.overload_policy",
+    }
+
+
+def test_telemetry_reset_uninstalls():
+    director.configure(on=True)
+    director.install(prefetch=_FakeIter())
+    telemetry.reset()
+    assert director.get() is None
+
+
+# ---------------------------------------------------------------------------
+# triggering: consecutive-window streak, breach sign, drift
+# ---------------------------------------------------------------------------
+
+def test_single_breach_window_never_triggers():
+    it = _FakeIter()
+    d = _director(prefetch=it)
+    d._on_window(_win(1))
+    assert not it.calls and not d.snapshot()["decisions"]
+    assert d.snapshot()["state"]["streak"] == 1
+
+
+def test_consecutive_breaches_trigger_one_action():
+    it = _FakeIter()
+    d = _director(prefetch=it)
+    d._on_window(_win(1))
+    d._on_window(_win(2))
+    assert it.calls == [2]               # depth 1 -> 2, exactly once
+    (dec,) = d.snapshot()["decisions"]
+    assert dec["action"] == {"kind": "io.prefetch_depth",
+                             "site": "io.PrefetchIter", "from": 1, "to": 2}
+    assert dec["trigger"]["policy_key"] == "input_bound"
+    assert dec["candidates"]             # the candidate table is audited
+
+
+def test_positive_divergence_is_not_a_breach():
+    # sign convention: divergence = 100*(measured/predicted - 1);
+    # ABOVE the roofline (positive) must never count toward the streak
+    it = _FakeIter()
+    d = _director(prefetch=it)
+    for w in range(1, 5):
+        d._on_window(_win(w, div=60.0))
+    assert not it.calls and not d.snapshot()["decisions"]
+
+
+def test_streak_resets_on_clean_window():
+    it = _FakeIter()
+    d = _director(prefetch=it)
+    d._on_window(_win(1))
+    d._on_window(_win(2, div=-1.0))      # inside threshold — streak resets
+    d._on_window(_win(3))
+    assert not it.calls
+    d._on_window(_win(4))
+    assert it.calls == [2]
+
+
+def test_sustained_bucket_drift_triggers_without_breach():
+    it = _FakeIter()
+    d = _director(prefetch=it)
+    d._on_window(_win(1, div=-1.0, cls="compute_bound"))  # stable class
+    d._on_window(_win(2, div=-1.0, cls="input_bound"))    # drift 1
+    assert not d.snapshot()["decisions"]    # one drifted window: nothing
+    d._on_window(_win(3, div=-1.0, cls="input_bound"))    # drift 2: act
+    assert it.calls == [2]
+    (dec,) = d.snapshot()["decisions"]
+    assert dec["trigger"]["drift"] is True
+    assert dec["trigger"]["breach"] is False
+    # the trigger re-anchors the stable class to what the run drifted to
+    assert d.snapshot()["state"]["stable_class"] == "input_bound"
+
+
+# ---------------------------------------------------------------------------
+# hysteresis: cooldown, hold, revert-if-worse exactly once
+# ---------------------------------------------------------------------------
+
+def test_cooldown_blocks_and_hold_freezes_kind():
+    it = _FakeIter()
+    d = _director(prefetch=it)
+    d._on_window(_win(1))
+    d._on_window(_win(2))                # applied: depth 1 -> 2
+    d._on_window(_win(3))                # cooldown 2 -> 1: held
+    assert len(d.snapshot()["decisions"]) == 1
+    d._on_window(_win(4))                # cooldown over: evaluation sample
+    # same divergence as the baseline — kept but HELD (no re-fire)
+    assert _kinds(d) == ["io.prefetch_depth", "hold"]
+    assert d.snapshot()["state"]["held"] == ["io.prefetch_depth"]
+    d._on_window(_win(5))
+    d._on_window(_win(6))                # streak trips again...
+    assert it.calls == [2]               # ...but the knob never re-fires
+    assert _kinds(d)[-1] == "none"
+
+
+def test_revert_if_worse_exactly_once_then_veto():
+    it = _FakeIter()
+    d = _director(prefetch=it)
+    d._on_window(_win(1, div=-30.0))
+    d._on_window(_win(2, div=-30.0))     # applied; baseline -30
+    d._on_window(_win(3, div=-80.0))
+    d._on_window(_win(4, div=-80.0))     # post-cooldown: worse by 50 pts
+    assert it.calls == [2, 1]            # the one revert undid the resize
+    snap = d.snapshot()
+    assert snap["state"]["reverts_total"] == 1
+    assert snap["state"]["vetoed"] == ["io.prefetch_depth"]
+    # the applied decision is flagged on the ring, the revert is audited
+    applied, revert = snap["decisions"]
+    assert applied["reverted"] is True and revert["action"]["kind"] == \
+        "revert"
+    # further breaches: the revert opened its own cooldown (5, 6), then
+    # the streak rebuilds (7, 8) — vetoed: audited no-action, never a
+    # re-apply
+    for w in range(5, 9):
+        d._on_window(_win(w, div=-80.0))
+    assert it.calls == [2, 1] and _kinds(d)[-1] == "none"
+    assert "vetoed" in d.snapshot()["decisions"][-1]["action"]["reason"]
+    assert d.snapshot()["state"]["reverts_total"] == 1
+
+
+def test_measurably_better_keeps_kind_armed():
+    it = _FakeIter()
+    d = _director(prefetch=it)
+    d._on_window(_win(1, div=-60.0))
+    d._on_window(_win(2, div=-60.0))     # applied: 1 -> 2, baseline -60
+    d._on_window(_win(3, div=-40.0))
+    d._on_window(_win(4, div=-40.0))     # post-cooldown: better by 20 pts
+    assert d.snapshot()["state"]["held"] == []
+    d._on_window(_win(5, div=-40.0))
+    d._on_window(_win(6, div=-40.0))     # still breached: may escalate
+    assert it.calls == [2, 4]            # armed kinds escalate while helping
+
+
+def test_depth_cap_is_an_audited_no_action():
+    it = _FakeIter(depth=8)
+    d = _director(prefetch=it, max_depth=8)
+    d._on_window(_win(1))
+    d._on_window(_win(2))
+    assert not it.calls
+    (dec,) = d.snapshot()["decisions"]
+    assert dec["action"]["kind"] == "none" and "cap" in \
+        dec["action"]["reason"]
+
+
+# ---------------------------------------------------------------------------
+# policy routing
+# ---------------------------------------------------------------------------
+
+def test_rollback_storm_outranks_bucket_and_retunes():
+    tr = _FakeTrainer(entry={"config": {"env": {"XLA_FLAGS": "x"}},
+                             "score": 1.0})
+    d = _director(trainer=tr)
+    d._on_window(_win(1, div=-90.0, cls="host_bound", rolled=3))
+    d._on_window(_win(2, div=-90.0, cls="host_bound", rolled=4))
+    assert len(tr.retunes) == 1
+    entry, site = tr.retunes[0]
+    assert site == "director.recompile"
+    (dec,) = d.snapshot()["decisions"]
+    assert dec["action"]["kind"] == "trainer.retune"
+    assert dec["trigger"]["policy_key"] == "rollback_storm"
+    assert dec["trigger"]["rolled_back_steps"] == 4
+    # family outside the search space: banked fallback, still audited
+    assert dec["action"]["source"] == "banked"
+    assert entry["config"]["env"] == {"XLA_FLAGS": "x"}
+
+
+def test_unremediable_bucket_is_audited_hands_off():
+    d = _director(trainer=_FakeTrainer(), prefetch=_FakeIter())
+    d._on_window(_win(1, cls="collective_bound"))
+    d._on_window(_win(2, cls="collective_bound"))
+    (dec,) = d.snapshot()["decisions"]
+    assert dec["action"]["kind"] == "none"
+    assert "collective_bound" in dec["action"]["reason"]
+    # the no-action decision still opened a cooldown — no per-window spam
+    d._on_window(_win(3, cls="collective_bound"))
+    assert len(d.snapshot()["decisions"]) == 1
+
+
+def test_input_bound_without_prefetch_target():
+    d = _director(trainer=_FakeTrainer())
+    d._on_window(_win(1))
+    d._on_window(_win(2))
+    (dec,) = d.snapshot()["decisions"]
+    assert dec["action"]["kind"] == "none"
+    assert "no PrefetchIter" in dec["action"]["reason"]
+
+
+# ---------------------------------------------------------------------------
+# serve-side breach (slo.burn)
+# ---------------------------------------------------------------------------
+
+def _burn(slo="ttft_p95", severity="error", **fields):
+    fields.setdefault("slo", slo)
+    return types.SimpleNamespace(kind="slo.burn", severity=severity,
+                                 fields=fields)
+
+
+def test_serve_breach_once_per_episode():
+    r = _FakeRouter()
+    d = _director(router=r)
+    d._on_event(_burn(burn=3.0, bad_fraction=0.4))
+    d._on_event(_burn(burn=4.0, bad_fraction=0.5))   # still burning
+    assert len(r.calls) == 1                         # one action, no stack
+    assert r.shed_depth == 8 and r.hedge_ms == 50.0
+    (dec,) = d.snapshot()["decisions"]
+    assert dec["action"]["kind"] == "router.overload_policy"
+    assert dec["trigger"]["slo"] == "ttft_p95"
+    # recovery re-arms the episode
+    d._on_event(_burn(severity="info", recovered=True, burn=0.1))
+    d._on_event(_burn(burn=2.0, bad_fraction=0.3))
+    assert len(r.calls) == 2
+
+
+def test_serve_breach_halves_existing_shed_keeps_hedge():
+    r = _FakeRouter()
+    r.shed_depth, r.hedge_ms = 16, 20.0
+    d = _director(router=r)
+    d._on_event(_burn(burn=3.0))
+    assert r.shed_depth == 8 and r.hedge_ms == 20.0
+
+
+# ---------------------------------------------------------------------------
+# audit surfaces: bus events, ring bound, gauges, bundles
+# ---------------------------------------------------------------------------
+
+def test_decisions_land_on_the_bus():
+    director.configure(on=True)
+    it = _FakeIter()
+    d = director.install(prefetch=it, windows=2, cooldown=2)
+    from incubator_mxnet_tpu.telemetry import events
+    events.emit("goodput.window", severity="info", **_win(1))
+    events.emit("goodput.window", severity="info", **_win(2))
+    assert it.calls == [2]
+    evs = telemetry.get_events("director.decision")
+    assert len(evs) == 1 and evs[0].severity == "warning"
+    assert evs[0].fields["action"]["kind"] == "io.prefetch_depth"
+    assert evs[0].fields["hysteresis"]["cooldown_left"] == 2
+    # ... and the snapshot/bundle both embed the same ring
+    assert telemetry.snapshot()["director"]["decisions"] == \
+        d.snapshot()["decisions"]
+    from incubator_mxnet_tpu.telemetry import flight
+    doc = flight.bundle("director_test")
+    assert doc["director"]["decisions"][0]["action"]["kind"] == \
+        "io.prefetch_depth"
+
+
+def test_ring_is_bounded_counters_are_not():
+    d = _director(trainer=_FakeTrainer(), ring=3, windows=1, cooldown=1)
+    for w in range(1, 11):
+        d._on_window(_win(w, cls="collective_bound"))
+    snap = d.snapshot()
+    assert len(snap["decisions"]) == 3
+    assert snap["state"]["decisions_total"] > 3
+
+
+def test_gauges_published():
+    d = _director(prefetch=_FakeIter())
+    d._on_window(_win(1, div=-42.0))
+    from incubator_mxnet_tpu.telemetry import metrics
+    text = metrics.prometheus_text()
+    assert "mxtpu_director_breach_streak 1" in text
+    assert "mxtpu_director_last_divergence_pct -42" in text
+
+
+def test_postmortem_renders_decision_ring():
+    director.configure(on=True)
+    it = _FakeIter()
+    director.install(prefetch=it, windows=2, cooldown=2)
+    d = director.get()
+    d._on_window(_win(1))
+    d._on_window(_win(2))
+    from incubator_mxnet_tpu.telemetry import flight
+    from tools import postmortem
+    text = postmortem.render(flight.bundle("director_test"))
+    assert "flight director" in text
+    assert "prefetch depth 1 -> 2" in text
+
+
+# ---------------------------------------------------------------------------
+# the rescoring hook (benchmark.autotune.score measured=...)
+# ---------------------------------------------------------------------------
+
+_METRICS = {"flops_per_step": 2.0e12, "hbm_bytes_per_step": 1.0e11,
+            "comm_bytes_per_step": 2.0e10, "fusion_groups": 12,
+            "graphs": 1, "tokens_per_step": 4096}
+
+
+def test_score_without_measured_is_bit_identical():
+    from benchmark import autotune
+    assert autotune.score(_METRICS) == autotune.score(_METRICS,
+                                                      measured=None)
+
+
+def test_score_measured_reweighting():
+    from benchmark import autotune
+    base = autotune.score(_METRICS)
+    # input/host time the analytic model assumes away lowers the score
+    starved = autotune.score(_METRICS, measured={
+        "compute": 0.2, "input_wait": 0.7, "host": 0.05,
+        "collective": 0.05})
+    assert starved < base
+    # measured comm can only RAISE the analytic comm term (lower bound):
+    # a measured fraction below the analytic estimate changes nothing
+    tiny_comm = autotune.score(_METRICS, measured={
+        "compute": 1.0, "input_wait": 0.0, "host": 0.0,
+        "collective": 1e-9})
+    assert tiny_comm == pytest.approx(base)
+    # deterministic: same inputs, same score
+    assert starved == autotune.score(_METRICS, measured={
+        "compute": 0.2, "input_wait": 0.7, "host": 0.05,
+        "collective": 0.05})
+
+
+def test_measured_fractions_from_window():
+    f = director.FlightDirector._measured_fractions(
+        _win(1, wall=100.0, cats={"input_wait": 50.0, "host": 10.0,
+                                  "compute": 30.0, "collective": 10.0}))
+    assert f == {"compute": 0.3, "collective": 0.1, "input_wait": 0.5,
+                 "host": 0.1}
+    assert director.FlightDirector._measured_fractions(
+        {"wall_ms": 0.0}) is None
+
+
+# ---------------------------------------------------------------------------
+# the real remediation targets (live trainer + iterator)
+# ---------------------------------------------------------------------------
+
+def _trainer():
+    mx.random.seed(7)
+    net = gluon.nn.HybridSequential(prefix="dir_")
+    with net.name_scope():
+        net.add(gluon.nn.Dense(16, activation="relu", in_units=12),
+                gluon.nn.Dense(4, in_units=16))
+    net.initialize(mx.init.Xavier())
+    return parallel.ShardedTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.05},
+        mesh=parallel.make_mesh(devices=jax.devices()[:1]))
+
+
+def test_trainer_retune_banks_on_director_site():
+    tr = _trainer()
+    rng = onp.random.RandomState(0)
+    x = rng.randn(16, 12).astype("float32")
+    y = rng.randint(0, 4, (16,)).astype("float32")
+    tr.step(x, y).asnumpy()
+    assert len(compile_log.records("trainer.step")) == 1
+    compile_log.mark_warmed("trainer.step")
+
+    tr.retune({"config": {"env": {}}}, site="director.recompile")
+    loss = tr.step(x, y).asnumpy()
+    assert onp.isfinite(loss).all()
+    # the cutover compile is banked under the director's site — and the
+    # trainer.step zero-post-warmup contract survives the staged swap
+    recs = compile_log.records("director.recompile")
+    assert len(recs) == 1 and recs[0].warmup
+    compile_log.assert_zero_post_warmup("trainer.step")
+    compile_log.mark_warmed("director.recompile")
+    # steady state after the cutover: one graph, no further compiles
+    tr.step(x, y).asnumpy()
+    compile_log.assert_zero_post_warmup("director.recompile")
+    assert tr.last_step_graphs == 1
+
+
+def test_trainer_retune_requires_built_step():
+    with pytest.raises(mx.MXNetError, match="retune"):
+        _trainer().retune({"config": {"env": {}}})
+
+
+def test_prefetch_set_depth_live_resize_no_batch_dropped():
+    rng = onp.random.RandomState(0)
+    x = rng.randn(64, 12).astype("float32")
+    it = mio.PrefetchIter(
+        mio.NDArrayIter(x, batch_size=8, last_batch_handle="discard"),
+        depth=1)
+    seen = []
+    for i, b in enumerate(it):
+        if i == 2:
+            assert it.set_depth(4) == 1 and it.depth == 4
+        seen.append(onp.asarray(b.data[0])[0, 0])
+    assert len(seen) == 8                # 64/8 — nothing dropped
+    assert seen == sorted(set(seen), key=seen.index)  # in order, no dupes
+    assert seen == [float(x[i * 8, 0]) for i in range(8)]
+    it.close()
+
+
+def test_prefetch_set_depth_validates():
+    it = mio.PrefetchIter(
+        mio.NDArrayIter(onp.zeros((8, 4), "float32"), batch_size=4),
+        depth=2)
+    with pytest.raises(mx.MXNetError):
+        it.set_depth(0)
+    it.close()
+    with pytest.raises(mx.MXNetError):
+        it.set_depth(3)

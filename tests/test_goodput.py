@@ -107,6 +107,50 @@ def test_classification_input_vs_compute_bound():
     assert goodput.report()["classification"] == "compute_bound"
 
 
+def test_dominant_bucket_tie_break_order_pinned():
+    """Regression pin (ISSUE 19): the flight director's policy table
+    keys off the classification, so the triage tie-break order —
+    input_wait > host > collective > compute, first wins exact ties —
+    is load-bearing API, not an implementation detail."""
+    assert goodput._BOUND_CATEGORIES == ("input_wait", "host",
+                                         "collective", "compute")
+    # exact ties resolve to the EARLIER triage bucket at every rank
+    tie = {"input_wait": 5.0, "host": 5.0, "collective": 5.0,
+           "compute": 5.0}
+    assert goodput._classify(tie) == "input_bound"
+    assert goodput._classify({"host": 5.0, "collective": 5.0,
+                              "compute": 5.0}) == "host_bound"
+    assert goodput._classify({"collective": 5.0,
+                              "compute": 5.0}) == "collective_bound"
+    # strictly-larger later bucket still wins
+    assert goodput._classify({"input_wait": 5.0,
+                              "compute": 5.1}) == "compute_bound"
+    # all-zero (or empty) vectors classify as nothing, never a default
+    assert goodput._classify({}) is None
+    assert goodput._classify({"compute": 0.0}) is None
+
+
+def test_divergence_gauge_sign_convention_pinned():
+    """Regression pin (ISSUE 19): divergence = 100·(measured/predicted
+    − 1) — measured MFU BELOW the roofline is NEGATIVE. The director's
+    breach test (`div <= -threshold`) depends on this sign; flipping it
+    would silently disarm the loop."""
+    goodput.configure(on=True)
+    prof = goodput.set_cost_profile(flops_per_step=1e9)
+    predicted = prof["predicted_mfu"]
+    assert predicted is not None and predicted > 0
+    # wall long enough that measured MFU falls below the roofline
+    slow = goodput._mfu(wall_ms=1e3, good_steps=1)
+    assert slow["measured_mfu"] < predicted
+    assert slow["divergence_pct"] < 0
+    assert slow["divergence_pct"] == pytest.approx(
+        100.0 * (slow["measured_mfu"] / predicted - 1.0), abs=0.01)
+    # and a run FASTER than predicted reads positive — no breach
+    fast_wall_ms = prof["roofline_s"] * 1e3 / 2.0
+    fast = goodput._mfu(wall_ms=fast_wall_ms, good_steps=1)
+    assert fast["divergence_pct"] > 0
+
+
 def test_inter_step_gap_lands_in_host():
     import time
     goodput.configure(on=True, window=100)

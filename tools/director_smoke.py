@@ -1,0 +1,185 @@
+#!/usr/bin/env python
+"""Flight-director smoke — the CI gate for ISSUE 19.
+
+Runs two short chaos-injected training phases with the goodput ledger
+AND the flight director on, then asserts the closed loop end to end:
+
+1. **input_remediated** — under seeded ``slow_input`` chaos the windows
+   classify ``input_bound`` and the director applies exactly ONE
+   ``io.prefetch_depth`` remediation (live ``PrefetchIter.set_depth``
+   resize, no batch dropped), then holds: zero reverts, zero repeat
+   applications of the same kind;
+2. **storm_remediated** — under seeded ``grad_blowup`` chaos with a
+   ``skip_and_rollback`` guard the windows carry rolled-back steps and
+   the director applies exactly ONE ``trainer.retune`` staged
+   recompile;
+3. **staged_recompile_on_ledger** — the one compile the cutover costs
+   is banked on the compile ledger under the ``director.recompile``
+   site, the post-retune trainer still runs ONE jitted graph per step,
+   and ``assert_zero_post_warmup`` holds for BOTH the ``trainer.step``
+   and ``director.recompile`` sites after the cutover;
+4. **zero_oscillation** — across both phases: no revert decisions, no
+   action kind applied twice (the hysteresis hold/cooldown damping) —
+   the A→B→A hunt the damping exists to prevent never happens;
+5. **decisions_audited** — every decision landed on the bus as a
+   ``director.decision`` event (the stream is then independently
+   validated by telemetry_check) and the bounded decision ring renders
+   through ``flight.bundle`` → ``tools/postmortem.py``.
+
+Prints one JSON line of gates; exit 0 = all green, 1 = any gate red.
+
+    MXTPU_TELEMETRY_JSONL=events.jsonl python -m tools.director_smoke
+"""
+from __future__ import annotations
+
+# mxlint: disable-file=MX401 — throwaway chaos smokes whose runs are the
+# test fixture; checkpointing them would only slow the gate down
+
+import json
+import os
+import sys
+import warnings
+
+
+def _setup_env() -> None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["MXTPU_GOODPUT"] = "1"
+    os.environ["MXTPU_GOODPUT_WINDOW"] = "4"
+    os.environ["MXTPU_DIRECTOR"] = "1"
+
+
+def _build(mx, gluon, parallel, fault, jax, guard=None):
+    mx.random.seed(11)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(32, activation="relu", in_units=16),
+            gluon.nn.Dense(8, in_units=32))
+    net.initialize(mx.init.Xavier())
+    return parallel.ShardedTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.05},
+        mesh=parallel.make_mesh(devices=jax.devices()[:1]),
+        guard=guard or fault.StepGuard(policy="warn"))
+
+
+def _applied(decisions, kind):
+    return [d for d in decisions if d["action"].get("kind") == kind]
+
+
+def main() -> int:
+    _setup_env()
+    import numpy as onp
+
+    import incubator_mxnet_tpu as mx
+    import jax
+    from incubator_mxnet_tpu import fault, gluon, parallel, telemetry
+    from incubator_mxnet_tpu import io as mio
+    from incubator_mxnet_tpu.telemetry import (compile_log, director, flight,
+                                               goodput)
+
+    gates = {}
+    steps = 24
+
+    # -- phase 1: input starvation → one prefetch-depth remediation ------
+    tr = _build(mx, gluon, parallel, fault, jax)
+    rng = onp.random.RandomState(0)
+    x = rng.randn(16 * (steps + 2), 16).astype("float32")
+    y = rng.randint(0, 8, (16 * (steps + 2),)).astype("float32")
+    tr.step(x[:16], y[:16]).asnumpy()       # init + compile (pre-begin)
+    goodput.price(tr, sample_args=(x[:16], y[:16]))
+    it = mio.PrefetchIter(
+        mio.NDArrayIter(x, y, batch_size=16, last_batch_handle="discard"),
+        place=lambda b: tr.place(*(b.data + b.label)), depth=1)
+    director.install(trainer=tr, prefetch=it, windows=2, cooldown=2)
+    goodput.begin()
+    with fault.inject.chaos(seed=7, slow_input=1.0, delay_s=0.02):
+        for i, placed in enumerate(it):
+            tr.step(*placed)
+            if i + 1 >= steps:
+                break
+    depth_after = it.depth
+    it.close()
+    snap1 = director.snapshot()
+    dec1 = snap1["decisions"]
+    grew = _applied(dec1, "io.prefetch_depth")
+    gates["p1_decisions"] = len(dec1)
+    gates["p1_depth_after"] = depth_after
+    gates["input_remediated"] = bool(
+        len(grew) == 1 and grew[0]["action"]["from"] == 1
+        and grew[0]["action"]["to"] == depth_after > 1
+        and grew[0]["trigger"]["classification"] == "input_bound")
+    gates["p1_no_reverts"] = (snap1["state"]["reverts_total"] == 0
+                              and not _applied(dec1, "revert"))
+
+    # -- phase 2: rollback storm → one staged recompile ------------------
+    goodput.reset()
+    os.environ["MXTPU_GOODPUT"] = "1"       # reset cleared overrides only
+    tr2 = _build(mx, gluon, parallel, fault, jax,
+                 guard=fault.StepGuard(policy="skip_and_rollback",
+                                       grad_norm_limit=10.0,
+                                       max_consecutive=200))
+    xb, yb = x[:16], y[:16]
+    tr2.step(xb, yb).asnumpy()              # init + compile (pre-begin)
+    goodput.price(tr2, sample_args=(xb, yb))
+    director.install(trainer=tr2, windows=2, cooldown=2)
+    goodput.begin()
+    with fault.inject.chaos(seed=7, grad_blowup=1.0, blowup_factor=16.0), \
+            warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for _ in range(steps):
+            tr2.step(xb, yb)
+    snap2 = director.snapshot()
+    dec2 = snap2["decisions"]
+    retuned = _applied(dec2, "trainer.retune")
+    gates["p2_decisions"] = len(dec2)
+    gates["storm_remediated"] = bool(
+        len(retuned) == 1
+        and retuned[0]["trigger"]["policy_key"] == "rollback_storm"
+        and (retuned[0]["trigger"]["rolled_back_steps"] or 0) > 0)
+    gates["p2_no_reverts"] = (snap2["state"]["reverts_total"] == 0
+                              and not _applied(dec2, "revert"))
+    gates["one_graph_per_step"] = tr2.last_step_graphs == 1
+
+    # the staged recompile is banked under its own ledger site and the
+    # zero-post-warmup contract holds for both sites across the cutover
+    n_recompile = len(compile_log.records("director.recompile"))
+    gates["recompile_records"] = n_recompile
+    compile_log.mark_warmed("trainer.step")
+    compile_log.mark_warmed("director.recompile")
+    try:
+        compile_log.assert_zero_post_warmup("trainer.step")
+        compile_log.assert_zero_post_warmup("director.recompile")
+        gates["staged_recompile_on_ledger"] = n_recompile == 1
+    except (AssertionError, mx.MXNetError):
+        gates["staged_recompile_on_ledger"] = False
+
+    # -- cross-phase damping: never the same knob twice, never A→B→A -----
+    all_dec = dec1 + dec2
+    applied_kinds = [d["action"]["kind"] for d in all_dec
+                     if d["action"].get("kind") not in
+                     (None, "none", "hold", "revert")]
+    gates["zero_oscillation"] = bool(
+        gates["p1_no_reverts"] and gates["p2_no_reverts"]
+        and len(applied_kinds) == len(set(applied_kinds)))
+
+    # -- the audit trail is first-class observability --------------------
+    evs = telemetry.get_events("director.decision")
+    gates["decision_events"] = len(evs)
+    gates["decisions_audited"] = len(evs) == len(all_dec) > 0
+    from tools import postmortem
+    doc = flight.bundle("director_smoke")
+    rendered = postmortem.render(doc)
+    gates["ring_renders"] = ("flight director" in rendered
+                             and "trainer.retune" in rendered)
+
+    ok = all(gates[k] for k in
+             ("input_remediated", "p1_no_reverts", "storm_remediated",
+              "p2_no_reverts", "one_graph_per_step",
+              "staged_recompile_on_ledger", "zero_oscillation",
+              "decisions_audited", "ring_renders"))
+    gates["ok"] = ok
+    print(json.dumps(gates, sort_keys=True))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

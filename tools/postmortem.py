@@ -217,6 +217,44 @@ def render(doc: Dict, events_n: int = 40) -> str:
         if gp.get("classification"):
             out.append(f"  classification: {gp['classification']}")
 
+    # -- flight director: the closed loop's decision ring ------------------
+    fd = doc.get("director") or {}
+    decisions = fd.get("decisions") or []
+    if isinstance(fd, dict) and (decisions or fd.get("installed")):
+        st = fd.get("state") or {}
+        out += _section(
+            f"flight director ({st.get('decisions_total', 0)} decision(s), "
+            f"{st.get('reverts_total', 0)} revert(s), "
+            f"cooldown_left={st.get('cooldown_left', 0)})")
+        for dec in decisions:
+            trig = dec.get("trigger") or {}
+            act = dec.get("action") or {}
+            kind = act.get("kind")
+            where = (f"window {trig['window']}" if trig.get("window")
+                     is not None else f"slo {trig.get('slo')}")
+            desc = kind
+            if kind == "io.prefetch_depth":
+                desc = f"prefetch depth {act.get('from')} -> {act.get('to')}"
+            elif kind == "trainer.retune":
+                desc = (f"staged recompile ({act.get('source')}) env "
+                        f"{act.get('from')} -> {act.get('to')}")
+            elif kind == "router.overload_policy":
+                desc = f"router {act.get('from')} -> {act.get('to')}"
+            elif kind in ("none", "hold", "revert"):
+                desc = f"{kind}: {act.get('reason') or act.get('of', '')}"
+            # reverts and reverted actions are the page's alarm lines —
+            # a remediation that had to be undone IS the triage lead
+            bad = kind == "revert" or dec.get("reverted")
+            out.append(
+                f"  {'!!' if bad else '  '} #{dec.get('id')} {where} "
+                f"div={trig.get('divergence_pct')} "
+                f"cls={trig.get('classification')}: {desc}"
+                f"{'  [REVERTED]' if dec.get('reverted') else ''}")
+        vetoed = st.get("vetoed") or []
+        held = st.get("held") or []
+        if vetoed or held:
+            out.append(f"  vetoed={vetoed} held={held}")
+
     # -- collective schedule: the SPMD-divergence ledger -------------------
     cs = doc.get("collective_schedule") or {}
     banked = cs.get("banked") or {}
@@ -302,7 +340,8 @@ def render(doc: Dict, events_n: int = 40) -> str:
                             "mxtpu_guard_", "mxtpu_watchdog_",
                             "mxtpu_chaos_", "mxtpu_lockcheck_",
                             "mxtpu_memory_", "mxtpu_numerics_drift",
-                            "mxtpu_goodput_", "mxtpu_io_",
+                            "mxtpu_goodput_", "mxtpu_director_",
+                            "mxtpu_io_",
                             "mxtpu_collective_",
                             "mxtpu_router_", "mxtpu_serve_replica")):
             for labels, val in sorted(mets[name].items()):

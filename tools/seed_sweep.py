@@ -112,6 +112,38 @@ def _run_metric_gate(example, argv, seed, timeout):
     return None, (r.stderr or r.stdout)[-300:]
 
 
+PAUSE_PIDFILE = os.path.join(REPO, "benchmark", ".pause_during_window.pid")
+
+
+def _write_pause_pidfile() -> None:
+    """Advertise this sweep's process group to tools/tpu_window.py so a
+    TPU window can SIGSTOP it for the duration of a step program. Two
+    lines: our pgid, then a cmdline hint the window loop verifies against
+    /proc/<pgid>/cmdline before signalling (a reused pgid must never
+    freeze an unrelated group). Deleted on exit — only if the content is
+    still ours, so a successor sweep's file survives a late atexit."""
+    import atexit
+    pgid = os.getpgrp()
+    content = f"{pgid}\nseed_sweep\n"
+    try:
+        with open(PAUSE_PIDFILE, "w") as f:
+            f.write(content)
+    except OSError as e:
+        print(f"pause pidfile not written ({e}); a concurrent TPU window "
+              f"cannot freeze this sweep", flush=True)
+        return
+
+    def _cleanup():
+        try:
+            with open(PAUSE_PIDFILE) as f:
+                if f.read() == content:
+                    os.unlink(PAUSE_PIDFILE)
+        except OSError:
+            pass
+
+    atexit.register(_cleanup)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--seeds", type=int, default=20)
@@ -119,6 +151,7 @@ def main(argv=None) -> int:
                     help="comma-separated gate-name substrings to keep")
     ap.add_argument("--timeout", type=int, default=900)
     args = ap.parse_args(argv)
+    _write_pause_pidfile()
 
     keys = args.gates.split(",") if args.gates else None
 

@@ -127,18 +127,25 @@ def step_op_corpus():
     # repeats them as 'FAILED path::test - msg' — parse both (anchored on
     # a '::'-bearing test id so captured-stdout noise and a mid-line
     # truncation at SIGKILL can't pollute or crash the parse), dedupe.
+    # Collection/fixture crashes print 'ERROR path::test' / 'path::test
+    # ERROR' the same two ways and are failures of the corpus too.
     fails = []
     for l in lines:
         toks = l.split()
         tid = None
-        if len(toks) >= 2 and toks[0] == "FAILED" and "::" in toks[1]:
+        if len(toks) >= 2 and toks[0] in ("FAILED", "ERROR") \
+                and "::" in toks[1]:
             tid = toks[1]
-        elif len(toks) >= 2 and toks[1] == "FAILED" and "::" in toks[0]:
+        elif len(toks) >= 2 and toks[1] in ("FAILED", "ERROR") \
+                and "::" in toks[0]:
             tid = toks[0]
         if tid and tid not in fails:
             fails.append(tid)
     return {"step": "op_corpus", "ok": rc == 0, "rc": rc,
-            "failures": fails[:40], "tail": " | ".join(lines[-3:])}
+            "failures": fails[:40], "tail": " | ".join(lines[-3:]),
+            # a crashed/SIGKILLed pytest often says why only on stderr
+            # (same contract as step_resnet/step_int8)
+            "err": None if rc == 0 else (err or "")[-300:]}
 
 
 def step_bert_sweep():
@@ -213,13 +220,33 @@ def _pause_pid(sig) -> None:
     import signal as _signal
     try:
         with open(PAUSE_PIDFILE) as f:
-            pid = int(f.read().strip())
+            content = f.read().splitlines()
+        pid = int(content[0].strip())
+        # line 2 (optional): a cmdline substring naming the job that wrote
+        # the file — the sweep writes "seed_sweep"
+        hint = content[1].strip() if len(content) > 1 else "seed_sweep"
         if pid <= 1 or pid == os.getpgrp():
             return  # never freeze init or our own group (stale/bad pidfile)
+        # pgids are recycled: before SIGSTOPping a whole group, check the
+        # group leader's /proc cmdline actually looks like the job the
+        # pidfile claims — a reused pgid must not freeze an unrelated
+        # process group (the null-separated argv is matched as one string)
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                cmdline = f.read().replace(b"\0", b" ").decode(
+                    "utf-8", "replace")
+        except (FileNotFoundError, ProcessLookupError):
+            return  # leader gone: stale pidfile, nothing to pause
+        if hint and hint not in cmdline:
+            print(f"[{_now()}] pause pidfile {PAUSE_PIDFILE} names pgid "
+                  f"{pid} ({hint!r}) but its leader is running "
+                  f"{cmdline[:120]!r} — stale/reused pgid, NOT signalling",
+                  flush=True)
+            return
         os.killpg(pid, sig)
         name = "SIGSTOP" if sig == _signal.SIGSTOP else "SIGCONT"
         print(f"[{_now()}] sent {name} to pgid {pid}", flush=True)
-    except (FileNotFoundError, ValueError, ProcessLookupError,
+    except (FileNotFoundError, ValueError, IndexError, ProcessLookupError,
             PermissionError):
         pass
 
