@@ -501,6 +501,60 @@ def _proxy_record(family: str, iters: int = 4) -> dict:
     return record
 
 
+def _proxy_record_int8(family: str, iters: int = 4) -> dict:
+    """One structured proxy record for a ``models.QUANT_FAMILIES``
+    calibrated int8 twin (``models.quantized_smoke`` — the same entry the
+    quant-lint gate analyzes). Same deterministic cost keys as
+    :func:`_proxy_record` so ``_proxy_compare`` gates them identically,
+    plus the deterministic ratios vs the f32 twin — the banked proof the
+    quantization actually pays (bytes strictly below 1.0)."""
+    from incubator_mxnet_tpu import models, profiler, telemetry
+    from incubator_mxnet_tpu.analysis import hlo
+
+    qsm = models.quantized_smoke(family)
+    cm = qsm["compiled"]
+    max_g = max(8, qsm["table"].num_buckets())
+    rep = hlo.cost(cm, max_graphs=max_g)
+    head = rep.head
+    if head is None:
+        raise RuntimeError(
+            f"--proxy: int8 family {family!r} traced zero graphs "
+            f"(skipped: {rep.skipped}) — cannot price it")
+    f32 = qsm["f32"]["compiled"]
+    f32_rep = hlo.cost(f32, max_graphs=max_g)
+    args = qsm["example_args"]
+    _proxy_sync(cm.predict(*args))        # compile the example bucket
+    profiler.reset_spans()
+    for _ in range(iters):
+        _proxy_sync(cm.predict(*args))
+    sr = profiler.step_report(frame="serve.predict")
+    record = {
+        "graphs": len(rep.rows),
+        "flops_per_step": rep.model_flops_per_step(),
+        "bytes_per_step": rep.bytes_per_step(),
+        "peak_live_bytes": rep.peak_live_bytes(),
+        "ladder_peak_bytes": rep.ladder_peak_bytes(),
+        "comm_bytes_per_step": rep.comm_bytes_per_step(),
+        "collective_ops": rep.collective_ops_per_step(),
+        "param_bytes": head.param_bytes,
+        "activation_bytes": head.activation_bytes,
+        "transcendentals": head.transcendentals,
+        "eqns": head.eqns,
+        "fusible_eqns": head.fusible_eqns,
+        "fusion_groups": head.fusion_groups,
+        "fusion_candidates": head.fusion_candidates,
+        "unknown_eqns": head.unknown_eqns,
+        "bytes_ratio_vs_f32": (rep.bytes_per_step()
+                               / max(f32_rep.bytes_per_step(), 1)),
+        "ladder_peak_ratio_vs_f32": (rep.ladder_peak_bytes()
+                                     / max(f32_rep.ladder_peak_bytes(), 1)),
+        "host_gap_ms": sr["host_gap_ms_mean"],
+        "instrumented_pct": sr["instrumented_pct"],
+    }
+    telemetry.emit("perf.proxy", family=family + "_int8", **record)
+    return record
+
+
 def _proxy_compare(current: dict, banked: dict, tol: float):
     """Gate the deterministic metrics against the banked baseline.
     Returns ``(failures, warnings)`` — a metric above ``1 + tol`` times
@@ -745,6 +799,11 @@ def run_proxy(argv) -> int:
 
     try:
         fams = {f: _proxy_record(f, iters=args.iters) for f in families}
+        # the calibrated int8 twins ride along for every selected family
+        # that has one — banked under their own "int8" section so the
+        # "families" set stays exactly models.SERVE_SPECS
+        int8 = {f + "_int8": _proxy_record_int8(f, iters=args.iters)
+                for f in families if f in models.QUANT_FAMILIES}
     except RuntimeError as e:
         print(f"bench.py {e}", file=sys.stderr)
         return 2
@@ -783,10 +842,12 @@ def run_proxy(argv) -> int:
                   file=sys.stderr)
         failures, warns = _proxy_compare(
             fams, baseline.get("families", {}), args.tolerance)
+        q_fail, q_warn = _proxy_compare(
+            int8, baseline.get("int8", {}), args.tolerance)
         t_fail, t_warn = _proxy_compare(
             train, baseline.get("train", {}), args.tolerance)
-        failures += t_fail
-        warns += t_warn
+        failures += q_fail + t_fail
+        warns += q_warn + t_warn
         gate = {"baseline": args.check, "tolerance": args.tolerance,
                 "failures": failures, "warnings": warns}
         # the whole-trajectory view rides along with the per-graph gate:
@@ -815,6 +876,10 @@ def run_proxy(argv) -> int:
                       f: {k: v for k, v in rec.items()
                           if k not in _PROXY_VOLATILE_KEYS}
                       for f, rec in sorted(fams.items())},
+                  "int8": {
+                      f: {k: v for k, v in rec.items()
+                          if k not in _PROXY_VOLATILE_KEYS}
+                      for f, rec in sorted(int8.items())},
                   "train": {
                       f: {k: v for k, v in rec.items()
                           if k not in _PROXY_VOLATILE_KEYS}
@@ -831,8 +896,8 @@ def run_proxy(argv) -> int:
         "value": total_flops,
         "unit": "flops/step (sum over families)",
         "vs_baseline": None,
-        "extra": {"families": fams, "train": train, "gate": gate,
-                  "backend": jax.default_backend()},
+        "extra": {"families": fams, "int8": int8, "train": train,
+                  "gate": gate, "backend": jax.default_backend()},
     }
     if mesh_step is not None:
         result["extra"]["mesh_step"] = mesh_step

@@ -91,6 +91,10 @@ DIMS: Dict[str, Dim] = {d.name: d for d in (
         note="probe batch size / batch-bucket geometry"),
     Dim("seq", "geom", (16, 32),
         note="probe sequence length / seq-bucket geometry"),
+    Dim("quantize", "struct", ("off", "int8"),
+        note="serving precision: float zoo vs calibrated int8 twin "
+             "(models.quantized_smoke); candidates whose quantized "
+             "graphs carry MX71x errors are scored but never elected"),
 )}
 
 #: per-family dimension subsets + probe kind. Train families score the
@@ -101,11 +105,12 @@ FAMILY_SPACES: Dict[str, Dict[str, Any]] = {
              "dims": ("remat", "flash_bk", "embed_grad", "batch", "seq")},
     "lenet": {"kind": "train", "dims": ("batch",)},
     "bert_encoder": {"kind": "serve",
-                     "dims": ("flash_bk", "batch", "seq")},
+                     "dims": ("flash_bk", "batch", "seq", "quantize")},
     "transformer_encoder": {"kind": "serve",
                             "dims": ("flash_bk", "batch", "seq")},
     "nmt_encoder": {"kind": "serve",
-                    "dims": ("flash_bk", "embed_grad", "batch", "seq")},
+                    "dims": ("flash_bk", "embed_grad", "batch", "seq",
+                             "quantize")},
 }
 
 #: real-hardware geometry the subprocess sweep (bert_sweep.py) probes —
@@ -286,17 +291,31 @@ def evaluate(family: str, cfg: Dict[str, Any]) -> Dict[str, Any]:
     env = {DIMS[k].env: str(v) for k, v in cfg.items()
            if DIMS[k].kind == "env" and str(v) != ""}
     kind = FAMILY_SPACES[family]["kind"]
+    quantized = str(cfg.get("quantize", "off")) == "int8"
+    quant_errors = 0
     with _cache_mod.applied({"config": {"env": env}}, force=True):
         if kind == "train":
             trainer, batch, tokens = _train_probe(family, cfg)
             trainer.prepare(*batch)
             rep = hlo.cost(trainer, sample_args=batch)
         else:
-            smoke = models.hlo_smoke(family, batch=cfg.get("batch"),
-                                     seq=cfg.get("seq"))
-            rep = hlo.cost(smoke["compiled"],
-                           max_graphs=max(8,
-                                          smoke["table"].num_buckets()))
+            if quantized:
+                smoke = models.quantized_smoke(family,
+                                               batch=cfg.get("batch"),
+                                               seq=cfg.get("seq"))
+            else:
+                smoke = models.hlo_smoke(family, batch=cfg.get("batch"),
+                                         seq=cfg.get("seq"))
+            max_g = max(8, smoke["table"].num_buckets())
+            rep = hlo.cost(smoke["compiled"], max_graphs=max_g)
+            if quantized:
+                # precision-flow gate: an int8 candidate whose graphs
+                # carry MX71x errors (silent promotion, missing
+                # calibration, q/dq hazards) is priced like any other
+                # but marked dirty — search() never elects it
+                qrep = hlo.verify(smoke["compiled"], max_graphs=max_g)
+                quant_errors = sum(1 for d in qrep.errors
+                                   if d.code.startswith("MX71"))
             tokens = (int(cfg.get("batch") or 2)
                       * int(cfg.get("seq") or 16))
     head = rep.head
@@ -317,6 +336,9 @@ def evaluate(family: str, cfg: Dict[str, Any]) -> Dict[str, Any]:
         # constraint checks against MXTPU_HBM_BUDGET
         "peak_live_bytes": rep.peak_live_bytes(),
         "ladder_peak_bytes": rep.ladder_peak_bytes(),
+        # MX71x error count over the quantized graphs (0 for float
+        # candidates) — the precision-flow feasibility input
+        "quant_errors": quant_errors,
     }
 
 
@@ -355,13 +377,21 @@ def search(family: str, budget: Optional[int] = None, cache=None,
     rows = []
     for cfg in cand:
         metrics = evaluate(family, cfg)
-        feasible = (hbm_budget is None
-                    or metrics["ladder_peak_bytes"] <= hbm_budget)
+        mem_ok = (hbm_budget is None
+                  or metrics["ladder_peak_bytes"] <= hbm_budget)
+        # MX711-dirty (or any MX71x-error) int8 candidate: scored,
+        # reported, never elected — same contract as the memory gate
+        quant_ok = metrics.get("quant_errors", 0) == 0
         rows.append({"config": dict(cfg), "metrics": metrics,
                      "score": score(metrics, measured=measured),
-                     "feasible": feasible})
+                     "feasible": mem_ok and quant_ok})
     feasible_i = [i for i, r in enumerate(rows) if r["feasible"]]
     if not feasible_i:
+        if hbm_budget is None:
+            raise RuntimeError(
+                f"autotune: every candidate of {family!r} failed the "
+                "MX71x precision-flow gate — recalibrate the quantized "
+                "zoo or drop the quantize dim")
         raise RuntimeError(
             f"autotune: every candidate of {family!r} exceeds the "
             f"{hbm_budget / 2**20:.1f} MiB MXTPU_HBM_BUDGET (smallest "
@@ -376,6 +406,8 @@ def search(family: str, budget: Optional[int] = None, cache=None,
         "evaluated": len(rows), "space_size": len(full),
         "truncated": len(full) - len(cand),   # no silent caps
         "infeasible": len(rows) - len(feasible_i),
+        "quant_infeasible": sum(
+            1 for r in rows if r["metrics"].get("quant_errors", 0)),
         "hbm_budget": hbm_budget,
         "winner": best["config"], "winner_score": best["score"],
         "winner_metrics": best["metrics"],
@@ -541,6 +573,11 @@ def main(argv=None) -> int:
                   " candidate(s) excluded by the MXTPU_HBM_BUDGET "
                   "memory-feasibility constraint "
                   f"({res['hbm_budget']} bytes)", file=sys.stderr)
+        if res["quant_infeasible"]:
+            print(f"autotune: {fam}: {res['quant_infeasible']}/"
+                  f"{res['evaluated']} candidate(s) excluded by the "
+                  "MX71x precision-flow gate (dirty quantized graphs)",
+                  file=sys.stderr)
         results[fam] = res
         if args.gate:
             if not args.cache_dir:

@@ -20,9 +20,14 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 
 import numpy as onp
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # `python benchmark/int8_probe.py` direct run
+    sys.path.insert(0, REPO)
 
 
 def main() -> None:
@@ -104,6 +109,33 @@ def main() -> None:
 
     int8_ms = _time(int8_dense, xi, wi)
     bf16_ms = _time(bf16_dense, xbf, wbf)
+
+    # The synthetic dense above proves the MXU path exists; the bucket
+    # census below proves the SERVED graphs actually take it. Trace the
+    # quantized-zoo twin (models.quantized_smoke — the same entry
+    # mxlint --hlo --quantized lints and serve_bench --int8 runs) and
+    # report the per-bucket int8 census from the MX71x pass's own
+    # boundary accounting, so the probe's evidence and the lint's
+    # verdict can never disagree.
+    family = os.environ.get("MXTPU_INT8_FAMILY", "lenet")
+    from incubator_mxnet_tpu import analysis, models
+    qsm = models.quantized_smoke(family)
+    traced = analysis.hlo.trace_entry(
+        qsm["compiled"], max_graphs=max(8, qsm["table"].num_buckets()))
+    buckets = []
+    for g in traced.graphs:
+        st = analysis.hlo.quant_graph_stats(g)
+        buckets.append({
+            "site": g.site,
+            "signature": [list(s) for s in (g.signature or [])],
+            "quantized": st.quantized,
+            "int8_matmuls": len(st.int_matmuls),
+            "quantize_boundaries": len(st.q_converts),
+            "dequantize_boundaries": len(st.dq_converts),
+            "saved_bytes": st.saved_bytes,
+            "churn_bytes": st.churn_bytes,
+        })
+
     print(json.dumps({
         "metric": "int8_dense_vs_bf16",
         "int8_ms": round(int8_ms, 4), "bf16_ms": round(bf16_ms, 4),
@@ -111,6 +143,12 @@ def main() -> None:
         "hlo_has_int8_dot": bool(has_int8_dot),
         "hlo_convert_before_dot": bool(early_convert),
         "shape": [B, IN, OUT],
+        "quantized_zoo": {
+            "family": family,
+            "buckets": buckets,
+            "all_buckets_quantized": bool(buckets) and all(
+                b["quantized"] for b in buckets),
+        },
         "backend": jax.default_backend(),
     }))
 

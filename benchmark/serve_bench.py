@@ -592,6 +592,132 @@ def decode_run(n_requests: int, smoke: bool, out_path=None) -> int:
     return 0
 
 
+def int8_run(model_name: str, n_requests: int, clients: int,
+             deadline_ms: float, iters: int, out_path=None) -> int:
+    """The ``--int8`` section: calibrated int8 serving through the
+    quantized zoo (``models.quantized_smoke`` — the same entry
+    ``mxlint --hlo --quantized`` lints and the autotune ``quantize``
+    dimension prices), gated device-blind:
+
+    1. **MX71x staging lint** over every quantized bucket graph
+       (``analysis.hlo.verify(..., quant=True)`` — the gate
+       ``ModelRegistry`` applies): a silent f32 promotion (MX711),
+       missing calibration (MX712), or q/dq hazard (MX713) fails in
+       seconds, before the first compile;
+    2. **MX709 ladder feasibility at HALF the f32 budget**: the int8
+       twin's whole-ladder residency must fit a budget set to half the
+       float model's own ladder peak — the "int8 buys you double the
+       geometry" claim as a hard lint gate;
+    3. **zero post-warmup recompiles** across the mixed-shape dynamic
+       workload — quantized buckets AOT-warm exactly like float ones;
+    4. the banked int8 proxy (bytes/step, peak residency) must come in
+       strictly below the f32 twin's — the record carries both and
+       their ratio.
+    """
+    from incubator_mxnet_tpu import models, serve
+    from incubator_mxnet_tpu.analysis import hlo as _hlo
+
+    family = "bert_encoder" if model_name == "bert" else "lenet"
+    qsm = models.quantized_smoke(family)
+    qcm, table, spec = qsm["compiled"], qsm["table"], qsm["spec"]
+    f32 = qsm["f32"]["compiled"]
+    max_g = max(8, table.num_buckets())
+
+    def make_request(rng):
+        if family == "lenet":
+            return (rng.randn(1, 28, 28).astype("float32"),)
+        L = int(rng.randint(4, table.axes["seq"][1]))
+        return (rng.randint(1, 1000, (L,)).astype("int32"),
+                onp.zeros((L,), "int32"), onp.float32(L))
+
+    # gate 1 — the MX71x staging lint (same call ModelRegistry stages
+    # with), trace-only, before any compile
+    analysis_rep = _hlo.verify(qcm, max_graphs=max_g, quant=True)
+    if analysis_rep.errors:
+        print("serve_bench --int8: analysis.hlo rejected the quantized "
+              f"model: {[d.code for d in analysis_rep.errors]}",
+              file=sys.stderr)
+        return 1
+
+    # gate 2 + 4 — price both twins device-blind, then re-lint the int8
+    # ladder against HALF the float ladder's own residency
+    cost_q = _hlo.cost(qcm, max_graphs=max_g)
+    cost_f = _hlo.cost(f32, max_graphs=max_g)
+    f32_ladder = cost_f.ladder_peak_bytes()
+    half_budget = f32_ladder // 2
+    half_rep = _hlo.verify(qcm, max_graphs=max_g,
+                           hbm_budget_bytes=half_budget)
+    mx709 = [d for d in half_rep.diagnostics if d.code == "MX709"]
+    if mx709:
+        print("serve_bench --int8: INT8 LADDER INFEASIBLE AT HALF THE "
+              f"F32 BUDGET ({half_budget} bytes): "
+              f"{[d.message for d in mx709]}", file=sys.stderr)
+        return 1
+    bytes_ratio = (cost_q.bytes_per_step() / cost_f.bytes_per_step()
+                   if cost_f.bytes_per_step() else None)
+    peak_ratio = (cost_q.ladder_peak_bytes() / f32_ladder
+                  if f32_ladder else None)
+    if bytes_ratio is None or bytes_ratio >= 1.0:
+        print("serve_bench --int8: quantized bytes/step "
+              f"({cost_q.bytes_per_step()}) is not below the f32 twin "
+              f"({cost_f.bytes_per_step()})", file=sys.stderr)
+        return 1
+
+    # gate 3 — warm every quantized bucket, then the mixed-shape
+    # dynamic workload must add zero compiles
+    warm = qcm.warmup()
+    sweep = offline_sweep(qcm, table, make_request, iters)
+    dyn = dynamic_run(qcm, spec, make_request, n_requests, clients,
+                      deadline_ms)
+    if dyn["errors"]:
+        print(f"serve_bench --int8: {len(dyn['errors'])} client "
+              f"error(s): {dyn['errors']}", file=sys.stderr)
+        return 1
+    recompiles = dyn["compile_cache"]["post_warmup_compiles"]
+    if recompiles:
+        print("serve_bench --int8: ZERO-RECOMPILE CONTRACT VIOLATED: "
+              f"{recompiles} post-warmup compile(s) on the quantized "
+              "buckets", file=sys.stderr)
+        return 1
+
+    result = {
+        "metric": f"serve_int8_{family}_throughput_req_per_sec",
+        "value": dyn["throughput_req_per_sec"],
+        "unit": "req/sec",
+        "vs_baseline": None,
+        "extra": {
+            "family": family,
+            "backend": jax.default_backend(),
+            "warmup": warm,
+            "offline_sweep": sweep,
+            "dynamic": dyn,
+            "analysis": analysis_rep.summary_dict(),
+            "proxy_int8": {
+                "bytes_per_step": cost_q.bytes_per_step(),
+                "peak_live_bytes": cost_q.peak_live_bytes(),
+                "ladder_peak_bytes": cost_q.ladder_peak_bytes(),
+            },
+            "proxy_f32": {
+                "bytes_per_step": cost_f.bytes_per_step(),
+                "peak_live_bytes": cost_f.peak_live_bytes(),
+                "ladder_peak_bytes": f32_ladder,
+            },
+            "bytes_ratio_vs_f32": round(bytes_ratio, 4),
+            "ladder_peak_ratio_vs_f32": (round(peak_ratio, 4)
+                                         if peak_ratio is not None
+                                         else None),
+            "half_f32_budget_bytes": half_budget,
+            "mx709_at_half_budget": len(mx709),
+        },
+    }
+    doc = json.dumps(result)
+    print(doc)
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(doc + "\n")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--model", default=os.environ.get(
@@ -621,6 +747,14 @@ def main(argv=None) -> int:
                     "MX706/MX709 clean over the decode graphs, and the "
                     "statically priced capacity matching the runtime "
                     "block pool's admission limit")
+    ap.add_argument("--int8", action="store_true",
+                    help="run the calibrated int8 serving section "
+                    "instead: the quantized-zoo twin "
+                    "(models.quantized_smoke) of --model, gated "
+                    "device-blind on the MX71x staging lint, MX709 "
+                    "ladder feasibility at HALF the f32 budget, zero "
+                    "post-warmup recompiles over the quantized buckets, "
+                    "and bytes/step strictly below the f32 twin")
     ap.add_argument("--cache-dir", default=None,
                     help="artifact-cache root for --replicas (default: "
                     "a fresh temp dir)")
@@ -646,6 +780,14 @@ def main(argv=None) -> int:
         n = args.requests if args.requests != 1000 else (
             12 if args.smoke else 64)
         return decode_run(n, args.smoke, out_path=args.out)
+    if args.int8:
+        n = args.requests if args.requests != 1000 else (
+            40 if args.smoke else 400)
+        deadline = args.deadline_ms if args.deadline_ms is not None else \
+            float(os.environ.get("MXTPU_SERVE_DEADLINE_MS", "5"))
+        return int8_run(args.model, n, args.clients, deadline,
+                        min(args.iters, 5) if args.smoke else args.iters,
+                        out_path=args.out)
     if args.chaos_replicas and args.replicas <= 0:
         args.replicas = 3
 

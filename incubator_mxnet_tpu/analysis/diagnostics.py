@@ -109,6 +109,31 @@ CODES = {
              "bucket ladder's summed) liveness-scan peak_live_bytes "
              "exceeds MXTPU_HBM_BUDGET — the geometry cannot fit on "
              "the chip",
+    "MX710": "informational quantized-region summary (quantize boundaries, "
+             "int8 matmuls, dequantize boundaries, estimated bytes saved) "
+             "from analysis.hlo.quant — provenance row for quantized "
+             "serving, never gates a build; emitted only under "
+             "verify(..., quant=True)",
+    "MX711": "silent f32 promotion inside a declared-int8 region: a "
+             "quantized (int8) tensor is widened back to float and feeds "
+             "a float matmul/conv — the compute the quantization was "
+             "supposed to run on the int8 MXU path silently runs at f32",
+    "MX712": "quantized tensor with no calibration provenance: the "
+             "quantize boundary's range is computed on the fly from the "
+             "data being quantized (an online min/max reduction) instead "
+             "of a calibrated Observer range baked into the graph",
+    "MX713": "q/dq pairing hazard: a tensor is re-quantized with no "
+             "intervening compute (a quantize→dequantize→quantize round "
+             "trip / double quantization) — a scale/zero-point mismatch "
+             "across the boundary silently degrades accuracy",
+    "MX714": "accuracy-hazard reduction kept in int8: an additive "
+             "reduction (sum/mean/softmax/normalization accumulation) "
+             "runs with an int8 accumulator — 8-bit accumulation "
+             "overflows; widen to int32/float before reducing",
+    "MX715": "quantization boundary churn: the graph's quantize/"
+             "dequantize convert traffic exceeds the f32 bytes its int8 "
+             "compute saves — the quantized build is an anti-optimization "
+             "(priced via analysis.hlo.cost)",
     "MX801": "shared attribute mutated without the lock that guards it "
              "elsewhere, in a class that runs threads (attribute→lock "
              "binding inferred from `with self._lock:` dominance)",
@@ -173,6 +198,8 @@ DEFAULT_SEVERITY: Dict[str, str] = {
     "MX701": "error", "MX702": "warning", "MX703": "warning",
     "MX704": "warning", "MX705": "error", "MX706": "warning",
     "MX707": "info", "MX708": "error", "MX709": "error",
+    "MX710": "info", "MX711": "error", "MX712": "error",
+    "MX713": "error", "MX714": "warning", "MX715": "warning",
     "MX801": "warning", "MX802": "error", "MX803": "warning",
     "MX804": "warning", "MX805": "warning",
     "MX901": "error", "MX902": "warning", "MX903": "warning",

@@ -48,12 +48,16 @@ from .cost import (  # noqa: F401  (importing registers hlo_cost/hlo_memory)
     CostReport, GraphCost, cost, cost_table, graph_cost, hbm_budget_bytes,
     ladder_peak_bytes, peak_live_bytes,
 )
+from .quant import (  # noqa: F401  (importing registers hlo_quant)
+    QuantGraphStats, quant_graph_stats,
+)
 
 __all__ = ["verify", "verify_trace", "trace_entry", "TracedGraph",
            "TraceResult", "HLO_PASSES", "register_hlo_pass",
            "list_hlo_passes", "run_hlo_passes", "walk_eqns",
            "cost", "cost_table", "graph_cost", "CostReport", "GraphCost",
-           "peak_live_bytes", "ladder_peak_bytes", "hbm_budget_bytes"]
+           "peak_live_bytes", "ladder_peak_bytes", "hbm_budget_bytes",
+           "quant_graph_stats", "QuantGraphStats"]
 
 
 def verify_trace(result: TraceResult, *,
@@ -61,7 +65,8 @@ def verify_trace(result: TraceResult, *,
                  const_limit_bytes: int = 1 << 20,
                  donation_min_bytes: int = 1 << 16,
                  hbm_budget_bytes: Optional[int] = None,
-                 cost: bool = False) -> Report:
+                 cost: bool = False,
+                 quant: bool = False) -> Report:
     """Run the MX7xx passes over an already-traced entry and fold in the
     tracer's own diagnostics/coverage notes — the shared second half of
     :func:`verify`, exposed so a caller that needs the
@@ -71,7 +76,7 @@ def verify_trace(result: TraceResult, *,
                             const_limit_bytes=const_limit_bytes,
                             donation_min_bytes=donation_min_bytes,
                             hbm_budget_bytes=hbm_budget_bytes,
-                            cost=cost)
+                            cost=cost, quant=quant)
     for d in result.diags:
         report.add(d)
     report.skipped.extend(result.skipped)
@@ -84,7 +89,8 @@ def verify(model, sample_args=None, *,
            const_limit_bytes: int = 1 << 20,
            donation_min_bytes: int = 1 << 16,
            hbm_budget_bytes: Optional[int] = None,
-           cost: bool = False) -> Report:
+           cost: bool = False,
+           quant: bool = False) -> Report:
     """Trace ``model`` (every bucket/signature/call site, capped at
     ``max_graphs``) and run the registered MX7xx passes; returns the
     merged :class:`~..diagnostics.Report`.
@@ -106,9 +112,19 @@ def verify(model, sample_args=None, *,
     ``hbm_budget_bytes`` overrides the ``MXTPU_HBM_BUDGET`` env read of
     the MX709 memory pass (``None`` = read the env; unset env = the
     pass is silent).
+
+    ``quant=True`` additionally emits the MX710 informational
+    quantized-region summary per quantized graph. The MX711–MX715
+    precision-flow checks themselves are always on — they fire only on
+    graphs that actually contain quantize boundaries or int8 matmuls, so
+    float models are unaffected. ``serve.ModelRegistry`` stages every
+    version with ``quant=True``: an un-calibrated or silently-promoted
+    int8 build is rejected before its first device step while the active
+    version keeps serving.
     """
     return verify_trace(trace_entry(model, sample_args,
                                     max_graphs=max_graphs),
                         passes=passes, const_limit_bytes=const_limit_bytes,
                         donation_min_bytes=donation_min_bytes,
-                        hbm_budget_bytes=hbm_budget_bytes, cost=cost)
+                        hbm_budget_bytes=hbm_budget_bytes, cost=cost,
+                        quant=quant)

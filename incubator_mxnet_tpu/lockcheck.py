@@ -180,7 +180,13 @@ class TrackedLock:
                     continue
                 fwd = (h, self.name)
                 rev = (self.name, h)
-                if fwd not in _EDGES:
+                if rev not in _EDGES and fwd not in _EDGES:
+                    # an acquisition that reverses a recorded order is
+                    # evidence of the bug, not a new legal order: banking
+                    # it as an edge would make the VICTIM thread's
+                    # consistent re-acquire look inverted too, bounding
+                    # both halves of a real deadlock and turning the
+                    # flag-the-culprit contract into a timeout race
                     _EDGES[fwd] = {"thread": thread,
                                    "ts": round(time.time(), 6)}
                 if rev in _EDGES:

@@ -68,6 +68,16 @@ SERVE_SPECS = {
 }
 
 
+#: Families whose smoke model actually contains quantizable layers
+#: (``nn.Dense``/``nn.Conv2D`` children the int8 graph pass can swap).
+#: ``transformer_encoder`` is excluded: its stacked-parameter scan
+#: encoder has no per-layer Dense children, so its "quantized" twin
+#: would be a float copy. This is the quantized zoo every int8 consumer
+#: iterates (``mxlint --hlo --quantized``, ``serve_bench --int8``,
+#: ``bench.py --proxy`` int8 records, ``benchmark/int8_probe.py``).
+QUANT_FAMILIES = ("bert", "bert_encoder", "lenet", "nmt_encoder")
+
+
 def serve_spec(family: str) -> dict:
     """Copy of the named serving spec (see :data:`SERVE_SPECS`)."""
     if family not in SERVE_SPECS:
@@ -126,9 +136,9 @@ def hlo_smoke(family: str, batch: int = None, seq: int = None) -> dict:
 
     spec = serve_spec(family)
     B = int(batch) if batch else 2
-    batch_lad = (int(batch),) if batch else (1, 4)
+    batch_lad = (int(batch), int(batch)) if batch else (1, 4)
     L = int(seq) if seq else 16
-    seq_lad = (int(seq),) if seq else (8, 16)
+    seq_lad = (int(seq), int(seq)) if seq else (8, 16)
     if family in ("bert", "bert_encoder"):
         vocab, P = 1000, 4
         if L > 32:
@@ -182,3 +192,66 @@ def hlo_smoke(family: str, batch: int = None, seq: int = None) -> dict:
                                    autotune_key=family)
     return {"block": net, "example_args": args, "table": table,
             "spec": spec, "compiled": compiled}
+
+
+def calib_args(family: str, batch: int = None, seq: int = None,
+               seed: int = 0) -> tuple:
+    """Seeded non-degenerate inputs for ``family``'s serving signature —
+    the calibration batch :func:`quantized_smoke` observes. The zoo's
+    ``hlo_smoke`` example args are mostly zeros (fine for tracing,
+    useless for calibration: every range collapses), so calibration data
+    is drawn separately: float tensors ~N(0,1), ids uniform over the
+    probe vocab, valid lengths full."""
+    import numpy as onp
+
+    from .. import nd
+
+    sm_args = hlo_smoke(family, batch=batch, seq=seq)["example_args"]
+    rs = onp.random.RandomState(seed)
+    out = []
+    for a in sm_args:
+        arr = onp.asarray(a.asnumpy())
+        if arr.dtype.kind == "f":
+            if arr.ndim == 1:          # valid_length-style: keep full
+                out.append(nd.array(arr))
+            else:
+                out.append(nd.array(
+                    rs.randn(*arr.shape).astype(arr.dtype)))
+        else:                          # ids: uniform over the probe vocab
+            hi = max(int(arr.max()) + 1, 32)
+            out.append(nd.array(
+                rs.randint(0, hi, arr.shape).astype(arr.dtype)))
+    return tuple(out)
+
+
+def quantized_smoke(family: str, batch: int = None, seq: int = None,
+                    percentile: float = None) -> dict:
+    """The quantized twin of :func:`hlo_smoke`: calibrate the family's
+    smoke model on a seeded batch (:func:`calib_args` →
+    ``quantization.observe_net``) and lower the Observer through
+    ``quantization.quantize_model`` into a quantized
+    ``serve.CompiledModel`` sharing the float model's bucket table,
+    axes, pad values, and ``autotune_key``.
+
+    This is THE quantized-zoo entry every int8 consumer analyzes —
+    ``mxlint --hlo --quantized``, the autotune ``quantize`` dimension,
+    ``serve_bench --int8``, ``benchmark/int8_probe.py``, and the
+    ``<family>_int8`` proxy records — so the graphs CI lints, the graphs
+    the roofline prices, and the graphs the bench runs are provably the
+    same. Deterministic: same family/geometry → byte-identical int8
+    weights and ranges.
+
+    Returns ``{"block", "example_args", "table", "spec", "compiled",
+    "observer", "f32"}`` — ``compiled`` is the quantized model,
+    ``f32`` the full float ``hlo_smoke`` dict it was derived from.
+    """
+    from .. import quantization as _quant
+
+    sm = hlo_smoke(family, batch=batch, seq=seq)
+    cargs = calib_args(family, batch=batch, seq=seq)
+    observer = _quant.observe_net(sm["block"], [cargs])
+    qcm = _quant.quantize_model(sm["compiled"], observer,
+                                percentile=percentile)
+    return {"block": qcm._block, "example_args": sm["example_args"],
+            "table": sm["table"], "spec": sm["spec"], "compiled": qcm,
+            "observer": observer, "f32": sm}

@@ -24,6 +24,8 @@ Dequantized outputs stay within ~1% of fp32 for typical nets (tested in
 """
 from __future__ import annotations
 
+import copy
+import os
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as onp
@@ -34,8 +36,19 @@ from ..base import MXNetError
 from ..ndarray import NDArray
 from .. import ndarray as nd
 
-__all__ = ["quantize_net", "QuantizedDense", "QuantizedConv2D",
+__all__ = ["quantize_net", "quantize_model", "observe_net",
+           "QuantizedDense", "QuantizedConv2D",
            "LayerRangeCollector", "Observer", "optimal_threshold"]
+
+
+def _quant_percentile(percentile: Optional[float] = None) -> float:
+    """The calibration percentile: explicit argument, else the
+    ``MXTPU_QUANT_PERCENTILE`` env knob, else 99.99 (the TensorRT-style
+    default that clips outliers instead of letting one spike stretch the
+    whole int8 encoding)."""
+    if percentile is not None:
+        return float(percentile)
+    return float(os.environ.get("MXTPU_QUANT_PERCENTILE", "") or 99.99)
 
 
 # ---------------------------------------------------------------------------
@@ -274,7 +287,12 @@ def _q8(arr: onp.ndarray) -> Tuple[onp.ndarray, float, float]:
 
 
 class _QuantizedLayerBase:
-    """Mixin holding the frozen int8 weights + calibrated ranges."""
+    """Mixin marking a swapped-in int8 layer (weights live as gluon
+    ``Constant`` parameters, calibrated ranges as python floats)."""
+
+
+def _unwrap(x):
+    return x._data if isinstance(x, NDArray) else x
 
 
 def _make_quantized_dense(layer, in_range):
@@ -291,28 +309,39 @@ def _make_quantized_dense(layer, in_range):
         """int8 Dense swapped in by quantize_net (reference:
         quantized_fully_connected + the requantize node the graph pass
         appends). Output is dequantized fp32 so surrounding float ops
-        compose; XLA fuses the int8 dot + scale into one kernel."""
+        compose; XLA fuses the int8 dot + scale into one kernel.
+
+        The int8 weights are gluon ``Constant`` parameters, NOT python
+        closures: they trace as real graph arguments, so
+        ``analysis.hlo`` prices them at 1 byte/element in
+        ``param_bytes``/``peak_live_bytes`` (the ~4x reduction the
+        quantization exists to buy) and never trips the MX705
+        baked-constant check on large layers."""
 
         def __init__(self, **kw):
             super().__init__(**kw)
-            self._qw = jnp.asarray(qw)
-            self._qb = jnp.asarray(qb) if qb is not None else None
+            self.qweight = self.params.get_constant("qweight", qw)
+            if qb is not None:
+                self.qbias = self.params.get_constant("qbias", qb)
             self._range = in_range
 
-        def hybrid_forward(self, F, x):
+        def hybrid_forward(self, F, x, qweight, qbias=None):
             from ..ops import quantization as Q
             lo, hi = self._range
-            data = x._data if isinstance(x, NDArray) else x
-            qx, qlo, qhi = Q.quantize(data, lo, hi, out_type="int8")
+            qx, qlo, qhi = Q.quantize(_unwrap(x), lo, hi, out_type="int8")
             acc, omin, omax = Q.quantized_fully_connected(
-                qx, self._qw, self._qb, qlo, qhi, wmin, wmax, bmin, bmax,
-                num_hidden=units, no_bias=self._qb is None, flatten=flatten)
+                qx, _unwrap(qweight),
+                _unwrap(qbias) if qbias is not None else None,
+                qlo, qhi, wmin, wmax, bmin, bmax,
+                num_hidden=units, no_bias=qbias is None, flatten=flatten)
             out = Q.dequantize(acc, omin, omax)
             out = NDArray(out, ctx=x.context) if isinstance(x, NDArray) \
                 else out
             return act(out) if act is not None else out
 
-    return QuantizedDense(prefix=layer.prefix.rstrip("_") + "_int8_")
+    qlayer = QuantizedDense(prefix=layer.prefix.rstrip("_") + "_int8_")
+    qlayer.collect_params().initialize()
+    return qlayer
 
 
 def _make_quantized_conv(layer, in_range):
@@ -328,30 +357,36 @@ def _make_quantized_conv(layer, in_range):
     class QuantizedConv2D(HybridBlock, _QuantizedLayerBase):
         """int8 Conv2D swapped in by quantize_net (reference:
         quantized_conv + requantize). NCHW only, matching the reference's
-        quantized conv support envelope."""
+        quantized conv support envelope. Weights are ``Constant``
+        parameters for the same tracing/pricing reasons as
+        :class:`QuantizedDense`."""
 
         def __init__(self, **kw):
             super().__init__(**kw)
-            self._qw = jnp.asarray(qw)
-            self._qb = jnp.asarray(qb) if qb is not None else None
+            self.qweight = self.params.get_constant("qweight", qw)
+            if qb is not None:
+                self.qbias = self.params.get_constant("qbias", qb)
             self._range = in_range
 
-        def hybrid_forward(self, F, x):
+        def hybrid_forward(self, F, x, qweight, qbias=None):
             from ..ops import quantization as Q
             lo, hi = self._range
-            data = x._data if isinstance(x, NDArray) else x
-            qx, qlo, qhi = Q.quantize(data, lo, hi, out_type="int8")
+            qx, qlo, qhi = Q.quantize(_unwrap(x), lo, hi, out_type="int8")
             acc, omin, omax = Q.quantized_conv(
-                qx, self._qw, self._qb, qlo, qhi, wmin, wmax, bmin, bmax,
+                qx, _unwrap(qweight),
+                _unwrap(qbias) if qbias is not None else None,
+                qlo, qhi, wmin, wmax, bmin, bmax,
                 stride=kwargs["stride"], pad=kwargs["pad"],
                 dilate=kwargs["dilate"], num_filter=kwargs["num_filter"],
-                no_bias=self._qb is None, layout=kwargs["layout"])
+                no_bias=qbias is None, layout=kwargs["layout"])
             out = Q.dequantize(acc, omin, omax)
             out = NDArray(out, ctx=x.context) if isinstance(x, NDArray) \
                 else out
             return act(out) if act is not None else out
 
-    return QuantizedConv2D(prefix=layer.prefix.rstrip("_") + "_int8_")
+    qlayer = QuantizedConv2D(prefix=layer.prefix.rstrip("_") + "_int8_")
+    qlayer.collect_params().initialize()
+    return qlayer
 
 
 # ---------------------------------------------------------------------------
@@ -371,17 +406,128 @@ def _iter_quantizable(block, prefix=""):
             yield from _iter_quantizable(child)
 
 
+def _walk_blocks(b):
+    yield b
+    for c in b._children.values():
+        yield from _walk_blocks(c)
+
+
+class _eager_tree:
+    """Deactivate hybridize across a block tree so forward hooks fire on
+    real arrays (a live jit cache would replay the compiled graph and the
+    hooks would never see data); restores the previous state on exit."""
+
+    def __init__(self, net):
+        from ..gluon.block import HybridBlock
+        self._hb = HybridBlock
+        self._net = net
+        self._saved = []
+
+    def __enter__(self):
+        for b in _walk_blocks(self._net):
+            if isinstance(b, self._hb) and getattr(b, "_active", False):
+                self._saved.append(b)
+                b._active = False
+        return self
+
+    def __exit__(self, *exc):
+        for b in self._saved:
+            b._active = True
+        return False
+
+
+def _ranges_for_layers(site_ranges: Dict[str, Tuple[float, float]],
+                       layer_names: Sequence[str]
+                       ) -> Dict[str, Tuple[float, float]]:
+    """Bridge Observer site names to gluon layer names. Calibration
+    tables key sites as the layer name itself (:func:`observe_net`), a
+    tagged activation (``act:dense0``), or a scoped telemetry site
+    (``serve/act:dense0``) — resolve each layer by exact match, then
+    ``act:<name>``, then ``:<name>`` suffix, then substring."""
+    out = {}
+    for name in layer_names:
+        rng = site_ranges.get(name) or site_ranges.get("act:" + name)
+        if rng is None:
+            for site in sorted(site_ranges):
+                if site.endswith(":" + name) or name in site:
+                    rng = site_ranges[site]
+                    break
+        if rng is not None:
+            out[name] = rng
+    return out
+
+
+def observe_net(net, calib_data, num_calib_batches: Optional[int] = None,
+                bins: int = 40, lo_exp: int = -24) -> Observer:
+    """Run calibration batches eagerly and return an :class:`Observer`
+    keyed by layer name — one log2-magnitude histogram per quantizable
+    layer's input, the same bucket scheme ``telemetry.numerics`` hist
+    mode uses (bucket ``i`` counts ``|x|`` in ``[2^(lo_exp+i),
+    2^(lo_exp+i+1))``), so an observer built here and one built from
+    ``numerics.calibration_table()`` merge and quantize identically."""
+    obs = Observer()
+    handles = []
+
+    def _record(name, arr):
+        a = onp.abs(arr.ravel().astype(onp.float64))
+        nz = a[a > 0]
+        counts = onp.zeros(bins, dtype=onp.float64)
+        if nz.size:
+            exp = onp.floor(onp.log2(nz)).astype(onp.int64)
+            idx = onp.clip(exp - lo_exp, 0, bins - 1)
+            counts = onp.bincount(idx, minlength=bins).astype(onp.float64)
+        obs.update(name, counts, lo_exp,
+                   amin=float(arr.min()), amax=float(arr.max()))
+
+    with _eager_tree(net):
+        for _parent, _name, layer in _iter_quantizable(net):
+            def pre_hook(blk, inputs, _lname=layer.name):
+                x = inputs[0]
+                _record(_lname, onp.asarray(
+                    x.asnumpy() if isinstance(x, NDArray) else x))
+            handles.append(layer.register_forward_pre_hook(pre_hook))
+        try:
+            n = 0
+            for batch in calib_data:
+                args = batch if isinstance(batch, (list, tuple)) \
+                    else (batch,)
+                net(*args)
+                n += 1
+                if num_calib_batches is not None \
+                        and n >= num_calib_batches:
+                    break
+        finally:
+            for h in handles:
+                h.detach()
+    return obs
+
+
 def quantize_net(net, calib_data=None, calib_mode: str = "naive",
                  quantized_dtype: str = "int8",
                  exclude_layers: Sequence[str] = (),
-                 num_calib_batches: Optional[int] = None):
+                 num_calib_batches: Optional[int] = None,
+                 percentile: Optional[float] = None):
     """Quantize a gluon network to int8 in place (returns the same block;
     reference: ``mx.contrib.quantization.quantize_net_v2``).
 
-    ``calib_data``: iterable of input batches (NDArray, or tuples for
-    multi-input nets). ``calib_mode='naive'`` records min/max;
-    ``'entropy'`` selects KL-optimal thresholds. ``exclude_layers``: layer
-    name substrings to keep in float (reference: excluded_sym_names).
+    ``calib_data`` — any of:
+
+    * an iterable of input batches (NDArray, or tuples for multi-input
+      nets): forward hooks collect per-layer ranges, ``calib_mode=
+      'naive'`` keeping min/max, ``'entropy'`` selecting KL-optimal
+      thresholds (the legacy :class:`LayerRangeCollector` path);
+    * an :class:`Observer` (from :func:`observe_net` or
+      ``telemetry.numerics.calibration_table()``): its
+      percentile-clipped ``ranges()`` are lowered directly — no
+      calibration forward runs;
+    * an Observer ``to_table()`` dict (the banked-beside-checkpoints
+      form): rehydrated into an Observer first.
+
+    All three sources converge on one site→layer range resolution
+    (:func:`_ranges_for_layers`) and one swap pass. ``percentile``
+    applies to the Observer paths (default: ``MXTPU_QUANT_PERCENTILE``
+    env, else 99.99). ``exclude_layers``: layer name substrings to keep
+    in float (reference: excluded_sym_names).
     """
     if quantized_dtype != "int8":
         raise MXNetError("TPU int8 path supports quantized_dtype='int8' "
@@ -390,43 +536,47 @@ def quantize_net(net, calib_data=None, calib_mode: str = "naive",
         raise MXNetError("quantize_net needs calib_data (reference requires "
                          "a calibration dataset for calib_mode != 'none')")
 
-    # Calibration must run EAGERLY: a live jit cache would replay the
-    # compiled graph (hooks never fire / see tracers). Deactivate hybridize
-    # across the tree for the calibration passes and re-enable after the
-    # swap with caches cleared (the float graphs are stale then anyway).
     from ..gluon.block import HybridBlock
-    hybridized = []
-
-    def _walk(b):
-        yield b
-        for c in b._children.values():
-            yield from _walk(c)
-
-    for b in _walk(net):
-        if isinstance(b, HybridBlock) and getattr(b, "_active", False):
-            hybridized.append(b)
-            b._active = False
-
-    # -- 1. calibration: hook every quantizable layer's input ------------
-    collector = LayerRangeCollector(mode=calib_mode)
-    handles = []
     targets = list(_iter_quantizable(net))
-    for parent, name, layer in targets:
-        def pre_hook(blk, inputs, _name=layer.name):
-            x = inputs[0]
-            collector.collect(_name, onp.asarray(
-                x.asnumpy() if isinstance(x, NDArray) else x))
-        handles.append(layer.register_forward_pre_hook(pre_hook))
-    n = 0
-    for batch in calib_data:
-        args = batch if isinstance(batch, (list, tuple)) else (batch,)
-        net(*args)
-        n += 1
-        if num_calib_batches is not None and n >= num_calib_batches:
-            break
-    for h in handles:
-        h.detach()
-    ranges = collector.ranges()
+
+    observer = None
+    if isinstance(calib_data, Observer):
+        observer = calib_data
+    elif isinstance(calib_data, dict) and calib_data and all(
+            isinstance(v, dict) and "counts" in v
+            for v in calib_data.values()):
+        observer = Observer(calib_data)
+
+    if observer is not None:
+        # -- 1a. calibrated ranges straight from the observer ------------
+        ranges = _ranges_for_layers(
+            observer.ranges(_quant_percentile(percentile)),
+            [layer.name for _p, _n, layer in targets])
+    else:
+        # -- 1b. legacy path: hook every quantizable layer's input -------
+        collector = LayerRangeCollector(mode=calib_mode)
+        handles = []
+        with _eager_tree(net):
+            for parent, name, layer in targets:
+                def pre_hook(blk, inputs, _name=layer.name):
+                    x = inputs[0]
+                    collector.collect(_name, onp.asarray(
+                        x.asnumpy() if isinstance(x, NDArray) else x))
+                handles.append(layer.register_forward_pre_hook(pre_hook))
+            try:
+                n = 0
+                for batch in calib_data:
+                    args = batch if isinstance(batch, (list, tuple)) \
+                        else (batch,)
+                    net(*args)
+                    n += 1
+                    if num_calib_batches is not None \
+                            and n >= num_calib_batches:
+                        break
+            finally:
+                for h in handles:
+                    h.detach()
+        ranges = collector.ranges()
 
     # -- 2. graph pass: swap layers for int8 versions ---------------------
     for parent, name, layer in targets:
@@ -449,10 +599,90 @@ def quantize_net(net, calib_data=None, calib_mode: str = "naive",
         if setattr_name:
             object.__setattr__(parent, setattr_name, qlayer)
 
-    # drop stale float executables; restore hybridize state
-    for b in _walk(net):
+    # drop stale float executables
+    for b in _walk_blocks(net):
         if isinstance(b, HybridBlock):
             b._clear_cached_op()
-    for b in hybridized:
-        b._active = True
     return net
+
+
+def quantize_model(model, observer, percentile: Optional[float] = None,
+                   exclude_layers: Sequence[str] = ()):
+    """Lower an :class:`Observer`'s calibrated ranges into a quantized
+    serving twin of a ``serve.CompiledModel``.
+
+    Returns a NEW ``CompiledModel`` over an int8 copy of the wrapped
+    block, inheriting the original's bucket table, input/output axes,
+    pad values, donation intent, and ``autotune_key`` — per-bucket AOT
+    warmup, donated request buffers, and banked autotune winners all
+    keep working, keyed exactly as before. The original model is NOT
+    touched: its block tree is deep-copied before the swap, so the
+    active float version keeps serving while the quantized candidate is
+    staged (and possibly rejected by the MX71x gate —
+    ``analysis.hlo.verify(..., quant=True)`` at ``ModelRegistry``
+    staging).
+
+    ``observer``: an :class:`Observer` or its ``to_table()`` dict.
+    ``percentile``: range-clipping percentile (default
+    ``MXTPU_QUANT_PERCENTILE`` env, else 99.99).
+    """
+    from ..gluon.block import HybridBlock
+    from ..serve.compiled import CompiledModel
+    if not isinstance(model, CompiledModel):
+        raise MXNetError("quantize_model takes a serve.CompiledModel "
+                         f"(got {type(model).__name__}); use quantize_net "
+                         "for a bare gluon block")
+    if model._mode != "block":
+        raise MXNetError("quantize_model needs a live-block CompiledModel; "
+                         "an imported artifact's graphs are already frozen "
+                         "— quantize before export")
+    if not (isinstance(observer, Observer)
+            or (isinstance(observer, dict) and observer)):
+        raise MXNetError("quantize_model needs an Observer (or its "
+                         "to_table() dict) — calibration provenance is "
+                         "exactly what the MX712 staging gate checks for")
+
+    # deep-copy the block tree with the uncopyable per-block state
+    # stripped: jit caches (compiled executables, stale after the swap
+    # anyway) and name scopes (threading.local); the original keeps its
+    # executables untouched
+    from ..gluon.block import _BlockScope
+    block = model._block
+    saved = []
+    for b in _walk_blocks(block):
+        jits = (b._jit_cache, b._cache_info) \
+            if isinstance(b, HybridBlock) else None
+        saved.append((b, jits, b._scope))
+        if jits is not None:
+            b._jit_cache, b._cache_info = {}, {}
+        b._scope = None
+    try:
+        twin = copy.deepcopy(block)
+    finally:
+        for b, jits, scope in saved:
+            if jits is not None:
+                b._jit_cache, b._cache_info = jits
+            b._scope = scope
+    for b in _walk_blocks(twin):
+        b._scope = _BlockScope(b)
+
+    quantize_net(twin, calib_data=observer, percentile=percentile,
+                 exclude_layers=exclude_layers)
+
+    # the copied signature/param caches describe the float tree; drop
+    # them so the CompiledModel warm-up below re-records the quantized
+    # tree (int8 Constants become real traced params)
+    for b in _walk_blocks(twin):
+        if isinstance(b, HybridBlock):
+            b._last_sig = None
+            b._warmed_up = False
+
+    example_args = [NDArray(jnp.zeros(shape, dtype=dtype))
+                    for shape, dtype in model._in_avals]
+    return CompiledModel(twin, model._table, model._input_axes,
+                         example_args=example_args,
+                         output_axes=model._output_axes,
+                         pad_values=list(model._pad_values),
+                         donate=model._donate_requested,
+                         ctx=model._ctx,
+                         autotune_key=model._autotune_key)

@@ -95,8 +95,12 @@ class CompiledModel:
         # _compile — same contract as ShardedTrainer, under the serving
         # ledger site "serve.compiled"
         from .. import autotune as _autotune
+        # the resolved key is kept so a derived build (e.g.
+        # quantization.quantize_model's int8 twin) can inherit it and
+        # keep consulting the same banked winner
+        self._autotune_key = autotune_key or type(block).__name__.lower()
         self.autotune_entry = _autotune.consult(
-            "serve.compiled", autotune_key or type(block).__name__.lower())
+            "serve.compiled", self._autotune_key)
         # in-graph numerics telemetry (MXTPU_NUMERICS, resolved ONCE at
         # build like the autotune consult): when enabled every bucket's
         # executable additionally returns per-site stat vectors —

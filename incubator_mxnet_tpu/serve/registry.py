@@ -297,7 +297,13 @@ class ModelRegistry:
                        ladder_peak_bytes=ladder, hbm_budget=budget,
                        buckets=peaks)
             source["ladder_peak_bytes"] = ladder
-            rep = _hlo.verify_trace(traced)
+            # quant=True: the MX71x dtype-flow family runs on every
+            # staged version — an un-calibrated (MX712) or
+            # silently-promoted (MX711) int8 build is rejected here,
+            # before its first device step, while the active version
+            # keeps serving; float builds have no quantize boundaries
+            # and pass through untouched
+            rep = _hlo.verify_trace(traced, quant=True)
             if rep.diagnostics or rep.skipped:
                 _tele.emit("serve.analysis", model=name, version=version,
                            **rep.summary_dict())

@@ -252,6 +252,18 @@ ENV_VARS: Dict[str, tuple] = {
                               "is not given (candidates enumerate in "
                               "deterministic space order and truncate "
                               "here)."),
+    "MXTPU_QUANT_PERCENTILE": ("99.99", "Calibration percentile the "
+                               "quantization Observer paths use when no "
+                               "explicit percentile is passed "
+                               "(quantization.quantize_model, "
+                               "Observer.ranges, models.quantized_smoke). "
+                               "100 = exact min/max (outlier-hostage "
+                               "ranges); 99.99 clips the histogram tail "
+                               "the TensorRT way."),
+    "MXTPU_INT8_FAMILY": ("lenet", "Quantized zoo family "
+                          "benchmark/int8_probe.py censuses for its "
+                          "per-bucket MX71x summary (any "
+                          "models.QUANT_FAMILIES member)."),
     "MXTPU_HBM_BUDGET": ("", "Per-chip device-memory budget in bytes "
                          "(K/M/G suffixes and float forms accepted). "
                          "When set: the MX709 hlo_memory pass errors on "

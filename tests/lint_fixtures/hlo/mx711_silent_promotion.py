@@ -1,0 +1,24 @@
+"""Seeded MX711: activations are dequantized BEFORE the matmul, so the
+contraction runs as a float ``dot_general`` — the int8 encoding bought
+nothing, silently. (The clean pattern keeps the dot on int8 operands and
+dequantizes the int32 accumulator after.) Co-emits MX715: with no int8
+matmul left in the graph, every boundary is pure churn."""
+import jax.numpy as jnp
+import numpy as onp
+
+from incubator_mxnet_tpu.ops import quantization as Q
+
+EXPECT = "MX711"
+
+
+def model():
+    rs = onp.random.RandomState(0)
+    w = rs.randn(16, 8).astype("float32")
+
+    def fn(x):
+        q, mn, mx = Q.quantize_v2(x, min_calib_range=-3.0,
+                                  max_calib_range=3.0)
+        deq = Q.dequantize(q, mn, mx)          # too early: before the dot
+        return jnp.dot(deq, jnp.asarray(w))    # float matmul — MX711
+
+    return fn, (rs.randn(4, 16).astype("float32"),)

@@ -1,0 +1,19 @@
+"""Seeded MX712: ``quantize_v2`` without calibration ranges takes the
+online branch — the scale is computed from a ``reduce_min``/``reduce_max``
+over the live activations inside the serving graph, so the encoding has
+no calibration provenance (and drifts with every batch)."""
+import numpy as onp
+
+from incubator_mxnet_tpu.ops import quantization as Q
+
+EXPECT = "MX712"
+
+
+def model():
+    rs = onp.random.RandomState(0)
+
+    def fn(x):
+        q, mn, mx = Q.quantize_v2(x)           # online ranges — MX712
+        return Q.dequantize(q, mn, mx)
+
+    return fn, (rs.randn(4, 16).astype("float32"),)

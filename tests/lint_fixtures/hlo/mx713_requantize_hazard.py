@@ -1,0 +1,23 @@
+"""Seeded MX713: quantize → dequantize → quantize again with no matmul
+or reduction in between — a double rounding that loses precision for
+free. (A real requantize — int32 accumulator rescaled to int8 after an
+int8 dot — stays clean: the backward slice stops at the matmul.)"""
+import numpy as onp
+
+from incubator_mxnet_tpu.ops import quantization as Q
+
+EXPECT = "MX713"
+
+
+def model():
+    rs = onp.random.RandomState(0)
+
+    def fn(x):
+        q1, mn1, mx1 = Q.quantize_v2(x, min_calib_range=-3.0,
+                                     max_calib_range=3.0)
+        d1 = Q.dequantize(q1, mn1, mx1)
+        q2, mn2, mx2 = Q.quantize_v2(d1, min_calib_range=-3.0,
+                                     max_calib_range=3.0)  # MX713
+        return Q.dequantize(q2, mn2, mx2)
+
+    return fn, (rs.randn(4, 16).astype("float32"),)

@@ -1,0 +1,22 @@
+"""Seeded MX714: a ``reduce_sum`` that accumulates IN int8 — 127 + 127
+wraps. The MXU contract is int8 operands, int32 accumulator
+(``preferred_element_type``); a reduction whose output dtype is int8
+accumulated in int8 the whole way."""
+import jax.numpy as jnp
+import numpy as onp
+
+from incubator_mxnet_tpu.ops import quantization as Q
+
+EXPECT = "MX714"
+
+
+def model():
+    rs = onp.random.RandomState(0)
+
+    def fn(x):
+        q, mn, mx = Q.quantize_v2(x, min_calib_range=-3.0,
+                                  max_calib_range=3.0)
+        s = jnp.sum(q, axis=1, dtype=jnp.int8)   # int8 accumulator — MX714
+        return s, mn, mx
+
+    return fn, (rs.randn(4, 16).astype("float32"),)

@@ -1,0 +1,20 @@
+"""Seeded MX715: a quantize/dequantize round-trip with NO int8 compute
+between the boundaries — all the convert traffic, none of the matmul
+savings. The boundary bytes (priced with the same element-width model as
+``analysis.hlo.cost``) strictly exceed the zero bytes saved."""
+import numpy as onp
+
+from incubator_mxnet_tpu.ops import quantization as Q
+
+EXPECT = "MX715"
+
+
+def model():
+    rs = onp.random.RandomState(0)
+
+    def fn(x):
+        q, mn, mx = Q.quantize_v2(x, min_calib_range=-3.0,
+                                  max_calib_range=3.0)
+        return Q.dequantize(q, mn, mx) * 2.0   # pure churn — MX715
+
+    return fn, (rs.randn(4, 16).astype("float32"),)

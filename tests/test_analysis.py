@@ -479,6 +479,17 @@ class TestDiagnosticRegistryAudit:
     def test_hlo_family_registered(self):
         from incubator_mxnet_tpu.analysis.diagnostics import CODES
         assert {f"MX70{i}" for i in range(1, 7)} <= set(CODES)
+        # the MX71x dtype-flow family: contiguous 710..715, severities
+        # split exactly as documented (711-713 gate, 714/715 warn,
+        # 710 is the opt-in info summary)
+        from incubator_mxnet_tpu.analysis.diagnostics import \
+            DEFAULT_SEVERITY
+        assert {f"MX71{i}" for i in range(6)} <= set(CODES)
+        assert DEFAULT_SEVERITY["MX710"] == "info"
+        for c in ("MX711", "MX712", "MX713"):
+            assert DEFAULT_SEVERITY[c] == "error"
+        for c in ("MX714", "MX715"):
+            assert DEFAULT_SEVERITY[c] == "warning"
 
 
 class TestSuppressions:
@@ -596,6 +607,58 @@ class TestHloPasses:
         monkeypatch.delenv("MXTPU_HBM_BUDGET")
         assert hlo.verify(entry, sample).codes() == []
 
+    @pytest.mark.parametrize("fixture", [
+        "mx711_silent_promotion.py",
+        "mx712_no_calibration.py",
+        "mx713_requantize_hazard.py",
+        "mx714_int8_accumulation.py",
+        "mx715_boundary_churn.py",
+    ])
+    def test_quant_fixture_flagged(self, fixture):
+        # the MX71x fixtures legitimately co-emit other MX71x findings
+        # (e.g. a graph whose only matmul runs in float is ALSO pure
+        # boundary churn), so the contract is membership + family
+        # confinement, not exclusivity
+        from incubator_mxnet_tpu.analysis import hlo
+        from incubator_mxnet_tpu.analysis.diagnostics import \
+            DEFAULT_SEVERITY
+        mod = _hlo_fixture(fixture)
+        entry, sample = mod.model()
+        rep = hlo.verify(entry, sample)
+        assert mod.EXPECT in rep.codes(), \
+            f"{fixture}: expected {mod.EXPECT}, got {rep.codes()}"
+        assert {d.code for d in rep} <= {f"MX71{i}" for i in range(6)}, \
+            f"{fixture}: out-of-family findings: {rep.codes()}"
+        assert DEFAULT_SEVERITY[mod.EXPECT] in \
+            {d.severity for d in rep if d.code == mod.EXPECT}
+
+    def test_quant_clean_ops_path_and_summary(self):
+        # the calibrated ops-level round-trip — int8 dot, int32
+        # accumulator, dequantize after — carries ZERO MX71x findings,
+        # and quant=True adds exactly the MX710 info summary
+        import jax.numpy as jnp
+        import numpy as onp
+        from incubator_mxnet_tpu.analysis import hlo
+        from incubator_mxnet_tpu.ops import quantization as Q
+        rs = onp.random.RandomState(0)
+        w = rs.randn(8, 16).astype("float32")   # (num_hidden, C)
+
+        def fn(x):
+            qw, wmn, wmx = Q.quantize_v2(jnp.asarray(w),
+                                         min_calib_range=-3.0,
+                                         max_calib_range=3.0)
+            qx, xmn, xmx = Q.quantize_v2(x, min_calib_range=-3.0,
+                                         max_calib_range=3.0)
+            acc, omn, omx = Q.quantized_fully_connected(
+                qx, qw, None, xmn, xmx, wmn, wmx, no_bias=True)
+            return Q.dequantize(acc, omn, omx)
+
+        sample = (rs.randn(4, 16).astype("float32"),)
+        assert hlo.verify(fn, sample).codes() == []
+        rep = hlo.verify(fn, sample, quant=True)
+        assert [d.code for d in rep] == ["MX710"]
+        assert rep.errors == [] and rep.warnings == []
+
     def test_error_severities(self):
         # MX701 (callback) and MX705 gate CI (error); the perf-shaped
         # findings ride as warnings
@@ -613,7 +676,7 @@ class TestHloPasses:
         assert names == ["hlo_transfer", "hlo_promotion", "hlo_dead_code",
                          "hlo_donation", "hlo_constants", "hlo_signature",
                          "hlo_mesh_step", "hlo_cost", "hlo_memory",
-                         "hlo_collective_schedule"]
+                         "hlo_quant", "hlo_collective_schedule"]
         with pytest.raises(MXNetError, match="unknown hlo pass"):
             hlo.run_hlo_passes([], names=["nope"])
 

@@ -165,6 +165,49 @@ class TestSearchDriver:
         assert deltas["flash-BK256"] == {"MXTPU_FLASH_BK": "256"}
         assert deltas["embed-onehot-grad"] == {"MXTPU_EMBED_ONEHOT_GRAD": "1"}
 
+    def test_quantize_dim_searched_and_deterministic(self):
+        """quantize ∈ {off, int8} is a real searched dimension: it is
+        declared LAST so it varies fastest, and a budget-truncated
+        serve-family search still covers both precisions. Same space →
+        same winner, same scores, twice."""
+        r1 = driver.search("bert_encoder", budget=2)
+        r2 = driver.search("bert_encoder", budget=2)
+        assert r1["winner"] == r2["winner"]
+        assert [row["score"] for row in r1["rows"]] \
+            == [row["score"] for row in r2["rows"]]
+        assert "quantize" in r1["dims"]
+        assert [row["config"]["quantize"] for row in r1["rows"]] \
+            == ["off", "int8"]
+        # the shipped quantized zoo is MX71x-clean, so both rows are
+        # electable and nothing lands in the quant-infeasible bucket
+        assert all(row["metrics"]["quant_errors"] == 0
+                   for row in r1["rows"])
+        assert all(row["feasible"] for row in r1["rows"])
+        assert r1["quant_infeasible"] == 0
+
+    def test_mx711_dirty_candidate_never_elected(self, monkeypatch):
+        """An int8 candidate whose quantized graphs carry MX71x errors
+        is scored and reported but NEVER elected — even when its proxy
+        score beats every float candidate (the gate excludes it, not the
+        ranking)."""
+        real = driver.evaluate
+
+        def dirty(family, cfg):
+            m = real(family, cfg)
+            if str(cfg.get("quantize", "off")) == "int8":
+                m = dict(m, quant_errors=1,
+                         tokens_per_step=m["tokens_per_step"] * 1000.0)
+            return m
+
+        monkeypatch.setattr(driver, "evaluate", dirty)
+        res = driver.search("bert_encoder", budget=4)
+        assert res["winner"]["quantize"] == "off"
+        assert res["quant_infeasible"] == 2
+        int8_rows = [r for r in res["rows"]
+                     if r["config"]["quantize"] == "int8"]
+        assert int8_rows and not any(r["feasible"] for r in int8_rows)
+        assert max(r["score"] for r in int8_rows) > res["winner_score"]
+
 
 # ---------------------------------------------------------------------------
 # consult-on-build (trainer + CompiledModel)

@@ -229,3 +229,22 @@ class TestProxyBench:
             {"lenet": rec}, banked["families"], banked["tolerance"])
         assert failures == [], failures
         assert warns == [], warns
+
+    def test_banked_int8_section_matches_current_tree(self):
+        # the additive "int8" section: one record per QUANT_FAMILIES
+        # calibrated twin, gated with the same keys as the f32 families,
+        # and the banked bytes ratio proves the quantization pays
+        banked_path = os.path.join(REPO, "PERF_PROXY.json")
+        with open(banked_path) as f:
+            banked = json.load(f)
+        assert set(banked["int8"]) \
+            == {f + "_int8" for f in models.QUANT_FAMILIES}
+        for fam, rec in banked["int8"].items():
+            assert 0 < rec["bytes_ratio_vs_f32"] < 1.0, fam
+            assert 0 < rec["ladder_peak_ratio_vs_f32"] < 1.0, fam
+        bench = _bench()
+        rec = bench._proxy_record_int8("lenet", iters=1)
+        failures, warns = bench._proxy_compare(
+            {"lenet_int8": rec}, banked["int8"], banked["tolerance"])
+        assert failures == [], failures
+        assert warns == [], warns

@@ -156,10 +156,12 @@ class _HloTargetError(Exception):
     propagate with their own traceback."""
 
 
-def _hlo_expand(targets):
+def _hlo_expand(targets, quantized=False):
     """``--hlo`` target list → [(label, entry, sample_args)]; families
     come from models.SERVE_SPECS, ``all`` expands to every family,
-    ``module:factory`` is imported and called."""
+    ``module:factory`` is imported and called. ``quantized=True``
+    resolves families through ``models.quantized_smoke`` instead (the
+    calibrated int8 zoo; ``all`` expands to ``models.QUANT_FAMILIES``)."""
     import importlib
 
     from incubator_mxnet_tpu import models
@@ -168,7 +170,8 @@ def _hlo_expand(targets):
     names = []
     for t in targets:
         if t == "all":
-            names.extend(sorted(models.SERVE_SPECS))
+            names.extend(sorted(models.QUANT_FAMILIES if quantized
+                                else models.SERVE_SPECS))
         else:
             names.append(t)
     for name in names:
@@ -184,9 +187,15 @@ def _hlo_expand(targets):
             entry, sample = made if isinstance(made, tuple) else (made, None)
             out.append((name, entry, sample))
         elif name in models.SERVE_SPECS:
+            if quantized and name not in models.QUANT_FAMILIES:
+                raise _HloTargetError(
+                    f"--hlo target {name!r} has no quantizable layers "
+                    f"(quantized zoo: {sorted(models.QUANT_FAMILIES)})")
             try:
-                out.append((name, models.hlo_smoke(name)["compiled"],
-                            None))
+                smoke = (models.quantized_smoke(name) if quantized
+                         else models.hlo_smoke(name))
+                out.append((name + ("_int8" if quantized else ""),
+                            smoke["compiled"], None))
             except KeyError as e:
                 # hlo_smoke's own "no smoke model" KeyError means a
                 # family was added to SERVE_SPECS without a smoke
@@ -235,6 +244,12 @@ def main(argv=None) -> int:
                          "transcendentals, fusion groups; --format=json "
                          "emits one {\"kind\": \"cost\", ...} object per "
                          "graph) and run the informational MX707 pass")
+    ap.add_argument("--quantized", action="store_true",
+                    help="with --hlo: lint the calibrated int8 zoo instead "
+                         "of the float one — families resolve through "
+                         "models.quantized_smoke ('all' expands to "
+                         "models.QUANT_FAMILIES) and the MX71x pass emits "
+                         "its per-region MX710 quantization summaries")
     ap.add_argument("--format", choices=("text", "json"), default="text",
                     help="finding output: human text (default) or one "
                          "JSON object per line (summary on stderr)")
@@ -250,6 +265,11 @@ def main(argv=None) -> int:
     if args.cost and not args.hlo:
         print("mxlint: --cost needs at least one --hlo target "
               "(the cost table prices compiled graphs)", file=sys.stderr)
+        return 2
+    if args.quantized and not args.hlo:
+        print("mxlint: --quantized needs at least one --hlo target "
+              "(the quantized zoo is a compiled-graph surface)",
+              file=sys.stderr)
         return 2
 
     import incubator_mxnet_tpu.analysis as analysis
@@ -297,7 +317,7 @@ def main(argv=None) -> int:
     if args.hlo:
         from incubator_mxnet_tpu.base import MXNetError
         try:
-            hlo_targets = _hlo_expand(args.hlo)
+            hlo_targets = _hlo_expand(args.hlo, quantized=args.quantized)
         except _HloTargetError as e:
             print(f"mxlint: {e}", file=sys.stderr)
             return 2
@@ -308,8 +328,8 @@ def main(argv=None) -> int:
                 # table price the SAME TracedGraph records, so the CLI
                 # and the CI perf-proxy gate can never disagree
                 traced = analysis.hlo.trace_entry(entry, sample)
-                report.extend(analysis.hlo.verify_trace(traced,
-                                                        cost=args.cost))
+                report.extend(analysis.hlo.verify_trace(
+                    traced, cost=args.cost, quant=args.quantized))
                 if args.cost:
                     cost_rows.extend(
                         (label, c) for c in
